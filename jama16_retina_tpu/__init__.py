@@ -1,0 +1,22 @@
+"""jama16_retina_tpu — a TPU-native (JAX/XLA/pjit/pallas) training and
+evaluation framework with the capabilities of the JAMA-2016 diabetic
+retinopathy replication (`MasatoAkiyama/jama16-retina-replication`).
+
+The reference repo's capability surface (see /root/repo/SURVEY.md and
+BASELINE.json `north_star`) is: offline fundus preprocessing of Kaggle
+EyePACS and Messidor-2 into sharded TFRecords; `train.py`/`evaluate.py`
+entry points with a `--device` backend gate; an Inception-v3 builder
+(TF-Slim in the reference → Flax here) with binary referable-DR and
+5-class ICDR heads; data-parallel training with gradient allreduce and
+cross-replica BatchNorm over ICI; early stopping on validation AUC with
+best-checkpoint saving; 10-model averaged-logit ensembles; and a
+backend-agnostic evaluation layer reporting ROC-AUC and
+sensitivity-at-fixed-specificity operating points.
+
+This package is a ground-up TPU-first redesign, not a port: all hot-loop
+compute is a single XLA program per step (jit/shard_map over a
+`jax.sharding.Mesh`), collectives ride ICI via `lax.psum`/`pmean`, and
+optional pallas kernels cover fused elementwise hot spots.
+"""
+
+__version__ = "0.1.0"

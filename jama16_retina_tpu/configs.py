@@ -1,0 +1,226 @@
+"""Typed configuration system (SURVEY.md N2).
+
+The reference uses per-script argparse flags (SURVEY.md §5.6); here the
+same surface is expressed as frozen dataclasses plus named presets
+matching the five BASELINE.json configs (BASELINE.json:7-11):
+
+  * ``eyepacs_binary``   — Inception-v3 binary referable-DR, 299x299, batch 32
+  * ``messidor2_eval``   — Messidor-2 held-out eval at sens@spec {0.87, 0.98}
+  * ``icdr5``            — 5-class ICDR severity grading (multi:softmax)
+  * ``ensemble10``       — 10-model ensemble with averaged logits
+  * ``resnet50`` / ``efficientnet_b4`` — backbone swap under same train loop
+
+CLI flags (absl) override individual fields; see train.py / evaluate.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model architecture + head selection (reference: TF-Slim inception_v3)."""
+
+    arch: str = "inception_v3"  # inception_v3 | resnet50 | efficientnet_b4 | tiny_cnn
+    # "binary" -> 1-logit sigmoid referable-DR head (ICDR grade >= 2);
+    # "multi"  -> 5-logit softmax ICDR severity head (BASELINE.json:9).
+    head: str = "binary"
+    image_size: int = 299
+    dropout_rate: float = 0.2
+    # bfloat16 matmuls/convs with float32 BN statistics and loss: the
+    # TPU-native numerics policy (MXU-friendly; SURVEY.md §7.7).
+    compute_dtype: str = "bfloat16"
+    # Auxiliary logits head, mirroring TF-Slim inception_v3's aux head.
+    aux_head: bool = True
+    aux_weight: float = 0.4
+
+    @property
+    def num_classes(self) -> int:
+        """Derived from head, never stored — cannot desync via overrides."""
+        return 5 if self.head == "multi" else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Input pipeline config (reference: lib/dataset tf.data over TFRecords)."""
+
+    train_dir: str = ""
+    val_dir: str = ""
+    test_dir: str = ""
+    batch_size: int = 32  # global batch across all devices (BASELINE.json:7)
+    image_size: int = 299
+    shuffle_buffer: int = 4096
+    prefetch_batches: int = 2
+    # Augmentation mirrors the reference's online pipeline: random
+    # horizontal/vertical flips plus brightness/contrast/saturation/hue
+    # jitter (SURVEY.md R5). Executed in JAX on-device so it fuses into
+    # the step's XLA program instead of burning host CPU.
+    augment: bool = True
+    flip: bool = True
+    brightness_delta: float = 0.25
+    contrast_range: tuple[float, float] = (0.75, 1.25)
+    saturation_range: tuple[float, float] = (0.8, 1.2)
+    hue_delta: float = 0.05
+    rotate: bool = True  # fundus images have rotational symmetry
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Train-loop config (reference: train.py session loop, SURVEY.md §3.1)."""
+
+    steps: int = 30000
+    eval_every: int = 500
+    log_every: int = 50
+    learning_rate: float = 1e-3
+    lr_schedule: str = "cosine"  # constant | cosine | warmup_cosine
+    warmup_steps: int = 500
+    weight_decay: float = 4e-5
+    optimizer: str = "adamw"  # adamw | sgdm | rmsprop
+    momentum: float = 0.9
+    # Early stopping on validation AUC (reference: stop after `patience`
+    # evals without a new best; keep best checkpoint).
+    early_stop_patience: int = 10
+    min_delta: float = 1e-4
+    seed: int = 0
+    checkpoint_dir: str = "/tmp/retina_ckpt"
+    max_to_keep: int = 3
+    resume: bool = False
+    # loss-scale epsilon for label smoothing on the multi head
+    label_smoothing: float = 0.0
+    gradient_clip_norm: float = 0.0  # 0 disables
+    # Number of independently seeded ensemble members the train driver
+    # produces (reference trains k=10, BASELINE.json:10). 1 = single model.
+    ensemble_size: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh config (SURVEY.md N7-N9).
+
+    The workload is data-parallel only (SURVEY.md N10: Inception-v3 at
+    ~24M params fits trivially per chip); ``data_axis`` is the one mesh
+    axis. ``model_axis_size`` is the documented extension seam for a
+    future model axis — kept at 1.
+    """
+
+    data_axis: str = "data"
+    num_devices: int = 0  # 0 = all local devices
+    model_axis_size: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalConfig:
+    """Evaluation config (reference: evaluate.py, SURVEY.md §3.2)."""
+
+    batch_size: int = 64
+    # Operating points: thresholds chosen on the ROC curve at fixed
+    # specificity (BASELINE.json:8).
+    operating_specificities: tuple[float, float] = (0.87, 0.98)
+    # Ensemble: list of checkpoint dirs whose probabilities are averaged
+    # (BASELINE.json:10 "averaged logits").
+    ensemble_dirs: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "eyepacs_binary"
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
+
+    def replace(self, **sections) -> "ExperimentConfig":
+        return dataclasses.replace(self, **sections)
+
+
+def _preset_eyepacs_binary() -> ExperimentConfig:
+    return ExperimentConfig(name="eyepacs_binary")
+
+
+def _preset_messidor2_eval() -> ExperimentConfig:
+    return ExperimentConfig(
+        name="messidor2_eval",
+        eval=EvalConfig(operating_specificities=(0.87, 0.98)),
+    )
+
+
+def _preset_icdr5() -> ExperimentConfig:
+    return ExperimentConfig(
+        name="icdr5",
+        model=ModelConfig(head="multi"),
+        train=TrainConfig(label_smoothing=0.1),
+    )
+
+
+def _preset_ensemble10() -> ExperimentConfig:
+    return ExperimentConfig(name="ensemble10", train=TrainConfig(ensemble_size=10))
+
+
+def _preset_resnet50() -> ExperimentConfig:
+    return ExperimentConfig(name="resnet50", model=ModelConfig(arch="resnet50"))
+
+
+def _preset_efficientnet_b4() -> ExperimentConfig:
+    return ExperimentConfig(
+        name="efficientnet_b4", model=ModelConfig(arch="efficientnet_b4")
+    )
+
+
+def _preset_smoke() -> ExperimentConfig:
+    """Tiny config for tests/CI: small model, few steps."""
+    return ExperimentConfig(
+        name="smoke",
+        model=ModelConfig(arch="tiny_cnn", image_size=64, aux_head=False),
+        data=DataConfig(batch_size=8, image_size=64, shuffle_buffer=64),
+        train=TrainConfig(
+            steps=50, eval_every=25, log_every=10, learning_rate=3e-3,
+            warmup_steps=5, early_stop_patience=100,
+        ),
+        eval=EvalConfig(batch_size=8),
+    )
+
+
+PRESETS = {
+    "eyepacs_binary": _preset_eyepacs_binary,
+    "messidor2_eval": _preset_messidor2_eval,
+    "icdr5": _preset_icdr5,
+    "ensemble10": _preset_ensemble10,
+    "resnet50": _preset_resnet50,
+    "efficientnet_b4": _preset_efficientnet_b4,
+    "smoke": _preset_smoke,
+}
+
+
+def get_config(name: str) -> ExperimentConfig:
+    if name not in PRESETS:
+        raise ValueError(
+            f"unknown config preset {name!r}; available: {sorted(PRESETS)}"
+        )
+    return PRESETS[name]()
+
+
+def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
+    """Apply ``section.field=value`` overrides (CLI --set flags)."""
+    for item in dotted:
+        key, _, raw = item.partition("=")
+        section_name, _, field = key.partition(".")
+        section = getattr(cfg, section_name)
+        current = getattr(section, field)
+        if isinstance(current, bool):
+            value: object = raw.lower() in ("1", "true", "yes")
+        elif isinstance(current, int):
+            value = int(raw)
+        elif isinstance(current, float):
+            value = float(raw)
+        elif isinstance(current, tuple):
+            parts = [p for p in raw.split(",") if p]
+            elem = type(current[0]) if current else str
+            value = tuple(elem(p) for p in parts)
+        else:
+            value = raw
+        section = dataclasses.replace(section, **{field: value})
+        cfg = dataclasses.replace(cfg, **{section_name: section})
+    return cfg

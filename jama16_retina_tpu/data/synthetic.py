@@ -1,0 +1,124 @@
+"""Synthetic fundus-image generator (test/bench fixture, SURVEY.md §4).
+
+No EyePACS/Messidor data exists in this environment (SURVEY.md §2.3), so
+tests and benchmarks run on procedurally generated fundus-like images: a
+bright circular retina disc on black background, an optic-disc highlight,
+vessel-like arcs, and — crucially — ICDR-grade-correlated lesions
+(microaneurysm dots / hemorrhage blobs) whose count scales with grade.
+That correlation makes the binary referable-DR task *learnable*, so
+integration tests can assert real AUC lift rather than just loss motion.
+
+Pure numpy; cv2 only used by callers that want JPEG bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SynthConfig:
+    image_size: int = 299
+    min_radius_frac: float = 0.40  # fundus radius as fraction of image size
+    max_radius_frac: float = 0.48
+    lesions_per_grade: int = 6
+    lesion_radius: int = 3
+
+
+def _disc_mask(size: int, cx: float, cy: float, r: float) -> np.ndarray:
+    yy, xx = np.mgrid[0:size, 0:size]
+    return ((xx - cx) ** 2 + (yy - cy) ** 2) <= r * r
+
+
+def render_fundus(
+    rng: np.random.Generator, grade: int, cfg: SynthConfig
+) -> np.ndarray:
+    """Render one uint8 RGB fundus-like image for an ICDR grade in [0, 4]."""
+    s = cfg.image_size
+    img = np.zeros((s, s, 3), dtype=np.float32)
+
+    r = rng.uniform(cfg.min_radius_frac, cfg.max_radius_frac) * s
+    cx = s / 2 + rng.uniform(-0.03, 0.03) * s
+    cy = s / 2 + rng.uniform(-0.03, 0.03) * s
+    disc = _disc_mask(s, cx, cy, r)
+
+    # Retina base color: orange-red with radial shading.
+    yy, xx = np.mgrid[0:s, 0:s]
+    dist = np.sqrt((xx - cx) ** 2 + (yy - cy) ** 2) / max(r, 1.0)
+    shade = np.clip(1.0 - 0.35 * dist, 0.0, 1.0)
+    base = np.array([0.82, 0.42, 0.18], dtype=np.float32)
+    base = base * rng.uniform(0.85, 1.15, size=3)
+    img[disc] = (shade[disc, None] * base[None, :]) * 255.0
+
+    # Optic disc: bright yellowish circle off-center.
+    od_r = r * rng.uniform(0.10, 0.14)
+    od_cx = cx + rng.choice([-1, 1]) * r * 0.55
+    od_cy = cy + rng.uniform(-0.15, 0.15) * r
+    od = _disc_mask(s, od_cx, od_cy, od_r) & disc
+    img[od] = np.array([235.0, 210.0, 140.0], dtype=np.float32)
+
+    # Vessel-like dark arcs from the optic disc.
+    n_vessels = rng.integers(3, 6)
+    t = np.linspace(0, 1, 220)
+    for _ in range(n_vessels):
+        ang = rng.uniform(0, 2 * np.pi)
+        curve = rng.uniform(-2.0, 2.0)
+        px = od_cx + t * r * 1.6 * np.cos(ang + curve * t)
+        py = od_cy + t * r * 1.6 * np.sin(ang + curve * t)
+        pts = np.stack([py, px], axis=1).astype(np.int64)
+        ok = (
+            (pts[:, 0] >= 0) & (pts[:, 0] < s) & (pts[:, 1] >= 0) & (pts[:, 1] < s)
+        )
+        pts = pts[ok]
+        inside = disc[pts[:, 0], pts[:, 1]]
+        pts = pts[inside]
+        for dy in (-1, 0, 1):
+            yyv = np.clip(pts[:, 0] + dy, 0, s - 1)
+            img[yyv, pts[:, 1]] *= 0.55
+
+    # Grade-correlated lesions: dark red dots (count ~ grade), plus pale
+    # exudate blobs for grades >= 3. This is the learnable signal.
+    n_lesions = int(grade) * cfg.lesions_per_grade + int(rng.integers(0, 3))
+    for _ in range(n_lesions):
+        ang = rng.uniform(0, 2 * np.pi)
+        rad = rng.uniform(0.1, 0.9) * r
+        lx, ly = cx + rad * np.cos(ang), cy + rad * np.sin(ang)
+        lr = cfg.lesion_radius * rng.uniform(0.7, 1.6)
+        lm = _disc_mask(s, lx, ly, lr) & disc
+        img[lm] = np.array([95.0, 18.0, 12.0], dtype=np.float32)
+    if grade >= 3:
+        for _ in range(int(grade)):
+            ang = rng.uniform(0, 2 * np.pi)
+            rad = rng.uniform(0.2, 0.8) * r
+            lx, ly = cx + rad * np.cos(ang), cy + rad * np.sin(ang)
+            lm = _disc_mask(s, lx, ly, cfg.lesion_radius * 2.2) & disc
+            img[lm] = np.array([230.0, 220.0, 160.0], dtype=np.float32)
+
+    # Sensor noise.
+    img += rng.normal(0.0, 4.0, size=img.shape).astype(np.float32)
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def make_dataset(
+    n: int,
+    cfg: SynthConfig | None = None,
+    grades: np.ndarray | None = None,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Generate (images[n,s,s,3] uint8, grades[n] int32). Grade marginals
+    roughly follow EyePACS's skew toward grade 0 unless `grades` given."""
+    cfg = cfg or SynthConfig()
+    rng = np.random.default_rng(seed)
+    if grades is None:
+        grades = rng.choice(5, size=n, p=[0.55, 0.15, 0.15, 0.08, 0.07])
+    grades = np.asarray(grades, dtype=np.int32)
+    images = np.stack([render_fundus(rng, int(g), cfg) for g in grades])
+    return images, grades
+
+
+def binary_labels(grades: np.ndarray) -> np.ndarray:
+    """ICDR grade -> binary referable-DR label (grade >= 2 referable),
+    the reference's grade binning (SURVEY.md R3, BASELINE.json:7)."""
+    return (np.asarray(grades) >= 2).astype(np.int32)

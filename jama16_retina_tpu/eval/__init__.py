@@ -1,0 +1,1 @@
+from jama16_retina_tpu.eval import metrics  # noqa: F401

@@ -1,0 +1,214 @@
+"""Backend-agnostic evaluation metrics (SURVEY.md N11, reference R8).
+
+The reference's eval layer computes ROC-AUC and sensitivity at fixed
+specificity operating points (specificity 0.87 and 0.98, BASELINE.json:8)
+plus ensemble probability averaging (BASELINE.json:10). Everything here is
+pure numpy on host-gathered probabilities so the same code serves any
+training backend ("evaluation code is untouched", BASELINE.json:5) and is
+directly checkable against scikit-learn in tests.
+
+All functions accept 1-D numpy arrays; probabilities are P(positive).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+
+def roc_curve(labels: np.ndarray, scores: np.ndarray):
+    """ROC curve via single descending sort (O(n log n)).
+
+    Returns (fpr, tpr, thresholds) with one point per distinct score,
+    matching sklearn.metrics.roc_curve's convention of prepending the
+    (0, 0) point with threshold +inf.
+    """
+    labels = np.asarray(labels).astype(np.float64).ravel()
+    scores = np.asarray(scores).astype(np.float64).ravel()
+    if labels.shape != scores.shape:
+        raise ValueError("labels and scores must have the same shape")
+    if not np.all((labels == 0.0) | (labels == 1.0)):
+        raise ValueError(
+            "roc_curve expects binary labels in {0, 1}; got values "
+            f"{np.unique(labels)[:6]} — binarize grades first "
+            "(e.g. synthetic.binary_labels)"
+        )
+    order = np.argsort(-scores, kind="stable")
+    labels = labels[order]
+    scores = scores[order]
+
+    # Cumulative TP/FP counts at each distinct-score cut.
+    distinct = np.where(np.diff(scores))[0]
+    cut = np.r_[distinct, labels.size - 1]
+    tps = np.cumsum(labels)[cut]
+    fps = (cut + 1) - tps
+    p = tps[-1] if tps.size else 0.0
+    n = fps[-1] if fps.size else 0.0
+    if p == 0 or n == 0:
+        raise ValueError("roc_curve needs at least one positive and one negative")
+    tpr = np.r_[0.0, tps / p]
+    fpr = np.r_[0.0, fps / n]
+    thresholds = np.r_[np.inf, scores[cut]]
+    return fpr, tpr, thresholds
+
+
+def roc_auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Area under the ROC curve (trapezoidal; ties handled via the curve)."""
+    fpr, tpr, _ = roc_curve(labels, scores)
+    return float(np.trapezoid(tpr, fpr))
+
+
+@dataclasses.dataclass(frozen=True)
+class OperatingPoint:
+    """Threshold chosen at a fixed specificity (reference operating points)."""
+
+    target_specificity: float
+    threshold: float
+    sensitivity: float
+    specificity: float
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def sensitivity_at_specificity(
+    labels: np.ndarray, scores: np.ndarray, target_specificity: float
+) -> OperatingPoint:
+    """Pick the ROC threshold with specificity >= target that maximizes
+    sensitivity; report achieved sens/spec at that threshold.
+
+    This is the reference's operating-point selection (BASELINE.json:8):
+    on the ROC curve, specificity = 1 - fpr, so we take the largest fpr
+    with 1 - fpr >= target (ties on the curve already resolved toward
+    higher tpr by construction).
+    """
+    fpr, tpr, thresholds = roc_curve(labels, scores)
+    spec = 1.0 - fpr
+    feasible = np.where(spec >= target_specificity)[0]
+    if feasible.size == 0:  # unreachable: the (0,0) point has spec 1.0
+        feasible = np.array([0])
+    best = feasible[np.argmax(tpr[feasible])]
+    return OperatingPoint(
+        target_specificity=float(target_specificity),
+        threshold=float(thresholds[best]),
+        sensitivity=float(tpr[best]),
+        specificity=float(spec[best]),
+    )
+
+
+def confusion_at_threshold(
+    labels: np.ndarray, scores: np.ndarray, threshold: float
+) -> dict:
+    labels = np.asarray(labels).ravel().astype(bool)
+    pred = np.asarray(scores).ravel() >= threshold
+    tp = int(np.sum(pred & labels))
+    fp = int(np.sum(pred & ~labels))
+    fn = int(np.sum(~pred & labels))
+    tn = int(np.sum(~pred & ~labels))
+    return {
+        "tp": tp, "fp": fp, "fn": fn, "tn": tn,
+        "sensitivity": tp / max(tp + fn, 1),
+        "specificity": tn / max(tn + fp, 1),
+        "precision": tp / max(tp + fp, 1),
+        "accuracy": (tp + tn) / max(tp + fp + fn + tn, 1),
+    }
+
+
+def brier_score(labels: np.ndarray, scores: np.ndarray) -> float:
+    labels = np.asarray(labels, dtype=np.float64).ravel()
+    scores = np.asarray(scores, dtype=np.float64).ravel()
+    return float(np.mean((scores - labels) ** 2))
+
+
+def ensemble_average(prob_list: Sequence[np.ndarray]) -> np.ndarray:
+    """Averaged per-model probabilities (reference's "averaged logits",
+    BASELINE.json:10 — the replication averaged the models' sigmoid
+    outputs linearly)."""
+    if not prob_list:
+        raise ValueError("empty ensemble")
+    stacked = np.stack([np.asarray(p, dtype=np.float64) for p in prob_list])
+    return np.mean(stacked, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# 5-class ICDR severity metrics (BASELINE.json:9 "multi:softmax")
+# ---------------------------------------------------------------------------
+
+
+def multiclass_accuracy(labels: np.ndarray, probs: np.ndarray) -> float:
+    pred = np.argmax(np.asarray(probs), axis=-1)
+    return float(np.mean(pred == np.asarray(labels).ravel()))
+
+
+def confusion_matrix(labels: np.ndarray, preds: np.ndarray, num_classes: int) -> np.ndarray:
+    labels = np.asarray(labels).ravel().astype(np.int64)
+    preds = np.asarray(preds).ravel().astype(np.int64)
+    cm = np.zeros((num_classes, num_classes), dtype=np.int64)
+    np.add.at(cm, (labels, preds), 1)
+    return cm
+
+
+def quadratic_weighted_kappa(
+    labels: np.ndarray, preds: np.ndarray, num_classes: int = 5
+) -> float:
+    """Quadratic-weighted Cohen's kappa — the standard ordinal agreement
+    metric for ICDR grading (used by the Kaggle EyePACS competition)."""
+    cm = confusion_matrix(labels, preds, num_classes).astype(np.float64)
+    n = cm.sum()
+    if n == 0:
+        return 0.0
+    idx = np.arange(num_classes, dtype=np.float64)
+    w = (idx[:, None] - idx[None, :]) ** 2 / (num_classes - 1) ** 2
+    row = cm.sum(axis=1)
+    col = cm.sum(axis=0)
+    expected = np.outer(row, col) / n
+    denom = np.sum(w * expected)
+    if denom == 0:
+        return 0.0
+    return float(1.0 - np.sum(w * cm) / denom)
+
+
+def referable_probs_from_multiclass(probs: np.ndarray) -> np.ndarray:
+    """Collapse 5-class ICDR probabilities to P(referable DR) = P(grade>=2),
+    so binary operating-point reporting works for the multi head too."""
+    probs = np.asarray(probs, dtype=np.float64)
+    return probs[..., 2:].sum(axis=-1)
+
+
+def evaluation_report(
+    labels: np.ndarray,
+    probs: np.ndarray,
+    operating_specificities: Sequence[float] = (0.87, 0.98),
+) -> dict:
+    """The reference's final eval report shape: AUC plus one row per
+    operating point (SURVEY.md §3.2), identical format for every backend."""
+    labels = np.asarray(labels).ravel()
+    probs = np.asarray(probs)
+    if probs.ndim == 2 and probs.shape[-1] == 2:
+        raise ValueError(
+            "2-column probabilities are ambiguous; pass P(positive) as a "
+            "1-D array for the binary head (probs[:, 1])"
+        )
+    if probs.ndim == 2 and probs.shape[-1] > 2:  # 5-class ICDR head
+        binary_labels = (labels >= 2).astype(np.float64)
+        binary_probs = referable_probs_from_multiclass(probs)
+        report = {
+            "accuracy": multiclass_accuracy(labels, probs),
+            "quadratic_weighted_kappa": quadratic_weighted_kappa(
+                labels, np.argmax(probs, axis=-1), probs.shape[-1]
+            ),
+        }
+    else:
+        binary_labels = labels.astype(np.float64)
+        binary_probs = probs.ravel()
+        report = {}
+    report["auc"] = roc_auc(binary_labels, binary_probs)
+    report["brier"] = brier_score(binary_labels, binary_probs)
+    report["n_examples"] = int(binary_labels.size)
+    report["operating_points"] = [
+        sensitivity_at_specificity(binary_labels, binary_probs, s).as_dict()
+        for s in operating_specificities
+    ]
+    return report

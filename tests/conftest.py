@@ -1,0 +1,22 @@
+"""Test configuration: force the CPU backend with 8 fake XLA devices.
+
+SURVEY.md §4.3: multi-device behavior is tested without a pod via
+``--xla_force_host_platform_device_count``. Must run before jax imports.
+The real-TPU path is exercised separately by bench.py / __graft_entry__.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep TF (used only for tf.data/TFRecord on host) off any accelerator.
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,117 @@
+"""Metrics layer vs scikit-learn (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+from hypothesis import given, settings, strategies as st
+
+from jama16_retina_tpu.eval import metrics
+
+
+def _random_problem(seed, n=500):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, size=n)
+    # scores correlated with labels but noisy, with ties sprinkled in
+    scores = np.round(labels * 0.4 + rng.normal(0.3, 0.35, size=n), 2)
+    if labels.min() == labels.max():  # ensure both classes present
+        labels[0] = 1 - labels[0]
+    return labels, scores
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_auc_matches_sklearn(seed):
+    labels, scores = _random_problem(seed)
+    assert metrics.roc_auc(labels, scores) == pytest.approx(
+        skm.roc_auc_score(labels, scores), abs=1e-12
+    )
+
+
+@given(st.integers(min_value=0, max_value=10_000))
+@settings(max_examples=25, deadline=None)
+def test_auc_matches_sklearn_hypothesis(seed):
+    labels, scores = _random_problem(seed, n=120)
+    assert metrics.roc_auc(labels, scores) == pytest.approx(
+        skm.roc_auc_score(labels, scores), abs=1e-12
+    )
+
+
+def test_roc_curve_matches_sklearn():
+    labels, scores = _random_problem(3)
+    fpr, tpr, thr = metrics.roc_curve(labels, scores)
+    sk_fpr, sk_tpr, sk_thr = skm.roc_curve(labels, scores, drop_intermediate=False)
+    np.testing.assert_allclose(fpr, sk_fpr, atol=1e-12)
+    np.testing.assert_allclose(tpr, sk_tpr, atol=1e-12)
+    np.testing.assert_allclose(thr[1:], sk_thr[1:], atol=1e-12)
+
+
+def test_perfect_and_inverted_auc():
+    labels = np.array([0, 0, 1, 1])
+    assert metrics.roc_auc(labels, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert metrics.roc_auc(labels, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+
+
+def test_degenerate_labels_raise():
+    with pytest.raises(ValueError):
+        metrics.roc_auc(np.zeros(10), np.linspace(0, 1, 10))
+
+
+def test_sensitivity_at_specificity_feasible():
+    labels, scores = _random_problem(7, n=2000)
+    for target in (0.87, 0.98):
+        op = metrics.sensitivity_at_specificity(labels, scores, target)
+        assert op.specificity >= target - 1e-12
+        # achieved sens/spec must agree with a direct confusion recount
+        cm = metrics.confusion_at_threshold(labels, scores, op.threshold)
+        assert cm["sensitivity"] == pytest.approx(op.sensitivity, abs=1e-12)
+        assert cm["specificity"] == pytest.approx(op.specificity, abs=1e-12)
+
+
+def test_sens_at_spec_monotone_in_target():
+    labels, scores = _random_problem(11, n=2000)
+    ops = [
+        metrics.sensitivity_at_specificity(labels, scores, t)
+        for t in (0.5, 0.87, 0.98)
+    ]
+    assert ops[0].sensitivity >= ops[1].sensitivity >= ops[2].sensitivity
+
+
+def test_ensemble_average():
+    a = np.array([0.2, 0.8])
+    b = np.array([0.4, 0.6])
+    np.testing.assert_allclose(metrics.ensemble_average([a, b]), [0.3, 0.7])
+    with pytest.raises(ValueError):
+        metrics.ensemble_average([])
+
+
+def test_quadratic_weighted_kappa_matches_sklearn():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 5, size=400)
+    preds = np.clip(labels + rng.integers(-1, 2, size=400), 0, 4)
+    ours = metrics.quadratic_weighted_kappa(labels, preds, 5)
+    theirs = skm.cohen_kappa_score(labels, preds, weights="quadratic")
+    assert ours == pytest.approx(theirs, abs=1e-12)
+    assert metrics.quadratic_weighted_kappa(labels, labels, 5) == 1.0
+
+
+def test_referable_collapse():
+    probs = np.array([[0.5, 0.3, 0.1, 0.05, 0.05], [0.0, 0.1, 0.4, 0.3, 0.2]])
+    np.testing.assert_allclose(
+        metrics.referable_probs_from_multiclass(probs), [0.2, 0.9]
+    )
+
+
+def test_evaluation_report_binary_and_multi():
+    rng = np.random.default_rng(5)
+    grades = rng.integers(0, 5, size=300)
+    probs5 = rng.dirichlet(np.ones(5), size=300)
+    # bias probs toward the true grade so AUC is informative
+    probs5[np.arange(300), grades] += 1.0
+    probs5 /= probs5.sum(axis=1, keepdims=True)
+    rep = metrics.evaluation_report(grades, probs5)
+    assert {"auc", "accuracy", "quadratic_weighted_kappa", "operating_points"} <= set(rep)
+    assert len(rep["operating_points"]) == 2
+    assert rep["auc"] > 0.6
+
+    binary = (grades >= 2).astype(int)
+    rep2 = metrics.evaluation_report(binary, probs5[:, 2:].sum(axis=1))
+    assert rep2["auc"] == pytest.approx(rep["auc"], abs=1e-12)

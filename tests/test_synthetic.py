@@ -1,0 +1,46 @@
+"""Synthetic fundus fixture sanity (SURVEY.md §4 fixtures)."""
+
+import numpy as np
+
+from jama16_retina_tpu.data import synthetic
+
+
+def test_shapes_dtype_and_determinism():
+    imgs, grades = synthetic.make_dataset(8, synthetic.SynthConfig(image_size=64), seed=3)
+    assert imgs.shape == (8, 64, 64, 3) and imgs.dtype == np.uint8
+    assert grades.shape == (8,) and set(np.unique(grades)) <= set(range(5))
+    imgs2, grades2 = synthetic.make_dataset(8, synthetic.SynthConfig(image_size=64), seed=3)
+    np.testing.assert_array_equal(imgs, imgs2)
+    np.testing.assert_array_equal(grades, grades2)
+
+
+def test_fundus_structure():
+    cfg = synthetic.SynthConfig(image_size=128)
+    rng = np.random.default_rng(0)
+    img = synthetic.render_fundus(rng, 0, cfg)
+    # corners are (near-)black background; center is bright retina
+    assert img[:8, :8].mean() < 20
+    assert img[60:68, 60:68].mean() > 60
+
+
+def test_grade_signal_present():
+    """Higher grades must carry more dark-lesion pixels — the learnable
+    signal integration tests rely on."""
+    cfg = synthetic.SynthConfig(image_size=128)
+
+    def lesion_frac(grade, seed):
+        rng = np.random.default_rng(seed)
+        img = synthetic.render_fundus(rng, grade, cfg).astype(np.int32)
+        # lesions are dark red: low green+blue, moderate red
+        mask = (img[..., 0] < 130) & (img[..., 0] > 50) & (img[..., 1] < 40)
+        return mask.mean()
+
+    g0 = np.mean([lesion_frac(0, s) for s in range(10)])
+    g4 = np.mean([lesion_frac(4, s) for s in range(10)])
+    assert g4 > g0 * 2
+
+
+def test_binary_labels():
+    np.testing.assert_array_equal(
+        synthetic.binary_labels(np.array([0, 1, 2, 3, 4])), [0, 0, 1, 1, 1]
+    )
