@@ -50,7 +50,8 @@ class DataConfig:
     val_dir: str = ""
     test_dir: str = ""
     batch_size: int = 32  # global batch across all devices (BASELINE.json:7)
-    image_size: int = 299
+    # NOTE: image size lives ONLY in ModelConfig.image_size; the pipeline
+    # reads it from there so the two can never desync via overrides.
     shuffle_buffer: int = 4096
     prefetch_batches: int = 2
     # Augmentation mirrors the reference's online pipeline: random
@@ -174,7 +175,7 @@ def _preset_smoke() -> ExperimentConfig:
     return ExperimentConfig(
         name="smoke",
         model=ModelConfig(arch="tiny_cnn", image_size=64, aux_head=False),
-        data=DataConfig(batch_size=8, image_size=64, shuffle_buffer=64),
+        data=DataConfig(batch_size=8, shuffle_buffer=64),
         train=TrainConfig(
             steps=50, eval_every=25, log_every=10, learning_rate=3e-3,
             warmup_steps=5, early_stop_patience=100,
@@ -205,22 +206,36 @@ def get_config(name: str) -> ExperimentConfig:
 def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
     """Apply ``section.field=value`` overrides (CLI --set flags)."""
     for item in dotted:
-        key, _, raw = item.partition("=")
-        section_name, _, field = key.partition(".")
-        section = getattr(cfg, section_name)
-        current = getattr(section, field)
-        if isinstance(current, bool):
-            value: object = raw.lower() in ("1", "true", "yes")
-        elif isinstance(current, int):
-            value = int(raw)
-        elif isinstance(current, float):
-            value = float(raw)
-        elif isinstance(current, tuple):
-            parts = [p for p in raw.split(",") if p]
-            elem = type(current[0]) if current else str
-            value = tuple(elem(p) for p in parts)
-        else:
-            value = raw
+        key, eq, raw = item.partition("=")
+        section_name, dot, field = key.partition(".")
+        if not eq or not dot or not field:
+            raise ValueError(
+                f"malformed override {item!r}; expected section.field=value "
+                "(e.g. train.steps=100)"
+            )
+        try:
+            section = getattr(cfg, section_name)
+            current = getattr(section, field)
+        except AttributeError as e:
+            raise ValueError(f"unknown config field in override {item!r}: {e}")
+        try:
+            if isinstance(current, bool):
+                value: object = raw.lower() in ("1", "true", "yes")
+            elif isinstance(current, int):
+                value = int(raw)
+            elif isinstance(current, float):
+                value = float(raw)
+            elif isinstance(current, tuple):
+                parts = [p for p in raw.split(",") if p]
+                elem = type(current[0]) if current else str
+                value = tuple(elem(p) for p in parts)
+            else:
+                value = raw
+        except ValueError:
+            raise ValueError(
+                f"bad value in override {item!r}: cannot parse {raw!r} as "
+                f"{type(current).__name__}"
+            )
         section = dataclasses.replace(section, **{field: value})
         cfg = dataclasses.replace(cfg, **{section_name: section})
     return cfg

@@ -29,6 +29,8 @@ def roc_curve(labels: np.ndarray, scores: np.ndarray):
     scores = np.asarray(scores).astype(np.float64).ravel()
     if labels.shape != scores.shape:
         raise ValueError("labels and scores must have the same shape")
+    if labels.size == 0:
+        raise ValueError("roc_curve needs at least one positive and one negative")
     if not np.all((labels == 0.0) | (labels == 1.0)):
         raise ValueError(
             "roc_curve expects binary labels in {0, 1}; got values "

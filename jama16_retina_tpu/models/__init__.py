@@ -1,0 +1,55 @@
+"""Model zoo behind the ``build()`` plugin boundary (SURVEY.md N5).
+
+The reference exposes a TF-Slim ``inception_v3`` graph builder; the north
+star (BASELINE.json:5) makes the model builder the plugin boundary so the
+surrounding train/eval code never sees architecture details. Here
+``build(model_cfg)`` returns a Flax module with one uniform call contract:
+
+    variables = model.init(rngs, images, train=False)
+    (logits, aux_logits), mutated = model.apply(
+        variables, images, train=True,
+        mutable=["batch_stats"], rngs={"dropout": key})
+
+``aux_logits`` is ``None`` for architectures without an auxiliary head.
+``axis_name`` threads the data-parallel mesh axis into BatchNorm for the
+explicit pmap/shard_map path; under jit-over-global-arrays it stays None
+because XLA GSPMD already computes global-batch statistics (SURVEY.md N8).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from jama16_retina_tpu.configs import ModelConfig
+from jama16_retina_tpu.models.efficientnet import EfficientNet
+from jama16_retina_tpu.models.inception_v3 import InceptionV3
+from jama16_retina_tpu.models.resnet import ResNet50
+from jama16_retina_tpu.models.tiny_cnn import TinyCNN
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+}
+
+
+def build(cfg: ModelConfig, axis_name: str | None = None):
+    """Construct the Flax module named by ``cfg.arch`` (reference R7)."""
+    dtype = _DTYPES[cfg.compute_dtype]
+    common = dict(
+        num_classes=cfg.num_classes,
+        dtype=dtype,
+        axis_name=axis_name,
+        dropout_rate=cfg.dropout_rate,
+    )
+    if cfg.arch == "inception_v3":
+        return InceptionV3(
+            aux_head=cfg.aux_head,
+            **common,
+        )
+    if cfg.arch == "resnet50":
+        return ResNet50(**common)
+    if cfg.arch == "efficientnet_b4":
+        return EfficientNet.b4(**common)
+    if cfg.arch == "tiny_cnn":
+        return TinyCNN(**common)
+    raise ValueError(f"unknown arch {cfg.arch!r}")

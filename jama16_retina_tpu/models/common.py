@@ -1,0 +1,69 @@
+"""Shared model building blocks with the TPU numerics policy.
+
+Policy (SURVEY.md §7.7): convolutions/matmuls run in ``dtype`` (bfloat16
+by default — MXU-native), while BatchNorm statistics and normalization
+run in float32. Parameters are always float32 (``param_dtype``).
+
+``axis_name`` mirrors the reference's cross-replica BatchNorm requirement
+(BASELINE.json:5 "cross-replica BatchNorm psum over ICI"): when the model
+runs under ``pmap``/``shard_map`` with a named data axis, BatchNorm batch
+moments are averaged over that axis so the 32-image *global* batch defines
+the statistics, not the per-replica slice (SURVEY.md §7 hard part b).
+Under ``jit`` over global arrays, the batch axis is one logical array and
+XLA GSPMD inserts the same all-reduce automatically, so ``axis_name`` must
+stay ``None`` there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# TF-Slim/keras InceptionV3 batch-norm hyperparameters: eps 1e-3 and no
+# learned scale (gamma) — relu follows immediately, making gamma redundant.
+BN_EPS = 1e-3
+BN_MOMENTUM = 0.9
+
+
+class ConvBN(nn.Module):
+    """Conv -> BatchNorm -> ReLU, the unit cell of every backbone here.
+
+    Matches the TF-Slim ``conv2d + batch_norm`` arg-scope cell the
+    reference's Inception-v3 is built from (SURVEY.md R7): no conv bias
+    (BN absorbs it), BN without scale, ReLU activation.
+    """
+
+    features: int
+    kernel: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: str = "SAME"
+    use_scale: bool = False
+    activation: Any = nn.relu
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(
+            self.features,
+            tuple(self.kernel),
+            strides=tuple(self.strides),
+            padding=self.padding,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPS,
+            use_scale=self.use_scale,
+            dtype=jnp.float32,
+            axis_name=self.axis_name if train else None,
+            name="bn",
+        )(x)
+        x = self.activation(x) if self.activation is not None else x
+        return x.astype(self.dtype)

@@ -1,0 +1,182 @@
+"""Flax EfficientNet — backbone swap option (BASELINE.json:11, SURVEY.md N5).
+
+From-scratch implementation of EfficientNet (Tan & Le 2019): MBConv
+inverted-bottleneck blocks with depthwise convs, squeeze-and-excitation,
+swish activation, and compound width/depth scaling. ``EfficientNet.b4``
+builds the B4 scaling (width 1.4, depth 1.8) the BASELINE config names.
+
+TPU notes: depthwise convs lower to XLA ``feature_group_count`` convs; SE
+is two tiny matmuls on the pooled vector (negligible); stochastic depth
+uses a per-block Bernoulli on the residual branch, traced once (no Python
+branching on data).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# (expand_ratio, kernel, stride, out_filters_b0, repeats_b0)
+_B0_BLOCKS = (
+    (1, 3, 1, 16, 1),
+    (6, 3, 2, 24, 2),
+    (6, 5, 2, 40, 2),
+    (6, 3, 2, 80, 3),
+    (6, 5, 1, 112, 3),
+    (6, 5, 2, 192, 4),
+    (6, 3, 1, 320, 1),
+)
+_SE_RATIO = 0.25
+_BN_MOMENTUM = 0.99  # EfficientNet's own BN momentum (not the Inception one)
+_BN_EPS = 1e-3
+
+
+def round_filters(filters: int, width_mult: float) -> int:
+    """EfficientNet channel rounding: nearest multiple of 8, never < 90%."""
+    filters *= width_mult
+    new = max(8, int(filters + 4) // 8 * 8)
+    if new < 0.9 * filters:
+        new += 8
+    return int(new)
+
+
+def round_repeats(repeats: int, depth_mult: float) -> int:
+    return int(math.ceil(depth_mult * repeats))
+
+
+class MBConv(nn.Module):
+    in_filters: int
+    out_filters: int
+    expand_ratio: int
+    kernel: int
+    strides: int
+    drop_rate: float
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        def bn(name):
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=_BN_MOMENTUM,
+                epsilon=_BN_EPS, use_scale=True, dtype=jnp.float32,
+                axis_name=self.axis_name if train else None, name=name,
+            )
+
+        inputs = x
+        expanded = self.in_filters * self.expand_ratio
+        if self.expand_ratio != 1:
+            x = nn.Conv(
+                expanded, (1, 1), use_bias=False, dtype=self.dtype,
+                param_dtype=jnp.float32, name="expand_conv",
+            )(x)
+            x = nn.swish(bn("expand_bn")(x)).astype(self.dtype)
+        # Depthwise conv.
+        x = nn.Conv(
+            expanded, (self.kernel, self.kernel),
+            strides=(self.strides, self.strides), padding="SAME",
+            feature_group_count=expanded, use_bias=False, dtype=self.dtype,
+            param_dtype=jnp.float32, name="depthwise_conv",
+        )(x)
+        x = nn.swish(bn("depthwise_bn")(x)).astype(self.dtype)
+        # Squeeze-and-excitation on the *unexpanded* input width.
+        se_filters = max(1, int(self.in_filters * _SE_RATIO))
+        se = x.mean(axis=(1, 2), keepdims=True)
+        se = nn.Conv(
+            se_filters, (1, 1), dtype=self.dtype, param_dtype=jnp.float32,
+            name="se_reduce",
+        )(se)
+        se = nn.swish(se)
+        se = nn.Conv(
+            expanded, (1, 1), dtype=self.dtype, param_dtype=jnp.float32,
+            name="se_expand",
+        )(se)
+        x = x * nn.sigmoid(se)
+        # Project.
+        x = nn.Conv(
+            self.out_filters, (1, 1), use_bias=False, dtype=self.dtype,
+            param_dtype=jnp.float32, name="project_conv",
+        )(x)
+        x = bn("project_bn")(x).astype(self.dtype)
+        if self.strides == 1 and self.in_filters == self.out_filters:
+            if train and self.drop_rate > 0.0:
+                # Stochastic depth: drop the whole residual branch per-example.
+                keep = 1.0 - self.drop_rate
+                mask = jax.random.bernoulli(
+                    self.make_rng("dropout"), keep, (x.shape[0], 1, 1, 1)
+                ).astype(x.dtype)
+                x = x * mask / keep
+            x = x + inputs
+        return x
+
+
+class EfficientNet(nn.Module):
+    num_classes: int = 1
+    width_mult: float = 1.0
+    depth_mult: float = 1.0
+    dropout_rate: float = 0.2
+    drop_connect_rate: float = 0.2
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+    blocks: Sequence = _B0_BLOCKS
+
+    @classmethod
+    def b4(cls, **kw):
+        kw.setdefault("dropout_rate", 0.4)
+        return cls(width_mult=1.4, depth_mult=1.8, **kw)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn(name):
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=_BN_MOMENTUM,
+                epsilon=_BN_EPS, use_scale=True, dtype=jnp.float32,
+                axis_name=self.axis_name if train else None, name=name,
+            )
+
+        x = x.astype(self.dtype)
+        stem = round_filters(32, self.width_mult)
+        x = nn.Conv(
+            stem, (3, 3), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32, name="stem_conv",
+        )(x)
+        x = nn.swish(bn("stem_bn")(x)).astype(self.dtype)
+
+        total_blocks = sum(
+            round_repeats(r, self.depth_mult) for (_, _, _, _, r) in self.blocks
+        )
+        block_idx = 0
+        in_filters = stem
+        for stage, (expand, kernel, stride, out_b0, repeats_b0) in enumerate(
+            self.blocks
+        ):
+            out_filters = round_filters(out_b0, self.width_mult)
+            for rep in range(round_repeats(repeats_b0, self.depth_mult)):
+                x = MBConv(
+                    in_filters=in_filters,
+                    out_filters=out_filters,
+                    expand_ratio=expand,
+                    kernel=kernel,
+                    strides=stride if rep == 0 else 1,
+                    drop_rate=self.drop_connect_rate * block_idx / total_blocks,
+                    dtype=self.dtype,
+                    axis_name=self.axis_name,
+                    name=f"stage{stage + 1}_block{rep + 1}",
+                )(x, train)
+                in_filters = out_filters
+                block_idx += 1
+
+        head = round_filters(1280, self.width_mult)
+        x = nn.Conv(
+            head, (1, 1), use_bias=False, dtype=self.dtype,
+            param_dtype=jnp.float32, name="head_conv",
+        )(x)
+        x = nn.swish(bn("head_bn")(x))
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="Logits")(x)
+        return logits, None

@@ -1,0 +1,239 @@
+"""Flax Inception-v3, weight-matched to the TF-Slim layout.
+
+The reference builds its model with the TF-Slim ``inception_v3`` graph
+builder (BASELINE.json:5; SURVEY.md R7). This is a from-scratch Flax
+re-implementation of that architecture — stem, Mixed_5b..Mixed_7c blocks,
+optional auxiliary head off Mixed_6e, global average pool, dropout,
+logits — with module names mirroring the slim variable scopes so a weight
+transplant is a mechanical tree rename (tested against
+``tf.keras.applications.InceptionV3``, the locally available twin of the
+slim builder; SURVEY.md §4.2).
+
+Input: NHWC float images, nominally 299x299x3 in [-1, 1].
+Output: ``(logits[N, num_classes], aux_logits or None)``.
+
+TPU notes: all convs run in bfloat16 on the MXU with float32 BN (see
+``common.ConvBN``); the whole forward is trace-once/static-shape, so XLA
+fuses the elementwise tails into the conv kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from jama16_retina_tpu.models.common import ConvBN
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35x35 block (slim Mixed_5b/5c/5d): 1x1 / 5x5 / double-3x3 / pool."""
+
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cbn = lambda f, k, name: ConvBN(  # noqa: E731
+            f, k, dtype=self.dtype, axis_name=self.axis_name, name=name
+        )
+        b1 = cbn(64, (1, 1), "Branch_0_Conv2d_0a_1x1")(x, train)
+        b5 = cbn(48, (1, 1), "Branch_1_Conv2d_0a_1x1")(x, train)
+        b5 = cbn(64, (5, 5), "Branch_1_Conv2d_0b_5x5")(b5, train)
+        b3 = cbn(64, (1, 1), "Branch_2_Conv2d_0a_1x1")(x, train)
+        b3 = cbn(96, (3, 3), "Branch_2_Conv2d_0b_3x3")(b3, train)
+        b3 = cbn(96, (3, 3), "Branch_2_Conv2d_0c_3x3")(b3, train)
+        bp = _avg_pool_same(x)
+        bp = cbn(self.pool_features, (1, 1), "Branch_3_Conv2d_0b_1x1")(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35->17 grid reduction (slim Mixed_6a)."""
+
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cbn = lambda f, k, s, p, name: ConvBN(  # noqa: E731
+            f, k, strides=s, padding=p, dtype=self.dtype,
+            axis_name=self.axis_name, name=name,
+        )
+        b3 = cbn(384, (3, 3), (2, 2), "VALID", "Branch_0_Conv2d_1a_3x3")(x, train)
+        bd = cbn(64, (1, 1), (1, 1), "SAME", "Branch_1_Conv2d_0a_1x1")(x, train)
+        bd = cbn(96, (3, 3), (1, 1), "SAME", "Branch_1_Conv2d_0b_3x3")(bd, train)
+        bd = cbn(96, (3, 3), (2, 2), "VALID", "Branch_1_Conv2d_1a_3x3")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 block with factorized 7x7 (slim Mixed_6b..6e)."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c7 = self.channels_7x7
+        cbn = lambda f, k, name: ConvBN(  # noqa: E731
+            f, k, dtype=self.dtype, axis_name=self.axis_name, name=name
+        )
+        b1 = cbn(192, (1, 1), "Branch_0_Conv2d_0a_1x1")(x, train)
+        b7 = cbn(c7, (1, 1), "Branch_1_Conv2d_0a_1x1")(x, train)
+        b7 = cbn(c7, (1, 7), "Branch_1_Conv2d_0b_1x7")(b7, train)
+        b7 = cbn(192, (7, 1), "Branch_1_Conv2d_0c_7x1")(b7, train)
+        bd = cbn(c7, (1, 1), "Branch_2_Conv2d_0a_1x1")(x, train)
+        bd = cbn(c7, (7, 1), "Branch_2_Conv2d_0b_7x1")(bd, train)
+        bd = cbn(c7, (1, 7), "Branch_2_Conv2d_0c_1x7")(bd, train)
+        bd = cbn(c7, (7, 1), "Branch_2_Conv2d_0d_7x1")(bd, train)
+        bd = cbn(192, (1, 7), "Branch_2_Conv2d_0e_1x7")(bd, train)
+        bp = _avg_pool_same(x)
+        bp = cbn(192, (1, 1), "Branch_3_Conv2d_0b_1x1")(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17->8 grid reduction (slim Mixed_7a)."""
+
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cbn = lambda f, k, s, p, name: ConvBN(  # noqa: E731
+            f, k, strides=s, padding=p, dtype=self.dtype,
+            axis_name=self.axis_name, name=name,
+        )
+        b3 = cbn(192, (1, 1), (1, 1), "SAME", "Branch_0_Conv2d_0a_1x1")(x, train)
+        b3 = cbn(320, (3, 3), (2, 2), "VALID", "Branch_0_Conv2d_1a_3x3")(b3, train)
+        b7 = cbn(192, (1, 1), (1, 1), "SAME", "Branch_1_Conv2d_0a_1x1")(x, train)
+        b7 = cbn(192, (1, 7), (1, 1), "SAME", "Branch_1_Conv2d_0b_1x7")(b7, train)
+        b7 = cbn(192, (7, 1), (1, 1), "SAME", "Branch_1_Conv2d_0c_7x1")(b7, train)
+        b7 = cbn(192, (3, 3), (2, 2), "VALID", "Branch_1_Conv2d_1a_3x3")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 block with expanded filter-bank splits (slim Mixed_7b/7c)."""
+
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cbn = lambda f, k, name: ConvBN(  # noqa: E731
+            f, k, dtype=self.dtype, axis_name=self.axis_name, name=name
+        )
+        b1 = cbn(320, (1, 1), "Branch_0_Conv2d_0a_1x1")(x, train)
+
+        b3 = cbn(384, (1, 1), "Branch_1_Conv2d_0a_1x1")(x, train)
+        b3 = jnp.concatenate(
+            [
+                cbn(384, (1, 3), "Branch_1_Conv2d_0b_1x3")(b3, train),
+                cbn(384, (3, 1), "Branch_1_Conv2d_0c_3x1")(b3, train),
+            ],
+            axis=-1,
+        )
+        bd = cbn(448, (1, 1), "Branch_2_Conv2d_0a_1x1")(x, train)
+        bd = cbn(384, (3, 3), "Branch_2_Conv2d_0b_3x3")(bd, train)
+        bd = jnp.concatenate(
+            [
+                cbn(384, (1, 3), "Branch_2_Conv2d_0c_1x3")(bd, train),
+                cbn(384, (3, 1), "Branch_2_Conv2d_0d_3x1")(bd, train),
+            ],
+            axis=-1,
+        )
+        bp = _avg_pool_same(x)
+        bp = cbn(192, (1, 1), "Branch_3_Conv2d_0b_1x1")(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class AuxHead(nn.Module):
+    """Slim auxiliary classifier off Mixed_6e (17x17x768 input)."""
+
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = ConvBN(
+            128, (1, 1), dtype=self.dtype, axis_name=self.axis_name,
+            name="Conv2d_1b_1x1",
+        )(x, train)
+        x = ConvBN(
+            768, x.shape[1:3], padding="VALID", dtype=self.dtype,
+            axis_name=self.axis_name, name="Conv2d_2a_5x5",
+        )(x, train)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="Logits",
+        )(x.astype(jnp.float32))
+        return x
+
+
+class InceptionV3(nn.Module):
+    """The flagship backbone (reference R7, BASELINE.json:7)."""
+
+    num_classes: int = 1
+    aux_head: bool = True
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(dtype=self.dtype, axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 -> 35x35x192.
+        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID",
+                   name="Conv2d_1a_3x3", **kw)(x, train)
+        x = ConvBN(32, (3, 3), padding="VALID", name="Conv2d_2a_3x3", **kw)(x, train)
+        x = ConvBN(64, (3, 3), name="Conv2d_2b_3x3", **kw)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = ConvBN(80, (1, 1), padding="VALID", name="Conv2d_3b_1x1", **kw)(x, train)
+        x = ConvBN(192, (3, 3), padding="VALID", name="Conv2d_4a_3x3", **kw)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        # 35x35 blocks.
+        x = InceptionA(pool_features=32, name="Mixed_5b", **kw)(x, train)
+        x = InceptionA(pool_features=64, name="Mixed_5c", **kw)(x, train)
+        x = InceptionA(pool_features=64, name="Mixed_5d", **kw)(x, train)
+        # 17x17 blocks.
+        x = InceptionB(name="Mixed_6a", **kw)(x, train)
+        x = InceptionC(channels_7x7=128, name="Mixed_6b", **kw)(x, train)
+        x = InceptionC(channels_7x7=160, name="Mixed_6c", **kw)(x, train)
+        x = InceptionC(channels_7x7=160, name="Mixed_6d", **kw)(x, train)
+        x = InceptionC(channels_7x7=192, name="Mixed_6e", **kw)(x, train)
+
+        aux = None
+        if self.aux_head:
+            aux = AuxHead(
+                num_classes=self.num_classes, name="AuxLogits", **kw
+            )(x, train)
+
+        # 8x8 blocks.
+        x = InceptionD(name="Mixed_7a", **kw)(x, train)
+        x = InceptionE(name="Mixed_7b", **kw)(x, train)
+        x = InceptionE(name="Mixed_7c", **kw)(x, train)
+
+        # Head: global average pool -> dropout -> logits (float32).
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(
+            self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="Logits",
+        )(x)
+        return logits, aux

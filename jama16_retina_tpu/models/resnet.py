@@ -1,0 +1,88 @@
+"""Flax ResNet-50 — backbone swap option (BASELINE.json:11, SURVEY.md N5).
+
+Standard bottleneck-v1 ResNet-50 (He et al. 2016): 7x7/2 stem, 3-4-6-3
+bottleneck stages with expansion 4. Unlike the Inception cell, ResNet BN
+keeps its learned scale (no ReLU directly after the residual-add path's
+last BN). Same ``(logits, aux=None)`` contract and numerics policy as the
+rest of the zoo (bf16 convs, f32 BN, f32 head).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from jama16_retina_tpu.models.common import BN_EPS, BN_MOMENTUM
+
+
+class Bottleneck(nn.Module):
+    features: int  # inner width; output is 4x
+    strides: tuple = (1, 1)
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        def conv(f, k, s, name):
+            return nn.Conv(
+                f, k, strides=s, padding="SAME", use_bias=False,
+                dtype=self.dtype, param_dtype=jnp.float32, name=name,
+            )
+
+        def bn(name):
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=BN_MOMENTUM,
+                epsilon=BN_EPS, use_scale=True, dtype=jnp.float32,
+                axis_name=self.axis_name if train else None, name=name,
+            )
+
+        residual = x
+        y = conv(self.features, (1, 1), (1, 1), "conv1")(x)
+        y = nn.relu(bn("bn1")(y)).astype(self.dtype)
+        y = conv(self.features, (3, 3), self.strides, "conv2")(y)
+        y = nn.relu(bn("bn2")(y)).astype(self.dtype)
+        y = conv(self.features * 4, (1, 1), (1, 1), "conv3")(y)
+        y = bn("bn3")(y)
+        if residual.shape[-1] != y.shape[-1] or self.strides != (1, 1):
+            residual = conv(
+                self.features * 4, (1, 1), self.strides, "conv_proj"
+            )(residual)
+            residual = bn("bn_proj")(residual)
+        return nn.relu(y + residual).astype(self.dtype)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1
+    dropout_rate: float = 0.2
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+    stage_sizes: tuple = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            name="conv_init",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=BN_MOMENTUM,
+            epsilon=BN_EPS, use_scale=True, dtype=jnp.float32,
+            axis_name=self.axis_name if train else None, name="bn_init",
+        )(x)
+        x = nn.relu(x).astype(self.dtype)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(
+                    features=64 * 2**i, strides=strides, dtype=self.dtype,
+                    axis_name=self.axis_name, name=f"stage{i + 1}_block{j + 1}",
+                )(x, train)
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="Logits")(x)
+        return logits, None

@@ -1,0 +1,36 @@
+"""Tiny CNN backbone for tests and CI smoke runs (SURVEY.md §4 fixtures).
+
+Small enough to train in seconds on the CPU backend, but structurally
+honest: same ConvBN cell (so cross-replica BN paths are exercised), same
+``(logits, aux)`` contract as the real backbones.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from jama16_retina_tpu.models.common import ConvBN
+
+
+class TinyCNN(nn.Module):
+    num_classes: int = 1
+    dropout_rate: float = 0.1
+    features: tuple = (16, 32, 64)
+    dtype: Any = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = ConvBN(
+                f, (3, 3), strides=(2, 2), dtype=self.dtype,
+                axis_name=self.axis_name, name=f"conv{i}",
+            )(x, train)
+        x = x.mean(axis=(1, 2)).astype(jnp.float32)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="Logits")(x)
+        return logits, None

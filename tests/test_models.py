@@ -1,0 +1,128 @@
+"""Model-zoo tests (SURVEY.md §4.2 — model parity + shape census).
+
+Heavy backbones are checked with ``jax.eval_shape`` (abstract init — no
+XLA compile, critical on this 1-vCPU host); numeric forward/backward
+behavior is exercised through ``tiny_cnn``, which shares the same ConvBN
+cell and call contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models
+from jama16_retina_tpu.configs import ModelConfig
+
+
+def abstract_variables(model, image_size, batch=2):
+    x = jnp.zeros((batch, image_size, image_size, 3))
+    return jax.eval_shape(
+        lambda k, x: model.init({"params": k, "dropout": k}, x, train=False),
+        jax.random.key(0),
+        x,
+    )
+
+
+def n_leaves(tree):
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tree))
+
+
+# Golden trainable-parameter counts. inception_v3 is independently
+# verified against tf.keras below; the others pin against regression.
+EXPECTED_PARAMS = {
+    "inception_v3": 24_327_970,  # binary head + slim aux head
+    "resnet50": 23_510_081,  # == keras ResNet50 minus its 1000-class head
+    "efficientnet_b4": 17_550_409,
+    "tiny_cnn": 23_649,
+}
+
+
+@pytest.mark.parametrize("arch", sorted(EXPECTED_PARAMS))
+def test_param_census(arch):
+    cfg = ModelConfig(arch=arch, compute_dtype="float32")
+    size = 64 if arch == "tiny_cnn" else 299
+    variables = abstract_variables(models.build(cfg), size)
+    assert n_leaves(variables["params"]) == EXPECTED_PARAMS[arch]
+    assert n_leaves(variables["batch_stats"]) > 0
+
+
+@pytest.mark.slow
+def test_inception_param_parity_with_keras():
+    """Weight-match check vs the locally available TF twin (SURVEY.md §4.2):
+    same trainable-parameter count as tf.keras InceptionV3 when configured
+    identically (1000 classes, no aux head)."""
+    tf = pytest.importorskip("tensorflow")
+    keras_model = tf.keras.applications.InceptionV3(
+        weights=None, include_top=True, classes=1000
+    )
+    keras_trainable = sum(int(tf.size(w)) for w in keras_model.trainable_weights)
+
+    from jama16_retina_tpu.models.inception_v3 import InceptionV3
+
+    m = InceptionV3(num_classes=1000, aux_head=False, dtype=jnp.float32)
+    variables = abstract_variables(m, 299, batch=1)
+    assert n_leaves(variables["params"]) == keras_trainable == 23_817_352
+
+
+@pytest.mark.parametrize(
+    "arch,num_aux", [("inception_v3", 1), ("resnet50", 0), ("efficientnet_b4", 0)]
+)
+def test_output_shapes_binary_and_multi(arch, num_aux):
+    for head, classes in [("binary", 1), ("multi", 5)]:
+        cfg = ModelConfig(arch=arch, head=head, compute_dtype="bfloat16")
+        m = models.build(cfg)
+        out = jax.eval_shape(
+            lambda k, x: m.apply(
+                m.init({"params": k, "dropout": k}, x, train=False),
+                x,
+                train=False,
+            ),
+            jax.random.key(0),
+            jnp.zeros((4, 299, 299, 3)),
+        )
+        logits, aux = out
+        assert logits.shape == (4, classes)
+        assert logits.dtype == jnp.float32  # head always f32
+        if num_aux:
+            assert aux.shape == (4, classes)
+        else:
+            assert aux is None
+
+
+def test_tiny_cnn_trains_bn_and_dropout():
+    """Numeric forward: BN stats mutate in train mode, dropout is rng-driven,
+    logits differ between train and eval modes."""
+    cfg = ModelConfig(arch="tiny_cnn", compute_dtype="float32", image_size=32)
+    m = models.build(cfg)
+    x = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    variables = m.init({"params": jax.random.key(0), "dropout": jax.random.key(0)}, x, train=False)
+
+    (logits, aux), mutated = m.apply(
+        variables,
+        x,
+        train=True,
+        mutable=["batch_stats"],
+        rngs={"dropout": jax.random.key(2)},
+    )
+    assert aux is None and logits.shape == (8, 1)
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    eval_logits, _ = m.apply(variables, x, train=False)
+    assert not np.allclose(np.asarray(eval_logits), np.asarray(logits))
+
+
+def test_bfloat16_policy_param_dtype():
+    """Params stay float32 even when compute dtype is bfloat16."""
+    cfg = ModelConfig(arch="tiny_cnn", compute_dtype="bfloat16", image_size=32)
+    m = models.build(cfg)
+    variables = abstract_variables(m, 32)
+    for leaf in jax.tree.leaves(variables["params"]):
+        assert leaf.dtype == jnp.float32
+
+
+def test_build_rejects_unknown_arch():
+    with pytest.raises(ValueError, match="unknown arch"):
+        models.build(ModelConfig(arch="vgg19"))
