@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Evaluate entry point — the reference's evaluate.py surface (SURVEY.md
+§3.2): restore checkpoint(s), run the test split, print a JSON report
+with AUC and sensitivity at the fixed-specificity operating points
+(BASELINE.json:8). Multiple --ensemble_dir flags (or an ensemble10
+workdir laid out by train.py) average per-model probabilities
+(BASELINE.json:10).
+
+Examples:
+  python evaluate.py --config=eyepacs_binary --data_dir=/data/eyepacs \
+      --checkpoint_dir=/ckpt/run1
+  python evaluate.py --config=messidor2_eval --data_dir=/data/messidor2 \
+      --checkpoint_dir=/ckpt/run1 --split=test
+  python evaluate.py --config=ensemble10 --data_dir=... \
+      --checkpoint_dir=/ckpt/ens   # auto-discovers member_NN subdirs
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from absl import app, flags
+
+_CONFIG = flags.DEFINE_string("config", "eyepacs_binary", "preset name")
+_SET = flags.DEFINE_multi_string("set", [], "config overrides")
+_DATA_DIR = flags.DEFINE_string("data_dir", "", "TFRecord directory")
+_CKPT = flags.DEFINE_string("checkpoint_dir", "", "checkpoint dir (or ensemble root)")
+_ENSEMBLE = flags.DEFINE_multi_string(
+    "ensemble_dir", [], "explicit member checkpoint dirs (repeatable; the "
+    "reference's -e flag)"
+)
+_SPLIT = flags.DEFINE_string("split", "test", "which split to evaluate")
+_DEVICE = flags.DEFINE_enum("device", "tpu", ["tpu", "cpu"], "backend gate")
+_FAKE_DEVICES = flags.DEFINE_integer("fake_devices", 0, "cpu fake devices")
+
+
+def _discover_dirs(root: str) -> list[str]:
+    members = sorted(glob.glob(os.path.join(root, "member_*")))
+    return members or [root]
+
+
+def main(argv):
+    del argv
+    if _DEVICE.value == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if _FAKE_DEVICES.value:
+            jax.config.update("jax_num_cpu_devices", _FAKE_DEVICES.value)
+
+    from jama16_retina_tpu import configs, trainer
+
+    cfg = configs.get_config(_CONFIG.value)
+    if _SET.value:
+        cfg = configs.override(cfg, _SET.value)
+    data_dir = _DATA_DIR.value or cfg.data.test_dir
+    if not data_dir:
+        raise app.UsageError("--data_dir is required")
+    dirs = list(_ENSEMBLE.value) or list(cfg.eval.ensemble_dirs)
+    if not dirs:
+        if not _CKPT.value:
+            raise app.UsageError("--checkpoint_dir or --ensemble_dir required")
+        dirs = _discover_dirs(_CKPT.value)
+
+    report = trainer.evaluate_checkpoints(
+        cfg, data_dir, dirs, split=_SPLIT.value
+    )
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    app.run(main)

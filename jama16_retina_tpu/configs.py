@@ -166,7 +166,9 @@ def _preset_resnet50() -> ExperimentConfig:
 
 def _preset_efficientnet_b4() -> ExperimentConfig:
     return ExperimentConfig(
-        name="efficientnet_b4", model=ModelConfig(arch="efficientnet_b4")
+        name="efficientnet_b4",
+        # B4 compound scaling specifies dropout 0.4 (vs the generic 0.2).
+        model=ModelConfig(arch="efficientnet_b4", dropout_rate=0.4),
     )
 
 

@@ -1,0 +1,109 @@
+"""On-device image augmentation in JAX (reference R5's augment stage).
+
+The reference augments in tf.data on the host (flips + brightness /
+contrast / saturation / hue jitter, SURVEY.md R5). On a 1-vCPU host that
+would starve the TPU, so augmentation runs *inside* the jit'd train step
+on uint8 batches already in HBM: XLA fuses the whole thing into the
+input-normalization epilogue, and the host↔device transfer stays uint8
+(3x smaller than f32).
+
+All ops are shape-static and batched; randomness comes from one PRNG key
+per step, split per-example — so a (step, example) pair fully determines
+the augmentation, which is what makes the determinism test in
+tests/test_pipeline.py possible. Fundus-specific extra: 90-degree
+rotations + both flips (retinas have no canonical orientation).
+
+Hue/saturation follow the classic YIQ-space approximation (rotation
+about / scaling of the chroma plane) rather than an HSV round-trip: one
+3x3 matmul per pixel, MXU-trivial, visually equivalent for small jitter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from jama16_retina_tpu.configs import DataConfig
+
+# RGB <-> YIQ (NTSC) matrices.
+_RGB2YIQ = jnp.array(
+    [
+        [0.299, 0.587, 0.114],
+        [0.596, -0.274, -0.322],
+        [0.211, -0.523, 0.312],
+    ],
+    dtype=jnp.float32,
+)
+_YIQ2RGB = jnp.array(
+    [
+        [1.0, 0.956, 0.621],
+        [1.0, -0.272, -0.647],
+        [1.0, -1.106, 1.703],
+    ],
+    dtype=jnp.float32,
+)
+
+
+def normalize(images_u8: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [0,255] -> float32 [-1, 1] (Inception input convention)."""
+    return images_u8.astype(jnp.float32) / 127.5 - 1.0
+
+
+def _augment_one(key: jax.Array, img: jnp.ndarray, cfg: DataConfig) -> jnp.ndarray:
+    """img: HWC float32 in [-1, 1]."""
+    k = jax.random.split(key, 8)
+
+    if cfg.flip:
+        img = jnp.where(jax.random.bernoulli(k[0]), img[:, ::-1], img)
+        img = jnp.where(jax.random.bernoulli(k[1]), img[::-1, :], img)
+    if cfg.rotate:
+        # Uniform choice of 0/90/180/270 via lax.switch (square images).
+        rot = jax.random.randint(k[2], (), 0, 4)
+        img = jax.lax.switch(
+            rot,
+            [
+                lambda x: x,
+                lambda x: jnp.rot90(x, 1),
+                lambda x: jnp.rot90(x, 2),
+                lambda x: jnp.rot90(x, 3),
+            ],
+            img,
+        )
+
+    if cfg.brightness_delta > 0:
+        img = img + jax.random.uniform(
+            k[3], (), minval=-cfg.brightness_delta, maxval=cfg.brightness_delta
+        )
+    lo, hi = cfg.contrast_range
+    if (lo, hi) != (1.0, 1.0):
+        c = jax.random.uniform(k[4], (), minval=lo, maxval=hi)
+        mean = img.mean(axis=(0, 1), keepdims=True)
+        img = (img - mean) * c + mean
+
+    # Chroma jitter in YIQ space: saturation scales (I, Q); hue rotates them.
+    slo, shi = cfg.saturation_range
+    if (slo, shi) != (1.0, 1.0) or cfg.hue_delta > 0:
+        yiq = img @ _RGB2YIQ.T
+        s = jax.random.uniform(k[5], (), minval=slo, maxval=shi)
+        theta = jax.random.uniform(
+            k[6], (), minval=-cfg.hue_delta, maxval=cfg.hue_delta
+        ) * (2.0 * jnp.pi)
+        cos, sin = jnp.cos(theta) * s, jnp.sin(theta) * s
+        i, q = yiq[..., 1], yiq[..., 2]
+        yiq = jnp.stack(
+            [yiq[..., 0], cos * i - sin * q, sin * i + cos * q], axis=-1
+        )
+        img = yiq @ _YIQ2RGB.T
+
+    return jnp.clip(img, -1.0, 1.0)
+
+
+def augment_batch(
+    key: jax.Array, images_u8: jnp.ndarray, cfg: DataConfig
+) -> jnp.ndarray:
+    """uint8 NHWC batch -> augmented float32 [-1,1] batch (train path)."""
+    imgs = normalize(images_u8)
+    if not cfg.augment:
+        return imgs
+    keys = jax.random.split(key, imgs.shape[0])
+    return jax.vmap(lambda k, im: _augment_one(k, im, cfg))(keys, imgs)

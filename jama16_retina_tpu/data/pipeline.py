@@ -1,0 +1,147 @@
+"""Online input pipeline: TFRecord shards -> device-resident uint8 batches.
+
+Reference layer: ``lib/dataset`` (SURVEY.md R5) — decode, augment,
+shuffle, batch 32. TPU-native split of responsibilities (SURVEY.md N4):
+
+  host (tf.data, CPU):  shard interleave -> parse -> JPEG decode ->
+                        resize-if-needed -> shuffle -> batch (uint8)
+  device (XLA, in-step): normalize + augment (data/augment.py), fused
+                        into the train step's program
+
+The host→device copy is uint8 and double-buffered (``device_prefetch``)
+so H2D overlaps compute — the practical form of "decoding straight into
+HBM" (BASELINE.json:5) on a 1-vCPU host.
+
+Eval pipelines pad the last partial batch and carry a validity mask so
+jit sees only one batch shape (static shapes, no recompiles) while the
+metrics layer sees every real example exactly once.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from jama16_retina_tpu.configs import DataConfig
+from jama16_retina_tpu.data import tfrecord
+
+
+def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
+                      seed: int):
+    import tensorflow as tf
+
+    ds = tf.data.Dataset.from_tensor_slices(list(paths))
+    if training:
+        ds = ds.shuffle(len(paths), seed=seed, reshuffle_each_iteration=True)
+    ds = ds.interleave(
+        tf.data.TFRecordDataset,
+        cycle_length=min(4, len(paths)),
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=not training,
+    )
+    parse = tfrecord.parse_fn()
+
+    def to_features(serialized):
+        image, grade, _ = parse(serialized)
+        # decode_jpeg's static shape is unknown inside tf.data, so the
+        # size check must be a dynamic tf.cond — a Python `if` on
+        # image.shape would always take the resize branch, paying a
+        # float round-trip per record even for correctly sized shards.
+        shape = tf.shape(image)
+        image = tf.cond(
+            tf.logical_and(
+                tf.equal(shape[0], image_size), tf.equal(shape[1], image_size)
+            ),
+            lambda: image,
+            lambda: tf.cast(
+                tf.image.resize(image, (image_size, image_size), method="bilinear"),
+                tf.uint8,
+            ),
+        )
+        image = tf.ensure_shape(image, (image_size, image_size, 3))
+        return image, grade
+
+    ds = ds.map(to_features, num_parallel_calls=tf.data.AUTOTUNE)
+    return ds
+
+
+def train_batches(
+    data_dir: str,
+    split: str,
+    cfg: DataConfig,
+    image_size: int,
+    seed: int = 0,
+) -> Iterator[dict]:
+    """Infinite shuffled uint8 batches: {'image': [B,S,S,3], 'grade': [B]}."""
+    import tensorflow as tf
+
+    paths = tfrecord.list_split(data_dir, split)
+    ds = _build_tf_dataset(paths, image_size, True, cfg, seed)
+    ds = ds.shuffle(cfg.shuffle_buffer, seed=seed).repeat()
+    ds = ds.batch(cfg.batch_size, drop_remainder=True)
+    ds = ds.prefetch(cfg.prefetch_batches)
+    for image, grade in ds.as_numpy_iterator():
+        yield {"image": image, "grade": grade}
+
+
+def eval_batches(
+    data_dir: str,
+    split: str,
+    batch_size: int,
+    image_size: int,
+) -> Iterator[dict]:
+    """One epoch of padded batches: {'image', 'grade', 'mask'} — mask=0 rows
+    are padding and must be dropped after host gather."""
+    paths = tfrecord.list_split(data_dir, split)
+    ds = _build_tf_dataset(paths, image_size, False, DataConfig(), seed=0)
+    ds = ds.batch(batch_size, drop_remainder=False)
+    for image, grade in ds.as_numpy_iterator():
+        n = image.shape[0]
+        if n < batch_size:
+            pad = batch_size - n
+            image = np.concatenate(
+                [image, np.zeros((pad, *image.shape[1:]), image.dtype)], axis=0
+            )
+            grade = np.concatenate([grade, np.zeros((pad,), grade.dtype)], axis=0)
+        mask = (np.arange(batch_size) < n).astype(np.float32)
+        yield {"image": image, "grade": grade, "mask": mask}
+
+
+def device_prefetch(
+    it: Iterator[dict], sharding=None, size: int = 2
+) -> Iterator[dict]:
+    """Move batches to device ahead of consumption (double-buffering).
+
+    With a ``NamedSharding(mesh, P('data'))`` the put is the global-array
+    scatter across the mesh's data axis; with None it targets the default
+    device. jax.device_put is async — the queue depth of ``size`` is what
+    lets H2D copies run behind the current step's compute.
+    """
+    queue: collections.deque = collections.deque()
+
+    def put(batch: dict) -> dict:
+        if sharding is None:
+            return jax.device_put(batch)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, _shard_for(x, sharding)), batch
+        )
+
+    def _shard_for(x, sharding):
+        # Rank-aware: batch-dim sharding for arrays, replicated for scalars.
+        import jax.sharding as jsh
+
+        if not hasattr(sharding, "spec"):
+            return sharding
+        ndim = np.ndim(x)
+        spec = list(sharding.spec) + [None] * max(0, ndim - len(sharding.spec))
+        return jsh.NamedSharding(sharding.mesh, jsh.PartitionSpec(*spec[:ndim]))
+
+    for batch in it:
+        queue.append(put(batch))
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

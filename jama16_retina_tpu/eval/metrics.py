@@ -30,7 +30,10 @@ def roc_curve(labels: np.ndarray, scores: np.ndarray):
     if labels.shape != scores.shape:
         raise ValueError("labels and scores must have the same shape")
     if labels.size == 0:
-        raise ValueError("roc_curve needs at least one positive and one negative")
+        raise ValueError(
+            "roc_curve got empty input — no examples reached the metric "
+            "(check eval split / mask filtering)"
+        )
     if not np.all((labels == 0.0) | (labels == 1.0)):
         raise ValueError(
             "roc_curve expects binary labels in {0, 1}; got values "

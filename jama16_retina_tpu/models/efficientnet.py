@@ -126,7 +126,7 @@ class EfficientNet(nn.Module):
 
     @classmethod
     def b4(cls, **kw):
-        kw.setdefault("dropout_rate", 0.4)
+        # dropout_rate arrives from ModelConfig (the b4 preset sets 0.4).
         return cls(width_mult=1.4, depth_mult=1.8, **kw)
 
     @nn.compact

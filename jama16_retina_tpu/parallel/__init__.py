@@ -1,0 +1,8 @@
+"""Parallelism layer: device mesh + sharding helpers (SURVEY.md N7-N9)."""
+
+from jama16_retina_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+)

@@ -1,0 +1,73 @@
+"""Device mesh + sharding layout (SURVEY.md N7/N9; BASELINE.json:5).
+
+The reference is single-process TF with no distributed layer (SURVEY.md
+§1); the north star mandates data-parallel training with gradient
+allreduce and cross-replica BatchNorm over ICI. TPU-natively that is:
+
+  * one ``jax.sharding.Mesh`` over all devices with a single ``'data'``
+    axis (N10: DP is the only strategy this 24M-param CNN needs; a
+    model axis would be added HERE if one were ever warranted);
+  * batches sharded ``P('data')`` on dim 0, parameters/optimizer state
+    replicated ``P()``;
+  * the train step jit'd over global arrays — XLA GSPMD turns the
+    gradient mean and the global-batch BN moments into ICI all-reduces.
+    No NCCL/MPI analogue exists or is needed (SURVEY.md §5.8).
+
+Multi-host: ``initialize_distributed()`` wraps
+``jax.distributed.initialize`` — a no-op single-host, the DCN bring-up
+on a pod — after which ``jax.devices()`` spans all hosts and the same
+mesh code scales unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize_distributed() -> None:
+    """Multi-host bring-up (SURVEY.md §3.5). Safe to call single-host."""
+    if jax.process_count() > 1:
+        return  # already initialized by the launcher
+    try:
+        jax.distributed.initialize()
+    except Exception:
+        # Single-host / no coordinator configured: run locally.
+        pass
+
+
+def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` devices
+    (0 = all). Device order is jax.devices() order, which groups
+    ICI-adjacent chips before DCN hops — collectives ride ICI first."""
+    devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Dim-0 (batch) sharding over the data axis."""
+    return NamedSharding(mesh, P(mesh.axis_names[0]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host batch dict as global arrays sharded on dim 0."""
+    sh = batch_sharding(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        spec = P(mesh.axis_names[0], *([None] * (x.ndim - 1))) if x.ndim else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)

@@ -1,0 +1,260 @@
+"""Training core: state, loss, optimizer, jit'd train/eval steps.
+
+Reference equivalent: the session loop inside ``train.py`` (SURVEY.md
+§3.1/R1) — forward/backward, optimizer step, periodic validation. Here
+the whole step is ONE XLA program (SURVEY.md §3.4): on-device uint8
+normalize+augment, bf16 forward/backward, loss, gradient mean across the
+data mesh axis, optimizer update, and global-batch BatchNorm moments.
+Exactly one dispatch per step; the gradient/BN all-reduces ride ICI.
+
+Two parallel forms are provided:
+
+  * ``make_train_step`` — the primary path: ``jax.jit`` over global
+    arrays with explicit in/out shardings on a 1-axis Mesh. XLA GSPMD
+    derives the gradient all-reduce, and BatchNorm statistics are
+    global-batch by construction (the batch is one logical array).
+  * ``make_pmap_train_step`` — the explicit-collective form (per-replica
+    ``lax.pmean`` on grads, BN with ``axis_name='data'``), kept as the
+    reference semantics the jit path must match; the DP≡single-device
+    test in tests/test_train.py pins the two together (SURVEY.md §4.3).
+
+Loss (reference R1): sigmoid BCE for the binary referable-DR head,
+softmax CE for the 5-class ICDR head (BASELINE.json:7,9), optional label
+smoothing, plus the Inception aux-head loss at weight ``aux_weight``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.configs import ExperimentConfig, TrainConfig
+from jama16_retina_tpu.data import augment as augment_lib
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def make_schedule(tc: TrainConfig) -> optax.Schedule:
+    if tc.lr_schedule == "constant":
+        return optax.constant_schedule(tc.learning_rate)
+    if tc.lr_schedule == "cosine":
+        return optax.cosine_decay_schedule(tc.learning_rate, tc.steps)
+    if tc.lr_schedule == "warmup_cosine":
+        # Validity clamp only: warmup must fit inside the run. Honors an
+        # explicit warmup_steps whenever it is feasible, and says so when
+        # it is not (short smoke runs with the 500-step default).
+        warmup = max(1, min(tc.warmup_steps, tc.steps - 1))
+        if warmup != tc.warmup_steps:
+            absl_logging.warning(
+                "warmup_steps=%d does not fit in steps=%d; clamped to %d",
+                tc.warmup_steps, tc.steps, warmup,
+            )
+        return optax.warmup_cosine_decay_schedule(
+            0.0, tc.learning_rate, warmup, tc.steps
+        )
+    raise ValueError(f"unknown lr_schedule {tc.lr_schedule!r}")
+
+
+def _decay_mask(params) -> Any:
+    """Weight decay only on rank>=2 kernels — BN scales/biases and dense
+    biases are excluded (standard practice; the reference's slim arg scope
+    likewise regularized conv weights only)."""
+    return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    sched = make_schedule(tc)
+    if tc.optimizer == "adamw":
+        opt = optax.adamw(sched, weight_decay=tc.weight_decay, mask=_decay_mask)
+    elif tc.optimizer == "sgdm":
+        opt = optax.chain(
+            optax.add_decayed_weights(tc.weight_decay, mask=_decay_mask),
+            optax.sgd(sched, momentum=tc.momentum, nesterov=True),
+        )
+    elif tc.optimizer == "rmsprop":
+        # The reference's TF-Slim era default (RECALL) was RMSProp.
+        opt = optax.chain(
+            optax.add_decayed_weights(tc.weight_decay, mask=_decay_mask),
+            optax.rmsprop(sched, decay=0.9, eps=1.0, momentum=tc.momentum),
+        )
+    else:
+        raise ValueError(f"unknown optimizer {tc.optimizer!r}")
+    if tc.gradient_clip_norm > 0:
+        opt = optax.chain(optax.clip_by_global_norm(tc.gradient_clip_norm), opt)
+    return opt
+
+
+def create_state(
+    cfg: ExperimentConfig, model, rng: jax.Array
+) -> tuple[TrainState, optax.GradientTransformation]:
+    size = cfg.model.image_size
+    dummy = jnp.zeros((2, size, size, 3), jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng}, dummy, train=False)
+    tx = make_optimizer(cfg.train)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=tx.init(variables["params"]),
+    )
+    return state, tx
+
+
+def _labels_from_grades(grades: jnp.ndarray, head: str) -> jnp.ndarray:
+    if head == "binary":
+        # ICDR grade >= 2 -> referable DR (reference R3 binning).
+        return (grades >= 2).astype(jnp.float32)
+    return grades.astype(jnp.int32)
+
+
+def _head_loss(logits: jnp.ndarray, labels: jnp.ndarray, head: str,
+               smoothing: float, mask: jnp.ndarray | None) -> jnp.ndarray:
+    if head == "binary":
+        target = labels * (1.0 - smoothing) + 0.5 * smoothing
+        per_ex = optax.sigmoid_binary_cross_entropy(logits[:, 0], target)
+    else:
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        if smoothing > 0:
+            onehot = optax.smooth_labels(onehot, smoothing)
+        per_ex = optax.softmax_cross_entropy(logits, onehot)
+    if mask is None:
+        return per_ex.mean()
+    return (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def _probs(logits: jnp.ndarray, head: str) -> jnp.ndarray:
+    if head == "binary":
+        return jax.nn.sigmoid(logits[:, 0])
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def loss_fn(params, batch_stats, model, images, grades, dropout_rng,
+            cfg: ExperimentConfig, train: bool):
+    labels = _labels_from_grades(grades, cfg.model.head)
+    variables = {"params": params, "batch_stats": batch_stats}
+    if train:
+        (logits, aux), mutated = model.apply(
+            variables, images, train=True, mutable=["batch_stats"],
+            rngs={"dropout": dropout_rng},
+        )
+        new_stats = mutated["batch_stats"]
+    else:
+        logits, aux = model.apply(variables, images, train=False)
+        new_stats = batch_stats
+    smoothing = cfg.train.label_smoothing
+    loss = _head_loss(logits, labels, cfg.model.head, smoothing, None)
+    if aux is not None:
+        loss = loss + cfg.model.aux_weight * _head_loss(
+            aux, labels, cfg.model.head, smoothing, None
+        )
+    return loss, (logits, new_stats)
+
+
+def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
+               model, cfg: ExperimentConfig, augment_key_extra=None):
+    """Shared body for the jit and pmap step forms."""
+    key = jax.random.fold_in(base_key, state.step)
+    if augment_key_extra is not None:
+        key = jax.random.fold_in(key, augment_key_extra)
+    aug_key, dropout_key = jax.random.split(key)
+    images = augment_lib.augment_batch(aug_key, batch["image"], cfg.data)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    (loss, (logits, new_stats)), grads = grad_fn(
+        state.params, state.batch_stats, model, images, batch["grade"],
+        dropout_key, cfg, True,
+    )
+    return loss, logits, new_stats, grads
+
+
+def _apply_update(state: TrainState, grads, new_stats, tx) -> TrainState:
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    return TrainState(
+        step=state.step + 1,
+        params=optax.apply_updates(state.params, updates),
+        batch_stats=new_stats,
+        opt_state=new_opt,
+    )
+
+
+def make_train_step(
+    cfg: ExperimentConfig, model, tx, mesh=None
+) -> Callable:
+    """The primary jit path over global arrays (SURVEY.md §3.4).
+
+    With ``mesh``: state replicated, batch sharded on dim 0; XLA GSPMD
+    inserts the gradient all-reduce (grads of replicated params w.r.t. a
+    sharded batch loss) and BN sees the global batch. Donation keeps the
+    replicated state buffer in place across steps.
+    """
+
+    def step(state: TrainState, batch: dict, base_key: jax.Array):
+        loss, logits, new_stats, grads = _step_impl(
+            state, batch, base_key, model, cfg
+        )
+        return _apply_update(state, grads, new_stats, tx), {"loss": loss}
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+    repl = mesh_lib.replicated(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(repl, data, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=0,
+    )
+
+
+def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):
+    """Explicit-collective DP form (SURVEY.md N7): per-replica grads are
+    ``lax.pmean``'d; the model must be built with ``axis_name=axis`` so BN
+    moments psum over replicas (N8). Used by tests to pin the jit path's
+    semantics; state is replicated per-device, batch is [n_dev, B/n_dev, ...].
+    """
+
+    def step(state: TrainState, batch: dict, base_key: jax.Array):
+        loss, logits, new_stats, grads = _step_impl(
+            state, batch, base_key, model, cfg,
+            augment_key_extra=jax.lax.axis_index(axis),
+        )
+        grads = jax.lax.pmean(grads, axis)
+        loss = jax.lax.pmean(loss, axis)
+        return _apply_update(state, grads, new_stats, tx), {"loss": loss}
+
+    # state/batch are per-device stacked; the PRNG key is broadcast.
+    return jax.pmap(step, axis_name=axis, in_axes=(0, 0, None))
+
+
+def make_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
+    """Masked forward pass -> per-example probabilities (SURVEY.md §3.2).
+
+    Returns host-gatherable probs; padding rows (mask==0) are kept in the
+    output and must be trimmed by the caller — that keeps the jit shape
+    static across the final partial batch.
+    """
+
+    def step(state: TrainState, batch: dict):
+        images = augment_lib.normalize(batch["image"])
+        logits, _ = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False,
+        )
+        return _probs(logits, cfg.model.head)
+
+    if mesh is None:
+        return jax.jit(step)
+    repl = mesh_lib.replicated(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(step, in_shardings=(repl, data), out_shardings=repl)

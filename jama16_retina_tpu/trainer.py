@@ -1,0 +1,212 @@
+"""End-to-end training/eval drivers (reference train.py/evaluate.py bodies).
+
+``fit`` is the reference's session loop re-shaped for TPU (SURVEY.md
+§3.1): one jit dispatch per step over a data-parallel mesh, periodic
+validation AUC, early stopping on best val AUC with orbax best-checkpoint
+retention, JSONL metrics. ``fit_ensemble`` repeats it for k
+independently-seeded members (reference R11); ``evaluate_checkpoints``
+restores member checkpoints, averages probabilities, and emits the
+reference's report shape (AUC + operating points; SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import numpy as np
+
+from jama16_retina_tpu import models, train_lib
+from jama16_retina_tpu.configs import ExperimentConfig
+from jama16_retina_tpu.data import pipeline
+from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+from jama16_retina_tpu.utils.logging import RunLog
+
+
+def _binary_eval_labels(grades: np.ndarray, head: str) -> np.ndarray:
+    """evaluation_report expects binary labels for the binary head and raw
+    grades for the 5-class head."""
+    return (grades >= 2).astype(np.float64) if head == "binary" else grades
+
+
+def predict_split(
+    cfg: ExperimentConfig,
+    model,
+    state: train_lib.TrainState,
+    data_dir: str,
+    split: str,
+    mesh=None,
+    eval_step=None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Run the test pipeline (no augmentation) -> (grades, probs) on host.
+
+    Pass a prebuilt ``eval_step`` when calling repeatedly (every val
+    interval / every ensemble member) — a fresh ``make_eval_step`` closure
+    would defeat the jit cache and recompile the backbone each time.
+    """
+    if eval_step is None:
+        eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+    grades_all, probs_all = [], []
+    for batch in pipeline.eval_batches(
+        data_dir, split, cfg.eval.batch_size, cfg.model.image_size
+    ):
+        if mesh is not None:
+            dev_batch = mesh_lib.shard_batch(batch, mesh)
+        else:
+            dev_batch = jax.device_put(batch)
+        probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
+        keep = batch["mask"] > 0
+        grades_all.append(batch["grade"][keep])
+        probs_all.append(probs[keep])
+    return np.concatenate(grades_all), np.concatenate(probs_all)
+
+
+def fit(
+    cfg: ExperimentConfig,
+    data_dir: str,
+    workdir: str,
+    seed: int | None = None,
+    mesh=None,
+) -> dict:
+    """Train one model; returns {'best_auc', 'best_step', 'stopped_early'}."""
+    seed = cfg.train.seed if seed is None else seed
+    mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
+    log = RunLog(workdir)
+    log.write("config", name=cfg.name, seed=seed,
+              n_devices=int(np.prod(list(mesh.shape.values()))))
+
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(seed))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    train_step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+    ckpt = ckpt_lib.Checkpointer(
+        os.path.abspath(workdir), max_to_keep=cfg.train.max_to_keep
+    )
+
+    start_step = 0
+    if cfg.train.resume and ckpt.latest_step is not None:
+        state = ckpt.restore(ckpt_lib.abstract_like(state), ckpt.latest_step)
+        state = jax.device_put(state, mesh_lib.replicated(mesh))
+        start_step = int(jax.device_get(state.step))
+        log.write("resume", step=start_step)
+
+    base_key = jax.random.key(seed)
+    batches = pipeline.device_prefetch(
+        pipeline.train_batches(
+            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed
+        ),
+        sharding=mesh_lib.batch_sharding(mesh),
+        size=cfg.data.prefetch_batches,
+    )
+
+    best_auc, best_step, since_best = -np.inf, start_step, 0
+    stopped_early = False
+    t_log, imgs_since = time.time(), 0
+    for step_i in range(start_step, cfg.train.steps):
+        state, m = train_step(state, next(batches), base_key)
+        imgs_since += cfg.data.batch_size
+
+        if (step_i + 1) % cfg.train.log_every == 0:
+            dt = time.time() - t_log
+            log.write(
+                "train", step=step_i + 1, loss=float(m["loss"]),
+                images_per_sec=round(imgs_since / max(dt, 1e-9), 2),
+            )
+            t_log, imgs_since = time.time(), 0
+
+        if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
+            grades, probs = predict_split(
+                cfg, model, state, data_dir, "val", mesh, eval_step=eval_step
+            )
+            # Early stopping always tracks *referable-DR* AUC; the 5-class
+            # head collapses to P(grade>=2) for this purpose (SURVEY.md N11).
+            bin_probs = (
+                probs if cfg.model.head == "binary"
+                else metrics.referable_probs_from_multiclass(probs)
+            )
+            auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
+            ckpt.save(step_i + 1, jax.device_get(state), {"val_auc": auc})
+            if auc > best_auc + cfg.train.min_delta:
+                best_auc, best_step, since_best = auc, step_i + 1, 0
+            else:
+                since_best += 1
+            log.write("eval", step=step_i + 1, val_auc=round(auc, 5),
+                      best_auc=round(best_auc, 5), since_best=since_best)
+            if since_best >= cfg.train.early_stop_patience:
+                stopped_early = True
+                log.write("early_stop", step=step_i + 1, best_step=best_step)
+                break
+
+    ckpt.wait()
+    ckpt.close()
+    log.close()
+    return {
+        "best_auc": float(best_auc),
+        "best_step": int(best_step),
+        "stopped_early": stopped_early,
+    }
+
+
+def fit_ensemble(
+    cfg: ExperimentConfig, data_dir: str, workdir: str
+) -> list[dict]:
+    """Train k independently-seeded members (reference R11, BASELINE.json:10),
+    each in its own member_NN checkpoint dir."""
+    results = []
+    for member in range(cfg.train.ensemble_size):
+        mdir = ckpt_lib.member_dir(workdir, member)
+        res = fit(cfg, data_dir, mdir, seed=cfg.train.seed + member)
+        results.append({"member": member, "workdir": mdir, **res})
+    return results
+
+
+def restore_for_eval(
+    cfg: ExperimentConfig, model, ckpt_dir: str, mesh=None
+) -> train_lib.TrainState:
+    """Restore a member's best checkpoint (reference evaluate.py restore)."""
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    ckpt = ckpt_lib.Checkpointer(os.path.abspath(ckpt_dir))
+    restored = ckpt.restore(ckpt_lib.abstract_like(jax.device_get(state)))
+    ckpt.close()
+    if mesh is not None:
+        restored = jax.device_put(restored, mesh_lib.replicated(mesh))
+    return restored
+
+
+def evaluate_checkpoints(
+    cfg: ExperimentConfig,
+    data_dir: str,
+    ckpt_dirs: list[str],
+    split: str = "test",
+    mesh=None,
+) -> dict:
+    """Single- or multi-checkpoint (ensemble-averaged) evaluation
+    (SURVEY.md §3.2; BASELINE.json:10 'averaged logits')."""
+    if not ckpt_dirs:
+        raise ValueError("need at least one checkpoint dir")
+    mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
+    model = models.build(cfg.model)
+    eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+    prob_list, grades = [], None
+    for d in ckpt_dirs:
+        state = restore_for_eval(cfg, model, d, mesh)
+        g, p = predict_split(
+            cfg, model, state, data_dir, split, mesh, eval_step=eval_step
+        )
+        if grades is not None and not np.array_equal(g, grades):
+            raise RuntimeError("checkpoints saw different eval sets")
+        grades = g
+        prob_list.append(p)
+    probs = metrics.ensemble_average(prob_list)
+    report = metrics.evaluation_report(
+        _binary_eval_labels(grades, cfg.model.head),
+        probs,
+        cfg.eval.operating_specificities,
+    )
+    report["split"] = split
+    report["n_models"] = len(ckpt_dirs)
+    return report

@@ -1,0 +1,102 @@
+"""Checkpoint management on orbax (SURVEY.md N12/§5.4, reference R9).
+
+Reference behavior to match: save best-so-far by validation AUC, one
+directory per ensemble member, restore-for-eval (``tf.train.Saver``
+semantics). Orbax gives the TPU-native version: async-capable, sharded-
+array aware, with ``best_fn`` retention driven by the metrics we pass at
+save time. State saved = params + BN stats + optimizer state + step
+(the full ``train_lib.TrainState``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from jama16_retina_tpu.train_lib import TrainState
+
+BEST_METRIC = "val_auc"
+
+
+def member_dir(checkpoint_dir: str, member: int) -> str:
+    """One directory per ensemble member (reference R9/R11 layout)."""
+    return os.path.join(checkpoint_dir, f"member_{member:02d}")
+
+
+class Checkpointer:
+    """Best-by-val-AUC retention PLUS an unconditional latest checkpoint.
+
+    Orbax's ``best_fn`` retention deletes a just-saved step at save time
+    when it is not among the top ``max_to_keep`` by metric — so a single
+    best-retention manager silently rolls ``--resume`` back to an old
+    best step after a val-AUC plateau. Two managers fix that: ``best/``
+    keeps the top-k by val AUC (the reference's save-best Saver
+    semantics, R9), ``latest/`` keeps exactly the newest step for resume.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._best = ocp.CheckpointManager(
+            os.path.join(directory, "best"),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                best_fn=lambda m: float(m[BEST_METRIC]),
+                best_mode="max",
+                create=True,
+            ),
+        )
+        self._latest = ocp.CheckpointManager(
+            os.path.join(directory, "latest"),
+            options=ocp.CheckpointManagerOptions(max_to_keep=1, create=True),
+        )
+
+    def save(self, step: int, state: TrainState, metrics: dict) -> None:
+        self._best.save(
+            step,
+            args=ocp.args.StandardSave(state),
+            metrics={k: float(v) for k, v in metrics.items()},
+        )
+        self._latest.save(step, args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        self._best.wait_until_finished()
+        self._latest.wait_until_finished()
+
+    @property
+    def best_step(self) -> int | None:
+        return self._best.best_step()
+
+    @property
+    def latest_step(self) -> int | None:
+        return self._latest.latest_step()
+
+    def restore(self, abstract_state: TrainState, step: int | None = None
+                ) -> TrainState:
+        """Restore ``step`` if given (from whichever manager has it),
+        else the best step, else the latest."""
+        if step is not None:
+            mngr = self._best if step in self._best.all_steps() else self._latest
+            return mngr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        if self.best_step is not None:
+            return self._best.restore(
+                self.best_step, args=ocp.args.StandardRestore(abstract_state)
+            )
+        if self.latest_step is not None:
+            return self._latest.restore(
+                self.latest_step, args=ocp.args.StandardRestore(abstract_state)
+            )
+        raise FileNotFoundError(f"no checkpoints in {self._best.directory}")
+
+    def close(self) -> None:
+        self._best.close()
+        self._latest.close()
+
+
+def abstract_like(state: TrainState) -> TrainState:
+    """Shape/dtype skeleton for StandardRestore."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), state
+    )

@@ -1,0 +1,37 @@
+"""Run metrics logging (SURVEY.md §5.5).
+
+Reference: console prints + periodic val AUC. Build: absl console logs
+plus one JSONL file per run — a line per event (train step stats, eval
+reports) — identical shape for every backend/config so runs diff cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO
+
+from absl import logging as absl_logging
+
+
+class RunLog:
+    def __init__(self, workdir: str, name: str = "metrics.jsonl"):
+        os.makedirs(workdir, exist_ok=True)
+        self.path = os.path.join(workdir, name)
+        self._fh: IO = open(self.path, "a")
+
+    def write(self, kind: str, **fields) -> dict:
+        rec = {"kind": kind, "t": round(time.time(), 3), **fields}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        absl_logging.info("%s %s", kind, {k: v for k, v in fields.items()})
+        return rec
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def read_jsonl(path: str) -> list[dict]:
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]

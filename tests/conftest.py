@@ -7,7 +7,9 @@ The real-TPU path is exercised separately by bench.py / __graft_entry__.py.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the ambient environment pins JAX_PLATFORMS=axon (the one
+# real TPU chip); tests must instead see 8 fake CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +18,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Keep TF (used only for tf.data/TFRecord on host) off any accelerator.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+# The jaxtyping pytest plugin imports jax BEFORE this conftest runs, and
+# jax snapshots JAX_PLATFORMS at import time — so the env vars above are
+# too late. Re-point the already-imported jax at CPU explicitly. The
+# XLA_FLAGS fake-device flag is still read lazily at first backend init,
+# which has not happened yet at plugin-import time.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import sys
 

@@ -1,0 +1,118 @@
+"""Input-pipeline tests (SURVEY.md §4.1): TFRecord round-trip, batching,
+eval padding, on-device augmentation determinism, device prefetch sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import DataConfig
+from jama16_retina_tpu.data import augment, pipeline, tfrecord
+
+N, SIZE, SHARDS = 20, 64, 3
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tfr")
+    tfrecord.write_synthetic_split(str(d), "train", N, SIZE, SHARDS, seed=1)
+    tfrecord.write_synthetic_split(str(d), "test", N, SIZE, SHARDS, seed=2)
+    return str(d)
+
+
+def test_roundtrip_count_and_shapes(data_dir):
+    paths = tfrecord.list_split(data_dir, "train")
+    assert len(paths) == SHARDS
+    assert tfrecord.count_records(paths) == N
+    batch = next(
+        pipeline.train_batches(data_dir, "train", DataConfig(batch_size=4), SIZE)
+    )
+    assert batch["image"].shape == (4, SIZE, SIZE, 3)
+    assert batch["image"].dtype == np.uint8
+    assert batch["grade"].shape == (4,)
+    assert set(np.unique(batch["grade"])).issubset(set(range(5)))
+
+
+def test_missing_split_raises(data_dir):
+    with pytest.raises(FileNotFoundError, match="no TFRecord shards"):
+        tfrecord.list_split(data_dir, "val")
+
+
+def test_train_batches_repeat_and_shuffle(data_dir):
+    cfg = DataConfig(batch_size=8, shuffle_buffer=32)
+    it = pipeline.train_batches(data_dir, "train", cfg, SIZE, seed=0)
+    batches = [next(it) for _ in range(5)]  # 40 images > N: must repeat
+    assert all(b["image"].shape == (8, SIZE, SIZE, 3) for b in batches)
+    assert not np.array_equal(batches[0]["image"], batches[3]["image"])
+
+
+def test_eval_batches_cover_every_example_once(data_dir):
+    got = list(pipeline.eval_batches(data_dir, "test", batch_size=8, image_size=SIZE))
+    assert all(b["image"].shape == (8, SIZE, SIZE, 3) for b in got)
+    total = sum(int(b["mask"].sum()) for b in got)
+    assert total == N
+    # Padding rows are masked out and zero-filled.
+    last = got[-1]
+    pad = last["mask"] == 0
+    assert last["image"][pad].sum() == 0
+
+
+def test_eval_resizes_mismatched_records(tmp_path):
+    tfrecord.write_synthetic_split(str(tmp_path), "test", 4, 48, 1, seed=3)
+    b = next(pipeline.eval_batches(str(tmp_path), "test", batch_size=4, image_size=SIZE))
+    assert b["image"].shape == (4, SIZE, SIZE, 3)
+
+
+def test_normalize_range():
+    u8 = jnp.array([[[[0, 127, 255]]]], dtype=jnp.uint8)
+    out = augment.normalize(u8)
+    np.testing.assert_allclose(
+        np.asarray(out).ravel(), [-1.0, -0.0039216, 1.0], atol=1e-4
+    )
+
+
+def test_augment_deterministic_under_key():
+    cfg = DataConfig()
+    imgs = (np.random.default_rng(0).random((4, 32, 32, 3)) * 255).astype(np.uint8)
+    key = jax.random.key(7)
+    a = augment.augment_batch(key, jnp.asarray(imgs), cfg)
+    b = augment.augment_batch(key, jnp.asarray(imgs), cfg)
+    c = augment.augment_batch(jax.random.key(8), jnp.asarray(imgs), cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert np.asarray(a).min() >= -1.0 and np.asarray(a).max() <= 1.0
+
+
+def test_augment_off_is_pure_normalize():
+    cfg = DataConfig(augment=False)
+    imgs = (np.random.default_rng(1).random((2, 16, 16, 3)) * 255).astype(np.uint8)
+    out = augment.augment_batch(jax.random.key(0), jnp.asarray(imgs), cfg)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(augment.normalize(jnp.asarray(imgs)))
+    )
+
+
+def test_augment_jits_without_retrace():
+    cfg = DataConfig()
+    fn = jax.jit(lambda k, x: augment.augment_batch(k, x, cfg))
+    x = jnp.zeros((4, 16, 16, 3), jnp.uint8)
+    fn(jax.random.key(0), x)
+    n0 = fn._cache_size()
+    fn(jax.random.key(1), x)
+    assert fn._cache_size() == n0
+
+
+def test_device_prefetch_shards_batch_dim(data_dir):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest must provide 8 fake CPU devices"
+    mesh = Mesh(np.array(devices), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    it = pipeline.train_batches(data_dir, "train", DataConfig(batch_size=8), SIZE)
+    out = next(pipeline.device_prefetch(it, sharding=sharding, size=2))
+    assert out["image"].shape == (8, SIZE, SIZE, 3)
+    # Each device holds exactly its 1/8 slice of the batch dim.
+    shard_shapes = {s.data.shape for s in out["image"].addressable_shards}
+    assert shard_shapes == {(1, SIZE, SIZE, 3)}
+    assert len(out["image"].sharding.device_set) == 8

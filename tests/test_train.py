@@ -1,0 +1,223 @@
+"""Train-core tests (SURVEY.md §4.3 — distributed without a cluster).
+
+The key invariant: the jit-over-global-arrays step on an 8-device mesh
+must be numerically equivalent to (a) the same step on one device, and
+(b) the explicit pmap+psum form with cross-replica BatchNorm. That pins
+"gradient allreduce + cross-replica BN psum" (BASELINE.json:5) through
+the real compiler on 8 fake CPU devices.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib
+from jama16_retina_tpu.configs import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    TrainConfig,
+    get_config,
+)
+from jama16_retina_tpu.data import synthetic
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+
+def small_cfg(head="binary", augment=False, **train_kw) -> ExperimentConfig:
+    train_kw.setdefault("learning_rate", 3e-3)
+    train_kw.setdefault("steps", 64)
+    train_kw.setdefault("lr_schedule", "constant")
+    train_kw.setdefault("optimizer", "sgdm")
+    return ExperimentConfig(
+        name="test",
+        model=ModelConfig(
+            arch="tiny_cnn", head=head, image_size=32, aux_head=False,
+            compute_dtype="float32", dropout_rate=0.0,
+        ),
+        data=DataConfig(batch_size=16, augment=augment),
+        train=TrainConfig(**train_kw),
+    )
+
+
+def make_batch(cfg, n=16, seed=0):
+    imgs, grades = synthetic.make_dataset(
+        n, synthetic.SynthConfig(image_size=cfg.model.image_size), seed=seed
+    )
+    return {"image": imgs, "grade": grades.astype(np.int32)}
+
+
+def tree_allclose(a, b, **kw):
+    flat_a, flat_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), **kw)
+
+
+class TestDPEquivalence:
+    def _single_device_step(self, cfg, batch, key):
+        model = models.build(cfg.model)
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+        step = train_lib.make_train_step(cfg, model, tx, mesh=None)
+        return step(state, jax.device_put(batch), key)
+
+    def test_jit_mesh_equals_single_device(self):
+        cfg = small_cfg()
+        batch = make_batch(cfg)
+        key = jax.random.key(42)
+        new1, m1 = self._single_device_step(cfg, batch, key)
+
+        mesh = mesh_lib.make_mesh()
+        model = models.build(cfg.model)
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+        step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+        gbatch = mesh_lib.shard_batch(batch, mesh)
+        new8, m8 = step(state, gbatch, key)
+
+        assert len(jax.devices()) == 8
+        np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=1e-5)
+        tree_allclose(new1.params, new8.params, rtol=2e-5, atol=1e-6)
+        # Cross-replica BN: running stats after the sharded step must match
+        # the global-batch stats from the single-device step.
+        tree_allclose(new1.batch_stats, new8.batch_stats, rtol=2e-5, atol=1e-6)
+
+    def test_pmap_psum_equals_single_device(self):
+        cfg = small_cfg()
+        batch = make_batch(cfg)
+        key = jax.random.key(42)
+        new1, m1 = self._single_device_step(cfg, batch, key)
+
+        n_dev = len(jax.devices())
+        model = models.build(cfg.model, axis_name="data")
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+        pstate = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_dev, *x.shape)), state
+        )
+        pbatch = jax.tree.map(
+            lambda x: np.reshape(x, (n_dev, x.shape[0] // n_dev, *x.shape[1:])),
+            batch,
+        )
+        step = train_lib.make_pmap_train_step(cfg, model, tx)
+        newp, mp = step(pstate, pbatch, key)
+
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(np.asarray(mp["loss"])[0]), rtol=1e-5
+        )
+        one = jax.tree.map(lambda x: x[0], newp)
+        tree_allclose(new1.params, one.params, rtol=2e-5, atol=1e-6)
+        tree_allclose(new1.batch_stats, one.batch_stats, rtol=2e-5, atol=1e-6)
+
+    def test_without_cross_replica_bn_stats_differ(self):
+        """Negative control: axis_name=None under pmap gives per-shard BN
+        moments that do NOT match global-batch moments — proving the psum
+        is load-bearing at small per-replica batch (SURVEY.md §7b)."""
+        cfg = small_cfg()
+        batch = make_batch(cfg)
+        key = jax.random.key(42)
+        new1, _ = self._single_device_step(cfg, batch, key)
+
+        n_dev = len(jax.devices())
+        model = models.build(cfg.model, axis_name=None)  # broken on purpose
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+        pstate = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_dev, *x.shape)), state
+        )
+        pbatch = jax.tree.map(
+            lambda x: np.reshape(x, (n_dev, x.shape[0] // n_dev, *x.shape[1:])),
+            batch,
+        )
+        step = train_lib.make_pmap_train_step(cfg, model, tx)
+        newp, _ = step(pstate, pbatch, key)
+        stats0 = jax.tree.map(lambda x: np.asarray(x[0]), newp.batch_stats)
+        with pytest.raises(AssertionError):
+            tree_allclose(new1.batch_stats, stats0, rtol=1e-4)
+
+
+def test_loss_decreases_on_learnable_synthetic():
+    cfg = small_cfg(augment=False)
+    mesh = mesh_lib.make_mesh()
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    imgs, grades = synthetic.make_dataset(
+        64, synthetic.SynthConfig(image_size=32), seed=1
+    )
+    key = jax.random.key(0)
+    losses = []
+    for i in range(40):
+        idx = np.random.default_rng(i).choice(64, 16, replace=False)
+        batch = mesh_lib.shard_batch(
+            {"image": imgs[idx], "grade": grades[idx].astype(np.int32)}, mesh
+        )
+        state, m = step(state, batch, key)
+        losses.append(float(m["loss"]))
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.8, losses
+
+
+def test_multi_head_trains_and_evals():
+    cfg = small_cfg(head="multi")
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, label_smoothing=0.1)
+    )
+    mesh = mesh_lib.make_mesh()
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    batch = mesh_lib.shard_batch(make_batch(cfg), mesh)
+    state, m = step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+    eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+    ebatch = dict(make_batch(cfg), mask=np.ones(16, np.float32))
+    probs = eval_step(state, mesh_lib.shard_batch(ebatch, mesh))
+    assert probs.shape == (16, 5)
+    np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_eval_step_binary_probs_in_range():
+    cfg = small_cfg()
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    eval_step = train_lib.make_eval_step(cfg, model)
+    batch = dict(make_batch(cfg), mask=np.ones(16, np.float32))
+    probs = np.asarray(eval_step(state, jax.device_put(batch)))
+    assert probs.shape == (16,)
+    assert probs.min() >= 0.0 and probs.max() <= 1.0
+
+
+def test_augmented_step_is_deterministic_per_key():
+    cfg = small_cfg(augment=True)
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    step = train_lib.make_train_step(cfg, model, tx)
+    batch = jax.device_put(make_batch(cfg))
+    # donate_argnums=0 invalidates state; re-create per call.
+    _, m1 = step(state, batch, jax.random.key(5))
+    state2, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    _, m2 = step(state2, batch, jax.random.key(5))
+    state3, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    _, m3 = step(state3, batch, jax.random.key(6))
+    assert float(m1["loss"]) == float(m2["loss"])
+    assert float(m1["loss"]) != float(m3["loss"])
+
+
+@pytest.mark.parametrize("opt", ["adamw", "sgdm", "rmsprop"])
+@pytest.mark.parametrize("sched", ["constant", "cosine", "warmup_cosine"])
+def test_optimizer_matrix(opt, sched):
+    cfg = small_cfg(optimizer=opt, lr_schedule=sched)
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    step = train_lib.make_train_step(cfg, model, tx)
+    batch = jax.device_put(make_batch(cfg))
+    new, m = step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+    assert int(new.step) == 1
+
+
+def test_unknown_optimizer_and_schedule_raise():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        train_lib.make_optimizer(TrainConfig(optimizer="lion"))
+    with pytest.raises(ValueError, match="unknown lr_schedule"):
+        train_lib.make_schedule(TrainConfig(lr_schedule="linear"))

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Train entry point — same UX surface as the reference's train.py
+(SURVEY.md N1, BASELINE.json:5): pick a config, point at a data dir of
+TFRecord shards, get checkpoints + metrics in --workdir. The --device
+flag is the backend gate from the north star: ``tpu`` (default) uses the
+ambient JAX platform (the axon TPU here), ``cpu`` forces the CPU backend
+(with optional fake multi-device for sharding tests).
+
+Examples:
+  python train.py --config=eyepacs_binary --data_dir=/data/eyepacs \
+      --workdir=/ckpt/run1
+  python train.py --config=smoke --synthetic=64 --data_dir=/tmp/synth \
+      --workdir=/tmp/ck --device=cpu
+  python train.py --config=ensemble10 ...   # trains 10 seeded members
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from absl import app, flags
+
+_CONFIG = flags.DEFINE_string("config", "eyepacs_binary", "preset name")
+_SET = flags.DEFINE_multi_string(
+    "set", [], "config overrides, section.field=value"
+)
+_DATA_DIR = flags.DEFINE_string("data_dir", "", "TFRecord directory")
+_WORKDIR = flags.DEFINE_string(
+    "workdir", "", "checkpoint/metrics directory (default: train.checkpoint_dir)"
+)
+_DEVICE = flags.DEFINE_enum(
+    "device", "tpu", ["tpu", "cpu"], "backend gate (BASELINE.json:5)"
+)
+_FAKE_DEVICES = flags.DEFINE_integer(
+    "fake_devices", 0,
+    "with --device=cpu: number of fake XLA host devices (sharding tests)",
+)
+_SYNTHETIC = flags.DEFINE_integer(
+    "synthetic", 0,
+    "if >0 and data_dir has no train split, write N synthetic fundus "
+    "examples per split first (test/bench fixture; no real data ships "
+    "with this environment)",
+)
+_RESUME = flags.DEFINE_boolean("resume", False, "resume from latest ckpt")
+
+
+def main(argv):
+    del argv
+    if _DEVICE.value == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if _FAKE_DEVICES.value:
+            jax.config.update("jax_num_cpu_devices", _FAKE_DEVICES.value)
+
+    from jama16_retina_tpu import configs, trainer
+    from jama16_retina_tpu.data import tfrecord
+
+    cfg = configs.get_config(_CONFIG.value)
+    if _SET.value:
+        cfg = configs.override(cfg, _SET.value)
+    if _RESUME.value:
+        cfg = configs.override(cfg, ["train.resume=true"])
+    data_dir = _DATA_DIR.value or cfg.data.train_dir
+    if not data_dir:
+        raise app.UsageError("--data_dir is required")
+    workdir = _WORKDIR.value or cfg.train.checkpoint_dir
+
+    if _SYNTHETIC.value:
+        try:
+            tfrecord.list_split(data_dir, "train")
+        except FileNotFoundError:
+            n = _SYNTHETIC.value
+            for split, ns, seed in (
+                ("train", n, 1), ("val", max(n // 2, 8), 2), ("test", max(n // 2, 8), 3),
+            ):
+                tfrecord.write_synthetic_split(
+                    data_dir, split, ns, cfg.model.image_size, num_shards=4,
+                    seed=seed,
+                )
+
+    if cfg.train.ensemble_size > 1:
+        results = trainer.fit_ensemble(cfg, data_dir, workdir)
+    else:
+        results = trainer.fit(cfg, data_dir, workdir)
+    print(json.dumps({"config": cfg.name, "results": results}, default=str))
+
+
+if __name__ == "__main__":
+    app.run(main)
