@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Benchmark: flagship train-step throughput on the local chip.
+
+Prints exactly ONE JSON line:
+  {"metric": "train_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": R}
+
+Workload = the production config of record (BASELINE.json:7): Inception-v3,
+binary head, 299x299, global batch 32, aux head on, bf16 compute — the
+full train step (on-device augment + fwd/bwd + optax update) as compiled
+by train_lib.make_train_step, fed device-resident uint8 batches.
+
+``vs_baseline``: the reference never published throughput (BASELINE.md),
+so the denominator is derived from the driver-set target "train wall-clock
+< 1 hour on a v3-8 slice" (BASELINE.json:5): the replication protocol
+passes ~15 epochs x ~57k EyePACS images ≈ 860k images through the model;
+doing that in 3600 s on 8 chips needs ≈ 30 images/sec/chip. So
+vs_baseline = value / 30, i.e. >1.0 means this chip alone beats the
+per-chip rate the 1-hour target requires.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 30.0  # see module docstring
+WARMUP_STEPS = 3
+TIMED_STEPS = 20
+
+
+def main() -> None:
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    cfg = get_config("eyepacs_binary")
+    batch_size = cfg.data.batch_size
+    size = cfg.model.image_size
+
+    mesh = mesh_lib.make_mesh()  # all local devices (1 chip under axon)
+    n_dev = mesh.devices.size
+    print(f"bench: {n_dev} device(s), batch {batch_size}, {size}px",
+          file=sys.stderr)
+
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    batch = mesh_lib.shard_batch(
+        {
+            "image": rng.integers(0, 256, (batch_size, size, size, 3), np.uint8),
+            "grade": rng.integers(0, 5, (batch_size,), np.int32),
+        },
+        mesh,
+    )
+    key = jax.random.key(1)
+
+    t0 = time.time()
+    for _ in range(WARMUP_STEPS):
+        state, m = step(state, batch, key)
+    jax.block_until_ready(state)
+    print(f"bench: warmup+compile {time.time() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    for _ in range(TIMED_STEPS):
+        state, m = step(state, batch, key)
+    jax.block_until_ready(state)
+    dt = time.time() - t0
+
+    images_per_sec = TIMED_STEPS * batch_size / dt
+    per_chip = images_per_sec / n_dev
+    print(f"bench: {TIMED_STEPS} steps in {dt:.2f}s, loss={float(m['loss']):.4f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,13 @@ class TrainConfig:
     # Number of independently seeded ensemble members the train driver
     # produces (reference trains k=10, BASELINE.json:10). 1 = single model.
     ensemble_size: int = 1
+    # Profiling (SURVEY.md §5.1): if > 0, capture a jax.profiler trace of
+    # this many steps (starting at step 10) into <workdir>/profile —
+    # TensorBoard/Perfetto-viewable XLA op + ICI collective timeline.
+    profile_steps: int = 0
+    # Debug mode (SURVEY.md §5.2): enable jax_debug_nans so the first
+    # non-finite value aborts with the failing primitive's stack.
+    debug: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

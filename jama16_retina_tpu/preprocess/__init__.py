@@ -1,0 +1,7 @@
+"""Offline preprocessing layer (SURVEY.md N3; reference R3/R4/R6/R10)."""
+
+from jama16_retina_tpu.preprocess.fundus import (  # noqa: F401
+    FundusNotFound,
+    find_fundus_circle,
+    resize_and_center_fundus,
+)

@@ -1,0 +1,154 @@
+"""Dataset preprocessing runners (reference R3/R4/R10, SURVEY.md §3.3).
+
+Shared machinery for ``preprocess_eyepacs.py`` / ``preprocess_messidor.py``:
+flexible label-CSV parsing, stratified train/val/test partitioning,
+image -> fundus-normalize -> JPEG -> sharded TFRecords. Pure CPU.
+
+Label CSVs in the wild differ (EyePACS ``image,level``; Messidor-2
+``Image name;Retinopathy grade;...``), so the parser sniffs the delimiter
+and picks the name/grade columns by header keywords, falling back to
+(first, second) column for headerless files.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import os
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from jama16_retina_tpu.data import tfrecord
+from jama16_retina_tpu.preprocess import fundus
+
+IMAGE_EXTENSIONS = (".jpeg", ".jpg", ".png", ".tif", ".tiff", ".JPG")
+
+
+def parse_labels_csv(path: str) -> dict[str, int]:
+    """-> {image_name_without_extension: grade}."""
+    with open(path, newline="") as fh:
+        sample = fh.read(4096)
+        fh.seek(0)
+        delim = ";" if sample.count(";") > sample.count(",") else ","
+        rows = list(csv.reader(fh, delimiter=delim))
+    if not rows:
+        raise ValueError(f"empty labels file {path!r}")
+
+    header = [c.strip().lower() for c in rows[0]]
+    name_col, grade_col = 0, 1
+    has_header = any(not _is_int(c) for c in rows[0][1:2]) and any(
+        k in " ".join(header) for k in ("image", "name", "level", "grade")
+    )
+    if has_header:
+        for i, col in enumerate(header):
+            if "image" in col or "name" in col:
+                name_col = i
+                break
+        for i, col in enumerate(header):
+            if "level" in col or "grade" in col or "retinopathy" in col:
+                grade_col = i
+                break
+        rows = rows[1:]
+
+    labels: dict[str, int] = {}
+    for row in rows:
+        if len(row) <= max(name_col, grade_col) or not row[name_col].strip():
+            continue
+        name = os.path.splitext(row[name_col].strip())[0]
+        labels[name] = int(float(row[grade_col].strip()))
+    if not labels:
+        raise ValueError(f"no (name, grade) rows parsed from {path!r}")
+    return labels
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(float(s.strip()))
+        return True
+    except (ValueError, AttributeError):
+        return False
+
+
+def find_image(data_dir: str, name: str) -> str | None:
+    for ext in IMAGE_EXTENSIONS:
+        p = os.path.join(data_dir, name + ext)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def stratified_split(
+    labels: dict[str, int], val_frac: float, test_frac: float, seed: int = 0
+) -> dict[str, list[tuple[str, int]]]:
+    """Per-grade shuffle then slice — keeps grade marginals equal across
+    splits (the reference partitioned per-class; SURVEY.md R3)."""
+    rng = np.random.default_rng(seed)
+    splits: dict[str, list[tuple[str, int]]] = {"train": [], "val": [], "test": []}
+    by_grade: dict[int, list[str]] = {}
+    for name, g in sorted(labels.items()):
+        by_grade.setdefault(g, []).append(name)
+    for g, names in sorted(by_grade.items()):
+        names = list(names)
+        rng.shuffle(names)
+        n = len(names)
+        n_test = int(round(n * test_frac))
+        n_val = int(round(n * val_frac))
+        for name in names[:n_test]:
+            splits["test"].append((name, g))
+        for name in names[n_test:n_test + n_val]:
+            splits["val"].append((name, g))
+        for name in names[n_test + n_val:]:
+            splits["train"].append((name, g))
+    return splits
+
+
+@dataclasses.dataclass
+class PreprocessStats:
+    written: int = 0
+    skipped_missing: int = 0
+    skipped_unreadable: int = 0
+    skipped_no_fundus: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def process_split(
+    items: Sequence[tuple[str, int]],
+    data_dir: str,
+    out_dir: str,
+    split: str,
+    image_size: int = 299,
+    num_shards: int = 16,
+    ben_graham: bool = False,
+    jpeg_quality: int = 92,
+) -> PreprocessStats:
+    """Normalize every (name, grade) image and write TFRecord shards."""
+    import cv2
+
+    stats = PreprocessStats()
+
+    def records() -> Iterator[tuple[bytes, int, str]]:
+        for name, grade in items:
+            path = find_image(data_dir, name)
+            if path is None:
+                stats.skipped_missing += 1
+                continue
+            bgr = cv2.imread(path, cv2.IMREAD_COLOR)
+            if bgr is None:
+                stats.skipped_unreadable += 1
+                continue
+            rgb = bgr[..., ::-1]
+            try:
+                norm = fundus.resize_and_center_fundus(
+                    rgb, diameter=image_size, ben_graham=ben_graham
+                )
+            except fundus.FundusNotFound:
+                stats.skipped_no_fundus += 1
+                continue
+            stats.written += 1
+            yield tfrecord.encode_jpeg(norm, quality=jpeg_quality), grade, name
+
+    tfrecord.write_shards(records(), out_dir, split, num_shards)
+    return stats

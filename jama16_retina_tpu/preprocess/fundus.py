@@ -1,0 +1,134 @@
+"""Fundus normalization (reference R6: ``lib/preprocess``, SURVEY.md §3.3).
+
+Raw EyePACS/Messidor photographs are rectangular frames with the roughly
+circular retina somewhere inside, at wildly varying scales and exposure.
+The reference normalizes each image so the fundus disc has a fixed
+radius, centered, on black background, cropped to 299x299 — that is what
+this module reproduces, CPU-side with OpenCV/numpy (it never touches the
+TPU; SURVEY.md §1 preprocessing layer).
+
+Pipeline per image:
+  1. threshold a downsampled grayscale copy to find lit (non-background)
+     pixels;
+  2. fit the fundus circle from the lit region's bounding extent;
+  3. uniformly rescale so the circle's diameter equals
+     ``diameter * fill`` pixels;
+  4. paste centered on a black ``diameter x diameter`` canvas;
+  5. optionally apply a circular mask to zero residual border glare.
+
+An optional contrast enhancement (``ben_graham=True``: subtract a local
+Gaussian average — the classic Kaggle-DR trick) is provided for the
+quality push toward the 0.97 AUC target (SURVEY.md §6 note); it is OFF
+by default to match the reference's plain normalization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+class FundusNotFound(ValueError):
+    """No circular lit region detected (blank/corrupt photograph)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Circle:
+    cx: float
+    cy: float
+    radius: float
+
+
+def find_fundus_circle(
+    image_rgb: np.ndarray, threshold: int = 12, min_radius_frac: float = 0.05
+) -> Circle:
+    """Locate the fundus disc: bounding extent of above-threshold pixels.
+
+    Row/column projections of the lit mask are robust to the dark corners
+    and small specular highlights typical of fundus frames, and cost one
+    pass over a grayscale copy — no Hough transform needed.
+    """
+    if image_rgb.ndim != 3 or image_rgb.shape[-1] != 3:
+        raise ValueError(f"expected HWC RGB, got shape {image_rgb.shape}")
+    gray = image_rgb.astype(np.float32).mean(axis=-1)
+    mask = gray > threshold
+    rows = np.flatnonzero(mask.any(axis=1))
+    cols = np.flatnonzero(mask.any(axis=0))
+    if rows.size == 0 or cols.size == 0:
+        raise FundusNotFound("no pixels above background threshold")
+    y0, y1 = rows[0], rows[-1]
+    x0, x1 = cols[0], cols[-1]
+    # The disc is the inscribed circle of the lit extent; when the frame
+    # crops top/bottom (common in EyePACS), width is the trustworthy axis.
+    radius = max(x1 - x0 + 1, y1 - y0 + 1) / 2.0
+    cx = (x0 + x1 + 1) / 2.0
+    cy = (y0 + y1 + 1) / 2.0
+    if radius < min_radius_frac * max(image_rgb.shape[:2]):
+        raise FundusNotFound(f"detected radius {radius:.1f}px too small")
+    return Circle(cx=cx, cy=cy, radius=radius)
+
+
+def _gaussian_blur(image: np.ndarray, sigma: float) -> np.ndarray:
+    import cv2
+
+    return cv2.GaussianBlur(image, (0, 0), sigmaX=sigma, sigmaY=sigma)
+
+
+def ben_graham_enhance(image: np.ndarray, alpha: float = 4.0) -> np.ndarray:
+    """Subtract the local average color (Gaussian ~radius/30) — evens out
+    illumination differences between cameras; from the winning Kaggle
+    EyePACS recipe. Input/output uint8 RGB."""
+    f = image.astype(np.float32)
+    blur = _gaussian_blur(f, sigma=max(image.shape[0] / 30.0, 1.0))
+    out = alpha * (f - blur) + 128.0
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def resize_and_center_fundus(
+    image_rgb: np.ndarray,
+    diameter: int = 299,
+    fill: float = 0.98,
+    circular_mask: bool = True,
+    ben_graham: bool = False,
+    threshold: int = 12,
+) -> np.ndarray:
+    """Normalize one photograph to a centered fixed-radius fundus
+    (the reference's ``resize_and_center_fundus``, SURVEY.md R6).
+
+    Returns uint8 RGB ``[diameter, diameter, 3]``. Raises FundusNotFound
+    for blank frames (callers count and skip these, as the reference's
+    preprocessing scripts did).
+    """
+    import cv2
+
+    circle = find_fundus_circle(image_rgb, threshold=threshold)
+    scale = (diameter * fill) / (2.0 * circle.radius)
+    resized = cv2.resize(
+        image_rgb, None, fx=scale, fy=scale,
+        interpolation=cv2.INTER_AREA if scale < 1 else cv2.INTER_CUBIC,
+    )
+    cx, cy = circle.cx * scale, circle.cy * scale
+
+    canvas = np.zeros((diameter, diameter, 3), dtype=np.uint8)
+    # Source window centered on the fundus, clipped to the resized frame.
+    half = diameter / 2.0
+    sx0 = int(round(cx - half)); sy0 = int(round(cy - half))
+    sx1, sy1 = sx0 + diameter, sy0 + diameter
+    dx0 = max(0, -sx0); dy0 = max(0, -sy0)
+    sx0 = max(0, sx0); sy0 = max(0, sy0)
+    sx1 = min(resized.shape[1], sx1); sy1 = min(resized.shape[0], sy1)
+    w = sx1 - sx0; h = sy1 - sy0
+    if w <= 0 or h <= 0:
+        raise FundusNotFound("fundus window fell outside the frame")
+    canvas[dy0:dy0 + h, dx0:dx0 + w] = resized[sy0:sy1, sx0:sx1]
+
+    if ben_graham:
+        canvas = ben_graham_enhance(canvas)
+    if circular_mask:
+        yy, xx = np.mgrid[0:diameter, 0:diameter]
+        r = diameter * fill / 2.0
+        m = ((xx - diameter / 2 + 0.5) ** 2 + (yy - diameter / 2 + 0.5) ** 2
+             ) <= r * r
+        canvas[~m] = 0
+    return canvas

@@ -73,6 +73,9 @@ def fit(
 ) -> dict:
     """Train one model; returns {'best_auc', 'best_step', 'stopped_early'}."""
     seed = cfg.train.seed if seed is None else seed
+    prev_debug_nans = jax.config.jax_debug_nans
+    if cfg.train.debug:
+        jax.config.update("jax_debug_nans", True)
     mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
     log = RunLog(workdir)
     log.write("config", name=cfg.name, seed=seed,
@@ -81,7 +84,12 @@ def fit(
     model = models.build(cfg.model)
     state, tx = train_lib.create_state(cfg, model, jax.random.key(seed))
     state = jax.device_put(state, mesh_lib.replicated(mesh))
-    train_step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    # Donation conflicts with jax_debug_nans' op-by-op re-execution (the
+    # donated buffers are gone by the time the NaN checker re-runs), so
+    # debug mode trades the in-place state update for usable NaN reports.
+    train_step = train_lib.make_train_step(
+        cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
+    )
     eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
     ckpt = ckpt_lib.Checkpointer(
         os.path.abspath(workdir), max_to_keep=cfg.train.max_to_keep
@@ -103,43 +111,80 @@ def fit(
         size=cfg.data.prefetch_batches,
     )
 
+    # Profiler window (SURVEY.md §5.1): skip the compile+warmup steps when
+    # the run is long enough, clamp the window inside short runs, and warn
+    # when no window fits at all.
+    profile_start, profile_stop = -1, -1
+    if cfg.train.profile_steps > 0:
+        remaining = cfg.train.steps - start_step
+        if remaining < cfg.train.profile_steps:
+            log.write("profile_skipped", reason=(
+                f"only {remaining} steps remain, profile_steps="
+                f"{cfg.train.profile_steps} does not fit"))
+        else:
+            profile_start = min(
+                start_step + 10, cfg.train.steps - cfg.train.profile_steps
+            )
+            profile_stop = profile_start + cfg.train.profile_steps
+    tracing = False
+
     best_auc, best_step, since_best = -np.inf, start_step, 0
     stopped_early = False
     t_log, imgs_since = time.time(), 0
-    for step_i in range(start_step, cfg.train.steps):
-        state, m = train_step(state, next(batches), base_key)
-        imgs_since += cfg.data.batch_size
+    try:
+        for step_i in range(start_step, cfg.train.steps):
+            if step_i == profile_start:
+                jax.profiler.start_trace(os.path.join(workdir, "profile"))
+                tracing = True
+            state, m = train_step(state, next(batches), base_key)
+            if tracing and step_i + 1 >= profile_stop:
+                jax.block_until_ready(state)
+                jax.profiler.stop_trace()
+                tracing = False
+                log.write("profile", dir=os.path.join(workdir, "profile"),
+                          steps=cfg.train.profile_steps)
+            imgs_since += cfg.data.batch_size
 
-        if (step_i + 1) % cfg.train.log_every == 0:
-            dt = time.time() - t_log
-            log.write(
-                "train", step=step_i + 1, loss=float(m["loss"]),
-                images_per_sec=round(imgs_since / max(dt, 1e-9), 2),
-            )
-            t_log, imgs_since = time.time(), 0
+            if (step_i + 1) % cfg.train.log_every == 0:
+                dt = time.time() - t_log
+                log.write(
+                    "train", step=step_i + 1, loss=float(m["loss"]),
+                    images_per_sec=round(imgs_since / max(dt, 1e-9), 2),
+                )
+                t_log, imgs_since = time.time(), 0
 
-        if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
-            grades, probs = predict_split(
-                cfg, model, state, data_dir, "val", mesh, eval_step=eval_step
-            )
-            # Early stopping always tracks *referable-DR* AUC; the 5-class
-            # head collapses to P(grade>=2) for this purpose (SURVEY.md N11).
-            bin_probs = (
-                probs if cfg.model.head == "binary"
-                else metrics.referable_probs_from_multiclass(probs)
-            )
-            auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
-            ckpt.save(step_i + 1, jax.device_get(state), {"val_auc": auc})
-            if auc > best_auc + cfg.train.min_delta:
-                best_auc, best_step, since_best = auc, step_i + 1, 0
-            else:
-                since_best += 1
-            log.write("eval", step=step_i + 1, val_auc=round(auc, 5),
-                      best_auc=round(best_auc, 5), since_best=since_best)
-            if since_best >= cfg.train.early_stop_patience:
-                stopped_early = True
-                log.write("early_stop", step=step_i + 1, best_step=best_step)
-                break
+            if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
+                grades, probs = predict_split(
+                    cfg, model, state, data_dir, "val", mesh, eval_step=eval_step
+                )
+                # Early stopping always tracks *referable-DR* AUC; the
+                # 5-class head collapses to P(grade>=2) here (SURVEY.md N11).
+                bin_probs = (
+                    probs if cfg.model.head == "binary"
+                    else metrics.referable_probs_from_multiclass(probs)
+                )
+                auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
+                ckpt.save(step_i + 1, jax.device_get(state), {"val_auc": auc})
+                if auc > best_auc + cfg.train.min_delta:
+                    best_auc, best_step, since_best = auc, step_i + 1, 0
+                else:
+                    since_best += 1
+                log.write("eval", step=step_i + 1, val_auc=round(auc, 5),
+                          best_auc=round(best_auc, 5), since_best=since_best)
+                if since_best >= cfg.train.early_stop_patience:
+                    stopped_early = True
+                    log.write("early_stop", step=step_i + 1, best_step=best_step)
+                    break
+    finally:
+        # Early stop / short runs / exceptions must not leak an open trace
+        # (the next fit() in an ensemble would crash on start_trace) or a
+        # flipped global debug flag.
+        if tracing:
+            jax.profiler.stop_trace()
+            log.write("profile", dir=os.path.join(workdir, "profile"),
+                      steps="truncated")
+        if cfg.train.debug:
+            jax.config.update("jax_debug_nans", prev_debug_nans)
 
     ckpt.wait()
     ckpt.close()

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Preprocess Messidor / Messidor-2 -> fundus-normalized TFRecord eval set
+(reference entry point of the same name, SURVEY.md §3.3; the held-out
+evaluation protocol of BASELINE.json:8).
+
+Messidor-2 ships adjudicated ICDR grades (0-4) in a CSV; original
+Messidor uses 0-3 retinopathy grades — both bin to referable DR at
+grade >= 2, so grades are stored raw exactly like EyePACS shards. The
+whole set is written as a single ``test`` split (it is an evaluation
+corpus; the reference never trained on it).
+
+Example:
+  python preprocess_messidor.py --data_dir=/data/messidor2/images \
+      --labels_csv=/data/messidor2/grades.csv --output_dir=/data/m2_tfr
+"""
+
+from __future__ import annotations
+
+import json
+
+from absl import app, flags
+
+_DATA_DIR = flags.DEFINE_string("data_dir", "", "directory of raw images")
+_LABELS = flags.DEFINE_string("labels_csv", "", "grading CSV path")
+_OUT = flags.DEFINE_string("output_dir", "", "TFRecord output directory")
+_SIZE = flags.DEFINE_integer("image_size", 299, "output diameter")
+_SHARDS = flags.DEFINE_integer("num_shards", 8, "shards for the test split")
+_BEN_GRAHAM = flags.DEFINE_boolean("ben_graham", False, "contrast enhancement")
+
+
+def main(argv):
+    del argv
+    from jama16_retina_tpu.preprocess import datasets
+
+    if not (_DATA_DIR.value and _LABELS.value and _OUT.value):
+        raise app.UsageError("--data_dir, --labels_csv, --output_dir required")
+
+    labels = datasets.parse_labels_csv(_LABELS.value)
+    items = sorted(labels.items())
+    stats = datasets.process_split(
+        items, _DATA_DIR.value, _OUT.value, "test",
+        image_size=_SIZE.value, num_shards=_SHARDS.value,
+        ben_graham=_BEN_GRAHAM.value,
+    )
+    print(json.dumps({"test": {"n_labeled": len(items), **stats.as_dict()}},
+                     indent=2))
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,96 @@
+"""Golden/regression tests (SURVEY.md §4.5): fixed-seed loss-curve snapshot
+to catch numeric drift, plus slow-marked smoke steps for every backbone."""
+
+import jax
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib
+from jama16_retina_tpu.configs import (
+    DataConfig,
+    ExperimentConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from jama16_retina_tpu.data import synthetic
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+# Regenerate with the snippet in this file's git history if an
+# *intentional* numeric change lands (optimizer math, BN epsilon, ...).
+GOLDEN_LOSSES = [
+    0.629701, 0.649518, 0.592727, 0.602597, 0.546152, 0.552273, 0.505571,
+    0.511634, 0.475866, 0.482175, 0.453977, 0.4601, 0.436576, 0.442141,
+    0.420471, 0.426378, 0.404534, 0.41107, 0.388373, 0.396005,
+]
+
+
+def _golden_cfg() -> ExperimentConfig:
+    return ExperimentConfig(
+        name="golden",
+        model=ModelConfig(
+            arch="tiny_cnn", head="binary", image_size=32, aux_head=False,
+            compute_dtype="float32", dropout_rate=0.0,
+        ),
+        data=DataConfig(batch_size=16, augment=False),
+        train=TrainConfig(
+            steps=20, learning_rate=1e-2, lr_schedule="constant",
+            optimizer="sgdm",
+        ),
+    )
+
+
+def test_fixed_seed_loss_curve_matches_golden():
+    cfg = _golden_cfg()
+    mesh = mesh_lib.make_mesh()
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(123))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    imgs, grades = synthetic.make_dataset(
+        32, synthetic.SynthConfig(image_size=32), seed=9
+    )
+    key = jax.random.key(7)
+    losses = []
+    for i in range(20):
+        idx = np.arange(16) if i % 2 == 0 else np.arange(16, 32)
+        b = mesh_lib.shard_batch(
+            {"image": imgs[idx], "grade": grades[idx].astype(np.int32)}, mesh
+        )
+        state, m = step(state, b, key)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, GOLDEN_LOSSES, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("arch", ["inception_v3", "resnet50", "efficientnet_b4"])
+def test_backbone_smoke_steps(arch):
+    """Two real optimizer steps per production backbone at reduced size:
+    finite loss, params actually move, BN stats mutate. (Slow: each arch
+    pays a full XLA CPU compile on this 1-vCPU host.)"""
+    cfg = ExperimentConfig(
+        name=f"smoke_{arch}",
+        model=ModelConfig(
+            arch=arch, head="binary", image_size=75,
+            aux_head=False, compute_dtype="float32",
+        ),
+        data=DataConfig(batch_size=8, augment=False),
+        train=TrainConfig(steps=4, learning_rate=1e-3, lr_schedule="constant",
+                          optimizer="adamw"),
+    )
+    mesh = mesh_lib.make_mesh()
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    p0 = jax.device_get(jax.tree.leaves(state.params)[0])
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    imgs, grades = synthetic.make_dataset(
+        8, synthetic.SynthConfig(image_size=75), seed=2
+    )
+    batch = mesh_lib.shard_batch(
+        {"image": imgs, "grade": grades.astype(np.int32)}, mesh
+    )
+    for _ in range(2):
+        state, m = step(state, batch, jax.random.key(1))
+    assert np.isfinite(float(m["loss"]))
+    p1 = jax.device_get(jax.tree.leaves(state.params)[0])
+    assert not np.allclose(p0, p1)

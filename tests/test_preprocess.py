@@ -1,0 +1,190 @@
+"""Offline-preprocessing tests (SURVEY.md §4.1): fundus normalization on
+synthetic circles with known geometry, label parsing, stratified splits,
+and the full raw-images -> TFRecords -> train pipeline round trip."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.data import pipeline, synthetic, tfrecord
+from jama16_retina_tpu.configs import DataConfig
+from jama16_retina_tpu.preprocess import (
+    FundusNotFound,
+    find_fundus_circle,
+    fundus,
+    resize_and_center_fundus,
+)
+from jama16_retina_tpu.preprocess import datasets
+
+
+def draw_disc(size_hw, cx, cy, r, value=120):
+    h, w = size_hw
+    img = np.zeros((h, w, 3), np.uint8)
+    yy, xx = np.mgrid[0:h, 0:w]
+    img[((xx - cx) ** 2 + (yy - cy) ** 2) <= r * r] = value
+    return img
+
+
+class TestFundusCircle:
+    def test_detects_known_circle(self):
+        img = draw_disc((400, 600), cx=310, cy=190, r=150)
+        c = find_fundus_circle(img)
+        assert abs(c.cx - 310) <= 2 and abs(c.cy - 190) <= 2
+        assert abs(c.radius - 150) <= 2
+
+    def test_blank_image_raises(self):
+        with pytest.raises(FundusNotFound):
+            find_fundus_circle(np.zeros((100, 100, 3), np.uint8))
+
+    def test_tiny_speck_raises(self):
+        img = np.zeros((200, 200, 3), np.uint8)
+        img[99:101, 99:101] = 200
+        with pytest.raises(FundusNotFound):
+            find_fundus_circle(img)
+
+    def test_vertically_cropped_frame_uses_width(self):
+        # EyePACS-style: circle top/bottom cut by the frame.
+        img = draw_disc((300, 500), cx=250, cy=150, r=200)
+        c = find_fundus_circle(img)
+        assert abs(c.radius - 200) <= 2
+        assert abs(c.cx - 250) <= 2
+
+
+class TestResizeAndCenter:
+    @pytest.mark.parametrize("cx,cy,r", [(310, 190, 150), (150, 150, 60),
+                                          (500, 260, 220)])
+    def test_output_centered_fixed_radius(self, cx, cy, r):
+        img = draw_disc((480, 720), cx, cy, r)
+        out = resize_and_center_fundus(img, diameter=128)
+        assert out.shape == (128, 128, 3) and out.dtype == np.uint8
+        c = find_fundus_circle(out, threshold=12)
+        # Centered within a couple px, radius ~= 128*0.98/2.
+        assert abs(c.cx - 64) <= 3 and abs(c.cy - 64) <= 3
+        assert abs(c.radius - 128 * 0.98 / 2) <= 3
+
+    def test_corners_are_black_with_mask(self):
+        img = np.full((300, 300, 3), 200, np.uint8)  # fully lit frame
+        out = resize_and_center_fundus(img, diameter=100, circular_mask=True)
+        assert out[0, 0].sum() == 0 and out[-1, -1].sum() == 0
+        assert out[50, 50].sum() > 0
+
+    def test_ben_graham_preserves_shape_and_range(self):
+        img = draw_disc((300, 300), 150, 150, 120)
+        out = resize_and_center_fundus(img, diameter=96, ben_graham=True)
+        assert out.shape == (96, 96, 3)
+        assert out.max() <= 255 and out.min() >= 0
+
+    def test_synthetic_fundus_roundtrip(self):
+        # The synthetic renderer's discs normalize cleanly too.
+        imgs, _ = synthetic.make_dataset(2, synthetic.SynthConfig(image_size=160))
+        for im in imgs:
+            out = resize_and_center_fundus(im, diameter=96)
+            c = find_fundus_circle(out)
+            assert abs(c.cx - 48) <= 4 and abs(c.cy - 48) <= 4
+
+
+class TestLabelsCsv:
+    def _write(self, tmp_path, rows, name="labels.csv", delim=","):
+        p = os.path.join(tmp_path, name)
+        with open(p, "w", newline="") as fh:
+            csv.writer(fh, delimiter=delim).writerows(rows)
+        return p
+
+    def test_eyepacs_format(self, tmp_path):
+        p = self._write(tmp_path, [["image", "level"], ["10_left", "0"],
+                                   ["10_right", "3"], ["13_left", "2"]])
+        labels = datasets.parse_labels_csv(p)
+        assert labels == {"10_left": 0, "10_right": 3, "13_left": 2}
+
+    def test_messidor_semicolon_format(self, tmp_path):
+        p = self._write(
+            tmp_path,
+            [["Image name", "Retinopathy grade", "Macular edema"],
+             ["20051020_43808_0100_PP.tif", "2", "0"],
+             ["20051020_43832_0100_PP.tif", "0", "1"]],
+            delim=";",
+        )
+        labels = datasets.parse_labels_csv(p)
+        assert labels == {
+            "20051020_43808_0100_PP": 2,
+            "20051020_43832_0100_PP": 0,
+        }
+
+    def test_headerless(self, tmp_path):
+        p = self._write(tmp_path, [["img_a", "1"], ["img_b", "4"]])
+        assert datasets.parse_labels_csv(p) == {"img_a": 1, "img_b": 4}
+
+    def test_empty_raises(self, tmp_path):
+        p = self._write(tmp_path, [])
+        with pytest.raises(ValueError):
+            datasets.parse_labels_csv(p)
+
+
+class TestStratifiedSplit:
+    def test_fractions_and_stratification(self):
+        labels = {f"g{g}_{i}": g for g in range(5) for i in range(40)}
+        splits = datasets.stratified_split(labels, 0.1, 0.2, seed=0)
+        assert len(splits["test"]) == 40 and len(splits["val"]) == 20
+        assert len(splits["train"]) == 140
+        for split in splits.values():
+            grades = [g for _, g in split]
+            assert set(grades) == set(range(5))  # every grade in every split
+        # Disjoint and complete.
+        names = [n for s in splits.values() for n, _ in s]
+        assert len(names) == len(set(names)) == 200
+
+    def test_deterministic_given_seed(self):
+        labels = {f"im{i}": i % 5 for i in range(50)}
+        a = datasets.stratified_split(labels, 0.2, 0.2, seed=3)
+        b = datasets.stratified_split(labels, 0.2, 0.2, seed=3)
+        assert a == b
+
+
+def test_end_to_end_raw_images_to_train_pipeline(tmp_path):
+    """Raw synthetic photos on disk + CSV -> process_split -> TFRecords
+    readable by the online pipeline (the full reference preprocessing
+    contract, SURVEY.md §3.3)."""
+    import cv2
+
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    rng = np.random.default_rng(0)
+    items = []
+    for i in range(8):
+        grade = int(rng.integers(0, 5))
+        # Rectangular frame with off-center disc, like a real photograph.
+        img = draw_disc((240, 320), cx=140 + i * 5, cy=120, r=90 + i,
+                        value=100 + i * 10)
+        cv2.imwrite(str(raw / f"im_{i}.jpeg"), img[..., ::-1])
+        items.append((f"im_{i}", grade))
+
+    out = tmp_path / "tfr"
+    stats = datasets.process_split(
+        items, str(raw), str(out), "train", image_size=96, num_shards=2
+    )
+    assert stats.written == 8 and stats.skipped_missing == 0
+    batch = next(
+        pipeline.train_batches(str(out), "train", DataConfig(batch_size=4), 96)
+    )
+    assert batch["image"].shape == (4, 96, 96, 3)
+    # Every stored image is a normalized centered disc.
+    c = find_fundus_circle(batch["image"][0])
+    assert abs(c.cx - 48) <= 4 and abs(c.cy - 48) <= 4
+
+
+def test_process_split_counts_missing_and_blank(tmp_path):
+    import cv2
+
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    cv2.imwrite(str(raw / "good.jpeg"),
+                draw_disc((200, 200), 100, 100, 80)[..., ::-1])
+    cv2.imwrite(str(raw / "blank.jpeg"), np.zeros((200, 200, 3), np.uint8))
+    items = [("good", 1), ("blank", 0), ("absent", 2)]
+    stats = datasets.process_split(items, str(raw), str(tmp_path / "o"),
+                                   "test", image_size=64, num_shards=1)
+    assert stats.written == 1
+    assert stats.skipped_no_fundus == 1
+    assert stats.skipped_missing == 1
