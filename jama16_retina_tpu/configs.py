@@ -65,6 +65,11 @@ class DataConfig:
     saturation_range: tuple[float, float] = (0.8, 1.2)
     hue_delta: float = 0.05
     rotate: bool = True  # fundus images have rotational symmetry
+    # Route the color half of augmentation through the fused pallas
+    # kernel (ops/pallas_augment.py, SURVEY.md N13) instead of the jnp
+    # composition. Same math; one HBM pass. TPU-only (tests use the
+    # kernel's interpret mode explicitly).
+    use_pallas: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

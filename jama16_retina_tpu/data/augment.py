@@ -49,45 +49,55 @@ def normalize(images_u8: jnp.ndarray) -> jnp.ndarray:
     return images_u8.astype(jnp.float32) / 127.5 - 1.0
 
 
-def _augment_one(key: jax.Array, img: jnp.ndarray, cfg: DataConfig) -> jnp.ndarray:
-    """img: HWC float32 in [-1, 1]."""
-    k = jax.random.split(key, 8)
+def _draw_params(key: jax.Array, n: int, cfg: DataConfig) -> dict:
+    """All augmentation randomness in 6 batch-level draws. Per-example
+    PRNG-key trees are threefry-expensive on TPU (hundreds of splits per
+    batch); drawing [n]-shaped vectors once keeps the RNG cost flat."""
+    k = jax.random.split(key, 6)
+    lo, hi = cfg.contrast_range
+    slo, shi = cfg.saturation_range
+    return {
+        "hflip": jax.random.bernoulli(k[0], shape=(n,)),
+        "vflip": jax.random.bernoulli(k[1], shape=(n,)),
+        "transpose": jax.random.bernoulli(k[2], shape=(n,)),
+        "brightness": jax.random.uniform(
+            k[3], (n,), minval=-cfg.brightness_delta,
+            maxval=cfg.brightness_delta,
+        ),
+        "contrast": jax.random.uniform(k[4], (n,), minval=lo, maxval=hi),
+        "sat_hue": jax.random.uniform(
+            k[5], (n, 2), minval=jnp.array([slo, -cfg.hue_delta]),
+            maxval=jnp.array([shi, cfg.hue_delta]),
+        ),
+    }
 
+
+def _augment_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
+    """img: HWC float32 in [-1, 1]; p: this example's slice of the params."""
     if cfg.flip:
-        img = jnp.where(jax.random.bernoulli(k[0]), img[:, ::-1], img)
-        img = jnp.where(jax.random.bernoulli(k[1]), img[::-1, :], img)
+        img = jnp.where(p["hflip"], img[:, ::-1], img)
+        img = jnp.where(p["vflip"], img[::-1, :], img)
     if cfg.rotate:
-        # Uniform choice of 0/90/180/270 via lax.switch (square images).
-        rot = jax.random.randint(k[2], (), 0, 4)
-        img = jax.lax.switch(
-            rot,
-            [
-                lambda x: x,
-                lambda x: jnp.rot90(x, 1),
-                lambda x: jnp.rot90(x, 2),
-                lambda x: jnp.rot90(x, 3),
-            ],
-            img,
-        )
+        # A random transpose composed with the two flips above generates
+        # the full dihedral group of the square — all four 90-degree
+        # rotations plus reflections — as three independent coin flips.
+        # One fused select instead of a 4-branch lax.switch, which under
+        # vmap materializes every rotated copy of the whole batch.
+        img = jnp.where(p["transpose"], jnp.swapaxes(img, 0, 1), img)
 
     if cfg.brightness_delta > 0:
-        img = img + jax.random.uniform(
-            k[3], (), minval=-cfg.brightness_delta, maxval=cfg.brightness_delta
-        )
+        img = img + p["brightness"]
     lo, hi = cfg.contrast_range
     if (lo, hi) != (1.0, 1.0):
-        c = jax.random.uniform(k[4], (), minval=lo, maxval=hi)
         mean = img.mean(axis=(0, 1), keepdims=True)
-        img = (img - mean) * c + mean
+        img = (img - mean) * p["contrast"] + mean
 
     # Chroma jitter in YIQ space: saturation scales (I, Q); hue rotates them.
     slo, shi = cfg.saturation_range
     if (slo, shi) != (1.0, 1.0) or cfg.hue_delta > 0:
         yiq = img @ _RGB2YIQ.T
-        s = jax.random.uniform(k[5], (), minval=slo, maxval=shi)
-        theta = jax.random.uniform(
-            k[6], (), minval=-cfg.hue_delta, maxval=cfg.hue_delta
-        ) * (2.0 * jnp.pi)
+        s = p["sat_hue"][0]
+        theta = p["sat_hue"][1] * (2.0 * jnp.pi)
         cos, sin = jnp.cos(theta) * s, jnp.sin(theta) * s
         i, q = yiq[..., 1], yiq[..., 2]
         yiq = jnp.stack(
@@ -98,12 +108,45 @@ def _augment_one(key: jax.Array, img: jnp.ndarray, cfg: DataConfig) -> jnp.ndarr
     return jnp.clip(img, -1.0, 1.0)
 
 
+def _geometric_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
+    if cfg.flip:
+        img = jnp.where(p["hflip"], img[:, ::-1], img)
+        img = jnp.where(p["vflip"], img[::-1, :], img)
+    if cfg.rotate:
+        img = jnp.where(p["transpose"], jnp.swapaxes(img, 0, 1), img)
+    return img
+
+
 def augment_batch(
-    key: jax.Array, images_u8: jnp.ndarray, cfg: DataConfig
+    key: jax.Array,
+    images_u8: jnp.ndarray,
+    cfg: DataConfig,
+    interpret: bool = False,
 ) -> jnp.ndarray:
-    """uint8 NHWC batch -> augmented float32 [-1,1] batch (train path)."""
-    imgs = normalize(images_u8)
+    """uint8 NHWC batch -> augmented float32 [-1,1] batch (train path).
+
+    ``cfg.use_pallas`` routes the color math through the fused kernel
+    (ops/pallas_augment.py); geometric moves are pixel permutations and
+    commute with per-pixel color ops (the contrast mean is permutation-
+    invariant), so applying color first is numerically equivalent to the
+    jnp path's geometric-first order.
+    """
     if not cfg.augment:
-        return imgs
-    keys = jax.random.split(key, imgs.shape[0])
-    return jax.vmap(lambda k, im: _augment_one(k, im, cfg))(keys, imgs)
+        return normalize(images_u8)
+    params = _draw_params(key, images_u8.shape[0], cfg)
+    if cfg.use_pallas:
+        from jama16_retina_tpu.ops import pallas_augment as pk
+
+        affine, offset = pk.color_affine_from_params(
+            pk.channel_means_u8(images_u8),
+            params["brightness"],
+            params["contrast"],
+            params["sat_hue"][:, 0],
+            params["sat_hue"][:, 1] * (2.0 * jnp.pi),
+        )
+        imgs = pk.fused_color_jitter(
+            images_u8, affine, offset, interpret=interpret
+        )
+        return jax.vmap(lambda im, p: _geometric_one(im, p, cfg))(imgs, params)
+    imgs = normalize(images_u8)
+    return jax.vmap(lambda im, p: _augment_one(im, p, cfg))(imgs, params)

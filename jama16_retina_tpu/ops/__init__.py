@@ -1,0 +1,1 @@
+"""Hand-written TPU kernels (SURVEY.md N13 — optional pallas perf slot)."""

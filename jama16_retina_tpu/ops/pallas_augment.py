@@ -1,0 +1,127 @@
+"""Pallas fused color-jitter kernel (SURVEY.md N13).
+
+The color half of the augmentation pipeline — uint8 -> [-1,1] normalize,
+brightness shift, contrast scale about the per-image mean, and the YIQ
+saturation/hue rotation (data/augment.py) — is algebraically one affine
+map per example:
+
+    out_c = sum_k A[c,k] * (x_k / 127.5 - 1) + o[c]
+
+with ``A = contrast * M_chroma`` and
+``o = M_chroma @ (mean * (1 - contrast) + brightness)`` (M_chroma =
+YIQ2RGB @ R(hue, sat) @ RGB2YIQ). XLA emits this as several fused loops
+plus a reduce; this kernel does the whole thing in ONE pass over HBM:
+uint8 pixels stream through VMEM once, 9 multiply-adds per pixel on the
+VPU, f32 out. Geometric augmentations (flips/transpose) are pure layout
+moves and stay in XLA where they fuse with the select.
+
+Layout: channels-first ``[B, 3, P]`` with P = H*W padded to the lane
+tile, so the per-channel rows sit in sublanes and the cross-channel
+combination is three row reads — no strided channel gather.
+
+Tested against the jnp reference in interpret mode on CPU
+(tests/test_pallas.py); ``fused_color_jitter`` is used by
+``augment_batch(..., use_pallas=True)`` on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_LANE = 128
+_CHUNK = 64 * _LANE  # pixels per grid step; 3x64x128 f32 ≈ 96 KiB of VMEM
+
+
+def _kernel(a_ref, o_ref, x_ref, out_ref):
+    x = x_ref[0].astype(jnp.float32) * (1.0 / 127.5) - 1.0  # [3, CHUNK]
+    a = a_ref[0]  # [3, 3]
+    o = o_ref[0]  # [3, 1] (kept 2-D for SMEM-free VMEM layout)
+    r, g, b = x[0], x[1], x[2]
+    rows = []
+    for c in range(3):
+        rows.append(
+            jnp.clip(
+                a[c, 0] * r + a[c, 1] * g + a[c, 2] * b + o[c, 0],
+                -1.0,
+                1.0,
+            )
+        )
+    out_ref[0] = jnp.stack(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_color_jitter(
+    images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
+    affine: jnp.ndarray,  # [B, 3, 3] f32 — A above
+    offset: jnp.ndarray,  # [B, 3] f32 — o above
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One-HBM-pass color jitter; returns [B, H, W, 3] float32 in [-1,1]."""
+    B, H, W, _ = images_u8.shape
+    P = H * W
+    P_pad = -(-P // _CHUNK) * _CHUNK
+    x = jnp.transpose(images_u8, (0, 3, 1, 2)).reshape(B, 3, P)
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, P_pad - P)))
+
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((B, 3, P_pad), jnp.float32),
+        grid=(B, P_pad // _CHUNK),
+        in_specs=[
+            pl.BlockSpec((1, 3, 3), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 3, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 3, _CHUNK), lambda b, j: (b, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, _CHUNK), lambda b, j: (b, 0, j)),
+        interpret=interpret,
+    )(affine, offset[..., None], x)
+
+    return jnp.transpose(out[:, :, :P].reshape(B, 3, H, W), (0, 2, 3, 1))
+
+
+def color_affine_from_params(
+    means: jnp.ndarray,  # [B, 3] per-image channel means of (x/127.5 - 1)
+    brightness: jnp.ndarray,  # [B]
+    contrast: jnp.ndarray,  # [B]
+    saturation: jnp.ndarray,  # [B]
+    hue_theta: jnp.ndarray,  # [B] radians
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Collapse the augment params into (A [B,3,3], o [B,3]).
+
+    Matches data/augment.py exactly: v = contrast*(t - mean) + mean +
+    brightness, then YIQ chroma rotation M @ v. (The jnp path computes
+    the contrast mean *after* brightness, but the mean of t + b is
+    mean(t) + b, so the algebra is identical.)
+    """
+    from jama16_retina_tpu.data.augment import _RGB2YIQ, _YIQ2RGB
+
+    B = means.shape[0]
+    cos = jnp.cos(hue_theta) * saturation
+    sin = jnp.sin(hue_theta) * saturation
+    zeros = jnp.zeros((B,))
+    ones = jnp.ones((B,))
+    rot = jnp.stack(
+        [
+            jnp.stack([ones, zeros, zeros], -1),
+            jnp.stack([zeros, cos, -sin], -1),
+            jnp.stack([zeros, sin, cos], -1),
+        ],
+        axis=-2,
+    )  # [B, 3, 3]
+    m_chroma = jnp.einsum("ij,bjk,kl->bil", _YIQ2RGB, rot, _RGB2YIQ)
+    affine = contrast[:, None, None] * m_chroma
+    o_pre = means * (1.0 - contrast[:, None]) + brightness[:, None]
+    offset = jnp.einsum("bij,bj->bi", m_chroma, o_pre)
+    return affine, offset
+
+
+def channel_means_u8(images_u8: jnp.ndarray) -> jnp.ndarray:
+    """Per-image channel means of (x/127.5 - 1), computed with a uint8->
+    f32 reduce (XLA; cheap single pass) — the kernel needs them as inputs
+    because contrast is defined about the image mean."""
+    return images_u8.astype(jnp.float32).mean(axis=(1, 2)) / 127.5 - 1.0

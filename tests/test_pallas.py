@@ -1,0 +1,92 @@
+"""Pallas fused color-jitter kernel vs the jnp reference (SURVEY.md N13).
+
+Runs the kernel in interpret mode (no TPU in the test environment); the
+compiled path is exercised on hardware by bench.py --use_pallas and the
+TPU-marked test below."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import DataConfig
+from jama16_retina_tpu.data import augment
+from jama16_retina_tpu.ops import pallas_augment as pk
+
+
+def _rand_images(b=4, h=37, w=53, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 256, (b, h, w, 3), np.uint8)
+    )
+
+
+def test_fused_kernel_matches_jnp_reference_exactly_parameterized():
+    """Identity params -> pure normalize; known params -> hand math."""
+    imgs = _rand_images()
+    B = imgs.shape[0]
+    ident_a = jnp.broadcast_to(jnp.eye(3), (B, 3, 3))
+    zero_o = jnp.zeros((B, 3))
+    out = pk.fused_color_jitter(imgs, ident_a, zero_o, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(augment.normalize(imgs)), atol=1e-6
+    )
+    # Scale+offset: A=0.5*I, o=0.25 -> clip(0.5*t + 0.25).
+    out = pk.fused_color_jitter(
+        imgs, 0.5 * ident_a, zero_o + 0.25, interpret=True
+    )
+    ref = jnp.clip(0.5 * augment.normalize(imgs) + 0.25, -1, 1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_pallas_path_matches_jnp_augment_path():
+    """Full augment_batch equivalence: the affine collapse + kernel must
+    reproduce the sequential jnp color pipeline bit-for-bit (up to f32
+    reassociation) including geometric moves."""
+    cfg = DataConfig()
+    imgs = _rand_images(b=6, h=41, w=41, seed=3)
+    key = jax.random.key(11)
+    ref = augment.augment_batch(key, imgs, cfg)
+    got = augment.augment_batch(
+        key, imgs, dataclasses.replace(cfg, use_pallas=True), interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_path_respects_disabled_color_flags():
+    cfg = DataConfig(
+        brightness_delta=0.0, contrast_range=(1.0, 1.0),
+        saturation_range=(1.0, 1.0), hue_delta=0.0,
+    )
+    imgs = _rand_images(b=2, h=16, w=24, seed=5)
+    key = jax.random.key(0)
+    ref = augment.augment_batch(key, imgs, cfg)
+    got = augment.augment_batch(
+        key, imgs, dataclasses.replace(cfg, use_pallas=True), interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+def test_non_tile_aligned_shapes():
+    """299x299 (the production size) is not lane-aligned; padding must be
+    invisible in the output."""
+    imgs = _rand_images(b=1, h=299, w=299, seed=7)
+    B = 1
+    a = jnp.broadcast_to(0.9 * jnp.eye(3), (B, 3, 3))
+    o = jnp.full((B, 3), 0.1)
+    out = pk.fused_color_jitter(imgs, a, o, interpret=True)
+    ref = jnp.clip(0.9 * augment.normalize(imgs) + 0.1, -1, 1)
+    assert out.shape == imgs.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+@pytest.mark.tpu
+def test_compiled_kernel_on_tpu():
+    imgs = _rand_images(b=2, h=128, w=128)
+    a = jnp.broadcast_to(jnp.eye(3), (2, 3, 3))
+    o = jnp.zeros((2, 3))
+    out = pk.fused_color_jitter(imgs, a, o)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(augment.normalize(imgs)), atol=1e-6
+    )
