@@ -50,6 +50,12 @@ def main(argv):
         if _FAKE_DEVICES.value:
             jax.config.update("jax_num_cpu_devices", _FAKE_DEVICES.value)
 
+    # Multi-host bring-up BEFORE anything touches a jax backend (no-op
+    # unless a coordinator is configured; SURVEY.md §3.5).
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed()
+
     from jama16_retina_tpu import configs, trainer
 
     cfg = configs.get_config(_CONFIG.value)

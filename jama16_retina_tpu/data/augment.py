@@ -22,26 +22,26 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from jama16_retina_tpu.configs import DataConfig
 
-# RGB <-> YIQ (NTSC) matrices.
-_RGB2YIQ = jnp.array(
+# RGB <-> YIQ (NTSC) matrices. The inverse is computed (in f64) rather
+# than using the classic hand-rounded [[1, .956, .621], ...] constants:
+# those are only a 3-decimal approximation, so the round trip
+# YIQ2RGB @ RGB2YIQ lands ~2.7e-3 off identity — a visible color shift
+# on every image and an irreducible gap between the sequential jnp path
+# and the pallas affine-collapsed path. With the true inverse the round
+# trip is identity to f32 rounding.
+_RGB2YIQ_F64 = np.array(
     [
         [0.299, 0.587, 0.114],
         [0.596, -0.274, -0.322],
         [0.211, -0.523, 0.312],
-    ],
-    dtype=jnp.float32,
+    ]
 )
-_YIQ2RGB = jnp.array(
-    [
-        [1.0, 0.956, 0.621],
-        [1.0, -0.272, -0.647],
-        [1.0, -1.106, 1.703],
-    ],
-    dtype=jnp.float32,
-)
+_RGB2YIQ = jnp.asarray(_RGB2YIQ_F64, dtype=jnp.float32)
+_YIQ2RGB = jnp.asarray(np.linalg.inv(_RGB2YIQ_F64), dtype=jnp.float32)
 
 
 def normalize(images_u8: jnp.ndarray) -> jnp.ndarray:
@@ -77,12 +77,15 @@ def _augment_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
     if cfg.flip:
         img = jnp.where(p["hflip"], img[:, ::-1], img)
         img = jnp.where(p["vflip"], img[::-1, :], img)
-    if cfg.rotate:
+    if cfg.rotate and img.shape[0] == img.shape[1]:
         # A random transpose composed with the two flips above generates
         # the full dihedral group of the square — all four 90-degree
         # rotations plus reflections — as three independent coin flips.
         # One fused select instead of a 4-branch lax.switch, which under
         # vmap materializes every rotated copy of the whole batch.
+        # Statically skipped for H != W: a transpose changes a rectangle's
+        # shape, and the rectangle's symmetry group has no 90-degree
+        # rotation — the two flips above already cover it.
         img = jnp.where(p["transpose"], jnp.swapaxes(img, 0, 1), img)
 
     if cfg.brightness_delta > 0:
@@ -93,9 +96,14 @@ def _augment_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
         img = (img - mean) * p["contrast"] + mean
 
     # Chroma jitter in YIQ space: saturation scales (I, Q); hue rotates them.
+    # The 3x3 matmuls are pinned to full-f32 precision: on TPU the MXU
+    # default is bf16 multiplicands, a ~1e-3 color error per round trip
+    # that costs nothing to avoid at this size (and would otherwise make
+    # the TPU jnp path diverge from CPU and from the pallas kernel).
     slo, shi = cfg.saturation_range
     if (slo, shi) != (1.0, 1.0) or cfg.hue_delta > 0:
-        yiq = img @ _RGB2YIQ.T
+        hp = jax.lax.Precision.HIGHEST
+        yiq = jnp.matmul(img, _RGB2YIQ.T, precision=hp)
         s = p["sat_hue"][0]
         theta = p["sat_hue"][1] * (2.0 * jnp.pi)
         cos, sin = jnp.cos(theta) * s, jnp.sin(theta) * s
@@ -103,7 +111,7 @@ def _augment_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
         yiq = jnp.stack(
             [yiq[..., 0], cos * i - sin * q, sin * i + cos * q], axis=-1
         )
-        img = yiq @ _YIQ2RGB.T
+        img = jnp.matmul(yiq, _YIQ2RGB.T, precision=hp)
 
     return jnp.clip(img, -1.0, 1.0)
 
@@ -112,7 +120,7 @@ def _geometric_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
     if cfg.flip:
         img = jnp.where(p["hflip"], img[:, ::-1], img)
         img = jnp.where(p["vflip"], img[::-1, :], img)
-    if cfg.rotate:
+    if cfg.rotate and img.shape[0] == img.shape[1]:
         img = jnp.where(p["transpose"], jnp.swapaxes(img, 0, 1), img)
     return img
 
@@ -136,6 +144,11 @@ def augment_batch(
     params = _draw_params(key, images_u8.shape[0], cfg)
     if cfg.use_pallas:
         from jama16_retina_tpu.ops import pallas_augment as pk
+
+        # Mosaic only lowers on TPU; on any other backend (CPU tests,
+        # --device=cpu, the multichip dryrun, a GPU host) fall back to
+        # the kernel's interpret mode so use_pallas configs run anywhere.
+        interpret = interpret or jax.default_backend() != "tpu"
 
         affine, offset = pk.color_affine_from_params(
             pk.channel_means_u8(images_u8),

@@ -30,18 +30,30 @@ from jama16_retina_tpu.data import tfrecord
 
 
 def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
-                      seed: int):
+                      seed: int, record_shard: tuple[int, int] | None = None):
     import tensorflow as tf
 
     ds = tf.data.Dataset.from_tensor_slices(list(paths))
     if training:
         ds = ds.shuffle(len(paths), seed=seed, reshuffle_each_iteration=True)
+    # deterministic=True even for training: the batch stream must be a
+    # pure function of (files, seed) so a resumed run can skip to its
+    # exact position (SURVEY.md §5.4 "input-pipeline position"; see
+    # train_batches skip_batches). Parallel reads still overlap — only
+    # their merge order is pinned.
     ds = ds.interleave(
         tf.data.TFRecordDataset,
         cycle_length=min(4, len(paths)),
         num_parallel_calls=tf.data.AUTOTUNE,
-        deterministic=not training,
+        deterministic=True,
     )
+    if record_shard is not None:
+        # Stride the SERIALIZED record stream — before the parse/decode
+        # map — so each host pays only 1/P of the decode work (tf.data's
+        # shard-early guidance). Requires the upstream file order to be
+        # identical on every process; train_batches guarantees that by
+        # using the un-offset seed in this branch.
+        ds = ds.shard(*record_shard)
     parse = tfrecord.parse_fn()
 
     def to_features(serialized):
@@ -74,17 +86,79 @@ def train_batches(
     cfg: DataConfig,
     image_size: int,
     seed: int = 0,
+    process_index: int | None = None,
+    process_count: int | None = None,
+    skip_batches: int = 0,
 ) -> Iterator[dict]:
-    """Infinite shuffled uint8 batches: {'image': [B,S,S,3], 'grade': [B]}."""
+    """Infinite shuffled uint8 batches: {'image': [B,S,S,3], 'grade': [B]}.
+
+    ``skip_batches``: resume support (SURVEY.md §5.4). The stream is a
+    pure function of (files, seed) — deterministic interleave + seeded
+    shuffles — so skipping k batches reproduces exactly the state an
+    uninterrupted run would have after k steps. The skipped records are
+    still read/decoded once at startup (bounded: ~one decode pass per
+    skipped epoch; raw-encoded records make this a parse, not a decode).
+
+    Multi-host (SURVEY.md §3.5): each process reads a disjoint 1/P slice
+    of the data — by whole shard files when there are enough, else by
+    record striding — and yields LOCAL batches of ``batch_size / P``
+    rows. ``mesh_lib.shard_batch`` / ``device_prefetch`` then assemble
+    the global array, so the train step always sees the global batch.
+    Defaults resolve from the jax runtime; single-process is unchanged.
+    """
     import tensorflow as tf
 
+    p_idx, p_cnt = _resolve_process(process_index, process_count)
+    batch_size = _local_batch_size(cfg.batch_size, p_cnt, "data.batch_size")
+
     paths = tfrecord.list_split(data_dir, split)
-    ds = _build_tf_dataset(paths, image_size, True, cfg, seed)
-    ds = ds.shuffle(cfg.shuffle_buffer, seed=seed).repeat()
-    ds = ds.batch(cfg.batch_size, drop_remainder=True)
+    if p_cnt > 1 and len(paths) >= p_cnt:
+        paths = paths[p_idx::p_cnt]  # file-level sharding: no wasted reads
+        record_shard = None
+        # Disjoint by construction (different files) — offsetting the
+        # file-shuffle seed per process just decorrelates epoch orders.
+        file_seed = seed + p_idx
+    elif p_cnt > 1:
+        # Few shard files: stride the one record stream instead. The
+        # file-shuffle seed MUST be identical on every process here —
+        # the strides partition positions of a single logical stream, so
+        # differently-ordered streams would overlap/drop records.
+        record_shard = (p_cnt, p_idx)
+        file_seed = seed
+    else:
+        record_shard = None
+        file_seed = seed
+    # The post-shard record shuffle may always be process-offset: its
+    # input is already this process's disjoint slice.
+    shuffle_seed = seed + p_idx if p_cnt > 1 else seed
+    ds = _build_tf_dataset(
+        paths, image_size, True, cfg, file_seed, record_shard=record_shard
+    )
+    ds = ds.shuffle(cfg.shuffle_buffer, seed=shuffle_seed).repeat()
+    ds = ds.batch(batch_size, drop_remainder=True)
+    if skip_batches:
+        ds = ds.skip(skip_batches)
     ds = ds.prefetch(cfg.prefetch_batches)
     for image, grade in ds.as_numpy_iterator():
         yield {"image": image, "grade": grade}
+
+
+def _resolve_process(
+    process_index: int | None, process_count: int | None
+) -> tuple[int, int]:
+    if process_count is None:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    return process_index or 0, process_count
+
+
+def _local_batch_size(global_batch: int, p_cnt: int, what: str) -> int:
+    if global_batch % p_cnt:
+        raise ValueError(
+            f"{what}={global_batch} not divisible by process_count={p_cnt}"
+        )
+    return global_batch // p_cnt
 
 
 def eval_batches(
@@ -92,9 +166,25 @@ def eval_batches(
     split: str,
     batch_size: int,
     image_size: int,
+    process_index: int | None = None,
+    process_count: int | None = None,
 ) -> Iterator[dict]:
     """One epoch of padded batches: {'image', 'grade', 'mask'} — mask=0 rows
-    are padding and must be dropped after host gather."""
+    are padding and must be dropped after host gather.
+
+    Multi-host: every process enumerates the SAME deterministic global
+    batch sequence (identical file list, no shuffle) so all hosts make
+    the same number of jit dispatches — differing counts would deadlock
+    the collective runtime. 'image' is this process's local row block
+    (rows [p*B/P, (p+1)*B/P) of the global batch, matching the
+    process-major layout ``shard_batch`` assembles); 'grade' and 'mask'
+    stay GLOBAL — they are host-side metadata for the metrics layer,
+    which sees replicated global probabilities. Eval decode is paid on
+    every host; eval runs are rare and correctness-critical, train is
+    where per-process sharding saves decode (train_batches).
+    """
+    p_idx, p_cnt = _resolve_process(process_index, process_count)
+    local = _local_batch_size(batch_size, p_cnt, "eval.batch_size")
     paths = tfrecord.list_split(data_dir, split)
     ds = _build_tf_dataset(paths, image_size, False, DataConfig(), seed=0)
     ds = ds.batch(batch_size, drop_remainder=False)
@@ -107,7 +197,11 @@ def eval_batches(
             )
             grade = np.concatenate([grade, np.zeros((pad,), grade.dtype)], axis=0)
         mask = (np.arange(batch_size) < n).astype(np.float32)
-        yield {"image": image, "grade": grade, "mask": mask}
+        yield {
+            "image": image[p_idx * local:(p_idx + 1) * local],
+            "grade": grade,
+            "mask": mask,
+        }
 
 
 def device_prefetch(
@@ -121,13 +215,20 @@ def device_prefetch(
     lets H2D copies run behind the current step's compute.
     """
     queue: collections.deque = collections.deque()
+    multiprocess = jax.process_count() > 1
 
     def put(batch: dict) -> dict:
         if sharding is None:
             return jax.device_put(batch)
-        return jax.tree.map(
-            lambda x: jax.device_put(x, _shard_for(x, sharding)), batch
-        )
+
+        def one(x):
+            sh = _shard_for(x, sharding)
+            if multiprocess and np.ndim(x):
+                # Local rows -> global array (see mesh_lib.shard_batch).
+                return jax.make_array_from_process_local_data(sh, np.asarray(x))
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(one, batch)
 
     def _shard_for(x, sharding):
         # Rank-aware: batch-dim sharding for arrays, replicated for scalars.

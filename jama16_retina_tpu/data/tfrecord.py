@@ -4,9 +4,22 @@ The reference's offline preprocessing emits partitioned image sets that
 its ``lib/dataset`` tf.data pipeline consumes (BASELINE.json:5 "the
 existing TFRecord pipeline"). Here the on-disk contract is explicit:
 
-    image/encoded  bytes   JPEG
+    image/encoded  bytes   JPEG (empty when the record is raw-encoded)
+    image/raw      bytes   raw uint8 HWC pixels (empty when JPEG-encoded)
+    image/height   int64   raw height (0 for JPEG records)
+    image/width    int64   raw width (0 for JPEG records)
     image/grade    int64   ICDR grade 0..4 (binary label derived online)
     image/name     bytes   source image id (debugging / dedup)
+
+Two encodings, chosen at preprocessing time:
+
+  * ``jpeg`` — compact (~30 KB/img at 299px), but each training epoch
+    pays a host JPEG decode per image. On this 1-vCPU host that caps the
+    feed rate far below what the chip consumes (measured by bench.py).
+  * ``raw``  — pre-decoded uint8 (268 KB/img at 299px, ~9x disk). The
+    hot path becomes a memcpy-parse; decode is paid ONCE offline. This
+    is the practical form of "decoding straight into HBM"
+    (BASELINE.json:5) when the host is CPU-starved.
 
 Files are sharded ``<split>-00007-of-00016.tfrecord`` so tf.data can
 interleave reads across shards. TF runs CPU-only here; it never touches
@@ -51,6 +64,29 @@ def make_example(jpeg_bytes: bytes, grade: int, name: str = ""):
     return tf.train.Example(features=tf.train.Features(feature=feat))
 
 
+def make_raw_example(image_u8: np.ndarray, grade: int, name: str = ""):
+    """Pre-decoded record: uint8 HWC pixels stored verbatim (see module
+    docstring for the jpeg/raw trade-off)."""
+    tf = _tf()
+    h, w, c = image_u8.shape
+    if c != 3 or image_u8.dtype != np.uint8:
+        raise ValueError(f"expected uint8 HW3, got {image_u8.dtype} {image_u8.shape}")
+    feat = {
+        "image/raw": tf.train.Feature(
+            bytes_list=tf.train.BytesList(value=[image_u8.tobytes()])
+        ),
+        "image/height": tf.train.Feature(int64_list=tf.train.Int64List(value=[h])),
+        "image/width": tf.train.Feature(int64_list=tf.train.Int64List(value=[w])),
+        "image/grade": tf.train.Feature(
+            int64_list=tf.train.Int64List(value=[int(grade)])
+        ),
+        "image/name": tf.train.Feature(
+            bytes_list=tf.train.BytesList(value=[name.encode()])
+        ),
+    }
+    return tf.train.Example(features=tf.train.Features(feature=feat))
+
+
 def write_shards(
     records: Iterable[tuple[bytes, int, str]],
     out_dir: str,
@@ -58,13 +94,24 @@ def write_shards(
     num_shards: int,
 ) -> list[str]:
     """Round-robin the (jpeg, grade, name) stream into ``num_shards`` files."""
+    return write_example_shards(
+        (make_example(j, g, n) for j, g, n in records), out_dir, split, num_shards
+    )
+
+
+def write_example_shards(
+    examples: Iterable,
+    out_dir: str,
+    split: str,
+    num_shards: int,
+) -> list[str]:
+    """Round-robin pre-built tf.train.Examples into ``num_shards`` files."""
     tf = _tf()
     os.makedirs(out_dir, exist_ok=True)
     paths = [shard_path(out_dir, split, i, num_shards) for i in range(num_shards)]
     writers = [tf.io.TFRecordWriter(p) for p in paths]
     try:
-        for i, (jpeg, grade, name) in enumerate(records):
-            ex = make_example(jpeg, grade, name)
+        for i, ex in enumerate(examples):
             writers[i % num_shards].write(ex.SerializeToString())
     finally:
         for w in writers:
@@ -91,6 +138,7 @@ def write_synthetic_split(
     image_size: int = 299,
     num_shards: int = 4,
     seed: int = 0,
+    encoding: str = "jpeg",
 ) -> list[str]:
     """Test/bench fixture: synthetic fundus images -> real TFRecord shards,
     so the whole online pipeline is exercised byte-identically to how it
@@ -101,11 +149,15 @@ def write_synthetic_split(
         n, synthetic.SynthConfig(image_size=image_size), seed=seed
     )
 
-    def gen() -> Iterator[tuple[bytes, int, str]]:
+    def gen() -> Iterator:
         for i in range(n):
-            yield encode_jpeg(images[i]), int(grades[i]), f"{split}_{seed}_{i:05d}"
+            name = f"{split}_{seed}_{i:05d}"
+            if encoding == "raw":
+                yield make_raw_example(images[i], int(grades[i]), name)
+            else:
+                yield make_example(encode_jpeg(images[i]), int(grades[i]), name)
 
-    return write_shards(gen(), out_dir, split, num_shards)
+    return write_example_shards(gen(), out_dir, split, num_shards)
 
 
 def list_split(data_dir: str, split: str) -> list[str]:
@@ -129,17 +181,34 @@ FEATURE_SPEC = {
 
 
 def parse_fn():
-    """Returns a tf.data map fn: serialized Example -> (image_u8, grade, name)."""
+    """Returns a tf.data map fn: serialized Example -> (image_u8, grade, name).
+
+    Handles both encodings per record: raw records reshape a byte string
+    (memcpy-cheap), JPEG records decode. The branch is a dynamic tf.cond
+    because shards of either encoding may be mixed in one directory."""
     tf = _tf()
     spec = {
-        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/encoded": tf.io.FixedLenFeature([], tf.string, default_value=""),
+        "image/raw": tf.io.FixedLenFeature([], tf.string, default_value=""),
+        "image/height": tf.io.FixedLenFeature([], tf.int64, default_value=0),
+        "image/width": tf.io.FixedLenFeature([], tf.int64, default_value=0),
         "image/grade": tf.io.FixedLenFeature([], tf.int64),
         "image/name": tf.io.FixedLenFeature([], tf.string, default_value=""),
     }
 
     def parse(serialized):
         f = tf.io.parse_single_example(serialized, spec)
-        image = tf.io.decode_jpeg(f["image/encoded"], channels=3)
+        image = tf.cond(
+            tf.strings.length(f["image/raw"]) > 0,
+            lambda: tf.reshape(
+                tf.io.decode_raw(f["image/raw"], tf.uint8),
+                tf.stack(
+                    [tf.cast(f["image/height"], tf.int32),
+                     tf.cast(f["image/width"], tf.int32), 3]
+                ),
+            ),
+            lambda: tf.io.decode_jpeg(f["image/encoded"], channels=3),
+        )
         return image, tf.cast(f["image/grade"], tf.int32), f["image/name"]
 
     return parse

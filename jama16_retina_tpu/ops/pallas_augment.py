@@ -38,7 +38,10 @@ _CHUNK = 64 * _LANE  # pixels per grid step; 3x64x128 f32 ≈ 96 KiB of VMEM
 
 
 def _kernel(a_ref, o_ref, x_ref, out_ref):
-    x = x_ref[0].astype(jnp.float32) * (1.0 / 127.5) - 1.0  # [3, CHUNK]
+    # Mosaic has no direct uint8->f32 cast on TPU; stage through int32
+    # (both legs are supported and exact for [0, 255]).
+    x = x_ref[0].astype(jnp.int32).astype(jnp.float32)  # [3, CHUNK]
+    x = x * (1.0 / 127.5) - 1.0
     a = a_ref[0]  # [3, 3]
     o = o_ref[0]  # [3, 1] (kept 2-D for SMEM-free VMEM layout)
     r, g, b = x[0], x[1], x[2]
@@ -113,10 +116,20 @@ def color_affine_from_params(
         ],
         axis=-2,
     )  # [B, 3, 3]
-    m_chroma = jnp.einsum("ij,bjk,kl->bil", _YIQ2RGB, rot, _RGB2YIQ)
+    # Decomposed as I + Minv (rot - I) M rather than Minv rot M: when the
+    # drawn params are identity (s=1, theta=0 — e.g. all color flags off),
+    # rot - I is exactly zero and the affine is exactly I, independent of
+    # f32 rounding in the matrix inverse. The jnp path statically skips
+    # the chroma block in that case, so exactness here is what keeps the
+    # two paths bit-compatible.
+    eye = jnp.eye(3, dtype=rot.dtype)
+    hp = jax.lax.Precision.HIGHEST
+    m_chroma = eye + jnp.einsum(
+        "ij,bjk,kl->bil", _YIQ2RGB, rot - eye, _RGB2YIQ, precision=hp
+    )
     affine = contrast[:, None, None] * m_chroma
     o_pre = means * (1.0 - contrast[:, None]) + brightness[:, None]
-    offset = jnp.einsum("bij,bj->bi", m_chroma, o_pre)
+    offset = jnp.einsum("bij,bj->bi", m_chroma, o_pre, precision=hp)
     return affine, offset
 
 

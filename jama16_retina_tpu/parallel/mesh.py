@@ -21,21 +21,73 @@ mesh code scales unchanged.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Env vars whose presence means "a multi-process launch is configured".
+# JAX's own auto-detection (cluster_detection_method) covers GKE/TPU-pod
+# metadata; these cover explicit launchers. Guarding on env — NOT on
+# jax.process_count(), which itself initializes a backend and always
+# returns 1 before jax.distributed.initialize() has run.
+_COORDINATOR_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
 
-def initialize_distributed() -> None:
-    """Multi-host bring-up (SURVEY.md §3.5). Safe to call single-host."""
-    if jax.process_count() > 1:
-        return  # already initialized by the launcher
-    try:
-        jax.distributed.initialize()
-    except Exception:
-        # Single-host / no coordinator configured: run locally.
-        pass
+
+def _multihost_env_configured() -> bool:
+    if any(os.environ.get(v) for v in _COORDINATOR_ENV_VARS):
+        return True
+    # Cloud TPU metadata: set on every TPU VM, including single-host
+    # slices (this axon environment exports 'localhost') — only a
+    # multi-name list means an actual pod of workers.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return "," in hostnames
+
+
+def initialize_distributed(force: bool = False) -> bool:
+    """Multi-host bring-up (SURVEY.md §3.5). MUST run before any other jax
+    API touches a backend — jax.distributed.initialize() after backend
+    init is too late. train.py/evaluate.py call this first thing in main.
+
+    Single-host (no coordinator env configured) this is a no-op, so the
+    same entry points run unchanged on one chip. Returns True when
+    distributed initialization actually ran.
+    """
+    if jax.distributed.is_initialized():
+        return True
+    if not force and not _multihost_env_configured():
+        return False  # single-host: leave the local backend to init lazily
+    addr = (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    )
+    kwargs = {}
+    if addr:
+        kwargs["coordinator_address"] = addr
+        n, p = os.environ.get("JAX_NUM_PROCESSES"), os.environ.get("JAX_PROCESS_ID")
+        # jax.distributed.initialize needs BOTH (or neither, relying on
+        # cluster auto-detection). Fail here with the missing name — a
+        # half-set launcher env otherwise dies with a jax-internal error
+        # on some hosts while the rest block on the coordinator.
+        if (n is None) != (p is None):
+            missing = "JAX_NUM_PROCESSES" if n is None else "JAX_PROCESS_ID"
+            raise RuntimeError(
+                f"multi-host launch env is half-configured: "
+                f"JAX_COORDINATOR_ADDRESS is set but {missing} is not "
+                "(set both JAX_NUM_PROCESSES and JAX_PROCESS_ID, or "
+                "neither if the cluster is auto-detectable)"
+            )
+        if n is not None:
+            kwargs["num_processes"] = int(n)
+            kwargs["process_id"] = int(p)
+    jax.distributed.initialize(**kwargs)
+    return True
 
 
 def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
@@ -62,12 +114,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(batch, mesh: Mesh):
-    """Place a host batch dict as global arrays sharded on dim 0."""
-    sh = batch_sharding(mesh)
+    """Place a host batch dict as global arrays sharded on dim 0.
+
+    Single-process: a plain sharded device_put. Multi-process: each
+    process contributes its LOCAL rows (the per-process slice the input
+    pipeline produced, SURVEY.md §3.5) and
+    ``jax.make_array_from_process_local_data`` assembles the global
+    array — global dim 0 = sum of local dims, laid out process-major
+    (jax.devices() orders each process's devices contiguously).
+    """
+    multiprocess = jax.process_count() > 1
 
     def put(x):
         x = np.asarray(x)
         spec = P(mesh.axis_names[0], *([None] * (x.ndim - 1))) if x.ndim else P()
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if multiprocess and x.ndim:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
 
     return jax.tree.map(put, batch)

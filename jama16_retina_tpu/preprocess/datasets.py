@@ -123,13 +123,21 @@ def process_split(
     num_shards: int = 16,
     ben_graham: bool = False,
     jpeg_quality: int = 92,
+    encoding: str = "jpeg",
 ) -> PreprocessStats:
-    """Normalize every (name, grade) image and write TFRecord shards."""
+    """Normalize every (name, grade) image and write TFRecord shards.
+
+    ``encoding='raw'`` stores pre-decoded uint8 pixels (~9x disk at
+    299px) so the training host never pays a per-epoch JPEG decode —
+    the feed-rate mitigation measured in bench.py / docs/PERF.md.
+    """
     import cv2
 
+    if encoding not in ("jpeg", "raw"):
+        raise ValueError(f"encoding must be jpeg|raw, got {encoding!r}")
     stats = PreprocessStats()
 
-    def records() -> Iterator[tuple[bytes, int, str]]:
+    def examples() -> Iterator:
         for name, grade in items:
             path = find_image(data_dir, name)
             if path is None:
@@ -148,7 +156,12 @@ def process_split(
                 stats.skipped_no_fundus += 1
                 continue
             stats.written += 1
-            yield tfrecord.encode_jpeg(norm, quality=jpeg_quality), grade, name
+            if encoding == "raw":
+                yield tfrecord.make_raw_example(norm, grade, name)
+            else:
+                yield tfrecord.make_example(
+                    tfrecord.encode_jpeg(norm, quality=jpeg_quality), grade, name
+                )
 
-    tfrecord.write_shards(records(), out_dir, split, num_shards)
+    tfrecord.write_example_shards(examples(), out_dir, split, num_shards)
     return stats

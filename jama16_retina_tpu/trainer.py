@@ -16,6 +16,7 @@ import time
 
 import jax
 import numpy as np
+from absl import logging as absl_logging
 
 from jama16_retina_tpu import models, train_lib
 from jama16_retina_tpu.configs import ExperimentConfig
@@ -53,15 +54,52 @@ def predict_split(
     for batch in pipeline.eval_batches(
         data_dir, split, cfg.eval.batch_size, cfg.model.image_size
     ):
+        # Only the image rows go to device — 'grade'/'mask' are global
+        # host metadata (multi-host: 'image' is the per-process block,
+        # see pipeline.eval_batches), and eval_step reads only 'image'.
         if mesh is not None:
-            dev_batch = mesh_lib.shard_batch(batch, mesh)
+            dev_batch = mesh_lib.shard_batch({"image": batch["image"]}, mesh)
         else:
-            dev_batch = jax.device_put(batch)
+            dev_batch = jax.device_put({"image": batch["image"]})
         probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
         keep = batch["mask"] > 0
         grades_all.append(batch["grade"][keep])
         probs_all.append(probs[keep])
     return np.concatenate(grades_all), np.concatenate(probs_all)
+
+
+def _run_meta_path(workdir: str) -> str:
+    return os.path.join(workdir, "run_meta.json")
+
+
+def _load_or_write_run_meta(
+    workdir: str, seed: int, cfg_name: str, resume: bool
+) -> int:
+    """Persist the data/PRNG seed so --resume reproduces the exact stream
+    even if the CLI seed differs (SURVEY.md §5.4: the saved PRNG 'state'
+    is just (seed, step) — keys are derived by fold_in(key(seed), step)
+    inside the jit step, and the pipeline is a pure function of seed).
+
+    The persisted seed wins ONLY on resume; a fresh run in a reused
+    workdir takes the requested seed and rewrites the meta (otherwise a
+    deliberately re-seeded rerun would silently duplicate the old run).
+    """
+    import json
+
+    path = _run_meta_path(workdir)
+    if resume and os.path.exists(path):
+        with open(path) as f:
+            meta = json.load(f)
+        if int(meta.get("seed", seed)) != seed:
+            absl_logging.warning(
+                "resuming with run_meta seed %s (CLI seed %s ignored for "
+                "stream continuity)", meta["seed"], seed,
+            )
+        return int(meta.get("seed", seed))
+    os.makedirs(workdir, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"seed": seed, "config": cfg_name}, f)
+    return seed
 
 
 def fit(
@@ -73,6 +111,7 @@ def fit(
 ) -> dict:
     """Train one model; returns {'best_auc', 'best_step', 'stopped_early'}."""
     seed = cfg.train.seed if seed is None else seed
+    seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
     prev_debug_nans = jax.config.jax_debug_nans
     if cfg.train.debug:
         jax.config.update("jax_debug_nans", True)
@@ -103,9 +142,14 @@ def fit(
         log.write("resume", step=start_step)
 
     base_key = jax.random.key(seed)
+    # skip_batches=start_step: one batch per completed step, so a resumed
+    # stream continues exactly where the interrupted one stopped
+    # (pipeline determinism; SURVEY.md §5.4). Augment/dropout keys need
+    # no restoring — they are fold_in(base_key, state.step) in-step.
     batches = pipeline.device_prefetch(
         pipeline.train_batches(
-            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed
+            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+            skip_batches=start_step,
         ),
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
@@ -190,7 +234,10 @@ def fit(
     ckpt.close()
     log.close()
     return {
-        "best_auc": float(best_auc),
+        # None (not -inf) when no eval ever ran — e.g. --resume with the
+        # restored step already at train.steps. json.dumps would otherwise
+        # emit -Infinity, which is not valid JSON.
+        "best_auc": float(best_auc) if np.isfinite(best_auc) else None,
         "best_step": int(best_step),
         "stopped_early": stopped_early,
     }

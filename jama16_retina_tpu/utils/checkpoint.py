@@ -39,6 +39,7 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        self._max_to_keep = max_to_keep
         self._best = ocp.CheckpointManager(
             os.path.join(directory, "best"),
             options=ocp.CheckpointManagerOptions(
@@ -54,12 +55,29 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: TrainState, metrics: dict) -> None:
-        self._best.save(
-            step,
-            args=ocp.args.StandardSave(state),
-            metrics={k: float(v) for k, v in metrics.items()},
-        )
+        """``latest/`` is written every time; ``best/`` only when this step
+        would actually enter the top-k by metric — otherwise orbax would
+        serialize the full state just to delete it during retention,
+        doubling checkpoint IO on every non-improving eval."""
+        if self._enters_best(float(metrics[BEST_METRIC])):
+            self._best.save(
+                step,
+                args=ocp.args.StandardSave(state),
+                metrics={k: float(v) for k, v in metrics.items()},
+            )
         self._latest.save(step, args=ocp.args.StandardSave(state))
+
+    def _enters_best(self, metric: float) -> bool:
+        steps = self._best.all_steps()
+        if len(steps) < self._max_to_keep:
+            return True
+        kept = []
+        for s in steps:
+            m = self._best.metrics(s)
+            if m is None:  # metricless step (shouldn't happen): displaceable
+                return True
+            kept.append(float(m[BEST_METRIC]))
+        return metric > min(kept)
 
     def wait(self) -> None:
         self._best.wait_until_finished()

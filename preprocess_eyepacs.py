@@ -32,6 +32,11 @@ _BEN_GRAHAM = flags.DEFINE_boolean(
     "subtract-local-average contrast enhancement (quality option beyond "
     "the reference's plain normalization)",
 )
+_ENCODING = flags.DEFINE_enum(
+    "encoding", "jpeg", ["jpeg", "raw"],
+    "record encoding: jpeg (compact) or raw pre-decoded uint8 (~9x disk, "
+    "removes the per-epoch host JPEG decode — see docs/PERF.md)",
+)
 
 
 def main(argv):
@@ -50,7 +55,7 @@ def main(argv):
         stats = datasets.process_split(
             items, _DATA_DIR.value, _OUT.value, split,
             image_size=_SIZE.value, num_shards=_SHARDS.value,
-            ben_graham=_BEN_GRAHAM.value,
+            ben_graham=_BEN_GRAHAM.value, encoding=_ENCODING.value,
         )
         report[split] = {"n_labeled": len(items), **stats.as_dict()}
     print(json.dumps(report, indent=2))

@@ -26,6 +26,11 @@ _OUT = flags.DEFINE_string("output_dir", "", "TFRecord output directory")
 _SIZE = flags.DEFINE_integer("image_size", 299, "output diameter")
 _SHARDS = flags.DEFINE_integer("num_shards", 8, "shards for the test split")
 _BEN_GRAHAM = flags.DEFINE_boolean("ben_graham", False, "contrast enhancement")
+_ENCODING = flags.DEFINE_enum(
+    "encoding", "jpeg", ["jpeg", "raw"],
+    "record encoding: jpeg (compact) or raw pre-decoded uint8 (see "
+    "docs/PERF.md)",
+)
 
 
 def main(argv):
@@ -40,7 +45,7 @@ def main(argv):
     stats = datasets.process_split(
         items, _DATA_DIR.value, _OUT.value, "test",
         image_size=_SIZE.value, num_shards=_SHARDS.value,
-        ben_graham=_BEN_GRAHAM.value,
+        ben_graham=_BEN_GRAHAM.value, encoding=_ENCODING.value,
     )
     print(json.dumps({"test": {"n_labeled": len(items), **stats.as_dict()}},
                      indent=2))

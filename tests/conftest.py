@@ -7,14 +7,21 @@ The real-TPU path is exercised separately by bench.py / __graft_entry__.py.
 
 import os
 
+# Escape hatch for the @pytest.mark.tpu tests: run them on the ambient
+# (real TPU) platform with
+#   JAMA16_TPU_TESTS=1 pytest -m tpu --override-ini addopts=
+# Everything else runs on 8 fake CPU devices below.
+_USE_REAL_TPU = os.environ.get("JAMA16_TPU_TESTS") == "1"
+
 # Hard override: the ambient environment pins JAX_PLATFORMS=axon (the one
 # real TPU chip); tests must instead see 8 fake CPU devices.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if not _USE_REAL_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 # Keep TF (used only for tf.data/TFRecord on host) off any accelerator.
 os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
@@ -26,8 +33,9 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 # which has not happened yet at plugin-import time.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _USE_REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import sys
 

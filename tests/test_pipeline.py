@@ -116,3 +116,101 @@ def test_device_prefetch_shards_batch_dim(data_dir):
     shard_shapes = {s.data.shape for s in out["image"].addressable_shards}
     assert shard_shapes == {(1, SIZE, SIZE, 3)}
     assert len(out["image"].sharding.device_set) == 8
+
+
+def test_raw_records_roundtrip_and_match_source(tmp_path):
+    """Raw-encoded TFRecords (pre-decoded mitigation, VERDICT r1 #3) carry
+    pixels bit-exactly — unlike JPEG there is no codec loss to tolerate."""
+    from jama16_retina_tpu.data import synthetic
+
+    images, grades = synthetic.make_dataset(
+        6, synthetic.SynthConfig(image_size=SIZE), seed=9
+    )
+    tfrecord.write_example_shards(
+        (tfrecord.make_raw_example(images[i], int(grades[i]), f"r{i}")
+         for i in range(6)),
+        str(tmp_path), "test", 2,
+    )
+    got = list(pipeline.eval_batches(str(tmp_path), "test", 8, SIZE))
+    assert sum(int(b["mask"].sum()) for b in got) == 6
+    # Deterministic eval order lets us match rows back to sources by grade
+    # multiset and exact-pixel membership.
+    out_imgs = got[0]["image"][got[0]["mask"] > 0]
+    src = {im.tobytes() for im in images}
+    assert all(im.tobytes() in src for im in out_imgs)
+
+
+def test_train_batches_process_sharding_partitions_data(data_dir):
+    """SURVEY.md §3.5: two processes see disjoint record subsets, local
+    batch = global/P, and together they cover the whole split."""
+    cfg = DataConfig(batch_size=8, shuffle_buffer=64)
+
+    def first_epoch_pixels(p_idx):
+        seen = set()
+        it = pipeline.train_batches(
+            data_dir, "train", cfg, SIZE, seed=0,
+            process_index=p_idx, process_count=2,
+        )
+        # N=20 records, local batch 4 -> one epoch is 2-3 local batches;
+        # read enough to cycle and collect unique images.
+        for _ in range(6):
+            b = next(it)
+            assert b["image"].shape == (4, SIZE, SIZE, 3)
+            for im in b["image"]:
+                seen.add(im.tobytes())
+        return seen
+
+    s0, s1 = first_epoch_pixels(0), first_epoch_pixels(1)
+    assert s0 and s1
+    assert not (s0 & s1), "processes must read disjoint records"
+    assert len(s0 | s1) == N, "union must cover the whole split"
+
+
+def test_train_batches_process_sharding_rejects_indivisible(data_dir):
+    with pytest.raises(ValueError, match="not divisible"):
+        next(pipeline.train_batches(
+            data_dir, "train", DataConfig(batch_size=9), SIZE,
+            process_index=0, process_count=2,
+        ))
+
+
+def test_eval_batches_process_sharding_blocks_reassemble(data_dir):
+    """Per-process eval blocks concatenate back to the single-process
+    batch (process-major layout), while grade/mask stay global."""
+    full = list(pipeline.eval_batches(data_dir, "test", 8, SIZE))
+    p0 = list(pipeline.eval_batches(
+        data_dir, "test", 8, SIZE, process_index=0, process_count=2))
+    p1 = list(pipeline.eval_batches(
+        data_dir, "test", 8, SIZE, process_index=1, process_count=2))
+    assert len(full) == len(p0) == len(p1)
+    for f, a, b in zip(full, p0, p1):
+        assert a["image"].shape == (4, SIZE, SIZE, 3)
+        np.testing.assert_array_equal(
+            np.concatenate([a["image"], b["image"]]), f["image"]
+        )
+        np.testing.assert_array_equal(a["grade"], f["grade"])
+        np.testing.assert_array_equal(a["mask"], f["mask"])
+
+
+def test_train_batches_record_striding_branch_partitions_data(data_dir):
+    """More processes than shard files (SHARDS=3 < P=5) takes the
+    record-striding branch: the file shuffle must be process-invariant so
+    the position strides partition ONE stream. The partition is exact
+    PER EPOCH (across epochs a record migrates between strides as the
+    file order reshuffles — harmless for training); with N=20, P=5 and
+    local batch 4, one batch is exactly one epoch's share per process."""
+    cfg = DataConfig(batch_size=20, shuffle_buffer=64)
+    seen = []
+    for p in range(5):
+        it = pipeline.train_batches(
+            data_dir, "train", cfg, SIZE, seed=0,
+            process_index=p, process_count=5,
+        )
+        b = next(it)
+        assert b["image"].shape == (4, SIZE, SIZE, 3)
+        seen.append({im.tobytes() for im in b["image"]})
+    union = set().union(*seen)
+    assert len(union) == N, "epoch-1 strides must jointly cover the split"
+    for i in range(5):
+        for j in range(i + 1, 5):
+            assert not (seen[i] & seen[j]), f"processes {i},{j} overlap"

@@ -109,6 +109,29 @@ class TestDPEquivalence:
         tree_allclose(new1.params, one.params, rtol=2e-5, atol=1e-6)
         tree_allclose(new1.batch_stats, one.batch_stats, rtol=2e-5, atol=1e-6)
 
+    def test_jit_mesh_equals_single_device_with_augmentation(self):
+        """VERDICT r1 #10: the production path runs augment=True, so the
+        DP pin must hold there too. On the jit path the augmentation key
+        depends only on (base_key, state.step) — identical whether the
+        batch lives on 1 device or 8 — so equivalence holds by
+        construction; this pins it through the compiler. (The pmap form
+        intentionally diverges: it folds lax.axis_index into the key so
+        replicas draw different augmentations — see make_pmap_train_step.)"""
+        cfg = small_cfg(augment=True)
+        batch = make_batch(cfg)
+        key = jax.random.key(42)
+        new1, m1 = self._single_device_step(cfg, batch, key)
+
+        mesh = mesh_lib.make_mesh()
+        model = models.build(cfg.model)
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+        step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+        new8, m8 = step(state, mesh_lib.shard_batch(batch, mesh), key)
+
+        np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=1e-5)
+        tree_allclose(new1.params, new8.params, rtol=2e-5, atol=1e-6)
+        tree_allclose(new1.batch_stats, new8.batch_stats, rtol=2e-5, atol=1e-6)
+
     def test_without_cross_replica_bn_stats_differ(self):
         """Negative control: axis_name=None under pmap gives per-shard BN
         moments that do NOT match global-batch moments — proving the psum

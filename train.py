@@ -54,6 +54,14 @@ def main(argv):
         if _FAKE_DEVICES.value:
             jax.config.update("jax_num_cpu_devices", _FAKE_DEVICES.value)
 
+    # Multi-host bring-up BEFORE anything touches a jax backend (no-op
+    # unless a coordinator is configured in the environment; SURVEY.md
+    # §3.5). After this, jax.devices() spans every host and the input
+    # pipeline shards files by jax.process_index().
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed()
+
     from jama16_retina_tpu import configs, trainer
     from jama16_retina_tpu.data import tfrecord
 
