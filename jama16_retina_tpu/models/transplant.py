@@ -1,0 +1,175 @@
+"""Weight transplant: tf.keras InceptionV3 -> the Flax tree (SURVEY.md §4.2).
+
+Operationalizes "weight-matched Flax Inception-v3" (BASELINE.json:5)
+against the locally available twin of the reference's TF-Slim builder,
+``tf.keras.applications.InceptionV3``. Both builders create the same 94
+conv+BN pairs in the same program order; keras encodes that creation
+order in its layer-name suffixes (``conv2d_17`` / its paired
+``batch_normalization_17``), while this package encodes it in slim-style
+scope names (``Mixed_6b/Branch_1_Conv2d_0b_1x7``). ``FLAX_CONV_ORDER``
+below is the explicit bridge: the flax module paths listed in keras
+creation order. Every transplanted kernel is shape-checked, so an
+ordering mistake fails loudly rather than producing silently-wrong
+weights.
+
+Layout facts this relies on (asserted where cheap):
+  * keras conv kernels are HWIO — identical to flax; no transpose.
+  * both builders use bias-free convs and scale-free BatchNorm
+    (beta/moving_mean/moving_variance only), eps 1e-3.
+  * the classifier head is a Dense on the 2048-d pooled features
+    (keras ``predictions`` -> flax ``Logits``).
+  * keras InceptionV3 has no auxiliary head; the flax aux head (a slim
+    feature) is untouched by the transplant.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+# Flax module paths of the 94 ConvBN cells, in the order the keras/slim
+# builders create them: stem, then each mixed block branch-by-branch in
+# source order (branch outputs are concatenated in this same order).
+_STEM = [
+    ("Conv2d_1a_3x3",), ("Conv2d_2a_3x3",), ("Conv2d_2b_3x3",),
+    ("Conv2d_3b_1x1",), ("Conv2d_4a_3x3",),
+]
+_BLOCK_A = [  # Mixed_5b/5c/5d
+    "Branch_0_Conv2d_0a_1x1",
+    "Branch_1_Conv2d_0a_1x1", "Branch_1_Conv2d_0b_5x5",
+    "Branch_2_Conv2d_0a_1x1", "Branch_2_Conv2d_0b_3x3", "Branch_2_Conv2d_0c_3x3",
+    "Branch_3_Conv2d_0b_1x1",
+]
+_BLOCK_B = [  # Mixed_6a
+    "Branch_0_Conv2d_1a_3x3",
+    "Branch_1_Conv2d_0a_1x1", "Branch_1_Conv2d_0b_3x3", "Branch_1_Conv2d_1a_3x3",
+]
+_BLOCK_C = [  # Mixed_6b..6e
+    "Branch_0_Conv2d_0a_1x1",
+    "Branch_1_Conv2d_0a_1x1", "Branch_1_Conv2d_0b_1x7", "Branch_1_Conv2d_0c_7x1",
+    "Branch_2_Conv2d_0a_1x1", "Branch_2_Conv2d_0b_7x1", "Branch_2_Conv2d_0c_1x7",
+    "Branch_2_Conv2d_0d_7x1", "Branch_2_Conv2d_0e_1x7",
+    "Branch_3_Conv2d_0b_1x1",
+]
+_BLOCK_D = [  # Mixed_7a
+    "Branch_0_Conv2d_0a_1x1", "Branch_0_Conv2d_1a_3x3",
+    "Branch_1_Conv2d_0a_1x1", "Branch_1_Conv2d_0b_1x7", "Branch_1_Conv2d_0c_7x1",
+    "Branch_1_Conv2d_1a_3x3",
+]
+_BLOCK_E = [  # Mixed_7b/7c
+    "Branch_0_Conv2d_0a_1x1",
+    "Branch_1_Conv2d_0a_1x1", "Branch_1_Conv2d_0b_1x3", "Branch_1_Conv2d_0c_3x1",
+    "Branch_2_Conv2d_0a_1x1", "Branch_2_Conv2d_0b_3x3",
+    "Branch_2_Conv2d_0c_1x3", "Branch_2_Conv2d_0d_3x1",
+    "Branch_3_Conv2d_0b_1x1",
+]
+
+FLAX_CONV_ORDER: list[tuple[str, ...]] = (
+    _STEM
+    + [("Mixed_5b", n) for n in _BLOCK_A]
+    + [("Mixed_5c", n) for n in _BLOCK_A]
+    + [("Mixed_5d", n) for n in _BLOCK_A]
+    + [("Mixed_6a", n) for n in _BLOCK_B]
+    + [("Mixed_6b", n) for n in _BLOCK_C]
+    + [("Mixed_6c", n) for n in _BLOCK_C]
+    + [("Mixed_6d", n) for n in _BLOCK_C]
+    + [("Mixed_6e", n) for n in _BLOCK_C]
+    + [("Mixed_7a", n) for n in _BLOCK_D]
+    + [("Mixed_7b", n) for n in _BLOCK_E]
+    + [("Mixed_7c", n) for n in _BLOCK_E]
+)
+
+
+def _creation_index(name: str, prefix: str) -> int | None:
+    """'conv2d' -> 0, 'conv2d_17' -> 17; None for unrelated layers."""
+    m = re.fullmatch(rf"{prefix}(?:_(\d+))?", name)
+    if not m:
+        return None
+    return int(m.group(1) or 0)
+
+
+def keras_conv_bn_pairs(keras_model) -> list[tuple[Any, Any]]:
+    """The 94 (Conv2D, BatchNormalization) pairs in CREATION order.
+
+    ``model.layers`` is topological order, but each ``conv2d_N`` was
+    created together with ``batch_normalization_N`` (keras
+    ``conv2d_bn``), so the name index is the reliable pairing/order key.
+    """
+    import tensorflow as tf
+
+    convs: dict[int, Any] = {}
+    bns: dict[int, Any] = {}
+    for layer in keras_model.layers:
+        if isinstance(layer, tf.keras.layers.Conv2D):
+            idx = _creation_index(layer.name, "conv2d")
+            if idx is not None:
+                convs[idx] = layer
+        elif isinstance(layer, tf.keras.layers.BatchNormalization):
+            idx = _creation_index(layer.name, "batch_normalization")
+            if idx is not None:
+                bns[idx] = layer
+    if sorted(convs) != sorted(bns) or sorted(convs) != list(range(len(convs))):
+        raise ValueError(
+            "unexpected keras layer naming: conv indices "
+            f"{sorted(convs)[:5]}.. vs bn indices {sorted(bns)[:5]}.. — "
+            "was the model built inside a non-fresh name scope?"
+        )
+    return [(convs[i], bns[i]) for i in range(len(convs))]
+
+
+def _set_in(tree: dict, path: tuple[str, ...], leaf: str, value, expect_shape):
+    node = tree
+    for p in path:
+        node = node[p]
+    old = node[leaf]
+    if tuple(np.shape(old)) != tuple(expect_shape):
+        raise ValueError(
+            f"shape mismatch at {'/'.join(path)}/{leaf}: flax "
+            f"{tuple(np.shape(old))} vs keras {tuple(expect_shape)}"
+        )
+    node[leaf] = np.asarray(value, dtype=np.asarray(old).dtype)
+
+
+def transplant_from_keras(
+    keras_model, params, batch_stats
+) -> tuple[Any, Any]:
+    """Return (params, batch_stats) with the keras weights copied in.
+
+    Covers the full backbone (94 ConvBN cells) and the classifier Dense
+    when the class counts match; leaves the flax aux head (absent from
+    keras) untouched. Raises on any shape mismatch.
+    """
+    import jax
+
+    params = jax.tree.map(np.asarray, jax.device_get(params))
+    batch_stats = jax.tree.map(np.asarray, jax.device_get(batch_stats))
+
+    pairs = keras_conv_bn_pairs(keras_model)
+    if len(pairs) != len(FLAX_CONV_ORDER):
+        raise ValueError(
+            f"expected {len(FLAX_CONV_ORDER)} conv/bn pairs, keras model "
+            f"has {len(pairs)}"
+        )
+    for (conv, bn), path in zip(pairs, FLAX_CONV_ORDER):
+        kernel = conv.kernel.numpy()  # HWIO in both frameworks
+        _set_in(params, (*path, "conv"), "kernel", kernel, kernel.shape)
+        beta = bn.beta.numpy()
+        _set_in(params, (*path, "bn"), "bias", beta, beta.shape)
+        _set_in(batch_stats, (*path, "bn"), "mean",
+                bn.moving_mean.numpy(), beta.shape)
+        _set_in(batch_stats, (*path, "bn"), "var",
+                bn.moving_variance.numpy(), beta.shape)
+
+    # Classifier head ('predictions' -> 'Logits') when the widths agree.
+    dense = next(
+        (l for l in keras_model.layers if l.name == "predictions"), None
+    )
+    if dense is not None and "Logits" in params:
+        k = dense.kernel.numpy()
+        if tuple(np.shape(params["Logits"]["kernel"])) == tuple(k.shape):
+            _set_in(params, ("Logits",), "kernel", k, k.shape)
+            _set_in(params, ("Logits",), "bias",
+                    dense.bias.numpy(), dense.bias.shape)
+    return params, batch_stats
