@@ -32,7 +32,13 @@ _ENSEMBLE = flags.DEFINE_multi_string(
     "reference's -e flag)"
 )
 _SPLIT = flags.DEFINE_string("split", "test", "which split to evaluate")
-_DEVICE = flags.DEFINE_enum("device", "tpu", ["tpu", "cpu"], "backend gate")
+_DEVICE = flags.DEFINE_enum(
+    "device", "tpu", ["tpu", "cpu", "tf"],
+    "backend gate (BASELINE.json:5): tpu/cpu run the Flax model under jit "
+    "on that platform; tf runs the legacy-graph stand-in (keras "
+    "InceptionV3 on host CPU, weights from the same orbax checkpoints) "
+    "through the same untouched metrics layer",
+)
 _FAKE_DEVICES = flags.DEFINE_integer("fake_devices", 0, "cpu fake devices")
 
 
@@ -43,7 +49,9 @@ def _discover_dirs(root: str) -> list[str]:
 
 def main(argv):
     del argv
-    if _DEVICE.value == "cpu":
+    if _DEVICE.value in ("cpu", "tf"):
+        # tf mode still restores orbax checkpoints through jax — pin jax
+        # to CPU so no TPU is required for the legacy-backend path.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -71,7 +79,8 @@ def main(argv):
         dirs = _discover_dirs(_CKPT.value)
 
     report = trainer.evaluate_checkpoints(
-        cfg, data_dir, dirs, split=_SPLIT.value
+        cfg, data_dir, dirs, split=_SPLIT.value,
+        backend="tf" if _DEVICE.value == "tf" else "flax",
     )
     print(json.dumps(report, indent=2))
 

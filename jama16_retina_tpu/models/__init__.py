@@ -32,8 +32,23 @@ _DTYPES = {
 }
 
 
-def build(cfg: ModelConfig, axis_name: str | None = None):
-    """Construct the Flax module named by ``cfg.arch`` (reference R7)."""
+def build(cfg: ModelConfig, axis_name: str | None = None,
+          backend: str = "flax"):
+    """Construct the model named by ``cfg.arch`` (reference R7).
+
+    ``backend`` is the plugin boundary from the north star
+    (BASELINE.json:5 ``model.build(backend=...)``): ``"flax"`` (default)
+    returns the TPU-native Flax module; ``"tf"`` returns the legacy-graph
+    stand-in — a tf.keras InceptionV3 whose weights are loaded from the
+    same orbax checkpoints (models/tf_backend.py) so the evaluation code
+    downstream is untouched.
+    """
+    if backend == "tf":
+        from jama16_retina_tpu.models import tf_backend
+
+        return tf_backend.build_tf(cfg)
+    if backend != "flax":
+        raise ValueError(f"unknown backend {backend!r} (want 'flax' or 'tf')")
     dtype = _DTYPES[cfg.compute_dtype]
     common = dict(
         num_classes=cfg.num_classes,

@@ -28,7 +28,13 @@ from jama16_retina_tpu.models.common import ConvBN
 
 
 def _avg_pool_same(x):
-    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+    # count_include_pad=False: TF/slim AvgPool averages over valid (non-
+    # padded) cells only; flax's include-pad default drifts every branch_pool
+    # output at the spatial boundary (caught by the keras transplant parity
+    # test — logit corr 0.9987 instead of exact).
+    return nn.avg_pool(
+        x, (3, 3), strides=(1, 1), padding="SAME", count_include_pad=False
+    )
 
 
 class InceptionA(nn.Module):

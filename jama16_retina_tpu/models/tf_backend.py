@@ -1,0 +1,102 @@
+"""The ``backend="tf"`` side of the ``build()`` plugin boundary.
+
+The north star (BASELINE.json:5) makes the model builder the plugin
+boundary: ``model.build(backend=...)`` returns "either the legacy TF
+graph or a weight-matched Flax Inception-v3 — so the AUC and
+sensitivity-at-fixed-specificity evaluation code is untouched". The
+legacy TF-Slim graph itself cannot be ported (the reference tree is
+empty, SURVEY.md §0), so the TF side is the locally available twin:
+``tf.keras.applications.InceptionV3``, loaded with weights restored from
+a *Flax* orbax checkpoint via the inverse of
+:mod:`jama16_retina_tpu.models.transplant`'s keras→flax name map.
+
+That makes ``evaluate.py --device=tf`` a genuine second backend: the
+same TFRecords, the same orbax checkpoints, the same
+``eval/metrics.py`` — only the forward pass runs in TF on host CPU.
+Byte-compatible report schema across backends is pinned by
+tests/test_tf_backend.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from jama16_retina_tpu.configs import ModelConfig
+from jama16_retina_tpu.models import transplant
+
+
+def build_tf(cfg: ModelConfig):
+    """Keras InceptionV3 with the config's head — the "legacy TF graph"
+    half of the plugin boundary. Raw logits (no classifier activation);
+    the head nonlinearity lives in :func:`predict_probs`, mirroring
+    train_lib._probs."""
+    import tensorflow as tf
+
+    if cfg.arch != "inception_v3":
+        raise ValueError(
+            "the TF backend covers the reference's model, Inception-v3 "
+            f"(BASELINE.json:5); got arch={cfg.arch!r}"
+        )
+    size = cfg.image_size
+    return tf.keras.applications.InceptionV3(
+        weights=None,
+        include_top=True,
+        classes=cfg.num_classes,
+        classifier_activation=None,
+        input_shape=(size, size, 3),
+    )
+
+
+def load_flax_state(keras_model, params, batch_stats) -> None:
+    """Inverse transplant: copy a Flax checkpoint into the keras graph.
+
+    Uses the same creation-order pairing as transplant.py (94 ConvBN
+    cells + the Logits/predictions Dense); the flax aux head has no keras
+    counterpart and is skipped — eval never runs the aux head. Every copy
+    is shape-checked by keras' ``assign``.
+    """
+    import jax
+
+    params = jax.tree.map(np.asarray, jax.device_get(params))
+    batch_stats = jax.tree.map(np.asarray, jax.device_get(batch_stats))
+
+    pairs = transplant.keras_conv_bn_pairs(keras_model)
+    if len(pairs) != len(transplant.FLAX_CONV_ORDER):
+        raise ValueError(
+            f"expected {len(transplant.FLAX_CONV_ORDER)} conv/bn pairs, "
+            f"keras model has {len(pairs)}"
+        )
+
+    def _get(tree, path):
+        node = tree
+        for p in path:
+            node = node[p]
+        return node
+
+    for (conv, bn), path in zip(pairs, transplant.FLAX_CONV_ORDER):
+        conv.kernel.assign(_get(params, (*path, "conv"))["kernel"])
+        bn.beta.assign(_get(params, (*path, "bn"))["bias"])
+        bn.moving_mean.assign(_get(batch_stats, (*path, "bn"))["mean"])
+        bn.moving_variance.assign(_get(batch_stats, (*path, "bn"))["var"])
+
+    dense = next(
+        (l for l in keras_model.layers if l.name == "predictions"), None
+    )
+    if dense is None:
+        raise ValueError("keras model has no 'predictions' head layer")
+    dense.kernel.assign(params["Logits"]["kernel"])
+    dense.bias.assign(params["Logits"]["bias"])
+
+
+def predict_probs(keras_model, images_u8: np.ndarray, head: str) -> np.ndarray:
+    """uint8 batch -> probabilities, numerically parallel to the jit
+    eval step: the same /127.5-1 normalization (augment.normalize) and
+    the same head nonlinearity (train_lib._probs)."""
+    import tensorflow as tf
+
+    x = images_u8.astype(np.float32) / 127.5 - 1.0
+    logits = keras_model(tf.convert_to_tensor(x), training=False).numpy()
+    if head == "binary":
+        return 1.0 / (1.0 + np.exp(-logits[:, 0]))
+    e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)

@@ -110,13 +110,21 @@ def keras_conv_bn_pairs(keras_model) -> list[tuple[Any, Any]]:
             idx = _creation_index(layer.name, "batch_normalization")
             if idx is not None:
                 bns[idx] = layer
-    if sorted(convs) != sorted(bns) or sorted(convs) != list(range(len(convs))):
+    # keras name counters are process-global, so the first index is an
+    # arbitrary offset (94 if another InceptionV3 was built earlier in the
+    # process) — and conv2d/batch_normalization counters advance
+    # independently. Creation order is the rank within each contiguous
+    # index range, so pair by rank, not by absolute index.
+    conv_idx, bn_idx = sorted(convs), sorted(bns)
+    contiguous = lambda xs: xs == list(range(xs[0], xs[0] + len(xs)))
+    if (len(convs) != len(bns) or not convs
+            or not contiguous(conv_idx) or not contiguous(bn_idx)):
         raise ValueError(
             "unexpected keras layer naming: conv indices "
-            f"{sorted(convs)[:5]}.. vs bn indices {sorted(bns)[:5]}.. — "
-            "was the model built inside a non-fresh name scope?"
+            f"{conv_idx[:5]}.. vs bn indices {bn_idx[:5]}.. — "
+            "non-contiguous creation indices break order-based pairing"
         )
-    return [(convs[i], bns[i]) for i in range(len(convs))]
+    return [(convs[i], bns[j]) for i, j in zip(conv_idx, bn_idx)]
 
 
 def _set_in(tree: dict, path: tuple[str, ...], leaf: str, value, expect_shape):

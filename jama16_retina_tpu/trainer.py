@@ -33,6 +33,23 @@ def _binary_eval_labels(grades: np.ndarray, head: str) -> np.ndarray:
     return (grades >= 2).astype(np.float64) if head == "binary" else grades
 
 
+def _predict_over_split(
+    cfg: ExperimentConfig, data_dir: str, split: str, batch_probs_fn
+) -> tuple[np.ndarray, np.ndarray]:
+    """Shared eval loop for every backend: iterate eval_batches, compute
+    per-batch probs via ``batch_probs_fn(batch) -> [B]-or-[B,C] array``,
+    trim padding rows (the mask contract of make_eval_step), concatenate."""
+    grades_all, probs_all = [], []
+    for batch in pipeline.eval_batches(
+        data_dir, split, cfg.eval.batch_size, cfg.model.image_size
+    ):
+        probs = batch_probs_fn(batch)
+        keep = batch["mask"] > 0
+        grades_all.append(batch["grade"][keep])
+        probs_all.append(probs[keep])
+    return np.concatenate(grades_all), np.concatenate(probs_all)
+
+
 def predict_split(
     cfg: ExperimentConfig,
     model,
@@ -50,10 +67,8 @@ def predict_split(
     """
     if eval_step is None:
         eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
-    grades_all, probs_all = [], []
-    for batch in pipeline.eval_batches(
-        data_dir, split, cfg.eval.batch_size, cfg.model.image_size
-    ):
+
+    def batch_probs(batch):
         # Only the image rows go to device — 'grade'/'mask' are global
         # host metadata (multi-host: 'image' is the per-process block,
         # see pipeline.eval_batches), and eval_step reads only 'image'.
@@ -61,11 +76,56 @@ def predict_split(
             dev_batch = mesh_lib.shard_batch({"image": batch["image"]}, mesh)
         else:
             dev_batch = jax.device_put({"image": batch["image"]})
-        probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
-        keep = batch["mask"] > 0
-        grades_all.append(batch["grade"][keep])
-        probs_all.append(probs[keep])
-    return np.concatenate(grades_all), np.concatenate(probs_all)
+        return np.asarray(jax.device_get(eval_step(state, dev_batch)))
+
+    return _predict_over_split(cfg, data_dir, split, batch_probs)
+
+
+def predict_split_tf(
+    cfg: ExperimentConfig, keras_model, data_dir: str, split: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """predict_split's TF-backend twin: same pipeline.eval_batches
+    stream, forward pass on host TF instead of the jit eval step. The
+    (grades, probs) contract is identical, so everything downstream —
+    ensemble averaging, evaluation_report — is untouched (BASELINE.json:5).
+    """
+    from jama16_retina_tpu.models import tf_backend
+
+    return _predict_over_split(
+        cfg, data_dir, split,
+        lambda batch: tf_backend.predict_probs(
+            keras_model, batch["image"], cfg.model.head
+        ),
+    )
+
+
+def _eval_and_track(
+    cfg: ExperimentConfig, log: RunLog, ckpt, step: int,
+    predict_fn, state_for_save,
+    best_auc: float, best_step: int, since_best: int,
+) -> tuple[float, int, int, bool]:
+    """The per-eval-interval block shared by every backend's train loop:
+    val predict -> referable-DR AUC (the 5-class head collapses to
+    P(grade>=2); SURVEY.md N11) -> checkpoint -> best/min_delta tracking
+    -> early-stop decision. One copy so the backends cannot
+    desynchronize on the early-stopping rule or the eval JSONL shape."""
+    grades, probs = predict_fn()
+    bin_probs = (
+        probs if cfg.model.head == "binary"
+        else metrics.referable_probs_from_multiclass(probs)
+    )
+    auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
+    ckpt.save(step, state_for_save, {"val_auc": auc})
+    if auc > best_auc + cfg.train.min_delta:
+        best_auc, best_step, since_best = auc, step, 0
+    else:
+        since_best += 1
+    log.write("eval", step=step, val_auc=round(auc, 5),
+              best_auc=round(best_auc, 5), since_best=since_best)
+    stop = since_best >= cfg.train.early_stop_patience
+    if stop:
+        log.write("early_stop", step=step, best_step=best_step)
+    return best_auc, best_step, since_best, stop
 
 
 def _run_meta_path(workdir: str) -> str:
@@ -198,26 +258,17 @@ def fit(
                 t_log, imgs_since = time.time(), 0
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
-                grades, probs = predict_split(
-                    cfg, model, state, data_dir, "val", mesh, eval_step=eval_step
+                best_auc, best_step, since_best, stop = _eval_and_track(
+                    cfg, log, ckpt, step_i + 1,
+                    lambda: predict_split(
+                        cfg, model, state, data_dir, "val", mesh,
+                        eval_step=eval_step,
+                    ),
+                    jax.device_get(state),
+                    best_auc, best_step, since_best,
                 )
-                # Early stopping always tracks *referable-DR* AUC; the
-                # 5-class head collapses to P(grade>=2) here (SURVEY.md N11).
-                bin_probs = (
-                    probs if cfg.model.head == "binary"
-                    else metrics.referable_probs_from_multiclass(probs)
-                )
-                auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
-                ckpt.save(step_i + 1, jax.device_get(state), {"val_auc": auc})
-                if auc > best_auc + cfg.train.min_delta:
-                    best_auc, best_step, since_best = auc, step_i + 1, 0
-                else:
-                    since_best += 1
-                log.write("eval", step=step_i + 1, val_auc=round(auc, 5),
-                          best_auc=round(best_auc, 5), since_best=since_best)
-                if since_best >= cfg.train.early_stop_patience:
+                if stop:
                     stopped_early = True
-                    log.write("early_stop", step=step_i + 1, best_step=best_step)
                     break
     finally:
         # Early stop / short runs / exceptions must not leak an open trace
@@ -244,16 +295,175 @@ def fit(
 
 
 def fit_ensemble(
-    cfg: ExperimentConfig, data_dir: str, workdir: str
+    cfg: ExperimentConfig, data_dir: str, workdir: str,
+    backend: str = "flax",
 ) -> list[dict]:
     """Train k independently-seeded members (reference R11, BASELINE.json:10),
     each in its own member_NN checkpoint dir."""
+    fit_fn = fit_tf if backend == "tf" else fit
     results = []
     for member in range(cfg.train.ensemble_size):
         mdir = ckpt_lib.member_dir(workdir, member)
-        res = fit(cfg, data_dir, mdir, seed=cfg.train.seed + member)
+        res = fit_fn(cfg, data_dir, mdir, seed=cfg.train.seed + member)
         results.append({"member": member, "workdir": mdir, **res})
     return results
+
+
+def fit_tf(
+    cfg: ExperimentConfig, data_dir: str, workdir: str, seed: int | None = None
+) -> dict:
+    """The legacy-backend training loop: ``train.py --device=tf``.
+
+    Completes the ``--device={tf,tpu}`` gate (SURVEY.md §5.6) on the
+    train side: a keras InceptionV3 trained on host TF, fed by the SAME
+    pipeline.train_batches stream, logged in the SAME JSONL shape, early-
+    stopped on the SAME val-AUC rule — and its best checkpoints written
+    through the keras->flax transplant into the SAME orbax format, so a
+    TF-trained model is evaluable by either backend.
+
+    Honest deltas from the TPU path, by design of a legacy path:
+      * augmentation is flips-only (the TPU path's fused color jitter is
+        a TPU feature; the reference era's tf.image jitter is not worth
+        re-creating for an eval/compat backend);
+      * keras InceptionV3 has no auxiliary head, so the flax objective's
+        ``aux_weight`` loss term is absent here;
+      * optax state is not representable in keras — a --resume of a
+        tf-trained checkpoint restarts optimizer moments;
+      * LR schedules collapse to the constant peak rate;
+      * weight decay is masked by variable NAME (beta/bias excluded)
+        rather than by rank — equivalent for these architectures.
+    """
+    import tensorflow as tf
+
+    from jama16_retina_tpu.models import tf_backend, transplant
+
+    seed = cfg.train.seed if seed is None else seed
+    seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
+    tf.keras.utils.set_random_seed(seed)
+    log = RunLog(workdir)
+    log.write("config", name=cfg.name, seed=seed, backend="tf")
+
+    keras_model = models.build(cfg.model, backend="tf")
+    tc = cfg.train
+    # Mirror train_lib.make_optimizer: decoupled weight decay, global-norm
+    # clipping, and the slim-era RMSprop eps=1.0.
+    clip = tc.gradient_clip_norm if tc.gradient_clip_norm > 0 else None
+    # keras AdamW requires a float weight_decay (None crashes); the base-
+    # optimizer kwarg on SGD/RMSprop wants None to mean "disabled".
+    wd_or_none = tc.weight_decay if tc.weight_decay else None
+    if tc.optimizer == "adamw":
+        opt = tf.keras.optimizers.AdamW(
+            tc.learning_rate, weight_decay=float(tc.weight_decay),
+            global_clipnorm=clip,
+        )
+    elif tc.optimizer == "sgdm":
+        opt = tf.keras.optimizers.SGD(
+            tc.learning_rate, momentum=tc.momentum, nesterov=True,
+            weight_decay=wd_or_none, global_clipnorm=clip,
+        )
+    elif tc.optimizer == "rmsprop":
+        opt = tf.keras.optimizers.RMSprop(
+            tc.learning_rate, rho=0.9, momentum=tc.momentum, epsilon=1.0,
+            weight_decay=wd_or_none, global_clipnorm=clip,
+        )
+    else:
+        raise ValueError(f"unknown optimizer {tc.optimizer!r}")
+    if tc.weight_decay:
+        # train_lib._decay_mask decays rank>=2 kernels only; the keras
+        # analogue is excluding BN betas and dense biases by name.
+        opt.exclude_from_weight_decay(var_names=["beta", "bias"])
+    if cfg.model.head == "binary":
+        loss = tf.keras.losses.BinaryCrossentropy(
+            from_logits=True, label_smoothing=tc.label_smoothing
+        )
+    else:
+        # Sparse CE has no label_smoothing in keras; one-hot targets keep
+        # the objective aligned with train_lib._head_loss.
+        loss = tf.keras.losses.CategoricalCrossentropy(
+            from_logits=True, label_smoothing=tc.label_smoothing
+        )
+    keras_model.compile(optimizer=opt, loss=loss)
+
+    # Flax twin: the orbax tree the transplant fills per save. Built on
+    # whatever jax platform is active (train.py pins CPU under --device=tf).
+    model = models.build(cfg.model)
+    state0, _ = train_lib.create_state(cfg, model, jax.random.key(seed))
+    state0 = jax.device_get(state0)
+    ckpt = ckpt_lib.Checkpointer(
+        os.path.abspath(workdir), max_to_keep=cfg.train.max_to_keep
+    )
+
+    start_step = 0
+    if cfg.train.resume and ckpt.latest_step is not None:
+        restored = ckpt.restore(
+            ckpt_lib.abstract_like(state0), ckpt.latest_step
+        )
+        tf_backend.load_flax_state(
+            keras_model, restored.params, restored.batch_stats
+        )
+        start_step = int(np.asarray(restored.step))
+        log.write("resume", step=start_step)
+
+    batches = pipeline.train_batches(
+        data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+        skip_batches=start_step,
+    )
+    best_auc, best_step, since_best = -np.inf, start_step, 0
+    stopped_early = False
+    t_log, imgs_since = time.time(), 0
+    for step_i in range(start_step, tc.steps):
+        batch = next(batches)
+        images = batch["image"]
+        if cfg.data.augment:
+            # Per-step generator keyed on (seed, step): a resumed run
+            # draws the same flips an uninterrupted one would (the numpy
+            # analogue of fit's fold_in(base_key, step); SURVEY.md §5.4).
+            rng = np.random.default_rng((seed, step_i))
+            flip_h = rng.random(images.shape[0]) < 0.5
+            flip_v = rng.random(images.shape[0]) < 0.5
+            images = np.where(flip_h[:, None, None, None], images[:, :, ::-1], images)
+            images = np.where(flip_v[:, None, None, None], images[:, ::-1], images)
+        x = images.astype(np.float32) / 127.5 - 1.0
+        if cfg.model.head == "binary":
+            y = (batch["grade"] >= 2).astype(np.float32)[:, None]
+        else:
+            y = np.eye(cfg.model.num_classes, dtype=np.float32)[
+                batch["grade"].astype(np.int64)
+            ]
+        step_loss = float(keras_model.train_on_batch(x, y))
+        imgs_since += images.shape[0]
+
+        if (step_i + 1) % tc.log_every == 0:
+            dt = time.time() - t_log
+            log.write("train", step=step_i + 1, loss=step_loss,
+                      images_per_sec=round(imgs_since / max(dt, 1e-9), 2))
+            t_log, imgs_since = time.time(), 0
+
+        if (step_i + 1) % tc.eval_every == 0 or step_i + 1 == tc.steps:
+            params, batch_stats = transplant.transplant_from_keras(
+                keras_model, state0.params, state0.batch_stats
+            )
+            best_auc, best_step, since_best, stop = _eval_and_track(
+                cfg, log, ckpt, step_i + 1,
+                lambda: predict_split_tf(cfg, keras_model, data_dir, "val"),
+                state0.replace(
+                    step=np.asarray(step_i + 1, np.int32),
+                    params=params, batch_stats=batch_stats,
+                ),
+                best_auc, best_step, since_best,
+            )
+            if stop:
+                stopped_early = True
+                break
+
+    ckpt.wait()
+    ckpt.close()
+    log.close()
+    return {
+        "best_auc": float(best_auc) if np.isfinite(best_auc) else None,
+        "best_step": int(best_step),
+        "stopped_early": stopped_early,
+    }
 
 
 def restore_for_eval(
@@ -275,20 +485,38 @@ def evaluate_checkpoints(
     ckpt_dirs: list[str],
     split: str = "test",
     mesh=None,
+    backend: str = "flax",
 ) -> dict:
     """Single- or multi-checkpoint (ensemble-averaged) evaluation
-    (SURVEY.md §3.2; BASELINE.json:10 'averaged logits')."""
+    (SURVEY.md §3.2; BASELINE.json:10 'averaged logits').
+
+    ``backend="tf"`` routes the forward pass through the keras legacy-
+    graph stand-in (models/tf_backend.py) — same checkpoints, same
+    pipeline, same metrics layer, per the north-star plugin boundary.
+    """
     if not ckpt_dirs:
         raise ValueError("need at least one checkpoint dir")
     mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
-    model = models.build(cfg.model)
-    eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+    model = models.build(cfg.model)  # flax: checkpoint tree structure
+    if backend == "tf":
+        from jama16_retina_tpu.models import tf_backend
+
+        keras_model = models.build(cfg.model, backend="tf")
+        eval_step = None
+    else:
+        eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
     prob_list, grades = [], None
     for d in ckpt_dirs:
         state = restore_for_eval(cfg, model, d, mesh)
-        g, p = predict_split(
-            cfg, model, state, data_dir, split, mesh, eval_step=eval_step
-        )
+        if backend == "tf":
+            tf_backend.load_flax_state(
+                keras_model, state.params, state.batch_stats
+            )
+            g, p = predict_split_tf(cfg, keras_model, data_dir, split)
+        else:
+            g, p = predict_split(
+                cfg, model, state, data_dir, split, mesh, eval_step=eval_step
+            )
         if grades is not None and not np.array_equal(g, grades):
             raise RuntimeError("checkpoints saw different eval sets")
         grades = g

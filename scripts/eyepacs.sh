@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Kaggle EyePACS acquisition (reference R10: eyepacs.sh, SURVEY.md §1
+# "Data acquisition"). The reference shipped download scripts; this
+# environment has no network, so this script DOCUMENTS and VERIFIES the
+# expected raw layout, and performs the download when the kaggle CLI and
+# credentials are available.
+#
+# Expected layout after this script succeeds:
+#   $DATA_DIR/
+#     trainLabels.csv          # columns: image,level   (ICDR grade 0-4)
+#     train/                   # {image}.jpeg originals, e.g. 10_left.jpeg
+#
+# Next step:
+#   python preprocess_eyepacs.py --data_dir=$DATA_DIR/train \
+#       --labels_csv=$DATA_DIR/trainLabels.csv --output_dir=$TFR_DIR
+set -euo pipefail
+
+DATA_DIR="${1:-data/eyepacs}"
+mkdir -p "$DATA_DIR"
+
+have_layout() {
+  [[ -f "$DATA_DIR/trainLabels.csv" ]] && [[ -d "$DATA_DIR/train" ]] \
+    && compgen -G "$DATA_DIR/train/*.jpeg" > /dev/null
+}
+
+if have_layout; then
+  echo "eyepacs.sh: raw layout already present under $DATA_DIR"
+  exit 0
+fi
+
+if ! command -v kaggle > /dev/null; then
+  cat >&2 <<EOF
+eyepacs.sh: kaggle CLI not found and $DATA_DIR is not populated.
+Install the kaggle CLI (pip install kaggle), place your API token at
+~/.kaggle/kaggle.json, accept the competition rules at
+https://www.kaggle.com/c/diabetic-retinopathy-detection, then re-run —
+or arrange the layout documented at the top of this script by hand.
+EOF
+  exit 1
+fi
+
+kaggle competitions download -c diabetic-retinopathy-detection -p "$DATA_DIR"
+( cd "$DATA_DIR"
+  unzip -o trainLabels.csv.zip
+  cat train.zip.* > train_all.zip 2> /dev/null || true
+  unzip -o train_all.zip || unzip -o train.zip
+  rm -f train_all.zip train.zip.* trainLabels.csv.zip )
+
+have_layout || { echo "eyepacs.sh: extraction did not produce the expected layout" >&2; exit 1; }
+echo "eyepacs.sh: done -> $DATA_DIR"

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Messidor-2 acquisition (reference R10: messidor2.sh, SURVEY.md §1).
+# Messidor-2 is distributed by ADCIS behind a license form and the
+# adjudicated ICDR grades come separately from the Krause et al. / Google
+# grading release, so there is no unattended download path at all — the
+# reference's script likewise required a manually obtained archive. This
+# script verifies/arranges the layout preprocess_messidor.py expects.
+#
+# Expected layout after this script succeeds:
+#   $DATA_DIR/
+#     grades.csv               # columns: image,grade   (ICDR 0-4, adjudicated)
+#     images/                  # {image}.{jpg|png|tif} fundus photographs
+#
+# Obtain:
+#   1. Request Messidor-2 from https://www.adcis.net/en/third-party/messidor2/
+#      -> messidor-2.zip (IMAGES part 1..4)
+#   2. Grades: "messidor_data.csv" from the Kaggle 'messidor2-dr-grades'
+#      dataset or the Google research release; rename/trim to image,grade.
+#
+# Usage: scripts/messidor2.sh [DATA_DIR] [path/to/messidor-2.zip]
+set -euo pipefail
+
+DATA_DIR="${1:-data/messidor2}"
+ARCHIVE="${2:-}"
+mkdir -p "$DATA_DIR"
+
+have_layout() {
+  [[ -f "$DATA_DIR/grades.csv" ]] && [[ -d "$DATA_DIR/images" ]] \
+    && find "$DATA_DIR/images" -maxdepth 1 -type f \
+         \( -name '*.jpg' -o -name '*.JPG' -o -name '*.png' -o -name '*.tif' \) \
+         | head -1 | grep -q .
+}
+
+if have_layout; then
+  echo "messidor2.sh: raw layout already present under $DATA_DIR"
+  exit 0
+fi
+
+if [[ -n "$ARCHIVE" && -f "$ARCHIVE" ]]; then
+  mkdir -p "$DATA_DIR/images"
+  unzip -o "$ARCHIVE" -d "$DATA_DIR/images"
+  # Flatten one level of nesting if the archive carries a top directory.
+  find "$DATA_DIR/images" -mindepth 2 -type f -exec mv -t "$DATA_DIR/images" {} +
+fi
+
+if ! have_layout; then
+  cat >&2 <<EOF
+messidor2.sh: $DATA_DIR is not populated and no usable archive was given.
+Messidor-2 cannot be downloaded unattended (license form); follow the
+"Obtain" steps at the top of this script, then either re-run with the
+archive path or arrange the documented layout by hand.
+EOF
+  exit 1
+fi
+echo "messidor2.sh: done -> $DATA_DIR"

@@ -160,10 +160,13 @@ def test_inception_forward_parity_after_keras_transplant():
     params, batch_stats = transplant.transplant_from_keras(
         keras_model, variables["params"], variables["batch_stats"]
     )
-    logits, aux = m.apply(
-        {"params": params, "batch_stats": batch_stats},
-        jnp.asarray(x), train=False,
-    )
+    # TPU f32 convs default to bf16 passes (~4e-5 drift over 94 layers vs
+    # TF's CPU f32); pin highest precision for an apples-to-apples compare.
+    with jax.default_matmul_precision("highest"):
+        logits, aux = m.apply(
+            {"params": params, "batch_stats": batch_stats},
+            jnp.asarray(x), train=False,
+        )
     assert aux is None
     flax_probs = np.asarray(jax.nn.softmax(logits, axis=-1))
     keras_probs = keras_model(x, training=False).numpy()

@@ -30,7 +30,11 @@ _WORKDIR = flags.DEFINE_string(
     "workdir", "", "checkpoint/metrics directory (default: train.checkpoint_dir)"
 )
 _DEVICE = flags.DEFINE_enum(
-    "device", "tpu", ["tpu", "cpu"], "backend gate (BASELINE.json:5)"
+    "device", "tpu", ["tpu", "cpu", "tf"],
+    "backend gate (BASELINE.json:5): tpu (default) trains the Flax model "
+    "on the ambient JAX platform, cpu forces the CPU backend, tf runs "
+    "the legacy keras backend on host TF (trainer.fit_tf) writing the "
+    "same orbax checkpoint format via weight transplant",
 )
 _FAKE_DEVICES = flags.DEFINE_integer(
     "fake_devices", 0,
@@ -47,7 +51,9 @@ _RESUME = flags.DEFINE_boolean("resume", False, "resume from latest ckpt")
 
 def main(argv):
     del argv
-    if _DEVICE.value == "cpu":
+    if _DEVICE.value in ("cpu", "tf"):
+        # tf mode trains in keras but writes orbax checkpoints through
+        # jax — pin jax to CPU so no TPU is required for the legacy path.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -88,8 +94,11 @@ def main(argv):
                     seed=seed,
                 )
 
+    backend = "tf" if _DEVICE.value == "tf" else "flax"
     if cfg.train.ensemble_size > 1:
-        results = trainer.fit_ensemble(cfg, data_dir, workdir)
+        results = trainer.fit_ensemble(cfg, data_dir, workdir, backend=backend)
+    elif backend == "tf":
+        results = trainer.fit_tf(cfg, data_dir, workdir)
     else:
         results = trainer.fit(cfg, data_dir, workdir)
     print(json.dumps({"config": cfg.name, "results": results}, default=str))
