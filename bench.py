@@ -17,6 +17,9 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        JPEG TFRecords at 299px (no device work).
   host_parse_raw     — same for pre-decoded raw records (the shipped
                        mitigation: decode paid once offline).
+  host_grain_raw     — the grain loader (data/grain_pipeline.py) on the
+                       same raw records: random-access index + protobuf
+                       parse, no tf.data runtime.
   augment_jnp / augment_pallas — the augmentation stage alone, jnp
                        composition vs the fused pallas kernel
                        (ops/pallas_augment.py), compiled on this chip.
@@ -85,17 +88,37 @@ def _ensure_bench_data(image_size: int) -> dict:
     return dirs
 
 
-def _host_rate(data_dir: str, cfg, image_size: int, n_batches: int = 30) -> float:
-    """Images/sec of the tf.data path alone (parse/decode+batch, no TPU)."""
-    from jama16_retina_tpu.data import pipeline
+def _host_rate(data_dir: str, cfg, image_size: int, n_batches: int = 30,
+               loader: str = "tfdata") -> float:
+    """Images/sec of the host loader alone (parse/decode+batch, no TPU)."""
+    if loader == "grain":
+        from jama16_retina_tpu.data import grain_pipeline
 
-    it = pipeline.train_batches(data_dir, "train", cfg.data, image_size, seed=0)
-    for _ in range(3):  # warm tf.data's threads/autotune
+        it = grain_pipeline.train_batches(
+            data_dir, "train", cfg.data, image_size, seed=0
+        )
+    else:
+        from jama16_retina_tpu.data import pipeline
+
+        it = pipeline.train_batches(
+            data_dir, "train", cfg.data, image_size, seed=0
+        )
+    for _ in range(3):  # warm threads/autotune
         next(it)
     t0 = time.time()
     for _ in range(n_batches):
         next(it)
     dt = time.time() - t0
+    # Tear down promptly: a leaked tf.data iterator keeps its autotune/
+    # reader threads alive and steals CPU from the next measurement
+    # (observed: the grain rate halves when measured after tf.data
+    # without this).
+    if hasattr(it, "close"):
+        it.close()
+    del it
+    import gc
+
+    gc.collect()
     return n_batches * cfg.data.batch_size / dt
 
 
@@ -204,6 +227,14 @@ def main() -> None:
         extras["host_parse_raw"] = round(_host_rate(dirs["raw"], cfg, size), 1)
         _log(f"host feed: jpeg-decode {extras['host_decode_jpeg']} img/s, "
              f"raw-parse {extras['host_parse_raw']} img/s")
+        try:
+            extras["host_grain_raw"] = round(
+                _host_rate(dirs["raw"], cfg, size, loader="grain"), 1
+            )
+            _log(f"host feed (grain loader, raw): "
+                 f"{extras['host_grain_raw']} img/s")
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"grain host bench failed: {type(e).__name__}: {e}")
 
         # End-to-end: the real pipeline (raw records) feeding the train
         # step through device_prefetch — what a training run actually gets.

@@ -50,6 +50,11 @@ class DataConfig:
     val_dir: str = ""
     test_dir: str = ""
     batch_size: int = 32  # global batch across all devices (BASELINE.json:7)
+    # Train-stream loader (SURVEY.md N4): "tfdata" = tf.data stream with
+    # deterministic replay resume (data/pipeline.py); "grain" = index-
+    # sampled loader with global shuffle and O(1) derived-state resume
+    # (data/grain_pipeline.py). Same {'image','grade'} batch contract.
+    loader: str = "tfdata"
     # NOTE: image size lives ONLY in ModelConfig.image_size; the pipeline
     # reads it from there so the two can never desync via overrides.
     shuffle_buffer: int = 4096
@@ -134,6 +139,11 @@ class EvalConfig:
     # Ensemble: list of checkpoint dirs whose probabilities are averaged
     # (BASELINE.json:10 "averaged logits").
     ensemble_dirs: tuple[str, ...] = ()
+    # Test-time augmentation: average probabilities over the 4 flip views
+    # (identity/h/v/hv) inside the one jit eval program. A quality lever
+    # beyond the reference (fundus photos have no canonical orientation);
+    # 4x eval FLOPs, eval only. Off by default for paper parity.
+    tta: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

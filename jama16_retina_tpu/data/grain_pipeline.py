@@ -1,0 +1,278 @@
+"""Grain-based train input pipeline: the ``data.loader="grain"`` option.
+
+The tf.data path (data/pipeline.py) resumes by deterministic REPLAY —
+``skip_batches=k`` re-reads up to an epoch of records to reach position
+k (SURVEY.md §5.4). This module is the O(1)-resume alternative named by
+SURVEY.md N4/§5.4: grain's index-based sampling makes the pipeline
+position an explicit, restorable value, and the position after k steps
+is DERIVABLE (``state_at_step``) — so resume stays a pure function of
+(seed, step), the same contract as the jit step's fold_in keys, with no
+side-channel state files.
+
+TPU-first consequences of index sampling over stream sampling:
+
+  * GLOBAL shuffle per epoch (a permutation of all record indices), not
+    tf.data's sliding-window approximation — better sample decorrelation
+    at identical memory cost (the permutation is implicit, seed-derived).
+  * Per-process sharding is exact and drop-remainder-stable via
+    ``ShardOptions`` on the sampler: process p reads indices p, p+P, ...
+    of the permuted stream; no coordination, no overlap.
+  * Random access needs record offsets; TFRecord is a sequential format,
+    so ``TFRecordIndex`` scans the length-prefixed framing once at
+    startup (cheap: two small reads per record, no payload decode) and
+    caches ``(path, offset, length)`` per record.
+  * The train path needs NO TensorFlow graph machinery: protos are
+    parsed with the protobuf runtime and JPEGs decoded by OpenCV.
+
+Eval stays on the tf.data path (padded global batches, multi-host
+batch-count alignment — see pipeline.eval_batches); eval is a rare,
+epoch-bounded pass where replay cost is irrelevant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Iterator, Sequence
+
+import numpy as np
+
+from jama16_retina_tpu.configs import DataConfig
+from jama16_retina_tpu.data import tfrecord
+
+
+class TFRecordIndex:
+    """Random-access index over TFRecord shards.
+
+    TFRecord framing per record: u64le payload length, u32 masked CRC of
+    the length, payload, u32 masked CRC of the payload. The index stores
+    payload extents only; CRCs are not verified (same stance as tf.data's
+    default) — a torn file surfaces as a proto parse error instead.
+    """
+
+    def __init__(self, paths: Sequence[str]):
+        self.paths = list(paths)
+        self._extents: list[tuple[int, int, int]] = []  # (path_i, off, len)
+        self._files: dict[int, Any] = {}  # lazy per-shard handles
+        for pi, path in enumerate(self.paths):
+            with open(path, "rb") as f:
+                off = 0
+                while True:
+                    header = f.read(12)
+                    if not header:
+                        break
+                    if len(header) < 12:
+                        raise ValueError(f"truncated TFRecord header in {path}")
+                    (length,) = struct.unpack("<Q", header[:8])
+                    self._extents.append((pi, off + 12, length))
+                    off += 12 + length + 4
+                    f.seek(off)
+
+    def __len__(self) -> int:
+        return len(self._extents)
+
+    def read(self, i: int) -> bytes:
+        pi, off, length = self._extents[i]
+        # Descriptors are cached per shard — global shuffle has no read
+        # locality, so reopening per record would put an open/close
+        # syscall pair on every image of the train hot path. os.pread is
+        # a positioned read with no shared seek cursor: grain's reader
+        # THREADS (ReadOptions defaults to a thread pool even with
+        # worker_count=0) hit the same descriptor concurrently.
+        fd = self._files.get(pi)
+        if fd is None:
+            fd = self._files[pi] = os.open(self.paths[pi], os.O_RDONLY)
+        return os.pread(fd, length, off)
+
+    # Keep the index picklable for grain worker processes: descriptors
+    # are per-process state and reopen lazily on first read.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_files"] = {}
+        return state
+
+    def __del__(self):
+        for fd in self.__dict__.get("_files", {}).values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+def _decode_example(payload: bytes, image_size: int) -> dict[str, Any]:
+    """Serialized tf.train.Example -> {'image': u8[S,S,3], 'grade': i32}.
+
+    Mirrors tfrecord.parse_fn (raw and JPEG encodings, bilinear resize to
+    the model size when shards were written at another size) without any
+    TF graph machinery on the hot path.
+
+    Pixel parity with tf.data: BIT-EXACT for records stored at the model
+    size — the layout preprocess_* writes, and what test_grain.py pins.
+    The resize FALLBACK is best-effort only: cv2's INTER_LINEAR (rounds)
+    and tf.image.resize (truncating cast) differ in low-order bits, so
+    store shards at the training size if loaders must be interchangeable.
+    """
+    import cv2
+    from tensorflow.core.example import example_pb2
+
+    ex = example_pb2.Example.FromString(payload)
+    feat = ex.features.feature
+    raw = feat["image/raw"].bytes_list.value
+    if raw and raw[0]:
+        h = feat["image/height"].int64_list.value[0]
+        w = feat["image/width"].int64_list.value[0]
+        image = np.frombuffer(raw[0], np.uint8).reshape(h, w, 3)
+    else:
+        jpeg = feat["image/encoded"].bytes_list.value[0]
+        bgr = cv2.imdecode(np.frombuffer(jpeg, np.uint8), cv2.IMREAD_COLOR)
+        if bgr is None:
+            raise ValueError("JPEG decode failed")
+        image = bgr[..., ::-1]  # records are RGB-encoded (tfrecord.encode_jpeg)
+    if image.shape[:2] != (image_size, image_size):
+        image = cv2.resize(
+            image, (image_size, image_size), interpolation=cv2.INTER_LINEAR
+        )
+    grade = np.int32(feat["image/grade"].int64_list.value[0])
+    return {"image": np.ascontiguousarray(image), "grade": grade}
+
+
+class FundusSource:
+    """grain RandomAccessDataSource over fundus TFRecord shards."""
+
+    def __init__(self, data_dir: str, split: str, image_size: int):
+        self.index = TFRecordIndex(tfrecord.list_split(data_dir, split))
+        self.image_size = image_size
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        return _decode_example(self.index.read(int(i)), self.image_size)
+
+    def __repr__(self) -> str:  # embedded in grain's state JSON
+        return f"FundusSource(n={len(self)}, size={self.image_size})"
+
+
+def _batch_dicts(rows) -> dict[str, np.ndarray]:
+    return {
+        "image": np.stack([r["image"] for r in rows]),
+        "grade": np.asarray([r["grade"] for r in rows], np.int32),
+    }
+
+
+def make_train_iterator(
+    data_dir: str,
+    split: str,
+    cfg: DataConfig,
+    image_size: int,
+    seed: int = 0,
+    process_index: int | None = None,
+    process_count: int | None = None,
+    worker_count: int = 0,
+):
+    """Infinite per-process loader of {'image': [b,S,S,3], 'grade': [b]}
+    local batches (b = batch_size / P), as a grain iterator with
+    get_state()/set_state(). Same yield contract as pipeline.train_batches.
+    """
+    import grain.python as pygrain
+
+    from jama16_retina_tpu.data.pipeline import (
+        _local_batch_size,
+        _resolve_process,
+    )
+
+    p_idx, p_cnt = _resolve_process(process_index, process_count)
+    local_bs = _local_batch_size(cfg.batch_size, p_cnt, "data.batch_size")
+    source = FundusSource(data_dir, split, image_size)
+    if len(source) == 0:
+        raise ValueError(f"no records under {data_dir}/{split}")
+    sampler = pygrain.IndexSampler(
+        len(source),
+        shard_options=pygrain.ShardOptions(
+            shard_index=p_idx, shard_count=p_cnt, drop_remainder=True
+        ),
+        shuffle=True,
+        num_epochs=None,  # infinite
+        seed=seed,
+    )
+    loader = pygrain.DataLoader(
+        data_source=source,
+        sampler=sampler,
+        operations=[
+            pygrain.Batch(local_bs, drop_remainder=True, batch_fn=_batch_dicts)
+        ],
+        worker_count=worker_count,
+    )
+    return iter(loader)
+
+
+def state_at_step(
+    iterator, step: int, local_batch_size: int,
+    process_index: int = 0, process_count: int = 1,
+) -> bytes:
+    """The grain state an uninterrupted run would have after ``step``
+    batches — O(1) resume without saved pipeline state (SURVEY.md §5.4).
+
+    grain's state is explicit: ``last_seen_indices`` holds GLOBAL
+    sequence positions. Shard p of P enumerates positions p, p+P,
+    p+2P, ... (verified empirically against get_state()), so after
+    k = step * local_batch_size local records the in-process loader's
+    last position is p + (k-1)*P. Deriving the state (rather than
+    persisting get_state() bytes next to each checkpoint) keeps resume a
+    pure function of (seed, step) — identical semantics to the tf.data
+    path's skip_batches, minus the replayed decode. Defined only for
+    worker_count=0 (raises otherwise): worker processes emit whole
+    batches round-robin, making per-worker positions k-dependent in a
+    way no closed form reproduces.
+    """
+    state = json.loads(iterator.get_state().decode())
+    if int(state["worker_count"]) > 0:
+        # Worker processes emit whole BATCHES round-robin, so per-worker
+        # record consumption is uneven for arbitrary k — the even-split
+        # formula below would fabricate a state no real run ever had.
+        # Use get_state()/set_state() persistence for worker_count>0.
+        raise NotImplementedError(
+            "state_at_step derivation is defined for in-process loading "
+            "(worker_count=0, the default); persist iterator.get_state() "
+            "instead when using worker processes"
+        )
+    k = step * local_batch_size
+    state["last_seen_indices"] = {
+        "0": process_index + (k - 1) * process_count if k else -1
+    }
+    # In-process loading never advances last_worker_index.
+    state["last_worker_index"] = -1
+    return json.dumps(state).encode()
+
+
+def train_batches(
+    data_dir: str,
+    split: str,
+    cfg: DataConfig,
+    image_size: int,
+    seed: int = 0,
+    process_index: int | None = None,
+    process_count: int | None = None,
+    skip_batches: int = 0,
+    worker_count: int = 0,
+) -> Iterator[dict]:
+    """Drop-in twin of pipeline.train_batches on the grain loader —
+    ``skip_batches`` is an O(1) state restore instead of a replay."""
+    it = make_train_iterator(
+        data_dir, split, cfg, image_size, seed=seed,
+        process_index=process_index, process_count=process_count,
+        worker_count=worker_count,
+    )
+    if skip_batches:
+        from jama16_retina_tpu.data.pipeline import (
+            _local_batch_size,
+            _resolve_process,
+        )
+
+        p_idx, p_cnt = _resolve_process(process_index, process_count)
+        local_bs = _local_batch_size(cfg.batch_size, p_cnt, "data.batch_size")
+        it.set_state(
+            state_at_step(it, skip_batches, local_bs, p_idx, p_cnt)
+        )
+    return it

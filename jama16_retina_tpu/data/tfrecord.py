@@ -207,7 +207,16 @@ def parse_fn():
                      tf.cast(f["image/width"], tf.int32), 3]
                 ),
             ),
-            lambda: tf.io.decode_jpeg(f["image/encoded"], channels=3),
+            # INTEGER_ACCURATE (islow DCT): bit-exact with OpenCV's
+            # decoder, so for records stored at the model size the
+            # tf.data and grain loaders yield IDENTICAL pixel streams
+            # (tests/test_grain.py pins this; the resize fallback for
+            # mis-sized shards is best-effort — see grain_pipeline).
+            # ~15% slower than the fast default; the host still outruns
+            # the chip (docs/PERF.md) and raw encoding bypasses decode.
+            lambda: tf.io.decode_jpeg(
+                f["image/encoded"], channels=3, dct_method="INTEGER_ACCURATE"
+            ),
         )
         return image, tf.cast(f["image/grade"], tf.int32), f["image/name"]
 

@@ -88,15 +88,27 @@ def load_flax_state(keras_model, params, batch_stats) -> None:
     dense.bias.assign(params["Logits"]["bias"])
 
 
-def predict_probs(keras_model, images_u8: np.ndarray, head: str) -> np.ndarray:
+def predict_probs(
+    keras_model, images_u8: np.ndarray, head: str, tta: bool = False
+) -> np.ndarray:
     """uint8 batch -> probabilities, numerically parallel to the jit
-    eval step: the same /127.5-1 normalization (augment.normalize) and
-    the same head nonlinearity (train_lib._probs)."""
+    eval step: the same /127.5-1 normalization (augment.normalize), the
+    same head nonlinearity (train_lib._probs), and the same 4-flip-view
+    averaging when ``tta`` (train_lib.make_eval_step)."""
     import tensorflow as tf
 
     x = images_u8.astype(np.float32) / 127.5 - 1.0
-    logits = keras_model(tf.convert_to_tensor(x), training=False).numpy()
-    if head == "binary":
-        return 1.0 / (1.0 + np.exp(-logits[:, 0]))
-    e = np.exp(logits - logits.max(axis=-1, keepdims=True))
-    return e / e.sum(axis=-1, keepdims=True)
+    views = (
+        [x, x[:, :, ::-1], x[:, ::-1, :], x[:, ::-1, ::-1]] if tta else [x]
+    )
+
+    def probs_of(view):
+        logits = keras_model(
+            tf.convert_to_tensor(np.ascontiguousarray(view)), training=False
+        ).numpy()
+        if head == "binary":
+            return 1.0 / (1.0 + np.exp(-logits[:, 0]))
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    return np.mean([probs_of(v) for v in views], axis=0)

@@ -256,11 +256,25 @@ def make_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
 
     def step(state: TrainState, batch: dict):
         images = augment_lib.normalize(batch["image"])
-        logits, _ = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            images, train=False,
-        )
-        return _probs(logits, cfg.model.head)
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+        def forward(x):
+            logits, _ = model.apply(variables, x, train=False)
+            return _probs(logits, cfg.model.head)
+
+        if not cfg.eval.tta:
+            return forward(images)
+        # Flip-averaged TTA: stack the 4 views on a leading axis and scan
+        # so the backbone is traced/compiled ONCE (4 sequential passes),
+        # not inlined 4x into one giant program.
+        views = jnp.stack([
+            images,
+            images[:, :, ::-1],
+            images[:, ::-1, :],
+            images[:, ::-1, ::-1],
+        ])
+        probs = jax.lax.map(forward, views)
+        return probs.mean(axis=0)
 
     if mesh is None:
         return jax.jit(step)

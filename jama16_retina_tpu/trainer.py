@@ -94,8 +94,31 @@ def predict_split_tf(
     return _predict_over_split(
         cfg, data_dir, split,
         lambda batch: tf_backend.predict_probs(
-            keras_model, batch["image"], cfg.model.head
+            keras_model, batch["image"], cfg.model.head, tta=cfg.eval.tta
         ),
+    )
+
+
+def _train_stream(
+    cfg: ExperimentConfig, data_dir: str, seed: int, skip_batches: int
+):
+    """Dispatch on data.loader (SURVEY.md N4): both loaders yield the
+    same {'image','grade'} local batches and honor skip_batches, so the
+    train loops never see which one is underneath."""
+    if cfg.data.loader == "grain":
+        from jama16_retina_tpu.data import grain_pipeline
+
+        return grain_pipeline.train_batches(
+            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+            skip_batches=skip_batches,
+        )
+    if cfg.data.loader != "tfdata":
+        raise ValueError(
+            f"unknown data.loader {cfg.data.loader!r} (want tfdata|grain)"
+        )
+    return pipeline.train_batches(
+        data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+        skip_batches=skip_batches,
     )
 
 
@@ -207,10 +230,7 @@ def fit(
     # (pipeline determinism; SURVEY.md §5.4). Augment/dropout keys need
     # no restoring — they are fold_in(base_key, state.step) in-step.
     batches = pipeline.device_prefetch(
-        pipeline.train_batches(
-            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
-            skip_batches=start_step,
-        ),
+        _train_stream(cfg, data_dir, seed, skip_batches=start_step),
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
     )
@@ -404,10 +424,7 @@ def fit_tf(
         start_step = int(np.asarray(restored.step))
         log.write("resume", step=start_step)
 
-    batches = pipeline.train_batches(
-        data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
-        skip_batches=start_step,
-    )
+    batches = _train_stream(cfg, data_dir, seed, skip_batches=start_step)
     best_auc, best_step, since_best = -np.inf, start_step, 0
     stopped_early = False
     t_log, imgs_since = time.time(), 0

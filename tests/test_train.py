@@ -210,6 +210,31 @@ def test_eval_step_binary_probs_in_range():
     assert probs.min() >= 0.0 and probs.max() <= 1.0
 
 
+def test_tta_eval_is_mean_of_flip_views():
+    """eval.tta=true averages exactly the 4 flip views (configs.py
+    EvalConfig.tta): pin against manually flipped plain eval passes."""
+    cfg = small_cfg()
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    plain = train_lib.make_eval_step(cfg, model)
+    tta_cfg = dataclasses.replace(
+        cfg, eval=dataclasses.replace(cfg.eval, tta=True)
+    )
+    tta = train_lib.make_eval_step(tta_cfg, model)
+    batch = make_batch(cfg)
+    imgs = batch["image"]
+    expected = np.mean(
+        [
+            np.asarray(plain(state, {"image": v}))
+            for v in (imgs, imgs[:, :, ::-1], imgs[:, ::-1, :],
+                      imgs[:, ::-1, ::-1])
+        ],
+        axis=0,
+    )
+    got = np.asarray(tta(state, {"image": imgs}))
+    np.testing.assert_allclose(got, expected, atol=1e-6)
+
+
 def test_augmented_step_is_deterministic_per_key():
     cfg = small_cfg(augment=True)
     model = models.build(cfg.model)
