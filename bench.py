@@ -92,17 +92,10 @@ def _host_rate(data_dir: str, cfg, image_size: int, n_batches: int = 30,
                loader: str = "tfdata") -> float:
     """Images/sec of the host loader alone (parse/decode+batch, no TPU)."""
     if loader == "grain":
-        from jama16_retina_tpu.data import grain_pipeline
-
-        it = grain_pipeline.train_batches(
-            data_dir, "train", cfg.data, image_size, seed=0
-        )
+        from jama16_retina_tpu.data import grain_pipeline as mod
     else:
-        from jama16_retina_tpu.data import pipeline
-
-        it = pipeline.train_batches(
-            data_dir, "train", cfg.data, image_size, seed=0
-        )
+        from jama16_retina_tpu.data import pipeline as mod
+    it = mod.train_batches(data_dir, "train", cfg.data, image_size, seed=0)
     for _ in range(3):  # warm threads/autotune
         next(it)
     t0 = time.time()

@@ -32,6 +32,17 @@ _ENSEMBLE = flags.DEFINE_multi_string(
     "reference's -e flag)"
 )
 _SPLIT = flags.DEFINE_string("split", "test", "which split to evaluate")
+_THRESHOLD_SPLIT = flags.DEFINE_string(
+    "threshold_split", "",
+    "paper protocol: choose operating thresholds at the fixed "
+    "specificities on THIS split (e.g. val) and apply them unchanged to "
+    "--split, reported as operating_points_transferred",
+)
+_BOOTSTRAP = flags.DEFINE_integer(
+    "bootstrap", 0,
+    "number of bootstrap resamples for 95% CIs on AUC/sensitivity "
+    "(0 = off; the replication paper used 2000)",
+)
 _DEVICE = flags.DEFINE_enum(
     "device", "tpu", ["tpu", "cpu", "tf"],
     "backend gate (BASELINE.json:5): tpu/cpu run the Flax model under jit "
@@ -81,6 +92,8 @@ def main(argv):
     report = trainer.evaluate_checkpoints(
         cfg, data_dir, dirs, split=_SPLIT.value,
         backend="tf" if _DEVICE.value == "tf" else "flax",
+        threshold_split=_THRESHOLD_SPLIT.value or None,
+        bootstrap=_BOOTSTRAP.value,
     )
     print(json.dumps(report, indent=2))
 

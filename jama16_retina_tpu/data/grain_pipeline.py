@@ -52,9 +52,12 @@ class TFRecordIndex:
     """
 
     def __init__(self, paths: Sequence[str]):
+        import threading
+
         self.paths = list(paths)
         self._extents: list[tuple[int, int, int]] = []  # (path_i, off, len)
-        self._files: dict[int, Any] = {}  # lazy per-shard handles
+        self._files: dict[int, Any] = {}  # lazy per-shard descriptors
+        self._open_lock = threading.Lock()
         for pi, path in enumerate(self.paths):
             with open(path, "rb") as f:
                 off = 0
@@ -82,15 +85,27 @@ class TFRecordIndex:
         # worker_count=0) hit the same descriptor concurrently.
         fd = self._files.get(pi)
         if fd is None:
-            fd = self._files[pi] = os.open(self.paths[pi], os.O_RDONLY)
+            # Locked first-open: two racing reader threads would both
+            # os.open() and the loser's descriptor would leak.
+            with self._open_lock:
+                fd = self._files.get(pi)
+                if fd is None:
+                    fd = self._files[pi] = os.open(self.paths[pi], os.O_RDONLY)
         return os.pread(fd, length, off)
 
     # Keep the index picklable for grain worker processes: descriptors
-    # are per-process state and reopen lazily on first read.
+    # and the lock are per-process state, recreated after unpickling.
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_files"] = {}
+        del state["_open_lock"]
         return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._open_lock = threading.Lock()
 
     def __del__(self):
         for fd in self.__dict__.get("_files", {}).values():

@@ -121,6 +121,65 @@ def confusion_at_threshold(
     }
 
 
+def transferred_operating_points(
+    tune_labels: np.ndarray,
+    tune_scores: np.ndarray,
+    eval_labels: np.ndarray,
+    eval_scores: np.ndarray,
+    operating_specificities: Sequence[float],
+) -> list[dict]:
+    """The paper's operating-point protocol (JAMA 2016 / the replication):
+    thresholds are chosen at fixed specificity on a TUNING split, then
+    applied unchanged to the held-out eval split — reporting achieved
+    sensitivity/specificity plus the full confusion there. Selecting
+    thresholds on the eval split itself (sensitivity_at_specificity
+    directly) is optimistically biased; both forms appear in the report
+    so the bias is visible.
+    """
+    rows = []
+    for s in operating_specificities:
+        op = sensitivity_at_specificity(tune_labels, tune_scores, s)
+        achieved = confusion_at_threshold(eval_labels, eval_scores, op.threshold)
+        rows.append({
+            "target_specificity": float(s),
+            "threshold": op.threshold,
+            **achieved,
+        })
+    return rows
+
+
+def bootstrap_ci(
+    labels: np.ndarray,
+    scores: np.ndarray,
+    stat_fn,
+    n_samples: int = 2000,
+    seed: int = 0,
+    alpha: float = 0.05,
+) -> tuple[float, float]:
+    """Percentile-bootstrap CI for any statistic of (labels, scores) —
+    the replication reported 95% CIs on AUC this way. Resamples that
+    lose one class (possible on small eval sets) are skipped; needs at
+    least 100 valid resamples to report an interval.
+    """
+    labels = np.asarray(labels).ravel()
+    scores = np.asarray(scores).ravel()
+    rng = np.random.default_rng(seed)
+    stats = []
+    for _ in range(n_samples):
+        idx = rng.integers(0, labels.size, labels.size)
+        lab = labels[idx]
+        if lab.min() == lab.max():  # one-class resample: statistic undefined
+            continue
+        stats.append(stat_fn(lab, scores[idx]))
+    if len(stats) < 100:
+        raise ValueError(
+            f"only {len(stats)}/{n_samples} bootstrap resamples were valid "
+            "— eval set too small/imbalanced for a CI"
+        )
+    lo, hi = np.quantile(stats, [alpha / 2, 1 - alpha / 2])
+    return float(lo), float(hi)
+
+
 def brier_score(labels: np.ndarray, scores: np.ndarray) -> float:
     labels = np.asarray(labels, dtype=np.float64).ravel()
     scores = np.asarray(scores, dtype=np.float64).ravel()
@@ -186,9 +245,15 @@ def evaluation_report(
     labels: np.ndarray,
     probs: np.ndarray,
     operating_specificities: Sequence[float] = (0.87, 0.98),
+    bootstrap_samples: int = 0,
+    bootstrap_seed: int = 0,
 ) -> dict:
     """The reference's final eval report shape: AUC plus one row per
-    operating point (SURVEY.md §3.2), identical format for every backend."""
+    operating point (SURVEY.md §3.2), identical format for every backend.
+
+    ``bootstrap_samples > 0`` adds 95% percentile-bootstrap intervals
+    (``auc_ci95``, per-point ``sensitivity_ci95``) — the replication
+    paper's uncertainty protocol, absent from the reference code."""
     labels = np.asarray(labels).ravel()
     probs = np.asarray(probs)
     if probs.ndim == 2 and probs.shape[-1] == 2:
@@ -212,8 +277,23 @@ def evaluation_report(
     report["auc"] = roc_auc(binary_labels, binary_probs)
     report["brier"] = brier_score(binary_labels, binary_probs)
     report["n_examples"] = int(binary_labels.size)
-    report["operating_points"] = [
-        sensitivity_at_specificity(binary_labels, binary_probs, s).as_dict()
-        for s in operating_specificities
-    ]
+    # Each row: the ROC-chosen point plus the full confusion at its
+    # threshold (reference R2 reports confusion at the operating points).
+    report["operating_points"] = []
+    for s in operating_specificities:
+        op = sensitivity_at_specificity(binary_labels, binary_probs, s)
+        conf = confusion_at_threshold(binary_labels, binary_probs, op.threshold)
+        report["operating_points"].append({**conf, **op.as_dict()})
+    if bootstrap_samples > 0:
+        report["auc_ci95"] = list(bootstrap_ci(
+            binary_labels, binary_probs, roc_auc, bootstrap_samples,
+            bootstrap_seed,
+        ))
+        for row in report["operating_points"]:
+            thr = row["threshold"]
+            row["sensitivity_ci95"] = list(bootstrap_ci(
+                binary_labels, binary_probs,
+                lambda l, s: confusion_at_threshold(l, s, thr)["sensitivity"],
+                bootstrap_samples, bootstrap_seed,
+            ))
     return report

@@ -503,6 +503,8 @@ def evaluate_checkpoints(
     split: str = "test",
     mesh=None,
     backend: str = "flax",
+    threshold_split: str | None = None,
+    bootstrap: int = 0,
 ) -> dict:
     """Single- or multi-checkpoint (ensemble-averaged) evaluation
     (SURVEY.md §3.2; BASELINE.json:10 'averaged logits').
@@ -510,9 +512,21 @@ def evaluate_checkpoints(
     ``backend="tf"`` routes the forward pass through the keras legacy-
     graph stand-in (models/tf_backend.py) — same checkpoints, same
     pipeline, same metrics layer, per the north-star plugin boundary.
+
+    ``threshold_split`` (e.g. "val") additionally runs the paper's
+    operating-point protocol: thresholds chosen at the fixed
+    specificities on that split, applied unchanged to ``split``
+    (metrics.transferred_operating_points). ``bootstrap`` > 0 adds 95%
+    CIs to AUC/sensitivity (the replication's uncertainty reporting).
     """
     if not ckpt_dirs:
         raise ValueError("need at least one checkpoint dir")
+    if threshold_split == split:
+        raise ValueError(
+            f"threshold_split={split!r} is the eval split itself — "
+            "self-tuned thresholds are exactly the bias this protocol "
+            "avoids (the plain operating_points rows already report them)"
+        )
     mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
     model = models.build(cfg.model)  # flax: checkpoint tree structure
     if backend == "tf":
@@ -522,28 +536,53 @@ def evaluate_checkpoints(
         eval_step = None
     else:
         eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
-    prob_list, grades = [], None
+
+    def member_predict(state, eval_split):
+        if backend == "tf":
+            return predict_split_tf(cfg, keras_model, data_dir, eval_split)
+        return predict_split(
+            cfg, model, state, data_dir, eval_split, mesh, eval_step=eval_step
+        )
+
+    splits = [split] + ([threshold_split] if threshold_split else [])
+    prob_lists: dict[str, list] = {s: [] for s in splits}
+    grades_by: dict[str, np.ndarray] = {}
     for d in ckpt_dirs:
         state = restore_for_eval(cfg, model, d, mesh)
         if backend == "tf":
             tf_backend.load_flax_state(
                 keras_model, state.params, state.batch_stats
             )
-            g, p = predict_split_tf(cfg, keras_model, data_dir, split)
-        else:
-            g, p = predict_split(
-                cfg, model, state, data_dir, split, mesh, eval_step=eval_step
-            )
-        if grades is not None and not np.array_equal(g, grades):
-            raise RuntimeError("checkpoints saw different eval sets")
-        grades = g
-        prob_list.append(p)
-    probs = metrics.ensemble_average(prob_list)
+        for s in splits:
+            g, p = member_predict(state, s)
+            if s in grades_by and not np.array_equal(g, grades_by[s]):
+                raise RuntimeError("checkpoints saw different eval sets")
+            grades_by[s] = g
+            prob_lists[s].append(p)
+
+    probs = metrics.ensemble_average(prob_lists[split])
+    labels = _binary_eval_labels(grades_by[split], cfg.model.head)
     report = metrics.evaluation_report(
-        _binary_eval_labels(grades, cfg.model.head),
+        labels,
         probs,
         cfg.eval.operating_specificities,
+        bootstrap_samples=bootstrap,
     )
+    if threshold_split:
+        tune_probs = metrics.ensemble_average(prob_lists[threshold_split])
+        tune_grades = grades_by[threshold_split]
+        to_binary = (
+            (lambda p: p) if cfg.model.head == "binary"
+            else metrics.referable_probs_from_multiclass
+        )
+        report["operating_points_transferred"] = (
+            metrics.transferred_operating_points(
+                (tune_grades >= 2).astype(np.float64), to_binary(tune_probs),
+                (grades_by[split] >= 2).astype(np.float64), to_binary(probs),
+                cfg.eval.operating_specificities,
+            )
+        )
+        report["threshold_split"] = threshold_split
     report["split"] = split
     report["n_models"] = len(ckpt_dirs)
     return report

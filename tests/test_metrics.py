@@ -115,3 +115,64 @@ def test_evaluation_report_binary_and_multi():
     binary = (grades >= 2).astype(int)
     rep2 = metrics.evaluation_report(binary, probs5[:, 2:].sum(axis=1))
     assert rep2["auc"] == pytest.approx(rep["auc"], abs=1e-12)
+
+
+def test_bootstrap_ci_contains_point_estimate_and_is_deterministic():
+    rng = np.random.default_rng(11)
+    labels = rng.integers(0, 2, 400).astype(float)
+    scores = np.clip(labels * 0.4 + rng.normal(0.3, 0.25, 400), 0, 1)
+    auc = metrics.roc_auc(labels, scores)
+    lo, hi = metrics.bootstrap_ci(labels, scores, metrics.roc_auc, 500, seed=3)
+    assert lo <= auc <= hi
+    assert 0.0 < hi - lo < 0.3  # informative, not degenerate
+    assert (lo, hi) == metrics.bootstrap_ci(
+        labels, scores, metrics.roc_auc, 500, seed=3
+    )
+    # sklearn cross-check on one resample path: CI must bracket the
+    # sklearn AUC too (same statistic).
+    assert lo <= skm.roc_auc_score(labels, scores) <= hi
+
+
+def test_bootstrap_ci_rejects_tiny_one_class_sets():
+    labels = np.array([1.0, 1.0, 0.0])
+    scores = np.array([0.9, 0.8, 0.1])
+    with pytest.raises(ValueError, match="bootstrap"):
+        # nearly every 3-element resample is one-class
+        metrics.bootstrap_ci(labels, scores, metrics.roc_auc, 120, seed=0)
+
+
+def test_transferred_operating_points_use_tune_thresholds():
+    rng = np.random.default_rng(7)
+    tune_l = rng.integers(0, 2, 500).astype(float)
+    tune_s = np.clip(tune_l * 0.5 + rng.normal(0.25, 0.2, 500), 0, 1)
+    eval_l = rng.integers(0, 2, 400).astype(float)
+    eval_s = np.clip(eval_l * 0.5 + rng.normal(0.25, 0.2, 400), 0, 1)
+    rows = metrics.transferred_operating_points(
+        tune_l, tune_s, eval_l, eval_s, (0.87, 0.98)
+    )
+    assert [r["target_specificity"] for r in rows] == [0.87, 0.98]
+    for r in rows:
+        # threshold comes from the TUNE split ...
+        op = metrics.sensitivity_at_specificity(
+            tune_l, tune_s, r["target_specificity"]
+        )
+        assert r["threshold"] == op.threshold
+        # ... and the reported numbers are the EVAL-split confusion there.
+        conf = metrics.confusion_at_threshold(eval_l, eval_s, r["threshold"])
+        assert r["sensitivity"] == conf["sensitivity"]
+        assert r["specificity"] == conf["specificity"]
+        assert r["tp"] + r["fn"] == int(eval_l.sum())
+    # achieved specificity on eval may drift from target — that is the
+    # point of reporting the transfer; it must still be sane.
+    assert all(0.5 <= r["specificity"] <= 1.0 for r in rows)
+
+
+def test_evaluation_report_with_bootstrap():
+    rng = np.random.default_rng(13)
+    labels = rng.integers(0, 2, 300).astype(float)
+    scores = np.clip(labels * 0.5 + rng.normal(0.25, 0.2, 300), 0, 1)
+    rep = metrics.evaluation_report(labels, scores, bootstrap_samples=300)
+    assert rep["auc_ci95"][0] <= rep["auc"] <= rep["auc_ci95"][1]
+    for row in rep["operating_points"]:
+        lo, hi = row["sensitivity_ci95"]
+        assert 0.0 <= lo <= hi <= 1.0
