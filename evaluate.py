@@ -17,9 +17,7 @@ Examples:
 
 from __future__ import annotations
 
-import glob
 import json
-import os
 
 from absl import app, flags
 
@@ -38,6 +36,12 @@ _THRESHOLD_SPLIT = flags.DEFINE_string(
     "specificities on THIS split (e.g. val) and apply them unchanged to "
     "--split, reported as operating_points_transferred",
 )
+_THRESHOLD_DATA_DIR = flags.DEFINE_string(
+    "threshold_data_dir", "",
+    "TFRecord dir for --threshold_split when it lives in ANOTHER dataset "
+    "— the paper's cross-dataset protocol (EyePACS val thresholds "
+    "applied to Messidor-2) needs this; default: --data_dir",
+)
 _BOOTSTRAP = flags.DEFINE_integer(
     "bootstrap", 0,
     "number of bootstrap resamples for 95% CIs on AUC/sensitivity "
@@ -53,9 +57,6 @@ _DEVICE = flags.DEFINE_enum(
 _FAKE_DEVICES = flags.DEFINE_integer("fake_devices", 0, "cpu fake devices")
 
 
-def _discover_dirs(root: str) -> list[str]:
-    members = sorted(glob.glob(os.path.join(root, "member_*")))
-    return members or [root]
 
 
 def main(argv):
@@ -83,16 +84,19 @@ def main(argv):
     data_dir = _DATA_DIR.value or cfg.data.test_dir
     if not data_dir:
         raise app.UsageError("--data_dir is required")
+    from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
     dirs = list(_ENSEMBLE.value) or list(cfg.eval.ensemble_dirs)
     if not dirs:
         if not _CKPT.value:
             raise app.UsageError("--checkpoint_dir or --ensemble_dir required")
-        dirs = _discover_dirs(_CKPT.value)
+        dirs = ckpt_lib.discover_member_dirs(_CKPT.value)
 
     report = trainer.evaluate_checkpoints(
         cfg, data_dir, dirs, split=_SPLIT.value,
         backend="tf" if _DEVICE.value == "tf" else "flax",
         threshold_split=_THRESHOLD_SPLIT.value or None,
+        threshold_data_dir=_THRESHOLD_DATA_DIR.value or None,
         bootstrap=_BOOTSTRAP.value,
     )
     print(json.dumps(report, indent=2))

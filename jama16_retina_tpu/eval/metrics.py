@@ -127,6 +127,8 @@ def transferred_operating_points(
     eval_labels: np.ndarray,
     eval_scores: np.ndarray,
     operating_specificities: Sequence[float],
+    bootstrap_samples: int = 0,
+    bootstrap_seed: int = 0,
 ) -> list[dict]:
     """The paper's operating-point protocol (JAMA 2016 / the replication):
     thresholds are chosen at fixed specificity on a TUNING split, then
@@ -134,17 +136,35 @@ def transferred_operating_points(
     sensitivity/specificity plus the full confusion there. Selecting
     thresholds on the eval split itself (sensitivity_at_specificity
     directly) is optimistically biased; both forms appear in the report
-    so the bias is visible.
+    so the bias is visible. ``bootstrap_samples > 0`` adds 95% CIs on the
+    achieved sensitivity/specificity (eval-split resampling at the FIXED
+    transferred threshold — these rows are the protocol's headline
+    numbers, so they carry the uncertainty too).
     """
     rows = []
     for s in operating_specificities:
         op = sensitivity_at_specificity(tune_labels, tune_scores, s)
         achieved = confusion_at_threshold(eval_labels, eval_scores, op.threshold)
-        rows.append({
+        row = {
             "target_specificity": float(s),
             "threshold": op.threshold,
             **achieved,
-        })
+        }
+        if bootstrap_samples > 0:
+            thr = op.threshold
+
+            def sens_spec(l, sc):
+                c = confusion_at_threshold(l, sc, thr)
+                return {"sensitivity": c["sensitivity"],
+                        "specificity": c["specificity"]}
+
+            cis = bootstrap_ci(
+                eval_labels, eval_scores, sens_spec,
+                bootstrap_samples, bootstrap_seed,
+            )
+            row["sensitivity_ci95"] = list(cis["sensitivity"])
+            row["specificity_ci95"] = list(cis["specificity"])
+        rows.append(row)
     return rows
 
 
@@ -155,11 +175,15 @@ def bootstrap_ci(
     n_samples: int = 2000,
     seed: int = 0,
     alpha: float = 0.05,
-) -> tuple[float, float]:
+):
     """Percentile-bootstrap CI for any statistic of (labels, scores) —
-    the replication reported 95% CIs on AUC this way. Resamples that
-    lose one class (possible on small eval sets) are skipped; needs at
-    least 100 valid resamples to report an interval.
+    the replication reported 95% CIs on AUC this way.
+
+    ``stat_fn`` may return a float (returns ``(lo, hi)``) or a dict of
+    floats (returns ``{key: (lo, hi)}``, all statistics computed from
+    the SAME resamples — one pass instead of one per statistic).
+    Resamples that lose one class (possible on small eval sets) are
+    skipped; at least half of ``n_samples`` (min 20) must survive.
     """
     labels = np.asarray(labels).ravel()
     scores = np.asarray(scores).ravel()
@@ -171,12 +195,19 @@ def bootstrap_ci(
         if lab.min() == lab.max():  # one-class resample: statistic undefined
             continue
         stats.append(stat_fn(lab, scores[idx]))
-    if len(stats) < 100:
+    min_valid = max(20, n_samples // 2)
+    if len(stats) < min_valid:
         raise ValueError(
             f"only {len(stats)}/{n_samples} bootstrap resamples were valid "
-            "— eval set too small/imbalanced for a CI"
+            f"(need >= {min_valid}) — eval set too small/imbalanced for a CI"
         )
-    lo, hi = np.quantile(stats, [alpha / 2, 1 - alpha / 2])
+    q = [alpha / 2, 1 - alpha / 2]
+    if isinstance(stats[0], dict):
+        return {
+            k: tuple(float(v) for v in np.quantile([s[k] for s in stats], q))
+            for k in stats[0]
+        }
+    lo, hi = np.quantile(stats, q)
     return float(lo), float(hi)
 
 

@@ -504,6 +504,7 @@ def evaluate_checkpoints(
     mesh=None,
     backend: str = "flax",
     threshold_split: str | None = None,
+    threshold_data_dir: str | None = None,
     bootstrap: int = 0,
 ) -> dict:
     """Single- or multi-checkpoint (ensemble-averaged) evaluation
@@ -516,16 +517,26 @@ def evaluate_checkpoints(
     ``threshold_split`` (e.g. "val") additionally runs the paper's
     operating-point protocol: thresholds chosen at the fixed
     specificities on that split, applied unchanged to ``split``
-    (metrics.transferred_operating_points). ``bootstrap`` > 0 adds 95%
-    CIs to AUC/sensitivity (the replication's uncertainty reporting).
+    (metrics.transferred_operating_points). ``threshold_data_dir``
+    points the tuning split at ANOTHER dataset — the actual JAMA/
+    replication protocol is thresholds tuned on the EyePACS val set and
+    applied to Messidor-2, which lives in a different TFRecord dir.
+    ``bootstrap`` > 0 adds 95% CIs to AUC and to the sensitivities of
+    both the self-tuned and the transferred operating points.
     """
     if not ckpt_dirs:
         raise ValueError("need at least one checkpoint dir")
-    if threshold_split == split:
+    tune_dir = threshold_data_dir or data_dir
+    # realpath: './tfr', 'tfr/' and a symlink to tfr are the same eval
+    # set — spelling differences must not bypass the self-tuning guard.
+    if threshold_split == split and (
+        os.path.realpath(tune_dir) == os.path.realpath(data_dir)
+    ):
         raise ValueError(
-            f"threshold_split={split!r} is the eval split itself — "
-            "self-tuned thresholds are exactly the bias this protocol "
-            "avoids (the plain operating_points rows already report them)"
+            f"threshold_split={split!r} on the same data dir is the eval "
+            "set itself — self-tuned thresholds are exactly the bias this "
+            "protocol avoids (the plain operating_points rows already "
+            "report them)"
         )
     mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
     model = models.build(cfg.model)  # flax: checkpoint tree structure
@@ -537,15 +548,18 @@ def evaluate_checkpoints(
     else:
         eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
 
-    def member_predict(state, eval_split):
+    def member_predict(state, from_dir, eval_split):
         if backend == "tf":
-            return predict_split_tf(cfg, keras_model, data_dir, eval_split)
+            return predict_split_tf(cfg, keras_model, from_dir, eval_split)
         return predict_split(
-            cfg, model, state, data_dir, eval_split, mesh, eval_step=eval_step
+            cfg, model, state, from_dir, eval_split, mesh, eval_step=eval_step
         )
 
-    splits = [split] + ([threshold_split] if threshold_split else [])
-    prob_lists: dict[str, list] = {s: [] for s in splits}
+    # (key, data_dir, split) prediction passes; tune pass only if asked.
+    passes = [("eval", data_dir, split)]
+    if threshold_split:
+        passes.append(("tune", tune_dir, threshold_split))
+    prob_lists: dict[str, list] = {k: [] for k, _, _ in passes}
     grades_by: dict[str, np.ndarray] = {}
     for d in ckpt_dirs:
         state = restore_for_eval(cfg, model, d, mesh)
@@ -553,15 +567,15 @@ def evaluate_checkpoints(
             tf_backend.load_flax_state(
                 keras_model, state.params, state.batch_stats
             )
-        for s in splits:
-            g, p = member_predict(state, s)
-            if s in grades_by and not np.array_equal(g, grades_by[s]):
+        for key, from_dir, s in passes:
+            g, p = member_predict(state, from_dir, s)
+            if key in grades_by and not np.array_equal(g, grades_by[key]):
                 raise RuntimeError("checkpoints saw different eval sets")
-            grades_by[s] = g
-            prob_lists[s].append(p)
+            grades_by[key] = g
+            prob_lists[key].append(p)
 
-    probs = metrics.ensemble_average(prob_lists[split])
-    labels = _binary_eval_labels(grades_by[split], cfg.model.head)
+    probs = metrics.ensemble_average(prob_lists["eval"])
+    labels = _binary_eval_labels(grades_by["eval"], cfg.model.head)
     report = metrics.evaluation_report(
         labels,
         probs,
@@ -569,8 +583,8 @@ def evaluate_checkpoints(
         bootstrap_samples=bootstrap,
     )
     if threshold_split:
-        tune_probs = metrics.ensemble_average(prob_lists[threshold_split])
-        tune_grades = grades_by[threshold_split]
+        tune_probs = metrics.ensemble_average(prob_lists["tune"])
+        tune_grades = grades_by["tune"]
         to_binary = (
             (lambda p: p) if cfg.model.head == "binary"
             else metrics.referable_probs_from_multiclass
@@ -578,11 +592,14 @@ def evaluate_checkpoints(
         report["operating_points_transferred"] = (
             metrics.transferred_operating_points(
                 (tune_grades >= 2).astype(np.float64), to_binary(tune_probs),
-                (grades_by[split] >= 2).astype(np.float64), to_binary(probs),
+                (grades_by["eval"] >= 2).astype(np.float64), to_binary(probs),
                 cfg.eval.operating_specificities,
+                bootstrap_samples=bootstrap,
             )
         )
         report["threshold_split"] = threshold_split
+        if threshold_data_dir:
+            report["threshold_data_dir"] = threshold_data_dir
     report["split"] = split
     report["n_models"] = len(ckpt_dirs)
     return report

@@ -27,6 +27,16 @@ def member_dir(checkpoint_dir: str, member: int) -> str:
     return os.path.join(checkpoint_dir, f"member_{member:02d}")
 
 
+def discover_member_dirs(root: str) -> list[str]:
+    """Ensemble discovery for the CLIs (evaluate.py/predict.py): the
+    member_NN subdirs written by member_dir, else the root itself as a
+    single model. Lives here so the layout convention has one home."""
+    import glob
+
+    members = sorted(glob.glob(os.path.join(root, "member_*")))
+    return members or [root]
+
+
 class Checkpointer:
     """Best-by-val-AUC retention PLUS an unconditional latest checkpoint.
 

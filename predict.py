@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Predict referable-DR probability for raw fundus photographs.
+
+Completes the user-facing surface around the reference's train/evaluate
+pair (SURVEY.md §1): point at a trained checkpoint (or an ensemble root)
+and at image files/directories, and get one JSON line per image —
+  {"image": path, "prob": P(referable), "referable": bool, ...}
+— produced by the SAME offline fundus normalization the preprocessing
+scripts apply (preprocess/fundus.py) and the SAME jit eval step /
+ensemble averaging evaluate.py uses, so a prediction here is exactly
+what the eval metrics were computed over.
+
+Examples:
+  python predict.py --checkpoint_dir=/ckpt/run1 --images photo.jpeg
+  python predict.py --config=ensemble10 --checkpoint_dir=/ckpt/ens \
+      --images /data/clinic_batch/ --set eval.tta=true
+  python predict.py ... --threshold=0.2327   # from an evaluate.py report
+
+The decision threshold is NOT hardcoded: pass the operating threshold
+chosen by evaluate.py (e.g. at specificity 0.87/0.98, BASELINE.json:8);
+without --threshold only probabilities are emitted.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+from absl import app, flags
+
+_CONFIG = flags.DEFINE_string("config", "eyepacs_binary", "preset name")
+_SET = flags.DEFINE_multi_string("set", [], "config overrides")
+_CKPT = flags.DEFINE_string("checkpoint_dir", "", "checkpoint dir (or ensemble root)")
+_ENSEMBLE = flags.DEFINE_multi_string("ensemble_dir", [], "explicit member dirs")
+_IMAGES = flags.DEFINE_multi_string(
+    "images", [], "image file, directory, or glob (repeatable)"
+)
+_THRESHOLD = flags.DEFINE_float(
+    "threshold", -1.0,
+    "decision threshold from an evaluate.py operating point; <0 emits "
+    "probabilities only",
+)
+_DEVICE = flags.DEFINE_enum("device", "tpu", ["tpu", "cpu"], "backend gate")
+_BATCH = flags.DEFINE_integer("batch_size", 8, "prediction batch size")
+_BEN_GRAHAM = flags.DEFINE_boolean(
+    "ben_graham", False,
+    "MUST match the preprocessing of the training TFRecords: apply the "
+    "same subtract-local-average enhancement preprocess_* --ben_graham "
+    "used, or the model sees a shifted input distribution",
+)
+
+_EXTS = (".jpg", ".jpeg", ".png", ".tif", ".tiff", ".bmp")
+
+
+def _expand(patterns: list[str]) -> list[str]:
+    paths: list[str] = []
+    for pat in patterns:
+        if os.path.isdir(pat):
+            paths.extend(
+                p for p in sorted(glob.glob(os.path.join(pat, "*")))
+                if p.lower().endswith(_EXTS)
+            )
+        elif any(ch in pat for ch in "*?["):
+            paths.extend(sorted(glob.glob(pat)))
+        elif os.path.exists(pat):
+            paths.append(pat)
+        else:
+            raise FileNotFoundError(pat)
+    if not paths:
+        raise FileNotFoundError(f"no images matched {patterns}")
+    return paths
+
+
+def main(argv):
+    del argv
+    if _DEVICE.value == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import cv2
+    import numpy as np
+
+    from jama16_retina_tpu import configs, models, train_lib, trainer
+    from jama16_retina_tpu.eval import metrics
+    from jama16_retina_tpu.preprocess import fundus
+
+    cfg = configs.get_config(_CONFIG.value)
+    if _SET.value:
+        cfg = configs.override(cfg, _SET.value)
+    from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+    dirs = list(_ENSEMBLE.value)
+    if not dirs:
+        if not _CKPT.value:
+            raise app.UsageError("--checkpoint_dir or --ensemble_dir required")
+        dirs = ckpt_lib.discover_member_dirs(_CKPT.value)
+    paths = _expand(list(_IMAGES.value))
+
+    size = cfg.model.image_size
+    normed, kept, skipped = [], [], []
+    for p in paths:
+        bgr = cv2.imread(p, cv2.IMREAD_COLOR)
+        if bgr is None:
+            skipped.append((p, "unreadable"))
+            continue
+        try:
+            normed.append(
+                fundus.resize_and_center_fundus(
+                    bgr[..., ::-1], diameter=size,
+                    ben_graham=_BEN_GRAHAM.value,
+                )
+            )
+            kept.append(p)
+        except fundus.FundusNotFound as e:
+            skipped.append((p, f"no fundus found: {e}"))
+    for p, why in skipped:
+        print(json.dumps({"image": p, "error": why}))
+    if not kept:
+        sys.exit(1)
+
+    import jax
+
+    model = models.build(cfg.model)
+    eval_step = train_lib.make_eval_step(cfg, model)
+    prob_list = []
+    for d in dirs:
+        state = trainer.restore_for_eval(cfg, model, d)
+        probs = []
+        # Pad to a fixed batch so jit compiles once per run.
+        n = len(kept)
+        for i in range(0, n, _BATCH.value):
+            block = normed[i:i + _BATCH.value]
+            pad = _BATCH.value - len(block)
+            batch = np.stack(block + [np.zeros_like(normed[0])] * pad)
+            out = np.asarray(eval_step(state, {"image": batch}))
+            probs.append(out[:len(block)])
+        prob_list.append(np.concatenate(probs))
+    probs = metrics.ensemble_average(prob_list)
+
+    for p, pr in zip(kept, probs):
+        if cfg.model.head != "binary":
+            pr5 = np.asarray(pr)
+            referable = float(metrics.referable_probs_from_multiclass(pr5))
+            row = {
+                "image": p,
+                "prob": referable,
+                "grade_probs": [round(float(x), 6) for x in pr5],
+                "predicted_grade": int(np.argmax(pr5)),
+            }
+            score = referable
+        else:
+            score = float(pr)
+            row = {"image": p, "prob": round(score, 6)}
+        if _THRESHOLD.value >= 0:
+            row["referable"] = bool(score >= _THRESHOLD.value)
+            row["threshold"] = _THRESHOLD.value
+        row["n_models"] = len(dirs)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    app.run(main)

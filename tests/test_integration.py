@@ -117,8 +117,32 @@ def test_evaluate_checkpoints_threshold_transfer_and_ci(fitted, smoke_cfg, data_
         # (they may coincide numerically only by accident; just check the
         # transferred rows carry a threshold and full confusion).
         assert 0.0 <= r["threshold"] <= 1.0 or np.isinf(r["threshold"])
+        # the protocol's headline rows carry the uncertainty too
+        assert r["sensitivity_ci95"][0] <= r["sensitivity"] <= r["sensitivity_ci95"][1]
+        assert r["specificity_ci95"][0] <= r["specificity"] <= r["specificity_ci95"][1]
     lo, hi = report["auc_ci95"]
     assert lo <= report["auc"] <= hi
+
+
+def test_evaluate_checkpoints_cross_dataset_thresholds(
+    fitted, smoke_cfg, data_dir, tmp_path
+):
+    """The actual JAMA protocol shape: tuning split in ANOTHER dataset
+    dir (EyePACS val -> Messidor-2 test). Same split name on a different
+    dir must pass the self-tuning guard."""
+    other = str(tmp_path / "tune_ds")
+    tfrecord.write_synthetic_split(other, "test", 32, 64, 2, seed=9)
+    workdir, _ = fitted
+    report = trainer.evaluate_checkpoints(
+        smoke_cfg, data_dir, [workdir],
+        threshold_split="test", threshold_data_dir=other,
+    )
+    assert report["threshold_data_dir"] == other
+    assert len(report["operating_points_transferred"]) == 2
+    with pytest.raises(ValueError, match="eval set itself"):
+        trainer.evaluate_checkpoints(
+            smoke_cfg, data_dir, [workdir], threshold_split="test"
+        )
 
 
 def test_resume_continues_from_checkpoint(smoke_cfg, data_dir, tmp_path):

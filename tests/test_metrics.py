@@ -133,12 +133,36 @@ def test_bootstrap_ci_contains_point_estimate_and_is_deterministic():
     assert lo <= skm.roc_auc_score(labels, scores) <= hi
 
 
-def test_bootstrap_ci_rejects_tiny_one_class_sets():
-    labels = np.array([1.0, 1.0, 0.0])
+def test_bootstrap_ci_rejects_degenerate_sets_and_small_n_works():
+    # One-class input: every resample is invalid -> hard error.
+    labels = np.array([1.0, 1.0, 1.0])
     scores = np.array([0.9, 0.8, 0.1])
     with pytest.raises(ValueError, match="bootstrap"):
-        # nearly every 3-element resample is one-class
         metrics.bootstrap_ci(labels, scores, metrics.roc_auc, 120, seed=0)
+    # Small n_samples must WORK on a healthy set (the floor is relative,
+    # not a hard 100 — evaluate.py --bootstrap=50 is legal).
+    rng = np.random.default_rng(0)
+    l = rng.integers(0, 2, 200).astype(float)
+    s = np.clip(l * 0.4 + rng.normal(0.3, 0.25, 200), 0, 1)
+    lo, hi = metrics.bootstrap_ci(l, s, metrics.roc_auc, 50, seed=1)
+    assert 0.0 <= lo <= hi <= 1.0
+
+
+def test_bootstrap_ci_dict_statistic_single_pass():
+    rng = np.random.default_rng(2)
+    l = rng.integers(0, 2, 300).astype(float)
+    s = np.clip(l * 0.5 + rng.normal(0.25, 0.2, 300), 0, 1)
+    cis = metrics.bootstrap_ci(
+        l, s,
+        lambda a, b: {
+            "sens": metrics.confusion_at_threshold(a, b, 0.5)["sensitivity"],
+            "spec": metrics.confusion_at_threshold(a, b, 0.5)["specificity"],
+        },
+        300, seed=4,
+    )
+    assert set(cis) == {"sens", "spec"}
+    for lo, hi in cis.values():
+        assert 0.0 <= lo <= hi <= 1.0
 
 
 def test_transferred_operating_points_use_tune_thresholds():

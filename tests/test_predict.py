@@ -1,0 +1,88 @@
+"""predict.py CLI: raw image files -> fundus-normalized -> checkpointed
+model -> per-image JSON rows (the inference surface around the reference's
+train/evaluate pair). Runs as a subprocess because predict.py is a CLI."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.data import synthetic
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    root = tmp_path_factory.mktemp("predict")
+    # A checkpoint of the smoke model (tiny_cnn @64px, random init is fine
+    # — predict.py's contract is plumbing, not accuracy).
+    cfg = override(
+        get_config("smoke"),
+        ["model.image_size=64", "data.batch_size=8", "eval.batch_size=8"],
+    )
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    ckdir = str(root / "ckpt")
+    ck = ckpt_lib.Checkpointer(ckdir)
+    ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+    ck.wait()
+    ck.close()
+    # Raw photograph files: synthetic fundus rendered larger than the
+    # model size and saved as JPEG, so predict.py must find the circle,
+    # rescale, and center — the real preprocessing path.
+    import cv2
+
+    imgdir = root / "imgs"
+    imgdir.mkdir()
+    for i in range(3):
+        img = synthetic.render_fundus(
+            np.random.default_rng(i), i % 5, synthetic.SynthConfig(image_size=96)
+        )
+        cv2.imwrite(str(imgdir / f"eye_{i}.jpeg"), img[..., ::-1])
+    # One unreadable file: must be reported as an error row, not crash.
+    (imgdir / "junk.jpeg").write_bytes(b"not a jpeg")
+    return cfg, ckdir, str(imgdir)
+
+
+def run_predict(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "predict.py"), *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+    )
+
+
+@pytest.mark.slow
+def test_predict_cli_emits_json_rows(setup):
+    _, ckdir, imgdir = setup
+    res = run_predict([
+        "--config=smoke", "--set", "model.image_size=64",
+        f"--checkpoint_dir={ckdir}", f"--images={imgdir}",
+        "--device=cpu", "--threshold=0.5", "--batch_size=2",
+    ])
+    assert res.returncode == 0, res.stderr[-2000:]
+    rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
+    errors = [r for r in rows if "error" in r]
+    preds = [r for r in rows if "prob" in r]
+    assert len(errors) == 1 and "junk" in errors[0]["image"]
+    assert len(preds) == 3
+    for r in preds:
+        assert 0.0 <= r["prob"] <= 1.0
+        assert r["referable"] == (r["prob"] >= 0.5)
+        assert r["n_models"] == 1
+
+
+@pytest.mark.slow
+def test_predict_cli_requires_checkpoint(setup):
+    _, _, imgdir = setup
+    res = run_predict(["--config=smoke", f"--images={imgdir}", "--device=cpu"])
+    assert res.returncode != 0
+    assert "checkpoint_dir" in res.stderr
