@@ -101,6 +101,11 @@ class TrainConfig:
     # loss-scale epsilon for label smoothing on the multi head
     label_smoothing: float = 0.0
     gradient_clip_norm: float = 0.0  # 0 disables
+    # Polyak/EMA weight averaging (0 disables): eval and checkpoints use
+    # the shadow params when enabled — a standard AUC lever for inception
+    # training toward the >=0.97 target (SURVEY.md §6 note). Typical
+    # values 0.999-0.9999. Flax path only (fit_tf rejects it).
+    ema_decay: float = 0.0
     # Number of independently seeded ensemble members the train driver
     # produces (reference trains k=10, BASELINE.json:10). 1 = single model.
     ensemble_size: int = 1

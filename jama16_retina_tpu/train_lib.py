@@ -43,6 +43,11 @@ class TrainState(flax.struct.PyTreeNode):
     params: Any
     batch_stats: Any
     opt_state: Any
+    # Polyak/EMA shadow of params (None when train.ema_decay == 0): a
+    # quality lever toward the AUC target (SURVEY.md §6 note) — eval and
+    # checkpoints carry it; eval prefers it when present. None is an
+    # empty pytree subtree, so the off case costs nothing anywhere.
+    ema_params: Any = None
 
 
 def make_schedule(tc: TrainConfig) -> optax.Schedule:
@@ -113,6 +118,11 @@ def create_state(
         params=variables["params"],
         batch_stats=variables["batch_stats"],
         opt_state=tx.init(variables["params"]),
+        # EMA shadow starts AT the init params (no debias term needed).
+        ema_params=(
+            jax.tree.map(jnp.copy, variables["params"])
+            if cfg.train.ema_decay > 0 else None
+        ),
     )
     return state, tx
 
@@ -184,13 +194,23 @@ def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
     return loss, logits, new_stats, grads
 
 
-def _apply_update(state: TrainState, grads, new_stats, tx) -> TrainState:
+def _apply_update(
+    state: TrainState, grads, new_stats, tx, ema_decay: float = 0.0
+) -> TrainState:
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    ema = state.ema_params
+    if ema is not None and ema_decay > 0:
+        ema = jax.tree.map(
+            lambda e, p: e * ema_decay + p * (1.0 - ema_decay),
+            ema, new_params,
+        )
     return TrainState(
         step=state.step + 1,
-        params=optax.apply_updates(state.params, updates),
+        params=new_params,
         batch_stats=new_stats,
         opt_state=new_opt,
+        ema_params=ema,
     )
 
 
@@ -211,7 +231,10 @@ def make_train_step(
         loss, logits, new_stats, grads = _step_impl(
             state, batch, base_key, model, cfg
         )
-        return _apply_update(state, grads, new_stats, tx), {"loss": loss}
+        new_state = _apply_update(
+            state, grads, new_stats, tx, cfg.train.ema_decay
+        )
+        return new_state, {"loss": loss}
 
     donate_argnums = (0,) if donate else ()
     if mesh is None:
@@ -240,7 +263,10 @@ def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):
         )
         grads = jax.lax.pmean(grads, axis)
         loss = jax.lax.pmean(loss, axis)
-        return _apply_update(state, grads, new_stats, tx), {"loss": loss}
+        new_state = _apply_update(
+            state, grads, new_stats, tx, cfg.train.ema_decay
+        )
+        return new_state, {"loss": loss}
 
     # state/batch are per-device stacked; the PRNG key is broadcast.
     return jax.pmap(step, axis_name=axis, in_axes=(0, 0, None))
@@ -256,7 +282,13 @@ def make_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
 
     def step(state: TrainState, batch: dict):
         images = augment_lib.normalize(batch["image"])
-        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        # EMA shadow params, when carried, are what the paper-quality
+        # model IS — eval always prefers them (train keeps optimizing
+        # the raw params).
+        eval_params = (
+            state.params if state.ema_params is None else state.ema_params
+        )
+        variables = {"params": eval_params, "batch_stats": state.batch_stats}
 
         def forward(x):
             logits, _ = model.apply(variables, x, train=False)

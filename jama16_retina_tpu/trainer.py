@@ -219,6 +219,17 @@ def fit(
 
     start_step = 0
     if cfg.train.resume and ckpt.latest_step is not None:
+        # Resume must continue the SAME optimization — an EMA-presence
+        # mismatch means the config changed under the run; fail loudly
+        # rather than silently drop/invent the shadow mid-training.
+        has_ema = ckpt.saved_with_ema(ckpt.latest_step)
+        if has_ema != (cfg.train.ema_decay > 0):
+            raise ValueError(
+                f"checkpoint in {workdir} was trained with ema "
+                f"{'on' if has_ema else 'off'} but this run sets "
+                f"train.ema_decay={cfg.train.ema_decay} — resume with a "
+                "matching config"
+            )
         state = ckpt.restore(ckpt_lib.abstract_like(state), ckpt.latest_step)
         state = jax.device_put(state, mesh_lib.replicated(mesh))
         start_step = int(jax.device_get(state.step))
@@ -357,6 +368,11 @@ def fit_tf(
 
     from jama16_retina_tpu.models import tf_backend, transplant
 
+    if cfg.train.ema_decay > 0:
+        raise ValueError(
+            "train.ema_decay is a flax-path feature; the legacy tf "
+            "backend has no EMA shadow (see TrainConfig.ema_decay)"
+        )
     seed = cfg.train.seed if seed is None else seed
     seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
     tf.keras.utils.set_random_seed(seed)
@@ -415,6 +431,11 @@ def fit_tf(
 
     start_step = 0
     if cfg.train.resume and ckpt.latest_step is not None:
+        if ckpt.saved_with_ema(ckpt.latest_step):
+            raise ValueError(
+                f"checkpoint in {workdir} carries an EMA shadow; the tf "
+                "backend cannot continue that training (ema is flax-only)"
+            )
         restored = ckpt.restore(
             ckpt_lib.abstract_like(state0), ckpt.latest_step
         )
@@ -486,10 +507,24 @@ def fit_tf(
 def restore_for_eval(
     cfg: ExperimentConfig, model, ckpt_dir: str, mesh=None
 ) -> train_lib.TrainState:
-    """Restore a member's best checkpoint (reference evaluate.py restore)."""
+    """Restore a member's best checkpoint (reference evaluate.py restore).
+
+    The abstract tree adapts to whether the CHECKPOINT carries an EMA
+    shadow (orbax tree metadata), not to the eval config — so a model
+    trained with --set train.ema_decay=0.999 evaluates correctly under
+    any preset without repeating the training hyperparameter.
+    """
     state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    abstract = ckpt_lib.abstract_like(jax.device_get(state))
     ckpt = ckpt_lib.Checkpointer(os.path.abspath(ckpt_dir))
-    restored = ckpt.restore(ckpt_lib.abstract_like(jax.device_get(state)))
+    if ckpt.saved_with_ema():
+        if abstract.ema_params is None:
+            abstract = abstract.replace(
+                ema_params=jax.tree.map(lambda x: x, abstract.params)
+            )
+    elif abstract.ema_params is not None:
+        abstract = abstract.replace(ema_params=None)
+    restored = ckpt.restore(abstract)
     ckpt.close()
     if mesh is not None:
         restored = jax.device_put(restored, mesh_lib.replicated(mesh))
@@ -564,8 +599,12 @@ def evaluate_checkpoints(
     for d in ckpt_dirs:
         state = restore_for_eval(cfg, model, d, mesh)
         if backend == "tf":
+            # Same preference as the jit eval step: the EMA shadow is
+            # the model of record when it was trained with one.
             tf_backend.load_flax_state(
-                keras_model, state.params, state.batch_stats
+                keras_model,
+                state.params if state.ema_params is None else state.ema_params,
+                state.batch_stats,
             )
         for key, from_dir, s in passes:
             g, p = member_predict(state, from_dir, s)

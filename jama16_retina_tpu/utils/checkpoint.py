@@ -11,7 +11,6 @@ save time. State saved = params + BN stats + optimizer state + step
 from __future__ import annotations
 
 import os
-from typing import Any
 
 import jax
 import numpy as np
@@ -92,6 +91,31 @@ class Checkpointer:
     def wait(self) -> None:
         self._best.wait_until_finished()
         self._latest.wait_until_finished()
+
+    def saved_with_ema(self, step: int | None = None) -> bool:
+        """Whether the checkpoint (default: the one restore() would pick)
+        carries an EMA shadow — read from orbax's saved tree metadata,
+        NOT from any config, so eval can adapt its abstract tree to what
+        the training run actually wrote (train.ema_decay is a train-time
+        choice the eval config cannot be trusted to repeat)."""
+        import json
+
+        if step is None:
+            step = self.best_step if self.best_step is not None else self.latest_step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._best.directory}")
+        mngr = self._best if step in self._best.all_steps() else self._latest
+        # manager.item_metadata() returns None on a freshly opened manager
+        # (handlers register only after a save/restore call), so read the
+        # step's tree metadata from disk: leaf keys nested under
+        # ('ema_params', ...) exist iff a shadow was saved — an ema-less
+        # state stores the single placeholder key ('ema_params',).
+        meta_path = os.path.join(
+            str(mngr.directory), str(step), "default", "_METADATA"
+        )
+        with open(meta_path) as f:
+            tree = json.load(f)["tree_metadata"]
+        return any(k.startswith("('ema_params', ") for k in tree)
 
     @property
     def best_step(self) -> int | None:

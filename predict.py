@@ -55,21 +55,25 @@ _EXTS = (".jpg", ".jpeg", ".png", ".tif", ".tiff", ".bmp")
 
 
 def _expand(patterns: list[str]) -> list[str]:
+    """Every pattern must contribute at least one image — a glob or
+    directory that matches nothing is an error, not a silent skip
+    (missing predictions in a screening tool must be loud)."""
     paths: list[str] = []
     for pat in patterns:
         if os.path.isdir(pat):
-            paths.extend(
+            matched = [
                 p for p in sorted(glob.glob(os.path.join(pat, "*")))
                 if p.lower().endswith(_EXTS)
-            )
+            ]
         elif any(ch in pat for ch in "*?["):
-            paths.extend(sorted(glob.glob(pat)))
+            matched = sorted(glob.glob(pat))
         elif os.path.exists(pat):
-            paths.append(pat)
+            matched = [pat]
         else:
-            raise FileNotFoundError(pat)
-    if not paths:
-        raise FileNotFoundError(f"no images matched {patterns}")
+            matched = []
+        if not matched:
+            raise FileNotFoundError(f"--images pattern matched nothing: {pat}")
+        paths.extend(matched)
     return paths
 
 
@@ -125,18 +129,21 @@ def main(argv):
 
     model = models.build(cfg.model)
     eval_step = train_lib.make_eval_step(cfg, model)
+    # Padded fixed-size batches built ONCE (jit compiles once per run;
+    # every ensemble member scores the same batches, only state differs).
+    batches, block_lens = [], []
+    for i in range(0, len(kept), _BATCH.value):
+        block = normed[i:i + _BATCH.value]
+        pad = _BATCH.value - len(block)
+        batches.append(np.stack(block + [np.zeros_like(normed[0])] * pad))
+        block_lens.append(len(block))
     prob_list = []
     for d in dirs:
         state = trainer.restore_for_eval(cfg, model, d)
-        probs = []
-        # Pad to a fixed batch so jit compiles once per run.
-        n = len(kept)
-        for i in range(0, n, _BATCH.value):
-            block = normed[i:i + _BATCH.value]
-            pad = _BATCH.value - len(block)
-            batch = np.stack(block + [np.zeros_like(normed[0])] * pad)
-            out = np.asarray(eval_step(state, {"image": batch}))
-            probs.append(out[:len(block)])
+        probs = [
+            np.asarray(eval_step(state, {"image": b}))[:n]
+            for b, n in zip(batches, block_lens)
+        ]
         prob_list.append(np.concatenate(probs))
     probs = metrics.ensemble_average(prob_list)
 

@@ -2,7 +2,6 @@
 fit() -> checkpoint round-trip -> evaluate with operating points; plus the
 k=2 ensemble path. Runs through the real compiler on 8 fake CPU devices."""
 
-import dataclasses
 import os
 
 import jax
@@ -170,6 +169,56 @@ def test_ensemble_k2_beats_or_matches_members(smoke_cfg, data_dir, tmp_path):
     assert ens_report["n_models"] == 2
     # Ensemble-averaged probs produce a valid report; AUC sane.
     assert 0.3 <= ens_report["auc"] <= 1.0
+
+
+def test_fit_with_ema_checkpoints_shadow_and_evaluates(
+    smoke_cfg, data_dir, tmp_path
+):
+    """train.ema_decay end to end: the saved state carries the shadow,
+    restore keeps it, evaluate scores with it, and fit_tf rejects it."""
+    cfg = override(
+        smoke_cfg,
+        ["train.ema_decay=0.95", "train.steps=20", "train.eval_every=10"],
+    )
+    workdir = str(tmp_path / "ema_run")
+    res = trainer.fit(cfg, data_dir, workdir, seed=0)
+    assert res["best_auc"] is not None
+
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    ckpt = ckpt_lib.Checkpointer(workdir)
+    restored = ckpt.restore(ckpt_lib.abstract_like(jax.device_get(state)))
+    ckpt.close()
+    assert restored.ema_params is not None
+    # Shadow differs from raw params (training moved them apart) ...
+    diffs = [
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(
+            jax.tree.leaves(restored.params),
+            jax.tree.leaves(restored.ema_params),
+        )
+    ]
+    assert max(diffs) > 0
+    # ... and evaluation runs through the shadow without structure errors.
+    report = trainer.evaluate_checkpoints(cfg, data_dir, [workdir])
+    assert 0.0 <= report["auc"] <= 1.0
+
+    with pytest.raises(ValueError, match="ema_decay"):
+        trainer.fit_tf(cfg, data_dir, str(tmp_path / "ema_tf"), seed=0)
+
+    # THE operational case: evaluating an EMA-trained checkpoint under a
+    # preset that never mentions ema (restore adapts to the checkpoint's
+    # saved structure, not the eval config).
+    report_default_cfg = trainer.evaluate_checkpoints(
+        smoke_cfg, data_dir, [workdir]
+    )
+    assert 0.0 <= report_default_cfg["auc"] <= 1.0
+    # And resuming with a mismatched ema config fails loudly.
+    with pytest.raises(ValueError, match="matching config"):
+        trainer.fit(
+            override(smoke_cfg, ["train.resume=true", "train.steps=25"]),
+            data_dir, workdir, seed=0,
+        )
 
 
 def test_early_stopping_triggers(smoke_cfg, data_dir, tmp_path):

@@ -56,7 +56,7 @@ def run_predict(args):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "predict.py"), *args],
-        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
     )
 
 
@@ -68,12 +68,13 @@ def test_predict_cli_emits_json_rows(setup):
         f"--checkpoint_dir={ckdir}", f"--images={imgdir}",
         "--device=cpu", "--threshold=0.5", "--batch_size=2",
     ])
-    assert res.returncode == 0, res.stderr[-2000:]
+    detail = f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-2000:]}"
+    assert res.returncode == 0, detail
     rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
     errors = [r for r in rows if "error" in r]
     preds = [r for r in rows if "prob" in r]
-    assert len(errors) == 1 and "junk" in errors[0]["image"]
-    assert len(preds) == 3
+    assert len(errors) == 1 and "junk" in errors[0]["image"], detail
+    assert len(preds) == 3, detail
     for r in preds:
         assert 0.0 <= r["prob"] <= 1.0
         assert r["referable"] == (r["prob"] >= 0.5)

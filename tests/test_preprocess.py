@@ -8,7 +8,7 @@ import os
 import numpy as np
 import pytest
 
-from jama16_retina_tpu.data import pipeline, synthetic, tfrecord
+from jama16_retina_tpu.data import pipeline, synthetic
 from jama16_retina_tpu.configs import DataConfig
 from jama16_retina_tpu.preprocess import (
     FundusNotFound,

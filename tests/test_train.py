@@ -20,7 +20,6 @@ from jama16_retina_tpu.configs import (
     ExperimentConfig,
     ModelConfig,
     TrainConfig,
-    get_config,
 )
 from jama16_retina_tpu.data import synthetic
 from jama16_retina_tpu.parallel import mesh as mesh_lib
@@ -208,6 +207,56 @@ def test_eval_step_binary_probs_in_range():
     probs = np.asarray(eval_step(state, jax.device_put(batch)))
     assert probs.shape == (16,)
     assert probs.min() >= 0.0 and probs.max() <= 1.0
+
+
+def test_ema_shadow_trails_params_and_eval_uses_it():
+    """train.ema_decay: the shadow moves toward the raw params at rate
+    (1-decay) per step, checkpoints carry it, and the eval step scores
+    with the shadow, not the raw params."""
+    cfg = small_cfg(ema_decay=0.9)
+    mesh = mesh_lib.make_mesh()
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    assert state.ema_params is not None
+    p0 = jax.device_get(state.params)
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    batch = mesh_lib.shard_batch(make_batch(cfg), mesh)
+    for _ in range(3):
+        state, _ = step(state, batch, jax.random.key(1))
+    state = jax.device_get(state)
+
+    # EMA lies strictly between init and current params for moved leaves.
+    leaf = jax.tree.leaves(state.params)[0]
+    leaf0 = jax.tree.leaves(p0)[0]
+    ema = jax.tree.leaves(state.ema_params)[0]
+    moved = np.abs(np.asarray(leaf) - np.asarray(leaf0)) > 1e-7
+    assert moved.any()
+    dist_ema = np.abs(np.asarray(ema) - np.asarray(leaf0))
+    dist_par = np.abs(np.asarray(leaf) - np.asarray(leaf0))
+    assert (dist_ema[moved] < dist_par[moved]).mean() > 0.9
+
+    # Eval scores with the shadow: swapping garbage into params must not
+    # change the output; swapping garbage into ema_params must.
+    eval_step = train_lib.make_eval_step(cfg, model)
+    images = make_batch(cfg)["image"]
+    base = np.asarray(eval_step(state, {"image": images}))
+    garbage = jax.tree.map(lambda x: x * 0.0, state.params)
+    same = np.asarray(
+        eval_step(state.replace(params=garbage), {"image": images})
+    )
+    np.testing.assert_array_equal(base, same)
+    changed = np.asarray(
+        eval_step(state.replace(ema_params=garbage), {"image": images})
+    )
+    assert not np.allclose(base, changed)
+
+
+def test_ema_disabled_state_has_no_shadow():
+    cfg = small_cfg()
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    assert state.ema_params is None
 
 
 def test_tta_eval_is_mean_of_flip_views():
