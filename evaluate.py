@@ -47,6 +47,11 @@ _BOOTSTRAP = flags.DEFINE_integer(
     "number of bootstrap resamples for 95% CIs on AUC/sensitivity "
     "(0 = off; the replication paper used 2000)",
 )
+_SAVE_PROBS = flags.DEFINE_string(
+    "save_probs", "",
+    "write per-image ensemble-averaged probabilities (name, grade, "
+    "prob[, per-class]) to this CSV for error analysis / recalibration",
+)
 _DEVICE = flags.DEFINE_enum(
     "device", "tpu", ["tpu", "cpu", "tf"],
     "backend gate (BASELINE.json:5): tpu/cpu run the Flax model under jit "
@@ -98,6 +103,7 @@ def main(argv):
         threshold_split=_THRESHOLD_SPLIT.value or None,
         threshold_data_dir=_THRESHOLD_DATA_DIR.value or None,
         bootstrap=_BOOTSTRAP.value,
+        save_probs=_SAVE_PROBS.value or None,
     )
     print(json.dumps(report, indent=2))
 

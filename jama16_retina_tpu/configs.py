@@ -113,6 +113,9 @@ class TrainConfig:
     # this many steps (starting at step 10) into <workdir>/profile —
     # TensorBoard/Perfetto-viewable XLA op + ICI collective timeline.
     profile_steps: int = 0
+    # Mirror train/eval scalars into <workdir>/tb TensorBoard events
+    # (JSONL remains the system of record; SURVEY.md §5.5).
+    tensorboard: bool = False
     # Debug mode (SURVEY.md §5.2): enable jax_debug_nans so the first
     # non-finite value aborts with the failing primitive's stack.
     debug: bool = False

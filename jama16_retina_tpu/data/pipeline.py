@@ -57,7 +57,7 @@ def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
     parse = tfrecord.parse_fn()
 
     def to_features(serialized):
-        image, grade, _ = parse(serialized)
+        image, grade, name = parse(serialized)
         # decode_jpeg's static shape is unknown inside tf.data, so the
         # size check must be a dynamic tf.cond — a Python `if` on
         # image.shape would always take the resize branch, paying a
@@ -74,7 +74,7 @@ def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
             ),
         )
         image = tf.ensure_shape(image, (image_size, image_size, 3))
-        return image, grade
+        return image, grade, name
 
     ds = ds.map(to_features, num_parallel_calls=tf.data.AUTOTUNE)
     return ds
@@ -134,6 +134,9 @@ def train_batches(
     ds = _build_tf_dataset(
         paths, image_size, True, cfg, file_seed, record_shard=record_shard
     )
+    # Train drops the name early: strings cannot go to device, and the
+    # step reads only image/grade.
+    ds = ds.map(lambda image, grade, name: (image, grade))
     ds = ds.shuffle(cfg.shuffle_buffer, seed=shuffle_seed).repeat()
     ds = ds.batch(batch_size, drop_remainder=True)
     if skip_batches:
@@ -188,7 +191,7 @@ def eval_batches(
     paths = tfrecord.list_split(data_dir, split)
     ds = _build_tf_dataset(paths, image_size, False, DataConfig(), seed=0)
     ds = ds.batch(batch_size, drop_remainder=False)
-    for image, grade in ds.as_numpy_iterator():
+    for image, grade, name in ds.as_numpy_iterator():
         n = image.shape[0]
         if n < batch_size:
             pad = batch_size - n
@@ -196,10 +199,14 @@ def eval_batches(
                 [image, np.zeros((pad, *image.shape[1:]), image.dtype)], axis=0
             )
             grade = np.concatenate([grade, np.zeros((pad,), grade.dtype)], axis=0)
+            name = np.concatenate([name, np.full((pad,), b"", name.dtype)], axis=0)
         mask = (np.arange(batch_size) < n).astype(np.float32)
         yield {
             "image": image[p_idx * local:(p_idx + 1) * local],
             "grade": grade,
+            # 'name' is host metadata like grade/mask (global rows) — it
+            # feeds --save_probs per-image exports, never the device.
+            "name": name,
             "mask": mask,
         }
 

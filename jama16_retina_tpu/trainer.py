@@ -35,11 +35,13 @@ def _binary_eval_labels(grades: np.ndarray, head: str) -> np.ndarray:
 
 def _predict_over_split(
     cfg: ExperimentConfig, data_dir: str, split: str, batch_probs_fn
-) -> tuple[np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Shared eval loop for every backend: iterate eval_batches, compute
     per-batch probs via ``batch_probs_fn(batch) -> [B]-or-[B,C] array``,
-    trim padding rows (the mask contract of make_eval_step), concatenate."""
-    grades_all, probs_all = [], []
+    trim padding rows (the mask contract of make_eval_step), concatenate.
+    Returns (grades, probs, names) — names are the per-record ids from
+    the TFRecords (bytes; feed --save_probs exports)."""
+    grades_all, probs_all, names_all = [], [], []
     for batch in pipeline.eval_batches(
         data_dir, split, cfg.eval.batch_size, cfg.model.image_size
     ):
@@ -47,7 +49,12 @@ def _predict_over_split(
         keep = batch["mask"] > 0
         grades_all.append(batch["grade"][keep])
         probs_all.append(probs[keep])
-    return np.concatenate(grades_all), np.concatenate(probs_all)
+        names_all.append(batch["name"][keep])
+    return (
+        np.concatenate(grades_all),
+        np.concatenate(probs_all),
+        np.concatenate(names_all),
+    )
 
 
 def predict_split(
@@ -58,8 +65,8 @@ def predict_split(
     split: str,
     mesh=None,
     eval_step=None,
-) -> tuple[np.ndarray, np.ndarray]:
-    """Run the test pipeline (no augmentation) -> (grades, probs) on host.
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run the test pipeline (no augmentation) -> (grades, probs, names).
 
     Pass a prebuilt ``eval_step`` when calling repeatedly (every val
     interval / every ensemble member) — a fresh ``make_eval_step`` closure
@@ -83,7 +90,7 @@ def predict_split(
 
 def predict_split_tf(
     cfg: ExperimentConfig, keras_model, data_dir: str, split: str
-) -> tuple[np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """predict_split's TF-backend twin: same pipeline.eval_batches
     stream, forward pass on host TF instead of the jit eval step. The
     (grades, probs) contract is identical, so everything downstream —
@@ -199,7 +206,7 @@ def fit(
     if cfg.train.debug:
         jax.config.update("jax_debug_nans", True)
     mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
-    log = RunLog(workdir)
+    log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
     log.write("config", name=cfg.name, seed=seed,
               n_devices=int(np.prod(list(mesh.shape.values()))))
 
@@ -294,7 +301,7 @@ def fit(
                     lambda: predict_split(
                         cfg, model, state, data_dir, "val", mesh,
                         eval_step=eval_step,
-                    ),
+                    )[:2],
                     jax.device_get(state),
                     best_auc, best_step, since_best,
                 )
@@ -376,7 +383,7 @@ def fit_tf(
     seed = cfg.train.seed if seed is None else seed
     seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
     tf.keras.utils.set_random_seed(seed)
-    log = RunLog(workdir)
+    log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
     log.write("config", name=cfg.name, seed=seed, backend="tf")
 
     keras_model = models.build(cfg.model, backend="tf")
@@ -483,7 +490,7 @@ def fit_tf(
             )
             best_auc, best_step, since_best, stop = _eval_and_track(
                 cfg, log, ckpt, step_i + 1,
-                lambda: predict_split_tf(cfg, keras_model, data_dir, "val"),
+                lambda: predict_split_tf(cfg, keras_model, data_dir, "val")[:2],
                 state0.replace(
                     step=np.asarray(step_i + 1, np.int32),
                     params=params, batch_stats=batch_stats,
@@ -509,22 +516,14 @@ def restore_for_eval(
 ) -> train_lib.TrainState:
     """Restore a member's best checkpoint (reference evaluate.py restore).
 
-    The abstract tree adapts to whether the CHECKPOINT carries an EMA
-    shadow (orbax tree metadata), not to the eval config — so a model
-    trained with --set train.ema_decay=0.999 evaluates correctly under
-    any preset without repeating the training hyperparameter.
+    Checkpointer.restore reconciles the abstract tree with whether the
+    CHECKPOINT carries an EMA shadow (orbax tree metadata), not the eval
+    config — so a model trained with --set train.ema_decay=0.999 (or a
+    pre-EMA legacy checkpoint) evaluates correctly under any preset.
     """
     state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
-    abstract = ckpt_lib.abstract_like(jax.device_get(state))
     ckpt = ckpt_lib.Checkpointer(os.path.abspath(ckpt_dir))
-    if ckpt.saved_with_ema():
-        if abstract.ema_params is None:
-            abstract = abstract.replace(
-                ema_params=jax.tree.map(lambda x: x, abstract.params)
-            )
-    elif abstract.ema_params is not None:
-        abstract = abstract.replace(ema_params=None)
-    restored = ckpt.restore(abstract)
+    restored = ckpt.restore(ckpt_lib.abstract_like(jax.device_get(state)))
     ckpt.close()
     if mesh is not None:
         restored = jax.device_put(restored, mesh_lib.replicated(mesh))
@@ -541,6 +540,7 @@ def evaluate_checkpoints(
     threshold_split: str | None = None,
     threshold_data_dir: str | None = None,
     bootstrap: int = 0,
+    save_probs: str | None = None,
 ) -> dict:
     """Single- or multi-checkpoint (ensemble-averaged) evaluation
     (SURVEY.md §3.2; BASELINE.json:10 'averaged logits').
@@ -596,6 +596,7 @@ def evaluate_checkpoints(
         passes.append(("tune", tune_dir, threshold_split))
     prob_lists: dict[str, list] = {k: [] for k, _, _ in passes}
     grades_by: dict[str, np.ndarray] = {}
+    names_by: dict[str, np.ndarray] = {}
     for d in ckpt_dirs:
         state = restore_for_eval(cfg, model, d, mesh)
         if backend == "tf":
@@ -607,10 +608,11 @@ def evaluate_checkpoints(
                 state.batch_stats,
             )
         for key, from_dir, s in passes:
-            g, p = member_predict(state, from_dir, s)
+            g, p, nm = member_predict(state, from_dir, s)
             if key in grades_by and not np.array_equal(g, grades_by[key]):
                 raise RuntimeError("checkpoints saw different eval sets")
             grades_by[key] = g
+            names_by[key] = nm
             prob_lists[key].append(p)
 
     probs = metrics.ensemble_average(prob_lists["eval"])
@@ -639,6 +641,41 @@ def evaluate_checkpoints(
         report["threshold_split"] = threshold_split
         if threshold_data_dir:
             report["threshold_data_dir"] = threshold_data_dir
+    if save_probs:
+        _write_probs_csv(
+            save_probs, names_by["eval"], grades_by["eval"], probs,
+            cfg.model.head,
+        )
+        report["probs_file"] = save_probs
     report["split"] = split
     report["n_models"] = len(ckpt_dirs)
     return report
+
+
+def _write_probs_csv(
+    path: str, names: np.ndarray, grades: np.ndarray, probs: np.ndarray,
+    head: str,
+) -> None:
+    """Per-image ensemble-averaged probabilities as CSV — the raw
+    material for error analysis / external recalibration that the final
+    report's aggregates can't provide. One row per eval example."""
+    import csv
+
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        if head == "binary":
+            w.writerow(["name", "grade", "prob_referable"])
+            for nm, g, p in zip(names, grades, probs):
+                w.writerow([nm.decode(), int(g), f"{float(p):.6f}"])
+        else:
+            n_cls = probs.shape[-1]
+            w.writerow(
+                ["name", "grade", "prob_referable"]
+                + [f"prob_grade_{c}" for c in range(n_cls)]
+            )
+            referable = metrics.referable_probs_from_multiclass(probs)
+            for nm, g, p, r in zip(names, grades, probs, referable):
+                w.writerow(
+                    [nm.decode(), int(g), f"{float(r):.6f}"]
+                    + [f"{float(x):.6f}" for x in p]
+                )

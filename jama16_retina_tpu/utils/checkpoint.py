@@ -15,6 +15,7 @@ import os
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+from absl import logging as absl_logging
 
 from jama16_retina_tpu.train_lib import TrainState
 
@@ -92,30 +93,50 @@ class Checkpointer:
         self._best.wait_until_finished()
         self._latest.wait_until_finished()
 
-    def saved_with_ema(self, step: int | None = None) -> bool:
-        """Whether the checkpoint (default: the one restore() would pick)
-        carries an EMA shadow — read from orbax's saved tree metadata,
-        NOT from any config, so eval can adapt its abstract tree to what
-        the training run actually wrote (train.ema_decay is a train-time
-        choice the eval config cannot be trusted to repeat)."""
+    def _pick(self, step: int | None):
+        """Resolve (manager, step) the way restore() selects them."""
+        if step is not None:
+            mngr = self._best if step in self._best.all_steps() else self._latest
+            return mngr, step
+        if self.best_step is not None:
+            return self._best, self.best_step
+        if self.latest_step is not None:
+            return self._latest, self.latest_step
+        raise FileNotFoundError(f"no checkpoints in {self._best.directory}")
+
+    def _tree_keys(self, mngr, step: int) -> list[str] | None:
+        """Stringified tree keys of the saved state, from the step's
+        on-disk metadata (manager.item_metadata() returns None on freshly
+        opened managers — handlers register only after a save/restore).
+        This reads orbax's internal _METADATA layout; if a future orbax
+        moves it, return None and callers fall back to the config-derived
+        abstract tree (pre-adaptive behavior) instead of breaking every
+        restore."""
         import json
 
-        if step is None:
-            step = self.best_step if self.best_step is not None else self.latest_step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {self._best.directory}")
-        mngr = self._best if step in self._best.all_steps() else self._latest
-        # manager.item_metadata() returns None on a freshly opened manager
-        # (handlers register only after a save/restore call), so read the
-        # step's tree metadata from disk: leaf keys nested under
-        # ('ema_params', ...) exist iff a shadow was saved — an ema-less
-        # state stores the single placeholder key ('ema_params',).
-        meta_path = os.path.join(
-            str(mngr.directory), str(step), "default", "_METADATA"
+        try:
+            meta_path = os.path.join(
+                str(mngr.directory), str(step), "default", "_METADATA"
+            )
+            with open(meta_path) as f:
+                return list(json.load(f)["tree_metadata"])
+        except (OSError, KeyError, ValueError) as e:
+            absl_logging.warning(
+                "could not read checkpoint tree metadata (%s: %s); "
+                "restoring with the config-derived tree", type(e).__name__, e,
+            )
+            return None
+
+    def saved_with_ema(self, step: int | None = None) -> bool:
+        """Whether the checkpoint (default: the one restore() would pick)
+        carries an EMA shadow — read from the saved tree metadata, NOT
+        from any config, so eval can adapt to what the training run
+        actually wrote (train.ema_decay is a train-time choice the eval
+        config cannot be trusted to repeat)."""
+        keys = self._tree_keys(*self._pick(step))
+        return keys is not None and any(
+            k.startswith("('ema_params', ") for k in keys
         )
-        with open(meta_path) as f:
-            tree = json.load(f)["tree_metadata"]
-        return any(k.startswith("('ema_params', ") for k in tree)
 
     @property
     def best_step(self) -> int | None:
@@ -128,19 +149,39 @@ class Checkpointer:
     def restore(self, abstract_state: TrainState, step: int | None = None
                 ) -> TrainState:
         """Restore ``step`` if given (from whichever manager has it),
-        else the best step, else the latest."""
-        if step is not None:
-            mngr = self._best if step in self._best.all_steps() else self._latest
-            return mngr.restore(step, args=ocp.args.StandardRestore(abstract_state))
-        if self.best_step is not None:
-            return self._best.restore(
-                self.best_step, args=ocp.args.StandardRestore(abstract_state)
-            )
-        if self.latest_step is not None:
-            return self._latest.restore(
-                self.latest_step, args=ocp.args.StandardRestore(abstract_state)
-            )
-        raise FileNotFoundError(f"no checkpoints in {self._best.directory}")
+        else the best step, else the latest.
+
+        The abstract tree is reconciled with the CHECKPOINT's saved
+        structure around the optional ``ema_params`` field, so any
+        checkpoint restores under any config:
+          * shadow saved  -> abstract gets a params-shaped shadow slot;
+          * ``None`` saved -> abstract's shadow slot cleared;
+          * field absent (pre-EMA legacy checkpoint) -> restore the four
+            original fields as a dict and rebuild the TrainState —
+            orbax treats present-as-None vs absent as a structure
+            mismatch, so the field cannot simply be nulled.
+        """
+        mngr, step = self._pick(step)
+        keys = self._tree_keys(mngr, step)
+        abstract = abstract_state
+        if keys is not None:
+            if any(k.startswith("('ema_params', ") for k in keys):
+                if abstract.ema_params is None:
+                    abstract = abstract.replace(
+                        ema_params=jax.tree.map(lambda x: x, abstract.params)
+                    )
+            elif "('ema_params',)" in keys:
+                abstract = abstract.replace(ema_params=None)
+            else:  # legacy: saved before the field existed
+                fields = ("step", "params", "batch_stats", "opt_state")
+                restored = mngr.restore(
+                    step,
+                    args=ocp.args.StandardRestore(
+                        {f: getattr(abstract, f) for f in fields}
+                    ),
+                )
+                return TrainState(**restored, ema_params=None)
+        return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
 
     def close(self) -> None:
         self._best.close()

@@ -3,11 +3,15 @@
 Reference: console prints + periodic val AUC. Build: absl console logs
 plus one JSONL file per run — a line per event (train step stats, eval
 reports) — identical shape for every backend/config so runs diff cleanly.
+Optional TensorBoard scalars (``tensorboard=True``) mirror the numeric
+fields of train/eval records into ``<workdir>/tb`` for users of the
+reference's TF-era tooling; the JSONL stays the system of record.
 """
 
 from __future__ import annotations
 
 import json
+import numbers
 import os
 import time
 from typing import IO
@@ -16,20 +20,40 @@ from absl import logging as absl_logging
 
 
 class RunLog:
-    def __init__(self, workdir: str, name: str = "metrics.jsonl"):
+    def __init__(self, workdir: str, name: str = "metrics.jsonl",
+                 tensorboard: bool = False):
         os.makedirs(workdir, exist_ok=True)
         self.path = os.path.join(workdir, name)
         self._fh: IO = open(self.path, "a")
+        self._tb = None
+        if tensorboard:
+            import tensorflow as tf
+
+            self._tb = tf.summary.create_file_writer(
+                os.path.join(workdir, "tb")
+            )
 
     def write(self, kind: str, **fields) -> dict:
         rec = {"kind": kind, "t": round(time.time(), 3), **fields}
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
         absl_logging.info("%s %s", kind, {k: v for k, v in fields.items()})
+        if self._tb is not None and "step" in fields:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                for k, v in fields.items():
+                    if k != "step" and isinstance(v, numbers.Real):
+                        tf.summary.scalar(
+                            f"{kind}/{k}", float(v), step=int(fields["step"])
+                        )
+            self._tb.flush()
         return rec
 
     def close(self) -> None:
         self._fh.close()
+        if self._tb is not None:
+            self._tb.close()
 
 
 def read_jsonl(path: str) -> list[dict]:

@@ -137,6 +137,7 @@ def main(argv):
         pad = _BATCH.value - len(block)
         batches.append(np.stack(block + [np.zeros_like(normed[0])] * pad))
         block_lens.append(len(block))
+    del normed  # the padded batches are the only copy needed from here on
     prob_list = []
     for d in dirs:
         state = trainer.restore_for_eval(cfg, model, d)

@@ -171,6 +171,63 @@ def test_ensemble_k2_beats_or_matches_members(smoke_cfg, data_dir, tmp_path):
     assert 0.3 <= ens_report["auc"] <= 1.0
 
 
+def test_legacy_checkpoint_without_ema_field_restores(
+    smoke_cfg, data_dir, tmp_path
+):
+    """Checkpoints written BEFORE TrainState grew ema_params (the round-2
+    on-disk population) must keep restoring: Checkpointer.restore falls
+    back to a four-field dict restore and rebuilds the state with
+    ema_params=None when the saved tree has no ema key at all."""
+    import orbax.checkpoint as ocp
+
+    model = models.build(smoke_cfg.model)
+    state, _ = train_lib.create_state(smoke_cfg, model, jax.random.key(0))
+    state = jax.device_get(state)
+    legacy = {f: getattr(state, f)
+              for f in ("step", "params", "batch_stats", "opt_state")}
+    legacy["step"] = np.asarray(7, np.int32)
+    workdir = str(tmp_path / "legacy")
+    mngr = ocp.CheckpointManager(
+        os.path.join(workdir, "latest"),
+        options=ocp.CheckpointManagerOptions(max_to_keep=1, create=True),
+    )
+    mngr.save(7, args=ocp.args.StandardSave(legacy))
+    mngr.wait_until_finished()
+    mngr.close()
+
+    ckpt = ckpt_lib.Checkpointer(workdir)
+    assert not ckpt.saved_with_ema()
+    restored = ckpt.restore(ckpt_lib.abstract_like(state))
+    ckpt.close()
+    assert restored.ema_params is None
+    assert int(restored.step) == 7
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_probs_csv_matches_report(fitted, smoke_cfg, data_dir, tmp_path):
+    """--save_probs: one CSV row per eval example, names from the
+    TFRecords, and recomputing AUC from the file reproduces the report."""
+    import csv
+
+    workdir, _ = fitted
+    out = str(tmp_path / "probs.csv")
+    report = trainer.evaluate_checkpoints(
+        smoke_cfg, data_dir, [workdir], save_probs=out
+    )
+    assert report["probs_file"] == out
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == report["n_examples"] == 48
+    assert len({r["name"] for r in rows}) == 48
+    assert all(r["name"] for r in rows)
+    labels = np.array([int(r["grade"]) >= 2 for r in rows], np.float64)
+    probs = np.array([float(r["prob_referable"]) for r in rows])
+    auc = metrics.roc_auc(labels, probs)
+    assert auc == pytest.approx(report["auc"], abs=2e-6)
+
+
 def test_fit_with_ema_checkpoints_shadow_and_evaluates(
     smoke_cfg, data_dir, tmp_path
 ):
