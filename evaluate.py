@@ -47,6 +47,11 @@ _BOOTSTRAP = flags.DEFINE_integer(
     "number of bootstrap resamples for 95% CIs on AUC/sensitivity "
     "(0 = off; the replication paper used 2000)",
 )
+_JIT_CACHE = flags.DEFINE_string(
+    "jit_cache_dir", "",
+    "persistent XLA compilation cache directory (share it with train.py "
+    "to skip the eval-step compile). Empty = off.",
+)
 _SAVE_PROBS = flags.DEFINE_string(
     "save_probs", "",
     "write per-image ensemble-averaged probabilities (name, grade, "
@@ -80,6 +85,9 @@ def main(argv):
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
     mesh_lib.initialize_distributed()
+
+    if _JIT_CACHE.value:
+        mesh_lib.enable_persistent_compilation_cache(_JIT_CACHE.value)
 
     from jama16_retina_tpu import configs, trainer
 

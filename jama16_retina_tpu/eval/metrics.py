@@ -217,6 +217,31 @@ def brier_score(labels: np.ndarray, scores: np.ndarray) -> float:
     return float(np.mean((scores - labels) ** 2))
 
 
+def expected_calibration_error(
+    labels: np.ndarray, scores: np.ndarray, n_bins: int = 15
+) -> float:
+    """Equal-width-bin ECE: sum_b (n_b/N) * |acc_b - conf_b|. Reported
+    next to Brier so miscalibration (which threshold transfer inherits)
+    is visible; recalibrate externally from --save_probs if needed."""
+    labels = np.asarray(labels, dtype=np.float64).ravel()
+    scores = np.asarray(scores, dtype=np.float64).ravel()
+    if labels.size == 0:
+        raise ValueError("expected_calibration_error got empty input")
+    bins = np.clip(
+        (scores * n_bins).astype(np.int64), 0, n_bins - 1
+    )
+    ece = 0.0
+    for b in range(n_bins):
+        sel = bins == b
+        n_b = int(sel.sum())
+        if n_b == 0:
+            continue
+        ece += (n_b / labels.size) * abs(
+            labels[sel].mean() - scores[sel].mean()
+        )
+    return float(ece)
+
+
 def ensemble_average(prob_list: Sequence[np.ndarray]) -> np.ndarray:
     """Averaged per-model probabilities (reference's "averaged logits",
     BASELINE.json:10 — the replication averaged the models' sigmoid
@@ -307,6 +332,7 @@ def evaluation_report(
         report = {}
     report["auc"] = roc_auc(binary_labels, binary_probs)
     report["brier"] = brier_score(binary_labels, binary_probs)
+    report["ece"] = expected_calibration_error(binary_labels, binary_probs)
     report["n_examples"] = int(binary_labels.size)
     # Each row: the ROC-chosen point plus the full confusion at its
     # threshold (reference R2 reports confusion at the operating points).

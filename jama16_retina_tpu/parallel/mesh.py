@@ -50,6 +50,17 @@ def _multihost_env_configured() -> bool:
     return "," in hostnames
 
 
+def enable_persistent_compilation_cache(path: str) -> None:
+    """Point XLA's persistent compilation cache at ``path`` (the CLIs'
+    --jit_cache_dir). One home for the floor overrides so train.py and
+    evaluate.py caches stay shareable: floors are zeroed because even the
+    small eval step recompiles per ensemble member, and on the TPU the
+    train step's ~80s compile is the dominant per-run fixed cost."""
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 def initialize_distributed(force: bool = False) -> bool:
     """Multi-host bring-up (SURVEY.md §3.5). MUST run before any other jax
     API touches a backend — jax.distributed.initialize() after backend

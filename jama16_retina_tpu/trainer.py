@@ -229,8 +229,10 @@ def fit(
         # Resume must continue the SAME optimization — an EMA-presence
         # mismatch means the config changed under the run; fail loudly
         # rather than silently drop/invent the shadow mid-training.
+        # (None = metadata unreadable: skip the guard rather than
+        # misdiagnose an EMA run as ema-off.)
         has_ema = ckpt.saved_with_ema(ckpt.latest_step)
-        if has_ema != (cfg.train.ema_decay > 0):
+        if has_ema is not None and has_ema != (cfg.train.ema_decay > 0):
             raise ValueError(
                 f"checkpoint in {workdir} was trained with ema "
                 f"{'on' if has_ema else 'off'} but this run sets "
@@ -596,7 +598,7 @@ def evaluate_checkpoints(
         passes.append(("tune", tune_dir, threshold_split))
     prob_lists: dict[str, list] = {k: [] for k, _, _ in passes}
     grades_by: dict[str, np.ndarray] = {}
-    names_by: dict[str, np.ndarray] = {}
+    eval_names = None  # identical across members (grade check pins this)
     for d in ckpt_dirs:
         state = restore_for_eval(cfg, model, d, mesh)
         if backend == "tf":
@@ -612,7 +614,8 @@ def evaluate_checkpoints(
             if key in grades_by and not np.array_equal(g, grades_by[key]):
                 raise RuntimeError("checkpoints saw different eval sets")
             grades_by[key] = g
-            names_by[key] = nm
+            if key == "eval":
+                eval_names = nm
             prob_lists[key].append(p)
 
     probs = metrics.ensemble_average(prob_lists["eval"])
@@ -643,7 +646,7 @@ def evaluate_checkpoints(
             report["threshold_data_dir"] = threshold_data_dir
     if save_probs:
         _write_probs_csv(
-            save_probs, names_by["eval"], grades_by["eval"], probs,
+            save_probs, eval_names, grades_by["eval"], probs,
             cfg.model.head,
         )
         report["probs_file"] = save_probs

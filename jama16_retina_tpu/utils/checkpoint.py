@@ -127,16 +127,18 @@ class Checkpointer:
             )
             return None
 
-    def saved_with_ema(self, step: int | None = None) -> bool:
+    def saved_with_ema(self, step: int | None = None) -> bool | None:
         """Whether the checkpoint (default: the one restore() would pick)
         carries an EMA shadow — read from the saved tree metadata, NOT
         from any config, so eval can adapt to what the training run
         actually wrote (train.ema_decay is a train-time choice the eval
-        config cannot be trusted to repeat)."""
+        config cannot be trusted to repeat). Returns None when the
+        metadata is unreadable (unknown ≠ 'no shadow': resume guards must
+        not misdiagnose an EMA run as ema-off)."""
         keys = self._tree_keys(*self._pick(step))
-        return keys is not None and any(
-            k.startswith("('ema_params', ") for k in keys
-        )
+        if keys is None:
+            return None
+        return any(k.startswith("('ema_params', ") for k in keys)
 
     @property
     def best_step(self) -> int | None:

@@ -200,3 +200,21 @@ def test_evaluation_report_with_bootstrap():
     for row in rep["operating_points"]:
         lo, hi = row["sensitivity_ci95"]
         assert 0.0 <= lo <= hi <= 1.0
+
+
+def test_expected_calibration_error():
+    # Perfectly calibrated by construction: P(y=1 | score s) == s for the
+    # two score levels used.
+    labels = np.array([1, 0, 0, 0] * 25 + [1, 1, 1, 0] * 25, np.float64)
+    scores = np.array([0.25] * 100 + [0.75] * 100)
+    assert metrics.expected_calibration_error(labels, scores) < 1e-12
+    # Maximally miscalibrated: confident and always wrong.
+    labels2 = np.array([0.0, 1.0] * 50)
+    scores2 = np.array([0.99, 0.01] * 50)
+    assert metrics.expected_calibration_error(labels2, scores2) > 0.9
+    # Hand-check one two-bin case.
+    l = np.array([1.0, 0.0, 1.0, 1.0])
+    s = np.array([0.1, 0.1, 0.9, 0.9])
+    # bin(0.1): acc 0.5 conf 0.1 -> 0.4 * 2/4 ; bin(0.9): acc 1.0 conf 0.9 -> 0.1 * 2/4
+    expect = 0.5 * 0.4 + 0.5 * 0.1
+    assert metrics.expected_calibration_error(l, s) == pytest.approx(expect)

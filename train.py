@@ -47,6 +47,12 @@ _SYNTHETIC = flags.DEFINE_integer(
     "with this environment)",
 )
 _RESUME = flags.DEFINE_boolean("resume", False, "resume from latest ckpt")
+_JIT_CACHE = flags.DEFINE_string(
+    "jit_cache_dir", "",
+    "persistent XLA compilation cache directory. Cuts the ~80s TPU "
+    "compile from every later run — and from members 2..k of an "
+    "ensemble run, which trace the identical graph. Empty = off.",
+)
 
 
 def main(argv):
@@ -67,6 +73,9 @@ def main(argv):
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
     mesh_lib.initialize_distributed()
+
+    if _JIT_CACHE.value:
+        mesh_lib.enable_persistent_compilation_cache(_JIT_CACHE.value)
 
     from jama16_retina_tpu import configs, trainer
     from jama16_retina_tpu.data import tfrecord
