@@ -52,6 +52,12 @@ _JIT_CACHE = flags.DEFINE_string(
     "persistent XLA compilation cache directory (share it with train.py "
     "to skip the eval-step compile). Empty = off.",
 )
+_CALIBRATE = flags.DEFINE_boolean(
+    "calibrate", False,
+    "fit a temperature on --threshold_split (required) and report "
+    "calibrated Brier/ECE on --split; AUC/thresholds are unaffected "
+    "(temperature is rank-preserving)",
+)
 _SAVE_PROBS = flags.DEFINE_string(
     "save_probs", "",
     "write per-image ensemble-averaged probabilities (name, grade, "
@@ -112,6 +118,7 @@ def main(argv):
         threshold_data_dir=_THRESHOLD_DATA_DIR.value or None,
         bootstrap=_BOOTSTRAP.value,
         save_probs=_SAVE_PROBS.value or None,
+        calibrate=_CALIBRATE.value,
     )
     print(json.dumps(report, indent=2))
 

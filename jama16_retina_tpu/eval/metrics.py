@@ -242,6 +242,49 @@ def expected_calibration_error(
     return float(ece)
 
 
+def fit_temperature(
+    labels: np.ndarray, probs: np.ndarray,
+    lo: float = 0.05, hi: float = 20.0, iters: int = 80,
+) -> float:
+    """Temperature that minimizes binary NLL on a TUNING split (golden-
+    section search over log T — NLL in T is unimodal for fixed logits).
+    Probabilities are mapped back to logits first, so this composes with
+    ensemble averaging. Apply with :func:`apply_temperature` to the EVAL
+    split; never fit on the split being reported (same bias rule as
+    threshold transfer).
+    """
+    labels = np.asarray(labels, dtype=np.float64).ravel()
+    p = np.clip(np.asarray(probs, dtype=np.float64).ravel(), 1e-7, 1 - 1e-7)
+    logits = np.log(p) - np.log1p(-p)
+
+    def nll(log_t: float) -> float:
+        z = logits / np.exp(log_t)
+        # stable log(1+e^z): logaddexp(0, z)
+        return float(np.mean(np.logaddexp(0.0, z) - labels * z))
+
+    a, b = np.log(lo), np.log(hi)
+    phi = (np.sqrt(5.0) - 1) / 2
+    c, d = b - phi * (b - a), a + phi * (b - a)
+    fc, fd = nll(c), nll(d)
+    for _ in range(iters):
+        if fc < fd:
+            b, d, fd = d, c, fc
+            c = b - phi * (b - a)
+            fc = nll(c)
+        else:
+            a, c, fc = c, d, fd
+            d = a + phi * (b - a)
+            fd = nll(d)
+    return float(np.exp((a + b) / 2))
+
+
+def apply_temperature(probs: np.ndarray, temperature: float) -> np.ndarray:
+    """sigmoid(logit(p) / T) elementwise."""
+    p = np.clip(np.asarray(probs, dtype=np.float64), 1e-7, 1 - 1e-7)
+    logits = np.log(p) - np.log1p(-p)
+    return 1.0 / (1.0 + np.exp(-logits / temperature))
+
+
 def ensemble_average(prob_list: Sequence[np.ndarray]) -> np.ndarray:
     """Averaged per-model probabilities (reference's "averaged logits",
     BASELINE.json:10 — the replication averaged the models' sigmoid

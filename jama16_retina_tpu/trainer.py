@@ -543,6 +543,7 @@ def evaluate_checkpoints(
     threshold_data_dir: str | None = None,
     bootstrap: int = 0,
     save_probs: str | None = None,
+    calibrate: bool = False,
 ) -> dict:
     """Single- or multi-checkpoint (ensemble-averaged) evaluation
     (SURVEY.md §3.2; BASELINE.json:10 'averaged logits').
@@ -560,9 +561,18 @@ def evaluate_checkpoints(
     applied to Messidor-2, which lives in a different TFRecord dir.
     ``bootstrap`` > 0 adds 95% CIs to AUC and to the sensitivities of
     both the self-tuned and the transferred operating points.
+    ``calibrate`` fits a temperature on the tuning split (requires
+    ``threshold_split``) and reports calibrated Brier/ECE on the eval
+    split — AUC and ROC thresholds are rank-invariant under temperature,
+    so only the calibration metrics change.
     """
     if not ckpt_dirs:
         raise ValueError("need at least one checkpoint dir")
+    if calibrate and not threshold_split:
+        raise ValueError(
+            "calibrate=True needs threshold_split: temperature must be "
+            "fit on a tuning split, never on the split being reported"
+        )
     tune_dir = threshold_data_dir or data_dir
     # realpath: './tfr', 'tfr/' and a symlink to tfr are the same eval
     # set — spelling differences must not bypass the self-tuning guard.
@@ -627,16 +637,17 @@ def evaluate_checkpoints(
         bootstrap_samples=bootstrap,
     )
     if threshold_split:
-        tune_probs = metrics.ensemble_average(prob_lists["tune"])
-        tune_grades = grades_by["tune"]
         to_binary = (
             (lambda p: p) if cfg.model.head == "binary"
             else metrics.referable_probs_from_multiclass
         )
+        tune_bin = (grades_by["tune"] >= 2).astype(np.float64)
+        tune_p = to_binary(metrics.ensemble_average(prob_lists["tune"]))
+        eval_bin = (grades_by["eval"] >= 2).astype(np.float64)
+        eval_p = to_binary(probs)
         report["operating_points_transferred"] = (
             metrics.transferred_operating_points(
-                (tune_grades >= 2).astype(np.float64), to_binary(tune_probs),
-                (grades_by["eval"] >= 2).astype(np.float64), to_binary(probs),
+                tune_bin, tune_p, eval_bin, eval_p,
                 cfg.eval.operating_specificities,
                 bootstrap_samples=bootstrap,
             )
@@ -644,6 +655,14 @@ def evaluate_checkpoints(
         report["threshold_split"] = threshold_split
         if threshold_data_dir:
             report["threshold_data_dir"] = threshold_data_dir
+        if calibrate:
+            temp = metrics.fit_temperature(tune_bin, tune_p)
+            cal = metrics.apply_temperature(eval_p, temp)
+            report["calibration"] = {
+                "temperature": round(temp, 4),
+                "brier": metrics.brier_score(eval_bin, cal),
+                "ece": metrics.expected_calibration_error(eval_bin, cal),
+            }
     if save_probs:
         _write_probs_csv(
             save_probs, eval_names, grades_by["eval"], probs,

@@ -123,6 +123,23 @@ def test_evaluate_checkpoints_threshold_transfer_and_ci(fitted, smoke_cfg, data_
     assert lo <= report["auc"] <= hi
 
 
+def test_evaluate_checkpoints_calibration(fitted, smoke_cfg, data_dir):
+    """--calibrate: temperature fit on val, calibrated Brier/ECE on test;
+    refuses to run without a tuning split."""
+    workdir, _ = fitted
+    report = trainer.evaluate_checkpoints(
+        smoke_cfg, data_dir, [workdir],
+        threshold_split="val", calibrate=True,
+    )
+    cal = report["calibration"]
+    assert cal["temperature"] > 0
+    assert 0.0 <= cal["ece"] <= 1.0 and 0.0 <= cal["brier"] <= 1.0
+    with pytest.raises(ValueError, match="tuning split"):
+        trainer.evaluate_checkpoints(
+            smoke_cfg, data_dir, [workdir], calibrate=True
+        )
+
+
 def test_evaluate_checkpoints_cross_dataset_thresholds(
     fitted, smoke_cfg, data_dir, tmp_path
 ):

@@ -218,3 +218,30 @@ def test_expected_calibration_error():
     # bin(0.1): acc 0.5 conf 0.1 -> 0.4 * 2/4 ; bin(0.9): acc 1.0 conf 0.9 -> 0.1 * 2/4
     expect = 0.5 * 0.4 + 0.5 * 0.1
     assert metrics.expected_calibration_error(l, s) == pytest.approx(expect)
+
+
+def test_fit_temperature_recovers_known_miscalibration():
+    """Generate calibrated probs, sharpen them by T_true (divide logits
+    by 1/T_true), and check the fitted temperature undoes it."""
+    rng = np.random.default_rng(21)
+    p_true = rng.uniform(0.05, 0.95, 4000)
+    labels = (rng.random(4000) < p_true).astype(np.float64)
+    logits = np.log(p_true) - np.log1p(-p_true)
+    t_true = 2.5
+    miscal = 1.0 / (1.0 + np.exp(-logits * t_true))  # overconfident
+    t_hat = metrics.fit_temperature(labels, miscal)
+    assert t_hat == pytest.approx(t_true, rel=0.15)
+    cal = metrics.apply_temperature(miscal, t_hat)
+    assert metrics.expected_calibration_error(labels, cal) < \
+        metrics.expected_calibration_error(labels, miscal)
+    # Rank preservation: AUC identical before/after.
+    assert metrics.roc_auc(labels, cal) == pytest.approx(
+        metrics.roc_auc(labels, miscal), abs=1e-12
+    )
+
+
+def test_fit_temperature_near_one_for_calibrated_input():
+    rng = np.random.default_rng(22)
+    p_true = rng.uniform(0.05, 0.95, 4000)
+    labels = (rng.random(4000) < p_true).astype(np.float64)
+    assert metrics.fit_temperature(labels, p_true) == pytest.approx(1.0, abs=0.15)
