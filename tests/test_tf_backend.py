@@ -73,6 +73,30 @@ def test_tf_backend_probs_match_jit_eval_step(cfg, flax_state):
     np.testing.assert_allclose(tf_probs, flax_probs, atol=1e-4)
 
 
+def test_tf_backend_tta_probs_match_jit_tta(cfg, flax_state):
+    """eval.tta must mean the same 4-view average on both backends."""
+    import dataclasses
+
+    from jama16_retina_tpu.models import tf_backend
+
+    model, state = flax_state
+    keras_model = models.build(cfg.model, backend="tf")
+    tf_backend.load_flax_state(keras_model, state.params, state.batch_stats)
+
+    tta_cfg = dataclasses.replace(
+        cfg, eval=dataclasses.replace(cfg.eval, tta=True)
+    )
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, (4, 75, 75, 3), dtype=np.uint8)
+    eval_step = train_lib.make_eval_step(tta_cfg, model)
+    with jax.default_matmul_precision("highest"):
+        flax_probs = np.asarray(eval_step(state, {"image": images}))
+    tf_probs = tf_backend.predict_probs(
+        keras_model, images, cfg.model.head, tta=True
+    )
+    np.testing.assert_allclose(tf_probs, flax_probs, atol=1e-4)
+
+
 def test_evaluate_checkpoints_tf_backend_report_parity(
     cfg, flax_state, tmp_path_factory
 ):
