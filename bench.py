@@ -55,7 +55,9 @@ import numpy as np
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 30.0  # see module docstring
 WARMUP_STEPS = 3
-TIMED_STEPS = 20
+# 50 timed steps ≈ 1.4s on-device: run-to-run variance of the headline
+# number was ~±4% at 20 steps (BENCH history 1086..1172 img/s).
+TIMED_STEPS = 50
 N_DISTINCT_BATCHES = 4
 # Synthetic TFRecord fixture for the host/pipeline measurements. Cached
 # across runs (rendering 299px fundus images costs ~0.1 s each on this

@@ -128,6 +128,37 @@ def test_evaluate_checkpoints_tf_backend_report_parity(
         == [o["target_specificity"] for o in report_flax["operating_points"]]
 
 
+def test_mixed_backend_ensemble_evaluates(cfg, flax_state, tmp_path_factory):
+    """The plugin boundary end to end: an ensemble whose members came
+    from DIFFERENT backends (one trained by keras fit_tf, one flax
+    state) evaluates through one evaluate_checkpoints call — checkpoints
+    are the interchange format."""
+    data_dir = str(tmp_path_factory.mktemp("mix_data"))
+    for split, n, seed in (("train", 32, 1), ("val", 16, 2), ("test", 16, 3)):
+        tfrecord.write_synthetic_split(data_dir, split, n, 75, seed=seed)
+    run_cfg = override(
+        cfg,
+        ["train.steps=2", "train.eval_every=2", "data.batch_size=8",
+         "eval.batch_size=8", "data.augment=false"],
+    )
+    tf_dir = str(tmp_path_factory.mktemp("mix_tf"))
+    trainer.fit_tf(run_cfg, data_dir, tf_dir, seed=0)
+
+    _, state = flax_state
+    flax_dir = str(tmp_path_factory.mktemp("mix_flax"))
+    ck = ckpt_lib.Checkpointer(flax_dir)
+    ck.save(1, state, {"val_auc": 0.5})
+    ck.wait()
+    ck.close()
+
+    report = trainer.evaluate_checkpoints(
+        run_cfg, data_dir, [tf_dir, flax_dir]
+    )
+    assert report["n_models"] == 2
+    assert report["n_examples"] == 16
+    assert 0.0 <= report["auc"] <= 1.0
+
+
 def test_fit_tf_trains_and_checkpoint_is_flax_evaluable(
     cfg, tmp_path_factory
 ):
