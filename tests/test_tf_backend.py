@@ -128,6 +128,29 @@ def test_evaluate_checkpoints_tf_backend_report_parity(
         == [o["target_specificity"] for o in report_flax["operating_points"]]
 
 
+def test_tf_backend_multiclass_probs_match(cfg):
+    """The 5-class ICDR head through the plugin boundary: keras
+    softmax probabilities match the jit eval step's."""
+    from jama16_retina_tpu.models import tf_backend
+
+    multi_cfg = override(cfg, ["model.head=multi"])
+    model = models.build(multi_cfg.model)
+    state, _ = train_lib.create_state(multi_cfg, model, jax.random.key(3))
+    state = jax.device_get(state)
+    keras_model = models.build(multi_cfg.model, backend="tf")
+    tf_backend.load_flax_state(keras_model, state.params, state.batch_stats)
+
+    rng = np.random.default_rng(2)
+    images = rng.integers(0, 256, (6, 75, 75, 3), dtype=np.uint8)
+    eval_step = train_lib.make_eval_step(multi_cfg, model)
+    with jax.default_matmul_precision("highest"):
+        flax_probs = np.asarray(eval_step(state, {"image": images}))
+    tf_probs = tf_backend.predict_probs(keras_model, images, "multi")
+    assert flax_probs.shape == tf_probs.shape == (6, 5)
+    np.testing.assert_allclose(tf_probs.sum(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(tf_probs, flax_probs, atol=1e-4)
+
+
 def test_mixed_backend_ensemble_evaluates(cfg, flax_state, tmp_path_factory):
     """The plugin boundary end to end: an ensemble whose members came
     from DIFFERENT backends (one trained by keras fit_tf, one flax
