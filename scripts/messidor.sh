@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Original-Messidor acquisition (reference R10: messidor.sh, SURVEY.md §1).
+# Messidor (the 2008 1200-image set, distinct from Messidor-2) is served
+# by ADCIS behind a license form, split into 3 "bases" of 4 zip parts
+# each, with per-base Excel annotation files — no unattended download
+# path exists, same as the reference's script. This script arranges the
+# layout preprocess_messidor.py expects and documents the label
+# conversion.
+#
+# Expected layout after this script succeeds:
+#   $DATA_DIR/
+#     grades.csv               # columns: image,grade  (retinopathy 0-3)
+#     images/                  # {image}.tif fundus photographs
+#
+# Obtain:
+#   1. Request Messidor from https://www.adcis.net/en/third-party/messidor/
+#      -> 12 image archives Base{11,12,13,14,21,22,23,24,31,32,33,34}.zip
+#      (3 bases x 4 parts) + one Annotation_Base*.xls per archive
+#   2. Convert the Excel sheets to one grades.csv: keep the "Image name"
+#      and "Retinopathy grade" columns (0-3 scale; grade >= 2 bins to
+#      referable exactly like EyePACS/Messidor-2 — preprocess stores the
+#      raw grade). Any spreadsheet tool or `python -c` one-liner works;
+#      there is nothing image-specific in the conversion.
+#      NOTE the published erratum: 13 images of Base11 have corrected
+#      grades and 3 duplicate pairs should be dropped — apply the ADCIS
+#      erratum list to the CSV before preprocessing.
+#
+# Usage: scripts/messidor.sh [DATA_DIR] [path/to/zip ...]
+set -euo pipefail
+
+DATA_DIR="${1:-data/messidor}"
+shift || true
+mkdir -p "$DATA_DIR"
+
+have_layout() {
+  [[ -f "$DATA_DIR/grades.csv" ]] && [[ -d "$DATA_DIR/images" ]] \
+    && find "$DATA_DIR/images" -maxdepth 1 -type f \
+         \( -name '*.tif' -o -name '*.TIF' -o -name '*.jpg' -o -name '*.png' \) \
+         | head -1 | grep -q .
+}
+
+if have_layout; then
+  echo "messidor.sh: raw layout already present under $DATA_DIR"
+  exit 0
+fi
+
+if [[ $# -gt 0 ]]; then
+  mkdir -p "$DATA_DIR/images"
+  for archive in "$@"; do
+    if [[ -f "$archive" ]]; then
+      unzip -o "$archive" -d "$DATA_DIR/images"
+    else
+      echo "messidor.sh: skipping missing archive $archive" >&2
+    fi
+  done
+  # Flatten one level of nesting if archives carry a top directory.
+  find "$DATA_DIR/images" -mindepth 2 -type f -exec mv -t "$DATA_DIR/images" {} +
+fi
+
+if ! have_layout; then
+  cat >&2 <<EOF
+messidor.sh: $DATA_DIR is not populated and no usable archives were given.
+Messidor cannot be downloaded unattended (license form); follow the
+"Obtain" steps at the top of this script (including the Excel->CSV grade
+conversion and the erratum), then re-run with the archive paths or
+arrange the documented layout by hand.
+EOF
+  exit 1
+fi
+echo "messidor.sh: done -> $DATA_DIR"

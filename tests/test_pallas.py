@@ -87,8 +87,6 @@ def test_compiled_kernel_on_tpu():
     # -m expression (e.g. 'not slow') replaces the default 'not tpu'
     # and would pull this onto the CPU backend, where compiled (non-
     # interpret) pallas is unsupported.
-    import jax
-
     if jax.default_backend() != "tpu":
         pytest.skip("compiled pallas kernel needs the real TPU backend")
     imgs = _rand_images(b=2, h=128, w=128)
