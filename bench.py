@@ -23,6 +23,12 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
   augment_jnp / augment_pallas — the augmentation stage alone, jnp
                        composition vs the fused pallas kernel
                        (ops/pallas_augment.py), compiled on this chip.
+  device_only_b128   — the same train step at per-chip batch 128. The
+                       config of record pins the GLOBAL batch at 32
+                       (4/chip on a v3-8), and at 32/chip the step is
+                       HBM-bound on stem activations (docs/PERF.md); this
+                       number shows the amortized rate the chip reaches
+                       when batch is not pinned by the experiment.
 
 Workload = the production config of record (BASELINE.json:7): Inception-v3,
 binary head, 299x299, global batch 32, aux head on, bf16 compute — the
@@ -50,6 +56,7 @@ import json
 import os
 import sys
 import time
+from typing import Any
 
 import numpy as np
 
@@ -117,6 +124,25 @@ def _host_rate(data_dir: str, cfg, image_size: int, n_batches: int = 30,
     return n_batches * cfg.data.batch_size / dt
 
 
+def _timed_steps(step, state, batch_iter, key, n_steps: int, batch_size: int,
+                 n_dev: int, warmup: int = WARMUP_STEPS) -> tuple[float, Any]:
+    """Shared timing discipline for every train-step measurement: warm up
+    (compile included), block, time ``n_steps``, block; returns
+    (images/sec/chip, final state). ``batch_iter`` is any callable
+    ``i -> batch`` (cycled list or pipeline iterator)."""
+    import jax
+
+    for i in range(warmup):
+        state, _ = step(state, batch_iter(i), key)
+    jax.block_until_ready(state)
+    t0 = time.time()
+    for i in range(n_steps):
+        state, m = step(state, batch_iter(i), key)
+    jax.block_until_ready(state)
+    rate = n_steps * batch_size / (time.time() - t0) / n_dev
+    return rate, state
+
+
 def _augment_rate(images_u8, data_cfg, use_pallas: bool, n: int = 30) -> float:
     """Images/sec of the augmentation stage alone, compiled on this chip."""
     import jax
@@ -149,6 +175,11 @@ def main() -> None:
     parser.add_argument(
         "--skip_host", action="store_true",
         help="device-only measurements (skip TFRecord fixture + host rates)",
+    )
+    parser.add_argument(
+        "--skip_b128", action="store_true",
+        help="skip the batch-128 scaling datapoint (saves its ~40s compile "
+             "for quick checks)",
     )
     args = parser.parse_args()
 
@@ -190,19 +221,12 @@ def main() -> None:
     key = jax.random.key(1)
 
     t0 = time.time()
-    for i in range(WARMUP_STEPS):
-        state, m = step(state, batches[i % N_DISTINCT_BATCHES], key)
-    jax.block_until_ready(state)
-    _log(f"warmup+compile {time.time() - t0:.1f}s")
-
-    t0 = time.time()
-    for i in range(TIMED_STEPS):
-        state, m = step(state, batches[i % N_DISTINCT_BATCHES], key)
-    jax.block_until_ready(state)
-    dt = time.time() - t0
-    device_only = TIMED_STEPS * batch_size / dt / n_dev
-    _log(f"device_only: {TIMED_STEPS} steps in {dt:.2f}s "
-         f"({device_only:.1f} img/s/chip), loss={float(m['loss']):.4f}")
+    device_only, state = _timed_steps(
+        step, state, lambda i: batches[i % N_DISTINCT_BATCHES], key,
+        TIMED_STEPS, batch_size, n_dev,
+    )
+    _log(f"device_only: {TIMED_STEPS} steps in {time.time() - t0:.1f}s "
+         f"incl. warmup+compile ({device_only:.1f} img/s/chip)")
 
     extras: dict = {"use_pallas": cfg.data.use_pallas}
 
@@ -238,17 +262,40 @@ def main() -> None:
             sharding=mesh_lib.batch_sharding(mesh),
             size=cfg.data.prefetch_batches,
         )
-        for _ in range(3):
-            state, m = step(state, next(it), key)
-        jax.block_until_ready(state)
-        t0 = time.time()
-        for _ in range(TIMED_STEPS):
-            state, m = step(state, next(it), key)
-        jax.block_until_ready(state)
-        dt = time.time() - t0
-        extras["pipeline_fed"] = round(TIMED_STEPS * batch_size / dt / n_dev, 2)
-        _log(f"pipeline_fed: {TIMED_STEPS} steps in {dt:.2f}s "
-             f"({extras['pipeline_fed']} img/s/chip)")
+        rate, state = _timed_steps(
+            step, state, lambda i: next(it), key, TIMED_STEPS, batch_size,
+            n_dev, warmup=3,
+        )
+        extras["pipeline_fed"] = round(rate, 2)
+        _log(f"pipeline_fed: {extras['pipeline_fed']} img/s/chip")
+
+    # Batch-scaling datapoint: per-chip batch 128 (see docstring). Placed
+    # LAST because the step donates its state argument — `state` must not
+    # be consumed while earlier sections still need it. A second compile
+    # (~40s); the measurement itself is ~2s.
+    if not args.skip_b128:
+        try:
+            big = 128 * n_dev
+            big_batches = [
+                mesh_lib.shard_batch(
+                    {
+                        "image": rng.integers(
+                            0, 256, (big, size, size, 3), np.uint8
+                        ),
+                        "grade": rng.integers(0, 5, (big,), np.int32),
+                    },
+                    mesh,
+                )
+                for _ in range(2)
+            ]
+            rate, state = _timed_steps(
+                step, state, lambda i: big_batches[i % 2], key, 20, big, n_dev
+            )
+            extras["device_only_b128"] = round(rate, 2)
+            _log(f"device_only @ batch 128/chip: "
+                 f"{extras['device_only_b128']} img/s/chip")
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"batch-128 bench failed: {type(e).__name__}: {e}")
 
     extras["device_only"] = round(device_only, 2)
     print(json.dumps({

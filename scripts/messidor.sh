@@ -58,13 +58,24 @@ if [[ $# -gt 0 ]]; then
 fi
 
 if ! have_layout; then
-  cat >&2 <<EOF
+  if [[ ! -f "$DATA_DIR/grades.csv" ]] \
+     && find "$DATA_DIR/images" -maxdepth 1 -type f 2>/dev/null | head -1 | grep -q .; then
+    cat >&2 <<EOF
+messidor.sh: images are in place under $DATA_DIR/images but
+$DATA_DIR/grades.csv is missing. The grade CSV cannot come from the
+image archives: convert the Annotation_Base*.xls sheets to one
+image,grade CSV (and apply the erratum) per the "Obtain" steps at the
+top of this script, then re-run.
+EOF
+  else
+    cat >&2 <<EOF
 messidor.sh: $DATA_DIR is not populated and no usable archives were given.
 Messidor cannot be downloaded unattended (license form); follow the
 "Obtain" steps at the top of this script (including the Excel->CSV grade
 conversion and the erratum), then re-run with the archive paths or
 arrange the documented layout by hand.
 EOF
+  fi
   exit 1
 fi
 echo "messidor.sh: done -> $DATA_DIR"

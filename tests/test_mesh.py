@@ -1,0 +1,59 @@
+"""parallel/mesh.py bring-up guards (SURVEY.md §3.5, §5.8).
+
+The actual multi-process path needs a pod; what IS testable in one
+process is the env contract: single-host no-op, the half-configured
+launcher-env diagnostic (which must fire BEFORE jax.distributed touches
+the network), and mesh construction bounds.
+"""
+
+import pytest
+
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+_ENV_VARS = mesh_lib._COORDINATOR_ENV_VARS + (
+    "TPU_WORKER_HOSTNAMES", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+)
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for v in _ENV_VARS:
+        monkeypatch.delenv(v, raising=False)
+    return monkeypatch
+
+
+def test_initialize_is_noop_without_coordinator_env(clean_env):
+    # Single host: returns False and must NOT call
+    # jax.distributed.initialize (which would grab a coordinator port).
+    assert mesh_lib.initialize_distributed() is False
+
+
+def test_single_host_tpu_metadata_is_not_multihost(clean_env):
+    # axon/Cloud TPU VMs export TPU_WORKER_HOSTNAMES even on one-host
+    # slices; only a comma-separated multi-name list means a pod.
+    clean_env.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert mesh_lib._multihost_env_configured() is False
+    clean_env.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    assert mesh_lib._multihost_env_configured() is True
+
+
+@pytest.mark.parametrize("present,missing", [
+    ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID"),
+    ("JAX_PROCESS_ID", "JAX_NUM_PROCESSES"),
+])
+def test_half_configured_launcher_env_fails_loudly(
+    clean_env, present, missing
+):
+    clean_env.setenv("JAX_COORDINATOR_ADDRESS", "coord:8476")
+    clean_env.setenv(present, "0" if present == "JAX_PROCESS_ID" else "4")
+    # Match the load-bearing clause, not just the var name — the message
+    # tail names BOTH vars, so a bare `match=missing` would be vacuous.
+    with pytest.raises(RuntimeError, match=f"but {missing} is not"):
+        mesh_lib.initialize_distributed()
+
+
+def test_make_mesh_rejects_oversubscription():
+    import jax
+
+    with pytest.raises(ValueError, match="requested"):
+        mesh_lib.make_mesh(len(jax.devices()) + 1)
