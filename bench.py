@@ -29,6 +29,14 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        HBM-bound on stem activations (docs/PERF.md); this
                        number shows the amortized rate the chip reaches
                        when batch is not pinned by the experiment.
+  ensemble4_member_images_per_sec / ensemble4_parallel_speedup —
+                       the member-parallel ensemble step (4 stacked
+                       members, train_lib.make_ensemble_train_step) in
+                       member-images/sec/chip, and its ratio to the
+                       sequential member rate (device_only). Single-chip
+                       this sits near 1.0 (weight/optimizer HBM traffic
+                       scales with members); the capability's payoff is
+                       pod topology — see configs.py ensemble_parallel.
 
 Workload = the production config of record (BASELINE.json:7): Inception-v3,
 binary head, 299x299, global batch 32, aux head on, bf16 compute — the
@@ -181,6 +189,11 @@ def main() -> None:
         help="skip the batch-128 scaling datapoint (saves its ~40s compile "
              "for quick checks)",
     )
+    parser.add_argument(
+        "--skip_ensemble", action="store_true",
+        help="skip the 4-member stacked-ensemble datapoint (saves its "
+             "compile for quick checks)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -296,6 +309,35 @@ def main() -> None:
                  f"{extras['device_only_b128']} img/s/chip")
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"batch-128 bench failed: {type(e).__name__}: {e}")
+
+    # Member-parallel ensemble training (train_lib.make_ensemble_train_step):
+    # 4 stacked members, one program, same batch-32 workload. The
+    # speedup column is what the stacked form buys over 4 sequential
+    # member-steps — the reference's k-sequential ensemble protocol is
+    # the denominator of the <1h wall-clock target (BASELINE.json:5,10).
+    if not args.skip_ensemble:
+        try:
+            k = 4
+            ens_state, ens_tx = train_lib.create_ensemble_state(
+                cfg, model, list(range(k))
+            )
+            ens_state = jax.device_put(ens_state, mesh_lib.replicated(mesh))
+            ens_step = train_lib.make_ensemble_train_step(
+                cfg, model, ens_tx, mesh=None
+            )
+            ens_keys = train_lib.stack_member_keys(list(range(k)))
+            rate, _ = _timed_steps(
+                lambda st, b, key: ens_step(st, b, ens_keys),
+                ens_state, lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                20, k * batch_size, n_dev,
+            )
+            extras["ensemble4_member_images_per_sec"] = round(rate, 2)
+            extras["ensemble4_parallel_speedup"] = round(rate / device_only, 2)
+            _log(f"ensemble k=4 stacked step: {rate:.1f} member-img/s/chip "
+                 f"({extras['ensemble4_parallel_speedup']}x the sequential "
+                 "member rate)")
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"ensemble bench failed: {type(e).__name__}: {e}")
 
     extras["device_only"] = round(device_only, 2)
     print(json.dumps({

@@ -109,6 +109,26 @@ class TrainConfig:
     # Number of independently seeded ensemble members the train driver
     # produces (reference trains k=10, BASELINE.json:10). 1 = single model.
     ensemble_size: int = 1
+    # Member-parallel ensemble training (trainer.fit_ensemble_parallel):
+    # instead of the reference's k sequential runs, stack the k members
+    # on a 'member' mesh axis and train them in ONE XLA program — members
+    # are independent replicas (zero cross-member collectives; this is
+    # ensemble data-parallelism over seeds, NOT tensor parallelism, see
+    # SURVEY.md N10) so the member axis shards embarrassingly across
+    # chips. Measured single-chip it is ~parity with the sequential
+    # driver (bench `ensemble4_parallel_speedup` ≈ 0.89: weight/optimizer
+    # HBM traffic scales with members, unlike batch scaling); the win is
+    # on multi-chip slices — each member-shard group trains with FEWER
+    # data-parallel ways (higher per-chip batch, the amortization
+    # documented in docs/PERF.md), no gradient allreduce crosses member
+    # groups, and the k-run protocol becomes one program (k× fewer
+    # dispatches/compiles). Members share the batch stream (seed =
+    # train.seed); diversity comes from per-member init/augmentation/
+    # dropout keys (seed + m, matching the sequential driver's seeds).
+    # Checkpoint layout is identical to the sequential driver's member_NN
+    # dirs. Flax path, single process only (covers a one-host v3-8 slice;
+    # multi-HOST runs are refused loudly — use the sequential driver).
+    ensemble_parallel: bool = False
     # Profiling (SURVEY.md §5.1): if > 0, capture a jax.profiler trace of
     # this many steps (starting at step 10) into <workdir>/profile —
     # TensorBoard/Perfetto-viewable XLA op + ICI collective timeline.

@@ -115,9 +115,51 @@ def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+def make_ensemble_mesh(
+    n_members: int, num_devices: int = 0
+) -> Mesh:
+    """2-D ``('member', 'data')`` mesh for member-parallel ensemble
+    training (trainer.fit_ensemble_parallel).
+
+    The member axis carries INDEPENDENT replicas — stacked params shard
+    across it with zero cross-member collectives (it is ensemble
+    data-parallelism over seeds, not a tensor/pipeline axis; SURVEY.md
+    N10's honesty note stands). Its size is ``gcd(n_members, n_devices)``
+    — the largest count that divides both, so the stacked member dim and
+    the device array always factor evenly (k=10 on 8 chips -> member
+    axis 2, data axis 4, 5 members per member-shard).
+    """
+    import math
+
+    devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    n = len(devices)
+    member_size = math.gcd(max(n_members, 1), n)
+    return Mesh(
+        np.asarray(devices).reshape(member_size, n // member_size),
+        ("member", "data"),
+    )
+
+
+def _batch_axis(mesh: Mesh) -> str:
+    """The mesh axis batches shard over: 'data' when present (2-D
+    ensemble mesh), else the sole axis of the 1-D mesh."""
+    return "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+
+
+def member_sharding(mesh: Mesh) -> NamedSharding:
+    """Dim-0 (stacked member) sharding over the member axis."""
+    return NamedSharding(mesh, P("member"))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Dim-0 (batch) sharding over the data axis."""
-    return NamedSharding(mesh, P(mesh.axis_names[0]))
+    return NamedSharding(mesh, P(_batch_axis(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -136,9 +178,11 @@ def shard_batch(batch, mesh: Mesh):
     """
     multiprocess = jax.process_count() > 1
 
+    axis = _batch_axis(mesh)
+
     def put(x):
         x = np.asarray(x)
-        spec = P(mesh.axis_names[0], *([None] * (x.ndim - 1))) if x.ndim else P()
+        spec = P(axis, *([None] * (x.ndim - 1))) if x.ndim else P()
         sharding = NamedSharding(mesh, spec)
         if multiprocess and x.ndim:
             return jax.make_array_from_process_local_data(sharding, x)

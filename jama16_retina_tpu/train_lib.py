@@ -272,6 +272,38 @@ def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):
     return jax.pmap(step, axis_name=axis, in_axes=(0, 0, None))
 
 
+def _eval_probs(
+    state: TrainState, images: jnp.ndarray, model, cfg: ExperimentConfig
+) -> jnp.ndarray:
+    """Normalized images -> per-example probabilities for ONE model.
+
+    EMA shadow params, when carried, are what the paper-quality model IS
+    — eval always prefers them (train keeps optimizing the raw params).
+    With ``cfg.eval.tta``, flip-averaged TTA stacks the 4 views on a
+    leading axis and ``lax.map``s so the backbone is traced/compiled ONCE
+    (4 sequential passes), not inlined 4x into one giant program.
+    """
+    eval_params = (
+        state.params if state.ema_params is None else state.ema_params
+    )
+    variables = {"params": eval_params, "batch_stats": state.batch_stats}
+
+    def forward(x):
+        logits, _ = model.apply(variables, x, train=False)
+        return _probs(logits, cfg.model.head)
+
+    if not cfg.eval.tta:
+        return forward(images)
+    views = jnp.stack([
+        images,
+        images[:, :, ::-1],
+        images[:, ::-1, :],
+        images[:, ::-1, ::-1],
+    ])
+    probs = jax.lax.map(forward, views)
+    return probs.mean(axis=0)
+
+
 def make_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
     """Masked forward pass -> per-example probabilities (SURVEY.md §3.2).
 
@@ -281,35 +313,138 @@ def make_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
     """
 
     def step(state: TrainState, batch: dict):
-        images = augment_lib.normalize(batch["image"])
-        # EMA shadow params, when carried, are what the paper-quality
-        # model IS — eval always prefers them (train keeps optimizing
-        # the raw params).
-        eval_params = (
-            state.params if state.ema_params is None else state.ema_params
+        return _eval_probs(
+            state, augment_lib.normalize(batch["image"]), model, cfg
         )
-        variables = {"params": eval_params, "batch_stats": state.batch_stats}
-
-        def forward(x):
-            logits, _ = model.apply(variables, x, train=False)
-            return _probs(logits, cfg.model.head)
-
-        if not cfg.eval.tta:
-            return forward(images)
-        # Flip-averaged TTA: stack the 4 views on a leading axis and scan
-        # so the backbone is traced/compiled ONCE (4 sequential passes),
-        # not inlined 4x into one giant program.
-        views = jnp.stack([
-            images,
-            images[:, :, ::-1],
-            images[:, ::-1, :],
-            images[:, ::-1, ::-1],
-        ])
-        probs = jax.lax.map(forward, views)
-        return probs.mean(axis=0)
 
     if mesh is None:
         return jax.jit(step)
     repl = mesh_lib.replicated(mesh)
     data = mesh_lib.batch_sharding(mesh)
     return jax.jit(step, in_shardings=(repl, data), out_shardings=repl)
+
+
+# ---------------------------------------------------------------------------
+# Member-parallel ensemble training (TrainConfig.ensemble_parallel)
+# ---------------------------------------------------------------------------
+#
+# The reference trains its k-model ensemble as k sequential runs (R11).
+# The members are INDEPENDENT replicas — no communication between them
+# ever — so TPU-natively they stack on a leading member axis: one vmapped
+# XLA program trains all k at once, and on a ('member', 'data') mesh
+# (mesh_lib.make_ensemble_mesh) GSPMD shards the stacked arrays across
+# chips with zero cross-member collectives. Single-chip the stacked step
+# measures ~parity with sequential members (bench
+# `ensemble4_parallel_speedup` — weight/optimizer HBM traffic scales
+# with members); the payoff is on pods, where member groups train with
+# fewer DP ways each (higher per-chip batch, docs/PERF.md) and no
+# allreduce crosses member groups.
+#
+# Semantics vs the sequential driver: member m keeps its seed
+# (train.seed + m) for init/augment/dropout — identical marginal
+# randomness — but all members see ONE batch stream (seed = train.seed)
+# instead of k independently shuffled streams. Ensemble diversity in
+# this protocol comes overwhelmingly from init and augmentation draws;
+# the sequential driver remains available (and is the paper-parity
+# form) by leaving ensemble_parallel off.
+
+
+def stack_member_keys(seeds: "list[int]") -> jax.Array:
+    """[k] stacked PRNG key vector, one key per member seed — the vmapped
+    twin of the sequential driver's ``base_key = jax.random.key(seed)``.
+    The ONE home for member-key construction: create_ensemble_state's
+    init keys and the train loop's base keys must come from the same
+    expression or member m's stream diverges from a sequential run."""
+    return jnp.stack([jax.random.key(int(s)) for s in seeds])
+
+
+def create_ensemble_state(
+    cfg: ExperimentConfig, model, seeds: "list[int]"
+) -> tuple[TrainState, optax.GradientTransformation]:
+    """Stacked TrainState: every leaf gains a leading [k] member dim.
+
+    Member m's slice is bit-identical to ``create_state`` under seed
+    ``seeds[m]`` (the vmapped init consumes the same per-member key).
+    """
+    size = cfg.model.image_size
+    dummy = jnp.zeros((2, size, size, 3), jnp.float32)
+    keys = stack_member_keys(seeds)
+    init_fn = jax.jit(jax.vmap(
+        lambda r: model.init({"params": r, "dropout": r}, dummy, train=False)
+    ))
+    variables = init_fn(keys)
+    tx = make_optimizer(cfg.train)
+    state = TrainState(
+        step=jnp.zeros((len(seeds),), jnp.int32),
+        params=variables["params"],
+        batch_stats=variables["batch_stats"],
+        opt_state=jax.vmap(tx.init)(variables["params"]),
+        ema_params=(
+            jax.tree.map(jnp.copy, variables["params"])
+            if cfg.train.ema_decay > 0 else None
+        ),
+    )
+    return state, tx
+
+
+def unstack_member(state: TrainState, m: int) -> TrainState:
+    """Member m's single-model TrainState (for per-member checkpoints —
+    the on-disk layout stays identical to the sequential driver's)."""
+    return jax.tree.map(lambda x: x[m], state)
+
+
+def make_ensemble_train_step(
+    cfg: ExperimentConfig, model, tx, mesh=None, donate: bool = True
+) -> Callable:
+    """One XLA program advancing all k stacked members one step.
+
+    ``base_keys`` is the [k] key vector (member m's key = the sequential
+    driver's base key under seed+m); each member folds its own key with
+    its own step counter, so augmentation and dropout draws are
+    independent across members exactly as in k separate runs. With a
+    ('member', 'data') mesh, state shards P('member') on the stacked dim
+    and the batch P('data') on dim 0 — every chip holds k/member_size
+    members and sees the batch rows of its data-axis block.
+    """
+
+    def step(state: TrainState, batch: dict, base_keys: jax.Array):
+        def one(st, bk):
+            loss, _, new_stats, grads = _step_impl(st, batch, bk, model, cfg)
+            return (
+                _apply_update(st, grads, new_stats, tx, cfg.train.ema_decay),
+                loss,
+            )
+
+        new_state, losses = jax.vmap(one)(state, base_keys)
+        return new_state, {"loss": losses}
+
+    donate_argnums = (0,) if donate else ()
+    if mesh is None:
+        return jax.jit(step, donate_argnums=donate_argnums)
+    member = mesh_lib.member_sharding(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(member, data, member),
+        out_shardings=(member, member),
+        donate_argnums=donate_argnums,
+    )
+
+
+def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
+    """Stacked eval: (stacked state, batch) -> probs [k, B(, C)] — all k
+    members forward the same batch in one program (the eval twin of
+    make_ensemble_train_step; same EMA/TTA semantics as _eval_probs)."""
+
+    def step(state: TrainState, batch: dict):
+        images = augment_lib.normalize(batch["image"])
+        return jax.vmap(lambda st: _eval_probs(st, images, model, cfg))(state)
+
+    if mesh is None:
+        return jax.jit(step)
+    member = mesh_lib.member_sharding(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    # Probs come back [k, B]: member-sharded rows, gathered by the host.
+    return jax.jit(
+        step, in_shardings=(member, data), out_shardings=member
+    )

@@ -129,6 +129,21 @@ def _train_stream(
     )
 
 
+def _best_tracking_update(
+    aucs, best_auc, best_step, since_best, step: int, min_delta: float
+):
+    """The best/min_delta/patience bookkeeping rule, vectorized over any
+    number of models — THE one copy of the early-stopping rule, shared by
+    the scalar drivers (_eval_and_track, via 0-d arrays) and the member-
+    parallel driver (length-k vectors), so they cannot desynchronize."""
+    improved = np.asarray(aucs) > np.asarray(best_auc) + min_delta
+    return (
+        np.where(improved, aucs, best_auc),
+        np.where(improved, step, best_step),
+        np.where(improved, 0, np.asarray(since_best) + 1),
+    )
+
+
 def _eval_and_track(
     cfg: ExperimentConfig, log: RunLog, ckpt, step: int,
     predict_fn, state_for_save,
@@ -146,10 +161,10 @@ def _eval_and_track(
     )
     auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
     ckpt.save(step, state_for_save, {"val_auc": auc})
-    if auc > best_auc + cfg.train.min_delta:
-        best_auc, best_step, since_best = auc, step, 0
-    else:
-        since_best += 1
+    b_auc, b_step, since = _best_tracking_update(
+        auc, best_auc, best_step, since_best, step, cfg.train.min_delta
+    )
+    best_auc, best_step, since_best = float(b_auc), int(b_step), int(since)
     log.write("eval", step=step, val_auc=round(auc, 5),
               best_auc=round(best_auc, 5), since_best=since_best)
     stop = since_best >= cfg.train.early_stop_patience
@@ -339,7 +354,19 @@ def fit_ensemble(
     backend: str = "flax",
 ) -> list[dict]:
     """Train k independently-seeded members (reference R11, BASELINE.json:10),
-    each in its own member_NN checkpoint dir."""
+    each in its own member_NN checkpoint dir.
+
+    ``train.ensemble_parallel=true`` routes to the member-parallel form
+    (one stacked XLA program, train_lib.make_ensemble_train_step) —
+    same seeds, same checkpoint layout, k× fewer dispatches."""
+    if cfg.train.ensemble_parallel:
+        if backend != "flax":
+            raise ValueError(
+                "ensemble_parallel is a flax-path feature (the stacked "
+                "member axis is a jax.vmap/GSPMD construct); use the "
+                "sequential driver for --device=tf"
+            )
+        return fit_ensemble_parallel(cfg, data_dir, workdir)
     fit_fn = fit_tf if backend == "tf" else fit
     results = []
     for member in range(cfg.train.ensemble_size):
@@ -347,6 +374,197 @@ def fit_ensemble(
         res = fit_fn(cfg, data_dir, mdir, seed=cfg.train.seed + member)
         results.append({"member": member, "workdir": mdir, **res})
     return results
+
+
+def _predict_split_members(
+    cfg: ExperimentConfig, state, data_dir: str, split: str,
+    mesh, eval_step,
+) -> tuple[np.ndarray, np.ndarray]:
+    """predict_split for a STACKED ensemble state: one vmapped forward
+    scores all k members per batch -> (grades [n], probs [k, n(, C)])."""
+    grades_all, probs_all = [], []
+    for batch in pipeline.eval_batches(
+        data_dir, split, cfg.eval.batch_size, cfg.model.image_size
+    ):
+        if mesh is not None:
+            dev_batch = mesh_lib.shard_batch({"image": batch["image"]}, mesh)
+        else:
+            dev_batch = jax.device_put({"image": batch["image"]})
+        probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
+        keep = batch["mask"] > 0
+        grades_all.append(batch["grade"][keep])
+        probs_all.append(probs[:, keep])
+    return np.concatenate(grades_all), np.concatenate(probs_all, axis=1)
+
+
+def fit_ensemble_parallel(
+    cfg: ExperimentConfig, data_dir: str, workdir: str
+) -> list[dict]:
+    """Member-parallel ensemble training: all k members advance in ONE
+    jit dispatch per step over a ('member', 'data') mesh.
+
+    The TPU-first redesign of the reference's k sequential runs (R11):
+    members are independent replicas, so the stacked member dim shards
+    across chips with zero cross-member collectives (single-chip it is
+    ~parity with sequential — see the ensemble_parallel note in
+    configs.py and bench's ensemble4_parallel_speedup; the win is mesh
+    topology on pods plus k× fewer dispatches). Member m keeps the
+    sequential driver's seed
+    (train.seed + m) for init/augment/dropout; all members share the
+    train.seed batch stream (documented delta — see configs.py).
+    Checkpoints land in the same member_NN/{best,latest} layout, best-by-
+    val-AUC per member, so evaluate.py/predict.py ensemble discovery is
+    oblivious to how the members were trained. Early stopping fires when
+    EVERY member has exhausted its patience; each member's best
+    checkpoint is whatever its own val-AUC peak was.
+    """
+    k = cfg.train.ensemble_size
+    seed = cfg.train.seed
+    if cfg.train.resume:
+        raise NotImplementedError(
+            "resume of a member-parallel run is not wired yet: restart "
+            "from scratch or train members sequentially "
+            "(train.ensemble_parallel=false) to resume"
+        )
+    if jax.process_count() > 1:
+        # The pipeline's per-process sharding yields 1-D-DP local blocks;
+        # assembling them under the 2-D ('member', 'data') layout (data-
+        # replicated across member rows) is not wired, and device_get of
+        # a member-sharded state needs a multi-host gather. Fail loudly
+        # rather than build a wrong global batch.
+        raise NotImplementedError(
+            "ensemble_parallel is single-process for now (multi-CHIP via "
+            "one process is fine — the member axis shards across local "
+            "devices); on a multi-host slice train members sequentially "
+            "or run one process per member group"
+        )
+    mesh = mesh_lib.make_ensemble_mesh(k, cfg.parallel.num_devices)
+    prev_debug_nans = jax.config.jax_debug_nans
+    if cfg.train.debug:
+        jax.config.update("jax_debug_nans", True)
+    log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
+    log.write(
+        "config", name=cfg.name, seed=seed, ensemble_parallel=True,
+        n_members=k, mesh_shape=dict(mesh.shape),
+    )
+
+    if cfg.train.profile_steps > 0:
+        # The per-member profiler window is not wired in this driver —
+        # say so in the run log instead of silently no-opping the flag
+        # (profile a single-member fit() for the per-step trace; the
+        # stacked program's cost structure is k-fold the same step).
+        log.write("profile_skipped",
+                  reason="profile_steps is not supported under "
+                         "ensemble_parallel; profile a single-member fit")
+
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_ensemble_state(
+        cfg, model, [seed + m for m in range(k)]
+    )
+    state = jax.device_put(state, mesh_lib.member_sharding(mesh))
+    train_step = train_lib.make_ensemble_train_step(
+        cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
+    )
+    eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
+    base_keys = jax.device_put(
+        train_lib.stack_member_keys([seed + m for m in range(k)]),
+        mesh_lib.member_sharding(mesh),
+    )
+    ckpts = [
+        ckpt_lib.Checkpointer(
+            os.path.abspath(ckpt_lib.member_dir(workdir, m)),
+            max_to_keep=cfg.train.max_to_keep,
+        )
+        for m in range(k)
+    ]
+    for m in range(k):
+        _load_or_write_run_meta(
+            ckpt_lib.member_dir(workdir, m), seed + m, cfg.name, resume=False
+        )
+
+    batches = pipeline.device_prefetch(
+        _train_stream(cfg, data_dir, seed, skip_batches=0),
+        sharding=mesh_lib.batch_sharding(mesh),
+        size=cfg.data.prefetch_batches,
+    )
+
+    best_auc = np.full((k,), -np.inf)
+    best_step = np.zeros((k,), np.int64)
+    since_best = np.zeros((k,), np.int64)
+    stopped_early = False
+    t_log, imgs_since = time.time(), 0
+    try:
+        for step_i in range(cfg.train.steps):
+            state, m_out = train_step(state, next(batches), base_keys)
+            imgs_since += cfg.data.batch_size
+
+            if (step_i + 1) % cfg.train.log_every == 0:
+                dt = time.time() - t_log
+                losses = np.asarray(jax.device_get(m_out["loss"]))
+                log.write(
+                    "train", step=step_i + 1,
+                    loss=round(float(losses.mean()), 6),
+                    loss_per_member=[round(float(x), 6) for x in losses],
+                    images_per_sec=round(imgs_since / max(dt, 1e-9), 2),
+                )
+                t_log, imgs_since = time.time(), 0
+
+            if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
+                grades, probs = _predict_split_members(
+                    cfg, state, data_dir, "val", mesh, eval_step
+                )
+                bin_labels = (grades >= 2).astype(np.float64)
+                member_probs = [
+                    p if cfg.model.head == "binary"
+                    else metrics.referable_probs_from_multiclass(p)
+                    for p in probs
+                ]
+                aucs = np.array([
+                    metrics.roc_auc(bin_labels, p) for p in member_probs
+                ])
+                ens_auc = metrics.roc_auc(
+                    bin_labels, metrics.ensemble_average(member_probs)
+                )
+                host_state = jax.device_get(state)
+                for m in range(k):
+                    ckpts[m].save(
+                        step_i + 1,
+                        train_lib.unstack_member(host_state, m),
+                        {"val_auc": float(aucs[m])},
+                    )
+                best_auc, best_step, since_best = _best_tracking_update(
+                    aucs, best_auc, best_step, since_best, step_i + 1,
+                    cfg.train.min_delta,
+                )
+                log.write(
+                    "eval", step=step_i + 1,
+                    val_auc_per_member=[round(float(a), 5) for a in aucs],
+                    ensemble_val_auc=round(float(ens_auc), 5),
+                    best_auc_per_member=[round(float(a), 5) for a in best_auc],
+                )
+                if np.all(since_best >= cfg.train.early_stop_patience):
+                    log.write("early_stop", step=step_i + 1,
+                              best_step=[int(s) for s in best_step])
+                    stopped_early = True
+                    break
+    finally:
+        if cfg.train.debug:
+            jax.config.update("jax_debug_nans", prev_debug_nans)
+
+    for c in ckpts:
+        c.wait()
+        c.close()
+    log.close()
+    return [
+        {
+            "member": m,
+            "workdir": ckpt_lib.member_dir(workdir, m),
+            "best_auc": float(best_auc[m]) if np.isfinite(best_auc[m]) else None,
+            "best_step": int(best_step[m]),
+            "stopped_early": stopped_early,
+        }
+        for m in range(k)
+    ]
 
 
 def fit_tf(

@@ -1,0 +1,145 @@
+"""Member-parallel ensemble training (train_lib ensemble steps +
+trainer.fit_ensemble_parallel; TrainConfig.ensemble_parallel).
+
+The contract: stacking k members on a member axis is a pure batching of
+the sequential driver — member m's slice of the stacked step must equal
+an independent single-model step under seed m (same keys, same batch),
+sharded or not — and the end-to-end driver must produce the same
+member_NN/{best,latest} checkpoint layout the sequential driver writes,
+so evaluate.py ensemble discovery cannot tell them apart.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib, trainer
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.data import tfrecord
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+from tests.test_train import make_batch, small_cfg, tree_allclose
+
+
+def _stacked_after_one_step(cfg, batch, seeds, mesh=None):
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_ensemble_state(cfg, model, seeds)
+    keys = train_lib.stack_member_keys(seeds)
+    if mesh is not None:
+        state = jax.device_put(state, mesh_lib.member_sharding(mesh))
+        keys = jax.device_put(keys, mesh_lib.member_sharding(mesh))
+        batch = mesh_lib.shard_batch(batch, mesh)
+    else:
+        batch = jax.device_put(batch)
+    step = train_lib.make_ensemble_train_step(cfg, model, tx, mesh=mesh)
+    new_state, m = step(state, batch, keys)
+    return jax.device_get(new_state), np.asarray(jax.device_get(m["loss"]))
+
+
+def test_stacked_step_equals_independent_members():
+    """Slice m of the stacked step == a single-model step under seed m
+    (same batch, same per-member base key) — the vmap is pure batching."""
+    cfg = small_cfg(augment=True)
+    batch = make_batch(cfg)
+    seeds = [0, 1]
+    stacked, losses = _stacked_after_one_step(cfg, batch, seeds)
+
+    model = models.build(cfg.model)
+    for m, seed in enumerate(seeds):
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(seed))
+        step = train_lib.make_train_step(cfg, model, tx, mesh=None)
+        solo, solo_m = step(state, jax.device_put(batch), jax.random.key(seed))
+        solo = jax.device_get(solo)
+        member = train_lib.unstack_member(stacked, m)
+        np.testing.assert_allclose(
+            losses[m], float(solo_m["loss"]), rtol=1e-5
+        )
+        tree_allclose(member.params, solo.params, rtol=2e-5, atol=1e-6)
+        tree_allclose(
+            member.batch_stats, solo.batch_stats, rtol=2e-5, atol=1e-6
+        )
+    # Different seeds must actually diverge (independent init/augment).
+    assert abs(losses[0] - losses[1]) > 0
+
+
+def test_member_sharded_equals_unsharded():
+    """The ('member', 'data') GSPMD sharding must not change numerics:
+    8 fake devices (member 2 x data 4) vs plain single-device vmap."""
+    cfg = small_cfg(augment=True)
+    batch = make_batch(cfg)
+    seeds = [3, 4]
+    mesh = mesh_lib.make_ensemble_mesh(2)
+    assert dict(mesh.shape) == {"member": 2, "data": 4}
+    sharded, loss_sh = _stacked_after_one_step(cfg, batch, seeds, mesh=mesh)
+    plain, loss_pl = _stacked_after_one_step(cfg, batch, seeds)
+    np.testing.assert_allclose(loss_sh, loss_pl, rtol=1e-5)
+    tree_allclose(sharded.params, plain.params, rtol=2e-5, atol=1e-6)
+    tree_allclose(sharded.batch_stats, plain.batch_stats, rtol=2e-5, atol=1e-6)
+
+
+def test_ensemble_eval_step_matches_single_eval():
+    cfg = small_cfg()
+    batch = make_batch(cfg)
+    model = models.build(cfg.model)
+    seeds = [5, 6]
+    state, _ = train_lib.create_ensemble_state(cfg, model, seeds)
+    ens = train_lib.make_ensemble_eval_step(cfg, model)
+    probs = np.asarray(ens(state, {"image": jax.device_put(batch["image"])}))
+    assert probs.shape == (2, batch["image"].shape[0])
+    solo_step = train_lib.make_eval_step(cfg, model)
+    for m in range(2):
+        solo = np.asarray(solo_step(
+            train_lib.unstack_member(state, m),
+            {"image": jax.device_put(batch["image"])},
+        ))
+        np.testing.assert_allclose(probs[m], solo, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_fit_ensemble_parallel_end_to_end(tmp_path):
+    """The driver trains k=2 members in one program and leaves the exact
+    sequential-layout artifacts: member_NN/{best,latest} orbax dirs, a
+    metrics.jsonl with per-member and ensemble val AUC, and checkpoints
+    evaluate_checkpoints can ensemble."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 3, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 2, seed=2)
+    tfrecord.write_synthetic_split(data_dir, "test", 24, 64, 2, seed=3)
+    cfg = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.steps=20", "train.eval_every=10", "data.batch_size=8",
+        "eval.batch_size=8",
+    ])
+    workdir = str(tmp_path / "ck")
+    results = trainer.fit_ensemble(cfg, data_dir, workdir)
+    assert [r["member"] for r in results] == [0, 1]
+    for r in results:
+        assert r["best_auc"] is not None
+        assert os.path.isdir(os.path.join(r["workdir"], "best"))
+        assert os.path.isdir(os.path.join(r["workdir"], "latest"))
+        meta = json.load(open(os.path.join(r["workdir"], "run_meta.json")))
+        assert meta["seed"] == cfg.train.seed + r["member"]
+    evals = [r for r in read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+             if r.get("kind") == "eval"]
+    assert evals and len(evals[-1]["val_auc_per_member"]) == 2
+    assert "ensemble_val_auc" in evals[-1]
+
+    report = trainer.evaluate_checkpoints(
+        cfg, data_dir, ckpt_lib.discover_member_dirs(workdir), split="test"
+    )
+    assert report["n_models"] == 2
+    assert 0.0 <= report["auc"] <= 1.0
+
+
+def test_ensemble_parallel_rejects_tf_backend(tmp_path):
+    cfg = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+    ])
+    with pytest.raises(ValueError, match="flax-path"):
+        trainer.fit_ensemble(cfg, str(tmp_path), str(tmp_path), backend="tf")
