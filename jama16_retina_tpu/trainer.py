@@ -240,6 +240,7 @@ def fit(
     )
 
     start_step = 0
+    best_auc, best_step, since_best = -np.inf, 0, 0
     if cfg.train.resume and ckpt.latest_step is not None:
         # Resume must continue the SAME optimization — an EMA-presence
         # mismatch means the config changed under the run; fail loudly
@@ -257,7 +258,17 @@ def fit(
         state = ckpt.restore(ckpt_lib.abstract_like(state), ckpt.latest_step)
         state = jax.device_put(state, mesh_lib.replicated(mesh))
         start_step = int(jax.device_get(state.step))
-        log.write("resume", step=start_step)
+        # Reconstruct best/early-stop tracking from the best-manager's
+        # on-disk metrics — forgetting the pre-interruption peak would
+        # both overrun the patience budget and let a worse post-resume
+        # step masquerade as "best" in the report.
+        info = ckpt.best_info()
+        if info is not None:
+            best_step, best_auc = info
+            since_best = max(0, (start_step - best_step) // cfg.train.eval_every)
+        log.write("resume", step=start_step,
+                  best_auc=(round(best_auc, 5) if np.isfinite(best_auc) else None),
+                  since_best=int(since_best))
 
     base_key = jax.random.key(seed)
     # skip_batches=start_step: one batch per completed step, so a resumed
@@ -287,7 +298,6 @@ def fit(
             profile_stop = profile_start + cfg.train.profile_steps
     tracing = False
 
-    best_auc, best_step, since_best = -np.inf, start_step, 0
     stopped_early = False
     t_log, imgs_since = time.time(), 0
     try:
@@ -416,16 +426,12 @@ def fit_ensemble_parallel(
     val-AUC per member, so evaluate.py/predict.py ensemble discovery is
     oblivious to how the members were trained. Early stopping fires when
     EVERY member has exhausted its patience; each member's best
-    checkpoint is whatever its own val-AUC peak was.
+    checkpoint is whatever its own val-AUC peak was. ``--resume``
+    restores every member's latest checkpoint (this driver keeps them in
+    lock-step) and continues the exact stream via skip_batches, same as
+    fit().
     """
     k = cfg.train.ensemble_size
-    seed = cfg.train.seed
-    if cfg.train.resume:
-        raise NotImplementedError(
-            "resume of a member-parallel run is not wired yet: restart "
-            "from scratch or train members sequentially "
-            "(train.ensemble_parallel=false) to resume"
-        )
     if jax.process_count() > 1:
         # The pipeline's per-process sharding yields 1-D-DP local blocks;
         # assembling them under the 2-D ('member', 'data') layout (data-
@@ -442,6 +448,17 @@ def fit_ensemble_parallel(
     prev_debug_nans = jax.config.jax_debug_nans
     if cfg.train.debug:
         jax.config.update("jax_debug_nans", True)
+    # The persisted member-0 seed is the base seed on resume (stream
+    # continuity — same rule as fit()); member m's meta then pins base+m.
+    seed = _load_or_write_run_meta(
+        ckpt_lib.member_dir(workdir, 0), cfg.train.seed, cfg.name,
+        cfg.train.resume,
+    )
+    for m in range(1, k):
+        _load_or_write_run_meta(
+            ckpt_lib.member_dir(workdir, m), seed + m, cfg.name,
+            cfg.train.resume,
+        )
     log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
     log.write(
         "config", name=cfg.name, seed=seed, ensemble_parallel=True,
@@ -477,24 +494,72 @@ def fit_ensemble_parallel(
         )
         for m in range(k)
     ]
-    for m in range(k):
-        _load_or_write_run_meta(
-            ckpt_lib.member_dir(workdir, m), seed + m, cfg.name, resume=False
-        )
+
+    start_step = 0
+    best_auc = np.full((k,), -np.inf)
+    best_step = np.zeros((k,), np.int64)
+    since_best = np.zeros((k,), np.int64)
+    if cfg.train.resume:
+        latest = [c.latest_step for c in ckpts]
+        if any(s is not None for s in latest):
+            # This driver checkpoints every member at every eval step, so
+            # a valid member-parallel workdir has all members at ONE step;
+            # anything else is a sequential-run workdir or a torn state.
+            if None in latest or len(set(latest)) != 1:
+                raise ValueError(
+                    f"member checkpoints are at different steps {latest} — "
+                    "not a member-parallel workdir (resume a sequential "
+                    "ensemble with train.ensemble_parallel=false)"
+                )
+            step0 = latest[0]
+            for c in ckpts:
+                has_ema = c.saved_with_ema(step0)
+                if has_ema is not None and has_ema != (cfg.train.ema_decay > 0):
+                    raise ValueError(
+                        f"checkpoints in {workdir} were trained with ema "
+                        f"{'on' if has_ema else 'off'} but this run sets "
+                        f"train.ema_decay={cfg.train.ema_decay} — resume "
+                        "with a matching config"
+                    )
+            # Shape-only skeleton per member (leaf[1:] strips the member
+            # dim) — no device->host transfer of the fresh stacked state.
+            member_abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x)[1:], x.dtype),
+                state,
+            )
+            members = [c.restore(member_abstract, step0) for c in ckpts]
+            state = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *members
+            )
+            state = jax.device_put(state, mesh_lib.member_sharding(mesh))
+            start_step = int(step0)
+            # Per-member best/early-stop tracking from each best-manager's
+            # on-disk metrics — same reconstruction fit() does on resume.
+            for m, c in enumerate(ckpts):
+                info = c.best_info()
+                if info is not None:
+                    best_step[m], best_auc[m] = info[0], info[1]
+                    since_best[m] = max(
+                        0, (start_step - info[0]) // cfg.train.eval_every
+                    )
+            log.write(
+                "resume", step=start_step,
+                best_auc_per_member=[
+                    (round(float(a), 5) if np.isfinite(a) else None)
+                    for a in best_auc
+                ],
+            )
 
     batches = pipeline.device_prefetch(
-        _train_stream(cfg, data_dir, seed, skip_batches=0),
+        _train_stream(cfg, data_dir, seed, skip_batches=start_step),
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
     )
 
-    best_auc = np.full((k,), -np.inf)
-    best_step = np.zeros((k,), np.int64)
-    since_best = np.zeros((k,), np.int64)
     stopped_early = False
     t_log, imgs_since = time.time(), 0
     try:
-        for step_i in range(cfg.train.steps):
+        for step_i in range(start_step, cfg.train.steps):
             state, m_out = train_step(state, next(batches), base_keys)
             imgs_since += cfg.data.batch_size
 

@@ -144,6 +144,19 @@ class Checkpointer:
     def best_step(self) -> int | None:
         return self._best.best_step()
 
+    def best_info(self) -> tuple[int, float] | None:
+        """(step, val_auc) of the retained best checkpoint, from the
+        best-manager's on-disk metrics — lets a resumed run reconstruct
+        its best/early-stop tracking instead of forgetting the
+        pre-interruption peak."""
+        s = self._best.best_step()
+        if s is None:
+            return None
+        m = self._best.metrics(s)
+        if m is None:
+            return None
+        return int(s), float(m[BEST_METRIC])
+
     @property
     def latest_step(self) -> int | None:
         return self._latest.latest_step()

@@ -137,6 +137,65 @@ def test_fit_ensemble_parallel_end_to_end(tmp_path):
     assert 0.0 <= report["auc"] <= 1.0
 
 
+@pytest.mark.slow
+def test_ensemble_parallel_resume_matches_uninterrupted(tmp_path):
+    """Interrupted-at-10 + resumed-to-20 must equal an uninterrupted
+    20-step member-parallel run exactly: same final per-member val AUCs
+    and bit-identical latest checkpoints (deterministic stream replay +
+    (seed, step)-derived keys, SURVEY.md §5.4 — now for k members)."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 3, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 2, seed=2)
+    # Constant LR: cosine's decay horizon depends on train.steps, and the
+    # interruption is simulated with a shorter steps= (same rationale as
+    # the sequential exact-resume test in test_integration.py).
+    base = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.eval_every=10", "data.batch_size=8", "eval.batch_size=8",
+        "train.lr_schedule=constant",
+    ])
+
+    def run(workdir, steps, resume=False):
+        cfg = override(base, [f"train.steps={steps}",
+                              f"train.resume={str(resume).lower()}"])
+        return trainer.fit_ensemble(cfg, data_dir, str(tmp_path / workdir))
+
+    full = run("full", 20)
+    run("split", 10)
+    resumed = run("split", 20, resume=True)
+    evals = {
+        w: [r for r in read_jsonl(str(tmp_path / w / "metrics.jsonl"))
+            if r.get("kind") == "eval" and r["step"] == 20]
+        for w in ("full", "split")
+    }
+    assert (evals["full"][-1]["val_auc_per_member"]
+            == evals["split"][-1]["val_auc_per_member"])
+    # Holds contractually (not just because AUC improved): resume
+    # reconstructs per-member best tracking from the best-manager's
+    # on-disk metrics, so the pre-interruption step-10 peak competes.
+    assert [r["best_auc"] for r in full] == [r["best_auc"] for r in resumed]
+    assert [r["best_step"] for r in full] == [r["best_step"] for r in resumed]
+    # The resumed run logged its restart point.
+    assert any(
+        r.get("kind") == "resume" and r["step"] == 10
+        for r in read_jsonl(str(tmp_path / "split" / "metrics.jsonl"))
+    )
+    # Bit-identical final states, member by member.
+    model = models.build(base.model)
+    cfg20 = override(base, ["train.steps=20"])
+    for m in range(2):
+        states = []
+        for w in ("full", "split"):
+            st, _ = train_lib.create_state(cfg20, model, jax.random.key(m))
+            ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(str(tmp_path / w), m))
+            states.append(ck.restore(
+                ckpt_lib.abstract_like(jax.device_get(st)), ck.latest_step
+            ))
+            ck.close()
+        for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_ensemble_parallel_rejects_tf_backend(tmp_path):
     cfg = override(get_config("smoke"), [
         "train.ensemble_size=2", "train.ensemble_parallel=true",
