@@ -29,6 +29,9 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        HBM-bound on stem activations (docs/PERF.md); this
                        number shows the amortized rate the chip reaches
                        when batch is not pinned by the experiment.
+  eval_images_per_sec — the jit eval step (forward-only, eval batch) on
+                       this chip: the per-model cost of the k-model
+                       ensemble evaluation protocol (BASELINE.json:10).
   ensemble4_member_images_per_sec / ensemble4_parallel_speedup —
                        the member-parallel ensemble step (4 stacked
                        members, train_lib.make_ensemble_train_step) in
@@ -282,10 +285,35 @@ def main() -> None:
         extras["pipeline_fed"] = round(rate, 2)
         _log(f"pipeline_fed: {extras['pipeline_fed']} img/s/chip")
 
+    # Eval-side rate: the forward-only jit eval step at the eval batch
+    # size — multiply by k models x test-set size for the ensemble
+    # evaluation cost (ten-model protocol, BASELINE.json:10).
+    try:
+        eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+        eval_bs = cfg.eval.batch_size
+        eval_batch = mesh_lib.shard_batch(
+            {"image": rng.integers(0, 256, (eval_bs, size, size, 3), np.uint8)},
+            mesh,
+        )
+        probs = eval_step(state, eval_batch)
+        jax.block_until_ready(probs)
+        n_eval = 30
+        t0 = time.time()
+        for _ in range(n_eval):
+            probs = eval_step(state, eval_batch)
+        jax.block_until_ready(probs)
+        extras["eval_images_per_sec"] = round(
+            n_eval * eval_bs / (time.time() - t0) / n_dev, 2
+        )
+        _log(f"eval step: {extras['eval_images_per_sec']} img/s/chip "
+             f"(batch {eval_bs}, forward-only)")
+    except Exception as e:  # pragma: no cover - bench must emit JSON
+        _log(f"eval bench failed: {type(e).__name__}: {e}")
+
     # Batch-scaling datapoint: per-chip batch 128 (see docstring). Placed
-    # LAST because the step donates its state argument — `state` must not
-    # be consumed while earlier sections still need it. A second compile
-    # (~40s); the measurement itself is ~2s.
+    # AFTER every section that reads `state`: the donating step consumes
+    # its buffers, and a mid-section failure here must not poison a
+    # later measurement. A second compile (~40s); the measurement ~2s.
     if not args.skip_b128:
         try:
             big = 128 * n_dev

@@ -144,6 +144,70 @@ def _best_tracking_update(
     )
 
 
+def _check_ema_compat(ckpt, cfg: ExperimentConfig, where: str, step=None):
+    """Resume must continue the SAME optimization — an EMA-presence
+    mismatch means the config changed under the run; fail loudly rather
+    than silently drop/invent the shadow mid-training. (None = metadata
+    unreadable: skip the guard rather than misdiagnose.)"""
+    has_ema = ckpt.saved_with_ema(step)
+    if has_ema is not None and has_ema != (cfg.train.ema_decay > 0):
+        raise ValueError(
+            f"checkpoint in {where} was trained with ema "
+            f"{'on' if has_ema else 'off'} but this run sets "
+            f"train.ema_decay={cfg.train.ema_decay} — resume with a "
+            "matching config"
+        )
+
+
+def _reconstruct_best_tracking(
+    workdir: str, start_step: int, cfg: ExperimentConfig, ckpts: list
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Best/early-stop tracking as of ``start_step``, for resume.
+
+    Primary source: replay the run's own eval history (metrics.jsonl)
+    through _best_tracking_update — the SAME min_delta/patience rule the
+    live loop applies, so a resumed run stops exactly when an
+    uninterrupted one would (the best manager's raw argmax is NOT
+    equivalent: sub-min_delta improvements enter its top-k without
+    resetting patience). Replays every eval record at step <= start_step
+    in file order, which also chains across repeated interruptions; a
+    reused workdir whose old evals share step numbers yields
+    conservative (never lost) tracking. Fallback when no JSONL survives:
+    the best manager's retained peak, with patience derived from the
+    eval cadence."""
+    from jama16_retina_tpu.utils.logging import read_jsonl
+
+    k = len(ckpts)
+    best_auc = np.full((k,), -np.inf)
+    best_step = np.zeros((k,), np.int64)
+    since_best = np.zeros((k,), np.int64)
+    path = os.path.join(workdir, "metrics.jsonl")
+    evals = []
+    if os.path.exists(path):
+        for r in read_jsonl(path):
+            if r.get("kind") != "eval" or r.get("step", 0) > start_step:
+                continue
+            if "val_auc_per_member" in r and len(r["val_auc_per_member"]) == k:
+                evals.append((r["step"], r["val_auc_per_member"]))
+            elif "val_auc" in r and k == 1:
+                evals.append((r["step"], [r["val_auc"]]))
+    if evals:
+        for step, aucs in evals:
+            best_auc, best_step, since_best = _best_tracking_update(
+                aucs, best_auc, best_step, since_best, step,
+                cfg.train.min_delta,
+            )
+        return best_auc, best_step, since_best
+    for m, ckpt in enumerate(ckpts):
+        info = ckpt.best_info()
+        if info is not None:
+            best_step[m], best_auc[m] = info
+            since_best[m] = max(
+                0, (start_step - info[0]) // cfg.train.eval_every
+            )
+    return best_auc, best_step, since_best
+
+
 def _eval_and_track(
     cfg: ExperimentConfig, log: RunLog, ckpt, step: int,
     predict_fn, state_for_save,
@@ -165,7 +229,10 @@ def _eval_and_track(
         auc, best_auc, best_step, since_best, step, cfg.train.min_delta
     )
     best_auc, best_step, since_best = float(b_auc), int(b_step), int(since)
-    log.write("eval", step=step, val_auc=round(auc, 5),
+    # val_auc is logged at FULL precision: it is the replay source for
+    # _reconstruct_best_tracking on resume (rounding would leak into the
+    # resumed run's best tracking). best_auc is display-only.
+    log.write("eval", step=step, val_auc=float(auc),
               best_auc=round(best_auc, 5), since_best=since_best)
     stop = since_best >= cfg.train.early_stop_patience
     if stop:
@@ -242,33 +309,23 @@ def fit(
     start_step = 0
     best_auc, best_step, since_best = -np.inf, 0, 0
     if cfg.train.resume and ckpt.latest_step is not None:
-        # Resume must continue the SAME optimization — an EMA-presence
-        # mismatch means the config changed under the run; fail loudly
-        # rather than silently drop/invent the shadow mid-training.
-        # (None = metadata unreadable: skip the guard rather than
-        # misdiagnose an EMA run as ema-off.)
-        has_ema = ckpt.saved_with_ema(ckpt.latest_step)
-        if has_ema is not None and has_ema != (cfg.train.ema_decay > 0):
-            raise ValueError(
-                f"checkpoint in {workdir} was trained with ema "
-                f"{'on' if has_ema else 'off'} but this run sets "
-                f"train.ema_decay={cfg.train.ema_decay} — resume with a "
-                "matching config"
-            )
+        _check_ema_compat(ckpt, cfg, workdir, ckpt.latest_step)
         state = ckpt.restore(ckpt_lib.abstract_like(state), ckpt.latest_step)
         state = jax.device_put(state, mesh_lib.replicated(mesh))
         start_step = int(jax.device_get(state.step))
-        # Reconstruct best/early-stop tracking from the best-manager's
-        # on-disk metrics — forgetting the pre-interruption peak would
-        # both overrun the patience budget and let a worse post-resume
-        # step masquerade as "best" in the report.
-        info = ckpt.best_info()
-        if info is not None:
-            best_step, best_auc = info
-            since_best = max(0, (start_step - best_step) // cfg.train.eval_every)
+        # Rebuild best/early-stop tracking as of the interruption —
+        # forgetting the pre-interruption peak would both overrun the
+        # patience budget and let a worse post-resume step masquerade as
+        # "best" in the report.
+        b_auc, b_step, since = _reconstruct_best_tracking(
+            workdir, start_step, cfg, [ckpt]
+        )
+        best_auc, best_step, since_best = (
+            float(b_auc[0]), int(b_step[0]), int(since[0])
+        )
         log.write("resume", step=start_step,
                   best_auc=(round(best_auc, 5) if np.isfinite(best_auc) else None),
-                  since_best=int(since_best))
+                  since_best=since_best)
 
     base_key = jax.random.key(seed)
     # skip_batches=start_step: one batch per completed step, so a resumed
@@ -512,15 +569,10 @@ def fit_ensemble_parallel(
                     "ensemble with train.ensemble_parallel=false)"
                 )
             step0 = latest[0]
-            for c in ckpts:
-                has_ema = c.saved_with_ema(step0)
-                if has_ema is not None and has_ema != (cfg.train.ema_decay > 0):
-                    raise ValueError(
-                        f"checkpoints in {workdir} were trained with ema "
-                        f"{'on' if has_ema else 'off'} but this run sets "
-                        f"train.ema_decay={cfg.train.ema_decay} — resume "
-                        "with a matching config"
-                    )
+            for m, c in enumerate(ckpts):
+                _check_ema_compat(
+                    c, cfg, ckpt_lib.member_dir(workdir, m), step0
+                )
             # Shape-only skeleton per member (leaf[1:] strips the member
             # dim) — no device->host transfer of the fresh stacked state.
             member_abstract = jax.tree.map(
@@ -533,15 +585,11 @@ def fit_ensemble_parallel(
             )
             state = jax.device_put(state, mesh_lib.member_sharding(mesh))
             start_step = int(step0)
-            # Per-member best/early-stop tracking from each best-manager's
-            # on-disk metrics — same reconstruction fit() does on resume.
-            for m, c in enumerate(ckpts):
-                info = c.best_info()
-                if info is not None:
-                    best_step[m], best_auc[m] = info[0], info[1]
-                    since_best[m] = max(
-                        0, (start_step - info[0]) // cfg.train.eval_every
-                    )
+            # Same eval-history replay fit() does on resume — exact
+            # min_delta/patience semantics, per member.
+            best_auc, best_step, since_best = _reconstruct_best_tracking(
+                workdir, start_step, cfg, ckpts
+            )
             log.write(
                 "resume", step=start_step,
                 best_auc_per_member=[
@@ -601,9 +649,11 @@ def fit_ensemble_parallel(
                     aucs, best_auc, best_step, since_best, step_i + 1,
                     cfg.train.min_delta,
                 )
+                # Full precision on val_auc_per_member — the resume
+                # replay source (same note as _eval_and_track).
                 log.write(
                     "eval", step=step_i + 1,
-                    val_auc_per_member=[round(float(a), 5) for a in aucs],
+                    val_auc_per_member=[float(a) for a in aucs],
                     ensemble_val_auc=round(float(ens_auc), 5),
                     best_auc_per_member=[round(float(a), 5) for a in best_auc],
                 )

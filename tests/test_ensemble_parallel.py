@@ -101,6 +101,23 @@ def test_ensemble_eval_step_matches_single_eval():
         np.testing.assert_allclose(probs[m], solo, rtol=2e-5, atol=1e-6)
 
 
+def test_ensemble_eval_step_multiclass_shapes():
+    """The stacked eval path must carry the 5-class head: probs come back
+    [k, B, C] and collapse member-wise to referable probabilities."""
+    from jama16_retina_tpu.eval import metrics as metrics_lib
+
+    cfg = small_cfg(head="multi")
+    batch = make_batch(cfg)
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [7, 8])
+    ens = train_lib.make_ensemble_eval_step(cfg, model)
+    probs = np.asarray(ens(state, {"image": jax.device_put(batch["image"])}))
+    assert probs.shape == (2, batch["image"].shape[0], 5)
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-5)
+    referable = metrics_lib.referable_probs_from_multiclass(probs[0])
+    assert referable.shape == (batch["image"].shape[0],)
+
+
 @pytest.mark.slow
 def test_fit_ensemble_parallel_end_to_end(tmp_path):
     """The driver trains k=2 members in one program and leaves the exact

@@ -170,8 +170,15 @@ def test_resume_continues_from_checkpoint(smoke_cfg, data_dir, tmp_path):
     log = read_jsonl(os.path.join(workdir, "metrics.jsonl"))
     resumes = [r for r in log if r["kind"] == "resume"]
     assert resumes and resumes[0]["step"] == 20
+    # Resume reconstructs best tracking from the best manager's on-disk
+    # metrics — the pre-interruption peak, not a -inf reset.
+    pre_best = max(
+        r["val_auc"] for r in log if r["kind"] == "eval" and r["step"] <= 20
+    )
+    assert resumes[0]["best_auc"] == pytest.approx(pre_best, abs=1e-5)
     evals = [r for r in log if r["kind"] == "eval"]
     assert evals[-1]["step"] == 30
+    assert evals[-1]["best_auc"] >= pre_best - 1e-9
 
 
 def test_ensemble_k2_beats_or_matches_members(smoke_cfg, data_dir, tmp_path):
