@@ -50,6 +50,11 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self._max_to_keep = max_to_keep
+        # Distinct barrier_sync_key_prefix per manager: on multi-host
+        # runs both managers finalize async saves through named orbax
+        # barriers, and with the default (empty) prefix the two managers'
+        # barriers collide ("Barrier ... is already ongoing"), deadlocking
+        # the coordination service at the next save.
         self._best = ocp.CheckpointManager(
             os.path.join(directory, "best"),
             options=ocp.CheckpointManagerOptions(
@@ -57,37 +62,56 @@ class Checkpointer:
                 best_fn=lambda m: float(m[BEST_METRIC]),
                 best_mode="max",
                 create=True,
+                multiprocessing_options=ocp.options.MultiprocessingOptions(
+                    barrier_sync_key_prefix="best"
+                ),
             ),
         )
         self._latest = ocp.CheckpointManager(
             os.path.join(directory, "latest"),
-            options=ocp.CheckpointManagerOptions(max_to_keep=1, create=True),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=1,
+                create=True,
+                multiprocessing_options=ocp.options.MultiprocessingOptions(
+                    barrier_sync_key_prefix="latest"
+                ),
+            ),
         )
+        # In-memory view of the best-manager's kept metrics: the
+        # enters-top-k decision gates a COLLECTIVE save, so on multi-host
+        # every process must reach the identical verdict — re-reading
+        # just-written (possibly still-finalizing) metrics from disk is
+        # a race across processes. Seeded from disk once at construction
+        # (all saves are finished then), updated in-memory per save.
+        self._best_kept: list[float] = []
+        for s in self._best.all_steps():
+            m = self._best.metrics(s)
+            if m is not None:
+                self._best_kept.append(float(m[BEST_METRIC]))
+        self._best_kept = sorted(self._best_kept)[-max_to_keep:]
 
     def save(self, step: int, state: TrainState, metrics: dict) -> None:
         """``latest/`` is written every time; ``best/`` only when this step
         would actually enter the top-k by metric — otherwise orbax would
         serialize the full state just to delete it during retention,
         doubling checkpoint IO on every non-improving eval."""
-        if self._enters_best(float(metrics[BEST_METRIC])):
+        metric = float(metrics[BEST_METRIC])
+        if self._enters_best(metric):
             self._best.save(
                 step,
                 args=ocp.args.StandardSave(state),
                 metrics={k: float(v) for k, v in metrics.items()},
             )
+            self._best_kept = sorted(self._best_kept + [metric])
+            self._best_kept = self._best_kept[-self._max_to_keep:]
         self._latest.save(step, args=ocp.args.StandardSave(state))
 
     def _enters_best(self, metric: float) -> bool:
-        steps = self._best.all_steps()
-        if len(steps) < self._max_to_keep:
+        # Decided from the in-memory view (see __init__) — deterministic
+        # across processes because the metric sequence is.
+        if len(self._best_kept) < self._max_to_keep:
             return True
-        kept = []
-        for s in steps:
-            m = self._best.metrics(s)
-            if m is None:  # metricless step (shouldn't happen): displaceable
-                return True
-            kept.append(float(m[BEST_METRIC]))
-        return metric > min(kept)
+        return metric > self._best_kept[0]
 
     def wait(self) -> None:
         self._best.wait_until_finished()

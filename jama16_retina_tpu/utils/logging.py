@@ -6,6 +6,14 @@ reports) — identical shape for every backend/config so runs diff cleanly.
 Optional TensorBoard scalars (``tensorboard=True``) mirror the numeric
 fields of train/eval records into ``<workdir>/tb`` for users of the
 reference's TF-era tooling; the JSONL stays the system of record.
+
+Multi-host: every process runs the same loop over the same global state,
+so process 0 owns ``metrics.jsonl`` (the system of record — concurrent
+appends from P processes would tear/duplicate it) and every other
+process mirrors its records to ``metrics.p{N}.jsonl``. The per-process
+mirrors are the heartbeat files of SURVEY.md §5.3: a wedged host is
+visible as a stale ``metrics.p{N}.jsonl`` mtime even while process 0
+keeps advancing toward the blocked collective.
 """
 
 from __future__ import annotations
@@ -23,17 +31,38 @@ class RunLog:
     def __init__(self, workdir: str, name: str = "metrics.jsonl",
                  tensorboard: bool = False):
         os.makedirs(workdir, exist_ok=True)
+        self._workdir = workdir
+        self._name = name
+        self._want_tb = tensorboard
+        # The file paths depend on jax.process_index(), which would
+        # force-initialize a jax backend from a mere constructor — defer
+        # until the first write (by which point the trainer has long
+        # since initialized jax deliberately).
         self.path = os.path.join(workdir, name)
-        self._fh: IO = open(self.path, "a")
+        self._fh: IO | None = None
         self._tb = None
-        if tensorboard:
+        self._opened = False
+
+    def _ensure_open(self) -> None:
+        if self._opened:
+            return
+        self._opened = True
+        import jax
+
+        idx = jax.process_index()
+        if idx != 0:
+            stem, ext = os.path.splitext(self._name)
+            self.path = os.path.join(self._workdir, f"{stem}.p{idx}{ext}")
+        self._fh = open(self.path, "a")
+        if self._want_tb and idx == 0:
             import tensorflow as tf
 
             self._tb = tf.summary.create_file_writer(
-                os.path.join(workdir, "tb")
+                os.path.join(self._workdir, "tb")
             )
 
     def write(self, kind: str, **fields) -> dict:
+        self._ensure_open()
         rec = {"kind": kind, "t": round(time.time(), 3), **fields}
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
@@ -51,11 +80,26 @@ class RunLog:
         return rec
 
     def close(self) -> None:
-        self._fh.close()
+        if self._fh is not None:
+            self._fh.close()
         if self._tb is not None:
             self._tb.close()
 
 
 def read_jsonl(path: str) -> list[dict]:
+    """Parse a JSONL file, SKIPPING malformed lines (warned, not raised):
+    a run killed mid-flush leaves a torn final line, and the resume path
+    replays this file — a preempted run must stay resumable."""
+    records = []
     with open(path) as fh:
-        return [json.loads(line) for line in fh if line.strip()]
+        for i, line in enumerate(fh):
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                absl_logging.warning(
+                    "%s:%d: skipping malformed JSONL line (torn write?)",
+                    path, i + 1,
+                )
+    return records
