@@ -272,21 +272,25 @@ def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):
     return jax.pmap(step, axis_name=axis, in_axes=(0, 0, None))
 
 
+def eval_params(state: TrainState):
+    """The params eval scores with: the EMA shadow when carried (it is
+    the paper-quality model of record; train keeps optimizing the raw
+    params), else the raw params. THE one copy of this preference —
+    every backend/entry point must score the same weights for the same
+    checkpoint."""
+    return state.params if state.ema_params is None else state.ema_params
+
+
 def _eval_probs(
     state: TrainState, images: jnp.ndarray, model, cfg: ExperimentConfig
 ) -> jnp.ndarray:
     """Normalized images -> per-example probabilities for ONE model.
 
-    EMA shadow params, when carried, are what the paper-quality model IS
-    — eval always prefers them (train keeps optimizing the raw params).
     With ``cfg.eval.tta``, flip-averaged TTA stacks the 4 views on a
     leading axis and ``lax.map``s so the backbone is traced/compiled ONCE
     (4 sequential passes), not inlined 4x into one giant program.
     """
-    eval_params = (
-        state.params if state.ema_params is None else state.ema_params
-    )
-    variables = {"params": eval_params, "batch_stats": state.batch_stats}
+    variables = {"params": eval_params(state), "batch_stats": state.batch_stats}
 
     def forward(x):
         logits, _ = model.apply(variables, x, train=False)

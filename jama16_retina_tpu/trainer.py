@@ -948,9 +948,7 @@ def evaluate_checkpoints(
             # Same preference as the jit eval step: the EMA shadow is
             # the model of record when it was trained with one.
             tf_backend.load_flax_state(
-                keras_model,
-                state.params if state.ema_params is None else state.ema_params,
-                state.batch_stats,
+                keras_model, train_lib.eval_params(state), state.batch_stats
             )
         for key, from_dir, s in passes:
             g, p, nm = member_predict(state, from_dir, s)

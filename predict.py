@@ -6,9 +6,10 @@ pair (SURVEY.md §1): point at a trained checkpoint (or an ensemble root)
 and at image files/directories, and get one JSON line per image —
   {"image": path, "prob": P(referable), "referable": bool, ...}
 — produced by the SAME offline fundus normalization the preprocessing
-scripts apply (preprocess/fundus.py) and the SAME jit eval step /
-ensemble averaging evaluate.py uses, so a prediction here is exactly
-what the eval metrics were computed over.
+scripts apply (preprocess/fundus.py) and the same forward/ensemble
+machinery evaluate.py uses (the jit eval step under --device={tpu,cpu};
+the keras legacy backend under --device=tf, float-comparable), so a
+prediction here is what the eval metrics were computed over.
 
 Examples:
   python predict.py --checkpoint_dir=/ckpt/run1 --images photo.jpeg
@@ -42,7 +43,13 @@ _THRESHOLD = flags.DEFINE_float(
     "decision threshold from an evaluate.py operating point; <0 emits "
     "probabilities only",
 )
-_DEVICE = flags.DEFINE_enum("device", "tpu", ["tpu", "cpu"], "backend gate")
+_DEVICE = flags.DEFINE_enum(
+    "device", "tpu", ["tpu", "cpu", "tf"],
+    "backend gate (BASELINE.json:5): tpu/cpu run the Flax model under "
+    "jit; tf runs the legacy keras backend on host TF, restored from the "
+    "same orbax checkpoints — predictions stay comparable because the "
+    "normalization and head nonlinearity are shared",
+)
 _BATCH = flags.DEFINE_integer("batch_size", 8, "prediction batch size")
 _BEN_GRAHAM = flags.DEFINE_boolean(
     "ben_graham", False,
@@ -79,7 +86,9 @@ def _expand(patterns: list[str]) -> list[str]:
 
 def main(argv):
     del argv
-    if _DEVICE.value == "cpu":
+    if _DEVICE.value in ("cpu", "tf"):
+        # tf mode restores orbax checkpoints through jax — pin jax to CPU
+        # so no TPU is required for the legacy path.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -127,8 +136,14 @@ def main(argv):
 
     import jax
 
-    model = models.build(cfg.model)
-    eval_step = train_lib.make_eval_step(cfg, model)
+    model = models.build(cfg.model)  # flax tree = the checkpoint schema
+    use_tf = _DEVICE.value == "tf"
+    if use_tf:
+        from jama16_retina_tpu.models import tf_backend
+
+        keras_model = models.build(cfg.model, backend="tf")
+    else:
+        eval_step = train_lib.make_eval_step(cfg, model)
     # Padded fixed-size batches built ONCE (jit compiles once per run;
     # every ensemble member scores the same batches, only state differs).
     batches, block_lens = [], []
@@ -141,10 +156,21 @@ def main(argv):
     prob_list = []
     for d in dirs:
         state = trainer.restore_for_eval(cfg, model, d)
-        probs = [
-            np.asarray(eval_step(state, {"image": b}))[:n]
-            for b, n in zip(batches, block_lens)
-        ]
+        if use_tf:
+            tf_backend.load_flax_state(
+                keras_model, train_lib.eval_params(state), state.batch_stats
+            )
+            probs = [
+                tf_backend.predict_probs(
+                    keras_model, b, cfg.model.head, tta=cfg.eval.tta
+                )[:n]
+                for b, n in zip(batches, block_lens)
+            ]
+        else:
+            probs = [
+                np.asarray(eval_step(state, {"image": b}))[:n]
+                for b, n in zip(batches, block_lens)
+            ]
         prob_list.append(np.concatenate(probs))
     probs = metrics.ensemble_average(prob_list)
 

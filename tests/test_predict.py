@@ -61,6 +61,51 @@ def run_predict(args):
 
 
 @pytest.mark.slow
+def test_predict_tf_backend_matches_flax(tmp_path):
+    """--device=tf (keras legacy backend) on the same checkpoint and
+    photos produces the same probabilities as the flax path to float
+    tolerance — the backend gate is now complete on all three user-facing
+    entry points (train/evaluate/predict)."""
+    overrides = [
+        "model.arch=inception_v3", "model.image_size=75",
+        "model.compute_dtype=float32", "model.aux_head=false",
+    ]
+    inception_args = [a for o in overrides for a in ("--set", o)]
+    cfg = override(get_config("smoke"), overrides)
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(1))
+    ckdir = str(tmp_path / "ckpt")
+    ck = ckpt_lib.Checkpointer(ckdir)
+    ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+    ck.wait()
+    ck.close()
+    import cv2
+
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    for i in range(2):
+        img = synthetic.render_fundus(
+            np.random.default_rng(i), 3, synthetic.SynthConfig(image_size=96)
+        )
+        cv2.imwrite(str(imgdir / f"eye_{i}.jpeg"), img[..., ::-1])
+
+    probs = {}
+    for device in ("cpu", "tf"):
+        res = run_predict([
+            "--config=smoke", *inception_args,
+            f"--checkpoint_dir={ckdir}", f"--images={imgdir}",
+            f"--device={device}", "--batch_size=2",
+        ])
+        detail = f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-2000:]}"
+        assert res.returncode == 0, detail
+        rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
+        probs[device] = {r["image"]: r["prob"] for r in rows if "prob" in r}
+    assert probs["cpu"].keys() == probs["tf"].keys() and len(probs["cpu"]) == 2
+    for k in probs["cpu"]:
+        assert abs(probs["cpu"][k] - probs["tf"][k]) < 2e-3, (k, probs)
+
+
+@pytest.mark.slow
 def test_predict_cli_emits_json_rows(setup):
     _, ckdir, imgdir = setup
     res = run_predict([
