@@ -1,0 +1,70 @@
+"""utils/logging.py + the resume replay helpers (SURVEY.md §5.3/§5.4)."""
+
+import json
+import os
+
+import numpy as np
+
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.trainer import _reconstruct_best_tracking
+from jama16_retina_tpu.utils.logging import RunLog, read_jsonl
+
+
+def test_read_jsonl_skips_torn_trailing_line(tmp_path):
+    """A run killed mid-flush leaves a partial last line; resume replays
+    this file, so parsing must degrade to skipping, not raising."""
+    p = tmp_path / "m.jsonl"
+    p.write_text(
+        json.dumps({"kind": "eval", "step": 5, "val_auc": 0.9}) + "\n"
+        + '{"kind": "eval", "step": 10, "val_a'  # torn mid-record
+    )
+    recs = read_jsonl(str(p))
+    assert recs == [{"kind": "eval", "step": 5, "val_auc": 0.9}]
+
+
+def test_runlog_roundtrip(tmp_path):
+    log = RunLog(str(tmp_path))
+    log.write("train", step=1, loss=0.5)
+    log.write("eval", step=2, val_auc=0.75)
+    log.close()
+    recs = read_jsonl(os.path.join(str(tmp_path), "metrics.jsonl"))
+    assert [r["kind"] for r in recs] == ["train", "eval"]
+    assert all("t" in r for r in recs)
+
+
+class _NoBest:
+    def best_info(self):
+        return None
+
+
+def test_reconstruct_best_tracking_replays_min_delta_rule(tmp_path):
+    """Sub-min_delta improvements must NOT reset patience on replay —
+    the divergence the JSONL replay exists to avoid (the best manager's
+    raw argmax would call step 30 'best' and forget the elapsed
+    patience)."""
+    cfg = override(get_config("smoke"), ["train.min_delta=0.01"])
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        for step, auc in [(10, 0.90), (20, 0.903), (30, 0.906)]:
+            f.write(json.dumps(
+                {"kind": "eval", "step": step, "val_auc": auc}) + "\n")
+    best_auc, best_step, since = _reconstruct_best_tracking(
+        str(tmp_path), 30, cfg, [_NoBest()]
+    )
+    assert float(best_auc[0]) == 0.90   # +0.003 twice never beat min_delta
+    assert int(best_step[0]) == 10
+    assert int(since[0]) == 2           # two non-improving evals elapsed
+
+
+def test_reconstruct_best_tracking_fallback_uses_manager_peak(tmp_path):
+    """No JSONL survives -> fall back to the best manager's retained
+    (step, metric), with patience derived from the eval cadence."""
+    cfg = override(get_config("smoke"), ["train.eval_every=10"])
+
+    class _Best:
+        def best_info(self):
+            return (20, 0.95)
+
+    best_auc, best_step, since = _reconstruct_best_tracking(
+        str(tmp_path / "empty"), 50, cfg, [_Best()]
+    )
+    assert (float(best_auc[0]), int(best_step[0]), int(since[0])) == (0.95, 20, 3)

@@ -57,3 +57,22 @@ def test_make_mesh_rejects_oversubscription():
 
     with pytest.raises(ValueError, match="requested"):
         mesh_lib.make_mesh(len(jax.devices()) + 1)
+
+
+@pytest.mark.parametrize("k,expect", [
+    (2, {"member": 2, "data": 4}),   # divides evenly
+    (10, {"member": 2, "data": 4}),  # gcd(10, 8) = 2
+    (3, {"member": 1, "data": 8}),   # coprime -> pure DP
+    (8, {"member": 8, "data": 1}),   # one member per device
+])
+def test_make_ensemble_mesh_factors_by_gcd(k, expect):
+    """The member axis is gcd(k, n_dev): the largest size dividing both
+    the stacked member dim and the device array (8 fake devices here)."""
+    import jax
+
+    if len(jax.devices()) != 8:  # the expectations encode the conftest's
+        pytest.skip("needs the 8-fake-device conftest environment")
+    mesh = mesh_lib.make_ensemble_mesh(k)
+    assert dict(mesh.shape) == expect
+    # Batches shard the data axis even on the 2-D mesh.
+    assert mesh_lib._batch_axis(mesh) == "data"
