@@ -208,6 +208,51 @@ def _reconstruct_best_tracking(
     return best_auc, best_step, since_best
 
 
+class _ProfilerWindow:
+    """The --profile_steps trace window (SURVEY.md §5.1), shared by the
+    single-model and member-parallel train loops: skip the compile+warmup
+    steps when the run is long enough, clamp the window inside short
+    runs, warn when no window fits, and never leak an open trace (the
+    next fit() in an ensemble would crash on start_trace)."""
+
+    def __init__(self, cfg: ExperimentConfig, log: RunLog, workdir: str,
+                 start_step: int):
+        self._dir = os.path.join(workdir, "profile")
+        self._steps = cfg.train.profile_steps
+        self._log = log
+        self._start, self._stop = -1, -1
+        self._tracing = False
+        if self._steps > 0:
+            remaining = cfg.train.steps - start_step
+            if remaining < self._steps:
+                log.write("profile_skipped", reason=(
+                    f"only {remaining} steps remain, profile_steps="
+                    f"{self._steps} does not fit"))
+            else:
+                self._start = min(
+                    start_step + 10, cfg.train.steps - self._steps
+                )
+                self._stop = self._start + self._steps
+
+    def before_step(self, step_i: int) -> None:
+        if step_i == self._start:
+            jax.profiler.start_trace(self._dir)
+            self._tracing = True
+
+    def after_step(self, step_i: int, state) -> None:
+        if self._tracing and step_i + 1 >= self._stop:
+            jax.block_until_ready(state)
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._log.write("profile", dir=self._dir, steps=self._steps)
+
+    def finalize(self) -> None:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            self._log.write("profile", dir=self._dir, steps="truncated")
+
+
 def _eval_and_track(
     cfg: ExperimentConfig, log: RunLog, ckpt, step: int,
     predict_fn, state_for_save,
@@ -338,37 +383,15 @@ def fit(
         size=cfg.data.prefetch_batches,
     )
 
-    # Profiler window (SURVEY.md §5.1): skip the compile+warmup steps when
-    # the run is long enough, clamp the window inside short runs, and warn
-    # when no window fits at all.
-    profile_start, profile_stop = -1, -1
-    if cfg.train.profile_steps > 0:
-        remaining = cfg.train.steps - start_step
-        if remaining < cfg.train.profile_steps:
-            log.write("profile_skipped", reason=(
-                f"only {remaining} steps remain, profile_steps="
-                f"{cfg.train.profile_steps} does not fit"))
-        else:
-            profile_start = min(
-                start_step + 10, cfg.train.steps - cfg.train.profile_steps
-            )
-            profile_stop = profile_start + cfg.train.profile_steps
-    tracing = False
+    profiler = _ProfilerWindow(cfg, log, workdir, start_step)
 
     stopped_early = False
     t_log, imgs_since = time.time(), 0
     try:
         for step_i in range(start_step, cfg.train.steps):
-            if step_i == profile_start:
-                jax.profiler.start_trace(os.path.join(workdir, "profile"))
-                tracing = True
+            profiler.before_step(step_i)
             state, m = train_step(state, next(batches), base_key)
-            if tracing and step_i + 1 >= profile_stop:
-                jax.block_until_ready(state)
-                jax.profiler.stop_trace()
-                tracing = False
-                log.write("profile", dir=os.path.join(workdir, "profile"),
-                          steps=cfg.train.profile_steps)
+            profiler.after_step(step_i, state)
             imgs_since += cfg.data.batch_size
 
             if (step_i + 1) % cfg.train.log_every == 0:
@@ -393,13 +416,9 @@ def fit(
                     stopped_early = True
                     break
     finally:
-        # Early stop / short runs / exceptions must not leak an open trace
-        # (the next fit() in an ensemble would crash on start_trace) or a
-        # flipped global debug flag.
-        if tracing:
-            jax.profiler.stop_trace()
-            log.write("profile", dir=os.path.join(workdir, "profile"),
-                      steps="truncated")
+        # Early stop / short runs / exceptions must not leak an open
+        # trace or a flipped global debug flag.
+        profiler.finalize()
         if cfg.train.debug:
             jax.config.update("jax_debug_nans", prev_debug_nans)
 
@@ -522,15 +541,6 @@ def fit_ensemble_parallel(
         n_members=k, mesh_shape=dict(mesh.shape),
     )
 
-    if cfg.train.profile_steps > 0:
-        # The per-member profiler window is not wired in this driver —
-        # say so in the run log instead of silently no-opping the flag
-        # (profile a single-member fit() for the per-step trace; the
-        # stacked program's cost structure is k-fold the same step).
-        log.write("profile_skipped",
-                  reason="profile_steps is not supported under "
-                         "ensemble_parallel; profile a single-member fit")
-
     model = models.build(cfg.model)
     state, tx = train_lib.create_ensemble_state(
         cfg, model, [seed + m for m in range(k)]
@@ -604,11 +614,14 @@ def fit_ensemble_parallel(
         size=cfg.data.prefetch_batches,
     )
 
+    profiler = _ProfilerWindow(cfg, log, workdir, start_step)
     stopped_early = False
     t_log, imgs_since = time.time(), 0
     try:
         for step_i in range(start_step, cfg.train.steps):
+            profiler.before_step(step_i)
             state, m_out = train_step(state, next(batches), base_keys)
+            profiler.after_step(step_i, state)
             imgs_since += cfg.data.batch_size
 
             if (step_i + 1) % cfg.train.log_every == 0:
@@ -663,6 +676,7 @@ def fit_ensemble_parallel(
                     stopped_early = True
                     break
     finally:
+        profiler.finalize()
         if cfg.train.debug:
             jax.config.update("jax_debug_nans", prev_debug_nans)
 

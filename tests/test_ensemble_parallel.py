@@ -131,7 +131,7 @@ def test_fit_ensemble_parallel_end_to_end(tmp_path):
     cfg = override(get_config("smoke"), [
         "train.ensemble_size=2", "train.ensemble_parallel=true",
         "train.steps=20", "train.eval_every=10", "data.batch_size=8",
-        "eval.batch_size=8",
+        "eval.batch_size=8", "train.profile_steps=5",
     ])
     workdir = str(tmp_path / "ck")
     results = trainer.fit_ensemble(cfg, data_dir, workdir)
@@ -142,10 +142,13 @@ def test_fit_ensemble_parallel_end_to_end(tmp_path):
         assert os.path.isdir(os.path.join(r["workdir"], "latest"))
         meta = json.load(open(os.path.join(r["workdir"], "run_meta.json")))
         assert meta["seed"] == cfg.train.seed + r["member"]
-    evals = [r for r in read_jsonl(os.path.join(workdir, "metrics.jsonl"))
-             if r.get("kind") == "eval"]
+    log = read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+    evals = [r for r in log if r.get("kind") == "eval"]
     assert evals and len(evals[-1]["val_auc_per_member"]) == 2
     assert "ensemble_val_auc" in evals[-1]
+    # The stacked program gets the same --profile_steps window fit() has.
+    assert any(r.get("kind") == "profile" and r["steps"] == 5 for r in log)
+    assert os.listdir(os.path.join(workdir, "profile"))
 
     report = trainer.evaluate_checkpoints(
         cfg, data_dir, ckpt_lib.discover_member_dirs(workdir), split="test"
