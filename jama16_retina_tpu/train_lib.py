@@ -100,18 +100,23 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     return opt
 
 
+def init_variables(model, dummy: jnp.ndarray, rng: jax.Array):
+    """Jit-compiled model.init — THE one home for init semantics (rng
+    collections, train=False). Eager init dispatches one tiny XLA
+    executable per primitive (minutes on the axon TPU for Inception-v3);
+    one compiled program is seconds."""
+    init_fn = jax.jit(
+        lambda r: model.init({"params": r, "dropout": r}, dummy, train=False)
+    )
+    return init_fn(rng)
+
+
 def create_state(
     cfg: ExperimentConfig, model, rng: jax.Array
 ) -> tuple[TrainState, optax.GradientTransformation]:
     size = cfg.model.image_size
     dummy = jnp.zeros((2, size, size, 3), jnp.float32)
-    # jit the init: eager init dispatches one tiny XLA executable per
-    # primitive (minutes on the axon TPU for Inception-v3); one compiled
-    # program is ~5x faster end-to-end.
-    init_fn = jax.jit(
-        lambda r: model.init({"params": r, "dropout": r}, dummy, train=False)
-    )
-    variables = init_fn(rng)
+    variables = init_variables(model, dummy, rng)
     tx = make_optimizer(cfg.train)
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
