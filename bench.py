@@ -57,6 +57,24 @@ passes ~15 epochs x ~57k EyePACS images ~= 860k images through the model;
 doing that in 3600 s on 8 chips needs ~= 30 images/sec/chip. So
 vs_baseline = value / 30, i.e. >1.0 means this chip alone beats the
 per-chip rate the 1-hour target requires.
+
+Timing discipline (round 3, VERDICT r2 #1): every timed section ends with
+a HOST-FETCHED scalar fence (`_fence`) — a device->host copy of a reduce
+of the final output — instead of ``jax.block_until_ready``. The round-2
+driver artifact showed block_until_ready-based windows can report
+physically impossible rates on the axon tunnel (BENCH_r02's eval/b128/
+ensemble rows were 8-25x above what the committed trace and v5e peak
+allow); a host fetch of a value data-dependent on every timed step cannot
+complete early. Train-style sections chain naturally (state_{i+1} depends
+on state_i, so one fence on the final state covers all steps); forward-only
+sections chain an on-device scalar accumulator through each iteration.
+The fence's own cost is measured on already-complete data and subtracted.
+
+On top of that, a PHYSICS GUARD computes each section's FLOPs/image from
+the compiled program's cost analysis and REFUSES to publish any rate that
+implies more FLOP/s than the chip's peak (`physics_peak_tflops` in the
+output; 197 TFLOP/s bf16 for this v5e-class chip). A refused key is
+logged and omitted — the bench can no longer silently emit garbage.
 """
 
 from __future__ import annotations
@@ -86,6 +104,105 @@ BENCH_N_IMAGES = 256
 
 def _log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr)
+
+
+# Per-chip peak dense bf16 FLOP/s by device-kind substring (public Cloud
+# TPU specs). The physics guard refuses any measured rate implying more
+# than this; unknown kinds get a deliberately generous default so the
+# guard can only ever reject the impossible, never the merely fast.
+_PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
+)
+_PEAK_DEFAULT_TFLOPS = 2000.0
+
+
+def _peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, tflops in _PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return tflops * 1e12
+    _log(f"unknown device kind {kind!r}: physics guard using generous "
+         f"{_PEAK_DEFAULT_TFLOPS:.0f} TFLOP/s default")
+    return _PEAK_DEFAULT_TFLOPS * 1e12
+
+
+def _fence(tree) -> float:
+    """Host-visible completion fence: reduce the LARGEST leaf of ``tree``
+    to a scalar ON DEVICE and fetch it. The fetch is data-dependent on
+    that leaf's producing computation, so unlike block_until_ready it
+    cannot return before the work actually ran (BENCH_r02 showed
+    block_until_ready-based windows emitting impossible rates on the
+    axon tunnel). Largest leaf, not leaves[0]: TrainState's first leaf
+    is the step COUNTER, whose value chain (step+1 per iteration) never
+    touches the heavy compute — a runtime retiring output buffers
+    independently could service that fetch early. The largest leaf is a
+    parameter/image tensor, squarely downstream of the matmuls."""
+    import jax
+    import jax.numpy as jnp
+
+    leaf = max(jax.tree_util.tree_leaves(tree), key=lambda x: x.size)
+    return float(jax.device_get(jnp.sum(leaf.astype(jnp.float32))))
+
+
+def _fence_cost(tree) -> float:
+    """Seconds one ``_fence`` costs on already-complete data — the fixed
+    dispatch + D2H overhead to subtract from fenced timing windows."""
+    t0 = time.time()
+    _fence(tree)
+    return time.time() - t0
+
+
+def _flops_of(fn, *args) -> "float | None":
+    """Total FLOPs of one call of jitted ``fn`` at these args, from the
+    compiled program's cost analysis (AOT lower+compile; the persistent
+    compilation cache set up in main() makes this share work with the
+    dispatch-path compile instead of doubling it)."""
+    try:
+        ca = fn.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = float(ca.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception as e:  # pragma: no cover - bench must still emit JSON
+        _log(f"cost analysis unavailable: {type(e).__name__}: {e}")
+        return None
+
+
+def _publish(extras: dict, key: str, rate: float,
+             flops_per_image: "float | None", peak: float,
+             suffix: str = "") -> "float | None":
+    """Guard-then-publish, shared by every measured section: refuse
+    physically impossible rates, else round into ``extras`` and log.
+    Returns the published rate (None when refused)."""
+    rate = _physics_guard(key, rate, flops_per_image, peak)
+    if rate is None:
+        return None
+    extras[key] = round(rate, 2)
+    _log(f"{key}: {extras[key]} img/s/chip{suffix}")
+    return rate
+
+
+def _physics_guard(name: str, rate: float, flops_per_image: "float | None",
+                   peak: float) -> "float | None":
+    """``rate`` (img/s/chip) if physically possible, else None (refuse).
+
+    A rate whose implied FLOP/s exceeds the chip's peak is a measurement
+    bug by definition — publish nothing rather than garbage (VERDICT r2
+    #1: BENCH_r02 emitted eval/b128/ensemble rates 8-25x beyond peak).
+    """
+    if flops_per_image is None:
+        return rate
+    implied = rate * flops_per_image
+    if implied > peak:
+        _log(f"PHYSICS VIOLATION: {name}={rate:.1f} img/s/chip implies "
+             f"{implied / 1e12:.0f} TFLOP/s > chip peak {peak / 1e12:.0f} "
+             f"TFLOP/s; key refused")
+        return None
+    return rate
 
 
 def _ensure_bench_data(image_size: int) -> dict:
@@ -138,23 +255,50 @@ def _host_rate(data_dir: str, cfg, image_size: int, n_batches: int = 30,
 def _timed_steps(step, state, batch_iter, key, n_steps: int, batch_size: int,
                  n_dev: int, warmup: int = WARMUP_STEPS) -> tuple[float, Any]:
     """Shared timing discipline for every train-step measurement: warm up
-    (compile included), block, time ``n_steps``, block; returns
+    (compile included), fence, time ``n_steps``, fence; returns
     (images/sec/chip, final state). ``batch_iter`` is any callable
-    ``i -> batch`` (cycled list or pipeline iterator)."""
-    import jax
+    ``i -> batch`` (cycled list or pipeline iterator).
 
+    The step chains state through iterations, so the single closing
+    ``_fence`` on the final state is data-dependent on EVERY timed step;
+    its own fixed cost is measured up front and subtracted.
+    """
     for i in range(warmup):
         state, _ = step(state, batch_iter(i), key)
-    jax.block_until_ready(state)
+    _fence(state)  # completes warmup + compiles the fence's reduce
+    sync = _fence_cost(state)
     t0 = time.time()
     for i in range(n_steps):
         state, m = step(state, batch_iter(i), key)
-    jax.block_until_ready(state)
-    rate = n_steps * batch_size / (time.time() - t0) / n_dev
+    _fence(state)
+    dt = max(time.time() - t0 - sync, 1e-9)
+    rate = n_steps * batch_size / dt / n_dev
     return rate, state
 
 
-def _augment_rate(images_u8, data_cfg, use_pallas: bool, n: int = 30) -> float:
+def _timed_forward(fn, n: int, images_per_call: int, n_dev: int = 1,
+                   warmup: int = 2) -> float:
+    """Images/sec/chip of forward-only ``fn(i) -> array`` calls whose
+    outputs do NOT chain: an on-device scalar accumulator is folded in
+    each iteration so the closing host fetch depends on every call."""
+    import jax
+    import jax.numpy as jnp
+
+    acc_add = jax.jit(lambda a, p: a + jnp.sum(p.astype(jnp.float32)))
+    acc = jnp.zeros((), jnp.float32)
+    for i in range(warmup):
+        acc = acc_add(acc, fn(i))
+    _fence(acc)  # completes warmup AND compiles the fence's reduce
+    sync = _fence_cost(acc)
+    t0 = time.time()
+    for i in range(n):
+        acc = acc_add(acc, fn(i))
+    _fence(acc)
+    dt = max(time.time() - t0 - sync, 1e-9)
+    return n * images_per_call / dt / n_dev
+
+
+def _augment_rate(images_u8, data_cfg, use_pallas: bool, n: int = 100) -> float:
     """Images/sec of the augmentation stage alone, compiled on this chip."""
     import jax
 
@@ -163,13 +307,10 @@ def _augment_rate(images_u8, data_cfg, use_pallas: bool, n: int = 30) -> float:
 
     fn = jax.jit(lambda k, im: augment.augment_batch(k, im, cfg))
     key = jax.random.key(0)
-    out = fn(key, images_u8)
-    jax.block_until_ready(out)
-    t0 = time.time()
-    for i in range(n):
-        out = fn(jax.random.fold_in(key, i), images_u8)
-    jax.block_until_ready(out)
-    return n * images_u8.shape[0] / (time.time() - t0)
+    return _timed_forward(
+        lambda i: fn(jax.random.fold_in(key, i), images_u8),
+        n, images_u8.shape[0],
+    )
 
 
 def main() -> None:
@@ -206,6 +347,15 @@ def main() -> None:
     from jama16_retina_tpu.data import pipeline
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
+    # Persistent compilation cache: the AOT lower+compile used for cost
+    # analysis and the dispatch-path compile then share one compilation
+    # instead of paying the ~40-80s train-step compile twice (and repeat
+    # bench invocations start warm).
+    mesh_lib.enable_persistent_compilation_cache(
+        os.environ.get("BENCH_JIT_CACHE", "/tmp/retina_bench_jitcache")
+    )
+    peak = _peak_flops()
+
     cfg = get_config("eyepacs_binary")
     if args.use_pallas or args.no_pallas:
         cfg = cfg.replace(data=dataclasses.replace(
@@ -236,6 +386,14 @@ def main() -> None:
     ]
     key = jax.random.key(1)
 
+    # FLOPs/image of the compiled train step — the physics guard's
+    # numerator for every train-style section (per-IMAGE cost is batch-
+    # size- and member-count-invariant to within BN/optimizer epsilon, so
+    # one analysis covers device_only, pipeline_fed, b128, and the
+    # stacked ensemble's member-images).
+    train_flops = _flops_of(step, state, batches[0], key)
+    flops_per_image = train_flops / batch_size if train_flops else None
+
     t0 = time.time()
     device_only, state = _timed_steps(
         step, state, lambda i: batches[i % N_DISTINCT_BATCHES], key,
@@ -243,8 +401,33 @@ def main() -> None:
     )
     _log(f"device_only: {TIMED_STEPS} steps in {time.time() - t0:.1f}s "
          f"incl. warmup+compile ({device_only:.1f} img/s/chip)")
+    guarded = _physics_guard("device_only", device_only, flops_per_image, peak)
+    if guarded is None:
+        # The headline must still be a trustworthy number: re-measure
+        # fully serialized (per-step fence, sync cost subtracted) — the
+        # strict lower bound on the true rate.
+        _log("re-measuring headline with per-step fences (strict lower "
+             "bound: fully serialized, sync cost NOT subtracted — "
+             "subtracting a 50x-amplified single sync sample could "
+             "overshoot the true rate)")
+        t0 = time.time()
+        for i in range(TIMED_STEPS):
+            state, _ = step(state, batches[i % N_DISTINCT_BATCHES], key)
+            _fence(state)
+        dt = max(time.time() - t0, 1e-9)
+        device_only = TIMED_STEPS * batch_size / dt / n_dev
+        if _physics_guard("device_only", device_only, flops_per_image,
+                          peak) is None:
+            raise RuntimeError(
+                "serialized per-step timing still implies an impossible "
+                "rate — the clock or the device is lying; no trustworthy "
+                "headline exists on this host"
+            )
 
     extras: dict = {"use_pallas": cfg.data.use_pallas}
+    extras["physics_peak_tflops"] = round(peak / 1e12, 1)
+    if flops_per_image:
+        extras["train_gflops_per_image"] = round(flops_per_image / 1e9, 2)
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.
     aug_imgs = jax.device_put(batches[0]["image"])
@@ -282,8 +465,31 @@ def main() -> None:
             step, state, lambda i: next(it), key, TIMED_STEPS, batch_size,
             n_dev, warmup=3,
         )
-        extras["pipeline_fed"] = round(rate, 2)
-        _log(f"pipeline_fed: {extras['pipeline_fed']} img/s/chip")
+        _publish(extras, "pipeline_fed", rate, flops_per_image, peak)
+
+        # HBM-resident loader (data.loader=hbm): whole split uploaded
+        # once, per-step on-device gather — zero steady-state H2D, the
+        # shipped answer to the axon H2D collapse (docs/PERF.md §H2D).
+        try:
+            from jama16_retina_tpu.data import hbm_pipeline
+
+            t0 = time.time()
+            hbm_it = hbm_pipeline.train_batches(
+                dirs["raw"], "train", cfg.data, size, seed=0, mesh=mesh
+            )
+            _fence(next(hbm_it)["image"])  # decode + upload + first gather
+            extras["hbm_load_sec"] = round(time.time() - t0, 2)
+            rate, state = _timed_steps(
+                step, state, lambda i: next(hbm_it), key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            _publish(
+                extras, "pipeline_fed_hbm", rate, flops_per_image, peak,
+                suffix=(f" (hbm-resident loader; one-time load "
+                        f"{extras['hbm_load_sec']}s)"),
+            )
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"hbm pipeline bench failed: {type(e).__name__}: {e}")
 
     # Eval-side rate: the forward-only jit eval step at the eval batch
     # size — multiply by k models x test-set size for the ensemble
@@ -295,18 +501,18 @@ def main() -> None:
             {"image": rng.integers(0, 256, (eval_bs, size, size, 3), np.uint8)},
             mesh,
         )
-        probs = eval_step(state, eval_batch)
-        jax.block_until_ready(probs)
-        n_eval = 30
-        t0 = time.time()
-        for _ in range(n_eval):
-            probs = eval_step(state, eval_batch)
-        jax.block_until_ready(probs)
-        extras["eval_images_per_sec"] = round(
-            n_eval * eval_bs / (time.time() - t0) / n_dev, 2
+        eval_flops = _flops_of(eval_step, state, eval_batch)
+        # 100 iterations ≈ 1-2s window: the ~22ms fixed sync cost on this
+        # tunnel is >2% of a 30-iteration window and was visibly noising
+        # the forward-only rates run to run.
+        rate = _timed_forward(
+            lambda i: eval_step(state, eval_batch), 100, eval_bs, n_dev
         )
-        _log(f"eval step: {extras['eval_images_per_sec']} img/s/chip "
-             f"(batch {eval_bs}, forward-only)")
+        _publish(
+            extras, "eval_images_per_sec", rate,
+            eval_flops / eval_bs if eval_flops else None, peak,
+            suffix=f" (batch {eval_bs}, forward-only)",
+        )
     except Exception as e:  # pragma: no cover - bench must emit JSON
         _log(f"eval bench failed: {type(e).__name__}: {e}")
 
@@ -332,9 +538,10 @@ def main() -> None:
             rate, state = _timed_steps(
                 step, state, lambda i: big_batches[i % 2], key, 20, big, n_dev
             )
-            extras["device_only_b128"] = round(rate, 2)
-            _log(f"device_only @ batch 128/chip: "
-                 f"{extras['device_only_b128']} img/s/chip")
+            _publish(
+                extras, "device_only_b128", rate, flops_per_image, peak,
+                suffix=" (batch 128/chip)",
+            )
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"batch-128 bench failed: {type(e).__name__}: {e}")
 
@@ -359,11 +566,15 @@ def main() -> None:
                 ens_state, lambda i: batches[i % N_DISTINCT_BATCHES], key,
                 20, k * batch_size, n_dev,
             )
-            extras["ensemble4_member_images_per_sec"] = round(rate, 2)
-            extras["ensemble4_parallel_speedup"] = round(rate / device_only, 2)
-            _log(f"ensemble k=4 stacked step: {rate:.1f} member-img/s/chip "
-                 f"({extras['ensemble4_parallel_speedup']}x the sequential "
-                 "member rate)")
+            rate = _publish(
+                extras, "ensemble4_member_images_per_sec", rate,
+                flops_per_image, peak,
+                suffix=" (member-img/s, k=4 stacked step)",
+            )
+            if rate is not None:
+                extras["ensemble4_parallel_speedup"] = round(
+                    rate / device_only, 2
+                )
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"ensemble bench failed: {type(e).__name__}: {e}")
 

@@ -53,7 +53,10 @@ class DataConfig:
     # Train-stream loader (SURVEY.md N4): "tfdata" = tf.data stream with
     # deterministic replay resume (data/pipeline.py); "grain" = index-
     # sampled loader with global shuffle and O(1) derived-state resume
-    # (data/grain_pipeline.py). Same {'image','grade'} batch contract.
+    # (data/grain_pipeline.py); "hbm" = whole split resident in device
+    # memory, per-step on-device gather, zero steady-state H2D — for
+    # splits that fit the HBM budget (data/hbm_pipeline.py, docs/PERF.md
+    # §H2D). Same {'image','grade'} batch contract.
     loader: str = "tfdata"
     # NOTE: image size lives ONLY in ModelConfig.image_size; the pipeline
     # reads it from there so the two can never desync via overrides.

@@ -1,0 +1,188 @@
+"""HBM-resident train input: the ``data.loader="hbm"`` option.
+
+The literal form of "decoding straight into HBM" (BASELINE.json:5): the
+whole decoded uint8 split is uploaded to device memory ONCE at startup,
+and every train batch after that is an on-device gather — zero per-step
+host→device traffic, zero host decode on the hot path. docs/PERF.md §H2D
+measured why this matters here: on the axon tunnel the per-batch H2D
+copy collapses to ~18 MB/s after the train executable loads, capping the
+streamed pipeline at ~28-120 img/s while the chip can train at ~1300;
+paying the (slow) upload once moves the steady-state rate back to the
+device-only ceiling. On healthy PCIe hosts the same mode removes the
+host from the steady-state entirely — useful for small/medium datasets
+(EyePACS train at 299px raw uint8 is ~15 GB vs 16 GB/chip HBM on v5e,
+so the fit is gated, not assumed; see ``fits_in_hbm``).
+
+Batch selection is a pure function of (seed, step), computed ON DEVICE
+inside one jit program per step:
+
+    epoch = step // steps_per_epoch        (drop-remainder epochs)
+    perm  = random.permutation(fold_in(key(seed), epoch), n)
+    idx   = perm[pos : pos + batch]        (pos = in-epoch offset)
+
+so epochs are exact global reshuffles (every record exactly once per
+epoch, like the grain loader's index sampling) and resume is O(1):
+``skip_batches=k`` just starts the step counter at k — the same
+(seed, step) contract as the jit step's fold_in keys (SURVEY.md §5.4).
+
+Single-process only (it is a single-host lever; multi-host slices keep
+the streamed loaders whose per-process sharding is wired end-to-end).
+Multi-CHIP within one process works: pass a mesh and the resident
+dataset rows shard across the data axis; the per-step gather is then a
+GSPMD collective over ICI, which is exactly the fabric it should ride.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from jama16_retina_tpu.configs import DataConfig
+from jama16_retina_tpu.data import tfrecord
+
+
+def load_split_numpy(
+    data_dir: str, split: str, image_size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """All records of a split, decoded on host once:
+    (images u8[N,S,S,3], grades i32[N]). Reuses the grain loader's
+    TF-free record index + proto decode (data/grain_pipeline.py)."""
+    from jama16_retina_tpu.data.grain_pipeline import (
+        TFRecordIndex,
+        _decode_example,
+    )
+
+    index = TFRecordIndex(tfrecord.list_split(data_dir, split))
+    n = len(index)
+    if n == 0:
+        raise ValueError(f"no records under {data_dir}/{split}")
+    images = np.empty((n, image_size, image_size, 3), np.uint8)
+    grades = np.empty((n,), np.int32)
+    for i in range(n):
+        row = _decode_example(index.read(i), image_size)
+        images[i] = row["image"]
+        grades[i] = row["grade"]
+    return images, grades
+
+
+def dataset_bytes(n: int, image_size: int) -> int:
+    return n * image_size * image_size * 3 + 4 * n
+
+
+def hbm_budget_bytes(max_fraction: float = 0.6) -> int:
+    """Per-chip HBM budget for the resident dataset: ``max_fraction`` of
+    the device's memory limit when the runtime reports one, else a
+    conservative 16 GB v5e-class assumption. The remaining fraction
+    belongs to the model/optimizer/activations (the flagship step's live
+    set is ~2 GB; 0.6 leaves ~3x headroom)."""
+    import jax
+
+    limit = None
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit")
+    except Exception:
+        pass
+    if not limit:
+        limit = 16 * 1024**3
+    return int(limit * max_fraction)
+
+
+def fits_in_hbm(
+    n: int, image_size: int, n_devices: int = 1, max_fraction: float = 0.6
+) -> bool:
+    """The size gate: the dataset shards row-wise across the mesh's data
+    axis, so the per-chip share must fit the per-chip budget."""
+    per_chip = dataset_bytes(n, image_size) / max(n_devices, 1)
+    return per_chip <= hbm_budget_bytes(max_fraction)
+
+
+def make_batch_fn(images, grades, batch_size: int, seed: int, mesh=None):
+    """jit'd ``step -> {'image','grade'}`` gather over the resident
+    arrays. With a mesh, the dataset is row-sharded over the data axis
+    and the output batch carries the standard batch sharding — the
+    shuffle gather becomes an ICI collective under GSPMD."""
+    import jax
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    n = images.shape[0]
+    if batch_size > n:
+        raise ValueError(f"batch_size={batch_size} exceeds dataset n={n}")
+    steps_per_epoch = n // batch_size
+    base = jax.random.key(seed)
+
+    if mesh is not None:
+        data_sh = mesh_lib.batch_sharding(mesh)
+        images = jax.device_put(images, data_sh)
+        grades = jax.device_put(grades, data_sh)
+    else:
+        images = jax.device_put(images)
+        grades = jax.device_put(grades)
+
+    def get_batch(step):
+        epoch = step // steps_per_epoch
+        pos = (step % steps_per_epoch) * batch_size
+        perm = jax.random.permutation(jax.random.fold_in(base, epoch), n)
+        idx = jax.lax.dynamic_slice(perm, (pos,), (batch_size,))
+        return {
+            "image": jnp.take(images, idx, axis=0),
+            "grade": jnp.take(grades, idx, axis=0),
+        }
+
+    if mesh is None:
+        return jax.jit(get_batch)
+    return jax.jit(
+        get_batch,
+        out_shardings={
+            "image": mesh_lib.batch_sharding(mesh),
+            "grade": mesh_lib.batch_sharding(mesh),
+        },
+    )
+
+
+def train_batches(
+    data_dir: str,
+    split: str,
+    cfg: DataConfig,
+    image_size: int,
+    seed: int = 0,
+    skip_batches: int = 0,
+    mesh=None,
+    max_fraction: float = 0.6,
+) -> Iterator[dict]:
+    """Drop-in twin of pipeline.train_batches yielding DEVICE-resident
+    batches. ``skip_batches`` is an O(1) counter offset (pure (seed,
+    step) semantics — no replay, no state files)."""
+    import jax
+
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "data.loader='hbm' is single-process (a single-host lever); "
+            "multi-host slices should use the tfdata or grain loader, "
+            "whose per-process input sharding is wired end-to-end"
+        )
+    images, grades = load_split_numpy(data_dir, split, image_size)
+    # The dataset shards across the DATA axis only (replicated over any
+    # 'member' axis of an ensemble mesh) — gating on total device count
+    # would under-count per-chip bytes by the member-axis factor.
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    n_dev = mesh.shape[mesh_lib._batch_axis(mesh)] if mesh is not None else 1
+    if not fits_in_hbm(len(images), image_size, n_dev, max_fraction):
+        raise ValueError(
+            f"{split} split ({dataset_bytes(len(images), image_size) / 1e9:.1f}"
+            f" GB over {n_dev} chip(s)) exceeds the HBM-resident budget "
+            f"({hbm_budget_bytes(max_fraction) / 1e9:.1f} GB/chip); use the "
+            "tfdata or grain loader for datasets this size"
+        )
+    get_batch = make_batch_fn(
+        images, grades, cfg.batch_size, seed, mesh=mesh
+    )
+    step = skip_batches
+    while True:
+        yield get_batch(step)
+        step += 1

@@ -107,11 +107,21 @@ def predict_split_tf(
 
 
 def _train_stream(
-    cfg: ExperimentConfig, data_dir: str, seed: int, skip_batches: int
+    cfg: ExperimentConfig, data_dir: str, seed: int, skip_batches: int,
+    mesh=None,
 ):
-    """Dispatch on data.loader (SURVEY.md N4): both loaders yield the
-    same {'image','grade'} local batches and honor skip_batches, so the
-    train loops never see which one is underneath."""
+    """Dispatch on data.loader (SURVEY.md N4): every loader yields the
+    same {'image','grade'} batches and honors skip_batches, so the train
+    loops never see which one is underneath. 'hbm' yields DEVICE-resident
+    batches (the whole split uploaded once — docs/PERF.md §H2D); the
+    others yield host arrays for device_prefetch to move."""
+    if cfg.data.loader == "hbm":
+        from jama16_retina_tpu.data import hbm_pipeline
+
+        return hbm_pipeline.train_batches(
+            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+            skip_batches=skip_batches, mesh=mesh,
+        )
     if cfg.data.loader == "grain":
         from jama16_retina_tpu.data import grain_pipeline
 
@@ -121,7 +131,7 @@ def _train_stream(
         )
     if cfg.data.loader != "tfdata":
         raise ValueError(
-            f"unknown data.loader {cfg.data.loader!r} (want tfdata|grain)"
+            f"unknown data.loader {cfg.data.loader!r} (want tfdata|grain|hbm)"
         )
     return pipeline.train_batches(
         data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
@@ -378,7 +388,7 @@ def fit(
     # (pipeline determinism; SURVEY.md §5.4). Augment/dropout keys need
     # no restoring — they are fold_in(base_key, state.step) in-step.
     batches = pipeline.device_prefetch(
-        _train_stream(cfg, data_dir, seed, skip_batches=start_step),
+        _train_stream(cfg, data_dir, seed, skip_batches=start_step, mesh=mesh),
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
     )
@@ -609,7 +619,7 @@ def fit_ensemble_parallel(
             )
 
     batches = pipeline.device_prefetch(
-        _train_stream(cfg, data_dir, seed, skip_batches=start_step),
+        _train_stream(cfg, data_dir, seed, skip_batches=start_step, mesh=mesh),
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
     )
@@ -728,6 +738,12 @@ def fit_tf(
         raise ValueError(
             "train.ema_decay is a flax-path feature; the legacy tf "
             "backend has no EMA shadow (see TrainConfig.ema_decay)"
+        )
+    if cfg.data.loader == "hbm":
+        raise ValueError(
+            "data.loader='hbm' yields device-resident batches for the "
+            "jit train step; the tf backend trains on host — use the "
+            "tfdata or grain loader with --device=tf"
         )
     seed = cfg.train.seed if seed is None else seed
     seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
