@@ -116,6 +116,16 @@ def make_batch_fn(images, grades, batch_size: int, seed: int, mesh=None):
     base = jax.random.key(seed)
 
     if mesh is not None:
+        # Row-sharding needs dim 0 divisible by the data axis; real
+        # splits have arbitrary counts, so pad with leading records
+        # re-used as filler. The permutation draws indices < n only —
+        # padding rows are never sampled, so epoch semantics are
+        # unchanged (no record lost, none duplicated).
+        d = mesh.shape[mesh_lib._batch_axis(mesh)]
+        pad = (-n) % d
+        if pad:
+            images = np.concatenate([images, images[:pad]])
+            grades = np.concatenate([grades, grades[:pad]])
         data_sh = mesh_lib.batch_sharding(mesh)
         images = jax.device_put(images, data_sh)
         grades = jax.device_put(grades, data_sh)

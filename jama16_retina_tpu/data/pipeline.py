@@ -212,7 +212,8 @@ def eval_batches(
 
 
 def device_prefetch(
-    it: Iterator[dict], sharding=None, size: int = 2
+    it: Iterator[dict], sharding=None, size: int = 2,
+    full_local: bool = False,
 ) -> Iterator[dict]:
     """Move batches to device ahead of consumption (double-buffering).
 
@@ -220,6 +221,12 @@ def device_prefetch(
     scatter across the mesh's data axis; with None it targets the default
     device. jax.device_put is async — the queue depth of ``size`` is what
     lets H2D copies run behind the current step's compute.
+
+    ``full_local``: each process's iterator yields the FULL global batch
+    (not its 1/P row block) and placement slices each device's shard from
+    it — the member-parallel driver's assembly, whose ('member','data')
+    device layout interleaves data columns across processes (see
+    mesh_lib.place_full_local).
     """
     queue: collections.deque = collections.deque()
     multiprocess = jax.process_count() > 1
@@ -227,6 +234,13 @@ def device_prefetch(
     def put(batch: dict) -> dict:
         if sharding is None:
             return jax.device_put(batch)
+        if full_local and multiprocess:
+            from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+            return mesh_lib.place_full_local(batch, sharding)
+        # full_local single-process falls through: plain sharded puts are
+        # equivalent there (and no-copy for already-device-resident hbm
+        # batches, which place_full_local's np.asarray would round-trip).
 
         def one(x):
             sh = _shard_for(x, sharding)
@@ -239,13 +253,11 @@ def device_prefetch(
 
     def _shard_for(x, sharding):
         # Rank-aware: batch-dim sharding for arrays, replicated for scalars.
-        import jax.sharding as jsh
-
         if not hasattr(sharding, "spec"):
             return sharding
-        ndim = np.ndim(x)
-        spec = list(sharding.spec) + [None] * max(0, ndim - len(sharding.spec))
-        return jsh.NamedSharding(sharding.mesh, jsh.PartitionSpec(*spec[:ndim]))
+        from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+        return mesh_lib._rank_sharding(np.ndim(x), sharding)
 
     for batch in it:
         queue.append(put(batch))

@@ -166,6 +166,41 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _rank_sharding(ndim: int, sharding: NamedSharding) -> NamedSharding:
+    """Extend (or trim) a sharding's spec to an array's rank — batch-dim
+    sharding for arrays, replicated for scalars."""
+    spec = list(sharding.spec) + [None] * max(0, ndim - len(sharding.spec))
+    return NamedSharding(sharding.mesh, P(*spec[:ndim]))
+
+
+def place_full_local(tree, sharding: NamedSharding):
+    """Place host values that are IDENTICAL on every process as global
+    arrays under (rank-extended) ``sharding``.
+
+    Single-process: a plain device_put. Multi-process: each process
+    supplies its own devices' shards from its full local copy
+    (``jax.make_array_from_callback``) — the assembly for layouts where
+    a process's devices do NOT own a contiguous process-major block of
+    dim 0, which is exactly the ('member', 'data') ensemble mesh: its
+    data columns interleave across processes, so ``shard_batch``'s
+    local-rows contract cannot express them. Every process must hold the
+    same full value (the member-parallel driver reads the full global
+    batch on every host for this reason).
+    """
+    multiprocess = jax.process_count() > 1
+
+    def put(x):
+        x = np.asarray(x)
+        sh = _rank_sharding(x.ndim, sharding)
+        if not multiprocess:
+            return jax.device_put(x, sh)
+        return jax.make_array_from_callback(
+            x.shape, sh, lambda idx, _x=x: _x[idx]
+        )
+
+    return jax.tree.map(put, tree)
+
+
 def shard_batch(batch, mesh: Mesh):
     """Place a host batch dict as global arrays sharded on dim 0.
 

@@ -358,41 +358,77 @@ def make_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
 # form) by leaving ensemble_parallel off.
 
 
-def stack_member_keys(seeds: "list[int]") -> jax.Array:
+def stack_member_keys(seeds: "list[int]", mesh=None) -> jax.Array:
     """[k] stacked PRNG key vector, one key per member seed — the vmapped
     twin of the sequential driver's ``base_key = jax.random.key(seed)``.
     The ONE home for member-key construction: create_ensemble_state's
     init keys and the train loop's base keys must come from the same
-    expression or member m's stream diverges from a sequential run."""
-    return jnp.stack([jax.random.key(int(s)) for s in seeds])
+    expression or member m's stream diverges from a sequential run.
+
+    With ``mesh``, the keys are computed INSIDE a jit with member-axis
+    out-shardings — on multi-host meshes a host-built stacked array
+    cannot be device_put to a sharding spanning non-addressable devices,
+    but a jit closing over the host seeds can produce it directly.
+    ``vmap(jax.random.key)`` over uint32 seeds equals the stacked
+    per-seed keys (threefry seeding's high word is zero for both;
+    pinned by tests/test_ensemble_parallel.py's stacked≡sequential run).
+    """
+    if mesh is None:
+        return jnp.stack([jax.random.key(int(s)) for s in seeds])
+    import numpy as np
+
+    seeds_arr = np.asarray([int(s) for s in seeds], np.uint32)
+    return jax.jit(
+        lambda: jax.vmap(jax.random.key)(jnp.asarray(seeds_arr)),
+        out_shardings=mesh_lib.member_sharding(mesh),
+    )()
 
 
 def create_ensemble_state(
-    cfg: ExperimentConfig, model, seeds: "list[int]"
+    cfg: ExperimentConfig, model, seeds: "list[int]", mesh=None
 ) -> tuple[TrainState, optax.GradientTransformation]:
     """Stacked TrainState: every leaf gains a leading [k] member dim.
 
     Member m's slice is bit-identical to ``create_state`` under seed
     ``seeds[m]`` (the vmapped init consumes the same per-member key).
+
+    With ``mesh``, the whole state is built in ONE jit with member-axis
+    out-shardings: the init computes directly into the member-sharded
+    global layout — each host initializes only its members, and no
+    host-side stacked copy exists (required on multi-host meshes, where
+    device_put cannot place host arrays across processes).
     """
     size = cfg.model.image_size
     dummy = jnp.zeros((2, size, size, 3), jnp.float32)
-    keys = stack_member_keys(seeds)
-    init_fn = jax.jit(jax.vmap(
-        lambda r: model.init({"params": r, "dropout": r}, dummy, train=False)
-    ))
-    variables = init_fn(keys)
     tx = make_optimizer(cfg.train)
-    state = TrainState(
-        step=jnp.zeros((len(seeds),), jnp.int32),
-        params=variables["params"],
-        batch_stats=variables["batch_stats"],
-        opt_state=jax.vmap(tx.init)(variables["params"]),
-        ema_params=(
-            jax.tree.map(jnp.copy, variables["params"])
-            if cfg.train.ema_decay > 0 else None
-        ),
-    )
+    import numpy as np
+
+    seeds_arr = np.asarray([int(s) for s in seeds], np.uint32)
+
+    def build():
+        keys = jax.vmap(jax.random.key)(jnp.asarray(seeds_arr))
+        variables = jax.vmap(
+            lambda r: model.init(
+                {"params": r, "dropout": r}, dummy, train=False
+            )
+        )(keys)
+        return TrainState(
+            step=jnp.zeros((len(seeds),), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables["batch_stats"],
+            opt_state=jax.vmap(tx.init)(variables["params"]),
+            ema_params=(
+                jax.tree.map(jnp.copy, variables["params"])
+                if cfg.train.ema_decay > 0 else None
+            ),
+        )
+
+    if mesh is None:
+        state = jax.jit(build)()
+    else:
+        state = jax.jit(
+            build, out_shardings=mesh_lib.member_sharding(mesh)
+        )()
     return state, tx
 
 
@@ -432,10 +468,13 @@ def make_ensemble_train_step(
         return jax.jit(step, donate_argnums=donate_argnums)
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
+    # Metrics come back REPLICATED (a [k]-float all-gather, negligible):
+    # the driver logs them with device_get, which on multi-host can only
+    # fetch fully-addressable arrays.
     return jax.jit(
         step,
         in_shardings=(member, data, member),
-        out_shardings=(member, member),
+        out_shardings=(member, mesh_lib.replicated(mesh)),
         donate_argnums=donate_argnums,
     )
 
@@ -453,7 +492,9 @@ def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable
         return jax.jit(step)
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
-    # Probs come back [k, B]: member-sharded rows, gathered by the host.
+    # Probs come back [k, B] REPLICATED (small: an all-gather of floats)
+    # so the host device_get works on multi-host meshes too.
     return jax.jit(
-        step, in_shardings=(member, data), out_shardings=member
+        step, in_shardings=(member, data),
+        out_shardings=mesh_lib.replicated(mesh),
     )

@@ -108,13 +108,21 @@ def predict_split_tf(
 
 def _train_stream(
     cfg: ExperimentConfig, data_dir: str, seed: int, skip_batches: int,
-    mesh=None,
+    mesh=None, full_batches: bool = False,
 ):
     """Dispatch on data.loader (SURVEY.md N4): every loader yields the
     same {'image','grade'} batches and honors skip_batches, so the train
     loops never see which one is underneath. 'hbm' yields DEVICE-resident
     batches (the whole split uploaded once — docs/PERF.md §H2D); the
-    others yield host arrays for device_prefetch to move."""
+    others yield host arrays for device_prefetch to move.
+
+    ``full_batches``: every process reads the FULL global batch stream
+    instead of its 1/P slice — the member-parallel driver's contract
+    (its ('member','data') layout needs all rows on every host; see
+    pipeline.device_prefetch full_local)."""
+    proc_kw = (
+        {"process_index": 0, "process_count": 1} if full_batches else {}
+    )
     if cfg.data.loader == "hbm":
         from jama16_retina_tpu.data import hbm_pipeline
 
@@ -127,7 +135,7 @@ def _train_stream(
 
         return grain_pipeline.train_batches(
             data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
-            skip_batches=skip_batches,
+            skip_batches=skip_batches, **proc_kw,
         )
     if cfg.data.loader != "tfdata":
         raise ValueError(
@@ -135,7 +143,7 @@ def _train_stream(
         )
     return pipeline.train_batches(
         data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
-        skip_batches=skip_batches,
+        skip_batches=skip_batches, **proc_kw,
     )
 
 
@@ -477,13 +485,21 @@ def _predict_split_members(
     mesh, eval_step,
 ) -> tuple[np.ndarray, np.ndarray]:
     """predict_split for a STACKED ensemble state: one vmapped forward
-    scores all k members per batch -> (grades [n], probs [k, n(, C)])."""
+    scores all k members per batch -> (grades [n], probs [k, n(, C)]).
+
+    Every process reads the FULL eval stream and full-local placement
+    slices each device's shard — the ('member','data') layout's data
+    columns interleave across processes, so the 1-D process-major block
+    contract of eval_batches' local rows does not apply here."""
     grades_all, probs_all = [], []
     for batch in pipeline.eval_batches(
-        data_dir, split, cfg.eval.batch_size, cfg.model.image_size
+        data_dir, split, cfg.eval.batch_size, cfg.model.image_size,
+        process_index=0, process_count=1,
     ):
         if mesh is not None:
-            dev_batch = mesh_lib.shard_batch({"image": batch["image"]}, mesh)
+            dev_batch = mesh_lib.place_full_local(
+                {"image": batch["image"]}, mesh_lib.batch_sharding(mesh)
+            )
         else:
             dev_batch = jax.device_put({"image": batch["image"]})
         probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
@@ -515,21 +531,17 @@ def fit_ensemble_parallel(
     checkpoint is whatever its own val-AUC peak was. ``--resume``
     restores every member's latest checkpoint (this driver keeps them in
     lock-step) and continues the exact stream via skip_batches, same as
-    fit().
+    fit(); after a save torn by a mid-eval crash it falls back to the
+    newest step ALL members can still restore.
+
+    Multi-host: works. Each process reads the FULL batch stream (the
+    ('member','data') device layout interleaves data columns across
+    processes, so there is no per-process row block — see
+    mesh_lib.place_full_local), state/keys are created INSIDE jit with
+    member-axis out-shardings, and checkpoint/metric gathers reshard to
+    replicated first (an ICI all-gather) so device_get is host-legal.
     """
     k = cfg.train.ensemble_size
-    if jax.process_count() > 1:
-        # The pipeline's per-process sharding yields 1-D-DP local blocks;
-        # assembling them under the 2-D ('member', 'data') layout (data-
-        # replicated across member rows) is not wired, and device_get of
-        # a member-sharded state needs a multi-host gather. Fail loudly
-        # rather than build a wrong global batch.
-        raise NotImplementedError(
-            "ensemble_parallel is single-process for now (multi-CHIP via "
-            "one process is fine — the member axis shards across local "
-            "devices); on a multi-host slice train members sequentially "
-            "or run one process per member group"
-        )
     mesh = mesh_lib.make_ensemble_mesh(k, cfg.parallel.num_devices)
     prev_debug_nans = jax.config.jax_debug_nans
     if cfg.train.debug:
@@ -541,10 +553,23 @@ def fit_ensemble_parallel(
         cfg.train.resume,
     )
     for m in range(1, k):
-        _load_or_write_run_meta(
+        persisted = _load_or_write_run_meta(
             ckpt_lib.member_dir(workdir, m), seed + m, cfg.name,
             cfg.train.resume,
         )
+        if persisted != seed + m:
+            # The helper's "CLI seed ignored" warning promises stream
+            # continuity, but this driver derives member streams from
+            # base+m regardless — a mismatched persisted seed means the
+            # workdir belongs to a different ensemble run; silently
+            # changing member m's PRNG stream would corrupt it.
+            raise ValueError(
+                f"member {m} run_meta pins seed {persisted}, but this "
+                f"ensemble derives member seeds from base {seed} "
+                f"(expected {seed + m}) — the workdir belongs to a "
+                "differently-seeded ensemble; resume with the original "
+                "base seed or use a fresh workdir"
+            )
     log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
     log.write(
         "config", name=cfg.name, seed=seed, ensemble_parallel=True,
@@ -552,17 +577,23 @@ def fit_ensemble_parallel(
     )
 
     model = models.build(cfg.model)
+    # State and keys are built INSIDE jit with member-axis out-shardings
+    # (multi-host legal: no host-side stacked copy to place).
     state, tx = train_lib.create_ensemble_state(
-        cfg, model, [seed + m for m in range(k)]
+        cfg, model, [seed + m for m in range(k)], mesh=mesh
     )
-    state = jax.device_put(state, mesh_lib.member_sharding(mesh))
     train_step = train_lib.make_ensemble_train_step(
         cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
     )
     eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
-    base_keys = jax.device_put(
-        train_lib.stack_member_keys([seed + m for m in range(k)]),
-        mesh_lib.member_sharding(mesh),
+    # Checkpoint/host gathers reshard member-sharded -> replicated (an
+    # all-gather riding ICI); device_get on multi-host is only legal for
+    # fully-addressable (replicated) arrays.
+    gather_state = jax.jit(
+        lambda s: s, out_shardings=mesh_lib.replicated(mesh)
+    )
+    base_keys = train_lib.stack_member_keys(
+        [seed + m for m in range(k)], mesh=mesh
     )
     ckpts = [
         ckpt_lib.Checkpointer(
@@ -580,15 +611,37 @@ def fit_ensemble_parallel(
         latest = [c.latest_step for c in ckpts]
         if any(s is not None for s in latest):
             # This driver checkpoints every member at every eval step, so
-            # a valid member-parallel workdir has all members at ONE step;
-            # anything else is a sequential-run workdir or a torn state.
+            # an intact member-parallel workdir has all members at ONE
+            # step. Differing steps mean either a sequential-run workdir
+            # OR a save torn by a crash between the per-member save()
+            # calls — recover by rolling every member back to the newest
+            # step they ALL still have (best/ retention often keeps it).
             if None in latest or len(set(latest)) != 1:
-                raise ValueError(
-                    f"member checkpoints are at different steps {latest} — "
-                    "not a member-parallel workdir (resume a sequential "
-                    "ensemble with train.ensemble_parallel=false)"
+                common = set.intersection(
+                    *[c.all_steps() for c in ckpts]
+                ) if ckpts else set()
+                if not common:
+                    raise ValueError(
+                        f"member checkpoints are at different steps "
+                        f"{latest} and share no restorable step — either "
+                        "this is a sequential-ensemble workdir (resume "
+                        "with train.ensemble_parallel=false) or a save "
+                        "was torn by a crash and retention has dropped "
+                        "the last common step"
+                    )
+                step0 = max(common)
+                absl_logging.warning(
+                    "member latest checkpoints disagree (%s) — likely a "
+                    "save torn by a crash; rolling back to the newest "
+                    "common step %d", latest, step0,
                 )
-            step0 = latest[0]
+                # Purge the abandoned timeline: stale newer checkpoints
+                # would collide with the re-run's saves at the same
+                # steps and hijack a later resume.
+                for c in ckpts:
+                    c.delete_newer_than(step0)
+            else:
+                step0 = latest[0]
             for m, c in enumerate(ckpts):
                 _check_ema_compat(
                     c, cfg, ckpt_lib.member_dir(workdir, m), step0
@@ -600,10 +653,12 @@ def fit_ensemble_parallel(
                 state,
             )
             members = [c.restore(member_abstract, step0) for c in ckpts]
-            state = jax.tree.map(
+            host_state = jax.tree.map(
                 lambda *xs: np.stack([np.asarray(x) for x in xs]), *members
             )
-            state = jax.device_put(state, mesh_lib.member_sharding(mesh))
+            state = mesh_lib.place_full_local(
+                host_state, mesh_lib.member_sharding(mesh)
+            )
             start_step = int(step0)
             # Same eval-history replay fit() does on resume — exact
             # min_delta/patience semantics, per member.
@@ -619,9 +674,13 @@ def fit_ensemble_parallel(
             )
 
     batches = pipeline.device_prefetch(
-        _train_stream(cfg, data_dir, seed, skip_batches=start_step, mesh=mesh),
+        _train_stream(
+            cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
+            full_batches=True,
+        ),
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
+        full_local=True,
     )
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
@@ -661,7 +720,7 @@ def fit_ensemble_parallel(
                 ens_auc = metrics.roc_auc(
                     bin_labels, metrics.ensemble_average(member_probs)
                 )
-                host_state = jax.device_get(state)
+                host_state = jax.device_get(gather_state(state))
                 for m in range(k):
                     ckpts[m].save(
                         step_i + 1,

@@ -49,12 +49,19 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        import zlib
+
         self._max_to_keep = max_to_keep
-        # Distinct barrier_sync_key_prefix per manager: on multi-host
-        # runs both managers finalize async saves through named orbax
-        # barriers, and with the default (empty) prefix the two managers'
-        # barriers collide ("Barrier ... is already ongoing"), deadlocking
-        # the coordination service at the next save.
+        # Distinct barrier_sync_key_prefix per manager AND per directory:
+        # on multi-host runs the managers finalize async saves through
+        # named orbax barriers, and identical prefixes collide ("Barrier
+        # ... is already ongoing"), deadlocking the coordination service
+        # at the next save. Per-directory disambiguation matters for the
+        # member-parallel driver, which keeps k member Checkpointers
+        # alive simultaneously. crc32, not hash(): PYTHONHASHSEED
+        # randomizes hash() per process, and the prefix must agree
+        # across all hosts.
+        tag = zlib.crc32(os.path.abspath(directory).encode()) & 0xFFFFFFFF
         self._best = ocp.CheckpointManager(
             os.path.join(directory, "best"),
             options=ocp.CheckpointManagerOptions(
@@ -63,7 +70,7 @@ class Checkpointer:
                 best_mode="max",
                 create=True,
                 multiprocessing_options=ocp.options.MultiprocessingOptions(
-                    barrier_sync_key_prefix="best"
+                    barrier_sync_key_prefix=f"best{tag:08x}"
                 ),
             ),
         )
@@ -73,7 +80,7 @@ class Checkpointer:
                 max_to_keep=1,
                 create=True,
                 multiprocessing_options=ocp.options.MultiprocessingOptions(
-                    barrier_sync_key_prefix="latest"
+                    barrier_sync_key_prefix=f"latest{tag:08x}"
                 ),
             ),
         )
@@ -184,6 +191,37 @@ class Checkpointer:
     @property
     def latest_step(self) -> int | None:
         return self._latest.latest_step()
+
+    def all_steps(self) -> set[int]:
+        """Every step restorable from either manager — the member-parallel
+        driver's torn-save recovery searches these for the newest step
+        ALL members still have."""
+        return set(self._best.all_steps()) | set(self._latest.all_steps())
+
+    def delete_newer_than(self, step: int) -> None:
+        """Purge checkpoints newer than ``step`` from both managers.
+
+        The member-parallel torn-save rollback re-trains from an older
+        common step; a member's abandoned-timeline checkpoint left in
+        place would (a) collide with the re-run's save at the same step
+        (orbax raises StepAlreadyExistsError) and (b) win max_to_keep's
+        lowest-step-first retention, so a second crash would resume from
+        the abandoned state."""
+        purged = False
+        for mngr in (self._best, self._latest):
+            for s in sorted(mngr.all_steps()):
+                if s > step:
+                    mngr.delete(s)
+                    purged = True
+        if purged:
+            # Rebuild the in-memory best view: deleted steps' metrics
+            # must not suppress future best/ saves.
+            self._best_kept = []
+            for s in self._best.all_steps():
+                m = self._best.metrics(s)
+                if m is not None:
+                    self._best_kept.append(float(m[BEST_METRIC]))
+            self._best_kept = sorted(self._best_kept)[-self._max_to_keep:]
 
     def restore(self, abstract_state: TrainState, step: int | None = None
                 ) -> TrainState:

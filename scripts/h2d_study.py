@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""H2D transfer study on the local chip (VERDICT r2 #2; docs/PERF.md §H2D).
+
+Measures host->device bandwidth as a function of (a) transfer size,
+(b) transfer path, and (c) whether a large executable has been loaded —
+the round-2 finding was that loading the flagship train-step executable
+collapses H2D on the axon tunnel from ~1.5 GB/s to ~18 MB/s with a
+~22 ms fixed per-transfer cost. This script quantifies every host-side
+lever that could beat the artifact:
+
+  paths:  device_put            (plain, committed default device)
+          device_put_sharded    (NamedSharding over a 1-chip mesh)
+          jit_arg               (numpy passed as a jit argument — the
+                                 dispatch path's implicit transfer)
+          np_asarray_d2h        (device->host direction, for symmetry)
+  sizes:  256 KB .. 64 MB chunks (a fixed per-transfer cost amortizes
+          with size; pure bandwidth collapse does not)
+
+Timing uses the same host-fetch fence discipline as bench.py (a scalar
+reduce fetched per transfer) so the numbers cannot be dispatch-only.
+
+Output: one JSON document on stdout with MB/s per (phase, path, size).
+Run directly on the TPU host:  python scripts/h2d_study.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SIZES_MB = (0.25, 1, 4, 16, 64)
+REPS = 5
+
+
+def _log(msg: str) -> None:
+    print(f"h2d_study: {msg}", file=sys.stderr)
+
+
+def _fence_scalar(x) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    return float(jax.device_get(jnp.sum(x[:16].astype(jnp.float32))))
+
+
+def _rate_mb_s(nbytes: int, seconds: float) -> float:
+    return nbytes / max(seconds, 1e-9) / 1e6
+
+
+def measure_paths(tag: str, results: dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    ident = jax.jit(lambda x: x * 1)  # jit_arg path: transfer + trivial op
+
+    for size_mb in SIZES_MB:
+        n = int(size_mb * 1e6)
+        host = np.random.default_rng(0).integers(
+            0, 256, (n,), np.uint8
+        )
+        row = results.setdefault(tag, {}).setdefault(f"{size_mb}MB", {})
+
+        # device_put
+        ts = []
+        for _ in range(REPS):
+            t0 = time.time()
+            d = jax.device_put(host)
+            _fence_scalar(d)
+            ts.append(time.time() - t0)
+            del d
+        row["device_put"] = round(_rate_mb_s(n, min(ts)), 1)
+
+        # device_put with NamedSharding
+        ts = []
+        for _ in range(REPS):
+            t0 = time.time()
+            d = jax.device_put(host, sharding)
+            _fence_scalar(d)
+            ts.append(time.time() - t0)
+            del d
+        row["device_put_sharded"] = round(_rate_mb_s(n, min(ts)), 1)
+
+        # implicit transfer via jit argument
+        ts = []
+        for _ in range(REPS):
+            t0 = time.time()
+            d = ident(host)
+            _fence_scalar(d)
+            ts.append(time.time() - t0)
+            del d
+        row["jit_arg"] = round(_rate_mb_s(n, min(ts)), 1)
+
+        # D2H for symmetry. A FRESH device array per rep: jax caches the
+        # host copy after the first np.asarray, so re-reading the same
+        # array measures a memcpy, not the tunnel.
+        ts = []
+        for _ in range(REPS):
+            dev = jax.device_put(host)
+            _fence_scalar(dev)
+            t0 = time.time()
+            np.asarray(dev)
+            ts.append(time.time() - t0)
+            del dev
+        row["np_asarray_d2h"] = round(_rate_mb_s(n, min(ts)), 1)
+
+        _log(f"{tag} {size_mb}MB: {row}")
+
+
+def load_big_executable() -> None:
+    """Compile+run the flagship train step — the trigger for the
+    round-2 H2D collapse (compilation alone triggered it)."""
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    cfg = get_config("eyepacs_binary")
+    mesh = mesh_lib.make_mesh()
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batch = mesh_lib.shard_batch(
+        {
+            "image": rng.integers(0, 256, (32, 299, 299, 3), np.uint8),
+            "grade": rng.integers(0, 5, (32,), np.int32),
+        },
+        mesh,
+    )
+    state, _ = step(state, batch, jax.random.key(1))
+    jax.block_until_ready(state)
+    _log("flagship executable compiled and run")
+
+
+def main() -> None:
+    results: dict = {}
+    measure_paths("before_executable", results)
+    load_big_executable()
+    measure_paths("after_executable", results)
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

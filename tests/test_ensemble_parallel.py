@@ -222,3 +222,77 @@ def test_ensemble_parallel_rejects_tf_backend(tmp_path):
     ])
     with pytest.raises(ValueError, match="flax-path"):
         trainer.fit_ensemble(cfg, str(tmp_path), str(tmp_path), backend="tf")
+
+
+def test_ensemble_parallel_rejects_foreign_seed_workdir(tmp_path):
+    """A member workdir persisted under a different base seed must be
+    refused, not silently retrained on a new PRNG stream (the run_meta
+    'CLI seed ignored' warning promises continuity this driver cannot
+    deliver for member streams derived from base+m)."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 16, 64, 1, seed=1)
+    workdir = str(tmp_path / "ck")
+    mdir = ckpt_lib.member_dir(workdir, 1)
+    os.makedirs(mdir)
+    with open(os.path.join(mdir, "run_meta.json"), "w") as f:
+        json.dump({"seed": 999, "config": "smoke"}, f)
+    cfg = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.resume=true", "train.steps=2",
+    ])
+    with pytest.raises(ValueError, match="differently-seeded"):
+        trainer.fit_ensemble(cfg, data_dir, workdir)
+
+
+@pytest.mark.slow
+def test_ensemble_parallel_recovers_from_torn_save(tmp_path):
+    """A crash between per-member saves leaves members' checkpoints at
+    different steps. Resume must roll every member back to the newest
+    COMMON step, purge the abandoned-timeline checkpoints (a later save
+    at the same step would otherwise collide), and reproduce the
+    uninterrupted run exactly from there."""
+    import shutil
+
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 3, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 2, seed=2)
+    base = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.eval_every=10", "data.batch_size=8", "eval.batch_size=8",
+        "train.lr_schedule=constant", "train.steps=20",
+    ])
+    full_dir, torn_dir = str(tmp_path / "full"), str(tmp_path / "torn")
+    full = trainer.fit_ensemble(base, data_dir, full_dir)
+    trainer.fit_ensemble(base, data_dir, torn_dir)
+
+    # Simulate the torn save: member 1 "missed" the step-20 save.
+    m1 = ckpt_lib.member_dir(torn_dir, 1)
+    for sub in ("best", "latest"):
+        p = os.path.join(m1, sub, "20")
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+
+    resumed = trainer.fit_ensemble(
+        override(base, ["train.resume=true"]), data_dir, torn_dir
+    )
+    # Rolled back to 10 and retrained: the resume record says so, and
+    # the re-run's step-20 save did not collide with member 0's stale
+    # step-20 checkpoint (it was purged).
+    assert any(
+        r.get("kind") == "resume" and r["step"] == 10
+        for r in read_jsonl(os.path.join(torn_dir, "metrics.jsonl"))
+    )
+    assert [r["best_auc"] for r in full] == [r["best_auc"] for r in resumed]
+    # Bit-identical final states vs the uninterrupted run.
+    model = models.build(base.model)
+    for m in range(2):
+        states = []
+        for w in (full_dir, torn_dir):
+            st, _ = train_lib.create_state(base, model, jax.random.key(m))
+            ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(w, m))
+            states.append(ck.restore(
+                ckpt_lib.abstract_like(jax.device_get(st)), ck.latest_step
+            ))
+            ck.close()
+        for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -61,7 +61,8 @@ def _child_env(extra=None) -> dict:
     return env
 
 
-def _run_train(data_dir, workdir, fake_devices, log_path, env=None):
+def _run_train(data_dir, workdir, fake_devices, log_path, env=None,
+               extra_args=()):
     # Child output goes to a FILE: with pipes, a process blocked on a
     # full pipe buffer while its peer waits at the jax.distributed
     # shutdown barrier deadlocks the whole group.
@@ -69,7 +70,7 @@ def _run_train(data_dir, workdir, fake_devices, log_path, env=None):
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "train.py"),
          f"--data_dir={data_dir}", f"--workdir={workdir}",
-         f"--fake_devices={fake_devices}", *COMMON_ARGS],
+         f"--fake_devices={fake_devices}", *COMMON_ARGS, *extra_args],
         env=_child_env(env), cwd=REPO,
         stdout=log, stderr=subprocess.STDOUT,
     )
@@ -163,3 +164,83 @@ def test_two_process_training_matches_single_process(tmp_path):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-3
         )
+
+
+ENSEMBLE_ARGS = [
+    "--set", "train.ensemble_size=2",
+    "--set", "train.ensemble_parallel=true",
+]
+
+
+@pytest.mark.slow
+def test_two_process_member_parallel_matches_single_process(tmp_path):
+    """Multi-HOST member-parallel ensembles (VERDICT r2 #3): a 2-process
+    x 2-fake-device run over the ('member': 2, 'data': 2) mesh — each
+    host reads the full batch stream, full-local assembly places the
+    interleaved data columns, the member-sharded state gathers through
+    the replicated reshard for checkpointing — pinned against the
+    single-process 4-device stacked run."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 1, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 1, seed=2)
+
+    w1 = str(tmp_path / "one_proc")
+    p = _run_train(data_dir, w1, 4, str(tmp_path / "one.log"),
+                   extra_args=ENSEMBLE_ARGS)
+    out = _wait(p)
+    assert p.returncode == 0, f"single-process ensemble failed:\n{out[-3000:]}"
+
+    w2 = str(tmp_path / "two_proc")
+    port = _free_port()
+    procs = [
+        _run_train(
+            data_dir, w2, 2, str(tmp_path / f"ep{i}.log"),
+            env={
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(i),
+            },
+            extra_args=ENSEMBLE_ARGS,
+        )
+        for i in range(2)
+    ]
+    outs = [_wait(p) for p in procs]
+    assert all(p.returncode == 0 for p in procs), (
+        f"two-process ensemble failed:\np0:\n{outs[0][-3000:]}\n"
+        f"p1:\n{outs[1][-3000:]}"
+    )
+    finals = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    assert finals[0]["results"] == finals[1]["results"]
+
+    # Same global batches (full stream on every host) -> per-member
+    # first-step losses match the single-process stacked run tightly.
+    def first_losses(w):
+        return next(
+            r["loss_per_member"]
+            for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
+            if r["kind"] == "train" and r["step"] == 1
+        )
+
+    l1, l2 = first_losses(w1), first_losses(w2)
+    assert len(l1) == len(l2) == 2
+    np.testing.assert_allclose(l1, l2, atol=5e-5)
+
+    # Both members' final checkpoints agree across the two runs.
+    cfg = override(get_config("smoke"), [
+        "train.steps=4", "data.augment=false", "model.dropout_rate=0.0",
+        "train.optimizer=sgdm",
+    ])
+    model = models.build(cfg.model)
+    for m in range(2):
+        states = []
+        for w in (w1, w2):
+            st, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+            ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(w, m))
+            states.append(ck.restore(
+                ckpt_lib.abstract_like(jax.device_get(st)), ck.latest_step
+            ))
+            ck.close()
+        for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-3
+            )
