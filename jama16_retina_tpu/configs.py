@@ -58,6 +58,12 @@ class DataConfig:
     # splits that fit the HBM budget (data/hbm_pipeline.py, docs/PERF.md
     # §H2D). Same {'image','grade'} batch contract.
     loader: str = "tfdata"
+    # grain loader only: number of worker PROCESSES decoding in parallel
+    # (0 = in-process). Multi-core TPU hosts want >0; resume then runs
+    # off per-checkpoint persisted iterator state instead of the
+    # (seed, step) derivation, which has no closed form across workers
+    # (data/grain_pipeline.state_at_step).
+    grain_workers: int = 0
     # NOTE: image size lives ONLY in ModelConfig.image_size; the pipeline
     # reads it from there so the two can never desync via overrides.
     shuffle_buffer: int = 4096

@@ -116,6 +116,58 @@ def _augment_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
     return jnp.clip(img, -1.0, 1.0)
 
 
+def augment_batch_np(
+    rng: "np.random.Generator", images_u8: np.ndarray, cfg: DataConfig
+) -> np.ndarray:
+    """Numpy twin of augment_batch for the host-side legacy TF backend
+    (trainer.fit_tf): the SAME ops, ranges, and op order — flips /
+    dihedral transpose, brightness, contrast about the per-image mean,
+    and YIQ-space saturation/hue with the exact inverse matrix.
+
+    Parity is distributional, not bitwise: draws come from numpy's
+    PRNG (the caller seeds it with (seed, step) for resume
+    determinism), while the TPU path derives threefry draws in-step.
+    Returns float32 [-1, 1] NHWC.
+    """
+    imgs = images_u8.astype(np.float32) / 127.5 - 1.0
+    if not cfg.augment:
+        return imgs
+    n = imgs.shape[0]
+
+    def per_ex(x):
+        return x[:, None, None, None]
+
+    if cfg.flip:
+        h, v = rng.random(n) < 0.5, rng.random(n) < 0.5
+        imgs = np.where(per_ex(h), imgs[:, :, ::-1], imgs)
+        imgs = np.where(per_ex(v), imgs[:, ::-1], imgs)
+    if cfg.rotate and imgs.shape[1] == imgs.shape[2]:
+        t = rng.random(n) < 0.5
+        imgs = np.where(per_ex(t), np.swapaxes(imgs, 1, 2), imgs)
+    if cfg.brightness_delta > 0:
+        imgs = imgs + per_ex(rng.uniform(
+            -cfg.brightness_delta, cfg.brightness_delta, n
+        ).astype(np.float32))
+    lo, hi = cfg.contrast_range
+    if (lo, hi) != (1.0, 1.0):
+        c = rng.uniform(lo, hi, n).astype(np.float32)
+        mean = imgs.mean(axis=(1, 2), keepdims=True)
+        imgs = (imgs - mean) * per_ex(c) + mean
+    slo, shi = cfg.saturation_range
+    if (slo, shi) != (1.0, 1.0) or cfg.hue_delta > 0:
+        s = rng.uniform(slo, shi, n).astype(np.float32)
+        theta = rng.uniform(-cfg.hue_delta, cfg.hue_delta, n).astype(
+            np.float32
+        ) * (2.0 * np.pi)
+        yiq = imgs @ np.asarray(_RGB2YIQ).T
+        cos = (np.cos(theta) * s)[:, None, None]
+        sin = (np.sin(theta) * s)[:, None, None]
+        y, i, q = yiq[..., 0], yiq[..., 1], yiq[..., 2]
+        yiq = np.stack([y, cos * i - sin * q, sin * i + cos * q], axis=-1)
+        imgs = yiq @ np.asarray(_YIQ2RGB).T
+    return np.clip(imgs, -1.0, 1.0).astype(np.float32)
+
+
 def _geometric_one(img: jnp.ndarray, p: dict, cfg: DataConfig) -> jnp.ndarray:
     if cfg.flip:
         img = jnp.where(p["hflip"], img[:, ::-1], img)
@@ -130,6 +182,7 @@ def augment_batch(
     images_u8: jnp.ndarray,
     cfg: DataConfig,
     interpret: bool = False,
+    debug: bool = False,
 ) -> jnp.ndarray:
     """uint8 NHWC batch -> augmented float32 [-1,1] batch (train path).
 
@@ -138,7 +191,17 @@ def augment_batch(
     commute with per-pixel color ops (the contrast mean is permutation-
     invariant), so applying color first is numerically equivalent to the
     jnp path's geometric-first order.
+
+    ``debug`` (the trainer passes train.debug, SURVEY.md §5.2): chex
+    shape/dtype asserts on the contract this function silently assumes —
+    trace-time only, zero compiled cost.
     """
+    if debug:
+        import chex
+
+        chex.assert_rank(images_u8, 4)
+        chex.assert_type(images_u8, jnp.uint8)
+        chex.assert_axis_dimension(images_u8, -1, 3)
     if not cfg.augment:
         return normalize(images_u8)
     params = _draw_params(key, images_u8.shape[0], cfg)

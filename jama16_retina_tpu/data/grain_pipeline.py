@@ -249,8 +249,11 @@ def state_at_step(
         # Use get_state()/set_state() persistence for worker_count>0.
         raise NotImplementedError(
             "state_at_step derivation is defined for in-process loading "
-            "(worker_count=0, the default); persist iterator.get_state() "
-            "instead when using worker processes"
+            "(worker_count=0, the default); worker-process runs resume "
+            "from the get_state() bytes the trainer persists next to "
+            "each checkpoint (grain_state/<step>.json — absent here, so "
+            "either this workdir predates worker-mode persistence or "
+            "the state file for this step was lost)"
         )
     k = step * local_batch_size
     state["last_seen_indices"] = {
@@ -271,15 +274,23 @@ def train_batches(
     process_count: int | None = None,
     skip_batches: int = 0,
     worker_count: int = 0,
+    initial_state: bytes | None = None,
 ) -> Iterator[dict]:
     """Drop-in twin of pipeline.train_batches on the grain loader —
-    ``skip_batches`` is an O(1) state restore instead of a replay."""
+    ``skip_batches`` is an O(1) state restore instead of a replay.
+
+    ``initial_state``: explicit grain iterator state to restore (the
+    resume path for ``worker_count > 0``, where positions have no
+    closed form — the trainer persists ``get_state()`` bytes next to
+    each checkpoint and hands them back here; see state_at_step)."""
     it = make_train_iterator(
         data_dir, split, cfg, image_size, seed=seed,
         process_index=process_index, process_count=process_count,
         worker_count=worker_count,
     )
-    if skip_batches:
+    if initial_state is not None:
+        it.set_state(initial_state)
+    elif skip_batches:
         from jama16_retina_tpu.data.pipeline import (
             _local_batch_size,
             _resolve_process,

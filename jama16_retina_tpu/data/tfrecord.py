@@ -10,6 +10,9 @@ existing TFRecord pipeline"). Here the on-disk contract is explicit:
     image/width    int64   raw width (0 for JPEG records)
     image/grade    int64   ICDR grade 0..4 (binary label derived online)
     image/name     bytes   source image id (debugging / dedup)
+    image/quality  float   gradability score in [0,1] from preprocessing
+                           (fundus.gradability_stats; -1 = not computed,
+                           e.g. legacy shards or synthetic fixtures)
 
 Two encodings, chosen at preprocessing time:
 
@@ -48,7 +51,8 @@ def shard_path(out_dir: str, split: str, shard: int, num_shards: int) -> str:
     )
 
 
-def make_example(jpeg_bytes: bytes, grade: int, name: str = ""):
+def make_example(jpeg_bytes: bytes, grade: int, name: str = "",
+                 quality: float = -1.0):
     tf = _tf()
     feat = {
         "image/encoded": tf.train.Feature(
@@ -60,11 +64,15 @@ def make_example(jpeg_bytes: bytes, grade: int, name: str = ""):
         "image/name": tf.train.Feature(
             bytes_list=tf.train.BytesList(value=[name.encode()])
         ),
+        "image/quality": tf.train.Feature(
+            float_list=tf.train.FloatList(value=[float(quality)])
+        ),
     }
     return tf.train.Example(features=tf.train.Features(feature=feat))
 
 
-def make_raw_example(image_u8: np.ndarray, grade: int, name: str = ""):
+def make_raw_example(image_u8: np.ndarray, grade: int, name: str = "",
+                     quality: float = -1.0):
     """Pre-decoded record: uint8 HWC pixels stored verbatim (see module
     docstring for the jpeg/raw trade-off)."""
     tf = _tf()
@@ -82,6 +90,9 @@ def make_raw_example(image_u8: np.ndarray, grade: int, name: str = ""):
         ),
         "image/name": tf.train.Feature(
             bytes_list=tf.train.BytesList(value=[name.encode()])
+        ),
+        "image/quality": tf.train.Feature(
+            float_list=tf.train.FloatList(value=[float(quality)])
         ),
     }
     return tf.train.Example(features=tf.train.Features(feature=feat))
@@ -221,6 +232,30 @@ def parse_fn():
         return image, tf.cast(f["image/grade"], tf.int32), f["image/name"]
 
     return parse
+
+
+def read_quality_by_name(paths: Sequence[str]) -> dict[bytes, float]:
+    """-> {image/name: image/quality} for every record, without touching
+    pixels (a light parse over the serialized stream). Used by evaluate's
+    ``--save_probs`` to join the preprocessing gradability score onto
+    per-image predictions (docs/QUALITY.md step 4: do misses correlate
+    with low-quality captures?). Records written before the quality
+    feature existed come back as -1.0."""
+    tf = _tf()
+    spec = {
+        "image/name": tf.io.FixedLenFeature([], tf.string, default_value=""),
+        "image/quality": tf.io.FixedLenFeature(
+            [], tf.float32, default_value=-1.0
+        ),
+    }
+    out: dict[bytes, float] = {}
+    ds = tf.data.TFRecordDataset(list(paths)).map(
+        lambda s: tf.io.parse_single_example(s, spec),
+        num_parallel_calls=tf.data.AUTOTUNE,
+    )
+    for f in ds.as_numpy_iterator():
+        out[f["image/name"]] = float(f["image/quality"])
+    return out
 
 
 def count_records(paths: Sequence[str]) -> int:

@@ -109,6 +109,11 @@ class PreprocessStats:
     skipped_missing: int = 0
     skipped_unreadable: int = 0
     skipped_no_fundus: int = 0
+    skipped_low_quality: int = 0
+    # Summary of the gradability scores of WRITTEN records (the filter
+    # threshold should be chosen from the report's distribution).
+    quality_mean: float = 0.0
+    quality_min: float = 0.0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -124,18 +129,32 @@ def process_split(
     ben_graham: bool = False,
     jpeg_quality: int = 92,
     encoding: str = "jpeg",
+    min_quality: float = 0.0,
 ) -> PreprocessStats:
     """Normalize every (name, grade) image and write TFRecord shards.
 
     ``encoding='raw'`` stores pre-decoded uint8 pixels (~9x disk at
     299px) so the training host never pays a per-epoch JPEG decode —
     the feed-rate mitigation measured in bench.py / docs/PERF.md.
+
+    Every image gets a gradability score (fundus.gradability_stats),
+    stored in its record (image/quality) and in the per-image report CSV
+    ``<out_dir>/quality_<split>.csv``; ``min_quality`` > 0 additionally
+    DROPS images scoring below it — the executable form of the original
+    JAMA study's image-quality grading step (docs/QUALITY.md).
     """
     import cv2
 
     if encoding not in ("jpeg", "raw"):
         raise ValueError(f"encoding must be jpeg|raw, got {encoding!r}")
     stats = PreprocessStats()
+    qualities: list[float] = []
+    os.makedirs(out_dir, exist_ok=True)
+    report_path = os.path.join(out_dir, f"quality_{split}.csv")
+    report = open(report_path, "w", newline="")
+    report_csv = csv.writer(report)
+    report_csv.writerow(["name", "grade", "quality", "lap_var", "mean",
+                        "std", "written"])
 
     def examples() -> Iterator:
         for name, grade in items:
@@ -149,19 +168,38 @@ def process_split(
                 continue
             rgb = bgr[..., ::-1]
             try:
-                norm = fundus.resize_and_center_fundus(
-                    rgb, diameter=image_size, ben_graham=ben_graham
+                norm, q = fundus.resize_and_center_fundus(
+                    rgb, diameter=image_size, ben_graham=ben_graham,
+                    with_quality=True,
                 )
             except fundus.FundusNotFound:
                 stats.skipped_no_fundus += 1
                 continue
+            keep = q["quality"] >= min_quality
+            report_csv.writerow([
+                name, grade, q["quality"], q["lap_var"], q["mean"],
+                q["std"], int(keep),
+            ])
+            if not keep:
+                stats.skipped_low_quality += 1
+                continue
             stats.written += 1
+            qualities.append(q["quality"])
             if encoding == "raw":
-                yield tfrecord.make_raw_example(norm, grade, name)
+                yield tfrecord.make_raw_example(
+                    norm, grade, name, quality=q["quality"]
+                )
             else:
                 yield tfrecord.make_example(
-                    tfrecord.encode_jpeg(norm, quality=jpeg_quality), grade, name
+                    tfrecord.encode_jpeg(norm, quality=jpeg_quality),
+                    grade, name, quality=q["quality"],
                 )
 
-    tfrecord.write_example_shards(examples(), out_dir, split, num_shards)
+    try:
+        tfrecord.write_example_shards(examples(), out_dir, split, num_shards)
+    finally:
+        report.close()
+    if qualities:
+        stats.quality_mean = round(float(np.mean(qualities)), 4)
+        stats.quality_min = round(float(np.min(qualities)), 4)
     return stats

@@ -85,6 +85,69 @@ def ben_graham_enhance(image: np.ndarray, alpha: float = 4.0) -> np.ndarray:
     return np.clip(out, 0, 255).astype(np.uint8)
 
 
+def _circle_mask(diameter: int, fill: float) -> np.ndarray:
+    yy, xx = np.mgrid[0:diameter, 0:diameter]
+    r = diameter * fill / 2.0
+    return ((xx - diameter / 2 + 0.5) ** 2
+            + (yy - diameter / 2 + 0.5) ** 2) <= r * r
+
+
+def gradability_stats(
+    norm_rgb: np.ndarray, fill: float = 0.98
+) -> dict[str, float]:
+    """Cheap image-quality / gradability heuristics for one NORMALIZED
+    fundus canvas (pre-enhancement), restricted to the fundus circle.
+
+    The replication's hypothesized AUC gap vs the original JAMA study is
+    the original's non-public image-quality grading (docs/QUALITY.md,
+    SURVEY.md §6 note) — this is the executable stand-in: a [0, 1]
+    ``quality`` score combining
+
+      * sharpness  — Laplacian variance inside the circle (the classic
+        focus measure; blur collapses it),
+      * illumination — penalize under/over-exposed means (a window, not
+        a target: fundus cameras differ in brightness),
+      * contrast   — grayscale std inside the circle (washed-out frames
+        carry no gradeable vasculature).
+
+    Each term saturates smoothly; the score is their product. It is a
+    HEURISTIC proxy for gradability, meant for ranking/filtering
+    (``--min_quality``), not a calibrated probability — thresholds
+    should be chosen by inspecting the preprocessing report's
+    distribution.
+    """
+    import cv2
+
+    if norm_rgb.ndim != 3 or norm_rgb.shape[0] != norm_rgb.shape[1]:
+        raise ValueError(f"expected square HWC canvas, got {norm_rgb.shape}")
+    d = norm_rgb.shape[0]
+    gray = cv2.cvtColor(norm_rgb, cv2.COLOR_RGB2GRAY)
+    mask = _circle_mask(d, fill)
+    vals = gray[mask].astype(np.float32)
+    lap = cv2.Laplacian(gray, cv2.CV_32F)
+    lap_var = float(lap[mask].var())
+    mean = float(vals.mean())
+    std = float(vals.std())
+    # Saturation constants chosen on synthetic + public fundus ranges:
+    # sharp fundus photographs at 299px sit at lap_var ~100-1000, heavy
+    # blur < 10; usable illumination means ~40-220 of 255; gradeable
+    # contrast std ≳ 25.
+    sharpness = 1.0 - float(np.exp(-lap_var / 50.0))
+    if mean < 40.0:
+        illum = mean / 40.0
+    elif mean > 220.0:
+        illum = max(0.0, (255.0 - mean) / 35.0)
+    else:
+        illum = 1.0
+    contrast = 1.0 - float(np.exp(-std / 25.0))
+    return {
+        "quality": round(sharpness * illum * contrast, 4),
+        "lap_var": round(lap_var, 2),
+        "mean": round(mean, 2),
+        "std": round(std, 2),
+    }
+
+
 def resize_and_center_fundus(
     image_rgb: np.ndarray,
     diameter: int = 299,
@@ -92,13 +155,18 @@ def resize_and_center_fundus(
     circular_mask: bool = True,
     ben_graham: bool = False,
     threshold: int = 12,
-) -> np.ndarray:
+    with_quality: bool = False,
+):
     """Normalize one photograph to a centered fixed-radius fundus
     (the reference's ``resize_and_center_fundus``, SURVEY.md R6).
 
-    Returns uint8 RGB ``[diameter, diameter, 3]``. Raises FundusNotFound
-    for blank frames (callers count and skip these, as the reference's
-    preprocessing scripts did).
+    Returns uint8 RGB ``[diameter, diameter, 3]`` — or, with
+    ``with_quality``, a ``(canvas, gradability_stats)`` pair where the
+    stats are computed on the PRE-enhancement canvas (ben-graham
+    deliberately flattens illumination and boosts edges, which would
+    blind the very heuristics meant to catch bad captures). Raises
+    FundusNotFound for blank frames (callers count and skip these, as
+    the reference's preprocessing scripts did).
     """
     import cv2
 
@@ -123,12 +191,9 @@ def resize_and_center_fundus(
         raise FundusNotFound("fundus window fell outside the frame")
     canvas[dy0:dy0 + h, dx0:dx0 + w] = resized[sy0:sy1, sx0:sx1]
 
+    quality = gradability_stats(canvas, fill) if with_quality else None
     if ben_graham:
         canvas = ben_graham_enhance(canvas)
     if circular_mask:
-        yy, xx = np.mgrid[0:diameter, 0:diameter]
-        r = diameter * fill / 2.0
-        m = ((xx - diameter / 2 + 0.5) ** 2 + (yy - diameter / 2 + 0.5) ** 2
-             ) <= r * r
-        canvas[~m] = 0
-    return canvas
+        canvas[~_circle_mask(diameter, fill)] = 0
+    return (canvas, quality) if with_quality else canvas

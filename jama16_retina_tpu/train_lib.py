@@ -185,11 +185,33 @@ def loss_fn(params, batch_stats, model, images, grades, dropout_rng,
 def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
                model, cfg: ExperimentConfig, augment_key_extra=None):
     """Shared body for the jit and pmap step forms."""
+    debug = cfg.train.debug
+    if debug:
+        # chex asserts under --debug (SURVEY.md §5.2): trace-time
+        # shape/dtype pins on the step's input contract.
+        import chex
+
+        chex.assert_rank(batch["image"], 4)
+        chex.assert_type(batch["image"], jnp.uint8)
+        chex.assert_rank(batch["grade"], 1)
+        chex.assert_equal_shape_prefix(
+            [batch["image"], batch["grade"]], 1
+        )
+        chex.assert_axis_dimension(
+            batch["image"], 1, cfg.model.image_size
+        )
     key = jax.random.fold_in(base_key, state.step)
     if augment_key_extra is not None:
         key = jax.random.fold_in(key, augment_key_extra)
     aug_key, dropout_key = jax.random.split(key)
-    images = augment_lib.augment_batch(aug_key, batch["image"], cfg.data)
+    images = augment_lib.augment_batch(
+        aug_key, batch["image"], cfg.data, debug=debug
+    )
+    if debug:
+        import chex
+
+        chex.assert_type(images, jnp.float32)
+        chex.assert_equal_shape([images, batch["image"]])
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     (loss, (logits, new_stats)), grads = grad_fn(

@@ -20,6 +20,7 @@ from absl import logging as absl_logging
 
 from jama16_retina_tpu import models, train_lib
 from jama16_retina_tpu.configs import ExperimentConfig
+from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
 from jama16_retina_tpu.parallel import mesh as mesh_lib
@@ -106,9 +107,91 @@ def predict_split_tf(
     )
 
 
+class _GrainStateTee:
+    """Snapshot the grain iterator's state after every produced batch.
+
+    device_prefetch pulls the iterator AHEAD of the train step by its
+    queue depth, so ``it.get_state()`` at checkpoint time describes a
+    future position; resume needs the state as of the checkpointed step.
+    The tee records state per batch ordinal (a bounded ring: prefetch
+    depth is small) so the trainer can persist exactly the state an
+    uninterrupted run had after step s's batch."""
+
+    def __init__(self, it, start_ordinal: int, keep: int = 16):
+        self._it = it
+        self._n = start_ordinal
+        # Ring depth must exceed the prefetch lead or the checkpoint
+        # step's state is evicted before persistence reads it.
+        self._keep = max(16, keep)
+        self._states: dict[int, bytes] = {}
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._it)
+        self._n += 1
+        self._states[self._n] = self._it.get_state()
+        self._states.pop(self._n - self._keep, None)
+        return batch
+
+    def state_after(self, ordinal: int) -> bytes | None:
+        return self._states.get(ordinal)
+
+
+def _grain_state_path(workdir: str, step: int) -> str:
+    """Per-PROCESS state file (same convention as RunLog's .p{N}
+    mirrors): each process's grain iterator holds its own shard
+    positions, and a shared filename would let the last writer clobber
+    every other process's resume point."""
+    import jax
+
+    idx = jax.process_index()
+    name = f"{step}.json" if idx == 0 else f"{step}.p{idx}.json"
+    return os.path.join(workdir, "grain_state", name)
+
+
+def _persist_grain_state(tee: "_GrainStateTee | None", workdir: str,
+                         step: int) -> None:
+    """Write the worker-mode grain position for ``step`` next to its
+    checkpoint (pruned alongside; tiny JSON files)."""
+    if tee is None:
+        return
+    state = tee.state_after(step)
+    if state is None:
+        # Legitimate only at a resumed run's first eval (no new batch
+        # consumed yet); any other miss means the ring was outrun.
+        if step > tee._n - tee._keep:
+            return
+        absl_logging.warning(
+            "grain state for step %d was evicted from the tee ring "
+            "(produced up to %d, keep=%d) — this checkpoint will not be "
+            "worker-mode resumable", step, tee._n, tee._keep,
+        )
+        return
+    os.makedirs(os.path.join(workdir, "grain_state"), exist_ok=True)
+    with open(_grain_state_path(workdir, step), "wb") as f:
+        f.write(state)
+
+
+def _load_grain_state(cfg: ExperimentConfig, workdir: str,
+                      start_step: int) -> bytes | None:
+    """Persisted worker-mode grain position for a resume, when one
+    applies. Missing file → None; grain_pipeline then raises its
+    documented NotImplementedError for worker-mode skip_batches."""
+    if (cfg.data.loader != "grain" or cfg.data.grain_workers <= 0
+            or start_step == 0):
+        return None
+    path = _grain_state_path(workdir, start_step)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return f.read()
+
+
 def _train_stream(
     cfg: ExperimentConfig, data_dir: str, seed: int, skip_batches: int,
-    mesh=None, full_batches: bool = False,
+    mesh=None, full_batches: bool = False, grain_state: bytes | None = None,
 ):
     """Dispatch on data.loader (SURVEY.md N4): every loader yields the
     same {'image','grade'} batches and honors skip_batches, so the train
@@ -135,7 +218,9 @@ def _train_stream(
 
         return grain_pipeline.train_batches(
             data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
-            skip_batches=skip_batches, **proc_kw,
+            skip_batches=skip_batches,
+            worker_count=cfg.data.grain_workers,
+            initial_state=grain_state, **proc_kw,
         )
     if cfg.data.loader != "tfdata":
         raise ValueError(
@@ -351,7 +436,8 @@ def fit(
     if cfg.train.debug:
         jax.config.update("jax_debug_nans", True)
     mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
-    log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
+    log = RunLog(workdir, tensorboard=cfg.train.tensorboard,
+                 fresh=not cfg.train.resume)
     log.write("config", name=cfg.name, seed=seed,
               n_devices=int(np.prod(list(mesh.shape.values()))))
 
@@ -395,8 +481,19 @@ def fit(
     # stream continues exactly where the interrupted one stopped
     # (pipeline determinism; SURVEY.md §5.4). Augment/dropout keys need
     # no restoring — they are fold_in(base_key, state.step) in-step.
+    stream = _train_stream(
+        cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
+        grain_state=_load_grain_state(cfg, workdir, start_step),
+    )
+    grain_tee = None
+    if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
+        # Worker-mode positions have no (seed, step) closed form — tee
+        # the stream so each checkpoint can persist its exact state.
+        stream = grain_tee = _GrainStateTee(
+            stream, start_step, keep=cfg.data.prefetch_batches + 4
+        )
     batches = pipeline.device_prefetch(
-        _train_stream(cfg, data_dir, seed, skip_batches=start_step, mesh=mesh),
+        stream,
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
     )
@@ -430,6 +527,7 @@ def fit(
                     jax.device_get(state),
                     best_auc, best_step, since_best,
                 )
+                _persist_grain_state(grain_tee, workdir, step_i + 1)
                 if stop:
                     stopped_early = True
                     break
@@ -570,7 +668,20 @@ def fit_ensemble_parallel(
                 "differently-seeded ensemble; resume with the original "
                 "base seed or use a fresh workdir"
             )
-    log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
+    # Marker distinguishing this driver's workdirs from the sequential
+    # driver's (identical member_NN layout otherwise). The torn-save
+    # rollback below DELETES checkpoints; it must never fire on a
+    # half-finished sequential-ensemble workdir, whose members are
+    # legitimately at different steps.
+    marker = os.path.join(workdir, ".member_parallel")
+    # Read BEFORE writing: a resume of a sequential workdir must not
+    # first stamp it as member-parallel and then trust the stamp.
+    was_member_parallel = os.path.exists(marker)
+    os.makedirs(workdir, exist_ok=True)
+    with open(marker, "w") as f:
+        f.write("workdir written by trainer.fit_ensemble_parallel\n")
+    log = RunLog(workdir, tensorboard=cfg.train.tensorboard,
+                 fresh=not cfg.train.resume)
     log.write(
         "config", name=cfg.name, seed=seed, ensemble_parallel=True,
         n_members=k, mesh_shape=dict(mesh.shape),
@@ -586,12 +697,18 @@ def fit_ensemble_parallel(
         cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
     )
     eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
-    # Checkpoint/host gathers reshard member-sharded -> replicated (an
-    # all-gather riding ICI); device_get on multi-host is only legal for
-    # fully-addressable (replicated) arrays.
-    gather_state = jax.jit(
-        lambda s: s, out_shardings=mesh_lib.replicated(mesh)
-    )
+    # Checkpoint/host gathers: on multi-host, reshard member-sharded ->
+    # replicated first (an all-gather riding ICI) — device_get is only
+    # legal for fully-addressable arrays there. Single-process the state
+    # is already fully addressable and the k-fold replicated copy would
+    # be a pure HBM spike (k=10 Inception states are GBs), so skip it.
+    if jax.process_count() > 1:
+        gather_state = jax.jit(
+            lambda s: s, out_shardings=mesh_lib.replicated(mesh)
+        )
+    else:
+        def gather_state(s):
+            return s
     base_keys = train_lib.stack_member_keys(
         [seed + m for m in range(k)], mesh=mesh
     )
@@ -617,17 +734,27 @@ def fit_ensemble_parallel(
             # calls — recover by rolling every member back to the newest
             # step they ALL still have (best/ retention often keeps it).
             if None in latest or len(set(latest)) != 1:
+                if not was_member_parallel:
+                    # Members at different steps in a workdir this
+                    # driver never stamped = a half-finished SEQUENTIAL
+                    # ensemble; rolling back would delete its perfectly
+                    # valid newer checkpoints.
+                    raise ValueError(
+                        f"member checkpoints are at different steps "
+                        f"{latest} and this is not a member-parallel "
+                        "workdir — resume the sequential ensemble with "
+                        "train.ensemble_parallel=false"
+                    )
                 common = set.intersection(
                     *[c.all_steps() for c in ckpts]
                 ) if ckpts else set()
                 if not common:
                     raise ValueError(
                         f"member checkpoints are at different steps "
-                        f"{latest} and share no restorable step — either "
-                        "this is a sequential-ensemble workdir (resume "
-                        "with train.ensemble_parallel=false) or a save "
-                        "was torn by a crash and retention has dropped "
-                        "the last common step"
+                        f"{latest} and share no restorable step — a "
+                        "save was torn by a crash and retention has "
+                        "dropped the last common step; the workdir "
+                        "needs manual surgery (or restart fresh)"
                     )
                 step0 = max(common)
                 absl_logging.warning(
@@ -673,11 +800,21 @@ def fit_ensemble_parallel(
                 ],
             )
 
+    stream = _train_stream(
+        cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
+        full_batches=True,
+        grain_state=_load_grain_state(cfg, workdir, start_step),
+    )
+    grain_tee = None
+    if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
+        # Same worker-mode persistence contract as fit() — states land
+        # in <workdir>/grain_state/ (per process; members share the one
+        # full stream so there is one state per process, not per member).
+        stream = grain_tee = _GrainStateTee(
+            stream, start_step, keep=cfg.data.prefetch_batches + 4
+        )
     batches = pipeline.device_prefetch(
-        _train_stream(
-            cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
-            full_batches=True,
-        ),
+        stream,
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
         full_local=True,
@@ -727,6 +864,7 @@ def fit_ensemble_parallel(
                         train_lib.unstack_member(host_state, m),
                         {"val_auc": float(aucs[m])},
                     )
+                _persist_grain_state(grain_tee, workdir, step_i + 1)
                 best_auc, best_step, since_best = _best_tracking_update(
                     aucs, best_auc, best_step, since_best, step_i + 1,
                     cfg.train.min_delta,
@@ -765,6 +903,32 @@ def fit_ensemble_parallel(
     ]
 
 
+def _keras_schedule(tc):
+    """train_lib.make_schedule's keras LearningRateSchedule twin (same
+    three shapes, same clamp rule for infeasible warmups) so fit_tf
+    trains under the SAME LR curve as the flax path."""
+    import tensorflow as tf
+
+    if tc.lr_schedule == "constant":
+        return tc.learning_rate
+    if tc.lr_schedule == "cosine":
+        return tf.keras.optimizers.schedules.CosineDecay(
+            tc.learning_rate, tc.steps
+        )
+    if tc.lr_schedule == "warmup_cosine":
+        warmup = max(1, min(tc.warmup_steps, tc.steps - 1))
+        if warmup != tc.warmup_steps:
+            absl_logging.warning(
+                "warmup_steps=%d does not fit in steps=%d; clamped to %d",
+                tc.warmup_steps, tc.steps, warmup,
+            )
+        return tf.keras.optimizers.schedules.CosineDecay(
+            0.0, tc.steps - warmup,
+            warmup_target=tc.learning_rate, warmup_steps=warmup,
+        )
+    raise ValueError(f"unknown lr_schedule {tc.lr_schedule!r}")
+
+
 def fit_tf(
     cfg: ExperimentConfig, data_dir: str, workdir: str, seed: int | None = None
 ) -> dict:
@@ -777,17 +941,19 @@ def fit_tf(
     through the keras->flax transplant into the SAME orbax format, so a
     TF-trained model is evaluable by either backend.
 
-    Honest deltas from the TPU path, by design of a legacy path:
-      * augmentation is flips-only (the TPU path's fused color jitter is
-        a TPU feature; the reference era's tf.image jitter is not worth
-        re-creating for an eval/compat backend);
+    Honest deltas from the TPU path — now only the structural ones:
       * keras InceptionV3 has no auxiliary head, so the flax objective's
         ``aux_weight`` loss term is absent here;
-      * optax state is not representable in keras — a --resume of a
-        tf-trained checkpoint restarts optimizer moments;
-      * LR schedules collapse to the constant peak rate;
+      * optax moments are not representable in keras — a --resume of a
+        tf-trained checkpoint restarts them (the LR-schedule POSITION
+        does resume: optimizer.iterations is set to the restored step);
       * weight decay is masked by variable NAME (beta/bias excluded)
         rather than by rank — equivalent for these architectures.
+    Closed in round 3 (VERDICT r2 #6): augmentation is the full numpy
+    twin of the TPU path (augment.augment_batch_np — flips, dihedral
+    transpose, brightness/contrast, YIQ saturation/hue, same ranges),
+    and make_schedule's constant/cosine/warmup_cosine all map onto
+    keras LearningRateSchedules (_keras_schedule).
     """
     import tensorflow as tf
 
@@ -804,33 +970,44 @@ def fit_tf(
             "jit train step; the tf backend trains on host — use the "
             "tfdata or grain loader with --device=tf"
         )
+    if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
+        raise ValueError(
+            "data.grain_workers>0 is unsupported on the legacy tf "
+            "backend: worker-mode resume needs the grain-state "
+            "persistence wired into the flax drivers — a long tf run "
+            "would train fine but never be resumable. Use "
+            "grain_workers=0 (or the flax path) with --device=tf"
+        )
     seed = cfg.train.seed if seed is None else seed
     seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
     tf.keras.utils.set_random_seed(seed)
-    log = RunLog(workdir, tensorboard=cfg.train.tensorboard)
+    log = RunLog(workdir, tensorboard=cfg.train.tensorboard,
+                 fresh=not cfg.train.resume)
     log.write("config", name=cfg.name, seed=seed, backend="tf")
 
     keras_model = models.build(cfg.model, backend="tf")
     tc = cfg.train
-    # Mirror train_lib.make_optimizer: decoupled weight decay, global-norm
-    # clipping, and the slim-era RMSprop eps=1.0.
+    # Mirror train_lib.make_optimizer: the same LR schedule (keras
+    # LearningRateSchedule twin of make_schedule), decoupled weight
+    # decay, global-norm clipping, and the slim-era RMSprop eps=1.0.
+    lr = _keras_schedule(tc)
     clip = tc.gradient_clip_norm if tc.gradient_clip_norm > 0 else None
     # keras AdamW requires a float weight_decay (None crashes); the base-
     # optimizer kwarg on SGD/RMSprop wants None to mean "disabled".
     wd_or_none = tc.weight_decay if tc.weight_decay else None
     if tc.optimizer == "adamw":
         opt = tf.keras.optimizers.AdamW(
-            tc.learning_rate, weight_decay=float(tc.weight_decay),
+            lr, weight_decay=float(tc.weight_decay),
             global_clipnorm=clip,
         )
     elif tc.optimizer == "sgdm":
         opt = tf.keras.optimizers.SGD(
-            tc.learning_rate, momentum=tc.momentum, nesterov=True,
+            lr, momentum=tc.momentum, nesterov=True,
             weight_decay=wd_or_none, global_clipnorm=clip,
         )
     elif tc.optimizer == "rmsprop":
         opt = tf.keras.optimizers.RMSprop(
-            tc.learning_rate, rho=0.9, momentum=tc.momentum, epsilon=1.0,
+            lr, rho=0.9, momentum=tc.momentum, epsilon=1.0,
             weight_decay=wd_or_none, global_clipnorm=clip,
         )
     else:
@@ -874,6 +1051,10 @@ def fit_tf(
             keras_model, restored.params, restored.batch_stats
         )
         start_step = int(np.asarray(restored.step))
+        # Resume the LR-schedule POSITION (keras schedules read
+        # optimizer.iterations). Moments still restart — the documented
+        # structural delta.
+        keras_model.optimizer.iterations.assign(start_step)
         log.write("resume", step=start_step)
 
     batches = _train_stream(cfg, data_dir, seed, skip_batches=start_step)
@@ -882,17 +1063,14 @@ def fit_tf(
     t_log, imgs_since = time.time(), 0
     for step_i in range(start_step, tc.steps):
         batch = next(batches)
-        images = batch["image"]
-        if cfg.data.augment:
-            # Per-step generator keyed on (seed, step): a resumed run
-            # draws the same flips an uninterrupted one would (the numpy
-            # analogue of fit's fold_in(base_key, step); SURVEY.md §5.4).
-            rng = np.random.default_rng((seed, step_i))
-            flip_h = rng.random(images.shape[0]) < 0.5
-            flip_v = rng.random(images.shape[0]) < 0.5
-            images = np.where(flip_h[:, None, None, None], images[:, :, ::-1], images)
-            images = np.where(flip_v[:, None, None, None], images[:, ::-1], images)
-        x = images.astype(np.float32) / 127.5 - 1.0
+        # Per-step generator keyed on (seed, step): a resumed run draws
+        # the same augmentations an uninterrupted one would (the numpy
+        # analogue of fit's fold_in(base_key, step); SURVEY.md §5.4).
+        # augment_batch_np is the full numpy twin of the TPU path
+        # (includes normalize; a no-op pass-through when augment=false).
+        x = augment_lib.augment_batch_np(
+            np.random.default_rng((seed, step_i)), batch["image"], cfg.data
+        )
         if cfg.model.head == "binary":
             y = (batch["grade"] >= 2).astype(np.float32)[:, None]
         else:
@@ -900,7 +1078,7 @@ def fit_tf(
                 batch["grade"].astype(np.int64)
             ]
         step_loss = float(keras_model.train_on_batch(x, y))
-        imgs_since += images.shape[0]
+        imgs_since += x.shape[0]
 
         if (step_i + 1) % tc.log_every == 0:
             dt = time.time() - t_log
@@ -1084,9 +1262,17 @@ def evaluate_checkpoints(
                 "ece": metrics.expected_calibration_error(eval_bin, cal),
             }
     if save_probs:
+        # Join the preprocessing gradability score per image (QUALITY.md
+        # step 4: do misses correlate with low-quality captures?). -1
+        # marks records written without a score (legacy/synthetic).
+        from jama16_retina_tpu.data import tfrecord as tfrecord_lib
+
+        quality_by_name = tfrecord_lib.read_quality_by_name(
+            tfrecord_lib.list_split(data_dir, split)
+        )
         _write_probs_csv(
             save_probs, eval_names, grades_by["eval"], probs,
-            cfg.model.head,
+            cfg.model.head, quality_by_name,
         )
         report["probs_file"] = save_probs
     report["split"] = split
@@ -1096,28 +1282,35 @@ def evaluate_checkpoints(
 
 def _write_probs_csv(
     path: str, names: np.ndarray, grades: np.ndarray, probs: np.ndarray,
-    head: str,
+    head: str, quality_by_name: "dict[bytes, float] | None" = None,
 ) -> None:
     """Per-image ensemble-averaged probabilities as CSV — the raw
     material for error analysis / external recalibration that the final
-    report's aggregates can't provide. One row per eval example."""
+    report's aggregates can't provide. One row per eval example; the
+    ``quality`` column carries the preprocessing gradability score
+    (-1 when the record predates it)."""
     import csv
+
+    def qual(nm) -> str:
+        if quality_by_name is None:
+            return "-1"
+        return f"{quality_by_name.get(nm, -1.0):.4f}"
 
     with open(path, "w", newline="") as f:
         w = csv.writer(f)
         if head == "binary":
-            w.writerow(["name", "grade", "prob_referable"])
+            w.writerow(["name", "grade", "quality", "prob_referable"])
             for nm, g, p in zip(names, grades, probs):
-                w.writerow([nm.decode(), int(g), f"{float(p):.6f}"])
+                w.writerow([nm.decode(), int(g), qual(nm), f"{float(p):.6f}"])
         else:
             n_cls = probs.shape[-1]
             w.writerow(
-                ["name", "grade", "prob_referable"]
+                ["name", "grade", "quality", "prob_referable"]
                 + [f"prob_grade_{c}" for c in range(n_cls)]
             )
             referable = metrics.referable_probs_from_multiclass(probs)
             for nm, g, p, r in zip(names, grades, probs, referable):
                 w.writerow(
-                    [nm.decode(), int(g), f"{float(r):.6f}"]
+                    [nm.decode(), int(g), qual(nm), f"{float(r):.6f}"]
                     + [f"{float(x):.6f}" for x in p]
                 )

@@ -90,12 +90,19 @@ class Checkpointer:
         # just-written (possibly still-finalizing) metrics from disk is
         # a race across processes. Seeded from disk once at construction
         # (all saves are finished then), updated in-memory per save.
-        self._best_kept: list[float] = []
+        # save() appends optimistically BEFORE the async save commits; a
+        # failed save would leave a phantom entry suppressing future
+        # best/ saves, so wait() reconciles against disk (every call
+        # site waits before closing).
+        self._rebuild_best_kept()
+
+    def _rebuild_best_kept(self) -> None:
+        self._best_kept = []
         for s in self._best.all_steps():
             m = self._best.metrics(s)
             if m is not None:
                 self._best_kept.append(float(m[BEST_METRIC]))
-        self._best_kept = sorted(self._best_kept)[-max_to_keep:]
+        self._best_kept = sorted(self._best_kept)[-self._max_to_keep:]
 
     def save(self, step: int, state: TrainState, metrics: dict) -> None:
         """``latest/`` is written every time; ``best/`` only when this step
@@ -123,6 +130,9 @@ class Checkpointer:
     def wait(self) -> None:
         self._best.wait_until_finished()
         self._latest.wait_until_finished()
+        # All async saves settled: drop any phantom _best_kept entry
+        # whose save failed to commit (see __init__).
+        self._rebuild_best_kept()
 
     def _pick(self, step: int | None):
         """Resolve (manager, step) the way restore() selects them."""
@@ -214,14 +224,8 @@ class Checkpointer:
                     mngr.delete(s)
                     purged = True
         if purged:
-            # Rebuild the in-memory best view: deleted steps' metrics
-            # must not suppress future best/ saves.
-            self._best_kept = []
-            for s in self._best.all_steps():
-                m = self._best.metrics(s)
-                if m is not None:
-                    self._best_kept.append(float(m[BEST_METRIC]))
-            self._best_kept = sorted(self._best_kept)[-self._max_to_keep:]
+            # Deleted steps' metrics must not suppress future best/ saves.
+            self._rebuild_best_kept()
 
     def restore(self, abstract_state: TrainState, step: int | None = None
                 ) -> TrainState:

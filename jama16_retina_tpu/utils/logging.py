@@ -29,10 +29,17 @@ from absl import logging as absl_logging
 
 class RunLog:
     def __init__(self, workdir: str, name: str = "metrics.jsonl",
-                 tensorboard: bool = False):
+                 tensorboard: bool = False, fresh: bool = False):
+        """``fresh``: a NON-resume run reusing a workdir rotates the
+        existing JSONL to ``<name>.prev`` (clobbering an older .prev)
+        instead of appending — the file is the resume-replay source for
+        best/early-stop tracking, and inherited eval records from a
+        previous run would poison a later resume of THIS run with a
+        best_auc it never achieved."""
         os.makedirs(workdir, exist_ok=True)
         self._workdir = workdir
         self._name = name
+        self._fresh = fresh
         self._want_tb = tensorboard
         # The file paths depend on jax.process_index(), which would
         # force-initialize a jax backend from a mere constructor — defer
@@ -53,6 +60,8 @@ class RunLog:
         if idx != 0:
             stem, ext = os.path.splitext(self._name)
             self.path = os.path.join(self._workdir, f"{stem}.p{idx}{ext}")
+        if self._fresh and os.path.exists(self.path):
+            os.replace(self.path, self.path + ".prev")
         self._fh = open(self.path, "a")
         if self._want_tb and idx == 0:
             import tensorflow as tf

@@ -37,6 +37,13 @@ _ENCODING = flags.DEFINE_enum(
     "record encoding: jpeg (compact) or raw pre-decoded uint8 (~9x disk, "
     "removes the per-epoch host JPEG decode — see docs/PERF.md)",
 )
+_MIN_QUALITY = flags.DEFINE_float(
+    "min_quality", 0.0,
+    "drop images whose gradability score (fundus.gradability_stats) is "
+    "below this [0,1] threshold — the executable form of the original "
+    "study's image-quality grading (docs/QUALITY.md); every image's "
+    "score lands in quality_<split>.csv regardless",
+)
 
 
 def main(argv):
@@ -56,6 +63,7 @@ def main(argv):
             items, _DATA_DIR.value, _OUT.value, split,
             image_size=_SIZE.value, num_shards=_SHARDS.value,
             ben_graham=_BEN_GRAHAM.value, encoding=_ENCODING.value,
+            min_quality=_MIN_QUALITY.value,
         )
         report[split] = {"n_labeled": len(items), **stats.as_dict()}
     print(json.dumps(report, indent=2))

@@ -31,6 +31,12 @@ _ENCODING = flags.DEFINE_enum(
     "record encoding: jpeg (compact) or raw pre-decoded uint8 (see "
     "docs/PERF.md)",
 )
+_MIN_QUALITY = flags.DEFINE_float(
+    "min_quality", 0.0,
+    "drop images whose gradability score is below this [0,1] threshold "
+    "(see preprocess_eyepacs.py --min_quality); scores land in "
+    "quality_test.csv regardless",
+)
 
 
 def main(argv):
@@ -46,6 +52,7 @@ def main(argv):
         items, _DATA_DIR.value, _OUT.value, "test",
         image_size=_SIZE.value, num_shards=_SHARDS.value,
         ben_graham=_BEN_GRAHAM.value, encoding=_ENCODING.value,
+        min_quality=_MIN_QUALITY.value,
     )
     print(json.dumps({"test": {"n_labeled": len(items), **stats.as_dict()}},
                      indent=2))

@@ -174,6 +174,61 @@ def test_fit_with_grain_loader_resumes_exactly(data_dir, tmp_path):
         assert full[s] == part[s], f"step {s}: {full[s]} != {part[s]}"
 
 
+@pytest.mark.slow  # worker-process startup dominates on a 1-vCPU host
+def test_fit_with_grain_workers_resumes_exactly(data_dir, tmp_path):
+    """Worker-mode resume (VERDICT r2 #5): with data.grain_workers=2
+    positions have no (seed, step) closed form, so the trainer persists
+    iterator.get_state() next to each checkpoint (grain_state/<step>)
+    and restores it on --resume. Interrupted+resumed == uninterrupted,
+    both in worker mode."""
+    cfg = override(
+        get_config("smoke"),
+        ["data.loader=grain", "data.grain_workers=2", "train.steps=12",
+         "train.eval_every=6", "train.log_every=1", "data.augment=true",
+         "data.batch_size=8", "eval.batch_size=8",
+         "train.lr_schedule=constant"],
+    )
+    w_full = str(tmp_path / "full")
+    trainer.fit(cfg, data_dir, w_full, seed=3)
+    full = {
+        r["step"]: r["loss"]
+        for r in read_jsonl(os.path.join(w_full, "metrics.jsonl"))
+        if r["kind"] == "train"
+    }
+    w_part = str(tmp_path / "part")
+    trainer.fit(override(cfg, ["train.steps=6"]), data_dir, w_part, seed=3)
+    assert os.path.exists(os.path.join(w_part, "grain_state", "6.json"))
+    trainer.fit(override(cfg, ["train.resume=true"]), data_dir, w_part, seed=3)
+    part = {
+        r["step"]: r["loss"]
+        for r in read_jsonl(os.path.join(w_part, "metrics.jsonl"))
+        if r["kind"] == "train"
+    }
+    assert set(full) == set(part) == set(range(1, 13))
+    for s in full:
+        assert full[s] == part[s], f"step {s}: {full[s]} != {part[s]}"
+
+
+def test_grain_worker_resume_without_state_file_fails_loudly(
+    data_dir, tmp_path
+):
+    """A worker-mode resume with no persisted state (legacy workdir)
+    must hit grain's documented NotImplementedError, not silently
+    fabricate a position."""
+    cfg = override(
+        get_config("smoke"),
+        ["data.loader=grain", "train.steps=6", "train.eval_every=3",
+         "data.batch_size=8", "eval.batch_size=8"],
+    )
+    w = str(tmp_path / "legacy")
+    trainer.fit(cfg, data_dir, w, seed=0)  # in-process run: no state files
+    resumed = override(cfg, [
+        "train.resume=true", "train.steps=9", "data.grain_workers=2",
+    ])
+    with pytest.raises(NotImplementedError, match="grain_state"):
+        trainer.fit(resumed, data_dir, w, seed=0)
+
+
 def test_unknown_loader_raises(data_dir, tmp_path):
     cfg = override(get_config("smoke"), ["data.loader=dali"])
     with pytest.raises(ValueError, match="unknown data.loader"):

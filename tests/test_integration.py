@@ -246,6 +246,9 @@ def test_save_probs_csv_matches_report(fitted, smoke_cfg, data_dir, tmp_path):
     assert len(rows) == report["n_examples"] == 48
     assert len({r["name"] for r in rows}) == 48
     assert all(r["name"] for r in rows)
+    # Synthetic fixtures predate quality scoring: the joined quality
+    # column is present and -1 for every row (QUALITY.md step 4 join).
+    assert all(float(r["quality"]) == -1.0 for r in rows)
     labels = np.array([int(r["grade"]) >= 2 for r in rows], np.float64)
     probs = np.array([float(r["prob_referable"]) for r in rows])
     auc = metrics.roc_auc(labels, probs)

@@ -68,3 +68,32 @@ def test_reconstruct_best_tracking_fallback_uses_manager_peak(tmp_path):
         str(tmp_path / "empty"), 50, cfg, [_Best()]
     )
     assert (float(best_auc[0]), int(best_step[0]), int(since[0])) == (0.95, 20, 3)
+
+
+def test_fresh_runlog_rotates_reused_workdir(tmp_path):
+    """A NON-resume run in a reused workdir must not inherit the old
+    run's records: metrics.jsonl is the resume-replay source for
+    best/early-stop tracking, so stale eval records would fabricate a
+    best_auc the new run never achieved (ADVICE r2 #4). The old file is
+    rotated to .prev, not destroyed."""
+    from jama16_retina_tpu.utils.logging import RunLog, read_jsonl
+
+    w = str(tmp_path)
+    old = RunLog(w)
+    old.write("eval", step=10, val_auc=0.99)
+    old.close()
+
+    fresh = RunLog(w, fresh=True)
+    fresh.write("config", seed=1)
+    fresh.close()
+    records = read_jsonl(os.path.join(w, "metrics.jsonl"))
+    assert [r["kind"] for r in records] == ["config"]
+    prev = read_jsonl(os.path.join(w, "metrics.jsonl.prev"))
+    assert [r["kind"] for r in prev] == ["eval"]
+
+    # resume (fresh=False) appends as before.
+    resumed = RunLog(w)
+    resumed.write("train", step=1, loss=0.5)
+    resumed.close()
+    kinds = [r["kind"] for r in read_jsonl(os.path.join(w, "metrics.jsonl"))]
+    assert kinds == ["config", "train"]

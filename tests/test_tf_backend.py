@@ -214,3 +214,65 @@ def test_fit_tf_trains_and_checkpoint_is_flax_evaluable(
     )
     assert report["n_examples"] == 24
     assert 0.0 <= report["auc"] <= 1.0
+
+
+def test_keras_schedule_matches_optax():
+    """_keras_schedule must trace the SAME LR curve make_schedule gives
+    the flax path (VERDICT r2 #6) — constant, cosine, and warmup_cosine
+    sampled across the run."""
+    from jama16_retina_tpu.configs import TrainConfig
+    from jama16_retina_tpu.trainer import _keras_schedule
+
+    for sched in ("constant", "cosine", "warmup_cosine"):
+        tc = TrainConfig(
+            steps=100, warmup_steps=10, learning_rate=3e-3,
+            lr_schedule=sched,
+        )
+        optax_fn = train_lib.make_schedule(tc)
+        keras_sched = _keras_schedule(tc)
+        for step in (0, 5, 10, 11, 50, 99):
+            want = float(optax_fn(step))
+            if isinstance(keras_sched, float):
+                got = keras_sched
+            else:
+                got = float(keras_sched(step))
+            assert got == pytest.approx(want, abs=3e-9), (sched, step)
+
+
+def test_augment_batch_np_mirrors_jnp_ranges():
+    """augment_batch_np (fit_tf's host augmentation) applies the same op
+    set as the TPU path: identity when off, near-identity when every
+    jitter range is degenerate (pins the exact YIQ inverse), in-range
+    float32 otherwise, deterministic under (seed, step) reseeding."""
+    from jama16_retina_tpu.configs import DataConfig
+    from jama16_retina_tpu.data import augment
+
+    rng0 = np.random.default_rng((7, 3))
+    imgs = np.random.default_rng(0).integers(
+        0, 256, (4, 32, 32, 3), np.uint8
+    )
+
+    off = augment.augment_batch_np(rng0, imgs, DataConfig(augment=False))
+    np.testing.assert_array_equal(
+        off, imgs.astype(np.float32) / 127.5 - 1.0
+    )
+
+    degenerate = DataConfig(
+        flip=False, rotate=False, brightness_delta=0.0,
+        contrast_range=(1.0, 1.0), saturation_range=(1.0, 1.0),
+        hue_delta=1e-12,  # forces the chroma branch: matrix round trip
+    )
+    ident = augment.augment_batch_np(
+        np.random.default_rng(0), imgs, degenerate
+    )
+    np.testing.assert_allclose(
+        ident, imgs.astype(np.float32) / 127.5 - 1.0, atol=2e-6
+    )
+
+    full = DataConfig()
+    a = augment.augment_batch_np(np.random.default_rng((7, 3)), imgs, full)
+    b = augment.augment_batch_np(np.random.default_rng((7, 3)), imgs, full)
+    np.testing.assert_array_equal(a, b)  # (seed, step) determinism
+    assert a.dtype == np.float32
+    assert a.min() >= -1.0 and a.max() <= 1.0
+    assert not np.array_equal(a, off)  # it actually augments

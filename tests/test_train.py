@@ -318,3 +318,27 @@ def test_unknown_optimizer_and_schedule_raise():
         train_lib.make_optimizer(TrainConfig(optimizer="lion"))
     with pytest.raises(ValueError, match="unknown lr_schedule"):
         train_lib.make_schedule(TrainConfig(lr_schedule="linear"))
+
+
+def test_debug_mode_chex_asserts_catch_bad_batches():
+    """--debug adds trace-time chex pins on the step's input contract
+    (SURVEY.md §5.2): wrong dtype/shape fail at trace instead of
+    training on garbage; a well-formed batch trains unchanged."""
+    cfg = small_cfg(debug=True, augment=True)
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    step = train_lib.make_train_step(cfg, model, tx, donate=False)
+    good = jax.device_put(make_batch(cfg))
+    _, m = step(state, good, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+    bad_dtype = {
+        "image": good["image"].astype(np.float32),  # contract is uint8
+        "grade": good["grade"],
+    }
+    with pytest.raises(AssertionError):
+        step(state, jax.device_put(bad_dtype), jax.random.key(0))
+
+    bad_rank = {"image": good["image"][0], "grade": good["grade"]}
+    with pytest.raises(AssertionError):
+        step(state, jax.device_put(bad_rank), jax.random.key(0))
