@@ -181,6 +181,15 @@ class EvalConfig:
     # beyond the reference (fundus photos have no canonical orientation);
     # 4x eval FLOPs, eval only. Off by default for paper parity.
     tta: bool = False
+    # Multi-host eval decode sharding (data/pipeline.eval_batches_sharded):
+    # each process decodes only 1/P of the records (stride-sharded before
+    # decode) instead of every host decoding the full eval set. Worth it
+    # under the k-model × frequent-eval protocol on pods; off by default
+    # — the unsharded path keeps the record order un-permuted. Applies to
+    # the 1-D DP eval path (fit/evaluate/predict); the member-parallel
+    # driver's eval ignores it (its ('member','data') layout has no
+    # per-process contiguous row block to decode into) and says so.
+    sharded: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,6 +20,7 @@ metrics layer sees every real example exactly once.
 from __future__ import annotations
 
 import collections
+import os
 from typing import Iterator
 
 import jax
@@ -29,8 +30,12 @@ from jama16_retina_tpu.configs import DataConfig
 from jama16_retina_tpu.data import tfrecord
 
 
-def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
-                      seed: int, record_shard: tuple[int, int] | None = None):
+def _serialized_stream(paths, training: bool, seed: int,
+                       record_shard: tuple[int, int] | None = None):
+    """The deterministic serialized-record stream every consumer shares:
+    eval metadata passes MUST see the identical record order the decode
+    stream produces (the interleave merge order is part of the
+    contract), so this is the one home for it."""
     import tensorflow as tf
 
     ds = tf.data.Dataset.from_tensor_slices(list(paths))
@@ -54,6 +59,14 @@ def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
         # identical on every process; train_batches guarantees that by
         # using the un-offset seed in this branch.
         ds = ds.shard(*record_shard)
+    return ds
+
+
+def _build_tf_dataset(paths, image_size: int, training: bool, cfg: DataConfig,
+                      seed: int, record_shard: tuple[int, int] | None = None):
+    import tensorflow as tf
+
+    ds = _serialized_stream(paths, training, seed, record_shard)
     parse = tfrecord.parse_fn()
 
     def to_features(serialized):
@@ -208,6 +221,116 @@ def eval_batches(
             # feeds --save_probs per-image exports, never the device.
             "name": name,
             "mask": mask,
+        }
+
+
+_METADATA_CACHE: dict = {}
+
+
+def read_split_metadata(
+    data_dir: str, split: str
+) -> tuple[np.ndarray, np.ndarray]:
+    """(grades [n] i32, names [n] bytes) in the SAME record order the
+    decode stream yields (shared _serialized_stream) — a parse-only
+    pass, no image decode, so it is cheap enough to run on every host
+    (the point of sharded eval is to split the DECODE).
+
+    Memoized per (dir, split): the k-model × frequent-eval protocol that
+    motivates sharded eval would otherwise re-parse the whole split on
+    every eval call; eval splits are immutable for the life of a run."""
+    import tensorflow as tf
+
+    key = (os.path.realpath(data_dir), split)
+    if key in _METADATA_CACHE:
+        return _METADATA_CACHE[key]
+    spec = {
+        "image/grade": tf.io.FixedLenFeature([], tf.int64),
+        "image/name": tf.io.FixedLenFeature([], tf.string, default_value=""),
+    }
+    ds = _serialized_stream(
+        tfrecord.list_split(data_dir, split), False, 0
+    ).map(
+        lambda s: tf.io.parse_single_example(s, spec),
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=True,
+    )
+    grades, names = [], []
+    for f in ds.as_numpy_iterator():
+        grades.append(int(f["image/grade"]))
+        names.append(f["image/name"])
+    result = (
+        np.asarray(grades, np.int32),
+        np.asarray(names, object) if names else np.zeros((0,), object),
+    )
+    _METADATA_CACHE[key] = result
+    return result
+
+
+def eval_batches_sharded(
+    data_dir: str,
+    split: str,
+    batch_size: int,
+    image_size: int,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> Iterator[dict]:
+    """Multi-host eval where each process DECODES only 1/P of the
+    records (eval.sharded; VERDICT r2 weak #4) — the unsharded
+    eval_batches pays the full decode on every host, which under the
+    k-model × eval-every-500-steps protocol multiplies host decode by
+    P×k.
+
+    Records are stride-sharded BEFORE decode (process p decodes records
+    p, p+P, ...), so the assembled global batch is a known PERMUTATION
+    of the record order: assembled row ``p*(B/P) + i`` of batch k holds
+    record ``p + (k*B/P + i)*P`` (process-major blocks, matching
+    ``shard_batch``'s assembly). Metadata ('grade'/'name'/'mask') is
+    emitted already aligned to that assembled order from a cheap
+    parse-only pass, so the metrics layer is oblivious to the
+    permutation. Every process still yields the same number of batches
+    (dispatch-count alignment). Single-process this degenerates to the
+    identity permutation and plain local decode.
+    """
+    p_idx, p_cnt = _resolve_process(process_index, process_count)
+    local = _local_batch_size(batch_size, p_cnt, "eval.batch_size")
+    grades, names = read_split_metadata(data_dir, split)
+    n = len(grades)
+    if n == 0:
+        return  # same as the unsharded path: no records, no batches
+    n_batches = -(-n // batch_size)  # ceil
+
+    paths = tfrecord.list_split(data_dir, split)
+    ds = _build_tf_dataset(
+        paths, image_size, False, DataConfig(), seed=0,
+        record_shard=(p_cnt, p_idx) if p_cnt > 1 else None,
+    )
+    ds = ds.map(lambda image, grade, name: image)
+    ds = ds.batch(local, drop_remainder=False)
+    it = ds.as_numpy_iterator()
+
+    # Assembled-order record ids per batch: block p rows i -> p+(kb+i)*P.
+    block = np.arange(local)
+    for k in range(n_batches):
+        imgs = next(it, None)
+        if imgs is None:
+            imgs = np.zeros((0, image_size, image_size, 3), np.uint8)
+        if imgs.shape[0] < local:
+            pad = local - imgs.shape[0]
+            imgs = np.concatenate(
+                [imgs, np.zeros((pad, *imgs.shape[1:]), imgs.dtype)]
+            )
+        rec = np.concatenate([
+            p + (k * local + block) * p_cnt for p in range(p_cnt)
+        ])
+        valid = rec < n
+        safe = np.minimum(rec, max(n - 1, 0))
+        yield {
+            "image": imgs,
+            "grade": np.where(valid, grades[safe], 0).astype(np.int32),
+            "name": np.asarray([
+                names[r] if v else b"" for r, v in zip(safe, valid)
+            ]),
+            "mask": valid.astype(np.float32),
         }
 
 

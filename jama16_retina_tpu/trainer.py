@@ -41,9 +41,17 @@ def _predict_over_split(
     per-batch probs via ``batch_probs_fn(batch) -> [B]-or-[B,C] array``,
     trim padding rows (the mask contract of make_eval_step), concatenate.
     Returns (grades, probs, names) — names are the per-record ids from
-    the TFRecords (bytes; feed --save_probs exports)."""
+    the TFRecords (bytes; feed --save_probs exports).
+
+    ``eval.sharded`` swaps in the decode-sharded stream (each process
+    decodes 1/P of the records; metadata comes pre-aligned to the
+    assembled permutation, so nothing downstream changes)."""
+    batches_fn = (
+        pipeline.eval_batches_sharded if cfg.eval.sharded
+        else pipeline.eval_batches
+    )
     grades_all, probs_all, names_all = [], [], []
-    for batch in pipeline.eval_batches(
+    for batch in batches_fn(
         data_dir, split, cfg.eval.batch_size, cfg.model.image_size
     ):
         probs = batch_probs_fn(batch)
@@ -587,8 +595,15 @@ def _predict_split_members(
 
     Every process reads the FULL eval stream and full-local placement
     slices each device's shard — the ('member','data') layout's data
-    columns interleave across processes, so the 1-D process-major block
-    contract of eval_batches' local rows does not apply here."""
+    columns interleave across processes, so neither the 1-D process-major
+    block contract of eval_batches' local rows nor eval.sharded's decode
+    sharding applies here (the flag is ignored, loudly)."""
+    if cfg.eval.sharded and jax.process_count() > 1:
+        absl_logging.warning(
+            "eval.sharded has no effect on the member-parallel driver's "
+            "evals: its ('member','data') layout has no per-process "
+            "contiguous row block — every host decodes the full eval set"
+        )
     grades_all, probs_all = [], []
     for batch in pipeline.eval_batches(
         data_dir, split, cfg.eval.batch_size, cfg.model.image_size,
