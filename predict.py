@@ -57,6 +57,14 @@ _BEN_GRAHAM = flags.DEFINE_boolean(
     "same subtract-local-average enhancement preprocess_* --ben_graham "
     "used, or the model sees a shifted input distribution",
 )
+_MIN_QUALITY = flags.DEFINE_float(
+    "min_quality", 0.0,
+    "flag images whose gradability score (fundus.gradability_stats; "
+    "also emitted per row as 'quality') falls below this [0,1] "
+    "threshold: their row gains \"gradable\": false and the probability "
+    "should not be trusted for screening — the JAMA protocol excluded "
+    "ungradeable images. 0 scores every image but flags none",
+)
 
 _EXTS = (".jpg", ".jpeg", ".png", ".tif", ".tiff", ".bmp")
 
@@ -113,19 +121,19 @@ def main(argv):
     paths = _expand(list(_IMAGES.value))
 
     size = cfg.model.image_size
-    normed, kept, skipped = [], [], []
+    normed, kept, skipped, qualities = [], [], [], []
     for p in paths:
         bgr = cv2.imread(p, cv2.IMREAD_COLOR)
         if bgr is None:
             skipped.append((p, "unreadable"))
             continue
         try:
-            normed.append(
-                fundus.resize_and_center_fundus(
-                    bgr[..., ::-1], diameter=size,
-                    ben_graham=_BEN_GRAHAM.value,
-                )
+            canvas, q = fundus.resize_and_center_fundus(
+                bgr[..., ::-1], diameter=size,
+                ben_graham=_BEN_GRAHAM.value, with_quality=True,
             )
+            normed.append(canvas)
+            qualities.append(q["quality"])
             kept.append(p)
         except fundus.FundusNotFound as e:
             skipped.append((p, f"no fundus found: {e}"))
@@ -174,7 +182,7 @@ def main(argv):
         prob_list.append(np.concatenate(probs))
     probs = metrics.ensemble_average(prob_list)
 
-    for p, pr in zip(kept, probs):
+    for p, pr, qual in zip(kept, probs, qualities):
         if cfg.model.head != "binary":
             pr5 = np.asarray(pr)
             referable = float(metrics.referable_probs_from_multiclass(pr5))
@@ -191,6 +199,12 @@ def main(argv):
         if _THRESHOLD.value >= 0:
             row["referable"] = bool(score >= _THRESHOLD.value)
             row["threshold"] = _THRESHOLD.value
+        # Live gradability (same heuristic preprocessing stores in
+        # TFRecords): screening decisions on ungradeable captures are
+        # the failure mode the JAMA protocol excluded by hand.
+        row["quality"] = round(float(qual), 4)
+        if _MIN_QUALITY.value > 0:
+            row["gradable"] = bool(qual >= _MIN_QUALITY.value)
         row["n_models"] = len(dirs)
         print(json.dumps(row))
 

@@ -124,6 +124,61 @@ def test_predict_cli_emits_json_rows(setup):
         assert 0.0 <= r["prob"] <= 1.0
         assert r["referable"] == (r["prob"] >= 0.5)
         assert r["n_models"] == 1
+        # Live gradability score on every prediction row; no 'gradable'
+        # flag without --min_quality.
+        assert 0.0 <= r["quality"] <= 1.0
+        assert "gradable" not in r
+
+
+@pytest.mark.slow
+def test_predict_cli_min_quality_flags_blurred(setup):
+    """--min_quality on the inference surface: a heavily defocused
+    photograph keeps its probability but gains gradable=false (the
+    screening protocol's exclude-ungradeable step, docs/QUALITY.md)."""
+    import cv2
+
+    import numpy as np
+    from jama16_retina_tpu.data import synthetic
+
+    import pathlib
+
+    _, ckdir, imgdir = setup
+    blurdir = pathlib.Path(imgdir).parent / "blur_imgs"
+    blurdir.mkdir(exist_ok=True)
+    rng = np.random.default_rng(5)
+    sharp = synthetic.render_fundus(
+        rng, 3, synthetic.SynthConfig(image_size=64)
+    )
+    cv2.imwrite(str(blurdir / "sharp.png"), sharp[..., ::-1])
+    cv2.imwrite(
+        str(blurdir / "blurred.png"),
+        cv2.GaussianBlur(sharp, (0, 0), 6)[..., ::-1],
+    )
+    def rows_for(extra):
+        res = run_predict([
+            "--config=smoke", "--set", "model.image_size=64",
+            f"--checkpoint_dir={ckdir}", f"--images={blurdir}",
+            "--device=cpu", "--batch_size=2", *extra,
+        ])
+        assert res.returncode == 0, (
+            f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-2000:]}"
+        )
+        return {
+            json.loads(l)["image"].split("/")[-1]: json.loads(l)
+            for l in res.stdout.splitlines() if l.strip()
+        }
+
+    # First pass scores both images; the flag threshold is derived from
+    # the data (the test pins SEPARATION, not an absolute constant).
+    rows = rows_for([])
+    q_blur = rows["blurred.png"]["quality"]
+    q_sharp = rows["sharp.png"]["quality"]
+    assert q_blur < q_sharp
+    assert all("gradable" not in r for r in rows.values())
+
+    rows = rows_for([f"--min_quality={(q_blur + q_sharp) / 2}"])
+    assert rows["blurred.png"]["gradable"] is False
+    assert rows["sharp.png"]["gradable"] is True
 
 
 @pytest.mark.slow
