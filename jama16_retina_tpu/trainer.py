@@ -509,20 +509,40 @@ def fit(
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
 
     stopped_early = False
-    t_log, imgs_since = time.time(), 0
+    t_start = t_log = time.time()
+    imgs_since = 0
+    avg_from_step = start_step
     try:
         for step_i in range(start_step, cfg.train.steps):
             profiler.before_step(step_i)
             state, m = train_step(state, next(batches), base_key)
+            if step_i == start_step:
+                # Cumulative-average clock starts AFTER the first step's
+                # dispatch returns: jit compiles synchronously there, and
+                # folding a ~40-80s compile into the denominator would
+                # make the average understate steady state for short runs.
+                t_start = time.time()
+                avg_from_step = step_i + 1
             profiler.after_step(step_i, state)
             imgs_since += cfg.data.batch_size
 
             if (step_i + 1) % cfg.train.log_every == 0:
                 dt = time.time() - t_log
-                log.write(
-                    "train", step=step_i + 1, loss=float(m["loss"]),
-                    images_per_sec=round(imgs_since / max(dt, 1e-9), 2),
-                )
+                # Window rate can overshoot physically (async dispatch
+                # races ahead between sync points); the compile-excluded
+                # cumulative average is the trustworthy throughput (same
+                # lesson as bench.py's fences, without per-window syncs).
+                fields = {
+                    "loss": float(m["loss"]),
+                    "images_per_sec": round(imgs_since / max(dt, 1e-9), 2),
+                }
+                steps_avg = step_i + 1 - avg_from_step
+                if steps_avg > 0:
+                    fields["images_per_sec_avg"] = round(
+                        steps_avg * cfg.data.batch_size
+                        / max(time.time() - t_start, 1e-9), 2,
+                    )
+                log.write("train", step=step_i + 1, **fields)
                 t_log, imgs_since = time.time(), 0
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
@@ -837,23 +857,35 @@ def fit_ensemble_parallel(
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
     stopped_early = False
-    t_log, imgs_since = time.time(), 0
+    t_start = t_log = time.time()
+    imgs_since = 0
+    avg_from_step = start_step
     try:
         for step_i in range(start_step, cfg.train.steps):
             profiler.before_step(step_i)
             state, m_out = train_step(state, next(batches), base_keys)
+            if step_i == start_step:
+                # Same compile-excluded average clock as fit().
+                t_start = time.time()
+                avg_from_step = step_i + 1
             profiler.after_step(step_i, state)
             imgs_since += cfg.data.batch_size
 
             if (step_i + 1) % cfg.train.log_every == 0:
                 dt = time.time() - t_log
                 losses = np.asarray(jax.device_get(m_out["loss"]))
-                log.write(
-                    "train", step=step_i + 1,
-                    loss=round(float(losses.mean()), 6),
-                    loss_per_member=[round(float(x), 6) for x in losses],
-                    images_per_sec=round(imgs_since / max(dt, 1e-9), 2),
-                )
+                fields = {
+                    "loss": round(float(losses.mean()), 6),
+                    "loss_per_member": [round(float(x), 6) for x in losses],
+                    "images_per_sec": round(imgs_since / max(dt, 1e-9), 2),
+                }
+                steps_avg = step_i + 1 - avg_from_step
+                if steps_avg > 0:
+                    fields["images_per_sec_avg"] = round(
+                        steps_avg * cfg.data.batch_size
+                        / max(time.time() - t_start, 1e-9), 2,
+                    )
+                log.write("train", step=step_i + 1, **fields)
                 t_log, imgs_since = time.time(), 0
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
