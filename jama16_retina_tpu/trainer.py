@@ -364,6 +364,72 @@ class _ProfilerWindow:
             self._log.write("profile", dir=self._dir, steps="truncated")
 
 
+class _ThroughputClock:
+    """Train-loop throughput bookkeeping, shared by all three loops (the
+    _ProfilerWindow pattern).
+
+    Two rates per log window:
+      * ``images_per_sec``  — the window rate. Window clocks reset after
+        the first (compiling) step and after every eval pause, so no
+        window folds a jit compile or an eval/checkpoint block in.
+      * ``images_per_sec_avg`` — cumulative images over accumulated
+        TRAIN wall time only (compile excluded via the first-step reset;
+        eval/checkpoint/persist excluded via pause()/resume()). The
+        async dispatch bursts that make individual windows overshoot
+        physically (the bench.py fence lesson) average out here without
+        paying any per-window device sync.
+    """
+
+    def __init__(self, batch_size: int):
+        now = time.time()
+        self._batch = batch_size
+        self._first_done = False
+        self._t_window = now
+        self._imgs_window = 0
+        self._t_resume = now
+        self._train_time = 0.0
+        self._imgs_avg = 0
+
+    def after_step(self) -> None:
+        if not self._first_done:
+            # The first dispatch compiled the program (~40-80s on the
+            # TPU): restart every clock and drop its images.
+            self._first_done = True
+            now = time.time()
+            self._t_window = now
+            self._t_resume = now
+            return
+        self._imgs_window += self._batch
+        self._imgs_avg += self._batch
+
+    def pause(self) -> None:
+        """Call before an eval/checkpoint block: train time stops."""
+        self._train_time += time.time() - self._t_resume
+
+    def resume(self) -> None:
+        now = time.time()
+        self._t_resume = now
+        self._t_window = now
+        self._imgs_window = 0
+
+    def fields(self) -> dict:
+        """Per-log-window rate fields; resets the window."""
+        now = time.time()
+        out = {
+            "images_per_sec": round(
+                self._imgs_window / max(now - self._t_window, 1e-9), 2
+            ),
+        }
+        train_time = self._train_time + (now - self._t_resume)
+        if self._imgs_avg > 0:
+            out["images_per_sec_avg"] = round(
+                self._imgs_avg / max(train_time, 1e-9), 2
+            )
+        self._t_window = now
+        self._imgs_window = 0
+        return out
+
+
 def _eval_and_track(
     cfg: ExperimentConfig, log: RunLog, ckpt, step: int,
     predict_fn, state_for_save,
@@ -509,43 +575,22 @@ def fit(
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
 
     stopped_early = False
-    t_start = t_log = time.time()
-    imgs_since = 0
-    avg_from_step = start_step
+    clock = _ThroughputClock(cfg.data.batch_size)
     try:
         for step_i in range(start_step, cfg.train.steps):
             profiler.before_step(step_i)
             state, m = train_step(state, next(batches), base_key)
-            if step_i == start_step:
-                # Cumulative-average clock starts AFTER the first step's
-                # dispatch returns: jit compiles synchronously there, and
-                # folding a ~40-80s compile into the denominator would
-                # make the average understate steady state for short runs.
-                t_start = time.time()
-                avg_from_step = step_i + 1
+            clock.after_step()
             profiler.after_step(step_i, state)
-            imgs_since += cfg.data.batch_size
 
             if (step_i + 1) % cfg.train.log_every == 0:
-                dt = time.time() - t_log
-                # Window rate can overshoot physically (async dispatch
-                # races ahead between sync points); the compile-excluded
-                # cumulative average is the trustworthy throughput (same
-                # lesson as bench.py's fences, without per-window syncs).
-                fields = {
-                    "loss": float(m["loss"]),
-                    "images_per_sec": round(imgs_since / max(dt, 1e-9), 2),
-                }
-                steps_avg = step_i + 1 - avg_from_step
-                if steps_avg > 0:
-                    fields["images_per_sec_avg"] = round(
-                        steps_avg * cfg.data.batch_size
-                        / max(time.time() - t_start, 1e-9), 2,
-                    )
-                log.write("train", step=step_i + 1, **fields)
-                t_log, imgs_since = time.time(), 0
+                log.write(
+                    "train", step=step_i + 1, loss=float(m["loss"]),
+                    **clock.fields(),
+                )
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
+                clock.pause()
                 best_auc, best_step, since_best, stop = _eval_and_track(
                     cfg, log, ckpt, step_i + 1,
                     lambda: predict_split(
@@ -556,6 +601,7 @@ def fit(
                     best_auc, best_step, since_best,
                 )
                 _persist_grain_state(grain_tee, workdir, step_i + 1)
+                clock.resume()
                 if stop:
                     stopped_early = True
                     break
@@ -857,38 +903,25 @@ def fit_ensemble_parallel(
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
     stopped_early = False
-    t_start = t_log = time.time()
-    imgs_since = 0
-    avg_from_step = start_step
+    clock = _ThroughputClock(cfg.data.batch_size)
     try:
         for step_i in range(start_step, cfg.train.steps):
             profiler.before_step(step_i)
             state, m_out = train_step(state, next(batches), base_keys)
-            if step_i == start_step:
-                # Same compile-excluded average clock as fit().
-                t_start = time.time()
-                avg_from_step = step_i + 1
+            clock.after_step()
             profiler.after_step(step_i, state)
-            imgs_since += cfg.data.batch_size
 
             if (step_i + 1) % cfg.train.log_every == 0:
-                dt = time.time() - t_log
                 losses = np.asarray(jax.device_get(m_out["loss"]))
-                fields = {
-                    "loss": round(float(losses.mean()), 6),
-                    "loss_per_member": [round(float(x), 6) for x in losses],
-                    "images_per_sec": round(imgs_since / max(dt, 1e-9), 2),
-                }
-                steps_avg = step_i + 1 - avg_from_step
-                if steps_avg > 0:
-                    fields["images_per_sec_avg"] = round(
-                        steps_avg * cfg.data.batch_size
-                        / max(time.time() - t_start, 1e-9), 2,
-                    )
-                log.write("train", step=step_i + 1, **fields)
-                t_log, imgs_since = time.time(), 0
+                log.write(
+                    "train", step=step_i + 1,
+                    loss=round(float(losses.mean()), 6),
+                    loss_per_member=[round(float(x), 6) for x in losses],
+                    **clock.fields(),
+                )
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
+                clock.pause()
                 grades, probs = _predict_split_members(
                     cfg, state, data_dir, "val", mesh, eval_step
                 )
@@ -924,6 +957,7 @@ def fit_ensemble_parallel(
                     ensemble_val_auc=round(float(ens_auc), 5),
                     best_auc_per_member=[round(float(a), 5) for a in best_auc],
                 )
+                clock.resume()
                 if np.all(since_best >= cfg.train.early_stop_patience):
                     log.write("early_stop", step=step_i + 1,
                               best_step=[int(s) for s in best_step])
@@ -1107,7 +1141,7 @@ def fit_tf(
     batches = _train_stream(cfg, data_dir, seed, skip_batches=start_step)
     best_auc, best_step, since_best = -np.inf, start_step, 0
     stopped_early = False
-    t_log, imgs_since = time.time(), 0
+    clock = _ThroughputClock(cfg.data.batch_size)
     for step_i in range(start_step, tc.steps):
         batch = next(batches)
         # Per-step generator keyed on (seed, step): a resumed run draws
@@ -1125,15 +1159,14 @@ def fit_tf(
                 batch["grade"].astype(np.int64)
             ]
         step_loss = float(keras_model.train_on_batch(x, y))
-        imgs_since += x.shape[0]
+        clock.after_step()
 
         if (step_i + 1) % tc.log_every == 0:
-            dt = time.time() - t_log
             log.write("train", step=step_i + 1, loss=step_loss,
-                      images_per_sec=round(imgs_since / max(dt, 1e-9), 2))
-            t_log, imgs_since = time.time(), 0
+                      **clock.fields())
 
         if (step_i + 1) % tc.eval_every == 0 or step_i + 1 == tc.steps:
+            clock.pause()
             params, batch_stats = transplant.transplant_from_keras(
                 keras_model, state0.params, state0.batch_stats
             )
@@ -1146,6 +1179,7 @@ def fit_tf(
                 ),
                 best_auc, best_step, since_best,
             )
+            clock.resume()
             if stop:
                 stopped_early = True
                 break

@@ -97,3 +97,32 @@ def test_fresh_runlog_rotates_reused_workdir(tmp_path):
     resumed.close()
     kinds = [r["kind"] for r in read_jsonl(os.path.join(w, "metrics.jsonl"))]
     assert kinds == ["config", "train"]
+
+
+def test_throughput_clock_excludes_compile_and_pauses():
+    """_ThroughputClock (shared by all three train loops): the first
+    (compiling) step starts no clock, eval pauses don't count toward
+    the cumulative average, and window clocks reset across pauses."""
+    import time
+
+    from jama16_retina_tpu.trainer import _ThroughputClock
+
+    clock = _ThroughputClock(batch_size=10)
+    time.sleep(0.2)   # "compile" inside the first step
+    clock.after_step()
+    for _ in range(4):
+        time.sleep(0.01)
+        clock.after_step()
+    clock.pause()
+    time.sleep(0.3)   # "eval" — must not count
+    clock.resume()
+    for _ in range(5):
+        time.sleep(0.01)
+        clock.after_step()
+    fields = clock.fields()
+    # 9 timed steps (first dropped) over ~0.09s of TRAIN time: had the
+    # 0.2s compile or the 0.3s eval leaked into the denominator, the
+    # average would fall below ~160 img/s; uncontaminated it is ~1000.
+    assert fields["images_per_sec_avg"] > 400, fields
+    # The window after resume covers only the 5 post-eval steps.
+    assert fields["images_per_sec"] > 400, fields
