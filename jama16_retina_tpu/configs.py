@@ -206,9 +206,11 @@ class ExperimentConfig:
 
 
 def _preset_eyepacs_binary() -> ExperimentConfig:
-    # use_pallas: the fused color-jitter kernel is ~6x faster than the
-    # jnp composition standalone and worth ~2% on the full train step
-    # (bench.py augment_jnp/augment_pallas); it is the production path
+    # use_pallas: under bench.py's fenced harness (round 3) the fused
+    # color-jitter kernel runs ~1.4x the jnp composition standalone
+    # (augment_pallas/augment_jnp) and is worth ~+2% on the full train
+    # step, since XLA already fuses most of the jnp stage into the step
+    # (docs/PERF.md "Changes that did land"). It is the production path
     # on TPU and transparently interprets on CPU (data/augment.py).
     return ExperimentConfig(
         name="eyepacs_binary", data=DataConfig(use_pallas=True)
