@@ -106,28 +106,13 @@ def _log(msg: str) -> None:
     print(f"bench: {msg}", file=sys.stderr)
 
 
-# Per-chip peak dense bf16 FLOP/s by device-kind substring (public Cloud
-# TPU specs). The physics guard refuses any measured rate implying more
-# than this; unknown kinds get a deliberately generous default so the
-# guard can only ever reject the impossible, never the merely fast.
-_PEAK_BF16_TFLOPS = (
-    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
-    ("v6", 918.0), ("trillium", 918.0),
-    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
-)
-_PEAK_DEFAULT_TFLOPS = 2000.0
-
-
 def _peak_flops() -> float:
-    import jax
+    # Peak-FLOPs table + lookup live in utils/physics.py so the train
+    # loops' throughput telemetry is held to the same physics standard
+    # as this bench (trainer._ThroughputClock).
+    from jama16_retina_tpu.utils import physics
 
-    kind = jax.devices()[0].device_kind.lower()
-    for sub, tflops in _PEAK_BF16_TFLOPS:
-        if sub in kind:
-            return tflops * 1e12
-    _log(f"unknown device kind {kind!r}: physics guard using generous "
-         f"{_PEAK_DEFAULT_TFLOPS:.0f} TFLOP/s default")
-    return _PEAK_DEFAULT_TFLOPS * 1e12
+    return physics.peak_flops(log=_log)
 
 
 def _fence(tree) -> float:
@@ -160,16 +145,17 @@ def _flops_of(fn, *args) -> "float | None":
     """Total FLOPs of one call of jitted ``fn`` at these args, from the
     compiled program's cost analysis (AOT lower+compile; the persistent
     compilation cache set up in main() makes this share work with the
-    dispatch-path compile instead of doubling it)."""
+    dispatch-path compile instead of doubling it). The cost_analysis
+    parsing itself is shared with the train loops' throughput ceiling
+    (utils/physics.flops_from_cost_analysis)."""
+    from jama16_retina_tpu.utils import physics
+
     try:
-        ca = fn.lower(*args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
+        compiled = fn.lower(*args).compile()
     except Exception as e:  # pragma: no cover - bench must still emit JSON
         _log(f"cost analysis unavailable: {type(e).__name__}: {e}")
         return None
+    return physics.flops_from_cost_analysis(compiled)
 
 
 def _publish(extras: dict, key: str, rate: float,
@@ -261,12 +247,15 @@ def _timed_steps(step, state, batch_iter, key, n_steps: int, batch_size: int,
 
     The step chains state through iterations, so the single closing
     ``_fence`` on the final state is data-dependent on EVERY timed step;
-    its own fixed cost is measured up front and subtracted.
+    its own fixed cost is measured up front and subtracted. The fence
+    cost on the axon tunnel is a noisy ~22-80 ms (drifts hour to hour),
+    so one sample could inflate the published rate by several percent —
+    take the median of 3 samples instead (ADVICE r3).
     """
     for i in range(warmup):
         state, _ = step(state, batch_iter(i), key)
     _fence(state)  # completes warmup + compiles the fence's reduce
-    sync = _fence_cost(state)
+    sync = sorted(_fence_cost(state) for _ in range(3))[1]
     t0 = time.time()
     for i in range(n_steps):
         state, m = step(state, batch_iter(i), key)
@@ -289,7 +278,7 @@ def _timed_forward(fn, n: int, images_per_call: int, n_dev: int = 1,
     for i in range(warmup):
         acc = acc_add(acc, fn(i))
     _fence(acc)  # completes warmup AND compiles the fence's reduce
-    sync = _fence_cost(acc)
+    sync = sorted(_fence_cost(acc) for _ in range(3))[1]  # median of 3
     t0 = time.time()
     for i in range(n):
         acc = acc_add(acc, fn(i))

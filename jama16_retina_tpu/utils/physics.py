@@ -1,0 +1,74 @@
+"""Chip FLOP-physics bounds, shared by bench.py and the train loops.
+
+Round 2's bench published rates implying 8-25x the chip's peak FLOP/s
+(the axon tunnel can complete host-visible sync primitives before the
+device work actually ran); round 3 added the fence + physics-guard
+discipline to bench.py. This module is that discipline's single home so
+the train loops' own throughput telemetry (trainer._ThroughputClock) is
+held to the same standard as the bench: a rate whose implied FLOP/s
+exceeds the chip's peak is a measurement bug by definition, and nothing
+in this repo publishes it (VERDICT r3 weak #5).
+"""
+
+from __future__ import annotations
+
+# Per-chip peak dense bf16 FLOP/s by device-kind substring (public Cloud
+# TPU specs). Guards can only ever REJECT with this table: unknown kinds
+# (including the fake CPU devices tests run on) get a deliberately
+# generous default, so a guard refuses the impossible, never the merely
+# fast.
+PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
+)
+DEFAULT_PEAK_TFLOPS = 2000.0
+
+
+def peak_flops(log=None) -> float:
+    """Peak dense bf16 FLOP/s of one local device (chip peak)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, tflops in PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return tflops * 1e12
+    if log is not None:
+        log(f"unknown device kind {kind!r}: physics guard using generous "
+            f"{DEFAULT_PEAK_TFLOPS:.0f} TFLOP/s default")
+    return DEFAULT_PEAK_TFLOPS * 1e12
+
+
+def flops_from_cost_analysis(compiled) -> "float | None":
+    """Total FLOPs of a compiled XLA program per cost_analysis, or None
+    when unavailable. THE parser for cost_analysis' version-dependent
+    return shape (dict vs one-element list of dicts) — shared by
+    bench.py and train_lib.aot_compile_step so the bench's physics
+    guard and the train loops' throughput ceiling cannot diverge when
+    the API shifts again."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    except Exception:  # pragma: no cover - environment-dependent
+        return None
+    return flops if flops > 0 else None
+
+
+def rate_ceiling(flops_per_call: "float | None", images_per_call: int,
+                 n_dev: int = 1) -> "float | None":
+    """Max physically possible GLOBAL images/sec for a step program that
+    costs ``flops_per_call`` FLOPs and advances ``images_per_call``
+    images over ``n_dev`` devices; None when FLOPs are unknown (no
+    guard, matching bench._physics_guard's contract).
+
+    ``flops_per_call`` is read as the TOTAL program cost. XLA's
+    cost_analysis on a GSPMD module is ambiguous between total and
+    per-device FLOPs; treating it as total can only make this ceiling
+    up to n_dev x too GENEROUS, which keeps the guard sound (it may
+    fail to reject, it can never wrongly reject).
+    """
+    if not flops_per_call or flops_per_call <= 0:
+        return None
+    return peak_flops() * n_dev * images_per_call / flops_per_call

@@ -125,4 +125,34 @@ def test_throughput_clock_excludes_compile_and_pauses():
     # average would fall below ~160 img/s; uncontaminated it is ~1000.
     assert fields["images_per_sec_avg"] > 400, fields
     # The window after resume covers only the 5 post-eval steps.
-    assert fields["images_per_sec"] > 400, fields
+    assert fields["images_per_sec_window"] > 400, fields
+
+
+def test_throughput_clock_physics_guard():
+    """No physically impossible rate can reach metrics.jsonl (VERDICT r3
+    weak #5): a window or average rate above the FLOP-derived ceiling is
+    published as None, not as a number; possible rates pass through."""
+    import time
+
+    from jama16_retina_tpu.trainer import _ThroughputClock
+
+    clock = _ThroughputClock(batch_size=1000)
+    clock.after_step()            # first (compiling) step: dropped
+    for _ in range(3):
+        clock.after_step()        # 3000 "images" in ~0us: impossible
+    fields = clock.fields()       # no ceiling installed yet: published
+    assert fields["images_per_sec_window"] > 0
+
+    clock.set_ceiling(5000.0)     # chip physics says <= 5000 img/s
+    for _ in range(3):
+        clock.after_step()
+    fields = clock.fields()
+    assert fields["images_per_sec_window"] is None, fields
+    assert fields["images_per_sec_avg"] is None, fields
+
+    # A rate under the ceiling still publishes.
+    time.sleep(1.0)
+    clock.after_step()
+    fields = clock.fields()
+    assert fields["images_per_sec_window"] is not None
+    assert 0 < fields["images_per_sec_window"] <= 5000
