@@ -25,11 +25,20 @@ epoch, like the grain loader's index sampling) and resume is O(1):
 ``skip_batches=k`` just starts the step counter at k — the same
 (seed, step) contract as the jit step's fold_in keys (SURVEY.md §5.4).
 
-Single-process only (it is a single-host lever; multi-host slices keep
-the streamed loaders whose per-process sharding is wired end-to-end).
-Multi-CHIP within one process works: pass a mesh and the resident
-dataset rows shard across the data axis; the per-step gather is then a
-GSPMD collective over ICI, which is exactly the fabric it should ride.
+Multi-CHIP: pass a mesh and the resident dataset rows shard across the
+data axis; the per-step gather is then a GSPMD collective over ICI,
+which is exactly the fabric it should ride. Multi-HOST (VERDICT r3 #3):
+each process decodes ONLY the rows its own devices hold and uploads
+them shard-by-shard (``jax.make_array_from_callback`` over the same
+row-sharded layout), after which the per-step gather program is
+identical to the single-process multi-chip one. On a 1-D data mesh
+that is 1/P of the decode work and host RAM per process; on a
+('member', 'data') ensemble mesh the dataset is REPLICATED over the
+member axis, so a process whose devices span every data-axis block
+(e.g. one member row per host) still decodes and holds the full split
+— size host RAM accordingly. Training through ``train.py --set
+data.loader=hbm`` is pinned 2-process ≡ single-process in
+tests/test_multiprocess.py.
 """
 
 from __future__ import annotations
@@ -37,9 +46,30 @@ from __future__ import annotations
 from typing import Iterator
 
 import numpy as np
+from absl import logging
 
 from jama16_retina_tpu.configs import DataConfig
 from jama16_retina_tpu.data import tfrecord
+
+
+def _decode_rows(
+    index, start: int, stop: int, image_size: int, n: "int | None" = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Rows [start, stop) of a TFRecordIndex into preallocated uint8/i32
+    arrays — THE decode loop, shared by the full single-process load and
+    the per-shard multi-host load (the 2-process ≡ 1-process pin depends
+    on both paths decoding identically). ``n``: wrap row ids past the
+    true record count (the multi-host padding rows reuse leading
+    records as filler)."""
+    from jama16_retina_tpu.data.grain_pipeline import _decode_example
+
+    images = np.empty((stop - start, image_size, image_size, 3), np.uint8)
+    grades = np.empty((stop - start,), np.int32)
+    for i in range(start, stop):
+        row = _decode_example(index.read(i % n if n else i), image_size)
+        images[i - start] = row["image"]
+        grades[i - start] = row["grade"]
+    return images, grades
 
 
 def load_split_numpy(
@@ -48,22 +78,13 @@ def load_split_numpy(
     """All records of a split, decoded on host once:
     (images u8[N,S,S,3], grades i32[N]). Reuses the grain loader's
     TF-free record index + proto decode (data/grain_pipeline.py)."""
-    from jama16_retina_tpu.data.grain_pipeline import (
-        TFRecordIndex,
-        _decode_example,
-    )
+    from jama16_retina_tpu.data.grain_pipeline import TFRecordIndex
 
     index = TFRecordIndex(tfrecord.list_split(data_dir, split))
     n = len(index)
     if n == 0:
         raise ValueError(f"no records under {data_dir}/{split}")
-    images = np.empty((n, image_size, image_size, 3), np.uint8)
-    grades = np.empty((n,), np.int32)
-    for i in range(n):
-        row = _decode_example(index.read(i), image_size)
-        images[i] = row["image"]
-        grades[i] = row["grade"]
-    return images, grades
+    return _decode_rows(index, 0, n, image_size)
 
 
 def dataset_bytes(n: int, image_size: int) -> int:
@@ -72,10 +93,13 @@ def dataset_bytes(n: int, image_size: int) -> int:
 
 def hbm_budget_bytes(max_fraction: float = 0.6) -> int:
     """Per-chip HBM budget for the resident dataset: ``max_fraction`` of
-    the device's memory limit when the runtime reports one, else a
-    conservative 16 GB v5e-class assumption. The remaining fraction
-    belongs to the model/optimizer/activations (the flagship step's live
-    set is ~2 GB; 0.6 leaves ~3x headroom)."""
+    the device's memory limit when the runtime reports one. When it
+    reports none, assume the SMALLEST HBM of any deployed TPU core
+    (8 GB, v2/v3) rather than the v5e's 16 — an optimistic assumption
+    here is an OOM at upload time, and the fallback is disclosed in the
+    log the same way bench.py discloses its generous physics default
+    (ADVICE r3). The remaining fraction belongs to the model/optimizer/
+    activations (the flagship step's live set is ~2 GB)."""
     import jax
 
     limit = None
@@ -86,7 +110,12 @@ def hbm_budget_bytes(max_fraction: float = 0.6) -> int:
     except Exception:
         pass
     if not limit:
-        limit = 16 * 1024**3
+        limit = 8 * 1024**3
+        logging.warning(
+            "device reports no bytes_limit: assuming a conservative "
+            "%d GB HBM budget base (smallest deployed TPU core)",
+            limit // 1024**3,
+        )
     return int(limit * max_fraction)
 
 
@@ -99,23 +128,77 @@ def fits_in_hbm(
     return per_chip <= hbm_budget_bytes(max_fraction)
 
 
-def make_batch_fn(images, grades, batch_size: int, seed: int, mesh=None):
+def _load_index_rows_sharded(index, n: int, image_size: int, mesh):
+    """Multi-host placement: decode ONLY this process's rows, upload
+    shard-by-shard -> (images, grades) as GLOBAL row-sharded arrays of
+    padded length (VERDICT r3 #3).
+
+    Each addressable device's dim-0 block is decoded exactly once (the
+    grade sharding's blocks coincide with the image sharding's, so one
+    decode feeds both callbacks). Padding rows — dim-0 must divide the
+    data axis — reuse leading records as filler; the batch permutation
+    draws indices < n only, so they are never sampled and the gather
+    program ends up IDENTICAL to the single-process multi-chip one.
+    """
+    import jax
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    d = mesh.shape[mesh_lib._batch_axis(mesh)]
+    n_pad = n + ((-n) % d)
+    img_sh = mesh_lib._rank_sharding(4, mesh_lib.batch_sharding(mesh))
+    g_sh = mesh_lib._rank_sharding(1, mesh_lib.batch_sharding(mesh))
+    img_shape = (n_pad, image_size, image_size, 3)
+
+    def _span(idx) -> tuple[int, int]:
+        s = idx[0]
+        return (s.start or 0, n_pad if s.stop is None else s.stop)
+
+    blocks: dict[tuple[int, int], tuple] = {}
+    for dev_idx in img_sh.addressable_devices_indices_map(img_shape).values():
+        start, stop = _span(dev_idx)
+        if (start, stop) not in blocks:
+            blocks[(start, stop)] = _decode_rows(
+                index, start, stop, image_size, n=n
+            )
+    logging.info(
+        "hbm loader (multi-host): process %d/%d decoded %d of %d rows",
+        jax.process_index(), jax.process_count(),
+        sum(b[1].shape[0] for b in blocks.values()), n_pad,
+    )
+    images = jax.make_array_from_callback(
+        img_shape, img_sh, lambda idx: blocks[_span(idx)][0]
+    )
+    grades = jax.make_array_from_callback(
+        (n_pad,), g_sh, lambda idx: blocks[_span(idx)][1]
+    )
+    return images, grades
+
+
+def make_batch_fn(images, grades, batch_size: int, seed: int, mesh=None,
+                  n_records: "int | None" = None):
     """jit'd ``step -> {'image','grade'}`` gather over the resident
     arrays. With a mesh, the dataset is row-sharded over the data axis
     and the output batch carries the standard batch sharding — the
-    shuffle gather becomes an ICI collective under GSPMD."""
+    shuffle gather becomes an ICI collective under GSPMD.
+
+    ``images``/``grades`` are host numpy (this function pads + places
+    them) or already-global jax Arrays from _load_index_rows_sharded
+    (multi-host; already padded — pass ``n_records`` = the TRUE record
+    count so the permutation never samples the padding)."""
     import jax
     import jax.numpy as jnp
 
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
-    n = images.shape[0]
+    n = int(n_records) if n_records is not None else images.shape[0]
     if batch_size > n:
         raise ValueError(f"batch_size={batch_size} exceeds dataset n={n}")
     steps_per_epoch = n // batch_size
     base = jax.random.key(seed)
 
-    if mesh is not None:
+    if isinstance(images, jax.Array):
+        pass  # pre-placed global arrays (multi-host path)
+    elif mesh is not None:
         # Row-sharding needs dim 0 divisible by the data axis; real
         # splits have arbitrary counts, so pad with leading records
         # re-used as filler. The permutation draws indices < n only —
@@ -133,25 +216,31 @@ def make_batch_fn(images, grades, batch_size: int, seed: int, mesh=None):
         images = jax.device_put(images)
         grades = jax.device_put(grades)
 
-    def get_batch(step):
+    # The resident arrays are jit ARGUMENTS, not closure captures: a
+    # multi-host global array spans non-addressable devices, which jit
+    # refuses to close over (argument shardings are inferred from the
+    # committed arrays either way, and an argument is not re-uploaded).
+    def get_batch(imgs, grs, step):
         epoch = step // steps_per_epoch
         pos = (step % steps_per_epoch) * batch_size
         perm = jax.random.permutation(jax.random.fold_in(base, epoch), n)
         idx = jax.lax.dynamic_slice(perm, (pos,), (batch_size,))
         return {
-            "image": jnp.take(images, idx, axis=0),
-            "grade": jnp.take(grades, idx, axis=0),
+            "image": jnp.take(imgs, idx, axis=0),
+            "grade": jnp.take(grs, idx, axis=0),
         }
 
     if mesh is None:
-        return jax.jit(get_batch)
-    return jax.jit(
-        get_batch,
-        out_shardings={
-            "image": mesh_lib.batch_sharding(mesh),
-            "grade": mesh_lib.batch_sharding(mesh),
-        },
-    )
+        jitted = jax.jit(get_batch)
+    else:
+        jitted = jax.jit(
+            get_batch,
+            out_shardings={
+                "image": mesh_lib.batch_sharding(mesh),
+                "grade": mesh_lib.batch_sharding(mesh),
+            },
+        )
+    return lambda step: jitted(images, grades, step)
 
 
 def train_batches(
@@ -169,28 +258,41 @@ def train_batches(
     step) semantics — no replay, no state files)."""
     import jax
 
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "data.loader='hbm' is single-process (a single-host lever); "
-            "multi-host slices should use the tfdata or grain loader, "
-            "whose per-process input sharding is wired end-to-end"
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    multiprocess = jax.process_count() > 1
+    if multiprocess and mesh is None:
+        raise ValueError(
+            "data.loader='hbm' needs a mesh on multi-process launches "
+            "(the resident rows shard across the mesh's data axis)"
         )
-    images, grades = load_split_numpy(data_dir, split, image_size)
+    if multiprocess:
+        # Count records from the index alone (cheap: record framing, no
+        # decode) so the HBM gate runs BEFORE any decode/upload work.
+        from jama16_retina_tpu.data.grain_pipeline import TFRecordIndex
+
+        index = TFRecordIndex(tfrecord.list_split(data_dir, split))
+        n = len(index)
+        if n == 0:
+            raise ValueError(f"no records under {data_dir}/{split}")
+    else:
+        images, grades = load_split_numpy(data_dir, split, image_size)
+        n = len(images)
     # The dataset shards across the DATA axis only (replicated over any
     # 'member' axis of an ensemble mesh) — gating on total device count
     # would under-count per-chip bytes by the member-axis factor.
-    from jama16_retina_tpu.parallel import mesh as mesh_lib
-
     n_dev = mesh.shape[mesh_lib._batch_axis(mesh)] if mesh is not None else 1
-    if not fits_in_hbm(len(images), image_size, n_dev, max_fraction):
+    if not fits_in_hbm(n, image_size, n_dev, max_fraction):
         raise ValueError(
-            f"{split} split ({dataset_bytes(len(images), image_size) / 1e9:.1f}"
+            f"{split} split ({dataset_bytes(n, image_size) / 1e9:.1f}"
             f" GB over {n_dev} chip(s)) exceeds the HBM-resident budget "
             f"({hbm_budget_bytes(max_fraction) / 1e9:.1f} GB/chip); use the "
             "tfdata or grain loader for datasets this size"
         )
+    if multiprocess:
+        images, grades = _load_index_rows_sharded(index, n, image_size, mesh)
     get_batch = make_batch_fn(
-        images, grades, cfg.batch_size, seed, mesh=mesh
+        images, grades, cfg.batch_size, seed, mesh=mesh, n_records=n
     )
     step = skip_batches
     while True:

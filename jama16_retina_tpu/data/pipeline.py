@@ -357,16 +357,26 @@ def device_prefetch(
     def put(batch: dict) -> dict:
         if sharding is None:
             return jax.device_put(batch)
-        if full_local and multiprocess:
-            from jama16_retina_tpu.parallel import mesh as mesh_lib
-
-            return mesh_lib.place_full_local(batch, sharding)
-        # full_local single-process falls through: plain sharded puts are
-        # equivalent there (and no-copy for already-device-resident hbm
-        # batches, which place_full_local's np.asarray would round-trip).
 
         def one(x):
             sh = _shard_for(x, sharding)
+            # is_equivalent_to, not ==: P('data') and P('data',None,...)
+            # describe the same placement but compare unequal.
+            if isinstance(x, jax.Array) and x.sharding.is_equivalent_to(
+                    sh, x.ndim):
+                # Already a correctly-sharded global array — the hbm
+                # loader's batches are born on device (multi-host: NOT
+                # fully addressable, so both host-assembly paths below
+                # would be wrong, not just wasteful). Checked before the
+                # full_local branch so the member-parallel driver can
+                # also ride the hbm loader on multi-host.
+                return x
+            if full_local and multiprocess:
+                from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+                return mesh_lib.place_full_local(x, sharding)
+            # full_local single-process falls through: plain sharded puts
+            # are equivalent there.
             if multiprocess and np.ndim(x):
                 # Local rows -> global array (see mesh_lib.shard_batch).
                 return jax.make_array_from_process_local_data(sh, np.asarray(x))

@@ -166,10 +166,137 @@ def test_two_process_training_matches_single_process(tmp_path):
         )
 
 
+@pytest.mark.slow
+def test_two_process_hbm_loader_matches_single_process(tmp_path):
+    """Multi-HOST HBM-resident loader (VERDICT r3 #3): each process
+    decodes only its own devices' row shards and uploads them in place;
+    the per-step gather is one global GSPMD program, so batch selection
+    — a pure function of (seed, step) over the SAME global row order —
+    must make the 2-process run match the single-process 4-device run
+    to reduce-order noise."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 1, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 1, seed=2)
+    hbm_args = ["--set", "data.loader=hbm"]
+
+    w1 = str(tmp_path / "one_proc")
+    p = _run_train(data_dir, w1, 4, str(tmp_path / "one.log"),
+                   extra_args=hbm_args)
+    out = _wait(p)
+    assert p.returncode == 0, f"single-process hbm run failed:\n{out[-3000:]}"
+
+    w2 = str(tmp_path / "two_proc")
+    port = _free_port()
+    procs = [
+        _run_train(
+            data_dir, w2, 2, str(tmp_path / f"hp{i}.log"),
+            env={
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(i),
+            },
+            extra_args=hbm_args,
+        )
+        for i in range(2)
+    ]
+    outs = [_wait(p) for p in procs]
+    assert all(p.returncode == 0 for p in procs), (
+        f"two-process hbm run failed:\np0:\n{outs[0][-3000:]}\n"
+        f"p1:\n{outs[1][-3000:]}"
+    )
+    finals = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    assert finals[0]["results"] == finals[1]["results"]
+
+    # Each process decoded only its half of the rows (the 1/P-decode
+    # property itself, from the loader's own log line).
+    for i in range(2):
+        with open(str(tmp_path / f"hp{i}.log")) as f:
+            assert "decoded 24 of 48 rows" in f.read(), f"p{i} log"
+
+    # Identical global batches (pure (seed, step) selection) -> the
+    # first-step loss pin is as tight as the single-model stream test's.
+    first = {
+        w: next(r["loss"] for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
+                if r["kind"] == "train" and r["step"] == 1)
+        for w in (w1, w2)
+    }
+    assert abs(first[w1] - first[w2]) < 5e-5, first
+
+    cfg = override(get_config("smoke"), [
+        "train.steps=4", "data.augment=false", "model.dropout_rate=0.0",
+        "train.optimizer=sgdm",
+    ])
+    model = models.build(cfg.model)
+    states = []
+    for w in (w1, w2):
+        st, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+        ck = ckpt_lib.Checkpointer(w)
+        states.append(ck.restore(
+            ckpt_lib.abstract_like(jax.device_get(st)), ck.latest_step
+        ))
+        ck.close()
+    for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-3
+        )
+
+
 ENSEMBLE_ARGS = [
     "--set", "train.ensemble_size=2",
     "--set", "train.ensemble_parallel=true",
 ]
+
+
+@pytest.mark.slow
+def test_two_process_member_parallel_hbm_loader_runs(tmp_path):
+    """Member-parallel + hbm loader on multi-host: the hbm batch is born
+    as a global array over the ('member','data') mesh, so
+    device_prefetch's full_local path must pass it through untouched
+    (the already-sharded check runs BEFORE the full_local host assembly
+    — a code-review catch on the round-4 diff). Pins the 2-process run
+    against the single-process stacked run."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 1, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 1, seed=2)
+    args = ENSEMBLE_ARGS + ["--set", "data.loader=hbm"]
+
+    w1 = str(tmp_path / "one_proc")
+    p = _run_train(data_dir, w1, 4, str(tmp_path / "one.log"),
+                   extra_args=args)
+    out = _wait(p)
+    assert p.returncode == 0, f"single-process run failed:\n{out[-3000:]}"
+
+    w2 = str(tmp_path / "two_proc")
+    port = _free_port()
+    procs = [
+        _run_train(
+            data_dir, w2, 2, str(tmp_path / f"ehp{i}.log"),
+            env={
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(i),
+            },
+            extra_args=args,
+        )
+        for i in range(2)
+    ]
+    outs = [_wait(p) for p in procs]
+    assert all(p.returncode == 0 for p in procs), (
+        f"two-process run failed:\np0:\n{outs[0][-3000:]}\n"
+        f"p1:\n{outs[1][-3000:]}"
+    )
+    finals = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    assert finals[0]["results"] == finals[1]["results"]
+
+    def first_losses(w):
+        return next(
+            r["loss_per_member"]
+            for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
+            if r["kind"] == "train" and r["step"] == 1
+        )
+
+    np.testing.assert_allclose(first_losses(w1), first_losses(w2),
+                               atol=5e-5)
 
 
 @pytest.mark.slow
