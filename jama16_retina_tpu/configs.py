@@ -35,6 +35,16 @@ class ModelConfig:
     # Auxiliary logits head, mirroring TF-Slim inception_v3's aux head.
     aux_head: bool = True
     aux_weight: float = 0.4
+    # Stem experiment levers for the batch-32 HBM bound (VERDICT r3 #2;
+    # measured in docs/PERF.md §Stem-experiments — flags stay off unless
+    # the measurement says otherwise). inception_v3 only.
+    # stem_s2d: numerically exact space-to-depth rewrite of the stride-2
+    # stem conv (299x299x3 -> 150x150x12 blocks; the MLPerf ResNet
+    # trick) — same parameter tree, so checkpoints/transplant unchanged.
+    stem_s2d: bool = False
+    # remat_stem: jax.checkpoint over the stem (recompute its
+    # activations in backward instead of keeping them live).
+    remat_stem: bool = False
 
     @property
     def num_classes(self) -> int:
