@@ -116,14 +116,20 @@ def write_example_shards(
     split: str,
     num_shards: int,
 ) -> list[str]:
-    """Round-robin pre-built tf.train.Examples into ``num_shards`` files."""
+    """Round-robin pre-built tf.train.Examples (or their already-
+    serialized bytes — what the preprocess worker pool ships across
+    processes) into ``num_shards`` files."""
     tf = _tf()
     os.makedirs(out_dir, exist_ok=True)
     paths = [shard_path(out_dir, split, i, num_shards) for i in range(num_shards)]
     writers = [tf.io.TFRecordWriter(p) for p in paths]
     try:
         for i, ex in enumerate(examples):
-            writers[i % num_shards].write(ex.SerializeToString())
+            # deterministic=True keeps proto-map field order stable
+            # across processes (byte-identical shards at any --workers).
+            data = (ex if isinstance(ex, bytes)
+                    else ex.SerializeToString(deterministic=True))
+            writers[i % num_shards].write(data)
     finally:
         for w in writers:
             w.close()

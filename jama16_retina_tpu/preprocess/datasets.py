@@ -119,6 +119,47 @@ class PreprocessStats:
         return dataclasses.asdict(self)
 
 
+def _process_one(task: tuple) -> tuple:
+    """The per-image stage — one (name, grade) -> (status, quality-dict,
+    serialized example bytes). Module-level and arg-packed so the
+    ``--workers`` process pool can pickle it; the serial path runs the
+    SAME function, which is what makes the pooled output byte-identical
+    by construction (every stage here — cv2 decode, fundus normalize,
+    JPEG encode, proto serialize — is deterministic per image)."""
+    (name, grade, data_dir, image_size, ben_graham, jpeg_quality,
+     encoding, min_quality) = task
+    import cv2
+
+    path = find_image(data_dir, name)
+    if path is None:
+        return "missing", None, None
+    bgr = cv2.imread(path, cv2.IMREAD_COLOR)
+    if bgr is None:
+        return "unreadable", None, None
+    rgb = bgr[..., ::-1]
+    try:
+        norm, q = fundus.resize_and_center_fundus(
+            rgb, diameter=image_size, ben_graham=ben_graham,
+            with_quality=True,
+        )
+    except fundus.FundusNotFound:
+        return "no_fundus", None, None
+    if q["quality"] < min_quality:
+        return "low_quality", q, None
+    if encoding == "raw":
+        ex = tfrecord.make_raw_example(norm, grade, name, quality=q["quality"])
+    else:
+        ex = tfrecord.make_example(
+            tfrecord.encode_jpeg(norm, quality=jpeg_quality),
+            grade, name, quality=q["quality"],
+        )
+    # deterministic=True: proto MAP fields (the Features dict) otherwise
+    # serialize in per-process hash order, and the pooled run's spawned
+    # children each have their own hash seed — the records would parse
+    # identically but differ byte-for-byte from the serial run's.
+    return "written", q, ex.SerializeToString(deterministic=True)
+
+
 def process_split(
     items: Sequence[tuple[str, int]],
     data_dir: str,
@@ -130,6 +171,7 @@ def process_split(
     jpeg_quality: int = 92,
     encoding: str = "jpeg",
     min_quality: float = 0.0,
+    workers: int = 0,
 ) -> PreprocessStats:
     """Normalize every (name, grade) image and write TFRecord shards.
 
@@ -142,9 +184,18 @@ def process_split(
     ``<out_dir>/quality_<split>.csv``; ``min_quality`` > 0 additionally
     DROPS images scoring below it — the executable form of the original
     JAMA study's image-quality grading step (docs/QUALITY.md).
-    """
-    import cv2
 
+    ``workers`` > 0 fans the per-image stage (_process_one) over that
+    many processes (SURVEY.md §3.3: "parallelized over CPU workers" —
+    ~0.1-0.3 s/image serial means hours over EyePACS' ~88k images on a
+    one-core loop, and preprocessing sits on the critical path of the
+    end-to-end wall-clock story). ``imap`` keeps results in item order,
+    and the single consumer below does ALL writing, so shards and the
+    quality CSV are byte-identical to the serial run's (pinned by
+    tests/test_preprocess.py). Spawned (not forked) children: the
+    parent may already hold an initialized TF runtime, which does not
+    survive fork.
+    """
     if encoding not in ("jpeg", "raw"):
         raise ValueError(f"encoding must be jpeg|raw, got {encoding!r}")
     stats = PreprocessStats()
@@ -156,49 +207,60 @@ def process_split(
     report_csv.writerow(["name", "grade", "quality", "lap_var", "mean",
                         "std", "written"])
 
-    def examples() -> Iterator:
-        for name, grade in items:
-            path = find_image(data_dir, name)
-            if path is None:
-                stats.skipped_missing += 1
-                continue
-            bgr = cv2.imread(path, cv2.IMREAD_COLOR)
-            if bgr is None:
-                stats.skipped_unreadable += 1
-                continue
-            rgb = bgr[..., ::-1]
-            try:
-                norm, q = fundus.resize_and_center_fundus(
-                    rgb, diameter=image_size, ben_graham=ben_graham,
-                    with_quality=True,
-                )
-            except fundus.FundusNotFound:
-                stats.skipped_no_fundus += 1
-                continue
-            keep = q["quality"] >= min_quality
-            report_csv.writerow([
-                name, grade, q["quality"], q["lap_var"], q["mean"],
-                q["std"], int(keep),
-            ])
-            if not keep:
-                stats.skipped_low_quality += 1
+    tasks = [
+        (name, grade, data_dir, image_size, ben_graham, jpeg_quality,
+         encoding, min_quality)
+        for name, grade in items
+    ]
+    _BUMP = {
+        "missing": "skipped_missing",
+        "unreadable": "skipped_unreadable",
+        "no_fundus": "skipped_no_fundus",
+        "low_quality": "skipped_low_quality",
+    }
+
+    def consume(results) -> Iterator[bytes]:
+        for (name, grade, *_), (status, q, data) in zip(tasks, results):
+            if q is not None:
+                keep = status == "written"
+                report_csv.writerow([
+                    name, grade, q["quality"], q["lap_var"], q["mean"],
+                    q["std"], int(keep),
+                ])
+            if status != "written":
+                setattr(stats, _BUMP[status],
+                        getattr(stats, _BUMP[status]) + 1)
                 continue
             stats.written += 1
             qualities.append(q["quality"])
-            if encoding == "raw":
-                yield tfrecord.make_raw_example(
-                    norm, grade, name, quality=q["quality"]
-                )
-            else:
-                yield tfrecord.make_example(
-                    tfrecord.encode_jpeg(norm, quality=jpeg_quality),
-                    grade, name, quality=q["quality"],
-                )
+            yield data
 
+    pool = None
+    if workers > 0:
+        import multiprocessing as mp
+
+        pool = mp.get_context("spawn").Pool(workers)
+        results = pool.imap(_process_one, tasks, chunksize=8)
+    else:
+        results = map(_process_one, tasks)
+    ok = False
     try:
-        tfrecord.write_example_shards(examples(), out_dir, split, num_shards)
+        tfrecord.write_example_shards(
+            consume(results), out_dir, split, num_shards
+        )
+        ok = True
     finally:
         report.close()
+        if pool is not None:
+            if ok:
+                pool.close()
+            else:
+                # imap's feeder has already queued the FULL task list;
+                # close()+join() here would decode every remaining image
+                # (hours at EyePACS scale) before the writer's error
+                # (disk full, Ctrl-C) ever surfaced.
+                pool.terminate()
+            pool.join()
     if qualities:
         stats.quality_mean = round(float(np.mean(qualities)), 4)
         stats.quality_min = round(float(np.min(qualities)), 4)

@@ -44,6 +44,12 @@ _MIN_QUALITY = flags.DEFINE_float(
     "study's image-quality grading (docs/QUALITY.md); every image's "
     "score lands in quality_<split>.csv regardless",
 )
+_WORKERS = flags.DEFINE_integer(
+    "workers", 0,
+    "CPU worker processes for the per-image stage (0 = in-process "
+    "serial). Output shards and quality CSVs are byte-identical to the "
+    "serial run at any worker count (SURVEY.md §3.3).",
+)
 
 
 def main(argv):
@@ -63,7 +69,7 @@ def main(argv):
             items, _DATA_DIR.value, _OUT.value, split,
             image_size=_SIZE.value, num_shards=_SHARDS.value,
             ben_graham=_BEN_GRAHAM.value, encoding=_ENCODING.value,
-            min_quality=_MIN_QUALITY.value,
+            min_quality=_MIN_QUALITY.value, workers=_WORKERS.value,
         )
         report[split] = {"n_labeled": len(items), **stats.as_dict()}
     print(json.dumps(report, indent=2))

@@ -37,6 +37,11 @@ _MIN_QUALITY = flags.DEFINE_float(
     "(see preprocess_eyepacs.py --min_quality); scores land in "
     "quality_test.csv regardless",
 )
+_WORKERS = flags.DEFINE_integer(
+    "workers", 0,
+    "CPU worker processes for the per-image stage (0 = serial); output "
+    "is byte-identical at any worker count",
+)
 
 
 def main(argv):
@@ -52,7 +57,7 @@ def main(argv):
         items, _DATA_DIR.value, _OUT.value, "test",
         image_size=_SIZE.value, num_shards=_SHARDS.value,
         ben_graham=_BEN_GRAHAM.value, encoding=_ENCODING.value,
-        min_quality=_MIN_QUALITY.value,
+        min_quality=_MIN_QUALITY.value, workers=_WORKERS.value,
     )
     print(json.dumps({"test": {"n_labeled": len(items), **stats.as_dict()}},
                      indent=2))

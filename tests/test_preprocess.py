@@ -174,6 +174,47 @@ def test_end_to_end_raw_images_to_train_pipeline(tmp_path):
     assert abs(c.cx - 48) <= 4 and abs(c.cy - 48) <= 4
 
 
+def test_process_split_workers_byte_identical(tmp_path):
+    """--workers=N must be a pure wall-clock lever: the 2-worker pool
+    produces byte-identical shards AND quality CSV to the serial run
+    (VERDICT r3 #6 — order preserved by imap, all writing in the one
+    consumer). One image is missing and one is blank so the skip
+    bookkeeping crosses the process boundary too."""
+    import cv2
+
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    rng = np.random.default_rng(1)
+    items = []
+    for i in range(6):
+        grade = int(rng.integers(0, 5))
+        img = draw_disc((200, 260), cx=120 + i * 4, cy=100, r=80 + i,
+                        value=90 + i * 12)
+        cv2.imwrite(str(raw / f"im_{i}.jpeg"), img[..., ::-1])
+        items.append((f"im_{i}", grade))
+    cv2.imwrite(str(raw / "blank.jpeg"), np.zeros((200, 260, 3), np.uint8))
+    items.append(("blank", 0))          # -> skipped_no_fundus
+    items.append(("gone", 1))           # -> skipped_missing
+
+    outs = {}
+    for label, workers in (("serial", 0), ("pool", 2)):
+        out = tmp_path / label
+        stats = datasets.process_split(
+            items, str(raw), str(out), "train", image_size=96,
+            num_shards=2, workers=workers,
+        )
+        assert stats.written == 6 and stats.skipped_no_fundus == 1
+        assert stats.skipped_missing == 1
+        outs[label] = out
+
+    serial_files = sorted(p.name for p in outs["serial"].iterdir())
+    assert serial_files == sorted(p.name for p in outs["pool"].iterdir())
+    for name in serial_files:
+        a = (outs["serial"] / name).read_bytes()
+        b = (outs["pool"] / name).read_bytes()
+        assert a == b, f"{name} differs between serial and 2-worker runs"
+
+
 class TestGradability:
     """fundus.gradability_stats: the image-quality lever (VERDICT r2 #4).
     Synthetic fundus images carry vessel/lesion texture, so heavy blur,
