@@ -59,6 +59,8 @@ def build(cfg: ModelConfig, axis_name: str | None = None,
     if cfg.arch == "inception_v3":
         return InceptionV3(
             aux_head=cfg.aux_head,
+            stem_s2d=cfg.stem_s2d,
+            remat_stem=cfg.remat_stem,
             **common,
         )
     if cfg.arch == "resnet50":

@@ -22,9 +22,10 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
-from jama16_retina_tpu.models.common import ConvBN
+from jama16_retina_tpu.models.common import BN_EPS, BN_MOMENTUM, ConvBN
 
 
 def _avg_pool_same(x):
@@ -164,6 +165,82 @@ class InceptionE(nn.Module):
         return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
 
+class _Kernel(nn.Module):
+    """Bare conv-kernel holder whose scope name mirrors nn.Conv's, so
+    S2DStemConv's parameter tree is IDENTICAL to ConvBN's
+    (<name>/conv/kernel, float32 (3,3,3,32)) — checkpoints, the keras
+    transplant map, and the baseline stem all interchange freely."""
+
+    shape: tuple
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel", nn.initializers.lecun_normal(), self.shape, jnp.float32
+        )
+
+
+class S2DStemConv(nn.Module):
+    """Space-to-depth form of the stride-2 3x3 VALID stem conv
+    (ModelConfig.stem_s2d; the MLPerf-ResNet input trick, re-derived for
+    this stem): pad 299->300, fold 2x2 spatial blocks into channels
+    (B,150,150,12), and convolve with a 2x2/stride-1 kernel built
+    IN-GRAPH from the same logical (3,3,3,32) parameter —
+
+        W'[Di,Dj,(di,dj,c),o] = W[2Di+di, 2Dj+dj, c, o]   (0 past 3x3)
+
+    which computes exactly the original conv's sums: output pixel i
+    covers original rows 2i..2i+3, of which the 3x3 taps are the
+    non-zero ones, and the 300th padded row/col only ever meets the
+    zeroed tap offset 3. The point is MXU shape, not math: a 3-channel
+    input conv wastes 125/128 of the MXU's contracting lanes, the
+    12-channel form 4x less, and the largest low-channel activation
+    (299^2x3) never exists on device. BN/ReLU identical to ConvBN."""
+
+    features: int = 32
+    dtype: Any = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        c_in = x.shape[-1]
+        w = _Kernel((3, 3, c_in, self.features), name="conv")()
+        w4 = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        # (4,4,c,o) -> (Di,di,Dj,dj,c,o) -> (Di,Dj,di,dj,c,o) -> 2x2 HWIO
+        w_s2d = (
+            w4.reshape(2, 2, 2, 2, c_in, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(2, 2, 4 * c_in, self.features)
+        )
+        n, h, w_sz, _ = x.shape
+        assert h == w_sz, "stem_s2d assumes square inputs"
+        # Blocks must cover every row the 2x2 block-conv reads for the
+        # original output size (h-3)//2 + 1; the trailing zero-pad rows
+        # only ever meet the zeroed tap offset 3 (exactness note above).
+        blocks = (h - 3) // 2 + 2
+        pad = 2 * blocks - h
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, pad), (0, 0)))
+        # (di, dj, c) fold order matches w_s2d's (di slowest).
+        x = (
+            x.reshape(n, blocks, 2, blocks, 2, c_in)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(n, blocks, blocks, 4 * c_in)
+        )
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype), w_s2d.astype(self.dtype),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=BN_MOMENTUM, epsilon=BN_EPS, use_scale=False,
+            dtype=self.dtype,
+            axis_name=self.axis_name if train else None,
+            name="bn",
+        )(y)
+        return nn.relu(y).astype(self.dtype)
+
+
 class AuxHead(nn.Module):
     """Slim auxiliary classifier off Mixed_6e (17x17x768 input)."""
 
@@ -198,20 +275,37 @@ class InceptionV3(nn.Module):
     dropout_rate: float = 0.2
     dtype: Any = jnp.bfloat16
     axis_name: str | None = None
+    stem_s2d: bool = False
+    remat_stem: bool = False
 
-    @nn.compact
-    def __call__(self, x, train: bool = False):
+    def _stem(self, x, train: bool):
+        """Stem: 299x299x3 -> 35x35x192 (the HBM-heavy low-channel part;
+        both VERDICT r3 #2 levers act here and only here)."""
         kw = dict(dtype=self.dtype, axis_name=self.axis_name)
-        x = x.astype(self.dtype)
-        # Stem: 299x299x3 -> 35x35x192.
-        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID",
-                   name="Conv2d_1a_3x3", **kw)(x, train)
+        if self.stem_s2d:
+            x = S2DStemConv(name="Conv2d_1a_3x3", **kw)(x, train)
+        else:
+            x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID",
+                       name="Conv2d_1a_3x3", **kw)(x, train)
         x = ConvBN(32, (3, 3), padding="VALID", name="Conv2d_2a_3x3", **kw)(x, train)
         x = ConvBN(64, (3, 3), name="Conv2d_2b_3x3", **kw)(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         x = ConvBN(80, (1, 1), padding="VALID", name="Conv2d_3b_1x1", **kw)(x, train)
         x = ConvBN(192, (3, 3), padding="VALID", name="Conv2d_4a_3x3", **kw)(x, train)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(dtype=self.dtype, axis_name=self.axis_name)
+        x = x.astype(self.dtype)
+        if self.remat_stem:
+            # Method-level nn.remat keeps every stem parameter at its
+            # original path (self's scope is shared); train is static.
+            x = nn.remat(type(self)._stem, static_argnums=(2,))(
+                self, x, train
+            )
+        else:
+            x = self._stem(x, train)
 
         # 35x35 blocks.
         x = InceptionA(pool_features=32, name="Mixed_5b", **kw)(x, train)
