@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Attack the batch-32 HBM bound with experiments, not prose (VERDICT r3 #2).
+
+PERF.md diagnoses the flagship step as HBM-bandwidth-bound on the
+299px stem activations; this script measures the standard TPU levers
+for exactly that bound, each under bench.py's fenced timing + physics
+guard (the only discipline this repo publishes rates with):
+
+  baseline   — eyepacs_binary flagship step as benched (BENCH_r03)
+  s2d        — ModelConfig.stem_s2d: exact space-to-depth stem rewrite
+  remat      — ModelConfig.remat_stem: recompute the stem in backward
+  s2d+remat  — both levers
+  b128       — batch-128 reference row (the amortization headroom bound)
+
+Each variant is a fresh state + train step on synthetic batches —
+identical to bench.py's device_only section, so rows are directly
+comparable to the headline. Results go to stdout as one JSON document
+(committed as docs/stem_experiments_r4.json) and the winner, if any,
+becomes the flagship preset default.
+
+Run: python scripts/stem_experiments.py   (~15 min on the chip, warm cache)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import bench  # repo-root bench.py: the shared fenced harness
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.enable_persistent_compilation_cache(
+        os.environ.get("BENCH_JIT_CACHE", "/tmp/retina_bench_jitcache")
+    )
+    peak = bench._peak_flops()
+    mesh = mesh_lib.make_mesh()
+    n_dev = mesh.devices.size
+
+    variants = [
+        ("baseline", [], 32),
+        ("s2d", ["model.stem_s2d=true"], 32),
+        ("remat", ["model.remat_stem=true"], 32),
+        ("s2d+remat", ["model.stem_s2d=true", "model.remat_stem=true"], 32),
+        ("s2d_b128", ["model.stem_s2d=true"], 128),
+    ]
+    rows = []
+    rng = np.random.default_rng(0)
+    for name, sets, batch_size in variants:
+        cfg = override(get_config("eyepacs_binary"),
+                       sets + [f"data.batch_size={batch_size}"])
+        size = cfg.model.image_size
+        model = models.build(cfg.model)
+        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+        state = jax.device_put(state, mesh_lib.replicated(mesh))
+        step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+        batches = [
+            mesh_lib.shard_batch(
+                {
+                    "image": rng.integers(
+                        0, 256, (batch_size, size, size, 3), np.uint8),
+                    "grade": rng.integers(0, 5, (batch_size,), np.int32),
+                },
+                mesh,
+            )
+            for _ in range(bench.N_DISTINCT_BATCHES)
+        ]
+        key = jax.random.key(1)
+        flops = bench._flops_of(step, state, batches[0], key)
+        fpi = flops / batch_size if flops else None
+        t0 = time.time()
+        rate, _ = bench._timed_steps(
+            step, state, lambda i: batches[i % bench.N_DISTINCT_BATCHES],
+            key, bench.TIMED_STEPS, batch_size, n_dev,
+        )
+        guarded = bench._physics_guard(name, rate, fpi, peak)
+        row = {
+            "variant": name,
+            "batch_size": batch_size,
+            "img_s_chip": round(guarded, 2) if guarded is not None else None,
+            "gflops_per_image": round(fpi / 1e9, 2) if fpi else None,
+            "mfu_pct": (round(100 * guarded * fpi / peak, 1)
+                        if guarded and fpi else None),
+            "wall_sec_incl_compile": round(time.time() - t0, 1),
+        }
+        rows.append(row)
+        print(f"stem_experiments: {row}", file=sys.stderr)
+        # Free the variant's state/executables before the next compile
+        # (b128 + stacked buffers would otherwise accumulate in HBM).
+        del state, step, batches
+    print(json.dumps({
+        "device": jax.devices()[0].device_kind,
+        "timed_steps": bench.TIMED_STEPS,
+        "physics_peak_tflops": round(peak / 1e12, 1),
+        "rows": rows,
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,8 +44,17 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--target", type=float, default=0.97)
     p.add_argument("--k", type=int, default=4, help="ensemble members")
-    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--steps", type=int, default=800)
     p.add_argument("--eval_every", type=int, default=50)
+    # The quality preset's horizons are tuned for real-EyePACS run
+    # lengths (warmup 500 of ~10k steps, EMA horizon ~1k steps); a
+    # short synthetic run must scale them with it or the EMA shadow the
+    # evals read is still mostly random init at the end (measured:
+    # ensemble val AUC 0.78 after 300 steps under ema_decay=0.999 with
+    # the full 500-step warmup clamped into the run).
+    p.add_argument("--warmup_steps", type=int, default=None,
+                   help="default: steps // 10")
+    p.add_argument("--ema_decay", type=float, default=0.99)
     p.add_argument("--train_n", type=int, default=1024)
     p.add_argument("--val_n", type=int, default=256)
     p.add_argument("--test_n", type=int, default=512)
@@ -127,6 +136,8 @@ def main(argv=None) -> dict:
             f.write(geom)
     data_gen_sec = time.time() - t0
 
+    warmup = (args.warmup_steps if args.warmup_steps is not None
+              else args.steps // 10)
     cfg = override(get_config(preset), [
         f"train.seed={args.seed}",
         f"train.ensemble_size={args.k}",
@@ -134,6 +145,9 @@ def main(argv=None) -> dict:
         f"train.steps={args.steps}",
         f"train.eval_every={args.eval_every}",
         f"train.log_every={args.eval_every}",
+        f"train.warmup_steps={warmup}",
+        f"train.ema_decay={args.ema_decay}" if not args.smoke else
+        "train.ema_decay=0.0",
         "data.loader=hbm",
         "data.batch_size=32",
         "eval.batch_size=64",
@@ -221,7 +235,9 @@ def main(argv=None) -> dict:
             "loader": "hbm", "batch_size": 32, "steps": args.steps,
             "eval_every": args.eval_every, "train_n": args.train_n,
             "seed": args.seed, "ensemble_parallel": True,
-            "ema": cfg.train.ema_decay > 0, "tta": cfg.eval.tta,
+            "warmup_steps": warmup, "ema_decay": cfg.train.ema_decay,
+            "label_smoothing": cfg.train.label_smoothing,
+            "tta": cfg.eval.tta,
         },
         "device": jax.devices()[0].device_kind,
         "workdir": workdir,
