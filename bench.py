@@ -158,6 +158,37 @@ def _flops_of(fn, *args) -> "float | None":
     return physics.flops_from_cost_analysis(compiled)
 
 
+def build_train_fixture(cfg, mesh, batch_size: int):
+    """(step, state, batches, key) for a device-only train measurement —
+    THE fixture both this bench's device_only/b128 sections and
+    scripts/stem_experiments.py time, so variant rows stay comparable
+    to the headline by construction, not by copy-paste."""
+    import jax
+    import numpy as np
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    size = cfg.model.image_size
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    rng = np.random.default_rng(0)
+    batches = [
+        mesh_lib.shard_batch(
+            {
+                "image": rng.integers(
+                    0, 256, (batch_size, size, size, 3), np.uint8),
+                "grade": rng.integers(0, 5, (batch_size,), np.int32),
+            },
+            mesh,
+        )
+        for _ in range(N_DISTINCT_BATCHES)
+    ]
+    return step, state, batches, jax.random.key(1)
+
+
 def _publish(extras: dict, key: str, rate: float,
              flops_per_image: "float | None", peak: float,
              suffix: str = "") -> "float | None":
@@ -357,23 +388,12 @@ def main() -> None:
     _log(f"{n_dev} device(s), batch {batch_size}, {size}px, "
          f"use_pallas={cfg.data.use_pallas}")
 
+    step, state, batches, key = build_train_fixture(cfg, mesh, batch_size)
+    # Later sections (eval step, b128, ensemble) still need the module
+    # definition and a pixel source; contents of random eval pixels are
+    # timing-irrelevant, so a fresh stream is fine.
     model = models.build(cfg.model)
-    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
-    state = jax.device_put(state, mesh_lib.replicated(mesh))
-    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
-
-    rng = np.random.default_rng(0)
-    batches = [
-        mesh_lib.shard_batch(
-            {
-                "image": rng.integers(0, 256, (batch_size, size, size, 3), np.uint8),
-                "grade": rng.integers(0, 5, (batch_size,), np.int32),
-            },
-            mesh,
-        )
-        for _ in range(N_DISTINCT_BATCHES)
-    ]
-    key = jax.random.key(1)
+    rng = np.random.default_rng(7)
 
     # FLOPs/image of the compiled train step — the physics guard's
     # numerator for every train-style section (per-IMAGE cost is batch-

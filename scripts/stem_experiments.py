@@ -30,14 +30,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np  # noqa: E402
-
 
 def main() -> None:
     import bench  # repo-root bench.py: the shared fenced harness
     import jax
 
-    from jama16_retina_tpu import models, train_lib
     from jama16_retina_tpu.configs import get_config, override
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
@@ -53,33 +50,21 @@ def main() -> None:
         ("s2d", ["model.stem_s2d=true"], 32),
         ("remat", ["model.remat_stem=true"], 32),
         ("s2d+remat", ["model.stem_s2d=true", "model.remat_stem=true"], 32),
+        # Diagnostic, not a candidate: how much of the bound is the
+        # augment stage's full-res elementwise traffic.
+        ("no_augment", ["data.augment=false"], 32),
         ("s2d_b128", ["model.stem_s2d=true"], 128),
     ]
     rows = []
-    rng = np.random.default_rng(0)
     for name, sets, batch_size in variants:
         cfg = override(get_config("eyepacs_binary"),
                        sets + [f"data.batch_size={batch_size}"])
-        size = cfg.model.image_size
-        model = models.build(cfg.model)
-        state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
-        state = jax.device_put(state, mesh_lib.replicated(mesh))
-        step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
-        batches = [
-            mesh_lib.shard_batch(
-                {
-                    "image": rng.integers(
-                        0, 256, (batch_size, size, size, 3), np.uint8),
-                    "grade": rng.integers(0, 5, (batch_size,), np.int32),
-                },
-                mesh,
-            )
-            for _ in range(bench.N_DISTINCT_BATCHES)
-        ]
-        key = jax.random.key(1)
+        t0 = time.time()  # before _flops_of: that is where AOT compiles
+        step, state, batches, key = bench.build_train_fixture(
+            cfg, mesh, batch_size
+        )
         flops = bench._flops_of(step, state, batches[0], key)
         fpi = flops / batch_size if flops else None
-        t0 = time.time()
         rate, _ = bench._timed_steps(
             step, state, lambda i: batches[i % bench.N_DISTINCT_BATCHES],
             key, bench.TIMED_STEPS, batch_size, n_dev,
