@@ -18,6 +18,20 @@ import dataclasses
 import numpy as np
 
 
+# make_dataset's default grade marginals [0.55, 0.15, 0.15, 0.08, 0.07];
+# P(referable) = P(grade >= 2). Callers publishing noisy_auc_ceiling
+# (scripts/time_to_auc.py) read this instead of re-deriving it so the
+# published ceiling cannot drift from the data actually written.
+GRADE_MARGINALS = (0.55, 0.15, 0.15, 0.08, 0.07)
+REFERABLE_PREVALENCE = float(sum(GRADE_MARGINALS[2:]))
+
+# Stream-key suffix deriving a split's label-flip rng from its seed
+# (np.random.default_rng([seed, FLIP_STREAM_KEY])) — independent of the
+# render stream, shared by tfrecord.write_synthetic_split and any caller
+# regenerating the flipped labels from the seed alone.
+FLIP_STREAM_KEY = 0x0F11
+
+
 @dataclasses.dataclass(frozen=True)
 class SynthConfig:
     image_size: int = 299
@@ -115,7 +129,7 @@ def make_dataset(
     cfg = cfg or SynthConfig()
     rng = np.random.default_rng(seed)
     if grades is None:
-        grades = rng.choice(5, size=n, p=[0.55, 0.15, 0.15, 0.08, 0.07])
+        grades = sample_grades(n, rng)
     grades = np.asarray(grades, dtype=np.int32)
     images = np.stack([render_fundus(rng, int(g), cfg) for g in grades])
     return images, grades
@@ -125,3 +139,76 @@ def binary_labels(grades: np.ndarray) -> np.ndarray:
     """ICDR grade -> binary referable-DR label (grade >= 2 referable),
     the reference's grade binning (SURVEY.md R3, BASELINE.json:7)."""
     return (np.asarray(grades) >= 2).astype(np.int32)
+
+
+def sample_grades(n: int, rng: np.random.Generator) -> np.ndarray:
+    """The grade draw make_dataset performs FIRST on its rng — exposed so
+    callers can reproduce a split's grades from its seed without paying
+    for image rendering (scripts/time_to_auc.py regenerates the val
+    grades this way to compute the realized noisy-AUC ceiling)."""
+    return rng.choice(5, size=n, p=list(GRADE_MARGINALS))
+
+
+def flip_binary_labels(
+    grades: np.ndarray, p: float, rng: np.random.Generator
+) -> np.ndarray:
+    """Symmetric label noise across the referable boundary.
+
+    With probability ``p`` per image, move the STORED grade to the other
+    side of the binary boundary (referable -> 1, non-referable -> 2) so
+    the binary label flips while the image still renders its true grade.
+    This is the fixture's difficulty control: the clean lesion-count
+    task is perfectly separable (measured AUC saturates at 1.0), so a
+    crossing of any sub-1.0 target says nothing about how close to
+    optimal the recipe is. Noisy labels cap the MEASURED val/test AUC at
+    ``noisy_auc_ceiling(p, prevalence)`` — a target near that ceiling is
+    only crossable by a near-Bayes-optimal model.
+    """
+    grades = np.asarray(grades, dtype=np.int32).copy()
+    flip = rng.random(grades.shape[0]) < p
+    pos = grades >= 2
+    grades[flip & pos] = 1
+    grades[flip & ~pos] = 2
+    return grades
+
+
+def noisy_auc_ceiling(p: float, prevalence: float) -> float:
+    """Max AUC measurable against labels flipped with probability ``p``.
+
+    A perfect scorer ranks every true-positive image above every true
+    negative and cannot order images within a true class (flips are
+    label-only and independent of the image). With
+    ``a = P(true+ | noisy+)`` and ``b = P(true+ | noisy-)`` (Bayes on
+    flip rate ``p`` and true prevalence ``prevalence``), a
+    noisy-positive/noisy-negative pair is correctly ordered when the
+    noisy+ is truly positive and the noisy- truly negative, and is a
+    coin flip when both fall in the same true class:
+
+        AUC_max = a(1-b) + 0.5 * (a*b + (1-a)(1-b))
+
+    Pinned against a Monte-Carlo estimate in tests/test_synthetic.py.
+    """
+    q = prevalence
+    a = (1 - p) * q / ((1 - p) * q + p * (1 - q))
+    b = p * q / (p * q + (1 - p) * (1 - q))
+    return a * (1 - b) + 0.5 * (a * b + (1 - a) * (1 - b))
+
+
+def realized_noisy_auc_ceiling(
+    true_y: np.ndarray, noisy_y: np.ndarray
+) -> float:
+    """Exact max AUC measurable on THIS finite label draw (the analytic
+    ceiling's population quantities replaced by the realized counts —
+    on a 256-image val split the two can differ by ~0.01, enough to
+    flip whether a near-ceiling target is crossable at all)."""
+    true_y = np.asarray(true_y).astype(bool)
+    noisy_y = np.asarray(noisy_y).astype(bool)
+    pp = float(np.sum(noisy_y & true_y))    # noisy+, true+
+    pn = float(np.sum(noisy_y & ~true_y))   # noisy+, true-
+    np_ = float(np.sum(~noisy_y & true_y))  # noisy-, true+
+    nn = float(np.sum(~noisy_y & ~true_y))  # noisy-, true-
+    pos, neg = pp + pn, np_ + nn
+    if pos == 0 or neg == 0:
+        raise ValueError("need at least one noisy-positive and one "
+                         "noisy-negative label")
+    return (pp * nn + 0.5 * (pp * np_ + pn * nn)) / (pos * neg)

@@ -156,15 +156,28 @@ def write_synthetic_split(
     num_shards: int = 4,
     seed: int = 0,
     encoding: str = "jpeg",
+    label_noise: float = 0.0,
 ) -> list[str]:
     """Test/bench fixture: synthetic fundus images -> real TFRecord shards,
     so the whole online pipeline is exercised byte-identically to how it
-    would run on preprocessed EyePACS (SURVEY.md §4 fixtures)."""
+    would run on preprocessed EyePACS (SURVEY.md §4 fixtures).
+
+    ``label_noise`` flips each stored grade across the referable
+    boundary with that probability (image still rendered from the true
+    grade) — see synthetic.flip_binary_labels for why this is the
+    fixture's difficulty control. The flip stream is derived from
+    ``seed`` independently of the render stream, so the same seed with
+    and without noise yields byte-identical images."""
     from jama16_retina_tpu.data import synthetic
 
     images, grades = synthetic.make_dataset(
         n, synthetic.SynthConfig(image_size=image_size), seed=seed
     )
+    if label_noise:
+        grades = synthetic.flip_binary_labels(
+            grades, label_noise,
+            np.random.default_rng([seed, synthetic.FLIP_STREAM_KEY]),
+        )
 
     def gen() -> Iterator:
         for i in range(n):

@@ -62,6 +62,15 @@ def parse_args(argv=None):
     p.add_argument("--train_n", type=int, default=1024)
     p.add_argument("--val_n", type=int, default=256)
     p.add_argument("--test_n", type=int, default=512)
+    p.add_argument(
+        "--label_noise", type=float, default=0.0,
+        help="flip each stored label across the referable boundary with "
+        "this probability (all splits). The clean task saturates at AUC "
+        "1.0, so crossing 0.97 bounds only throughput; with noise the "
+        "measured-AUC ceiling is analytic (synthetic.noisy_auc_ceiling, "
+        "published in the artifact) and a target near it is crossable "
+        "only by a near-Bayes-optimal model.",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bootstrap", type=int, default=2000)
     p.add_argument(
@@ -81,6 +90,11 @@ def _log(msg: str) -> None:
     print(f"time_to_auc: {msg}", file=sys.stderr)
 
 
+# Per-split fixture seeds — shared by the writer loop and the realized-
+# ceiling computation, which regenerates the val grades from the seed.
+SPLIT_SEEDS = {"train": 11, "val": 12, "test": 13}
+
+
 def main(argv=None) -> dict:
     args = parse_args(argv)
     from jama16_retina_tpu import trainer
@@ -89,6 +103,57 @@ def main(argv=None) -> dict:
     from jama16_retina_tpu.parallel import mesh as mesh_lib
     from jama16_retina_tpu.utils import checkpoint as ckpt_lib
     from jama16_retina_tpu.utils.logging import read_jsonl
+
+    ceiling = val_ceiling = None
+    if args.label_noise:
+        import numpy as np
+
+        from jama16_retina_tpu.data import synthetic
+
+        if not 0.0 <= args.label_noise <= 1.0:
+            raise SystemExit(
+                f"--label_noise {args.label_noise} is not a probability"
+            )
+        ceiling = round(
+            synthetic.noisy_auc_ceiling(
+                args.label_noise, synthetic.REFERABLE_PREVALENCE
+            ),
+            5,
+        )
+        # The gate uses the REALIZED ceiling on the exact val labels this
+        # run will score against, not the asymptotic formula — on a
+        # 256-image split the two differ by up to ~0.01, enough to admit
+        # a run that can never cross. Grades are the FIRST draw on the
+        # split seed and the flip stream is seed-derived
+        # (synthetic.FLIP_STREAM_KEY), so both regenerate exactly
+        # without rendering a single image.
+        vs = SPLIT_SEEDS["val"]
+        val_true = synthetic.sample_grades(
+            args.val_n, np.random.default_rng(vs)
+        )
+        val_noisy = synthetic.flip_binary_labels(
+            val_true, args.label_noise,
+            np.random.default_rng([vs, synthetic.FLIP_STREAM_KEY]),
+        )
+        val_ceiling = round(
+            synthetic.realized_noisy_auc_ceiling(
+                val_true >= 2, val_noisy >= 2
+            ),
+            5,
+        )
+        if val_ceiling < args.target:
+            # Checked BEFORE training: a target above the measured-AUC
+            # ceiling can never cross, and discovering that after the
+            # full TPU run would waste it.
+            raise SystemExit(
+                f"--target {args.target} exceeds the realized val "
+                f"measured-AUC ceiling {val_ceiling} (analytic "
+                f"{ceiling}) implied by --label_noise "
+                f"{args.label_noise} — the run could never cross"
+            )
+        _log(f"label_noise={args.label_noise}: measured-AUC ceiling "
+             f"{val_ceiling} realized on the {args.val_n}-image val "
+             f"split ({ceiling} analytic; target {args.target})")
 
     mesh_lib.initialize_distributed()
     # Same persistent-compile-cache home as bench.py: the stacked step's
@@ -106,6 +171,8 @@ def main(argv=None) -> dict:
     # -- synthetic data (reused across runs: rendering 299px fundus
     # images is host-CPU work that has nothing to do with the metric) --
     geom = f"{preset}_{image_size}_{args.train_n}_{args.val_n}_{args.test_n}"
+    if args.label_noise:
+        geom += f"_noise{args.label_noise:g}"
     data_dir = args.data_dir or os.path.join(
         tempfile.gettempdir(), f"time_to_auc_{geom}"
     )
@@ -129,12 +196,12 @@ def main(argv=None) -> dict:
         _log(f"rendering synthetic splits into {data_dir} ...")
         # raw encoding: the hbm loader's one-time host decode is then a
         # proto parse, not a JPEG decode (bench: 2722 vs 1847 img/s).
-        for split, n, seed in (("train", args.train_n, 11),
-                               ("val", args.val_n, 12),
-                               ("test", args.test_n, 13)):
+        for split, n, seed in (("train", args.train_n, SPLIT_SEEDS["train"]),
+                               ("val", args.val_n, SPLIT_SEEDS["val"]),
+                               ("test", args.test_n, SPLIT_SEEDS["test"])):
             tfrecord.write_synthetic_split(
                 data_dir, split, n, image_size, max(1, n // 256),
-                seed=seed, encoding="raw",
+                seed=seed, encoding="raw", label_noise=args.label_noise,
             )
         with open(done_path, "w") as f:
             f.write(geom)
@@ -222,6 +289,9 @@ def main(argv=None) -> dict:
     out = {
         "metric": "wall_sec_to_val_auc_target",
         "target_auc": args.target,
+        "label_noise": args.label_noise,
+        "measured_auc_ceiling_analytic": ceiling,
+        "measured_auc_ceiling_val_realized": val_ceiling,
         "value": ens_cross["wall_sec"] if ens_cross else None,
         "unit": "seconds (trainer start -> first ensemble-val crossing, "
                 "compile + hbm load included; see breakdown)",

@@ -44,3 +44,93 @@ def test_binary_labels():
     np.testing.assert_array_equal(
         synthetic.binary_labels(np.array([0, 1, 2, 3, 4])), [0, 0, 1, 1, 1]
     )
+
+
+def test_flip_binary_labels_rate_and_boundary():
+    grades = synthetic.sample_grades(20_000, np.random.default_rng(0))
+    flipped = synthetic.flip_binary_labels(
+        grades, 0.1, np.random.default_rng(1)
+    )
+    y, y_noisy = synthetic.binary_labels(grades), synthetic.binary_labels(flipped)
+    rate = (y != y_noisy).mean()
+    assert 0.08 < rate < 0.12  # ~p of labels flipped
+    # flips land exactly one grade across the boundary; unflipped
+    # grades are untouched
+    assert set(np.unique(flipped[y != y_noisy])) <= {1, 2}
+    np.testing.assert_array_equal(grades[y == y_noisy], flipped[y == y_noisy])
+    # p=0 is the identity
+    np.testing.assert_array_equal(
+        synthetic.flip_binary_labels(grades, 0.0, np.random.default_rng(2)),
+        grades,
+    )
+
+
+def test_noisy_auc_ceiling_matches_monte_carlo():
+    """The analytic ceiling (published in the time_to_auc artifact) must
+    match a direct simulation: score = true label + tiny within-class
+    jitter (a perfect scorer), AUC measured against flipped labels."""
+    from sklearn.metrics import roc_auc_score
+
+    p, q, n = 0.05, 0.30, 200_000
+    rng = np.random.default_rng(0)
+    truth = (rng.random(n) < q).astype(np.int32)
+    noisy = truth ^ (rng.random(n) < p)
+    score = truth + rng.random(n) * 1e-3  # perfect ranking, no exact ties
+    mc = roc_auc_score(noisy, score)
+    assert abs(synthetic.noisy_auc_ceiling(p, q) - mc) < 0.003
+    # clean labels -> perfect AUC
+    assert synthetic.noisy_auc_ceiling(0.0, q) == 1.0
+
+
+def test_write_synthetic_split_label_noise(tmp_path):
+    from jama16_retina_tpu.data import tfrecord
+    from jama16_retina_tpu.data.grain_pipeline import FundusSource
+
+    d = str(tmp_path)
+    tfrecord.write_synthetic_split(
+        d, "clean", 64, image_size=32, num_shards=1, seed=5, encoding="raw"
+    )
+    tfrecord.write_synthetic_split(
+        d, "noisy", 64, image_size=32, num_shards=1, seed=5, encoding="raw",
+        label_noise=0.25,
+    )
+    clean = FundusSource(d, "clean", 32)
+    noisy = FundusSource(d, "noisy", 32)
+    n_flip = 0
+    for i in range(64):
+        c, n = clean[i], noisy[i]
+        np.testing.assert_array_equal(c["image"], n["image"])
+        if (c["grade"] >= 2) != (n["grade"] >= 2):
+            n_flip += 1
+    assert 0 < n_flip < 64
+
+
+def test_sample_grades_is_make_datasets_first_draw():
+    """The realized-ceiling path (scripts/time_to_auc.py) reproduces a
+    split's grades from its seed via sample_grades — which must stay the
+    FIRST draw make_dataset performs, or the gate silently computes the
+    ceiling for different labels than the written split's."""
+    _, grades = synthetic.make_dataset(
+        32, synthetic.SynthConfig(image_size=32), seed=12
+    )
+    np.testing.assert_array_equal(
+        grades, synthetic.sample_grades(32, np.random.default_rng(12))
+    )
+
+
+def test_realized_ceiling_converges_to_analytic():
+    p, n = 0.05, 300_000
+    true = synthetic.sample_grades(n, np.random.default_rng(0))
+    noisy = synthetic.flip_binary_labels(
+        true, p, np.random.default_rng([0, synthetic.FLIP_STREAM_KEY])
+    )
+    realized = synthetic.realized_noisy_auc_ceiling(true >= 2, noisy >= 2)
+    analytic = synthetic.noisy_auc_ceiling(p, synthetic.REFERABLE_PREVALENCE)
+    assert abs(realized - analytic) < 0.002
+    # degenerate split refuses loudly
+    import pytest
+
+    with pytest.raises(ValueError):
+        synthetic.realized_noisy_auc_ceiling(
+            np.ones(4, bool), np.ones(4, bool)
+        )
