@@ -117,6 +117,16 @@ class TrainConfig:
     checkpoint_dir: str = "/tmp/retina_ckpt"
     max_to_keep: int = 3
     resume: bool = False
+    # Checkpoint every Nth eval (plus ALWAYS the final/early-stop eval,
+    # so the run ends durable). 1 = the reference's save-every-eval
+    # semantics. Raising it trades resume granularity and best-
+    # checkpoint resolution (best is picked among SAVED evals) for eval
+    # cadence: each save fetches the full train state device->host,
+    # which is the dominant per-eval cost when the state is large or
+    # the link is slow (measured: a k=4 stacked Inception state is
+    # 1.56 GB ~= 48 s/eval on this environment's tunnel, >10x the eval
+    # forward itself — docs/PERF.md §Eval).
+    save_every_evals: int = 1
     # loss-scale epsilon for label smoothing on the multi head
     label_smoothing: float = 0.0
     gradient_clip_norm: float = 0.0  # 0 disables
@@ -145,8 +155,10 @@ class TrainConfig:
     # train.seed); diversity comes from per-member init/augmentation/
     # dropout keys (seed + m, matching the sequential driver's seeds).
     # Checkpoint layout is identical to the sequential driver's member_NN
-    # dirs. Flax path, single process only (covers a one-host v3-8 slice;
-    # multi-HOST runs are refused loudly — use the sequential driver).
+    # dirs. Flax path; multi-host runs place each host's batch shard
+    # with make_array_from_process_local_data and reshard member-sharded
+    # state to replicated before host gathers (docs/MULTIHOST.md;
+    # pinned 2-process vs single-process in tests/test_multiprocess.py).
     ensemble_parallel: bool = False
     # Profiling (SURVEY.md §5.1): if > 0, capture a jax.profiler trace of
     # this many steps (starting at step 10) into <workdir>/profile —

@@ -330,12 +330,15 @@ def _reconstruct_best_tracking(
     live loop applies, so a resumed run stops exactly when an
     uninterrupted one would (the best manager's raw argmax is NOT
     equivalent: sub-min_delta improvements enter its top-k without
-    resetting patience). Replays every eval record at step <= start_step
-    in file order, which also chains across repeated interruptions; a
-    reused workdir whose old evals share step numbers yields
-    conservative (never lost) tracking. Fallback when no JSONL survives:
-    the best manager's retained peak, with patience derived from the
-    eval cadence."""
+    resetting patience). Replays the FIRST eval record per step at
+    step <= start_step in file order, which chains across repeated
+    interruptions: under sparse saves (train.save_every_evals) a crash
+    after an unsaved eval makes the resumed run re-run and re-log that
+    eval, so duplicates at one step are legitimate — and deterministic
+    replay makes them identical, so first-per-step keeps the patience
+    count exact (counting both would double-increment since_best).
+    Fallback when no JSONL survives: the best manager's retained peak,
+    with patience derived from the eval cadence."""
     from jama16_retina_tpu.utils.logging import read_jsonl
 
     k = len(ckpts)
@@ -352,6 +355,14 @@ def _reconstruct_best_tracking(
                 evals.append((r["step"], r["val_auc_per_member"]))
             elif "val_auc" in r and k == 1:
                 evals.append((r["step"], [r["val_auc"]]))
+    # One replay per STEP: under sparse saves (train.save_every_evals) a
+    # crash after an unsaved eval makes the resumed run re-run and
+    # re-log that eval, so the file legitimately holds duplicate records
+    # at one step. Deterministic replay makes the duplicates identical;
+    # counting them twice would double-increment since_best and fire
+    # early stopping before the configured patience.
+    seen: set[int] = set()
+    evals = [(s, a) for s, a in evals if not (s in seen or seen.add(s))]
     if evals:
         for step, aucs in evals:
             best_auc, best_step, since_best = _best_tracking_update(
@@ -528,23 +539,45 @@ def _aot_with_ceiling(cfg, mesh, clock, log, start_step, step_fn, *args):
     return compiled
 
 
+def _save_due(cfg: ExperimentConfig, step: int) -> bool:
+    """Is this eval's checkpoint due under train.save_every_evals?
+
+    Phase derives from the step ordinal (step // eval_every), not a
+    loop-local counter, so resume keeps the same save cadence. The final
+    step is always due (the run must end durable); so is a stopping
+    eval (forced inside _eval_and_track / the member-parallel block)."""
+    if step >= cfg.train.steps:
+        return True
+    n = max(1, cfg.train.save_every_evals)
+    return (step // cfg.train.eval_every) % n == 0
+
+
 def _eval_and_track(
     cfg: ExperimentConfig, log: RunLog, ckpt, step: int,
     predict_fn, state_for_save,
     best_auc: float, best_step: int, since_best: int,
-) -> tuple[float, int, int, bool]:
+    save_due: bool = True,
+) -> tuple[float, int, int, bool, bool]:
     """The per-eval-interval block shared by every backend's train loop:
     val predict -> referable-DR AUC (the 5-class head collapses to
-    P(grade>=2); SURVEY.md N11) -> checkpoint -> best/min_delta tracking
-    -> early-stop decision. One copy so the backends cannot
-    desynchronize on the early-stopping rule or the eval JSONL shape."""
+    P(grade>=2); SURVEY.md N11) -> best/min_delta tracking -> early-stop
+    decision -> checkpoint. One copy so the backends cannot
+    desynchronize on the early-stopping rule or the eval JSONL shape.
+
+    ``state_for_save`` is a ZERO-ARG CALLABLE, invoked only when the
+    save actually happens: materializing the state (a full device->host
+    fetch on the jax path) is the dominant per-eval cost when saves are
+    sparse (train.save_every_evals). ``save_due`` gates the periodic
+    save; a stopping eval ALWAYS saves so the run ends durable. The
+    eval record is logged BEFORE the save so time-to-target artifacts
+    timestamp the moment the AUC was known, not the fetch behind it.
+    Returns (..., stop, saved)."""
     grades, probs = predict_fn()
     bin_probs = (
         probs if cfg.model.head == "binary"
         else metrics.referable_probs_from_multiclass(probs)
     )
     auc = metrics.roc_auc((grades >= 2).astype(np.float64), bin_probs)
-    ckpt.save(step, state_for_save, {"val_auc": auc})
     b_auc, b_step, since = _best_tracking_update(
         auc, best_auc, best_step, since_best, step, cfg.train.min_delta
     )
@@ -555,9 +588,12 @@ def _eval_and_track(
     log.write("eval", step=step, val_auc=float(auc),
               best_auc=round(best_auc, 5), since_best=since_best)
     stop = since_best >= cfg.train.early_stop_patience
+    saved = save_due or stop
+    if saved:
+        ckpt.save(step, state_for_save(), {"val_auc": auc})
     if stop:
         log.write("early_stop", step=step, best_step=best_step)
-    return best_auc, best_step, since_best, stop
+    return best_auc, best_step, since_best, stop, saved
 
 
 def _run_meta_path(workdir: str) -> str:
@@ -695,17 +731,19 @@ def fit(
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
                 clock.pause()
-                best_auc, best_step, since_best, stop = _eval_and_track(
+                best_auc, best_step, since_best, stop, saved = _eval_and_track(
                     cfg, log, ckpt, step_i + 1,
                     lambda: predict_split(
                         cfg, model, state, data_dir, "val", mesh,
                         eval_step=eval_step,
                     )[:2],
-                    jax.device_get(state),
+                    lambda: jax.device_get(state),
                     best_auc, best_step, since_best,
+                    save_due=_save_due(cfg, step_i + 1),
                 )
-                _persist_grain_state(grain_tee, workdir, step_i + 1,
-                                     kept_steps=ckpt.all_steps())
+                if saved:
+                    _persist_grain_state(grain_tee, workdir, step_i + 1,
+                                         kept_steps=ckpt.all_steps())
                 clock.resume()
                 if stop:
                     stopped_early = True
@@ -759,7 +797,7 @@ def fit_ensemble(
 
 def _predict_split_members(
     cfg: ExperimentConfig, state, data_dir: str, split: str,
-    mesh, eval_step,
+    mesh, eval_step, cache: list | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """predict_split for a STACKED ensemble state: one vmapped forward
     scores all k members per batch -> (grades [n], probs [k, n(, C)]).
@@ -768,7 +806,21 @@ def _predict_split_members(
     slices each device's shard — the ('member','data') layout's data
     columns interleave across processes, so neither the 1-D process-major
     block contract of eval_batches' local rows nor eval.sharded's decode
-    sharding applies here (the flag is ignored, loudly)."""
+    sharding applies here (the flag is ignored, loudly).
+
+    ``cache``: pass the same list across repeated evals of one split to
+    keep its batches DEVICE-resident between them (the hbm-loader
+    residency philosophy applied to eval): the first call fills it with
+    (dev_batch, kept_grades, keep) tuples, later calls skip the host
+    re-parse and re-upload entirely (the val split re-upload is ~2-3 s
+    per eval on this environment's link — docs/PERF.md §Eval)."""
+    if cache:
+        grades_all, probs_all = [], []
+        for dev_batch, kept_grades, keep in cache:
+            probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
+            grades_all.append(kept_grades)
+            probs_all.append(probs[:, keep])
+        return np.concatenate(grades_all), np.concatenate(probs_all, axis=1)
     if cfg.eval.sharded and jax.process_count() > 1:
         absl_logging.warning(
             "eval.sharded has no effect on the member-parallel driver's "
@@ -790,6 +842,8 @@ def _predict_split_members(
         keep = batch["mask"] > 0
         grades_all.append(batch["grade"][keep])
         probs_all.append(probs[:, keep])
+        if cache is not None:
+            cache.append((dev_batch, batch["grade"][keep], keep))
     return np.concatenate(grades_all), np.concatenate(probs_all, axis=1)
 
 
@@ -883,6 +937,27 @@ def fit_ensemble_parallel(
         cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
     )
     eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
+    # Under the hbm loader the val split stays device-resident between
+    # evals too (same residency philosophy; the cache is filled on the
+    # first eval) — but only after the SAME budget discipline the loader
+    # applies to the train split: the cache must not be the one HBM
+    # tenant that never asked (uint8 rows vs 10% of the budget; the
+    # train split's own gate allows up to 60%, and the stacked train
+    # state needs the rest). Streamed loaders keep the per-eval re-read.
+    val_cache = None
+    if cfg.data.loader == "hbm":
+        from jama16_retina_tpu.data import hbm_pipeline, tfrecord
+
+        n_val = tfrecord.count_records(tfrecord.list_split(data_dir, "val"))
+        val_bytes = n_val * cfg.model.image_size ** 2 * 3
+        if val_bytes <= 0.1 * hbm_pipeline.hbm_budget_bytes():
+            val_cache = []
+        else:
+            absl_logging.warning(
+                "val split (%d images, %.1f MB) exceeds 10%% of the HBM "
+                "budget; evals stream from host instead of caching "
+                "device-resident", n_val, val_bytes / 1e6,
+            )
     # Checkpoint/host gathers: on multi-host, reshard member-sharded ->
     # replicated first (an all-gather riding ICI) — device_get is only
     # legal for fully-addressable arrays there. Single-process the state
@@ -913,12 +988,14 @@ def fit_ensemble_parallel(
     if cfg.train.resume:
         latest = [c.latest_step for c in ckpts]
         if any(s is not None for s in latest):
-            # This driver checkpoints every member at every eval step, so
-            # an intact member-parallel workdir has all members at ONE
-            # step. Differing steps mean either a sequential-run workdir
-            # OR a save torn by a crash between the per-member save()
-            # calls — recover by rolling every member back to the newest
-            # step they ALL still have (best/ retention often keeps it).
+            # This driver checkpoints every member in lock-step at each
+            # save-due eval (train.save_every_evals; skipped evals save
+            # no member), so an intact member-parallel workdir has all
+            # members at ONE step. Differing steps mean either a
+            # sequential-run workdir OR a save torn by a crash between
+            # the per-member save() calls — recover by rolling every
+            # member back to the newest step they ALL still have
+            # (best/ retention often keeps it).
             if None in latest or len(set(latest)) != 1:
                 if not was_member_parallel:
                     # Members at different steps in a workdir this
@@ -1047,7 +1124,8 @@ def fit_ensemble_parallel(
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
                 clock.pause()
                 grades, probs = _predict_split_members(
-                    cfg, state, data_dir, "val", mesh, eval_step
+                    cfg, state, data_dir, "val", mesh, eval_step,
+                    cache=val_cache,
                 )
                 bin_labels = (grades >= 2).astype(np.float64)
                 member_probs = [
@@ -1061,31 +1139,42 @@ def fit_ensemble_parallel(
                 ens_auc = metrics.roc_auc(
                     bin_labels, metrics.ensemble_average(member_probs)
                 )
-                host_state = jax.device_get(gather_state(state))
-                for m in range(k):
-                    ckpts[m].save(
-                        step_i + 1,
-                        train_lib.unstack_member(host_state, m),
-                        {"val_auc": float(aucs[m])},
-                    )
-                _persist_grain_state(
-                    grain_tee, workdir, step_i + 1,
-                    kept_steps=set.union(*[c.all_steps() for c in ckpts]),
-                )
                 best_auc, best_step, since_best = _best_tracking_update(
                     aucs, best_auc, best_step, since_best, step_i + 1,
                     cfg.train.min_delta,
                 )
                 # Full precision on val_auc_per_member — the resume
-                # replay source (same note as _eval_and_track).
+                # replay source (same note as _eval_and_track). Logged
+                # BEFORE the checkpoint fetch so time-to-target
+                # artifacts timestamp when the AUC was known.
                 log.write(
                     "eval", step=step_i + 1,
                     val_auc_per_member=[float(a) for a in aucs],
                     ensemble_val_auc=round(float(ens_auc), 5),
                     best_auc_per_member=[round(float(a), 5) for a in best_auc],
                 )
+                stopping = bool(
+                    np.all(since_best >= cfg.train.early_stop_patience)
+                )
+                if _save_due(cfg, step_i + 1) or stopping:
+                    # The dominant per-eval cost when saves are due: the
+                    # stacked state is k full train states (1.56 GB at
+                    # k=4 flagship scale) fetched device->host here —
+                    # train.save_every_evals spaces these out
+                    # (docs/PERF.md §Eval).
+                    host_state = jax.device_get(gather_state(state))
+                    for m in range(k):
+                        ckpts[m].save(
+                            step_i + 1,
+                            train_lib.unstack_member(host_state, m),
+                            {"val_auc": float(aucs[m])},
+                        )
+                    _persist_grain_state(
+                        grain_tee, workdir, step_i + 1,
+                        kept_steps=set.union(*[c.all_steps() for c in ckpts]),
+                    )
                 clock.resume()
-                if np.all(since_best >= cfg.train.early_stop_patience):
+                if stopping:
                     log.write("early_stop", step=step_i + 1,
                               best_step=[int(s) for s in best_step])
                     stopped_early = True
@@ -1294,17 +1383,21 @@ def fit_tf(
 
         if (step_i + 1) % tc.eval_every == 0 or step_i + 1 == tc.steps:
             clock.pause()
-            params, batch_stats = transplant.transplant_from_keras(
-                keras_model, state0.params, state0.batch_stats
-            )
-            best_auc, best_step, since_best, stop = _eval_and_track(
+            def _tf_state_for_save(step_now=step_i + 1):
+                params, batch_stats = transplant.transplant_from_keras(
+                    keras_model, state0.params, state0.batch_stats
+                )
+                return state0.replace(
+                    step=np.asarray(step_now, np.int32),
+                    params=params, batch_stats=batch_stats,
+                )
+
+            best_auc, best_step, since_best, stop, _ = _eval_and_track(
                 cfg, log, ckpt, step_i + 1,
                 lambda: predict_split_tf(cfg, keras_model, data_dir, "val")[:2],
-                state0.replace(
-                    step=np.asarray(step_i + 1, np.int32),
-                    params=params, batch_stats=batch_stats,
-                ),
+                _tf_state_for_save,
                 best_auc, best_step, since_best,
+                save_due=_save_due(cfg, step_i + 1),
             )
             clock.resume()
             if stop:

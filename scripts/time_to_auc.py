@@ -74,6 +74,15 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bootstrap", type=int, default=2000)
     p.add_argument(
+        "--save_every_evals", type=int, default=4,
+        help="checkpoint every Nth eval (train.save_every_evals; the "
+        "final eval always saves). Each save fetches the full stacked "
+        "state device->host (~48 s at k=4 flagship scale on this "
+        "tunnel), >10x the eval itself — and the crossing metric needs "
+        "the AUC, not the checkpoint. Pass 1 for the reference's "
+        "save-every-eval semantics.",
+    )
+    p.add_argument(
         "--data_dir", default="",
         help="reuse/create synthetic TFRecords here (default: a "
         "per-geometry dir under $TMPDIR, reused across runs)",
@@ -225,6 +234,7 @@ def main(argv=None) -> dict:
         # Patience in UNITS OF EVALS; keep the run bounded but give the
         # recipe room past the first crossing for the final protocol.
         "train.early_stop_patience=4",
+        f"train.save_every_evals={args.save_every_evals}",
         *overrides,
     ])
 
@@ -313,6 +323,7 @@ def main(argv=None) -> dict:
             "loader": "hbm", "batch_size": 32, "steps": args.steps,
             "eval_every": args.eval_every, "train_n": args.train_n,
             "seed": args.seed, "ensemble_parallel": True,
+            "save_every_evals": args.save_every_evals,
             "warmup_steps": warmup, "ema_decay": cfg.train.ema_decay,
             "label_smoothing": cfg.train.label_smoothing,
             "tta": cfg.eval.tta,

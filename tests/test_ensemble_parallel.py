@@ -296,3 +296,114 @@ def test_ensemble_parallel_recovers_from_torn_save(tmp_path):
             ck.close()
         for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_every_evals_sparse_checkpoints_and_resume(tmp_path):
+    """train.save_every_evals=2: checkpoints land only at every 2nd eval
+    (plus always the final one), eval records still cover every
+    interval, and a resume whose newest save predates the newest EVAL
+    rolls back to the saved step and still reproduces the uninterrupted
+    run exactly (deterministic replay is what makes sparse saves safe)."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 3, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 2, seed=2)
+    base = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.eval_every=10", "data.batch_size=8", "eval.batch_size=8",
+        "train.lr_schedule=constant", "train.save_every_evals=2",
+    ])
+
+    def run(workdir, steps, resume=False):
+        cfg = override(base, [f"train.steps={steps}",
+                              f"train.resume={str(resume).lower()}"])
+        return trainer.fit_ensemble(cfg, data_dir, str(tmp_path / workdir))
+
+    full = run("full", 40)
+    # Saves only where (step // eval_every) is even, plus the final step.
+    for m in range(2):
+        ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(str(tmp_path / "full"), m))
+        assert ck.all_steps() == {20, 40}
+        ck.close()
+    evals = [r["step"] for r in read_jsonl(str(tmp_path / "full" / "metrics.jsonl"))
+             if r.get("kind") == "eval"]
+    assert evals == [10, 20, 30, 40]
+
+    # Interrupt at 20, resume to 40: the resumed leg's eval at 30 is
+    # not save-due, so the resumed run must cross an unsaved eval and
+    # still land exactly on the uninterrupted run.
+    run("split", 20)
+    resumed = run("split", 40, resume=True)
+    assert any(
+        r.get("kind") == "resume" and r["step"] == 20
+        for r in read_jsonl(str(tmp_path / "split" / "metrics.jsonl"))
+    )
+    for m in range(2):
+        ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(str(tmp_path / "split"), m))
+        assert ck.all_steps() == {20, 40}
+        ck.close()
+    finals = {
+        w: [r for r in read_jsonl(str(tmp_path / w / "metrics.jsonl"))
+            if r.get("kind") == "eval" and r["step"] == 40][-1]
+        for w in ("full", "split")
+    }
+    assert (finals["full"]["val_auc_per_member"]
+            == finals["split"]["val_auc_per_member"])
+    assert [r["best_auc"] for r in full] == [r["best_auc"] for r in resumed]
+
+
+def test_predict_split_members_device_cache_matches_streamed(tmp_path):
+    """The device-resident eval cache must be a pure optimization: the
+    cached second call returns bit-identical (grades, probs) to the
+    streamed path, and actually skips the host pipeline (the cache is
+    populated after the first call)."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "val", 20, 64, 2, seed=2)
+    cfg = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "eval.batch_size=8",
+    ])
+    mesh = mesh_lib.make_ensemble_mesh(2, len(jax.devices()))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [0, 1], mesh=mesh)
+    eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
+
+    streamed = trainer._predict_split_members(
+        cfg, state, data_dir, "val", mesh, eval_step, cache=None
+    )
+    cache = []
+    first = trainer._predict_split_members(
+        cfg, state, data_dir, "val", mesh, eval_step, cache=cache
+    )
+    assert cache  # populated by the filling call
+    second = trainer._predict_split_members(
+        cfg, state, data_dir, "val", mesh, eval_step, cache=cache
+    )
+    for a, b in ((streamed, first), (streamed, second)):
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_best_tracking_replay_dedupes_re_logged_evals(tmp_path):
+    """Sparse saves + a crash after an unsaved eval make the resumed run
+    re-log that eval, so metrics.jsonl legitimately holds duplicate
+    records at one step; the resume replay must count each STEP once or
+    since_best double-increments and early stopping fires early."""
+    workdir = str(tmp_path)
+    cfg = override(get_config("smoke"), [
+        "train.ensemble_size=1", "train.early_stop_patience=4",
+        "train.min_delta=0.5",
+    ])
+    ck = ckpt_lib.Checkpointer(os.path.join(workdir, "member_00"))
+    with open(os.path.join(workdir, "metrics.jsonl"), "w") as f:
+        for step in (10, 20, 30, 20, 30):  # 20/30 re-logged after a crash
+            f.write(json.dumps({
+                "kind": "eval", "step": step,
+                "val_auc_per_member": [0.9 if step == 10 else 0.6],
+            }) + "\n")
+    best_auc, best_step, since_best = trainer._reconstruct_best_tracking(
+        workdir, 30, cfg, [ck]
+    )
+    ck.close()
+    assert best_auc[0] == 0.9 and best_step[0] == 10
+    # evals 20 and 30 count ONCE each despite being logged twice
+    assert since_best[0] == 2

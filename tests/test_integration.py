@@ -381,3 +381,38 @@ def test_run_meta_seed_wins_on_resume(smoke_cfg, data_dir, tmp_path):
     assert res["best_step"] >= 8
     with open(os.path.join(w, "run_meta.json")) as f:
         assert json.load(f)["seed"] == 5  # unchanged
+
+
+def test_fit_save_every_evals_gates_checkpoints(smoke_cfg, data_dir, tmp_path):
+    """train.save_every_evals on the single-model loop: evals run at
+    every interval (the JSONL record is the early-stop/resume source),
+    but checkpoints land only at every Nth eval plus the final step —
+    each skipped save skips the full device->host state fetch."""
+    cfg = override(smoke_cfg, [
+        "train.steps=60", "train.eval_every=10", "train.save_every_evals=3",
+    ])
+    workdir = str(tmp_path / "sparse")
+    trainer.fit(cfg, data_dir, workdir, seed=0)
+    evals = [r["step"] for r in read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+             if r.get("kind") == "eval"]
+    assert evals == [10, 20, 30, 40, 50, 60]
+    ck = ckpt_lib.Checkpointer(workdir)
+    # due: (step // 10) % 3 == 0 -> 30, 60; final 60 always due anyway
+    assert ck.all_steps() == {30, 60}
+    ck.close()
+
+
+def test_fit_stopping_eval_saves_even_when_not_due(smoke_cfg, data_dir, tmp_path):
+    """An early-stopping eval must checkpoint even if its ordinal is not
+    save-due — the run has to end durable (best + latest exist)."""
+    cfg = override(smoke_cfg, [
+        "train.steps=60", "train.eval_every=10", "train.save_every_evals=100",
+        "train.early_stop_patience=1", "train.learning_rate=0.0",
+        "train.min_delta=0.5",
+    ])
+    workdir = str(tmp_path / "stop")
+    res = trainer.fit(cfg, data_dir, workdir, seed=0)
+    assert res["stopped_early"]
+    ck = ckpt_lib.Checkpointer(workdir)
+    assert ck.all_steps()  # the stopping eval saved despite save_every_evals
+    ck.close()
