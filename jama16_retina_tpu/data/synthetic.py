@@ -173,9 +173,10 @@ def flip_binary_labels(
 
 
 def noisy_auc_ceiling(p: float, prevalence: float) -> float:
-    """Max AUC measurable against labels flipped with probability ``p``.
+    """EXPECTED AUC of the best noise-blind scorer against labels
+    flipped with probability ``p``.
 
-    A perfect scorer ranks every true-positive image above every true
+    The Bayes scorer ranks every true-positive image above every true
     negative and cannot order images within a true class (flips are
     label-only and independent of the image). With
     ``a = P(true+ | noisy+)`` and ``b = P(true+ | noisy-)`` (Bayes on
@@ -184,9 +185,15 @@ def noisy_auc_ceiling(p: float, prevalence: float) -> float:
     noisy+ is truly positive and the noisy- truly negative, and is a
     coin flip when both fall in the same true class:
 
-        AUC_max = a(1-b) + 0.5 * (a*b + (1-a)(1-b))
+        E[AUC] = a(1-b) + 0.5 * (a*b + (1-a)(1-b))
 
-    Pinned against a Monte-Carlo estimate in tests/test_synthetic.py.
+    This is a ceiling IN EXPECTATION, not almost surely: the
+    within-true-class coin flips make any single measured AUC fluctuate
+    around it (sd ~0.004 on a 512-image split at p=0.01), and
+    best-over-evals selection rides that fluctuation — a near-Bayes
+    model's best-of-run val AUC typically lands ~1 sd ABOVE this value
+    (observed in docs/time_to_auc_noise_r4.json: max 0.9883 vs expected
+    0.9836). Pinned against Monte Carlo in tests/test_synthetic.py.
     """
     q = prevalence
     a = (1 - p) * q / ((1 - p) * q + p * (1 - q))
@@ -197,10 +204,11 @@ def noisy_auc_ceiling(p: float, prevalence: float) -> float:
 def realized_noisy_auc_ceiling(
     true_y: np.ndarray, noisy_y: np.ndarray
 ) -> float:
-    """Exact max AUC measurable on THIS finite label draw (the analytic
-    ceiling's population quantities replaced by the realized counts —
-    on a 256-image val split the two can differ by ~0.01, enough to
-    flip whether a near-ceiling target is crossable at all)."""
+    """noisy_auc_ceiling's expectation computed on THIS finite label
+    draw (population quantities replaced by realized counts — on a
+    256-image val split the two can differ by ~0.01, enough to flip
+    whether a near-ceiling target is crossable at all). Same
+    expectation-not-almost-sure caveat as noisy_auc_ceiling."""
     true_y = np.asarray(true_y).astype(bool)
     noisy_y = np.asarray(noisy_y).astype(bool)
     pp = float(np.sum(noisy_y & true_y))    # noisy+, true+

@@ -67,9 +67,10 @@ def parse_args(argv=None):
         help="flip each stored label across the referable boundary with "
         "this probability (all splits). The clean task saturates at AUC "
         "1.0, so crossing 0.97 bounds only throughput; with noise the "
-        "measured-AUC ceiling is analytic (synthetic.noisy_auc_ceiling, "
-        "published in the artifact) and a target near it is crossable "
-        "only by a near-Bayes-optimal model.",
+        "expected noise-blind Bayes AUC is analytic "
+        "(synthetic.noisy_auc_ceiling, published in the artifact) and "
+        "a target near it is crossable only by a near-Bayes-optimal "
+        "model.",
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bootstrap", type=int, default=2000)
@@ -151,18 +152,20 @@ def main(argv=None) -> dict:
             5,
         )
         if val_ceiling < args.target:
-            # Checked BEFORE training: a target above the measured-AUC
-            # ceiling can never cross, and discovering that after the
-            # full TPU run would waste it.
+            # Checked BEFORE training: a target above the expected
+            # noise-blind optimum is crossable only by within-class
+            # coin-flip luck, and discovering that after the full TPU
+            # run would waste it.
             raise SystemExit(
-                f"--target {args.target} exceeds the realized val "
-                f"measured-AUC ceiling {val_ceiling} (analytic "
-                f"{ceiling}) implied by --label_noise "
-                f"{args.label_noise} — the run could never cross"
+                f"--target {args.target} exceeds the expected "
+                f"noise-blind Bayes AUC {val_ceiling} on this val draw "
+                f"(analytic {ceiling}) implied by --label_noise "
+                f"{args.label_noise} — crossing would need luck, not "
+                "a better model"
             )
-        _log(f"label_noise={args.label_noise}: measured-AUC ceiling "
-             f"{val_ceiling} realized on the {args.val_n}-image val "
-             f"split ({ceiling} analytic; target {args.target})")
+        _log(f"label_noise={args.label_noise}: expected noise-blind "
+             f"Bayes AUC {val_ceiling} on the {args.val_n}-image val "
+             f"draw ({ceiling} analytic; target {args.target})")
 
     mesh_lib.initialize_distributed()
     # Same persistent-compile-cache home as bench.py: the stacked step's
@@ -300,8 +303,12 @@ def main(argv=None) -> dict:
         "metric": "wall_sec_to_val_auc_target",
         "target_auc": args.target,
         "label_noise": args.label_noise,
-        "measured_auc_ceiling_analytic": ceiling,
-        "measured_auc_ceiling_val_realized": val_ceiling,
+        # EXPECTED AUC of the best noise-blind scorer (analytic /
+        # realized-on-this-val-draw). A ceiling in expectation only:
+        # single evals fluctuate ~+-0.004 around it and best-of-run
+        # selection rides that (synthetic.noisy_auc_ceiling docstring).
+        "noise_blind_bayes_auc_analytic": ceiling,
+        "noise_blind_bayes_auc_val_realized": val_ceiling,
         "value": ens_cross["wall_sec"] if ens_cross else None,
         "unit": "seconds (trainer start -> first ensemble-val crossing, "
                 "compile + hbm load included; see breakdown)",
