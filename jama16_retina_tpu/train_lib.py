@@ -31,6 +31,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
+from jax.sharding import PartitionSpec as P
 from absl import logging as absl_logging
 
 from jama16_retina_tpu.configs import ExperimentConfig, TrainConfig
@@ -523,6 +524,29 @@ def make_ensemble_train_step(
     donate_argnums = (0,) if donate else ()
     if mesh is None:
         return jax.jit(step, donate_argnums=donate_argnums)
+
+    def sharded_step(state: TrainState, batch: dict, base_keys: jax.Array):
+        # The member axis is MANUAL (jax.shard_map): each member-shard
+        # vmaps only its local members (the unsharded ``step`` above,
+        # reused verbatim so the two paths cannot diverge), whose
+        # weights live whole on the shard — under plain GSPMD, XLA's
+        # batched-conv strategy instead ALL-GATHERS the member-stacked
+        # kernels every step (~1300 extra all-gathers at
+        # ('member':2,'data':8); docs/MULTIHOST.md
+        # §Ensemble-collectives). The data axis stays automatic, so the
+        # batch-dim BN reductions and weight grads compile to the same
+        # data-axis all-reduces as the single-model jit step. ``batch``
+        # is closed over rather than passed through: it is unsharded on
+        # the manual axis ('data' is auto), which closure capture
+        # expresses exactly.
+        return jax.shard_map(
+            lambda st_local, keys_local: step(st_local, batch, keys_local),
+            mesh=mesh, axis_names={"member"},
+            in_specs=(P("member"), P("member")),
+            out_specs=(P("member"), P("member")),
+        )(state, base_keys)
+
+    step_fn = sharded_step
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
     # Metrics stay MEMBER-SHARDED whenever one process owns the whole
@@ -537,7 +561,7 @@ def make_ensemble_train_step(
         mesh_lib.replicated(mesh) if jax.process_count() > 1 else member
     )
     return jax.jit(
-        step,
+        step_fn,
         in_shardings=(member, data, member),
         out_shardings=(member, metric_sharding),
         donate_argnums=donate_argnums,
@@ -555,6 +579,19 @@ def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable
 
     if mesh is None:
         return jax.jit(step)
+
+    def sharded_step(state: TrainState, batch: dict):
+        # Manual member axis for the same reason as the train step:
+        # local member weights forward locally instead of being
+        # all-gathered by the batched-conv strategy. Reuses the
+        # unsharded ``step`` so the two paths cannot diverge.
+        return jax.shard_map(
+            lambda st_local: step(st_local, batch),
+            mesh=mesh, axis_names={"member"},
+            in_specs=(P("member"),), out_specs=P("member"),
+        )(state)
+
+    step_fn = sharded_step
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
     # Probs [k, B] member-sharded on dim 0 when single-process (fully
@@ -566,5 +603,5 @@ def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable
         mesh_lib.replicated(mesh) if jax.process_count() > 1 else member
     )
     return jax.jit(
-        step, in_shardings=(member, data), out_shardings=probs_sharding,
+        step_fn, in_shardings=(member, data), out_shardings=probs_sharding,
     )
