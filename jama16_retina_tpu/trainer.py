@@ -75,15 +75,36 @@ def predict_split(
     split: str,
     mesh=None,
     eval_step=None,
+    cache: list | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run the test pipeline (no augmentation) -> (grades, probs, names).
 
     Pass a prebuilt ``eval_step`` when calling repeatedly (every val
     interval / every ensemble member) — a fresh ``make_eval_step`` closure
     would defeat the jit cache and recompile the backbone each time.
+
+    ``cache``: pass one list across repeated evals of a split to keep
+    its batches device-resident between them; the first call fills it,
+    later calls skip the host re-parse and re-upload. Same IDEA as
+    _predict_split_members' cache but a different tuple layout ((dev,
+    grades, names, keep) here; 3-tuples and [k, B]-probs indexing
+    there) — the lists are not interchangeable.
     """
     if eval_step is None:
         eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+
+    if cache:
+        grades_all, probs_all, names_all = [], [], []
+        for dev_batch, kept_grades, kept_names, keep in cache:
+            probs = np.asarray(jax.device_get(eval_step(state, dev_batch)))
+            grades_all.append(kept_grades)
+            probs_all.append(probs[keep])
+            names_all.append(kept_names)
+        return (
+            np.concatenate(grades_all),
+            np.concatenate(probs_all),
+            np.concatenate(names_all),
+        )
 
     def batch_probs(batch):
         # Only the image rows go to device — 'grade'/'mask' are global
@@ -93,6 +114,11 @@ def predict_split(
             dev_batch = mesh_lib.shard_batch({"image": batch["image"]}, mesh)
         else:
             dev_batch = jax.device_put({"image": batch["image"]})
+        if cache is not None:
+            keep = batch["mask"] > 0
+            cache.append(
+                (dev_batch, batch["grade"][keep], batch["name"][keep], keep)
+            )
         return np.asarray(jax.device_get(eval_step(state, dev_batch)))
 
     return _predict_over_split(cfg, data_dir, split, batch_probs)
@@ -539,6 +565,34 @@ def _aot_with_ceiling(cfg, mesh, clock, log, start_step, step_fn, *args):
     return compiled
 
 
+def _eval_cache_for(cfg: ExperimentConfig, data_dir: str, split: str):
+    """A device-resident eval-batch cache (list to share across evals),
+    or None when it should not exist: streamed loaders keep the per-eval
+    re-read (their budget story never admitted the split into HBM), and
+    even under the hbm loader the split must clear the same budget
+    discipline the loader applies to train data — capped at 10% of the
+    HBM budget so the cache is never the one tenant that never asked
+    (the train split's own gate allows up to 60%, and the train state
+    needs the rest)."""
+    if cfg.data.loader != "hbm":
+        return None
+    from jama16_retina_tpu.data import hbm_pipeline
+
+    # read_split_metadata's memoized parse pass: the count comes from
+    # the same per-(dir, split) cache the eval protocol already fills,
+    # so the gate adds no second scan over the records.
+    n = len(pipeline.read_split_metadata(data_dir, split)[0])
+    split_bytes = n * cfg.model.image_size ** 2 * 3
+    if split_bytes <= 0.1 * hbm_pipeline.hbm_budget_bytes():
+        return []
+    absl_logging.warning(
+        "%s split (%d images, %.1f MB) exceeds 10%% of the HBM budget; "
+        "evals stream from host instead of caching device-resident",
+        split, n, split_bytes / 1e6,
+    )
+    return None
+
+
 def _save_due(cfg: ExperimentConfig, step: int) -> bool:
     """Is this eval's checkpoint due under train.save_every_evals?
 
@@ -659,6 +713,9 @@ def fit(
         cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
     )
     eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+    # Device-resident val batches between evals under the hbm loader
+    # (budget-gated; None = stream every eval as before).
+    val_cache = _eval_cache_for(cfg, data_dir, "val")
     ckpt = ckpt_lib.Checkpointer(
         os.path.abspath(workdir), max_to_keep=cfg.train.max_to_keep
     )
@@ -735,7 +792,7 @@ def fit(
                     cfg, log, ckpt, step_i + 1,
                     lambda: predict_split(
                         cfg, model, state, data_dir, "val", mesh,
-                        eval_step=eval_step,
+                        eval_step=eval_step, cache=val_cache,
                     )[:2],
                     lambda: jax.device_get(state),
                     best_auc, best_step, since_best,
@@ -939,25 +996,8 @@ def fit_ensemble_parallel(
     eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
     # Under the hbm loader the val split stays device-resident between
     # evals too (same residency philosophy; the cache is filled on the
-    # first eval) — but only after the SAME budget discipline the loader
-    # applies to the train split: the cache must not be the one HBM
-    # tenant that never asked (uint8 rows vs 10% of the budget; the
-    # train split's own gate allows up to 60%, and the stacked train
-    # state needs the rest). Streamed loaders keep the per-eval re-read.
-    val_cache = None
-    if cfg.data.loader == "hbm":
-        from jama16_retina_tpu.data import hbm_pipeline, tfrecord
-
-        n_val = tfrecord.count_records(tfrecord.list_split(data_dir, "val"))
-        val_bytes = n_val * cfg.model.image_size ** 2 * 3
-        if val_bytes <= 0.1 * hbm_pipeline.hbm_budget_bytes():
-            val_cache = []
-        else:
-            absl_logging.warning(
-                "val split (%d images, %.1f MB) exceeds 10%% of the HBM "
-                "budget; evals stream from host instead of caching "
-                "device-resident", n_val, val_bytes / 1e6,
-            )
+    # first eval, budget-gated by _eval_cache_for).
+    val_cache = _eval_cache_for(cfg, data_dir, "val")
     # Checkpoint/host gathers: on multi-host, reshard member-sharded ->
     # replicated first (an all-gather riding ICI) — device_get is only
     # legal for fully-addressable arrays there. Single-process the state
@@ -1496,11 +1536,21 @@ def evaluate_checkpoints(
     else:
         eval_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
 
+    # One device-resident cache per (dir, split) prediction pass, shared
+    # across members: k checkpoints would otherwise re-parse and
+    # re-upload the same eval batches k times (budget-gated; {} entries
+    # stay None for streamed loaders or oversized splits).
+    eval_caches: dict[tuple, list | None] = {}
+
     def member_predict(state, from_dir, eval_split):
         if backend == "tf":
             return predict_split_tf(cfg, keras_model, from_dir, eval_split)
+        cache_key = (from_dir, eval_split)
+        if cache_key not in eval_caches:
+            eval_caches[cache_key] = _eval_cache_for(cfg, from_dir, eval_split)
         return predict_split(
-            cfg, model, state, from_dir, eval_split, mesh, eval_step=eval_step
+            cfg, model, state, from_dir, eval_split, mesh,
+            eval_step=eval_step, cache=eval_caches[cache_key],
         )
 
     # (key, data_dir, split) prediction passes; tune pass only if asked.

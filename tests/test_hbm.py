@@ -140,3 +140,34 @@ def test_fit_tf_refuses_hbm_loader(data_dir, tmp_path):
     cfg = override(get_config("smoke"), ["data.loader=hbm"])
     with pytest.raises(ValueError, match="hbm"):
         trainer.fit_tf(cfg, data_dir, str(tmp_path / "x"), seed=0)
+
+
+def test_predict_split_device_cache_matches_streamed(data_dir):
+    """predict_split's device-resident eval cache (fit()'s hbm-loader
+    eval path) must be a pure optimization: cached calls return
+    bit-identical (grades, probs, names) to the streamed path."""
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+
+    cfg = override(get_config("smoke"), [
+        "eval.batch_size=8", "model.image_size=32",
+    ])
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    eval_step = train_lib.make_eval_step(cfg, model)
+
+    streamed = trainer.predict_split(
+        cfg, model, state, data_dir, "val", eval_step=eval_step
+    )
+    cache = []
+    first = trainer.predict_split(
+        cfg, model, state, data_dir, "val", eval_step=eval_step, cache=cache
+    )
+    assert cache
+    second = trainer.predict_split(
+        cfg, model, state, data_dir, "val", eval_step=eval_step, cache=cache
+    )
+    for got in (first, second):
+        for a, b in zip(streamed, got):
+            np.testing.assert_array_equal(a, b)
