@@ -242,6 +242,35 @@ def _apply_update(
     )
 
 
+def _mesh_devices(mesh) -> int:
+    return mesh.size if mesh is not None else 1
+
+
+def _pallas_safe_cfg(cfg: ExperimentConfig, mesh, context: str):
+    """Route augmentation off the Mosaic kernel on multi-device meshes.
+
+    Mosaic (pallas-TPU) kernels cannot be automatically partitioned by
+    GSPMD (jax raises NotImplementedError at lowering), so any step
+    compiled over a >1-device mesh must use the jnp augment composition
+    instead — same math (ops/pallas_augment.py is pinned against it),
+    and XLA fuses and partitions the jnp form freely. Single-device
+    programs (every bench/artifact on this one-chip host) keep the
+    kernel. Logged so a multi-chip run's ~2% end-to-end delta is
+    traceable to this routing."""
+    if not (cfg.data.use_pallas and _mesh_devices(mesh) > 1):
+        return cfg
+    import dataclasses
+
+    absl_logging.info(
+        "%s: use_pallas routed to the jnp composition on a %d-device "
+        "mesh (Mosaic kernels cannot be auto-partitioned)",
+        context, _mesh_devices(mesh),
+    )
+    return dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, use_pallas=False)
+    )
+
+
 def make_train_step(
     cfg: ExperimentConfig, model, tx, mesh=None, donate: bool = True
 ) -> Callable:
@@ -254,6 +283,7 @@ def make_train_step(
     under jax_debug_nans, whose op-by-op re-execution needs the inputs
     to still be alive.
     """
+    cfg = _pallas_safe_cfg(cfg, mesh, "train step")
 
     def step(state: TrainState, batch: dict, base_key: jax.Array):
         loss, logits, new_stats, grads = _step_impl(
@@ -509,6 +539,7 @@ def make_ensemble_train_step(
     and the batch P('data') on dim 0 — every chip holds k/member_size
     members and sees the batch rows of its data-axis block.
     """
+    cfg = _pallas_safe_cfg(cfg, mesh, "ensemble train step")
 
     def step(state: TrainState, batch: dict, base_keys: jax.Array):
         def one(st, bk):
@@ -546,7 +577,12 @@ def make_ensemble_train_step(
             out_specs=(P("member"), P("member")),
         )(state, base_keys)
 
-    step_fn = sharded_step
+    # A 1-device mesh gains nothing from manual axes and would lose the
+    # Mosaic augment kernel (see _pallas_safe_cfg) — keep the plain
+    # vmapped jit there (this host's bench/artifact form); the
+    # shard_map form engages exactly where its gathers-elimination
+    # matters, on real multi-device meshes.
+    step_fn = step if _mesh_devices(mesh) == 1 else sharded_step
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
     # Metrics stay MEMBER-SHARDED whenever one process owns the whole
@@ -591,7 +627,8 @@ def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable
             in_specs=(P("member"),), out_specs=P("member"),
         )(state)
 
-    step_fn = sharded_step
+    # Same 1-device routing as the train step.
+    step_fn = step if _mesh_devices(mesh) == 1 else sharded_step
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
     # Probs [k, B] member-sharded on dim 0 when single-process (fully

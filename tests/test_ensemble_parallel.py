@@ -407,3 +407,19 @@ def test_best_tracking_replay_dedupes_re_logged_evals(tmp_path):
     assert best_auc[0] == 0.9 and best_step[0] == 10
     # evals 20 and 30 count ONCE each despite being logged twice
     assert since_best[0] == 2
+
+
+def test_stacked_step_runs_with_pallas_augment_on_mesh():
+    """Regression: the flagship cfg (use_pallas=true) must build and run
+    on a multi-device ensemble mesh. Mosaic kernels cannot be
+    auto-partitioned (and the VMA checker rejects pallas out_shapes in
+    the shard_map body), so the step builder routes augmentation to the
+    jnp composition there (_pallas_safe_cfg) — this pins that the
+    routing exists and the program executes; single-device meshes keep
+    the kernel (bench/artifact parity)."""
+    cfg = small_cfg(augment=True)
+    cfg = override(cfg, ["data.use_pallas=true"])
+    batch = make_batch(cfg)
+    mesh = mesh_lib.make_ensemble_mesh(2)
+    stacked, losses = _stacked_after_one_step(cfg, batch, [0, 1], mesh=mesh)
+    assert losses.shape == (2,) and np.all(np.isfinite(losses))
