@@ -423,3 +423,37 @@ def test_stacked_step_runs_with_pallas_augment_on_mesh():
     mesh = mesh_lib.make_ensemble_mesh(2)
     stacked, losses = _stacked_after_one_step(cfg, batch, [0, 1], mesh=mesh)
     assert losses.shape == (2,) and np.all(np.isfinite(losses))
+
+
+@pytest.mark.slow
+def test_member_sharded_parity_at_flagship_architecture():
+    """Sharded-vs-plain parity on the REAL architecture (Inception at
+    75px), in f32: the tiny_cnn/f32 pin above is insensitive to
+    member-routing mistakes in the conv/BN stack. f32 keeps fp
+    reassociation at ~1e-4 (the member-manual form genuinely partitions
+    per-member compute over the data axis, so reduction orders differ
+    from the single-device stacked program; under bf16 that legitimate
+    divergence grows to ~0.04 in init loss — docs/MULTIHOST.md). A
+    member-routing or key bug would diverge by O(1)."""
+    from __graft_entry__ import _flagship_cfg
+
+    cfg = override(
+        _flagship_cfg(image_size=75, aux_head=False, batch_size=16),
+        ["train.ensemble_size=4", "train.ensemble_parallel=true",
+         "model.compute_dtype=float32"],
+    )
+    batch = make_batch(cfg)
+    seeds = [0, 1, 2, 3]
+    plain, l_plain = _stacked_after_one_step(cfg, batch, seeds)
+    sharded, l_sh = _stacked_after_one_step(
+        cfg, batch, seeds, mesh=mesh_lib.make_ensemble_mesh(4)
+    )
+    np.testing.assert_allclose(l_sh, l_plain, atol=1e-3)
+    # Params after an adamw step are sign-brittle where |grad| is at the
+    # reassociation-noise floor (update = +-lr either way), so pin the
+    # BN batch statistics instead: they are plain batch reductions — a
+    # member-routing bug would put another member's activations in them
+    # (O(1) divergence), while legitimate reassociation stays ~1e-4.
+    tree_allclose(
+        sharded.batch_stats, plain.batch_stats, rtol=5e-3, atol=5e-4
+    )
