@@ -32,6 +32,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
+    # argparse so an unknown/mistyped flag fails loudly instead of the
+    # script silently running the full ~15-minute table.
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--variants", default="",
+        help="comma-separated subset of variant names to run "
+        "(default: all)",
+    )
+    args = parser.parse_args()
+
     import bench  # repo-root bench.py: the shared fenced harness
     import jax
 
@@ -55,6 +67,15 @@ def main() -> None:
         ("no_augment", ["data.augment=false"], 32),
         ("s2d_b128", ["model.stem_s2d=true"], 128),
     ]
+    if args.variants:
+        want = {v.strip() for v in args.variants.split(",") if v.strip()}
+        unknown = want - {name for name, _, _ in variants}
+        if unknown:
+            parser.error(
+                f"unknown variants {sorted(unknown)}; choose from "
+                f"{[name for name, _, _ in variants]}"
+            )
+        variants = [v for v in variants if v[0] in want]
     rows = []
     for name, sets, batch_size in variants:
         cfg = override(get_config("eyepacs_binary"),
