@@ -528,6 +528,44 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - bench must emit JSON
         _log(f"eval bench failed: {type(e).__name__}: {e}")
 
+    # The NORTH-STAR hardware shape (VERDICT r4 #3): BASELINE.json:5
+    # names global batch 32 on a v3-8 slice — 4 images/chip. Every
+    # other row here measures per-chip batch >=32 (this chip's sweet
+    # spot), so the pod-slice story was extrapolated; this row measures
+    # the actual per-replica shard. Steps are ~ms at batch 4, so take
+    # 100 of them; the same physics guard applies. Expect well below
+    # b32's rate — the stem is HBM-bound and batch 4 amortizes nothing
+    # (docs/PERF.md §Pod translates this number to the v3-8 target).
+    # Runs BEFORE b128: the donating step chains `state`, and b128 (the
+    # most OOM-prone batch) must not be able to poison this row.
+    try:
+        b4 = 4 * n_dev
+        b4_batches = [
+            mesh_lib.shard_batch(
+                {
+                    "image": rng.integers(
+                        0, 256, (b4, size, size, 3), np.uint8
+                    ),
+                    "grade": rng.integers(0, 5, (b4,), np.int32),
+                },
+                mesh,
+            )
+            for _ in range(2)
+        ]
+        rate, state = _timed_steps(
+            step, state, lambda i: b4_batches[i % 2], key, 100, b4, n_dev
+        )
+        _publish(
+            extras, "device_only_b4", rate, flops_per_image, peak,
+            suffix=" (batch 4/chip: the v3-8 north-star per-replica shard)",
+        )
+    except Exception as e:  # pragma: no cover - bench must emit JSON
+        _log(f"batch-4 bench failed: {type(e).__name__}: {e}")
+        # The donating step may have consumed `state`'s buffers before
+        # the failure; rebuild so the b128/ensemble sections below
+        # measure from a valid state instead of use-after-donate.
+        _, state, _, _ = build_train_fixture(cfg, mesh, batch_size)
+
     # Batch-scaling datapoint: per-chip batch 128 (see docstring). Placed
     # AFTER every section that reads `state`: the donating step consumes
     # its buffers, and a mid-section failure here must not poison a

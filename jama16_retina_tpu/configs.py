@@ -160,6 +160,16 @@ class TrainConfig:
     # state to replicated before host gathers (docs/MULTIHOST.md;
     # pinned 2-process vs single-process in tests/test_multiprocess.py).
     ensemble_parallel: bool = False
+    # Run the member-parallel step with the DATA axis manual too (full
+    # jax.shard_map; train_lib.make_ensemble_train_step manual_data):
+    # every collective is explicit — the loss pmean whose backward IS
+    # the gradient all-reduce, and axis_name='data' BatchNorm moment
+    # pmeans — instead of GSPMD-derived. Same math (pinned vs the
+    # auto-data form in tests/test_ensemble_parallel.py); augmentation/
+    # dropout draws fold the data-shard index (pmap-style stream, same
+    # distribution). Use on big meshes where GSPMD's generic activation
+    # collectives dominate; ignored on 1-device meshes.
+    ensemble_manual_data: bool = False
     # Profiling (SURVEY.md §5.1): if > 0, capture a jax.profiler trace of
     # this many steps (starting at step 10) into <workdir>/profile —
     # TensorBoard/Perfetto-viewable XLA op + ICI collective timeline.

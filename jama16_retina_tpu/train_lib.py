@@ -184,8 +184,21 @@ def loss_fn(params, batch_stats, model, images, grades, dropout_rng,
 
 
 def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
-               model, cfg: ExperimentConfig, augment_key_extra=None):
-    """Shared body for the jit and pmap step forms."""
+               model, cfg: ExperimentConfig, augment_key_extra=None,
+               loss_axis: "str | None" = None):
+    """Shared body for the jit and pmap step forms.
+
+    ``loss_axis`` (the shard_map manual-data form): pmean the scalar loss
+    over that axis INSIDE the differentiated function, yielding the
+    global-batch gradient directly — under ``jax.shard_map`` a collective
+    in the forward (the axis_name BN moments) makes the raw local-loss
+    grads come back already cross-shard-summed (psum-self-transpose
+    semantics; a post-grad pmean then over-counts by the axis size — a
+    bug this option exists to prevent, pinned by
+    test_manual_data_step_matches_auto_data). Under ``jax.pmap`` the AD
+    semantics differ and the classic local-grads-then-pmean recipe of
+    make_pmap_train_step is exact (pinned by TestDPEquivalence); the two
+    recipes are NOT interchangeable across the two tracers."""
     debug = cfg.train.debug
     if debug:
         # chex asserts under --debug (SURVEY.md §5.2): trace-time
@@ -214,7 +227,17 @@ def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
         chex.assert_type(images, jnp.float32)
         chex.assert_equal_shape([images, batch["image"]])
 
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    fn = loss_fn
+    if loss_axis is not None:
+        def fn(params, batch_stats, model, images, grades, dropout_rng,
+               cfg, train):
+            loss, aux = loss_fn(
+                params, batch_stats, model, images, grades, dropout_rng,
+                cfg, train,
+            )
+            return jax.lax.pmean(loss, loss_axis), aux
+
+    grad_fn = jax.value_and_grad(fn, has_aux=True)
     (loss, (logits, new_stats)), grads = grad_fn(
         state.params, state.batch_stats, model, images, batch["grade"],
         dropout_key, cfg, True,
@@ -527,7 +550,8 @@ def unstack_member(state: TrainState, m: int) -> TrainState:
 
 
 def make_ensemble_train_step(
-    cfg: ExperimentConfig, model, tx, mesh=None, donate: bool = True
+    cfg: ExperimentConfig, model, tx, mesh=None, donate: bool = True,
+    manual_data: bool = False,
 ) -> Callable:
     """One XLA program advancing all k stacked members one step.
 
@@ -538,8 +562,42 @@ def make_ensemble_train_step(
     ('member', 'data') mesh, state shards P('member') on the stacked dim
     and the batch P('data') on dim 0 — every chip holds k/member_size
     members and sees the batch rows of its data-axis block.
+
+    ``manual_data`` (TrainConfig.ensemble_manual_data) makes the data
+    axis manual too: the whole step runs under ``jax.shard_map`` with
+    BOTH mesh axes manual, so every collective is explicit — one
+    ``lax.pmean`` for weight grads + loss, and the model's ``axis_name=
+    'data'`` BatchNorm pmeans its moments (the caller MUST build the
+    model with ``axis_name='data'``; make_pmap_train_step semantics,
+    now per member). Nothing is left to GSPMD's partitioner, which on
+    big meshes otherwise emits generic activation collectives (the
+    n>16 CPU-dryrun wall; docs/MULTIHOST.md). Augment/dropout draws
+    fold in the data-shard index exactly like the pmap reference form,
+    so draws differ from the auto-data path's global-batch draws —
+    same distribution, different stream (both are valid training
+    randomness; parity tests compare with augmentation off).
     """
     cfg = _pallas_safe_cfg(cfg, mesh, "ensemble train step")
+    if manual_data:
+        if mesh is None or "data" not in mesh.axis_names:
+            raise ValueError(
+                "manual_data needs a ('member', 'data') mesh"
+            )
+        if getattr(model, "axis_name", None) != "data":
+            raise ValueError(
+                "manual_data runs BatchNorm inside a manual data axis: "
+                "build the model with models.build(cfg.model, "
+                "axis_name='data') so its moments pmean over the mesh"
+            )
+        if cfg.data.use_pallas:
+            # Even on a 1-device mesh: Mosaic out_shapes are rejected by
+            # the shard_map VMA checker (same reason _pallas_safe_cfg
+            # exists for >1-device GSPMD meshes).
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, data=dataclasses.replace(cfg.data, use_pallas=False)
+            )
 
     def step(state: TrainState, batch: dict, base_keys: jax.Array):
         def one(st, bk):
@@ -555,6 +613,38 @@ def make_ensemble_train_step(
     donate_argnums = (0,) if donate else ()
     if mesh is None:
         return jax.jit(step, donate_argnums=donate_argnums)
+
+    def manual_step(state: TrainState, batch: dict, base_keys: jax.Array):
+        # BOTH axes manual. Each shard holds k/member_size whole members
+        # and its data-block's batch rows; per member: local fwd/bwd of
+        # the loss pmean'd over 'data' INSIDE the grad (loss_axis — the
+        # gradient all-reduce rides the loss pmean's backward psum; a
+        # post-grad pmean would double-count, see _step_impl), BN
+        # moments pmean'd inside the model. The only collectives in the
+        # program are those pmeans — exactly what a real pod runs over
+        # ICI, nothing partitioner-derived.
+        def shard_fn(st_local, batch_local, keys_local):
+            def one(st, bk):
+                loss, _, new_stats, grads = _step_impl(
+                    st, batch_local, bk, model, cfg,
+                    augment_key_extra=jax.lax.axis_index("data"),
+                    loss_axis="data",
+                )
+                return (
+                    _apply_update(
+                        st, grads, new_stats, tx, cfg.train.ema_decay
+                    ),
+                    loss,
+                )
+
+            new_st, losses = jax.vmap(one)(st_local, keys_local)
+            return new_st, {"loss": losses}
+
+        return jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("member"), P("data"), P("member")),
+            out_specs=(P("member"), P("member")),
+        )(state, batch, base_keys)
 
     def sharded_step(state: TrainState, batch: dict, base_keys: jax.Array):
         # The member axis is MANUAL (jax.shard_map): each member-shard
@@ -582,7 +672,14 @@ def make_ensemble_train_step(
     # vmapped jit there (this host's bench/artifact form); the
     # shard_map form engages exactly where its gathers-elimination
     # matters, on real multi-device meshes.
-    step_fn = step if _mesh_devices(mesh) == 1 else sharded_step
+    if manual_data:
+        # Also on 1-device meshes: the model's axis_name='data' BN needs
+        # the manual axis in scope (sizes are 1, the pmeans are no-ops).
+        step_fn = manual_step
+    elif _mesh_devices(mesh) == 1:
+        step_fn = step
+    else:
+        step_fn = sharded_step
     member = mesh_lib.member_sharding(mesh)
     data = mesh_lib.batch_sharding(mesh)
     # Metrics stay MEMBER-SHARDED whenever one process owns the whole

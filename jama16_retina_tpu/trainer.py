@@ -387,8 +387,22 @@ def _reconstruct_best_tracking(
     # at one step. Deterministic replay makes the duplicates identical;
     # counting them twice would double-increment since_best and fire
     # early stopping before the configured patience.
-    seen: set[int] = set()
-    evals = [(s, a) for s, a in evals if not (s in seen or seen.add(s))]
+    kept: dict[int, list] = {}
+    for s, a in evals:
+        if s not in kept:
+            kept[s] = a
+        elif not np.allclose(kept[s], a, atol=1e-9):
+            # Deterministic replay should make re-logged evals identical;
+            # disagreement means the workdir mixed nondeterministic eval
+            # passes (e.g. the TF backend) and the replayed best/patience
+            # state may differ from the state actually restored.
+            absl_logging.warning(
+                "metrics.jsonl holds disagreeing duplicate eval records "
+                "at step %d (%s vs %s); replaying the first — best/"
+                "patience reconstruction may not match the restored state",
+                s, kept[s], a,
+            )
+    evals = list(kept.items())
     if evals:
         for step, aucs in evals:
             best_auc, best_step, since_best = _best_tracking_update(
@@ -565,15 +579,29 @@ def _aot_with_ceiling(cfg, mesh, clock, log, start_step, step_fn, *args):
     return compiled
 
 
-def _eval_cache_for(cfg: ExperimentConfig, data_dir: str, split: str):
+def _eval_cache_bytes(cfg: ExperimentConfig, data_dir: str, split: str) -> int:
+    """Device bytes an eval cache for this split would actually hold:
+    batches are padded to eval.batch_size, so the resident rows are
+    ceil(n/B)*B, not n (a 20-image split at batch 8 uploads 24 rows)."""
+    n = len(pipeline.read_split_metadata(data_dir, split)[0])
+    b = cfg.eval.batch_size
+    return -(-n // b) * b * cfg.model.image_size ** 2 * 3
+
+
+def _eval_cache_for(
+    cfg: ExperimentConfig, data_dir: str, split: str,
+    reserved_bytes: int = 0,
+):
     """A device-resident eval-batch cache (list to share across evals),
     or None when it should not exist: streamed loaders keep the per-eval
     re-read (their budget story never admitted the split into HBM), and
     even under the hbm loader the split must clear the same budget
-    discipline the loader applies to train data — capped at 10% of the
-    HBM budget so the cache is never the one tenant that never asked
-    (the train split's own gate allows up to 60%, and the train state
-    needs the rest)."""
+    discipline the loader applies to train data — all caches TOGETHER
+    capped at 10% of the HBM budget (``reserved_bytes`` carries the
+    footprint of caches already admitted, so a multi-split eval pass
+    cannot pin 3x the gate by admitting each split individually), so the
+    cache is never the one tenant that never asked (the train split's
+    own gate allows up to 60%, and the train state needs the rest)."""
     if cfg.data.loader != "hbm":
         return None
     from jama16_retina_tpu.data import hbm_pipeline
@@ -581,14 +609,14 @@ def _eval_cache_for(cfg: ExperimentConfig, data_dir: str, split: str):
     # read_split_metadata's memoized parse pass: the count comes from
     # the same per-(dir, split) cache the eval protocol already fills,
     # so the gate adds no second scan over the records.
-    n = len(pipeline.read_split_metadata(data_dir, split)[0])
-    split_bytes = n * cfg.model.image_size ** 2 * 3
-    if split_bytes <= 0.1 * hbm_pipeline.hbm_budget_bytes():
+    split_bytes = _eval_cache_bytes(cfg, data_dir, split)
+    if reserved_bytes + split_bytes <= 0.1 * hbm_pipeline.hbm_budget_bytes():
         return []
     absl_logging.warning(
-        "%s split (%d images, %.1f MB) exceeds 10%% of the HBM budget; "
-        "evals stream from host instead of caching device-resident",
-        split, n, split_bytes / 1e6,
+        "%s split (%.1f MB + %.1f MB already cached) exceeds 10%% of the "
+        "HBM budget; evals stream from host instead of caching "
+        "device-resident",
+        split, split_bytes / 1e6, reserved_bytes / 1e6,
     )
     return None
 
@@ -599,11 +627,15 @@ def _save_due(cfg: ExperimentConfig, step: int) -> bool:
     Phase derives from the step ordinal (step // eval_every), not a
     loop-local counter, so resume keeps the same save cadence. The final
     step is always due (the run must end durable); so is a stopping
-    eval (forced inside _eval_and_track / the member-parallel block)."""
+    eval (forced inside _eval_and_track / the member-parallel block);
+    so is the FIRST eval (ordinal 1) — without it a fresh run has no
+    checkpoint until ordinal n, and a crash in that window resumes from
+    step 0 (ADVICE r4)."""
     if step >= cfg.train.steps:
         return True
     n = max(1, cfg.train.save_every_evals)
-    return (step // cfg.train.eval_every) % n == 0
+    ordinal = step // cfg.train.eval_every
+    return ordinal == 1 or ordinal % n == 0
 
 
 def _eval_and_track(
@@ -984,14 +1016,21 @@ def fit_ensemble_parallel(
         n_members=k, mesh_shape=dict(mesh.shape),
     )
 
-    model = models.build(cfg.model)
+    # manual_data wants axis_name='data' BN (explicit moment pmeans);
+    # harmless otherwise: axis_name only engages at train=True inside
+    # the manual region, so init/eval/checkpoint trees are identical.
+    manual_data = cfg.train.ensemble_manual_data and mesh.size > 1
+    model = models.build(
+        cfg.model, axis_name="data" if manual_data else None
+    )
     # State and keys are built INSIDE jit with member-axis out-shardings
     # (multi-host legal: no host-side stacked copy to place).
     state, tx = train_lib.create_ensemble_state(
         cfg, model, [seed + m for m in range(k)], mesh=mesh
     )
     train_step = train_lib.make_ensemble_train_step(
-        cfg, model, tx, mesh=mesh, donate=not cfg.train.debug
+        cfg, model, tx, mesh=mesh, donate=not cfg.train.debug,
+        manual_data=manual_data,
     )
     eval_step = train_lib.make_ensemble_eval_step(cfg, model, mesh=mesh)
     # Under the hbm loader the val split stays device-resident between
@@ -1539,15 +1578,24 @@ def evaluate_checkpoints(
     # One device-resident cache per (dir, split) prediction pass, shared
     # across members: k checkpoints would otherwise re-parse and
     # re-upload the same eval batches k times (budget-gated; {} entries
-    # stay None for streamed loaders or oversized splits).
+    # stay None for streamed loaders or oversized splits). The caches
+    # live simultaneously, so admission is gated on their JOINT
+    # footprint (cached_bytes), not per split (ADVICE r4).
     eval_caches: dict[tuple, list | None] = {}
+    cached_bytes = 0
 
     def member_predict(state, from_dir, eval_split):
+        nonlocal cached_bytes
         if backend == "tf":
             return predict_split_tf(cfg, keras_model, from_dir, eval_split)
         cache_key = (from_dir, eval_split)
         if cache_key not in eval_caches:
-            eval_caches[cache_key] = _eval_cache_for(cfg, from_dir, eval_split)
+            cache = _eval_cache_for(
+                cfg, from_dir, eval_split, reserved_bytes=cached_bytes
+            )
+            if cache is not None:
+                cached_bytes += _eval_cache_bytes(cfg, from_dir, eval_split)
+            eval_caches[cache_key] = cache
         return predict_split(
             cfg, model, state, from_dir, eval_split, mesh,
             eval_step=eval_step, cache=eval_caches[cache_key],

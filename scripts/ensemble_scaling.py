@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Isolate the single-chip stacked-ensemble tax (VERDICT r4 weak #3).
+
+BENCH r3/r4 measured `ensemble4_parallel_speedup` drifting 0.87x ->
+0.85x and PERF.md ATTRIBUTED it to member-multiplied weight/optimizer
+HBM traffic without an isolating experiment. This script produces the
+evidence, in the stem-experiments discipline (measure, don't argue):
+
+  * member-rate scaling table, k in {1, 2, 4, 8}: member-images/sec of
+    the stacked step at the flagship config (batch 32/chip shared by
+    all members — the bench's protocol). If the tax is weight/optimizer
+    traffic, the per-member rate must FALL with k roughly linearly in
+    the extra bytes moved per step.
+  * optimizer-state ablation at each k: adamw (2 f32 moments per param;
+    the config of record) vs plain SGD (ZERO optimizer state, same conv
+    FLOPs, same weight traffic). The gap between the two curves is the
+    optimizer-state traffic's share of the tax; what remains vs k=1 is
+    weights + activations.
+
+Each cell reuses bench.py's fencing discipline (_timed_steps: warmup +
+compile excluded, median-of-3 fence-cost subtraction, physics guard via
+the same FLOP analysis). Writes docs/ensemble_scaling_r5.json and
+prints the table; PERF.md §Ensemble cites it.
+
+Run on the real chip: `python scripts/ensemble_scaling.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import optax
+
+    import bench
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    cfg = get_config("eyepacs_binary")
+    size = cfg.model.image_size
+    batch_size = cfg.data.batch_size
+    mesh = mesh_lib.make_mesh(1)
+    n_dev = 1
+
+    rng = np.random.default_rng(0)
+    batches = [
+        mesh_lib.shard_batch(
+            {
+                "image": rng.integers(
+                    0, 256, (batch_size, size, size, 3), np.uint8
+                ),
+                "grade": rng.integers(0, 5, (batch_size,), np.int32),
+            },
+            mesh,
+        )
+        for _ in range(2)
+    ]
+    key = jax.random.key(0)
+
+    peak = bench._peak_flops()
+    rows = []
+    for optimizer in ("adamw", "sgd_stateless"):
+        for k in (1, 2, 4, 8):
+            model = models.build(cfg.model)
+            ens_cfg = override(
+                cfg,
+                [f"train.ensemble_size={k}", "train.ensemble_parallel=true"],
+            )
+            state, tx = train_lib.create_ensemble_state(
+                ens_cfg, model, list(range(k))
+            )
+            if optimizer == "sgd_stateless":
+                # optax.sgd without momentum carries NO state: same
+                # model, same weight/activation traffic, zero optimizer
+                # bytes — the ablation arm.
+                tx = optax.sgd(cfg.train.learning_rate)
+                state = dataclasses.replace(
+                    state, opt_state=jax.vmap(tx.init)(state.params)
+                )
+            step = train_lib.make_ensemble_train_step(
+                ens_cfg, model, tx, mesh=None
+            )
+            keys = train_lib.stack_member_keys(list(range(k)))
+            # Same physics discipline as bench._publish: a rate implying
+            # more FLOP/s than chip peak is refused, not recorded.
+            step_flops = bench._flops_of(step, state, batches[0], keys)
+            flops_per_member_image = (
+                step_flops / (k * batch_size) if step_flops else None
+            )
+            t0 = time.time()
+            rate, _ = bench._timed_steps(
+                lambda st, b, ky: step(st, b, keys),
+                jax.device_put(state), lambda i: batches[i % 2], key,
+                20, k * batch_size, n_dev,
+            )
+            wall = time.time() - t0
+            if not bench._physics_guard(
+                f"k={k}:{optimizer}", rate, flops_per_member_image, peak
+            ):
+                rows.append({
+                    "optimizer": optimizer, "k": k,
+                    "member_images_per_sec": None,
+                    "refused": "rate exceeds FLOP physics ceiling",
+                })
+                continue
+            rows.append({
+                "optimizer": optimizer,
+                "k": k,
+                "member_images_per_sec": round(rate, 2),
+                "per_member_rate": round(rate / k, 2),
+                "section_wall_sec": round(wall, 1),
+            })
+            print(
+                f"k={k} {optimizer}: {rate:.1f} member-img/s "
+                f"({rate / k:.1f} img/s per member) "
+                f"[{wall:.0f}s incl compile]",
+                file=sys.stderr,
+            )
+
+    # Normalize: speedup vs the same-optimizer k=1 rate (k=1 stacked is
+    # within noise of the plain single-model step).
+    base = {r["optimizer"]: r["member_images_per_sec"]
+            for r in rows if r["k"] == 1}
+    for r in rows:
+        rate, b = r["member_images_per_sec"], base.get(r["optimizer"])
+        r["speedup_vs_k1"] = round(rate / b, 3) if rate and b else None
+
+    out = {
+        "config": "eyepacs_binary (batch 32, 299px, bf16, aux on)",
+        "device": str(jax.devices()[0]),
+        "rows": rows,
+        "protocol": (
+            "bench._timed_steps: 3 warmup steps (compile excluded), 20 "
+            "timed steps, median-of-3 fence-cost subtraction; shared "
+            "batch across members (the fit_ensemble_parallel stream)"
+        ),
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "ensemble_scaling_r5.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": path}))
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,51 @@ def test_member_sharded_equals_unsharded():
     tree_allclose(sharded.batch_stats, plain.batch_stats, rtol=2e-5, atol=1e-6)
 
 
+def test_manual_data_step_matches_auto_data():
+    """The full-manual form (both mesh axes manual, explicit grad/BN
+    pmeans — TrainConfig.ensemble_manual_data) must reproduce the
+    auto-data shard_map path: same loss, params, and BN stats.
+    Augmentation and dropout are off (small_cfg defaults), so the
+    pmap-style per-data-shard key fold cannot introduce draw
+    differences — what remains is pure collective semantics: the
+    explicit pmeans must equal GSPMD's derived all-reduces."""
+    cfg = small_cfg()
+    batch = make_batch(cfg)
+    seeds = [3, 4]
+    mesh = mesh_lib.make_ensemble_mesh(2)
+    assert dict(mesh.shape) == {"member": 2, "data": 4}
+    auto, loss_auto = _stacked_after_one_step(cfg, batch, seeds, mesh=mesh)
+
+    model = models.build(cfg.model, axis_name="data")
+    state, tx = train_lib.create_ensemble_state(cfg, model, seeds)
+    state = jax.device_put(state, mesh_lib.member_sharding(mesh))
+    keys = jax.device_put(
+        train_lib.stack_member_keys(seeds), mesh_lib.member_sharding(mesh)
+    )
+    sharded = mesh_lib.shard_batch(batch, mesh)
+    step = train_lib.make_ensemble_train_step(
+        cfg, model, tx, mesh=mesh, manual_data=True
+    )
+    manual, m = step(state, sharded, keys)
+    manual = jax.device_get(manual)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(m["loss"])), loss_auto, rtol=1e-5
+    )
+    tree_allclose(manual.params, auto.params, rtol=2e-5, atol=1e-6)
+    tree_allclose(manual.batch_stats, auto.batch_stats, rtol=2e-5, atol=1e-6)
+
+
+def test_manual_data_step_requires_axis_name():
+    cfg = small_cfg()
+    mesh = mesh_lib.make_ensemble_mesh(2)
+    model = models.build(cfg.model)  # no axis_name
+    _, tx = train_lib.create_ensemble_state(cfg, model, [0, 1])
+    with pytest.raises(ValueError, match="axis_name"):
+        train_lib.make_ensemble_train_step(
+            cfg, model, tx, mesh=mesh, manual_data=True
+        )
+
+
 def test_ensemble_eval_step_matches_single_eval():
     cfg = small_cfg()
     batch = make_batch(cfg)
@@ -216,6 +261,32 @@ def test_ensemble_parallel_resume_matches_uninterrupted(tmp_path):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_fit_ensemble_parallel_manual_data_end_to_end(tmp_path):
+    """train.ensemble_manual_data=true through the REAL driver: the
+    trainer-level wiring (mesh.size>1 gate, axis_name='data' model
+    shared by the manual train step AND the eval step / checkpoint
+    paths, where the axis must never be reached outside the manual
+    region) runs end to end, trains, evals, and checkpoints."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 32, 64, 2, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 16, 64, 1, seed=2)
+    cfg = override(get_config("smoke"), [
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.ensemble_manual_data=true",
+        "train.steps=10", "train.eval_every=5", "data.batch_size=8",
+        "eval.batch_size=8",
+    ])
+    workdir = str(tmp_path / "ck")
+    results = trainer.fit_ensemble(cfg, data_dir, workdir)
+    assert [r["member"] for r in results] == [0, 1]
+    for r in results:
+        assert r["best_auc"] is not None
+        assert os.path.isdir(os.path.join(r["workdir"], "best"))
+    log = read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+    evals = [r for r in log if r.get("kind") == "eval"]
+    assert evals and len(evals[-1]["val_auc_per_member"]) == 2
+
+
 def test_ensemble_parallel_rejects_tf_backend(tmp_path):
     cfg = override(get_config("smoke"), [
         "train.ensemble_size=2", "train.ensemble_parallel=true",
@@ -299,11 +370,12 @@ def test_ensemble_parallel_recovers_from_torn_save(tmp_path):
 
 
 def test_save_every_evals_sparse_checkpoints_and_resume(tmp_path):
-    """train.save_every_evals=2: checkpoints land only at every 2nd eval
-    (plus always the final one), eval records still cover every
-    interval, and a resume whose newest save predates the newest EVAL
-    rolls back to the saved step and still reproduces the uninterrupted
-    run exactly (deterministic replay is what makes sparse saves safe)."""
+    """train.save_every_evals=2: checkpoints land only at the first and
+    every 2nd eval (plus always the final one), eval records still cover
+    every interval, and a resume whose newest save predates the newest
+    EVAL rolls back to the saved step and still reproduces the
+    uninterrupted run exactly (deterministic replay is what makes sparse
+    saves safe)."""
     data_dir = str(tmp_path / "data")
     tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 3, seed=1)
     tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 2, seed=2)
@@ -319,10 +391,11 @@ def test_save_every_evals_sparse_checkpoints_and_resume(tmp_path):
         return trainer.fit_ensemble(cfg, data_dir, str(tmp_path / workdir))
 
     full = run("full", 40)
-    # Saves only where (step // eval_every) is even, plus the final step.
+    # Saves where (step // eval_every) is even, plus the first eval
+    # (crash-window guard, ADVICE r4) and the final step.
     for m in range(2):
         ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(str(tmp_path / "full"), m))
-        assert ck.all_steps() == {20, 40}
+        assert ck.all_steps() == {10, 20, 40}
         ck.close()
     evals = [r["step"] for r in read_jsonl(str(tmp_path / "full" / "metrics.jsonl"))
              if r.get("kind") == "eval"]
@@ -339,7 +412,7 @@ def test_save_every_evals_sparse_checkpoints_and_resume(tmp_path):
     )
     for m in range(2):
         ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(str(tmp_path / "split"), m))
-        assert ck.all_steps() == {20, 40}
+        assert ck.all_steps() == {10, 20, 40}
         ck.close()
     finals = {
         w: [r for r in read_jsonl(str(tmp_path / w / "metrics.jsonl"))

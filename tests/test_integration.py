@@ -386,8 +386,10 @@ def test_run_meta_seed_wins_on_resume(smoke_cfg, data_dir, tmp_path):
 def test_fit_save_every_evals_gates_checkpoints(smoke_cfg, data_dir, tmp_path):
     """train.save_every_evals on the single-model loop: evals run at
     every interval (the JSONL record is the early-stop/resume source),
-    but checkpoints land only at every Nth eval plus the final step —
-    each skipped save skips the full device->host state fetch."""
+    but checkpoints land only at the FIRST eval (so a crash early in
+    the run never resumes from step 0 — ADVICE r4), every Nth eval, and
+    the final step — each skipped save skips the full device->host
+    state fetch."""
     cfg = override(smoke_cfg, [
         "train.steps=60", "train.eval_every=10", "train.save_every_evals=3",
     ])
@@ -397,8 +399,9 @@ def test_fit_save_every_evals_gates_checkpoints(smoke_cfg, data_dir, tmp_path):
              if r.get("kind") == "eval"]
     assert evals == [10, 20, 30, 40, 50, 60]
     ck = ckpt_lib.Checkpointer(workdir)
-    # due: (step // 10) % 3 == 0 -> 30, 60; final 60 always due anyway
-    assert ck.all_steps() == {30, 60}
+    # due: ordinal 1 -> 10; (step // 10) % 3 == 0 -> 30, 60 (final
+    # always due anyway)
+    assert ck.all_steps() == {10, 30, 60}
     ck.close()
 
 
