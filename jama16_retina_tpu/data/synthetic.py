@@ -123,13 +123,22 @@ def make_dataset(
     cfg: SynthConfig | None = None,
     grades: np.ndarray | None = None,
     seed: int = 0,
+    grade_marginals=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Generate (images[n,s,s,3] uint8, grades[n] int32). Grade marginals
-    roughly follow EyePACS's skew toward grade 0 unless `grades` given."""
+    roughly follow EyePACS's skew toward grade 0 unless `grades` given.
+
+    ``grade_marginals`` replaces GRADE_MARGINALS in the grade draw (the
+    distribution-shift knob behind scripts/cross_dataset_transfer.py)
+    while keeping the one-stream discipline: the draw stays FIRST on
+    the seed's rng and rendering continues on the same stream, so
+    labels and render noise never share stream positions — and
+    marginals == GRADE_MARGINALS reproduces the default path
+    byte-identically."""
     cfg = cfg or SynthConfig()
     rng = np.random.default_rng(seed)
     if grades is None:
-        grades = sample_grades(n, rng)
+        grades = sample_grades(n, rng, grade_marginals)
     grades = np.asarray(grades, dtype=np.int32)
     images = np.stack([render_fundus(rng, int(g), cfg) for g in grades])
     return images, grades
@@ -141,12 +150,29 @@ def binary_labels(grades: np.ndarray) -> np.ndarray:
     return (np.asarray(grades) >= 2).astype(np.int32)
 
 
-def sample_grades(n: int, rng: np.random.Generator) -> np.ndarray:
+def sample_grades(
+    n: int, rng: np.random.Generator, marginals=None
+) -> np.ndarray:
     """The grade draw make_dataset performs FIRST on its rng — exposed so
     callers can reproduce a split's grades from its seed without paying
     for image rendering (scripts/time_to_auc.py regenerates the val
-    grades this way to compute the realized noisy-AUC ceiling)."""
-    return rng.choice(5, size=n, p=list(GRADE_MARGINALS))
+    grades this way to compute the realized noisy-AUC ceiling).
+    ``marginals`` defaults to GRADE_MARGINALS; a custom vector must be
+    5 probabilities summing to 1."""
+    if marginals is None:
+        marginals = GRADE_MARGINALS
+    marg = np.asarray(marginals, np.float64)
+    if marg.shape != (5,) or np.any(marg < 0) or not np.isclose(
+        marg.sum(), 1.0
+    ):
+        raise ValueError(
+            f"grade_marginals must be 5 probabilities summing to 1, "
+            f"got {marginals!r}"
+        )
+    # Normalize residue inside our (looser) isclose gate: rng.choice's
+    # own sum check is ~1e-8-tight and would raise a generic numpy
+    # error for hand-typed marginals that pass the named check above.
+    return rng.choice(5, size=n, p=list(marg / marg.sum()))
 
 
 def flip_binary_labels(

@@ -157,6 +157,8 @@ def write_synthetic_split(
     seed: int = 0,
     encoding: str = "jpeg",
     label_noise: float = 0.0,
+    synth_cfg=None,
+    grade_marginals=None,
 ) -> list[str]:
     """Test/bench fixture: synthetic fundus images -> real TFRecord shards,
     so the whole online pipeline is exercised byte-identically to how it
@@ -167,11 +169,20 @@ def write_synthetic_split(
     grade) — see synthetic.flip_binary_labels for why this is the
     fixture's difficulty control. The flip stream is derived from
     ``seed`` independently of the render stream, so the same seed with
-    and without noise yields byte-identical images."""
+    and without noise yields byte-identical images.
+
+    ``synth_cfg`` (a synthetic.SynthConfig; its image_size wins over the
+    ``image_size`` arg) and ``grade_marginals`` (length-5 probability
+    vector replacing synthetic.GRADE_MARGINALS) exist to write
+    DISTRIBUTION-SHIFTED datasets — subtler lesions, different
+    referable prevalence — for the cross-dataset threshold-transfer
+    protocol (BASELINE.json:8's EyePACS→Messidor-2 clause;
+    scripts/cross_dataset_transfer.py)."""
     from jama16_retina_tpu.data import synthetic
 
+    cfg = synth_cfg or synthetic.SynthConfig(image_size=image_size)
     images, grades = synthetic.make_dataset(
-        n, synthetic.SynthConfig(image_size=image_size), seed=seed
+        n, cfg, seed=seed, grade_marginals=grade_marginals
     )
     if label_noise:
         grades = synthetic.flip_binary_labels(

@@ -47,6 +47,11 @@ def main() -> None:
     from jama16_retina_tpu.configs import get_config, override
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
+    # Same persistent jit cache as bench.py: 8 cells x ~60-90 s TPU
+    # compile otherwise dominates the experiment's wall time.
+    mesh_lib.enable_persistent_compilation_cache(
+        os.environ.get("BENCH_JIT_CACHE", "/tmp/retina_bench_jitcache")
+    )
     cfg = get_config("eyepacs_binary")
     size = cfg.model.image_size
     batch_size = cfg.data.batch_size

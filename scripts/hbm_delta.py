@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Decompose the pipeline_fed_hbm vs device_only delta (VERDICT r4 #5).
+
+BENCH r3 measured the hbm-resident loader at 99% of device-only; r4 at
+94%; PERF.md's claim had to say which is real. The two rows are measured
+MINUTES apart in a bench run on a tunnel whose fixed costs drift hour to
+hour, so the honest experiment is INTERLEAVED A/B in one process with
+the same compiled step:
+
+  A) device_only window — the step fed pre-placed device batches;
+  B) hbm window — the same step fed by hbm_pipeline.train_batches
+     (per-step on-device gather from the resident pool + one host
+     dispatch of the gather).
+
+3 repeats each, alternating, same fencing as bench. The A-B gap within
+one interleaved run is the loader's true per-step cost; variance ACROSS
+repeats is the tunnel's drift. Writes docs/hbm_delta_r5.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    import bench
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.data import hbm_pipeline
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.enable_persistent_compilation_cache(
+        os.environ.get("BENCH_JIT_CACHE", "/tmp/retina_bench_jitcache")
+    )
+    cfg = get_config("eyepacs_binary")
+    size = cfg.model.image_size
+    batch_size = cfg.data.batch_size
+    mesh = mesh_lib.make_mesh(1)
+
+    dirs = bench._ensure_bench_data(size)
+    step, state, batches, key = bench.build_train_fixture(
+        cfg, mesh, batch_size
+    )
+    t0 = time.time()
+    hbm_it = hbm_pipeline.train_batches(
+        dirs["raw"], "train", cfg.data, size, seed=0, mesh=mesh
+    )
+    bench._fence(next(hbm_it)["image"])
+    load_sec = time.time() - t0
+
+    rows = []
+    for rep in range(3):
+        r_dev, state = bench._timed_steps(
+            step, state, lambda i: batches[i % len(batches)], key,
+            bench.TIMED_STEPS, batch_size, 1,
+        )
+        r_hbm, state = bench._timed_steps(
+            step, state, lambda i: next(hbm_it), key,
+            bench.TIMED_STEPS, batch_size, 1,
+        )
+        ms_dev = 1000.0 * batch_size / r_dev
+        ms_hbm = 1000.0 * batch_size / r_hbm
+        rows.append({
+            "rep": rep,
+            "device_only_img_s": round(r_dev, 1),
+            "hbm_fed_img_s": round(r_hbm, 1),
+            "ratio": round(r_hbm / r_dev, 4),
+            "per_step_ms_device": round(ms_dev, 3),
+            "per_step_ms_hbm": round(ms_hbm, 3),
+            "loader_cost_ms_per_step": round(ms_hbm - ms_dev, 3),
+        })
+        print(
+            f"rep {rep}: device {r_dev:.1f} vs hbm {r_hbm:.1f} img/s "
+            f"(ratio {r_hbm / r_dev:.3f}, loader cost "
+            f"{ms_hbm - ms_dev:.2f} ms/step)",
+            file=sys.stderr,
+        )
+
+    out = {
+        "protocol": (
+            "interleaved A/B, same compiled step, bench fencing; the "
+            "within-run gap is the hbm loader's per-step cost (on-device "
+            "gather + its dispatch); across-rep variance is tunnel drift"
+        ),
+        "hbm_one_time_load_sec": round(load_sec, 2),
+        "rows": rows,
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "hbm_delta_r5.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": path}))
+
+
+if __name__ == "__main__":
+    main()

@@ -105,6 +105,54 @@ def test_write_synthetic_split_label_noise(tmp_path):
     assert 0 < n_flip < 64
 
 
+def test_write_synthetic_split_shifted_distribution(tmp_path):
+    """The shift knobs behind the cross-dataset transfer artifact
+    (scripts/cross_dataset_transfer.py): custom grade marginals move the
+    written prevalence, a custom SynthConfig changes the rendered
+    images, and malformed marginals are refused loudly."""
+    import pytest
+
+    from jama16_retina_tpu.data import synthetic, tfrecord
+    from jama16_retina_tpu.data.grain_pipeline import FundusSource
+
+    d = str(tmp_path)
+    marg = (0.2, 0.1, 0.3, 0.2, 0.2)  # prevalence 0.70 vs default 0.30
+    tfrecord.write_synthetic_split(
+        d, "shift", 200, image_size=32, num_shards=1, seed=5,
+        encoding="raw", grade_marginals=marg,
+        synth_cfg=synthetic.SynthConfig(
+            image_size=32, lesions_per_grade=2, lesion_radius=1
+        ),
+    )
+    tfrecord.write_synthetic_split(
+        d, "base", 200, image_size=32, num_shards=1, seed=5, encoding="raw"
+    )
+    shift, base = FundusSource(d, "shift", 32), FundusSource(d, "base", 32)
+    prev = np.mean([shift[i]["grade"] >= 2 for i in range(200)])
+    assert 0.55 < prev < 0.85  # binomial(200, 0.70) comfortably inside
+    # Same seed, different SynthConfig+grades: images must differ.
+    assert any(
+        not np.array_equal(shift[i]["image"], base[i]["image"])
+        for i in range(10)
+    )
+    # One-stream discipline: explicitly passing the DEFAULT marginals
+    # must reproduce the default path byte-identically (the grade draw
+    # stays first on the seed's rng; labels and render noise never
+    # share stream positions).
+    tfrecord.write_synthetic_split(
+        d, "ctrl", 200, image_size=32, num_shards=1, seed=5,
+        encoding="raw", grade_marginals=synthetic.GRADE_MARGINALS,
+    )
+    ctrl = FundusSource(d, "ctrl", 32)
+    for i in range(0, 200, 37):
+        np.testing.assert_array_equal(ctrl[i]["image"], base[i]["image"])
+        assert ctrl[i]["grade"] == base[i]["grade"]
+    with pytest.raises(ValueError, match="grade_marginals"):
+        tfrecord.write_synthetic_split(
+            d, "bad", 4, image_size=32, grade_marginals=(0.5, 0.5)
+        )
+
+
 def test_sample_grades_is_make_datasets_first_draw():
     """The realized-ceiling path (scripts/time_to_auc.py) reproduces a
     split's grades from its seed via sample_grades — which must stay the
