@@ -247,6 +247,44 @@ ENSEMBLE_ARGS = [
 ]
 
 
+def _first_member_losses(w):
+    """Per-member losses of train step 1 from a workdir's JSONL — the
+    tight cross-run pin (identical global batches, one reduce of
+    noise)."""
+    losses = next(
+        r["loss_per_member"]
+        for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
+        if r["kind"] == "train" and r["step"] == 1
+    )
+    assert len(losses) == 2
+    return losses
+
+
+def _compare_member_checkpoints(w1, w2, k=2):
+    """Restore both runs' final per-member checkpoints and compare to
+    the reduce-order envelope (a sharding/data-partition bug is O(1),
+    orders beyond it). The restore cfg must mirror COMMON_ARGS'
+    numeric fields (optimizer choice shapes the opt_state tree)."""
+    cfg = override(get_config("smoke"), [
+        "train.steps=4", "data.augment=false", "model.dropout_rate=0.0",
+        "train.optimizer=sgdm",
+    ])
+    model = models.build(cfg.model)
+    for m in range(k):
+        states = []
+        for w in (w1, w2):
+            st, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+            ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(w, m))
+            states.append(ck.restore(
+                ckpt_lib.abstract_like(jax.device_get(st)), ck.latest_step
+            ))
+            ck.close()
+        for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-3
+            )
+
+
 @pytest.mark.slow
 def test_two_process_member_parallel_hbm_loader_runs(tmp_path):
     """Member-parallel + hbm loader on multi-host: the hbm batch is born
@@ -288,15 +326,57 @@ def test_two_process_member_parallel_hbm_loader_runs(tmp_path):
     finals = [json.loads(o.strip().splitlines()[-1]) for o in outs]
     assert finals[0]["results"] == finals[1]["results"]
 
-    def first_losses(w):
-        return next(
-            r["loss_per_member"]
-            for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
-            if r["kind"] == "train" and r["step"] == 1
-        )
+    np.testing.assert_allclose(
+        _first_member_losses(w1), _first_member_losses(w2), atol=5e-5
+    )
 
-    np.testing.assert_allclose(first_losses(w1), first_losses(w2),
-                               atol=5e-5)
+
+@pytest.mark.slow
+def test_two_process_manual_data_matches_single_process(tmp_path):
+    """The fully-manual shard_map form (train.ensemble_manual_data,
+    round 5) under REAL multi-process collectives: its explicit
+    loss/BN pmeans ride Gloo across two OS processes over the
+    ('member': 2, 'data': 2) mesh. Pinned against the single-process
+    4-device manual run — a wrong-recipe gradient (the shard_map
+    psum-self-transpose trap, MULTIHOST.md §Full-manual) or a
+    mis-sharded batch would diverge at step 1."""
+    data_dir = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 64, 1, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 24, 64, 1, seed=2)
+    args = ENSEMBLE_ARGS + ["--set", "train.ensemble_manual_data=true"]
+
+    w1 = str(tmp_path / "one_proc")
+    p = _run_train(data_dir, w1, 4, str(tmp_path / "one.log"),
+                   extra_args=args)
+    out = _wait(p)
+    assert p.returncode == 0, f"single-process manual run failed:\n{out[-3000:]}"
+
+    w2 = str(tmp_path / "two_proc")
+    port = _free_port()
+    procs = [
+        _run_train(
+            data_dir, w2, 2, str(tmp_path / f"mp{i}.log"),
+            env={
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": "2",
+                "JAX_PROCESS_ID": str(i),
+            },
+            extra_args=args,
+        )
+        for i in range(2)
+    ]
+    outs = [_wait(p) for p in procs]
+    assert all(p.returncode == 0 for p in procs), (
+        f"two-process manual run failed:\np0:\n{outs[0][-3000:]}\n"
+        f"p1:\n{outs[1][-3000:]}"
+    )
+    finals = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    assert finals[0]["results"] == finals[1]["results"]
+
+    np.testing.assert_allclose(
+        _first_member_losses(w1), _first_member_losses(w2), atol=5e-5
+    )
+    _compare_member_checkpoints(w1, w2)
 
 
 @pytest.mark.slow
@@ -340,34 +420,9 @@ def test_two_process_member_parallel_matches_single_process(tmp_path):
     assert finals[0]["results"] == finals[1]["results"]
 
     # Same global batches (full stream on every host) -> per-member
-    # first-step losses match the single-process stacked run tightly.
-    def first_losses(w):
-        return next(
-            r["loss_per_member"]
-            for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
-            if r["kind"] == "train" and r["step"] == 1
-        )
-
-    l1, l2 = first_losses(w1), first_losses(w2)
-    assert len(l1) == len(l2) == 2
-    np.testing.assert_allclose(l1, l2, atol=5e-5)
-
-    # Both members' final checkpoints agree across the two runs.
-    cfg = override(get_config("smoke"), [
-        "train.steps=4", "data.augment=false", "model.dropout_rate=0.0",
-        "train.optimizer=sgdm",
-    ])
-    model = models.build(cfg.model)
-    for m in range(2):
-        states = []
-        for w in (w1, w2):
-            st, _ = train_lib.create_state(cfg, model, jax.random.key(0))
-            ck = ckpt_lib.Checkpointer(ckpt_lib.member_dir(w, m))
-            states.append(ck.restore(
-                ckpt_lib.abstract_like(jax.device_get(st)), ck.latest_step
-            ))
-            ck.close()
-        for a, b in zip(jax.tree.leaves(states[0]), jax.tree.leaves(states[1])):
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-3
-            )
+    # first-step losses match the single-process stacked run tightly;
+    # both members' final checkpoints agree across the two runs.
+    np.testing.assert_allclose(
+        _first_member_losses(w1), _first_member_losses(w2), atol=5e-5
+    )
+    _compare_member_checkpoints(w1, w2)
