@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Per-chip batch amortization curve for the flagship step (PERF §Pod).
+
+`device_only_b4` (round 5) measured the v3-8 north-star shard; this
+script fills in the curve between the protocol's 4 images/chip and the
+chip's b128 sweet spot — the quantitative basis for §Pod's topology
+arguments (member-parallel's whole value is moving per-chip batch UP
+this curve at fixed global batch). One process, shared fixture, bench
+fencing + physics guard per point. Writes docs/batch_curve_r5.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCHES = (4, 8, 16, 32, 64, 128)
+
+
+def main() -> None:
+    import jax
+
+    import bench
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.enable_persistent_compilation_cache(
+        os.environ.get("BENCH_JIT_CACHE", "/tmp/retina_bench_jitcache")
+    )
+    cfg = get_config("eyepacs_binary")
+    mesh = mesh_lib.make_mesh(1)
+    peak = bench._peak_flops()
+
+    rows = []
+    for b in BATCHES:
+        # The bench fixture AT this batch size: same step builder, same
+        # N_DISTINCT_BATCHES batch construction — curve points stay
+        # comparable to the bench headline by construction, not by
+        # re-implementation.
+        step, state, batches, key = bench.build_train_fixture(cfg, mesh, b)
+        flops = bench._flops_of(step, state, batches[0], key)
+        n_steps = max(20, 400 // b)  # keep windows >~0.5 s at small b
+        t0 = time.time()
+        rate, state = bench._timed_steps(
+            step, state, lambda i: batches[i % len(batches)], key,
+            n_steps, b, 1,
+        )
+        wall = time.time() - t0
+        guarded = bench._physics_guard(
+            f"b{b}", rate, flops / b if flops else None, peak
+        )
+        if guarded is None:
+            # Refused rates publish NOTHING derived from them.
+            rows.append({
+                "batch_per_chip": b, "images_per_sec": None,
+                "refused": "rate exceeds FLOP physics ceiling",
+            })
+            continue
+        rows.append({
+            "batch_per_chip": b,
+            "images_per_sec": round(guarded, 2),
+            "ms_per_step": round(1000.0 * b / guarded, 3),
+            "timed_steps": n_steps,
+            "section_wall_sec": round(wall, 1),
+        })
+        print(f"b{b}: {guarded:.1f} img/s ({1000.0 * b / guarded:.2f} "
+              f"ms/step) [{wall:.0f}s incl compile]",
+              file=sys.stderr, flush=True)
+
+    out = {
+        "config": "eyepacs_binary (299px, bf16, aux on, pallas augment)",
+        "device": str(jax.devices()[0]),
+        "protocol": "bench._timed_steps per point, shared donated state, "
+                    "physics-guarded",
+        "rows": rows,
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "batch_curve_r5.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": path}))
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,8 @@ _QUICK_TESTS = {
     # one real end-to-end train->checkpoint->evaluate (shared fixture)
     "test_integration.py::test_fit_improves_and_checkpoints",
     "test_integration.py::test_evaluate_checkpoints_report",
+    # predict CLI contract (no training: the loud missing-ckpt path)
+    "test_predict.py::test_predict_cli_requires_checkpoint",
 }
 
 

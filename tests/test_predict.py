@@ -181,8 +181,10 @@ def test_predict_cli_min_quality_flags_blurred(setup):
     assert rows["sharp.png"]["gradable"] is True
 
 
-@pytest.mark.slow
 def test_predict_cli_requires_checkpoint(setup):
+    # Not slow-marked: the fixture is random-init (no training) and the
+    # subprocess exits at flag validation — ~15 s, cheap enough for the
+    # quick tier's predict-CLI pin.
     _, _, imgdir = setup
     res = run_predict(["--config=smoke", f"--images={imgdir}", "--device=cpu"])
     assert res.returncode != 0
