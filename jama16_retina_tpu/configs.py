@@ -127,6 +127,15 @@ class TrainConfig:
     # 1.56 GB ~= 48 s/eval on this environment's tunnel, >10x the eval
     # forward itself — docs/PERF.md §Eval).
     save_every_evals: int = 1
+    # Always checkpoint the FIRST eval too (ADVICE r4): without it a
+    # sparse-save run has no checkpoint until ordinal save_every_evals
+    # and a crash in that window resumes from step 0. Default on — the
+    # right call on real hardware where a save is cheap. Opt out when
+    # the save is the experiment's dominant cost and the early crash
+    # window is an accepted trade (scripts/time_to_auc.py: a k=4
+    # stacked-state fetch is ~48 s on this environment's tunnel and
+    # would land BEFORE the crossing being measured).
+    save_first_eval: bool = True
     # loss-scale epsilon for label smoothing on the multi head
     label_smoothing: float = 0.0
     gradient_clip_norm: float = 0.0  # 0 disables

@@ -628,14 +628,16 @@ def _save_due(cfg: ExperimentConfig, step: int) -> bool:
     loop-local counter, so resume keeps the same save cadence. The final
     step is always due (the run must end durable); so is a stopping
     eval (forced inside _eval_and_track / the member-parallel block);
-    so is the FIRST eval (ordinal 1) — without it a fresh run has no
-    checkpoint until ordinal n, and a crash in that window resumes from
-    step 0 (ADVICE r4)."""
+    so is the FIRST eval (ordinal 1) under train.save_first_eval
+    (default on; ADVICE r4) — without it a fresh run has no checkpoint
+    until ordinal n, and a crash in that window resumes from step 0."""
     if step >= cfg.train.steps:
         return True
     n = max(1, cfg.train.save_every_evals)
     ordinal = step // cfg.train.eval_every
-    return ordinal == 1 or ordinal % n == 0
+    if cfg.train.save_first_eval and ordinal == 1:
+        return True
+    return ordinal % n == 0
 
 
 def _eval_and_track(

@@ -238,6 +238,14 @@ def main(argv=None) -> dict:
         # recipe room past the first crossing for the final protocol.
         "train.early_stop_patience=4",
         f"train.save_every_evals={args.save_every_evals}",
+        # The first-eval crash-window save (train.save_first_eval,
+        # ADVICE r4) is OFF here BY PROTOCOL: this script measures
+        # wall-clock to the crossing eval, and a k-member stacked-state
+        # fetch (~48 s for k=4 on this tunnel, docs/PERF.md §Eval)
+        # landing at eval 1 would inflate every crossing by that fetch.
+        # The trade is explicit: a crash before the first due save
+        # restarts this bounded, minutes-scale run from step 0.
+        "train.save_first_eval=false",
         *overrides,
     ])
 
@@ -331,6 +339,10 @@ def main(argv=None) -> dict:
             "eval_every": args.eval_every, "train_n": args.train_n,
             "seed": args.seed, "ensemble_parallel": True,
             "save_every_evals": args.save_every_evals,
+            # Protocol override (see the cfg construction): the first-
+            # eval crash-window save is off so the crossing never pays
+            # an early state fetch; a replay must set this too.
+            "save_first_eval": False,
             "warmup_steps": warmup, "ema_decay": cfg.train.ema_decay,
             "label_smoothing": cfg.train.label_smoothing,
             "tta": cfg.eval.tta,

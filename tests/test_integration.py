@@ -405,6 +405,25 @@ def test_fit_save_every_evals_gates_checkpoints(smoke_cfg, data_dir, tmp_path):
     ck.close()
 
 
+def test_save_due_first_eval_flag():
+    """train.save_first_eval: on (default) the first eval is always
+    due (no crash window that resumes from step 0 — ADVICE r4); off,
+    the pre-round-5 pure-ordinal cadence holds (scripts/time_to_auc.py
+    opts out so the measured crossing never pays an early state
+    fetch). Pure-function pin — the end-to-end default-on behavior is
+    covered by test_fit_save_every_evals_gates_checkpoints."""
+    from jama16_retina_tpu.configs import get_config, override
+
+    base = override(get_config("smoke"), [
+        "train.steps=60", "train.eval_every=10", "train.save_every_evals=3",
+    ])
+    due = [s for s in range(10, 61, 10) if trainer._save_due(base, s)]
+    assert due == [10, 30, 60]
+    off = override(base, ["train.save_first_eval=false"])
+    due_off = [s for s in range(10, 61, 10) if trainer._save_due(off, s)]
+    assert due_off == [30, 60]
+
+
 def test_fit_stopping_eval_saves_even_when_not_due(smoke_cfg, data_dir, tmp_path):
     """An early-stopping eval must checkpoint even if its ordinal is not
     save-due — the run has to end durable (best + latest exist)."""
