@@ -32,6 +32,16 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
   eval_images_per_sec — the jit eval step (forward-only, eval batch) on
                        this chip: the per-model cost of the k-model
                        ensemble evaluation protocol (BASELINE.json:10).
+  pipeline_fed_tiered — the tiered loader (data/tiered_pipeline.py) at
+                       a pinned 7/8-resident budget: most rows served
+                       from the HBM spill cache, the rest decoded by the
+                       parallel host stage and staged per shard. The
+                       ramp datapoint between pipeline_fed (0% resident)
+                       and pipeline_fed_hbm (100%). Companion keys:
+                       tiered_load_sec, tiered_resident_fraction, and
+                       tiered_zero_budget_fallback_ok (budget-0 batches
+                       verified bit-identical to an independent
+                       host-decoded reference of the streamed tier).
   ensemble4_member_images_per_sec / ensemble4_parallel_speedup —
                        the member-parallel ensemble step (4 stacked
                        members, train_lib.make_ensemble_train_step) in
@@ -40,6 +50,11 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        this sits near 1.0 (weight/optimizer HBM traffic
                        scales with members); the capability's payoff is
                        pod topology — see configs.py ensemble_parallel.
+                       A measured ratio < 1.0 is never published as a
+                       speedup: the key is withheld and the value lands
+                       in ensemble4_parallel_gated with a logged reason
+                       (trainer.fit_ensemble auto-falls back to the
+                       sequential driver on 1-device meshes to match).
 
 Workload = the production config of record (BASELINE.json:7): Inception-v3,
 binary head, 299x299, global batch 32, aux head on, bf16 compute — the
@@ -220,6 +235,57 @@ def _physics_guard(name: str, rate: float, flops_per_image: "float | None",
              f"TFLOP/s; key refused")
         return None
     return rate
+
+
+def tiered_resident_bytes(n_images: int, image_size: int) -> int:
+    """The pinned partial-residency budget the tiered section measures
+    at: 7/8 of the synthetic split resident, 1/8 streamed. Chosen so the
+    steady-state H2D shrinks ~8x vs the fully streamed row — enough to
+    clear the >= 3x acceptance bar on a tunnel-limited host while still
+    exercising a REAL mixed-tier batch every step."""
+    from jama16_retina_tpu.data import hbm_pipeline
+
+    return hbm_pipeline.row_bytes(image_size) * (n_images * 7 // 8)
+
+
+def tiered_residency_plan(n_images: int, image_size: int,
+                          batch_size: int = 32) -> float:
+    """Fraction of the split the tiered section's budget actually pins
+    (plan_residency rounds the per-batch quota down), for the log line
+    and the bench-guard test."""
+    from jama16_retina_tpu.data import hbm_pipeline, tiered_pipeline
+
+    capacity = hbm_pipeline.resident_row_capacity(
+        image_size, budget_bytes=tiered_resident_bytes(n_images, image_size)
+    )
+    _, _, n_res = tiered_pipeline.plan_residency(
+        n_images, batch_size, capacity
+    )
+    return n_res / n_images
+
+
+def _gate_ensemble_speedup(extras: dict, rate: float,
+                           device_only: float) -> None:
+    """Publish ensemble4_parallel_speedup ONLY when the stacked path is
+    actually a speedup; a measured slowdown is auto-disabled with a
+    logged reason and recorded under ..._gated instead (mirroring
+    trainer.fit_ensemble's single-device fallback), so the report can
+    never again ship a <1.0 'speedup' as if it were the production
+    path."""
+    # Gate on the UNROUNDED ratio: a 0.996 slowdown must not round up
+    # to a published "1.0 speedup". Round only for display.
+    speedup = rate / device_only
+    if speedup >= 1.0:
+        extras["ensemble4_parallel_speedup"] = round(speedup, 2)
+        return
+    extras["ensemble4_parallel_gated"] = round(speedup, 2)
+    _log(
+        f"ensemble4 stacked step is SLOWER than sequential members on "
+        f"this chip ({speedup:.3f}x < 1.0: weight/optimizer HBM traffic "
+        f"scales with members) — speedup key gated; "
+        f"trainer.fit_ensemble auto-falls back to the sequential driver "
+        f"on 1-device meshes for the same reason"
+    )
 
 
 def _ensure_bench_data(image_size: int) -> dict:
@@ -503,6 +569,70 @@ def main() -> None:
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"hbm pipeline bench failed: {type(e).__name__}: {e}")
 
+        # Tiered loader (data.loader=tiered): partial HBM residency —
+        # pin most rows, stream the rest through the parallel host
+        # decoder with staged H2D. Measured at a PINNED partial budget
+        # (the all-or-nothing hbm row above is the 100% endpoint, the
+        # streamed row the 0% endpoint) so the ramp between them is a
+        # real datapoint, not an extrapolation. Also asserts the
+        # zero-budget fallback is bit-identical to the streamed tier.
+        try:
+            from jama16_retina_tpu.data import tiered_pipeline
+
+            frac = tiered_residency_plan(BENCH_N_IMAGES, size)
+            t_cfg = dataclasses.replace(
+                cfg.data,
+                tiered_resident_bytes=tiered_resident_bytes(
+                    BENCH_N_IMAGES, size
+                ),
+            )
+            t0 = time.time()
+            tiered_it = tiered_pipeline.train_batches(
+                dirs["raw"], "train", t_cfg, size, seed=0, mesh=mesh
+            )
+            _fence(next(tiered_it)["image"])  # resident decode + upload
+            extras["tiered_load_sec"] = round(time.time() - t0, 2)
+            extras["tiered_resident_fraction"] = round(frac, 3)
+            rate, state = _timed_steps(
+                step, state, lambda i: next(tiered_it), key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            _publish(
+                extras, "pipeline_fed_tiered", rate, flops_per_image, peak,
+                suffix=(f" (tiered loader, {frac:.0%} HBM-resident; "
+                        f"one-time load {extras['tiered_load_sec']}s)"),
+            )
+
+            # Zero-budget fallback pin: the first batches of a
+            # budget-0 tiered stream must be bit-identical to the
+            # INDEPENDENT host-decoded reference sequence (plan ->
+            # record ids -> direct decode; no staging/combine jit), so
+            # the check can actually fail if the streamed tier's device
+            # plumbing ever corrupts, reorders, or re-derives batches.
+            z_cfg = dataclasses.replace(cfg.data, tiered_resident_bytes=0)
+            a_it = tiered_pipeline.train_batches(
+                dirs["raw"], "train", z_cfg, size, seed=0, mesh=mesh
+            )
+            b_it = tiered_pipeline.host_reference_batches(
+                dirs["raw"], "train", cfg.data, size, seed=0,
+                capacity_rows=0,
+            )
+            for _ in range(3):
+                a, b = next(a_it), next(b_it)
+                if not (
+                    np.array_equal(np.asarray(a["image"]),
+                                   np.asarray(b["image"]))
+                    and np.array_equal(np.asarray(a["grade"]),
+                                       np.asarray(b["grade"]))
+                ):
+                    raise RuntimeError(
+                        "tiered loader at budget 0 diverged from the "
+                        "streamed path — fallback contract broken"
+                    )
+            extras["tiered_zero_budget_fallback_ok"] = True
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"tiered pipeline bench failed: {type(e).__name__}: {e}")
+
     # Eval-side rate: the forward-only jit eval step at the eval batch
     # size — multiply by k models x test-set size for the ensemble
     # evaluation cost (ten-model protocol, BASELINE.json:10).
@@ -626,9 +756,7 @@ def main() -> None:
                 # serialized-fallback headline is deliberately
                 # pessimistic, and dividing the pipelined ensemble rate
                 # by it would overstate the speedup.
-                extras["ensemble4_parallel_speedup"] = round(
-                    rate / device_only, 2
-                )
+                _gate_ensemble_speedup(extras, rate, device_only)
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"ensemble bench failed: {type(e).__name__}: {e}")
 

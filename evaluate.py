@@ -84,7 +84,9 @@ def main(argv):
 
         jax.config.update("jax_platforms", "cpu")
         if _FAKE_DEVICES.value:
-            jax.config.update("jax_num_cpu_devices", _FAKE_DEVICES.value)
+            from jama16_retina_tpu.parallel import mesh as _mesh_compat
+
+            _mesh_compat.configure_fake_cpu_devices(_FAKE_DEVICES.value)
 
     # Multi-host bring-up BEFORE anything touches a jax backend (no-op
     # unless a coordinator is configured; SURVEY.md §3.5).

@@ -66,8 +66,39 @@ class DataConfig:
     # (data/grain_pipeline.py); "hbm" = whole split resident in device
     # memory, per-step on-device gather, zero steady-state H2D — for
     # splits that fit the HBM budget (data/hbm_pipeline.py, docs/PERF.md
-    # §H2D). Same {'image','grade'} batch contract.
+    # §H2D); "tiered" = partial HBM residency — pin as many rows as the
+    # budget allows, stream the rest through the parallel host decoder
+    # with overlapped per-shard H2D staging, so throughput degrades
+    # gracefully from the HBM-resident rate toward the streamed floor
+    # instead of cliffing when the split outgrows HBM
+    # (data/tiered_pipeline.py). Same {'image','grade'} batch contract.
     loader: str = "tfdata"
+    # Host decode worker THREADS for the tiered loader's streamed tier
+    # and the hbm/tiered one-time resident load
+    # (grain_pipeline.ParallelDecoder). 0 = auto: one per host core up
+    # to 8, leaving a core for device dispatch
+    # (grain_pipeline.resolve_decode_workers). Batch contents are
+    # worker-count-invariant by construction (deterministic ordering),
+    # so this is a pure throughput knob.
+    decode_workers: int = 0
+    # Tiered loader only: how many batches the loader keeps decoded +
+    # dispatched AHEAD of consumption (its internal staging queue, on
+    # top of prefetch_batches in the trainer's device_prefetch). 0 =
+    # auto: max(2, prefetch_batches).
+    stage_depth: int = 0
+    # Tiered loader only: TOTAL bytes of HBM (across the mesh's data
+    # axis) the resident tier may pin. -1 = auto-derive from the device
+    # budget (hbm_pipeline.hbm_budget_bytes x data-axis size); 0 = pin
+    # nothing (pure streamed mode — bit-identical batch sequence to
+    # tiered_pipeline.streamed_batches); >0 = explicit cap (what bench
+    # and tests use for reproducible partial residency).
+    tiered_resident_bytes: int = -1
+    # Route the tf.data loader's device placement through per-shard H2D
+    # staging (pipeline.device_prefetch per_shard): each device's row
+    # block is device_put separately so individual shard copies overlap
+    # the train step instead of one whole-batch put. Single-process
+    # meshes only (multi-process assembly already places per-device).
+    stage_per_shard: bool = False
     # grain loader only: number of worker PROCESSES decoding in parallel
     # (0 = in-process). Multi-core TPU hosts want >0; resume then runs
     # off per-checkpoint persisted iterator state instead of the
@@ -169,6 +200,15 @@ class TrainConfig:
     # state to replicated before host gathers (docs/MULTIHOST.md;
     # pinned 2-process vs single-process in tests/test_multiprocess.py).
     ensemble_parallel: bool = False
+    # Measured-speedup gate on the stacked path: single-chip the stacked
+    # step runs BELOW the sequential member rate (bench
+    # ensemble4_parallel_speedup 0.85-0.89 across rounds — weight/
+    # optimizer HBM traffic scales with members while batch does not),
+    # so fit_ensemble auto-falls back to the sequential driver on
+    # 1-device meshes, with a logged reason, rather than ship a known
+    # slowdown. Set true to force the stacked path anyway (e.g. to
+    # measure it, or when dispatch overhead dominates on a new chip).
+    ensemble_parallel_force: bool = False
     # Run the member-parallel step with the DATA axis manual too (full
     # jax.shard_map; train_lib.make_ensemble_train_step manual_data):
     # every collective is explicit — the loss pmean whose backward IS

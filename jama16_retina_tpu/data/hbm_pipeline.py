@@ -53,42 +53,70 @@ from jama16_retina_tpu.data import tfrecord
 
 
 def _decode_rows(
-    index, start: int, stop: int, image_size: int, n: "int | None" = None
+    index, start: int, stop: int, image_size: int, n: "int | None" = None,
+    workers: int = 1,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Rows [start, stop) of a TFRecordIndex into preallocated uint8/i32
     arrays — THE decode loop, shared by the full single-process load and
     the per-shard multi-host load (the 2-process ≡ 1-process pin depends
     on both paths decoding identically). ``n``: wrap row ids past the
     true record count (the multi-host padding rows reuse leading
-    records as filler)."""
-    from jama16_retina_tpu.data.grain_pipeline import _decode_example
+    records as filler). ``workers`` > 1 shards the loop across host
+    cores via grain_pipeline.ParallelDecoder.decode_range, whose output
+    is worker-count-invariant (disjoint preallocated slices), so the
+    2-process ≡ 1-process pin survives parallel decode."""
+    from jama16_retina_tpu.data.grain_pipeline import ParallelDecoder
 
-    images = np.empty((stop - start, image_size, image_size, 3), np.uint8)
-    grades = np.empty((stop - start,), np.int32)
-    for i in range(start, stop):
-        row = _decode_example(index.read(i % n if n else i), image_size)
-        images[i - start] = row["image"]
-        grades[i - start] = row["grade"]
-    return images, grades
+    decoder = ParallelDecoder(index, image_size, workers=workers)
+    try:
+        return decoder.decode_range(start, stop, n=n)
+    finally:
+        decoder.close()
 
 
 def load_split_numpy(
-    data_dir: str, split: str, image_size: int
+    data_dir: str, split: str, image_size: int, workers: int = 1
 ) -> tuple[np.ndarray, np.ndarray]:
     """All records of a split, decoded on host once:
     (images u8[N,S,S,3], grades i32[N]). Reuses the grain loader's
-    TF-free record index + proto decode (data/grain_pipeline.py)."""
+    TF-free record index + proto decode (data/grain_pipeline.py);
+    ``workers`` parallelizes the one-time decode across host cores."""
     from jama16_retina_tpu.data.grain_pipeline import TFRecordIndex
 
     index = TFRecordIndex(tfrecord.list_split(data_dir, split))
     n = len(index)
     if n == 0:
         raise ValueError(f"no records under {data_dir}/{split}")
-    return _decode_rows(index, 0, n, image_size)
+    return _decode_rows(index, 0, n, image_size, workers=workers)
+
+
+def row_bytes(image_size: int) -> int:
+    """Resident bytes one record costs: uint8 pixels + an i32 grade."""
+    return image_size * image_size * 3 + 4
 
 
 def dataset_bytes(n: int, image_size: int) -> int:
-    return n * image_size * image_size * 3 + 4 * n
+    return n * row_bytes(image_size)
+
+
+def resident_row_capacity(
+    image_size: int,
+    n_devices: int = 1,
+    max_fraction: float = 0.6,
+    budget_bytes: "int | None" = None,
+) -> int:
+    """How many dataset rows the HBM budget admits ACROSS the data axis
+    — the partial-residency generalization of ``fits_in_hbm``'s
+    all-or-nothing gate (the tiered loader pins this many rows and
+    streams the rest; data/tiered_pipeline.py). ``budget_bytes``
+    overrides the derivation with an explicit TOTAL resident budget
+    (the tiered loader's ``tiered_resident_bytes`` knob; benches pin it
+    for reproducible partial-residency measurements)."""
+    total = (
+        budget_bytes if budget_bytes is not None
+        else hbm_budget_bytes(max_fraction) * max(n_devices, 1)
+    )
+    return max(0, total // row_bytes(image_size))
 
 
 def hbm_budget_bytes(max_fraction: float = 0.6) -> int:
@@ -128,7 +156,8 @@ def fits_in_hbm(
     return per_chip <= hbm_budget_bytes(max_fraction)
 
 
-def _load_index_rows_sharded(index, n: int, image_size: int, mesh):
+def _load_index_rows_sharded(index, n: int, image_size: int, mesh,
+                             workers: int = 1):
     """Multi-host placement: decode ONLY this process's rows, upload
     shard-by-shard -> (images, grades) as GLOBAL row-sharded arrays of
     padded length (VERDICT r3 #3).
@@ -158,7 +187,7 @@ def _load_index_rows_sharded(index, n: int, image_size: int, mesh):
         start, stop = _span(dev_idx)
         if (start, stop) not in blocks:
             blocks[(start, stop)] = _decode_rows(
-                index, start, stop, image_size, n=n
+                index, start, stop, image_size, n=n, workers=workers
             )
     logging.info(
         "hbm loader (multi-host): process %d/%d decoded %d of %d rows",
@@ -258,8 +287,10 @@ def train_batches(
     step) semantics — no replay, no state files)."""
     import jax
 
+    from jama16_retina_tpu.data.grain_pipeline import resolve_decode_workers
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
+    workers = resolve_decode_workers(getattr(cfg, "decode_workers", 0))
     multiprocess = jax.process_count() > 1
     if multiprocess and mesh is None:
         raise ValueError(
@@ -276,7 +307,9 @@ def train_batches(
         if n == 0:
             raise ValueError(f"no records under {data_dir}/{split}")
     else:
-        images, grades = load_split_numpy(data_dir, split, image_size)
+        images, grades = load_split_numpy(
+            data_dir, split, image_size, workers=workers
+        )
         n = len(images)
     # The dataset shards across the DATA axis only (replicated over any
     # 'member' axis of an ensemble mesh) — gating on total device count
@@ -290,7 +323,9 @@ def train_batches(
             "tfdata or grain loader for datasets this size"
         )
     if multiprocess:
-        images, grades = _load_index_rows_sharded(index, n, image_size, mesh)
+        images, grades = _load_index_rows_sharded(
+            index, n, image_size, mesh, workers=workers
+        )
     get_batch = make_batch_fn(
         images, grades, cfg.batch_size, seed, mesh=mesh, n_records=n
     )

@@ -334,9 +334,50 @@ def eval_batches_sharded(
         }
 
 
+def staged_put(x, sharding):
+    """Per-shard H2D staging: device_put each device's dim-0 block
+    separately and assemble the global array from the single-device
+    pieces. Every per-shard put is async, so the copies for a batch can
+    overlap the running train step at SHARD granularity — the runtime
+    can start feeding device 0's block while device 3's is still being
+    sliced — instead of gating on one whole-batch transfer
+    (tf.data's overlapped-prefetch guidance, arXiv:2101.12127, applied
+    to the put side). Falls back to a plain sharded put whenever the
+    layout is not the simple single-process dim-0 case (scalars,
+    replicated specs, multi-process) — and for DEVICE-BORN arrays
+    (hbm/tiered loader batches), where np.asarray would be a blocking
+    D2H fetch followed by a pointless re-upload."""
+    sh = (
+        _rank_sharding_for(x, sharding)
+        if hasattr(sharding, "spec") else sharding
+    )
+    if isinstance(x, jax.Array):
+        return jax.device_put(x, sh)
+    x = np.asarray(x)
+    if (
+        jax.process_count() > 1
+        or not hasattr(sh, "spec")
+        or x.ndim == 0
+        or not any(s is not None for s in sh.spec)
+    ):
+        return jax.device_put(x, sh)
+    shape = x.shape
+    arrays = [
+        jax.device_put(x[idx], dev)
+        for dev, idx in sh.addressable_devices_indices_map(shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(shape, sh, arrays)
+
+
+def _rank_sharding_for(x, sharding):
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    return mesh_lib._rank_sharding(np.ndim(x), sharding)
+
+
 def device_prefetch(
     it: Iterator[dict], sharding=None, size: int = 2,
-    full_local: bool = False,
+    full_local: bool = False, per_shard: bool = False,
 ) -> Iterator[dict]:
     """Move batches to device ahead of consumption (double-buffering).
 
@@ -350,6 +391,10 @@ def device_prefetch(
     it — the member-parallel driver's assembly, whose ('member','data')
     device layout interleaves data columns across processes (see
     mesh_lib.place_full_local).
+
+    ``per_shard``: stage the single-process sharded put per device block
+    (``staged_put``) so the H2D copies overlap the train step at shard
+    granularity (DataConfig.stage_per_shard).
     """
     queue: collections.deque = collections.deque()
     multiprocess = jax.process_count() > 1
@@ -380,6 +425,8 @@ def device_prefetch(
             if multiprocess and np.ndim(x):
                 # Local rows -> global array (see mesh_lib.shard_batch).
                 return jax.make_array_from_process_local_data(sh, np.asarray(x))
+            if per_shard:
+                return staged_put(x, sh)
             return jax.device_put(x, sh)
 
         return jax.tree.map(one, batch)

@@ -152,7 +152,7 @@ def write_synthetic_split(
     out_dir: str,
     split: str,
     n: int,
-    image_size: int = 299,
+    image_size: "int | None" = None,
     num_shards: int = 4,
     seed: int = 0,
     encoding: str = "jpeg",
@@ -171,8 +171,14 @@ def write_synthetic_split(
     ``seed`` independently of the render stream, so the same seed with
     and without noise yields byte-identical images.
 
-    ``synth_cfg`` (a synthetic.SynthConfig; its image_size wins over the
-    ``image_size`` arg) and ``grade_marginals`` (length-5 probability
+    ``image_size`` defaults to 299 when neither it nor ``synth_cfg`` is
+    given. Passing BOTH with disagreeing sizes raises: letting
+    ``synth_cfg.image_size`` silently win writes shards at an unexpected
+    resolution that only surfaces later as loader shape errors
+    (ADVICE r5).
+
+    ``synth_cfg`` (a synthetic.SynthConfig) and ``grade_marginals``
+    (length-5 probability
     vector replacing synthetic.GRADE_MARGINALS) exist to write
     DISTRIBUTION-SHIFTED datasets — subtler lesions, different
     referable prevalence — for the cross-dataset threshold-transfer
@@ -180,7 +186,17 @@ def write_synthetic_split(
     scripts/cross_dataset_transfer.py)."""
     from jama16_retina_tpu.data import synthetic
 
-    cfg = synth_cfg or synthetic.SynthConfig(image_size=image_size)
+    if (synth_cfg is not None and image_size is not None
+            and synth_cfg.image_size != image_size):
+        raise ValueError(
+            f"write_synthetic_split got synth_cfg.image_size="
+            f"{synth_cfg.image_size} but image_size={image_size} — pass "
+            "one or the other (records would silently be written at the "
+            "synth_cfg size)"
+        )
+    cfg = synth_cfg or synthetic.SynthConfig(
+        image_size=299 if image_size is None else image_size
+    )
     images, grades = synthetic.make_dataset(
         n, cfg, seed=seed, grade_marginals=grade_marginals
     )

@@ -61,6 +61,36 @@ def enable_persistent_compilation_cache(path: str) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def configure_fake_cpu_devices(n: int) -> None:
+    """Point jax at ``n`` fake CPU devices — the one home for the
+    version-compat rule the CLIs and tests share: jax >= 0.4.38 exposes
+    jax_num_cpu_devices; older jax only honors the XLA_FLAGS knob,
+    which is read lazily at first backend init (so this must run before
+    anything touches a backend). Callers pin jax_platforms=cpu first."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def _distributed_is_initialized() -> bool:
+    """jax.distributed.is_initialized() where it exists (>= 0.4.38);
+    older jax exposes the same fact as the service client's presence."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(force: bool = False) -> bool:
     """Multi-host bring-up (SURVEY.md §3.5). MUST run before any other jax
     API touches a backend — jax.distributed.initialize() after backend
@@ -70,7 +100,7 @@ def initialize_distributed(force: bool = False) -> bool:
     same entry points run unchanged on one chip. Returns True when
     distributed initialization actually ran.
     """
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         return True
     if not force and not _multihost_env_configured():
         return False  # single-host: leave the local backend to init lazily

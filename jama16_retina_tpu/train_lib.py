@@ -34,6 +34,53 @@ import optax
 from jax.sharding import PartitionSpec as P
 from absl import logging as absl_logging
 
+def _shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+               replicate_out_axes=()):
+    """jax.shard_map across jax versions: the graduated API (>= 0.4.38)
+    takes ``axis_names`` = the MANUAL axes; the jax.experimental form
+    takes the complement as ``auto``. ``axis_names=None`` means fully
+    manual on both.
+
+    ``replicate_out_axes``: manual axes every OUTPUT leaf is replicated
+    over without being mapped in its out_spec (manual_step's 'data'
+    axis). The graduated VMA checker proves that replication through the
+    optimizer update on its own; the legacy check_rep inference cannot,
+    so on old jax the outputs are passed through a terminal
+    ``lax.pmean`` over those axes — numerically identity on
+    already-replicated values, and the one terminal op the legacy
+    checker accepts as proof. (check_rep=False is NOT a usable escape:
+    it changes the psum-transpose rule and silently rescales the
+    gradients of the in-step loss pmean.)"""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if axis_names is None else {"axis_names": set(axis_names)}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map
+
+    auto = (
+        frozenset() if axis_names is None
+        else frozenset(mesh.axis_names) - frozenset(axis_names)
+    )
+    g = f
+    if replicate_out_axes:
+        axes = tuple(replicate_out_axes)
+
+        def _mark(x):
+            # pmean divides, promoting int leaves to float — restrict to
+            # inexact leaves. Integer counters (step, optax counts) are
+            # replicated-input + 1 chains the checker infers unaided.
+            if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+                return jax.lax.pmean(x, axes)
+            return x
+
+        def g(*args):
+            return jax.tree.map(_mark, f(*args))
+
+    return shard_map(
+        g, mesh=mesh, in_specs=in_specs, out_specs=out_specs, auto=auto
+    )
+
 from jama16_retina_tpu.configs import ExperimentConfig, TrainConfig
 from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.parallel import mesh as mesh_lib
@@ -637,14 +684,22 @@ def make_ensemble_train_step(
                     loss,
                 )
 
-            new_st, losses = jax.vmap(one)(st_local, keys_local)
+            new_st, losses = jax.vmap(one)(
+                st_local, jax.random.wrap_key_data(keys_local)
+            )
             return new_st, {"loss": losses}
 
-        return jax.shard_map(
+        # Keys cross the shard_map boundary as RAW uint32 data
+        # (key_data/wrap_key_data round-trip, numerically identity):
+        # older jax partitioners reject extended PRNG-key dtypes at a
+        # manual-axis boundary ("tile assignment dimensions ... different
+        # than the input rank" on u32[k,2]).
+        return _shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P("member"), P("data"), P("member")),
             out_specs=(P("member"), P("member")),
-        )(state, batch, base_keys)
+            replicate_out_axes=("data",),
+        )(state, batch, jax.random.key_data(base_keys))
 
     def sharded_step(state: TrainState, batch: dict, base_keys: jax.Array):
         # The member axis is MANUAL (jax.shard_map): each member-shard
@@ -660,12 +715,16 @@ def make_ensemble_train_step(
         # is closed over rather than passed through: it is unsharded on
         # the manual axis ('data' is auto), which closure capture
         # expresses exactly.
-        return jax.shard_map(
-            lambda st_local, keys_local: step(st_local, batch, keys_local),
+        # Same raw-key-data boundary crossing as manual_step (older jax
+        # partitioners reject key dtypes at manual-axis boundaries).
+        return _shard_map(
+            lambda st_local, keys_local: step(
+                st_local, batch, jax.random.wrap_key_data(keys_local)
+            ),
             mesh=mesh, axis_names={"member"},
             in_specs=(P("member"), P("member")),
             out_specs=(P("member"), P("member")),
-        )(state, base_keys)
+        )(state, jax.random.key_data(base_keys))
 
     # A 1-device mesh gains nothing from manual axes and would lose the
     # Mosaic augment kernel (see _pallas_safe_cfg) — keep the plain
@@ -718,7 +777,7 @@ def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable
         # local member weights forward locally instead of being
         # all-gathered by the batched-conv strategy. Reuses the
         # unsharded ``step`` so the two paths cannot diverge.
-        return jax.shard_map(
+        return _shard_map(
             lambda st_local: step(st_local, batch),
             mesh=mesh, axis_names={"member"},
             in_specs=(P("member"),), out_specs=P("member"),

@@ -297,6 +297,17 @@ def _train_stream(
             data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
             skip_batches=skip_batches, mesh=mesh,
         )
+    if cfg.data.loader == "tiered":
+        from jama16_retina_tpu.data import tiered_pipeline
+
+        # Device-born batches like 'hbm' (device_prefetch passes them
+        # through untouched); partial HBM residency + parallel host
+        # decode for the remainder, so the full_batches contract is
+        # moot the same way it is for 'hbm' (one global stream).
+        return tiered_pipeline.train_batches(
+            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+            skip_batches=skip_batches, mesh=mesh,
+        )
     if cfg.data.loader == "grain":
         from jama16_retina_tpu.data import grain_pipeline
 
@@ -308,7 +319,8 @@ def _train_stream(
         )
     if cfg.data.loader != "tfdata":
         raise ValueError(
-            f"unknown data.loader {cfg.data.loader!r} (want tfdata|grain|hbm)"
+            f"unknown data.loader {cfg.data.loader!r} "
+            "(want tfdata|grain|hbm|tiered)"
         )
     return pipeline.train_batches(
         data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
@@ -391,7 +403,10 @@ def _reconstruct_best_tracking(
     for s, a in evals:
         if s not in kept:
             kept[s] = a
-        elif not np.allclose(kept[s], a, atol=1e-9):
+        elif not np.allclose(kept[s], a, atol=1e-9, equal_nan=True):
+            # equal_nan: a NaN val_auc (degenerate single-class val
+            # split) replays deterministically too — NaN != NaN must not
+            # flag the run's own re-logged evals on every resume.
             # Deterministic replay should make re-logged evals identical;
             # disagreement means the workdir mixed nondeterministic eval
             # passes (e.g. the TF backend) and the replayed best/patience
@@ -595,14 +610,14 @@ def _eval_cache_for(
     """A device-resident eval-batch cache (list to share across evals),
     or None when it should not exist: streamed loaders keep the per-eval
     re-read (their budget story never admitted the split into HBM), and
-    even under the hbm loader the split must clear the same budget
-    discipline the loader applies to train data — all caches TOGETHER
-    capped at 10% of the HBM budget (``reserved_bytes`` carries the
-    footprint of caches already admitted, so a multi-split eval pass
+    even under the hbm/tiered loaders the split must clear the same
+    budget discipline the loader applies to train data — all caches
+    TOGETHER capped at 10% of the HBM budget (``reserved_bytes`` carries
+    the footprint of caches already admitted, so a multi-split eval pass
     cannot pin 3x the gate by admitting each split individually), so the
     cache is never the one tenant that never asked (the train split's
     own gate allows up to 60%, and the train state needs the rest)."""
-    if cfg.data.loader != "hbm":
+    if cfg.data.loader not in ("hbm", "tiered"):
         return None
     from jama16_retina_tpu.data import hbm_pipeline
 
@@ -795,6 +810,7 @@ def fit(
         stream,
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
+        per_shard=cfg.data.stage_per_shard,
     )
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
@@ -876,7 +892,26 @@ def fit_ensemble(
                 "member axis is a jax.vmap/GSPMD construct); use the "
                 "sequential driver for --device=tf"
             )
-        return fit_ensemble_parallel(cfg, data_dir, workdir)
+        n_dev = cfg.parallel.num_devices or len(jax.devices())
+        if n_dev < 2 and not cfg.train.ensemble_parallel_force:
+            # Measured-speedup gate: single-chip the stacked step runs
+            # BELOW the sequential member rate (bench
+            # ensemble4_parallel_speedup 0.85 in r05 — weight/optimizer
+            # HBM traffic scales with members while batch does not), so
+            # the stacked path on a 1-device mesh ships a known
+            # slowdown. The wins it exists for — member-axis mesh
+            # topology, k× fewer dispatches amortized across chips —
+            # need >= 2 devices.
+            absl_logging.warning(
+                "train.ensemble_parallel disabled: 1-device mesh and the "
+                "stacked step measures SLOWER than sequential members "
+                "there (bench ensemble4_parallel_speedup < 1.0); "
+                "training the %d members sequentially instead. Set "
+                "train.ensemble_parallel_force=true to override.",
+                cfg.train.ensemble_size,
+            )
+        else:
+            return fit_ensemble_parallel(cfg, data_dir, workdir)
     fit_fn = fit_tf if backend == "tf" else fit
     results = []
     for member in range(cfg.train.ensemble_size):
@@ -1342,11 +1377,11 @@ def fit_tf(
             "train.ema_decay is a flax-path feature; the legacy tf "
             "backend has no EMA shadow (see TrainConfig.ema_decay)"
         )
-    if cfg.data.loader == "hbm":
+    if cfg.data.loader in ("hbm", "tiered"):
         raise ValueError(
-            "data.loader='hbm' yields device-resident batches for the "
-            "jit train step; the tf backend trains on host — use the "
-            "tfdata or grain loader with --device=tf"
+            f"data.loader={cfg.data.loader!r} yields device-resident "
+            "batches for the jit train step; the tf backend trains on "
+            "host — use the tfdata or grain loader with --device=tf"
         )
     if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
         raise ValueError(

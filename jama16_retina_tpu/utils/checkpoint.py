@@ -109,6 +109,14 @@ class Checkpointer:
         would actually enter the top-k by metric — otherwise orbax would
         serialize the full state just to delete it during retention,
         doubling checkpoint IO on every non-improving eval."""
+        # Numpy SCALARS (np.int32 etc., e.g. a stacked state's step
+        # counter after unstack_member's x[m] indexing) are rejected by
+        # older orbax StandardSave ("Unsupported type"); 0-d ndarrays
+        # are accepted by every version, and restore is unchanged.
+        state = jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            state,
+        )
         metric = float(metrics[BEST_METRIC])
         if self._enters_best(metric):
             self._best.save(

@@ -35,7 +35,13 @@ import jax
 
 if not _USE_REAL_TPU:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # Older jax (< 0.4.38) has no jax_num_cpu_devices option; the
+        # XLA_FLAGS fake-device flag set above still applies because the
+        # backend has not initialized yet at plugin-import time.
+        pass
 
 import sys
 

@@ -61,6 +61,41 @@ def test_peak_flops_table():
         jax.devices = real
 
 
+def test_ensemble_speedup_gate_withholds_slowdowns():
+    """A stacked-ensemble rate below the sequential member rate must
+    never be published as ensemble4_parallel_speedup — it lands in the
+    _gated key with a logged reason (ISSUE 1 satellite; BENCH_r05
+    shipped 0.85 as a 'speedup')."""
+    extras = {}
+    bench._gate_ensemble_speedup(extras, rate=1182.4, device_only=1397.8)
+    assert "ensemble4_parallel_speedup" not in extras
+    assert extras["ensemble4_parallel_gated"] == 0.85
+    extras = {}
+    bench._gate_ensemble_speedup(extras, rate=1600.0, device_only=1397.8)
+    assert extras["ensemble4_parallel_speedup"] == 1.14
+    assert "ensemble4_parallel_gated" not in extras
+
+
+def test_tiered_bench_plan_is_partial_residency():
+    """The pipeline_fed_tiered section must measure a genuinely MIXED
+    batch: its pinned budget yields a residency fraction strictly
+    between 0 and 1 on the bench fixture, and the published rate rides
+    the same physics guard as every other key."""
+    frac = bench.tiered_residency_plan(bench.BENCH_N_IMAGES, 299)
+    assert 0.0 < frac < 1.0
+    # 7/8 nominal, rounded down by per-batch quota planning.
+    assert 0.5 <= frac <= 0.875
+    extras = {}
+    out = bench._publish(
+        extras, "pipeline_fed_tiered", 83121.54, 33.3e9, 197e12
+    )
+    assert out is None and "pipeline_fed_tiered" not in extras
+    out = bench._publish(
+        extras, "pipeline_fed_tiered", 1000.0, 33.3e9, 197e12
+    )
+    assert out == 1000.0 and extras["pipeline_fed_tiered"] == 1000.0
+
+
 def test_timed_steps_counts_all_steps():
     """_timed_steps' fence discipline on CPU: a step that chains state
     through iterations yields a sane rate and the final state reflects

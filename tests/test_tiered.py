@@ -1,0 +1,351 @@
+"""Tiered ingest tests (data/tiered_pipeline.py; ISSUE 1 tentpole).
+
+Pins: residency planning at the 0% / partial / 100% boundaries, exact
+epoch semantics per tier, (seed, step) determinism and O(1) resume at
+every residency level, worker-count invariance of the parallel decode
+stage, the bit-identical zero-budget fallback to the streamed path,
+per-shard staged puts vs plain sharded puts, and trainer.fit end to end
+on data.loader=tiered with interrupted+resumed ≡ uninterrupted.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import trainer
+from jama16_retina_tpu.configs import DataConfig, get_config, override
+from jama16_retina_tpu.data import hbm_pipeline, tfrecord, tiered_pipeline
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+ROW = hbm_pipeline.row_bytes(32)
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tiered_data"))
+    tfrecord.write_synthetic_split(d, "train", 48, 32, 3, seed=1)
+    tfrecord.write_synthetic_split(d, "val", 24, 32, 2, seed=2)
+    return d
+
+
+def _cfg(resident_bytes: int, **kw) -> DataConfig:
+    return DataConfig(
+        batch_size=8, tiered_resident_bytes=resident_bytes, **kw
+    )
+
+
+def test_plan_residency_boundaries():
+    # 48 records, batch 8 -> 6 steps/epoch.
+    assert tiered_pipeline.plan_residency(48, 8, 0) == (6, 0, 0)
+    # Huge capacity: every batch fully resident, exactly one epoch pinned.
+    assert tiered_pipeline.plan_residency(48, 8, 10**6) == (6, 8, 48)
+    # Partial: 24 rows capacity -> 4 resident rows/batch, 24 pinned.
+    assert tiered_pipeline.plan_residency(48, 8, 24) == (6, 4, 24)
+    # Rounding: capacity that does not divide steps rounds DOWN so the
+    # epoch never over-consumes the pinned set.
+    steps, res_pb, n_res = tiered_pipeline.plan_residency(48, 8, 23)
+    assert (steps, res_pb, n_res) == (6, 3, 18)
+    # The streamed tier is always feasible: steps * (B - res_pb) <= n - n_res.
+    assert steps * (8 - res_pb) <= 48 - n_res
+    # Full residency with n % B != 0 pins ALL n rows (the per-epoch
+    # permutation rotates the drop, hbm-style) — not just B*steps.
+    assert tiered_pipeline.plan_residency(50, 8, 10**6) == (6, 8, 50)
+    # Capacity short of n but rich enough for all-resident batches must
+    # still reserve one streamed slot per batch: otherwise the rows
+    # capacity cannot pin would be excluded from training PERMANENTLY.
+    assert tiered_pipeline.plan_residency(50, 8, 49) == (6, 7, 42)
+    # Oversized batch is refused like the hbm loader.
+    with pytest.raises(ValueError, match="batch_size"):
+        tiered_pipeline.plan_residency(4, 8, 0)
+
+
+def test_no_record_is_permanently_excluded(tmp_path):
+    """n=50 / batch 8 does not divide: at FULL residency the 2-record
+    epoch drop must rotate (every record seen across a few epochs), and
+    at capacity 49 (cannot pin all 50) the streamed slot must rotate
+    the unpinned remainder through training."""
+    d = str(tmp_path / "odd")
+    tfrecord.write_synthetic_split(d, "train", 50, 32, 2, seed=4)
+    all_imgs, _ = hbm_pipeline.load_split_numpy(d, "train", 32)
+    everything = {im.tobytes() for im in all_imgs}
+    assert len(everything) == 50
+    for budget in (10**9, ROW * 49):
+        it = tiered_pipeline.train_batches(
+            d, "train", _cfg(budget), 32, seed=1
+        )
+        seen = set()
+        for _ in range(6 * 8):  # 8 epochs of 6 steps
+            seen |= {
+                im.tobytes() for im in np.asarray(next(it)["image"])
+            }
+        assert seen == everything, f"budget={budget}"
+
+
+def test_tiny_resident_set_pads_on_wide_mesh(tmp_path):
+    """A resident set SMALLER than the mesh's data axis (res_pb=1 ->
+    n_res=3 rows on 8 devices) must wrap-pad its device placement
+    instead of crashing the sharded put."""
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    d = str(tmp_path / "tiny")
+    tfrecord.write_synthetic_split(d, "train", 24, 32, 2, seed=6)
+    mesh = mesh_lib.make_mesh()
+    it = tiered_pipeline.train_batches(
+        d, "train", _cfg(ROW * 4), 32, seed=0, mesh=mesh
+    )
+    batch = next(it)
+    assert batch["image"].shape == (8, 32, 32, 3)
+
+
+@pytest.mark.parametrize(
+    "resident_bytes", [0, ROW * 24, 10**9], ids=["0pct", "50pct", "100pct"]
+)
+def test_deterministic_and_resumes_o1_at_every_residency(
+    data_dir, resident_bytes
+):
+    cfg = _cfg(resident_bytes)
+    a = tiered_pipeline.train_batches(data_dir, "train", cfg, 32, seed=3)
+    ref = [next(a) for _ in range(9)]
+    # Same seed -> identical stream.
+    b = tiered_pipeline.train_batches(data_dir, "train", cfg, 32, seed=3)
+    for r in ref:
+        got = next(b)
+        np.testing.assert_array_equal(
+            np.asarray(r["image"]), np.asarray(got["image"])
+        )
+    # skip_batches=k continues exactly where step k would be — across an
+    # epoch boundary (6 steps/epoch, skip 7), at every residency level.
+    resumed = tiered_pipeline.train_batches(
+        data_dir, "train", cfg, 32, seed=3, skip_batches=7
+    )
+    for r in ref[7:]:
+        got = next(resumed)
+        np.testing.assert_array_equal(
+            np.asarray(r["image"]), np.asarray(got["image"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r["grade"]), np.asarray(got["grade"])
+        )
+
+
+@pytest.mark.parametrize(
+    "resident_bytes", [0, ROW * 24, 10**9], ids=["0pct", "50pct", "100pct"]
+)
+def test_epoch_covers_every_record_once_at_every_residency(
+    data_dir, resident_bytes
+):
+    """48 records / batch 8 = 6 steps/epoch; at 0%, 50% and 100%
+    residency each epoch must cover all 48 records exactly once (the
+    48/8 fixture divides evenly, so both tiers' drop-remainders are
+    empty), and epochs must reshuffle."""
+    it = tiered_pipeline.train_batches(
+        data_dir, "train", _cfg(resident_bytes), 32, seed=7
+    )
+    epochs = []
+    for _ in range(2):
+        batches = [np.asarray(next(it)["image"]) for _ in range(6)]
+        epochs.append(np.concatenate(batches))
+    for ep in epochs:
+        assert len({im.tobytes() for im in ep}) == 48
+    assert not np.array_equal(epochs[0], epochs[1])
+
+
+def test_batch_composition_mixes_tiers(data_dir):
+    """Partial residency serves a fixed per-batch quota from each tier:
+    resident rows come from the pinned prefix [0, n_res) of the record
+    index, streamed rows from the remainder — verified against a full
+    host decode of the split."""
+    images, grades = hbm_pipeline.load_split_numpy(data_dir, "train", 32)
+    resident_keys = {im.tobytes() for im in images[:24]}
+    streamed_keys = {im.tobytes() for im in images[24:]}
+    it = tiered_pipeline.train_batches(
+        data_dir, "train", _cfg(ROW * 24), 32, seed=11
+    )
+    for _ in range(6):
+        batch = np.asarray(next(it)["image"])
+        got_res = [im.tobytes() in resident_keys for im in batch]
+        # Fixed layout: first res_pb rows resident, rest streamed.
+        assert got_res == [True] * 4 + [False] * 4
+        assert all(im.tobytes() in streamed_keys for im in batch[4:])
+
+
+def test_zero_budget_falls_back_bit_identically_to_streamed(data_dir):
+    """The acceptance contract: budget 0 -> the SAME batch sequence as
+    the INDEPENDENT host-decoded reference (plan -> record ids ->
+    direct decode, no staging/combine jit) — a check the loader's
+    device plumbing can actually fail. streamed_batches (the public
+    streamed mode) is held to the identical sequence."""
+    tiered = tiered_pipeline.train_batches(
+        data_dir, "train", _cfg(0), 32, seed=5
+    )
+    reference = tiered_pipeline.host_reference_batches(
+        data_dir, "train", DataConfig(batch_size=8), 32, seed=5,
+        capacity_rows=0,
+    )
+    streamed = tiered_pipeline.streamed_batches(
+        data_dir, "train", DataConfig(batch_size=8), 32, seed=5
+    )
+    for _ in range(8):
+        a, ref, c = next(tiered), next(reference), next(streamed)
+        for got in (a, c):
+            np.testing.assert_array_equal(
+                np.asarray(got["image"]), ref["image"]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got["grade"]), ref["grade"]
+            )
+
+
+def test_partial_residency_matches_host_reference(data_dir):
+    """The mixed-tier device path (resident gather + staged streamed
+    rows + combine jit) reproduces the host-decoded reference sequence
+    bit for bit at 50% residency."""
+    capacity = 24
+    tiered = tiered_pipeline.train_batches(
+        data_dir, "train", _cfg(ROW * capacity), 32, seed=13
+    )
+    reference = tiered_pipeline.host_reference_batches(
+        data_dir, "train", DataConfig(batch_size=8), 32, seed=13,
+        capacity_rows=capacity,
+    )
+    for _ in range(8):
+        a, ref = next(tiered), next(reference)
+        np.testing.assert_array_equal(np.asarray(a["image"]), ref["image"])
+        np.testing.assert_array_equal(np.asarray(a["grade"]), ref["grade"])
+
+
+def test_worker_count_invariance(data_dir):
+    """decode_workers is a pure throughput knob: 1 worker and 8 workers
+    must produce identical batches (the ParallelDecoder determinism
+    contract the resume story rests on)."""
+    i1 = tiered_pipeline.train_batches(
+        data_dir, "train", _cfg(ROW * 24, decode_workers=1), 32, seed=9
+    )
+    i8 = tiered_pipeline.train_batches(
+        data_dir, "train", _cfg(ROW * 24, decode_workers=8), 32, seed=9
+    )
+    for _ in range(7):
+        a, b = next(i1), next(i8)
+        np.testing.assert_array_equal(
+            np.asarray(a["image"]), np.asarray(b["image"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a["grade"]), np.asarray(b["grade"])
+        )
+
+
+def test_parallel_decoder_matches_single_thread(data_dir):
+    """decode_range/decode_batch are worker-count-invariant at the
+    array level (each worker fills a disjoint slice)."""
+    from jama16_retina_tpu.data.grain_pipeline import (
+        ParallelDecoder,
+        TFRecordIndex,
+    )
+
+    index = TFRecordIndex(tfrecord.list_split(data_dir, "train"))
+    one = ParallelDecoder(index, 32, workers=1)
+    many = ParallelDecoder(index, 32, workers=6)
+    try:
+        a_img, a_gr = one.decode_range(0, 48)
+        b_img, b_gr = many.decode_range(0, 48)
+        np.testing.assert_array_equal(a_img, b_img)
+        np.testing.assert_array_equal(a_gr, b_gr)
+        ids = [7, 3, 3, 41, 0]
+        a = one.decode_batch(ids)
+        b = many.decode_batch(ids)
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["grade"], b["grade"])
+    finally:
+        one.close()
+        many.close()
+
+
+def test_batches_carry_mesh_sharding(data_dir):
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh()  # all 8 fake devices
+    it = tiered_pipeline.train_batches(
+        data_dir, "train",
+        DataConfig(batch_size=16, tiered_resident_bytes=ROW * 24),
+        32, seed=0, mesh=mesh,
+    )
+    batch = next(it)
+    assert batch["image"].sharding == mesh_lib.batch_sharding(mesh)
+    assert batch["image"].shape == (16, 32, 32, 3)
+    assert batch["grade"].shape == (16,)
+
+
+def test_staged_put_matches_plain_put(data_dir):
+    """pipeline.staged_put is a pure staging optimization: same values,
+    same sharding as one whole-batch device_put."""
+    import jax
+
+    from jama16_retina_tpu.data import pipeline
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh()
+    sh = mesh_lib.batch_sharding(mesh)
+    x = np.arange(16 * 4 * 3, dtype=np.uint8).reshape(16, 4, 3)
+    staged = pipeline.staged_put(x, sh)
+    plain = jax.device_put(x, mesh_lib._rank_sharding(x.ndim, sh))
+    assert staged.sharding.is_equivalent_to(plain.sharding, x.ndim)
+    np.testing.assert_array_equal(np.asarray(staged), np.asarray(plain))
+    # Scalars fall back to a plain put instead of crashing.
+    s = pipeline.staged_put(np.float32(3.5), sh)
+    assert float(s) == 3.5
+
+
+def test_fit_with_tiered_loader_resumes_exactly(data_dir, tmp_path):
+    """trainer.fit end to end on data.loader=tiered at partial
+    residency: interrupted+resumed == uninterrupted loss curves
+    (SURVEY.md §5.4), resume O(1) by construction."""
+    cfg = override(
+        get_config("smoke"),
+        ["data.loader=tiered", "train.steps=12", "train.eval_every=6",
+         "train.log_every=1", "data.augment=true", "data.batch_size=8",
+         "eval.batch_size=8", "train.lr_schedule=constant",
+         # 24 of 48 rows resident at the smoke config's 64px images.
+         f"data.tiered_resident_bytes={hbm_pipeline.row_bytes(64) * 24}"],
+    )
+    w_full = str(tmp_path / "full")
+    trainer.fit(cfg, data_dir, w_full, seed=3)
+    full = {
+        r["step"]: r["loss"]
+        for r in read_jsonl(os.path.join(w_full, "metrics.jsonl"))
+        if r["kind"] == "train"
+    }
+    w_part = str(tmp_path / "part")
+    trainer.fit(override(cfg, ["train.steps=6"]), data_dir, w_part, seed=3)
+    trainer.fit(override(cfg, ["train.resume=true"]), data_dir, w_part, seed=3)
+    part = {
+        r["step"]: r["loss"]
+        for r in read_jsonl(os.path.join(w_part, "metrics.jsonl"))
+        if r["kind"] == "train"
+    }
+    assert set(full) == set(part) == set(range(1, 13))
+    for s in full:
+        assert full[s] == part[s], f"step {s}: {full[s]} != {part[s]}"
+
+
+def test_fit_tf_refuses_tiered_loader(data_dir, tmp_path):
+    cfg = override(get_config("smoke"), ["data.loader=tiered"])
+    with pytest.raises(ValueError, match="tiered"):
+        trainer.fit_tf(cfg, data_dir, str(tmp_path / "x"), seed=0)
+
+
+def test_write_synthetic_split_rejects_mismatched_sizes(tmp_path):
+    """ADVICE r5: synth_cfg.image_size must not silently override a
+    disagreeing explicit image_size."""
+    from jama16_retina_tpu.data import synthetic
+
+    with pytest.raises(ValueError, match="image_size"):
+        tfrecord.write_synthetic_split(
+            str(tmp_path), "train", 4, image_size=64,
+            synth_cfg=synthetic.SynthConfig(image_size=32),
+        )
+    # Matching sizes (and either alone) stay accepted.
+    tfrecord.write_synthetic_split(
+        str(tmp_path), "ok", 2, image_size=32, num_shards=1,
+        synth_cfg=synthetic.SynthConfig(image_size=32),
+    )
