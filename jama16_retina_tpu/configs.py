@@ -274,6 +274,44 @@ class EvalConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-engine config (serve/engine.py, serve/batcher.py).
+
+    The inference twin of DataConfig: knobs for the persistent
+    micro-batched serving path — how requests coalesce, which padded
+    batch shapes jit compiles for, and how the ensemble members forward.
+    """
+
+    # Largest coalesced batch one engine forward serves. The
+    # micro-batcher closes its window at this many rows (or at
+    # max_wait_ms, whichever first); the engine chunks larger inputs.
+    max_batch: int = 64
+    # Longest a request waits for co-riders before the window flushes.
+    # 0 serves every request the moment the engine is free (lowest
+    # latency, least coalescing).
+    max_wait_ms: float = 5.0
+    # Padded batch shapes the engine compiles for — every forward runs
+    # at one of these row counts, so jit compiles once per bucket and
+    # NEVER per request size. Empty = auto: powers of two from 8 up to
+    # max_batch. The largest bucket must cover max_batch. A single
+    # bucket (e.g. just max_batch) additionally makes per-row results
+    # bit-invariant to request interleaving: every row always runs at
+    # the same compiled shape (bf16 convs can drift at ulp level across
+    # shapes; see docs/PERF.md §Serve).
+    bucket_sizes: tuple[int, ...] = ()
+    # False (default): members forward under lax.map — one dispatch per
+    # batch, bit-identical per member to the sequential restore+forward
+    # path at the same batch shape (train_lib.make_serving_step).
+    # True: vmapped stacked forward (make_ensemble_eval_step's body) —
+    # float-equivalent, for member-shardable pod serving.
+    member_parallel: bool = False
+    # Fundus-normalization worker THREADS for the serving host stage
+    # (serve/host.py; same resolution rule as data.decode_workers —
+    # 0 = auto, one per host core up to 8).
+    host_workers: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "eyepacs_binary"
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -281,6 +319,7 @@ class ExperimentConfig:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     def replace(self, **sections) -> "ExperimentConfig":
         return dataclasses.replace(self, **sections)
@@ -408,7 +447,22 @@ def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
                 value = float(raw)
             elif isinstance(current, tuple):
                 parts = [p for p in raw.split(",") if p]
-                elem = type(current[0]) if current else str
+                if current:
+                    elem = type(current[0])
+                else:
+                    # Empty-default tuples carry no runtime element
+                    # type; read it off the dataclass annotation so
+                    # `serve.bucket_sizes=8,16` parses ints while
+                    # `eval.ensemble_dirs=20260801` (a date-named run
+                    # dir) STAYS a string path.
+                    ann = str(next(
+                        f.type for f in dataclasses.fields(section)
+                        if f.name == field
+                    ))
+                    elem = (
+                        int if "int" in ann
+                        else float if "float" in ann else str
+                    )
                 value = tuple(elem(p) for p in parts)
             else:
                 value = raw

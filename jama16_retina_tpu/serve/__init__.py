@@ -1,0 +1,29 @@
+"""Serving subsystem: persistent micro-batched inference over
+device-resident stacked ensembles (the first subsystem on the serving
+half of the ROADMAP north star).
+
+Three layers, composable and individually testable:
+
+  * ``engine``  — ServingEngine: restore every ensemble member ONCE,
+    stack them into one device-resident [k] parameter tree
+    (train_lib.stack_states), and serve a single stacked forward per
+    batch (train_lib.make_serving_step) instead of k sequential
+    restore+forward passes. Batches pad into a small set of bucketed
+    shapes so jit compiles once per bucket, never per request.
+  * ``batcher`` — MicroBatcher: a thread-safe request queue that
+    coalesces concurrent requests up to serve.max_batch or
+    serve.max_wait_ms and returns per-request futures in submission
+    order (arXiv:1812.11731's lesson operationalized: accelerator
+    inference throughput is won by batching, i.e. by coalescing).
+  * ``host``    — the host stage: fundus normalization parallelized
+    across a worker pool with worker-count-invariant output order
+    (the ParallelDecoder pattern applied to raw photographs).
+
+predict.py rides this stack for --device={tpu,cpu}; bench.py's
+``serve_*`` section measures it under the round-3 fenced discipline.
+"""
+
+from jama16_retina_tpu.serve.batcher import MicroBatcher
+from jama16_retina_tpu.serve.engine import ServingEngine, resolve_buckets
+
+__all__ = ["MicroBatcher", "ServingEngine", "resolve_buckets"]

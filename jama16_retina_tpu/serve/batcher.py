@@ -1,0 +1,213 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into engine
+batches.
+
+The throughput lever the batch-size study (PAPERS.md, arXiv:1812.11731)
+names for accelerator inference: a chip at batch 1 wastes almost all of
+its arithmetic, so a server must COALESCE concurrent requests into one
+forward. This stage is that server core, kept deliberately small:
+
+  * ``submit(rows)`` is thread-safe, returns a ``Future`` immediately;
+  * one worker thread drains the queue, closing each window at
+    ``max_batch`` rows or ``max_wait_ms`` after the window's FIRST
+    request (whichever comes first — a lone request never waits longer
+    than max_wait_ms, a burst never waits at all);
+  * the coalesced rows go to ``infer_fn`` (normally
+    ServingEngine.probs, which buckets/pads/chunks internally) and the
+    result rows are sliced back to their requests in submission order.
+
+Determinism contract: a row's result depends only on the row's content
+and the bucket shape it runs at — never on which other rows it happened
+to coalesce with (eval-mode forwards are row-independent; pinned by
+tests/test_serve.py). With a single-bucket engine every row always runs
+at the same compiled shape, making results bit-invariant to arrival
+interleaving; with multiple buckets, bf16 models can drift at float-ulp
+level across bucket shapes (docs/PERF.md §Serve).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+
+@dataclass
+class _Request:
+    rows: np.ndarray
+    future: Future = field(default_factory=Future)
+
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """Thread-safe coalescing request queue over a row-wise infer_fn.
+
+    ``infer_fn(rows[n, ...]) -> results[n, ...]`` must map row i of its
+    input to row i of its output (ServingEngine.probs does). Requests
+    larger than ``max_batch`` are accepted; the engine chunks them.
+
+    ``autostart=False`` leaves the worker unstarted until ``start()`` —
+    tests use it to stage a deterministic queue before any flush runs.
+
+    ``row_shape``/``row_dtype`` (optional): per-row shape/dtype every
+    submission must match, rejected AT SUBMIT otherwise. Without it one
+    malformed request would only fail inside its coalesced window,
+    taking innocent co-riders' futures down with it
+    (ServingEngine.make_batcher pins the model's [S, S, 3] uint8 rows).
+    """
+
+    def __init__(
+        self,
+        infer_fn: Callable[[np.ndarray], np.ndarray],
+        max_batch: int = 64,
+        max_wait_ms: float = 5.0,
+        autostart: bool = True,
+        row_shape: "tuple[int, ...] | None" = None,
+        row_dtype=None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._infer = infer_fn
+        self._row_shape = tuple(row_shape) if row_shape is not None else None
+        self._row_dtype = np.dtype(row_dtype) if row_dtype is not None else None
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._queue: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._closed = False
+        self.batches_run = 0
+        self.rows_run = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="jama16-serve-batcher", daemon=True
+        )
+        self._started = False
+        if autostart:
+            self.start()
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def submit(self, rows: np.ndarray) -> Future:
+        """Enqueue ``rows`` ([n, ...], n >= 1); the Future resolves to
+        the per-row results for exactly those rows, in row order."""
+        rows = np.asarray(rows)
+        if rows.ndim < 1 or rows.shape[0] == 0:
+            raise ValueError(
+                f"submit() wants [n, ...] with n >= 1, got shape {rows.shape}"
+            )
+        if self._row_shape is not None and rows.shape[1:] != self._row_shape:
+            raise ValueError(
+                f"submit() rows must be [n, {self._row_shape}], got "
+                f"{rows.shape} — rejected at submit so a malformed "
+                "request cannot fail its coalesced window's co-riders"
+            )
+        if self._row_dtype is not None and rows.dtype != self._row_dtype:
+            raise ValueError(
+                f"submit() rows must be {self._row_dtype}, got {rows.dtype}"
+            )
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            req = _Request(rows)
+            self._queue.put(req)
+        return req.future
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                return
+            window = [item]
+            rows = item.rows.shape[0]
+            deadline = time.monotonic() + self.max_wait_s
+            stop_after = False
+            while rows < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop_after = True
+                    break
+                window.append(nxt)
+                rows += nxt.rows.shape[0]
+            self._flush(window)
+            if stop_after:
+                return
+
+    def _flush(self, window: "list[_Request]") -> None:
+        try:
+            flat = (
+                window[0].rows if len(window) == 1
+                else np.concatenate([w.rows for w in window])
+            )
+            out = np.asarray(self._infer(flat))
+            if out.shape[0] != flat.shape[0]:
+                raise RuntimeError(
+                    f"infer_fn returned {out.shape[0]} rows for "
+                    f"{flat.shape[0]} inputs — row contract broken"
+                )
+            self.batches_run += 1
+            self.rows_run += int(flat.shape[0])
+            lo = 0
+            for w in window:
+                hi = lo + w.rows.shape[0]
+                # A caller may cancel() after a result() timeout — even
+                # CONCURRENTLY with this loop, so a cancelled() check
+                # would race; per-future try/except keeps one cancelled
+                # request from poisoning its co-riders' futures.
+                try:
+                    w.future.set_result(out[lo:hi])
+                except InvalidStateError:
+                    pass
+                lo = hi
+        except BaseException as e:  # noqa: BLE001 - futures carry it
+            # Every request of the window learns the failure; the worker
+            # survives to serve the next window (including a concurrent
+            # cancel() racing these set_exception calls).
+            for w in window:
+                try:
+                    if not w.future.done():
+                        w.future.set_exception(e)
+                except InvalidStateError:
+                    pass
+
+    def close(self) -> None:
+        """Stop accepting requests, flush everything already queued,
+        and join the worker."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(_STOP)
+        if self._started:
+            self._thread.join()
+        else:
+            # Never-started batcher: drain so queued futures don't hang.
+            pending = []
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _STOP:
+                    pending.append(item)
+            if pending:
+                self._flush(pending)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

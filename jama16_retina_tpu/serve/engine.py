@@ -1,0 +1,227 @@
+"""Persistent serving engine: device-resident stacked ensembles.
+
+The pre-existing inference surface (predict.py before this subsystem)
+paid O(k·images) per screening request: every ensemble member restored
+from orbax per process, k sequential jit forwards per batch, a fresh
+compile per invocation. This engine is the resident form of the same
+math:
+
+  * all k members restore ONCE and stack into one [k] parameter tree on
+    device (train_lib.stack_states — opt_state dropped, so the
+    residency is params+batch_stats only);
+  * each batch is served by ONE dispatch of the stacked forward
+    (train_lib.make_serving_step). The default lax.map member form is
+    bit-identical per member to the sequential restore+forward path at
+    the same batch shape — the parity contract that let predict.py be
+    rewired on top of this engine with byte-identical JSONL output
+    (pinned by tests/test_serve.py);
+  * inputs pad into a small set of bucketed batch shapes
+    (serve.bucket_sizes), so jit compiles once per bucket and never per
+    request size. Zero-fill padding rows are provably inert: eval-mode
+    forwards are row-independent (BN uses stored moments), so a kept
+    row's probabilities do not depend on its neighbors — the property
+    the bucket/coalescing machinery rests on, pinned by test;
+  * H2D overlaps device compute: per-bucket chunks are placed with
+    pipeline.staged_put (per-shard async puts) and all chunk dispatches
+    are queued before the first device_get, so the runtime uploads
+    chunk i+1 while chunk i computes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from jama16_retina_tpu import models, train_lib
+from jama16_retina_tpu.configs import ExperimentConfig, ServeConfig
+from jama16_retina_tpu.data import pipeline
+from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+
+def resolve_buckets(sc: ServeConfig, divisor: int = 1) -> tuple[int, ...]:
+    """The padded batch shapes the engine compiles for.
+
+    Explicit ``serve.bucket_sizes`` are taken verbatim (sorted,
+    deduplicated); the largest must cover ``serve.max_batch`` or chunks
+    at the coalescing cap would have no bucket to land in. Empty = auto:
+    powers of two from 8 up to max_batch — at most ~log2(max_batch)
+    compiles, and a partial chunk wastes at most half its bucket.
+
+    ``divisor``: the serving mesh's data-axis size. Batch rows shard
+    across that axis, so every bucket must divide by it — auto buckets
+    are rounded UP to the next multiple; explicit buckets that don't
+    divide are rejected HERE, at engine construction, instead of
+    surfacing as an opaque XLA uneven-sharding error on the first
+    request that hits the bad shape.
+    """
+    if sc.max_batch < 1:
+        raise ValueError(f"serve.max_batch must be >= 1, got {sc.max_batch}")
+    divisor = max(1, int(divisor))
+    if sc.bucket_sizes:
+        buckets = tuple(sorted({int(b) for b in sc.bucket_sizes}))
+        if buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1: {sc.bucket_sizes}")
+        bad = [b for b in buckets if b % divisor]
+        if bad:
+            raise ValueError(
+                f"bucket sizes {bad} do not divide across the serving "
+                f"mesh's data axis ({divisor} devices); every bucket "
+                f"must be a multiple of {divisor}"
+            )
+        if buckets[-1] < sc.max_batch:
+            raise ValueError(
+                f"largest bucket {buckets[-1]} < serve.max_batch "
+                f"{sc.max_batch}: chunks at the coalescing cap would have "
+                "no compiled shape"
+            )
+        return buckets
+    out, b = [], 8
+    while b < sc.max_batch:
+        out.append(b)
+        b *= 2
+    out.append(sc.max_batch)
+    return tuple(sorted({-(-b // divisor) * divisor for b in out}))
+
+
+class ServingEngine:
+    """Restore-once, stacked, bucket-batched ensemble inference.
+
+    Construct from checkpoint dirs (the production path) or hand a
+    pre-stacked state directly (``state=``; bench/tests skip the orbax
+    round-trip that way). ``mesh``: a DATA mesh — state replicated,
+    batch rows sharded across the data axis, exactly make_eval_step's
+    serving-side layout.
+    """
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        member_dirs: "list[str] | None" = None,
+        *,
+        model=None,
+        mesh=None,
+        state: "train_lib.TrainState | None" = None,
+    ):
+        self.cfg = cfg
+        self.model = model if model is not None else models.build(cfg.model)
+        self.mesh = mesh
+        if state is None:
+            if not member_dirs:
+                raise ValueError(
+                    "ServingEngine needs member checkpoint dirs (or a "
+                    "pre-stacked state=)"
+                )
+            from jama16_retina_tpu import trainer
+
+            state = train_lib.stack_states([
+                trainer.restore_for_eval(cfg, self.model, d)
+                for d in member_dirs
+            ])
+        else:
+            # Serving never steps the optimizer; drop its moments from
+            # the device residency whatever the caller handed over.
+            state = state.replace(opt_state=None)
+        self.n_members = int(state.step.shape[0])
+        place = (
+            mesh_lib.replicated(mesh) if mesh is not None
+            else jax.local_devices()[0]
+        )
+        self.state = jax.device_put(state, place)
+        self._batch_sharding = (
+            mesh_lib.batch_sharding(mesh) if mesh is not None else None
+        )
+        self._step = train_lib.make_serving_step(
+            cfg, self.model, mesh=mesh,
+            member_parallel=cfg.serve.member_parallel,
+        )
+        self.max_batch = int(cfg.serve.max_batch)
+        divisor = (
+            int(mesh.shape[mesh_lib._batch_axis(mesh)])
+            if mesh is not None else 1
+        )
+        self.buckets = resolve_buckets(cfg.serve, divisor=divisor)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        # Unreachable: chunks are capped at max_batch <= buckets[-1].
+        raise ValueError(f"no bucket covers chunk of {n} rows")
+
+    def _place(self, padded: np.ndarray):
+        if self._batch_sharding is not None:
+            return pipeline.staged_put(padded, self._batch_sharding)
+        return jax.device_put(padded, jax.local_devices()[0])
+
+    def member_probs(self, images: np.ndarray) -> np.ndarray:
+        """uint8 images [n, S, S, 3] -> per-member probabilities
+        [k, n] (binary) or [k, n, C] (multi head).
+
+        Chunks at max_batch, pads each chunk to its bucket shape with
+        zero rows, and keeps a BOUNDED window of dispatched chunks in
+        flight (fetching chunk i-2 only after dispatching chunk i): the
+        H2D/compute overlap of queue-ahead without letting device
+        residency grow with request size — a 50k-image screening batch
+        holds at most 3 chunks of buffers on device, not the whole
+        input. Padding rows are trimmed off on host.
+        """
+        images = np.asarray(images)
+        if images.ndim != 4:
+            raise ValueError(
+                f"expected images [n, S, S, 3], got shape {images.shape}"
+            )
+        if images.shape[0] == 0:
+            raise ValueError("empty request: no rows to score")
+        import collections
+
+        max_in_flight = 2
+        pending: collections.deque = collections.deque()
+        outs = []
+
+        def drain_one():
+            p, n = pending.popleft()
+            outs.append(np.asarray(jax.device_get(p))[:, :n])
+
+        for lo in range(0, images.shape[0], self.max_batch):
+            chunk = images[lo:lo + self.max_batch]
+            bucket = self._bucket_for(chunk.shape[0])
+            if bucket > chunk.shape[0]:
+                pad = np.zeros(
+                    (bucket - chunk.shape[0], *chunk.shape[1:]), chunk.dtype
+                )
+                padded = np.concatenate([chunk, pad])
+            else:
+                padded = chunk
+            dev = self._step(self.state, {"image": self._place(padded)})
+            pending.append((dev, chunk.shape[0]))
+            if len(pending) > max_in_flight:
+                drain_one()
+        while pending:
+            drain_one()
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=1)
+
+    def probs(self, images: np.ndarray) -> np.ndarray:
+        """Ensemble-averaged probabilities per row — the same
+        metrics.ensemble_average (float64 mean over members) every other
+        entry point applies, so a k=1 engine returns the member's probs
+        exactly and a k>1 engine matches evaluate.py/predict.py
+        averaging bit for bit."""
+        return metrics.ensemble_average(list(self.member_probs(images)))
+
+    def make_batcher(self):
+        """A MicroBatcher wired to this engine under cfg.serve's
+        coalescing knobs; results are ensemble-averaged rows. The
+        model's row shape/dtype are pinned so a malformed request is
+        rejected at submit() instead of failing its coalesced window's
+        co-riders."""
+        from jama16_retina_tpu.serve.batcher import MicroBatcher
+
+        size = self.cfg.model.image_size
+        return MicroBatcher(
+            self.probs,
+            max_batch=self.cfg.serve.max_batch,
+            max_wait_ms=self.cfg.serve.max_wait_ms,
+            row_shape=(size, size, 3),
+            row_dtype=np.uint8,
+        )

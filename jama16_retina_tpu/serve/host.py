@@ -1,0 +1,98 @@
+"""Serving host stage: parallel fundus normalization of raw photographs.
+
+predict.py's original host loop read and normalized images one at a time
+on a single thread — at ~0.1 s per 299px fundus normalization that
+stage, not the accelerator, bounds a screening batch. This module is the
+ParallelDecoder pattern (data/grain_pipeline.py, PR 1) applied to raw
+photograph files: cv2.imread and the OpenCV resize/blur pipeline inside
+``resize_and_center_fundus`` release the GIL, so a thread pool scales
+without process-spawn cost.
+
+Determinism contract (same as ParallelDecoder): output depends only on
+the input path list, never on worker count or scheduling — results are
+assembled in input order (``ThreadPoolExecutor.map`` is
+order-preserving), so ``workers`` is a pure throughput knob. Pinned by
+tests/test_serve.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from jama16_retina_tpu.data.grain_pipeline import resolve_decode_workers
+from jama16_retina_tpu.preprocess import fundus
+
+
+@dataclasses.dataclass
+class PreprocessResult:
+    """Kept rows in input order + the skip ledger predict.py reports."""
+
+    images: np.ndarray  # uint8 [n_kept, S, S, 3], input order
+    kept: list  # paths of the scored rows, aligned with images
+    skipped: list  # (path, reason) pairs, input order
+    qualities: list  # gradability score per kept row (fundus stats)
+
+
+def _load_one(path: str, image_size: int, ben_graham: bool):
+    """One path -> (error_reason | None, canvas | None, quality | None).
+    Total per row: unreadable files and blank frames become reasons, any
+    other exception propagates (a corrupt install must stay loud)."""
+    import cv2
+
+    bgr = cv2.imread(path, cv2.IMREAD_COLOR)
+    if bgr is None:
+        return "unreadable", None, None
+    try:
+        canvas, q = fundus.resize_and_center_fundus(
+            bgr[..., ::-1], diameter=image_size,
+            ben_graham=ben_graham, with_quality=True,
+        )
+    except fundus.FundusNotFound as e:
+        return f"no fundus found: {e}", None, None
+    return None, canvas, float(q["quality"])
+
+
+def preprocess_paths(
+    paths: "list[str]", image_size: int, ben_graham: bool = False,
+    workers: int = 0,
+) -> PreprocessResult:
+    """Normalize ``paths`` across a thread pool; worker-count-invariant.
+
+    ``workers``: 0 auto-derives like data.decode_workers (one thread per
+    host core up to 8, leaving a core for device dispatch).
+    """
+    workers = resolve_decode_workers(workers)
+
+    def one(p):
+        return _load_one(p, image_size, ben_graham)
+
+    if workers <= 1 or len(paths) < 2:
+        rows = [one(p) for p in paths]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(workers, len(paths)),
+            thread_name_prefix="jama16-serve-host",
+        ) as pool:
+            # map() yields results in input order regardless of which
+            # worker finished first — the whole determinism contract.
+            rows = list(pool.map(one, paths))
+
+    kept, skipped, qualities, canvases = [], [], [], []
+    for p, (why, canvas, quality) in zip(paths, rows):
+        if why is not None:
+            skipped.append((p, why))
+            continue
+        kept.append(p)
+        canvases.append(canvas)
+        qualities.append(quality)
+    images = (
+        np.stack(canvases) if canvases
+        else np.zeros((0, image_size, image_size, 3), np.uint8)
+    )
+    return PreprocessResult(
+        images=images, kept=kept, skipped=skipped, qualities=qualities
+    )

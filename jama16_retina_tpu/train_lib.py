@@ -760,6 +760,69 @@ def make_ensemble_train_step(
     )
 
 
+def stack_states(states: "list[TrainState]") -> TrainState:
+    """Stack k restored single-member TrainStates into the stacked [k]
+    layout (the inverse of ``unstack_member``) — the serving engine's
+    restore-once path (serve/engine.py): k member checkpoints become ONE
+    device-resident parameter tree, scored by one stacked forward per
+    batch instead of k restore+forward passes.
+
+    ``opt_state`` is dropped (None): serving never steps the optimizer,
+    and k stacked Adam moments would roughly triple the ensemble's HBM
+    residency for nothing. Members must agree on whether they carry an
+    EMA shadow (same run protocol); a mismatch fails loudly as a pytree
+    structure error rather than silently scoring mixed weights.
+    """
+    if not states:
+        raise ValueError("need at least one member state")
+    states = [s.replace(opt_state=None) for s in states]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def make_serving_step(
+    cfg: ExperimentConfig, model, mesh=None, member_parallel: bool = False
+) -> Callable:
+    """Stacked-state forward for the serving engine (serve/engine.py):
+    ``(stacked state [k], {'image': u8[B,S,S,3]}) -> probs [k, B(, C)]``.
+
+    ``member_parallel=False`` (default): members run under ``lax.map`` —
+    still ONE dispatch per batch (the k passes live inside the program;
+    no host round-trip or re-restore between members), and each member's
+    loop-body computation compiles to the same program a single-member
+    ``make_eval_step`` runs, so member m's rows are BIT-IDENTICAL to the
+    sequential restore+forward path at the same batch shape (pinned by
+    tests/test_serve.py — the serving rewire's parity contract; the
+    vmapped form batches convs across members, which reassociates and
+    drifts at float-ulp level on some arch/shape/dtype combos).
+
+    ``member_parallel=True``: ``vmap`` over members — the
+    make_ensemble_eval_step body, float-equivalent (rtol ~2e-5), higher
+    arithmetic intensity when members are small. Serving meshes here are
+    DATA meshes (state replicated, batch sharded on dim 0, like
+    make_eval_step); member-axis sharding stays the training-side
+    make_ensemble_eval_step's job.
+
+    Same EMA/TTA semantics as every other eval surface (_eval_probs).
+    """
+    cfg = _pallas_safe_cfg(cfg, mesh, "serving step")
+
+    def step(state: TrainState, batch: dict):
+        images = augment_lib.normalize(batch["image"])
+
+        def fwd(st):
+            return _eval_probs(st, images, model, cfg)
+
+        if member_parallel:
+            return jax.vmap(fwd)(state)
+        return jax.lax.map(fwd, state)
+
+    if mesh is None:
+        return jax.jit(step)
+    repl = mesh_lib.replicated(mesh)
+    data = mesh_lib.batch_sharding(mesh)
+    return jax.jit(step, in_shardings=(repl, data), out_shardings=repl)
+
+
 def make_ensemble_eval_step(cfg: ExperimentConfig, model, mesh=None) -> Callable:
     """Stacked eval: (stacked state, batch) -> probs [k, B(, C)] — all k
     members forward the same batch in one program (the eval twin of

@@ -6,10 +6,15 @@ pair (SURVEY.md §1): point at a trained checkpoint (or an ensemble root)
 and at image files/directories, and get one JSON line per image —
   {"image": path, "prob": P(referable), "referable": bool, ...}
 — produced by the SAME offline fundus normalization the preprocessing
-scripts apply (preprocess/fundus.py) and the same forward/ensemble
-machinery evaluate.py uses (the jit eval step under --device={tpu,cpu};
-the keras legacy backend under --device=tf, float-comparable), so a
-prediction here is what the eval metrics were computed over.
+scripts apply (preprocess/fundus.py, parallelized across --host_workers
+threads by serve/host.py) and the same forward/ensemble machinery
+evaluate.py uses. Under --device={tpu,cpu} the forward runs on the
+serving engine (serve/engine.py): all ensemble members restored ONCE
+into a device-resident stacked tree, one stacked forward per batch,
+bit-identical probabilities to the sequential per-member path it
+replaced (tests/test_serve.py). --device=tf keeps the keras legacy
+backend on host TF, float-comparable. Either way a prediction here is
+what the eval metrics were computed over.
 
 Examples:
   python predict.py --checkpoint_dir=/ckpt/run1 --images photo.jpeg
@@ -65,6 +70,20 @@ _MIN_QUALITY = flags.DEFINE_float(
     "should not be trusted for screening — the JAMA protocol excluded "
     "ungradeable images. 0 scores every image but flags none",
 )
+_STRICT = flags.DEFINE_boolean(
+    "strict", False,
+    "exit nonzero (code 2) when ANY input image was skipped as "
+    "unreadable or fundus-free, even though the rest scored — a "
+    "partially failed screening batch must be loud in pipelines that "
+    "check exit codes. Default keeps the per-row error JSON + exit 0 "
+    "behavior when at least one image scored",
+)
+_HOST_WORKERS = flags.DEFINE_integer(
+    "host_workers", 0,
+    "fundus-normalization worker threads (serve/host.py): 0 auto-"
+    "derives one per host core up to 8. Output is worker-count-"
+    "invariant, so this is a pure throughput knob",
+)
 
 _EXTS = (".jpg", ".jpeg", ".png", ".tif", ".tiff", ".bmp")
 
@@ -101,12 +120,13 @@ def main(argv):
 
         jax.config.update("jax_platforms", "cpu")
 
-    import cv2
+    import dataclasses
+
     import numpy as np
 
     from jama16_retina_tpu import configs, models, train_lib, trainer
     from jama16_retina_tpu.eval import metrics
-    from jama16_retina_tpu.preprocess import fundus
+    from jama16_retina_tpu.serve import host as serve_host
 
     cfg = configs.get_config(_CONFIG.value)
     if _SET.value:
@@ -120,29 +140,21 @@ def main(argv):
         dirs = ckpt_lib.discover_member_dirs(_CKPT.value)
     paths = _expand(list(_IMAGES.value))
 
+    # Host stage: fundus normalization parallelized across a worker pool
+    # (serve/host.py) with worker-count-invariant output order — the
+    # old serial per-image loop, minus the serialization.
     size = cfg.model.image_size
-    normed, kept, skipped, qualities = [], [], [], []
-    for p in paths:
-        bgr = cv2.imread(p, cv2.IMREAD_COLOR)
-        if bgr is None:
-            skipped.append((p, "unreadable"))
-            continue
-        try:
-            canvas, q = fundus.resize_and_center_fundus(
-                bgr[..., ::-1], diameter=size,
-                ben_graham=_BEN_GRAHAM.value, with_quality=True,
-            )
-            normed.append(canvas)
-            qualities.append(q["quality"])
-            kept.append(p)
-        except fundus.FundusNotFound as e:
-            skipped.append((p, f"no fundus found: {e}"))
+    pre = serve_host.preprocess_paths(
+        paths, size, ben_graham=_BEN_GRAHAM.value,
+        # The flag wins; 0 falls through to the config knob, and 0 there
+        # too means auto (resolve_decode_workers).
+        workers=_HOST_WORKERS.value or cfg.serve.host_workers,
+    )
+    kept, skipped, qualities = pre.kept, pre.skipped, pre.qualities
     for p, why in skipped:
         print(json.dumps({"image": p, "error": why}))
     if not kept:
         sys.exit(1)
-
-    import jax
 
     model = models.build(cfg.model)  # flax tree = the checkpoint schema
     use_tf = _DEVICE.value == "tf"
@@ -150,37 +162,52 @@ def main(argv):
         from jama16_retina_tpu.models import tf_backend
 
         keras_model = models.build(cfg.model, backend="tf")
-    else:
-        eval_step = train_lib.make_eval_step(cfg, model)
-    # Padded fixed-size batches built ONCE (jit compiles once per run;
-    # every ensemble member scores the same batches, only state differs).
-    batches, block_lens = [], []
-    for i in range(0, len(kept), _BATCH.value):
-        block = normed[i:i + _BATCH.value]
-        pad = _BATCH.value - len(block)
-        batches.append(np.stack(block + [np.zeros_like(normed[0])] * pad))
-        block_lens.append(len(block))
-    del normed  # the padded batches are the only copy needed from here on
-    prob_list = []
-    for d in dirs:
-        state = trainer.restore_for_eval(cfg, model, d)
-        if use_tf:
+        # Padded fixed-size batches built ONCE; every ensemble member
+        # scores the same batches, only the loaded weights differ.
+        batches, block_lens = [], []
+        for i in range(0, len(kept), _BATCH.value):
+            block = pre.images[i:i + _BATCH.value]
+            pad = _BATCH.value - block.shape[0]
+            if pad:
+                block = np.concatenate(
+                    [block, np.zeros((pad, *block.shape[1:]), block.dtype)]
+                )
+            else:
+                # Owned copy, not a view — views would pin the whole
+                # pre.images array past the release below.
+                block = block.copy()
+            batches.append(block)
+            block_lens.append(min(_BATCH.value, len(kept) - i))
+        pre = None  # the padded batches are the only copy needed now
+        prob_list = []
+        for d in dirs:
+            state = trainer.restore_for_eval(cfg, model, d)
             tf_backend.load_flax_state(
                 keras_model, train_lib.eval_params(state), state.batch_stats
             )
-            probs = [
+            prob_list.append(np.concatenate([
                 tf_backend.predict_probs(
                     keras_model, b, cfg.model.head, tta=cfg.eval.tta
                 )[:n]
                 for b, n in zip(batches, block_lens)
-            ]
-        else:
-            probs = [
-                np.asarray(eval_step(state, {"image": b}))[:n]
-                for b, n in zip(batches, block_lens)
-            ]
-        prob_list.append(np.concatenate(probs))
-    probs = metrics.ensemble_average(prob_list)
+            ]))
+        probs = metrics.ensemble_average(prob_list)
+    else:
+        # Serving engine (serve/engine.py): every member restored ONCE
+        # into a device-resident stacked tree, one stacked forward per
+        # batch. Pinned to a single bucket at --batch_size so the padded
+        # shapes — and therefore the probabilities — are bit-identical
+        # to the sequential per-member path this replaced
+        # (tests/test_serve.py pins both levels).
+        from jama16_retina_tpu.serve import ServingEngine
+
+        cfg = cfg.replace(serve=dataclasses.replace(
+            cfg.serve,
+            max_batch=_BATCH.value,
+            bucket_sizes=(_BATCH.value,),
+        ))
+        engine = ServingEngine(cfg, dirs, model=model)
+        probs = engine.probs(pre.images)
 
     for p, pr, qual in zip(kept, probs, qualities):
         if cfg.model.head != "binary":
@@ -207,6 +234,11 @@ def main(argv):
             row["gradable"] = bool(qual >= _MIN_QUALITY.value)
         row["n_models"] = len(dirs)
         print(json.dumps(row))
+
+    if skipped and _STRICT.value:
+        # Every scored row is already on stdout; the nonzero exit tells
+        # pipelines the screening batch was INCOMPLETE (--strict).
+        sys.exit(2)
 
 
 if __name__ == "__main__":

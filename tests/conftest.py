@@ -89,6 +89,11 @@ _QUICK_TESTS = {
     "test_integration.py::test_evaluate_checkpoints_report",
     # predict CLI contract (no training: the loud missing-ckpt path)
     "test_predict.py::test_predict_cli_requires_checkpoint",
+    # serving subsystem: the engine's bit-identity contract, the
+    # micro-batcher's coalescing, and the host stage's invariance
+    "test_serve.py::test_engine_bit_identical_to_sequential_path",
+    "test_serve.py::test_batcher_coalesces_queued_requests",
+    "test_serve.py::test_host_preprocess_is_worker_count_invariant",
 }
 
 

@@ -96,6 +96,68 @@ def test_tiered_bench_plan_is_partial_residency():
     assert out == 1000.0 and extras["pipeline_fed_tiered"] == 1000.0
 
 
+def test_serve_rates_ride_the_same_physics_guard():
+    """Every serve_* img/s key publishes through the SAME guard as the
+    training rates: an impossible rate (implied FLOP/s above chip peak)
+    is refused and omitted, a physical one lands rounded."""
+    flops_per_image = 4 * 33.3e9  # k=4 ensemble: every image pays 4 passes
+    extras = {}
+    for key in (
+        "serve_images_per_sec",
+        "serve_ensemble4_images_per_sec",
+        "serve_offered_images_per_sec_c8",
+    ):
+        out = bench._publish(extras, key, 83121.54, flops_per_image, 197e12)
+        assert out is None and key not in extras
+        out = bench._publish(extras, key, 1000.0, flops_per_image, 197e12)
+        assert out == 1000.0 and extras[key] == 1000.0
+
+
+def test_latency_summary_p50_le_p99():
+    """The offered-load latency summary's percentile pair comes from one
+    sorted sample, so p50 <= p99 must hold on ANY input — including the
+    degenerate single-sample window — and an empty window is refused
+    rather than summarized."""
+    rng = np.random.default_rng(0)
+    s = bench._latency_summary(rng.gamma(2.0, 10.0, size=500))
+    assert s["p50_ms"] <= s["p99_ms"]
+    assert s["n"] == 500
+    assert s["p50_ms"] <= s["mean_ms"] <= s["p99_ms"] * 2  # sane ballpark
+    one = bench._latency_summary([5.0])
+    assert one["p50_ms"] == one["p99_ms"] == one["mean_ms"] == 5.0
+    # Unsorted input must not corrupt the percentiles (the summary
+    # sorts internally).
+    rev = bench._latency_summary([30.0, 1.0, 2.0, 3.0])
+    assert rev["p50_ms"] <= rev["p99_ms"]
+    with pytest.raises(ValueError, match="empty"):
+        bench._latency_summary([])
+
+
+def test_offered_load_closed_loop_counts_every_request():
+    """The offered-load harness returns one latency per request across
+    all submitters and a positive window (CPU-only: a resolved-future
+    fake stands in for the batcher)."""
+    from concurrent.futures import Future
+
+    calls = []
+
+    def submit(payload):
+        calls.append(payload)
+        f = Future()
+        f.set_result(np.zeros(1))
+        return f
+
+    lats, window = bench._offered_load(
+        submit, concurrency=4, requests_per_worker=5,
+        payload=lambda w, i: (w, i),
+    )
+    assert len(lats) == 20 == len(calls)
+    assert window > 0
+    assert all(l >= 0 for l in lats)
+    # Every (worker, request) pair was offered exactly once.
+    assert sorted(calls) == [(w, i) for w in range(4) for i in range(5)]
+
+
 def test_timed_steps_counts_all_steps():
     """_timed_steps' fence discipline on CPU: a step that chains state
     through iterations yields a sane rate and the final state reflects

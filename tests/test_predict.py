@@ -181,6 +181,24 @@ def test_predict_cli_min_quality_flags_blurred(setup):
     assert rows["sharp.png"]["gradable"] is True
 
 
+@pytest.mark.slow
+def test_predict_cli_strict_exits_nonzero_on_skipped(setup):
+    """--strict: a partially failed screening batch (the junk.jpeg in
+    the fixture dir is unreadable) exits nonzero even though every other
+    image scored — and the scored rows are still all on stdout."""
+    _, ckdir, imgdir = setup
+    res = run_predict([
+        "--config=smoke", "--set", "model.image_size=64",
+        f"--checkpoint_dir={ckdir}", f"--images={imgdir}",
+        "--device=cpu", "--batch_size=2", "--strict",
+    ])
+    detail = f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-2000:]}"
+    assert res.returncode == 2, detail
+    rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
+    assert len([r for r in rows if "error" in r]) == 1, detail
+    assert len([r for r in rows if "prob" in r]) == 3, detail
+
+
 def test_predict_cli_requires_checkpoint(setup):
     # Not slow-marked: the fixture is random-init (no training) and the
     # subprocess exits at flag validation — ~15 s, cheap enough for the

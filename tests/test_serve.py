@@ -1,0 +1,577 @@
+"""Serving subsystem (jama16_retina_tpu/serve/): the engine's stacked
+forward is BIT-IDENTICAL to the sequential restore+forward path it
+replaced (the predict.py rewire contract), bucket padding is exact at
+every partial batch size, the micro-batcher coalesces concurrent
+submitters and returns correct per-request futures under any arrival
+interleaving, and the parallel host stage is worker-count-invariant."""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib, trainer
+from jama16_retina_tpu.configs import ServeConfig, get_config, override
+from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.serve import MicroBatcher, ServingEngine, resolve_buckets
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+K = 2  # ensemble members in the fixture
+N_IMGS = 12
+SIZE = 32
+
+
+@pytest.fixture(scope="module")
+def serve_setup(tmp_path_factory):
+    """Smoke-model ensemble checkpoints + an engine over them.
+
+    Buckets (4, 8) with max_batch 8: small enough that every partial
+    size and the chunk boundary are exercised by a 12-image request.
+    """
+    root = tmp_path_factory.mktemp("serve")
+    cfg = override(get_config("smoke"), [f"model.image_size={SIZE}"])
+    cfg = cfg.replace(serve=ServeConfig(
+        max_batch=8, max_wait_ms=20.0, bucket_sizes=(4, 8),
+    ))
+    model = models.build(cfg.model)
+    dirs = []
+    for m in range(K):
+        state, _ = train_lib.create_state(cfg, model, jax.random.key(m))
+        d = str(root / f"member_{m:02d}")
+        ck = ckpt_lib.Checkpointer(d)
+        ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+        ck.wait()
+        ck.close()
+        dirs.append(d)
+    engine = ServingEngine(cfg, dirs, model=model)
+    imgs = np.random.default_rng(0).integers(
+        0, 256, (N_IMGS, SIZE, SIZE, 3), np.uint8
+    )
+    return cfg, model, dirs, engine, imgs
+
+
+@pytest.fixture(scope="module")
+def sequential_ref(serve_setup):
+    """The pre-engine path predict.py ran: each member restored
+    individually, forwarded through the single-member jit eval step.
+    One restore + one jit instance for the whole module (the references
+    below call it at several shapes)."""
+    cfg, model, dirs, _, _ = serve_setup
+    states = [trainer.restore_for_eval(cfg, model, d) for d in dirs]
+    eval_step = train_lib.make_eval_step(cfg, model)
+
+    def member_probs(padded):
+        return np.stack([
+            np.asarray(eval_step(s, {"image": padded})) for s in states
+        ])
+
+    return member_probs
+
+
+def _pad(rows, bucket):
+    if rows.shape[0] == bucket:
+        return rows
+    fill = np.zeros((bucket - rows.shape[0], *rows.shape[1:]), rows.dtype)
+    return np.concatenate([rows, fill])
+
+
+# ---------------------------------------------------------------------------
+# Engine: stacked state, bit-identity, bucket padding
+# ---------------------------------------------------------------------------
+
+
+def test_engine_bit_identical_to_sequential_path(serve_setup, sequential_ref):
+    """The acceptance contract of the rewire: one stacked lax.map
+    forward == k sequential restore+forward passes, bit for bit, at the
+    same padded shapes (12 rows -> chunks of 8 and 4 on this engine)."""
+    _, _, _, engine, imgs = serve_setup
+    got = engine.member_probs(imgs)
+    assert got.shape[:2] == (K, N_IMGS)
+    ref = np.concatenate([
+        sequential_ref(imgs[:8]),
+        sequential_ref(_pad(imgs[8:], 4))[:, :4],
+    ], axis=1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_engine_probs_match_ensemble_average_exactly(serve_setup):
+    cfg, model, dirs, engine, imgs = serve_setup
+    member = engine.member_probs(imgs)
+    np.testing.assert_array_equal(
+        engine.probs(imgs), metrics.ensemble_average(list(member))
+    )
+
+
+def test_bucket_padding_exact_at_every_partial_size(serve_setup,
+                                                    sequential_ref):
+    """For every n in [1, max_batch]: the engine pads n rows to the
+    smallest covering bucket with zero rows, and the kept rows are
+    bitwise what the sequential path computes at that padded shape —
+    zero-fill neighbors are provably inert (row-independent eval)."""
+    _, _, _, engine, imgs = serve_setup
+    refs = {
+        b: sequential_ref(_pad(imgs[:b], b)) for b in engine.buckets
+    }
+    for n in range(1, engine.max_batch + 1):
+        bucket = next(b for b in engine.buckets if b >= n)
+        got = engine.member_probs(imgs[:n])
+        ref = sequential_ref(_pad(imgs[:n], bucket))[:, :n]
+        np.testing.assert_array_equal(got, ref, err_msg=f"n={n}")
+        # Rows shared with the full-bucket reference agree too: a kept
+        # row's value never depends on whether its neighbors were real
+        # images or padding.
+        np.testing.assert_array_equal(
+            got, refs[bucket][:, :n], err_msg=f"n={n} vs full bucket"
+        )
+
+
+def test_multi_chunk_requests_bounded_in_flight_stay_exact(serve_setup):
+    """Requests spanning more chunks than the engine's in-flight window
+    (12 rows at max_batch 4 -> 3 chunks vs window 2) produce exactly the
+    per-chunk results, in order — the bounded-residency drain loses no
+    rows and reorders nothing."""
+    cfg, model, dirs, engine, imgs = serve_setup
+    small = cfg.replace(serve=ServeConfig(max_batch=4, bucket_sizes=(4,)))
+    chunked = ServingEngine(small, dirs, model=model)
+    ref = np.concatenate(
+        [engine.member_probs(imgs[i:i + 4]) for i in range(0, N_IMGS, 4)],
+        axis=1,
+    )
+    np.testing.assert_array_equal(chunked.member_probs(imgs), ref)
+
+
+def test_vmapped_member_parallel_mode_is_float_equivalent(serve_setup):
+    """serve.member_parallel=true (the pod-topology vmapped form) is
+    documented float-equivalent, not bit-equal: batching convs across
+    members reassociates their reductions, which at the smoke model's
+    bf16 compute dtype drifts probabilities by up to ~4e-4 (well inside
+    bf16's ~8e-3 resolution; float32 configs sit at ~1e-7). This pin is
+    exactly why the engine's default is the bit-exact lax.map form."""
+    cfg, model, dirs, engine, imgs = serve_setup
+    vm_cfg = cfg.replace(
+        serve=dataclasses.replace(cfg.serve, member_parallel=True)
+    )
+    vm_engine = ServingEngine(vm_cfg, dirs, model=model)
+    got, ref = vm_engine.member_probs(imgs), engine.member_probs(imgs)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=2e-3)
+
+
+def test_engine_rejects_empty_and_misshapen_requests(serve_setup):
+    _, _, _, engine, imgs = serve_setup
+    with pytest.raises(ValueError, match="empty"):
+        engine.member_probs(imgs[:0])
+    with pytest.raises(ValueError, match="expected images"):
+        engine.member_probs(imgs[0])  # missing the row dim
+
+
+def test_stack_states_drops_opt_state_and_inverts_unstack(serve_setup):
+    cfg, model, dirs, engine, _ = serve_setup
+    states = [trainer.restore_for_eval(cfg, model, d) for d in dirs]
+    stacked = train_lib.stack_states(states)
+    assert stacked.opt_state is None
+    assert int(stacked.step.shape[0]) == K
+    for m, s in enumerate(states):
+        member = train_lib.unstack_member(stacked, m)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            member.params, s.params,
+        )
+    with pytest.raises(ValueError, match="at least one"):
+        train_lib.stack_states([])
+
+
+def test_resolve_buckets():
+    assert resolve_buckets(ServeConfig(max_batch=64)) == (8, 16, 32, 64)
+    assert resolve_buckets(ServeConfig(max_batch=48)) == (8, 16, 32, 48)
+    assert resolve_buckets(ServeConfig(max_batch=5)) == (5,)
+    assert resolve_buckets(
+        ServeConfig(max_batch=8, bucket_sizes=(8, 4, 4))
+    ) == (4, 8)
+    with pytest.raises(ValueError, match="largest bucket"):
+        resolve_buckets(ServeConfig(max_batch=16, bucket_sizes=(4, 8)))
+    with pytest.raises(ValueError, match="max_batch"):
+        resolve_buckets(ServeConfig(max_batch=0))
+
+
+def test_resolve_buckets_respects_mesh_divisor():
+    """Serving meshes shard batch rows over the data axis: auto buckets
+    round UP to the axis size, explicit non-dividing buckets are
+    rejected at construction instead of at first dispatch."""
+    assert resolve_buckets(
+        ServeConfig(max_batch=64), divisor=16
+    ) == (16, 32, 64)
+    assert resolve_buckets(
+        ServeConfig(max_batch=20), divisor=16
+    ) == (16, 32)  # 8 and 20 both round up
+    with pytest.raises(ValueError, match="data axis"):
+        resolve_buckets(
+            ServeConfig(max_batch=16, bucket_sizes=(4, 16)), divisor=8
+        )
+
+
+def test_engine_on_mesh_rounds_buckets_and_shards(serve_setup):
+    """An engine over the 8-fake-device data mesh auto-rounds its
+    buckets to the axis size and still scores a lone image correctly
+    (bit-identical to the meshless engine: lax.map at an 8-row shape
+    either way)."""
+    cfg, model, dirs, engine, imgs = serve_setup
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh()
+    auto_cfg = cfg.replace(serve=ServeConfig(max_batch=8))
+    mesh_engine = ServingEngine(auto_cfg, dirs, model=model, mesh=mesh)
+    assert all(b % mesh.devices.size == 0 for b in mesh_engine.buckets)
+    # Same compiled row shape (bucket 8) on both engines -> bitwise.
+    np.testing.assert_array_equal(
+        mesh_engine.member_probs(imgs[:8]), engine.member_probs(imgs[:8])
+    )
+    # A lone request still serves (padded to a full mesh-divisible
+    # bucket under the hood).
+    assert mesh_engine.member_probs(imgs[:1]).shape[:2] == (K, 1)
+
+
+def test_serve_config_overrides_parse_numeric_tuples():
+    from jama16_retina_tpu import configs
+
+    cfg = configs.override(get_config("smoke"), [
+        "serve.max_batch=16", "serve.max_wait_ms=2.5",
+        "serve.bucket_sizes=4,16", "serve.member_parallel=true",
+    ])
+    assert cfg.serve.max_batch == 16
+    assert cfg.serve.max_wait_ms == 2.5
+    assert cfg.serve.bucket_sizes == (4, 16)  # ints, not strings
+    assert cfg.serve.member_parallel is True
+    # Element types come from the ANNOTATION, not from what the value
+    # happens to parse as: a date-named checkpoint dir stays a string.
+    cfg = configs.override(
+        get_config("smoke"), ["eval.ensemble_dirs=20260801,/ckpt/b"]
+    )
+    assert cfg.eval.ensemble_dirs == ("20260801", "/ckpt/b")
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher: coalescing, ordering, determinism, failure paths
+# ---------------------------------------------------------------------------
+
+
+def _row_sums(rows):
+    return rows.reshape(rows.shape[0], -1).astype(np.float64).sum(axis=1)
+
+
+def test_batcher_coalesces_queued_requests():
+    """16 staged single-row requests flush as ONE coalesced batch (the
+    window drains the whole queue before its deadline)."""
+    calls = []
+
+    def infer(rows):
+        calls.append(rows.shape[0])
+        return _row_sums(rows)
+
+    rng = np.random.default_rng(1)
+    rows = rng.normal(size=(16, 3))
+    with MicroBatcher(
+        infer, max_batch=64, max_wait_ms=50.0, autostart=False
+    ) as b:
+        futs = [b.submit(rows[i:i + 1]) for i in range(16)]
+        b.start()
+        got = [f.result(timeout=30) for f in futs]
+    assert calls == [16]
+    assert b.batches_run == 1 and b.rows_run == 16
+    for i, g in enumerate(got):
+        np.testing.assert_array_equal(g, _row_sums(rows[i:i + 1]))
+
+
+def test_batcher_window_closes_at_max_batch():
+    calls = []
+
+    def infer(rows):
+        calls.append(rows.shape[0])
+        return _row_sums(rows)
+
+    rows = np.arange(40, dtype=np.float64).reshape(10, 4)
+    with MicroBatcher(
+        infer, max_batch=4, max_wait_ms=200.0, autostart=False
+    ) as b:
+        futs = [b.submit(rows[i:i + 1]) for i in range(10)]
+        b.start()
+        for f in futs:
+            f.result(timeout=30)
+    # 10 single-row requests at max_batch 4: windows close at 4 rows
+    # without ever waiting out the 200 ms deadline.
+    assert calls == [4, 4, 2]
+
+
+def test_batcher_concurrent_submitters_coalesce_and_stay_correct():
+    """Concurrent submitters: every future resolves to its own rows'
+    results, and the batcher runs FEWER batches than requests (i.e. it
+    actually coalesced) while a slow infer holds the engine."""
+    calls = []
+
+    def infer(rows):
+        calls.append(rows.shape[0])
+        time.sleep(0.03)  # while the engine is busy, submitters pile up
+        return _row_sums(rows)
+
+    rng = np.random.default_rng(2)
+    rows = rng.normal(size=(24, 5))
+    results = {}
+    barrier = threading.Barrier(8)
+
+    def submitter(w, batcher):
+        barrier.wait()
+        for i in range(w * 3, w * 3 + 3):
+            results[i] = batcher.submit(rows[i:i + 1])
+
+    with MicroBatcher(infer, max_batch=16, max_wait_ms=20.0) as b:
+        threads = [
+            threading.Thread(target=submitter, args=(w, b))
+            for w in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = {i: f.result(timeout=30) for i, f in results.items()}
+    assert sum(calls) == 24
+    assert len(calls) < 24, f"no coalescing happened: {calls}"
+    for i in range(24):
+        np.testing.assert_array_equal(got[i], _row_sums(rows[i:i + 1]))
+
+
+def test_batcher_multi_row_requests_split_in_submission_order():
+    """Requests of mixed sizes resolve to exactly their own row slices
+    of the coalesced result, in submission order."""
+    def infer(rows):
+        return _row_sums(rows)
+
+    rng = np.random.default_rng(3)
+    reqs = [rng.normal(size=(n, 4)) for n in (3, 1, 5)]
+    with MicroBatcher(
+        infer, max_batch=16, max_wait_ms=50.0, autostart=False
+    ) as b:
+        futs = [b.submit(r) for r in reqs]
+        # close() without start(): the drain path flushes everything
+        # still queued, so no future is left hanging.
+    for r, f in zip(reqs, futs):
+        np.testing.assert_array_equal(f.result(timeout=30), _row_sums(r))
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(reqs[0])
+
+
+def test_batcher_deterministic_under_arrival_interleaving(serve_setup):
+    """Single-bucket engine: a row's probabilities are bit-identical
+    whether it was submitted alone, coalesced with strangers, or
+    replayed in a different interleaving — every row always runs at the
+    same compiled shape with inert zero padding."""
+    cfg, model, dirs, _, imgs = serve_setup
+    one_bucket = cfg.replace(serve=ServeConfig(
+        max_batch=8, max_wait_ms=5.0, bucket_sizes=(8,),
+    ))
+    engine = ServingEngine(one_bucket, dirs, model=model)
+    ref = {i: engine.probs(imgs[i:i + 1]) for i in range(N_IMGS)}
+
+    for seed in (0, 1):
+        results = {}
+        lock = threading.Lock()
+
+        def submitter(idx_list, batcher):
+            for i in idx_list:
+                time.sleep(0.001 * ((i + seed) % 3))
+                f = batcher.submit(imgs[i:i + 1])
+                with lock:
+                    results[i] = f
+
+        order = np.random.default_rng(seed).permutation(N_IMGS)
+        with engine.make_batcher() as b:
+            threads = [
+                threading.Thread(
+                    target=submitter, args=(order[w::3], b)
+                )
+                for w in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            got = {i: f.result(timeout=60) for i, f in results.items()}
+        for i in range(N_IMGS):
+            np.testing.assert_array_equal(
+                got[i], ref[i], err_msg=f"seed={seed} img={i}"
+            )
+
+
+def test_batcher_rejects_malformed_rows_at_submit():
+    """With a pinned row shape/dtype, a malformed request fails ITS OWN
+    submit() and never reaches a coalesced window where it would take
+    innocent co-riders' futures down."""
+    def infer(rows):
+        return _row_sums(rows)
+
+    good = np.zeros((1, 4, 4, 3), np.uint8)
+    with MicroBatcher(
+        infer, max_batch=8, max_wait_ms=50.0, autostart=False,
+        row_shape=(4, 4, 3), row_dtype=np.uint8,
+    ) as b:
+        f_good = b.submit(good)
+        with pytest.raises(ValueError, match="co-riders"):
+            b.submit(np.zeros((1, 8, 8, 3), np.uint8))  # wrong size
+        with pytest.raises(ValueError, match="uint8"):
+            b.submit(np.zeros((1, 4, 4, 3), np.float32))  # wrong dtype
+    np.testing.assert_array_equal(
+        f_good.result(timeout=30), _row_sums(good)
+    )
+
+
+def test_batcher_cancelled_future_does_not_poison_window():
+    """A request cancel()ed before its window flushes must not corrupt
+    co-riders: their futures still resolve with their own results."""
+    def infer(rows):
+        return _row_sums(rows)
+
+    rows = np.arange(12, dtype=np.float64).reshape(3, 4)
+    with MicroBatcher(
+        infer, max_batch=8, max_wait_ms=50.0, autostart=False
+    ) as b:
+        f0 = b.submit(rows[0:1])
+        f1 = b.submit(rows[1:2])
+        f2 = b.submit(rows[2:3])
+        assert f1.cancel()  # not yet running: cancellable
+        b.start()
+        np.testing.assert_array_equal(
+            f0.result(timeout=30), _row_sums(rows[0:1])
+        )
+        np.testing.assert_array_equal(
+            f2.result(timeout=30), _row_sums(rows[2:3])
+        )
+        assert f1.cancelled()
+
+
+def test_batcher_propagates_infer_errors_and_survives():
+    boom = [True]
+
+    def infer(rows):
+        if boom[0]:
+            raise ValueError("engine exploded")
+        return _row_sums(rows)
+
+    rows = np.ones((2, 3))
+    with MicroBatcher(infer, max_batch=4, max_wait_ms=1.0) as b:
+        f1 = b.submit(rows)
+        with pytest.raises(ValueError, match="engine exploded"):
+            f1.result(timeout=30)
+        boom[0] = False  # the worker must have survived the failure
+        f2 = b.submit(rows)
+        np.testing.assert_array_equal(f2.result(timeout=30), _row_sums(rows))
+    with pytest.raises(ValueError, match="n >= 1"):
+        b2 = MicroBatcher(infer, max_batch=4, autostart=False)
+        b2.submit(rows[:0])
+
+
+# ---------------------------------------------------------------------------
+# Host stage: parallel fundus normalization
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def photo_dir(tmp_path_factory):
+    import cv2
+
+    from jama16_retina_tpu.data import synthetic
+
+    d = tmp_path_factory.mktemp("photos")
+    for i in range(4):
+        img = synthetic.render_fundus(
+            np.random.default_rng(i), i % 5,
+            synthetic.SynthConfig(image_size=96),
+        )
+        cv2.imwrite(str(d / f"eye_{i}.jpeg"), img[..., ::-1])
+    (d / "junk.jpeg").write_bytes(b"not a jpeg")
+    # A readable frame with no fundus in it (all-black): FundusNotFound.
+    cv2.imwrite(str(d / "zz_black.png"), np.zeros((96, 96, 3), np.uint8))
+    return d
+
+
+def test_host_preprocess_is_worker_count_invariant(photo_dir):
+    from jama16_retina_tpu.serve import host as serve_host
+
+    paths = sorted(str(p) for p in photo_dir.iterdir())
+    runs = [
+        serve_host.preprocess_paths(paths, 64, workers=w)
+        for w in (1, 4)
+    ]
+    a, b = runs
+    assert a.kept == b.kept and len(a.kept) == 4
+    assert a.skipped == b.skipped and len(a.skipped) == 2
+    reasons = dict(a.skipped)
+    assert "unreadable" in reasons[str(photo_dir / "junk.jpeg")]
+    assert "no fundus" in reasons[str(photo_dir / "zz_black.png")]
+    np.testing.assert_array_equal(a.images, b.images)
+    assert a.qualities == b.qualities
+    # Kept rows come back in input order (the _expand contract predict
+    # relies on for row<->path alignment).
+    assert a.kept == [p for p in paths if "eye_" in p]
+
+
+def test_host_preprocess_empty_keeps_shape():
+    from jama16_retina_tpu.serve import host as serve_host
+
+    res = serve_host.preprocess_paths([], 64, workers=2)
+    assert res.images.shape == (0, 64, 64, 3)
+    assert res.kept == [] and res.skipped == [] and res.qualities == []
+
+
+# ---------------------------------------------------------------------------
+# Engine vs predict.py CLI: JSONL parity on CPU
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_engine_matches_predict_cli_jsonl(serve_setup, photo_dir):
+    """The rewired predict.py CLI emits exactly what the engine +
+    parallel host stage compute in-process: same rows, same rounded
+    probabilities, same skip ledger — the subsystem and its CLI face
+    cannot drift apart."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    cfg, model, dirs, _, _ = serve_setup
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [
+            sys.executable, os.path.join(repo, "predict.py"),
+            "--config=smoke", "--set", f"model.image_size={SIZE}",
+            *[f"--ensemble_dir={d}" for d in dirs],
+            f"--images={photo_dir}", "--device=cpu", "--batch_size=2",
+        ],
+        capture_output=True, text=True, cwd=repo, timeout=900,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    detail = f"stdout:\n{res.stdout[-2000:]}\nstderr:\n{res.stderr[-2000:]}"
+    assert res.returncode == 0, detail
+    rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
+    cli = {r["image"]: r for r in rows if "prob" in r}
+    cli_errors = {r["image"] for r in rows if "error" in r}
+
+    from jama16_retina_tpu.serve import host as serve_host
+
+    paths = sorted(
+        str(p) for p in photo_dir.iterdir()
+        if str(p).lower().endswith((".jpg", ".jpeg", ".png"))
+    )
+    pre = serve_host.preprocess_paths(paths, SIZE, workers=2)
+    # The CLI pins a single bucket at --batch_size: reproduce it.
+    ecfg = cfg.replace(serve=ServeConfig(max_batch=2, bucket_sizes=(2,)))
+    engine = ServingEngine(ecfg, dirs, model=model)
+    probs = engine.probs(pre.images)
+    assert set(cli) == set(pre.kept)
+    assert cli_errors == {p for p, _ in pre.skipped}
+    for p, pr in zip(pre.kept, probs):
+        assert cli[p]["prob"] == round(float(pr), 6), p
+        assert cli[p]["n_models"] == K
