@@ -71,6 +71,13 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        the micro-batcher. Every serve_* img/s rate
                        rides the same physics guard (FLOPs from the
                        compiled serving program).
+  device_only_telemetry / telemetry_overhead_pct / telemetry_overhead_ok
+                     — the device_only window re-run with the trainer's
+                       per-step telemetry ops live (obs/ registry +
+                       StallClock; ISSUE 3): the hot-path cost of
+                       runtime telemetry, PINNED within 2% of the
+                       uninstrumented headline (_telemetry_overhead_guard;
+                       also bounded per-op in tests/test_bench_guard.py).
 
 Workload = the production config of record (BASELINE.json:7): Inception-v3,
 binary head, 299x299, global batch 32, aux head on, bf16 compute — the
@@ -302,6 +309,59 @@ def _gate_ensemble_speedup(extras: dict, rate: float,
         f"trainer.fit_ensemble auto-falls back to the sequential driver "
         f"on 1-device meshes for the same reason"
     )
+
+
+def _instrumented_step(step, registry):
+    """Wrap a train step with the SAME per-step telemetry ops the
+    trainer's hot loop pays (obs/spans.StallClock segment timing into
+    registry histograms + a step counter): what the telemetry-overhead
+    pin actually measures. Returns (wrapped_step, wrap_batch_iter)."""
+    from jama16_retina_tpu.obs.spans import StallClock
+
+    stalls = StallClock(registry)
+    c_steps = registry.counter("bench.steps")
+
+    def wrapped(state, batch, key):
+        with stalls.measure("dispatch"):
+            out = step(state, batch, key)
+        c_steps.inc()
+        return out
+
+    def wrap_batch_iter(batch_iter):
+        def get(i):
+            with stalls.measure("input"):
+                return batch_iter(i)
+        return get
+
+    return wrapped, wrap_batch_iter
+
+
+def _telemetry_overhead_guard(extras: dict, rate_on: float,
+                              rate_off: float,
+                              max_overhead: float = 0.02) -> bool:
+    """The ISSUE 3 overhead pin: device_only with telemetry enabled must
+    stay within ``max_overhead`` (2%) of disabled. Publishes the
+    measured overhead either way; a violation is flagged loudly in
+    ``telemetry_overhead_ok`` (and the log) instead of silently shipping
+    a slowed hot path. Negative overhead (telemetry run timed FASTER —
+    tunnel noise) clamps to 0 for the published percentage."""
+    overhead = 1.0 - rate_on / rate_off
+    extras["telemetry_overhead_pct"] = round(max(0.0, overhead) * 100, 2)
+    ok = overhead <= max_overhead
+    extras["telemetry_overhead_ok"] = ok
+    if not ok:
+        _log(
+            f"TELEMETRY OVERHEAD VIOLATION: instrumented device_only "
+            f"{rate_on:.1f} img/s/chip is {overhead * 100:.1f}% below "
+            f"uninstrumented {rate_off:.1f} (pin: <= "
+            f"{max_overhead * 100:.0f}%) — the obs hot path regressed"
+        )
+    else:
+        _log(
+            f"telemetry overhead: {extras['telemetry_overhead_pct']}% "
+            f"(pin <= {max_overhead * 100:.0f}%)"
+        )
+    return ok
 
 
 def _latency_summary(latencies_ms) -> dict:
@@ -590,6 +650,31 @@ def main() -> None:
     extras["physics_peak_tflops"] = round(peak / 1e12, 1)
     if flops_per_image:
         extras["train_gflops_per_image"] = round(flops_per_image / 1e9, 2)
+
+    # Telemetry overhead pin (ISSUE 3): the SAME step/batches/window as
+    # device_only, with the trainer's per-step telemetry ops live
+    # (StallClock segment timing feeding registry histograms + counter
+    # incs). Guarded to stay within 2% of the uninstrumented headline —
+    # the contract that lets cfg.obs.enabled default on.
+    if not headline_serialized:
+        try:
+            from jama16_retina_tpu.obs.registry import Registry
+
+            telem_step, wrap_iter = _instrumented_step(step, Registry())
+            rate_t, state = _timed_steps(
+                telem_step, state,
+                wrap_iter(lambda i: batches[i % N_DISTINCT_BATCHES]), key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_t = _publish(
+                extras, "device_only_telemetry", rate_t,
+                flops_per_image, peak,
+                suffix=" (device_only + trainer-style telemetry ops)",
+            )
+            if rate_t is not None:
+                _telemetry_overhead_guard(extras, rate_t, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"telemetry overhead bench failed: {type(e).__name__}: {e}")
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.
     aug_imgs = jax.device_put(batches[0]["image"])

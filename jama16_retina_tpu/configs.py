@@ -312,6 +312,24 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Runtime-telemetry config (jama16_retina_tpu/obs/; ISSUE 3).
+
+    The telemetry registry's hot-path cost is pinned by bench.py's
+    overhead guard (telemetry-on within 2% of off on device_only), so
+    ``enabled`` defaults on; off turns every metric op into one branch
+    (obs/registry.py) and skips the periodic exporter entirely.
+    """
+
+    enabled: bool = True
+    # Seconds between telemetry snapshots (the JSONL `telemetry` record,
+    # the atomic <workdir>/telemetry.prom rewrite, and the per-process
+    # `heartbeat` record). Checked from the train loop's logging cadence
+    # — a flush never lands mid-step.
+    flush_every_s: float = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "eyepacs_binary"
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -320,6 +338,7 @@ class ExperimentConfig:
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
 
     def replace(self, **sections) -> "ExperimentConfig":
         return dataclasses.replace(self, **sections)

@@ -34,12 +34,14 @@ from __future__ import annotations
 import json
 import os
 import struct
+import time
 from typing import Any, Iterator, Sequence
 
 import numpy as np
 
 from jama16_retina_tpu.configs import DataConfig
 from jama16_retina_tpu.data import tfrecord
+from jama16_retina_tpu.obs import registry as obs_registry
 
 
 class TFRecordIndex:
@@ -186,10 +188,23 @@ class ParallelDecoder:
     """
 
     def __init__(self, index: TFRecordIndex, image_size: int,
-                 workers: int = 1):
+                 workers: int = 1,
+                 registry: "obs_registry.Registry | None" = None):
         self.index = index
         self.image_size = image_size
         self.workers = max(1, int(workers))
+        # Worker-utilization telemetry (obs/): records decoded and the
+        # SUM of per-record decode time across all worker threads.
+        # utilization = busy_s / (wall * workers) — obs_report divides;
+        # a pool at 10% busy means the streamed tier is starved on
+        # upstream reads or consumers, not on decode CPU.
+        self._registry = (
+            registry if registry is not None
+            else obs_registry.default_registry()
+        )
+        self._c_records = self._registry.counter("data.decode.records")
+        self._c_busy = self._registry.counter("data.decode.busy_s")
+        self._registry.gauge("data.decode.workers").set(self.workers)
         self._pool = None
         if self.workers > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -199,9 +214,17 @@ class ParallelDecoder:
             )
 
     def _decode_one(self, i: int, n: "int | None" = None) -> dict:
-        return _decode_example(
+        if not self._registry.enabled:
+            return _decode_example(
+                self.index.read(i % n if n else i), self.image_size
+            )
+        t0 = time.perf_counter()
+        row = _decode_example(
             self.index.read(i % n if n else i), self.image_size
         )
+        self._c_busy.inc(time.perf_counter() - t0)
+        self._c_records.inc()
+        return row
 
     def decode_batch(self, ids) -> dict:
         """ids -> {'image': u8[len(ids),S,S,3], 'grade': i32[len(ids)]},

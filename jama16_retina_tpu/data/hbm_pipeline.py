@@ -329,7 +329,16 @@ def train_batches(
     get_batch = make_batch_fn(
         images, grades, cfg.batch_size, seed, mesh=mesh, n_records=n
     )
+    # Telemetry (obs/): the hbm loader is the 100%-residency endpoint —
+    # every batch row is a cache hit (an on-device gather, zero H2D).
+    from jama16_retina_tpu.obs import registry as obs_registry
+
+    reg = obs_registry.default_registry()
+    reg.gauge("data.hbm.resident_rows").set(n)
+    c_gather = reg.counter("data.hbm.gather_batches")
     step = skip_batches
     while True:
-        yield get_batch(step)
+        batch = get_batch(step)
+        c_gather.inc()  # before yield: the last batch is counted too
+        yield batch
         step += 1

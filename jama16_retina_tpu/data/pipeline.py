@@ -396,6 +396,14 @@ def device_prefetch(
     (``staged_put``) so the H2D copies overlap the train step at shard
     granularity (DataConfig.stage_per_shard).
     """
+    from jama16_retina_tpu.obs import registry as obs_registry
+
+    # Staged-H2D depth telemetry: how many dispatched batches sit ahead
+    # of the one being yielded. In this synchronous generator the fill
+    # discipline keeps it at `size` structurally — the gauge surfaces
+    # the EFFECTIVE depth config (incl. the drain tail) in snapshots;
+    # host-can't-keep-up shows as trainer input_wait_sec, not here.
+    g_depth = obs_registry.default_registry().gauge("data.prefetch.depth")
     queue: collections.deque = collections.deque()
     multiprocess = jax.process_count() > 1
 
@@ -442,6 +450,8 @@ def device_prefetch(
     for batch in it:
         queue.append(put(batch))
         if len(queue) > size:
+            g_depth.set(len(queue) - 1)
             yield queue.popleft()
     while queue:
+        g_depth.set(len(queue) - 1)
         yield queue.popleft()

@@ -58,6 +58,7 @@ hold the loader's device plumbing bit-identical to.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Iterator
 
 import numpy as np
@@ -69,6 +70,7 @@ from jama16_retina_tpu.data.hbm_pipeline import (
     resident_row_capacity,
     row_bytes,
 )
+from jama16_retina_tpu.obs import registry as obs_registry
 
 
 def plan_residency(
@@ -297,6 +299,20 @@ def train_batches(
         plan.res_pb, plan.str_pb, workers,
     )
 
+    # Telemetry (obs/): per-batch tier composition as HIT/SPILL counters
+    # — a resident row is an HBM-cache hit (on-device gather, zero H2D),
+    # a streamed row is the spill that pays decode + upload — plus the
+    # staging-queue depth gauge (the effective decode+H2D run-ahead this
+    # loader sustains; the synchronous fill keeps it at the configured
+    # depth, so host-side starvation surfaces as trainer input_wait_sec
+    # and in decode_batch_s, not as a sagging depth).
+    reg = obs_registry.default_registry()
+    c_hit = reg.counter("data.tiered.resident_rows")
+    c_spill = reg.counter("data.tiered.streamed_rows")
+    g_depth = reg.gauge("data.tiered.stage_depth")
+    h_decode = reg.histogram("data.tiered.decode_batch_s")
+    reg.gauge("data.tiered.resident_rows_pinned").set(plan.n_res)
+
     res_images = res_grades = None
     if plan.n_res:
         res_images, res_grades = decoder.decode_range(0, plan.n_res)
@@ -310,9 +326,13 @@ def train_batches(
 
     def make_batch(step: int) -> dict:
         res_idx, str_ids = plan.batch_indices(step)
+        c_hit.inc(plan.res_pb)
+        c_spill.inc(plan.str_pb)
         str_imgs = str_grs = None
         if plan.str_pb:
+            t0 = time.perf_counter()
             host = decoder.decode_batch(str_ids)
+            h_decode.observe(time.perf_counter() - t0)
             if sharding is not None and plan.str_pb % n_dev == 0:
                 # Per-shard staged upload: each device's block is an
                 # independent async copy behind the running step.
@@ -335,6 +355,7 @@ def train_batches(
         while True:
             while len(queue) <= depth:
                 queue.append(make_batch(step + len(queue)))
+            g_depth.set(len(queue))
             yield queue.popleft()
             step += 1
     finally:

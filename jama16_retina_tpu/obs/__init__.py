@@ -1,0 +1,53 @@
+"""Unified runtime telemetry (ISSUE 3): counters/gauges/histograms with
+stall attribution across the trainer, the data tiers, and serving.
+
+Before this subsystem the only runtime signals were the train loop's
+JSONL records and the offline bench — the 10x pipeline-fed gap
+(BENCH_r05) had to be diagnosed with hand-written one-off benchmarks,
+and the serving engine exposed zero runtime telemetry. tf.data's lesson
+(arXiv:2101.12127) is that FIRST-CLASS input-pipeline instrumentation
+is what makes such bottlenecks routinely visible; this package applies
+it system-wide:
+
+  * ``registry`` — named Counters/Gauges/fixed-bucket Histograms with
+    snapshot quantiles; O(1) lock-guarded hot-path ops; a process-wide
+    default registry plus injectable instances for tests.
+  * ``spans``    — ``span(name)`` timing contexts feeding histograms
+    (one branch when disabled), and ``StallClock``: the trainer's
+    per-window stall attribution (input-wait / dispatch / pause /
+    other, summing to window wall time).
+  * ``export``   — the periodic Snapshotter: ``telemetry`` records
+    through the run's RunLog JSONL, an atomically-rewritten
+    ``<workdir>/telemetry.prom`` (Prometheus text format), and an
+    explicit per-process ``heartbeat`` record (step +
+    last_progress_t) replacing the implicit metrics.p{N}.jsonl-mtime
+    probe of SURVEY.md §5.3.
+
+Render either output with ``scripts/obs_report.py``; the metric-name
+glossary lives in docs/OBSERVABILITY.md. The hot-path cost is pinned by
+bench.py's telemetry-overhead guard (device_only with telemetry on must
+stay within 2% of off) and tests/test_bench_guard.py's per-op bound.
+"""
+
+from jama16_retina_tpu.obs.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    set_default_registry,
+)
+from jama16_retina_tpu.obs.spans import StallClock, span
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "StallClock",
+    "default_registry",
+    "set_default_registry",
+    "span",
+]

@@ -1,0 +1,197 @@
+"""Telemetry export: periodic JSONL snapshots + Prometheus text files.
+
+Two consumers, one snapshot (ISSUE 3):
+
+  * ``telemetry`` records through the existing RunLog JSONL — the
+    system of record, diffable across runs, rendered by
+    scripts/obs_report.py;
+  * ``<workdir>/telemetry.prom`` — a Prometheus-text-format file
+    rewritten atomically on every flush, scrapeable by node_exporter's
+    textfile collector (or any file-based scraper) with zero coupling
+    to this process's lifetime. Process p != 0 writes
+    ``telemetry.p{N}.prom`` (the RunLog mirror convention).
+
+Plus the explicit HEARTBEAT: SURVEY.md §5.3's wedged-host probe used to
+be "stat the metrics.p{N}.jsonl mtime" — implicit, and blind to the
+difference between a host that stopped writing and one that writes but
+stopped PROGRESSING (wedged on a collective while its logging thread
+stays alive). Each flush now writes a ``heartbeat`` record carrying
+``step`` and ``last_progress_t`` (when the step counter last advanced),
+so both failure shapes are detectable from the JSONL alone —
+``scripts/obs_report.py --check-heartbeats`` is the cron/CI one-liner.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+
+from jama16_retina_tpu.obs import registry as registry_lib
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Dotted registry names -> Prometheus metric names."""
+    return _NAME_RE.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a Registry.snapshot() as Prometheus text exposition
+    (counters, gauges, and cumulative-``le`` histogram series with
+    ``_sum``/``_count``)."""
+    lines: list[str] = []
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(v)}")
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(v)}")
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        n = _prom_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        for bound, cum in h["buckets"]:
+            lines.append(f'{n}_bucket{{le="{_fmt(bound)}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{n}_sum {_fmt(h['sum'])}")
+        lines.append(f"{n}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def _jsonl_histograms(snapshot: dict) -> dict:
+    """Histogram summaries for the telemetry JSONL record: quantiles +
+    count/sum, WITHOUT the per-bucket series (the .prom file carries
+    those; the JSONL stays one readable line per flush)."""
+    return {
+        name: {
+            "count": h["count"],
+            "sum": round(h["sum"], 6),
+            "mean": round(h["mean"], 6) if h["mean"] is not None else None,
+            "p50": round(h["p50"], 6) if h["p50"] is not None else None,
+            "p95": round(h["p95"], 6) if h["p95"] is not None else None,
+            "p99": round(h["p99"], 6) if h["p99"] is not None else None,
+        }
+        for name, h in snapshot.get("histograms", {}).items()
+    }
+
+
+def _process_index() -> int:
+    """jax.process_index() when a backend exists; 0 otherwise. Deferred
+    and forgiving so pure-host telemetry (tests, CPU serving) never
+    force-initializes an accelerator backend."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 - no backend == single process
+        return 0
+
+
+class Snapshotter:
+    """Periodic registry snapshot -> RunLog ``telemetry`` record +
+    atomic ``telemetry.prom`` rewrite + per-process ``heartbeat``.
+
+    Pass the run's existing ``runlog`` (the trainer does) or let the
+    snapshotter open its own RunLog in ``workdir`` (serving sessions,
+    which have no train log) — an owned log is closed by ``close()``.
+
+    ``progress(step)`` is the hot-path hook: two attribute writes, no
+    lock (reader tolerance: a torn step/t pair is one flush stale).
+    ``maybe_flush()`` flushes at most every ``every_s`` seconds —
+    callers invoke it from their logging cadence, so a tight loop costs
+    one ``time.time()`` per call between flushes.
+    """
+
+    def __init__(
+        self,
+        registry: "registry_lib.Registry | None" = None,
+        workdir: str = "",
+        runlog=None,
+        every_s: float = 60.0,
+        prom_name: str = "telemetry.prom",
+    ):
+        if not workdir and runlog is None:
+            raise ValueError("Snapshotter needs a workdir and/or a runlog")
+        self._registry = (
+            registry if registry is not None
+            else registry_lib.default_registry()
+        )
+        self._workdir = workdir
+        self._owns_log = runlog is None
+        if runlog is None:
+            from jama16_retina_tpu.utils.logging import RunLog
+
+            runlog = RunLog(workdir)
+        self._log = runlog
+        self.every_s = float(every_s)
+        self._prom_name = prom_name
+        self._last_flush = time.time()
+        self._step: "int | None" = None
+        self._last_progress_t: "float | None" = None
+        self.flushes = 0
+
+    def progress(self, step: int) -> None:
+        """Record forward progress (the heartbeat's payload)."""
+        self._step = int(step)
+        self._last_progress_t = time.time()
+
+    def _prom_path(self) -> str:
+        idx = _process_index()
+        name = self._prom_name
+        if idx != 0:
+            stem, ext = os.path.splitext(name)
+            name = f"{stem}.p{idx}{ext}"
+        return os.path.join(self._workdir, name)
+
+    def flush(self) -> dict:
+        """Snapshot now: one ``telemetry`` + one ``heartbeat`` JSONL
+        record, and (when a workdir is set) an atomic .prom rewrite.
+        Returns the raw snapshot (tests read it)."""
+        snap = self._registry.snapshot()
+        self._log.write(
+            "telemetry",
+            counters={k: round(v, 6) for k, v in snap["counters"].items()},
+            gauges={k: round(v, 6) for k, v in snap["gauges"].items()},
+            histograms=_jsonl_histograms(snap),
+        )
+        self._log.write(
+            "heartbeat",
+            process_index=_process_index(),
+            step=self._step,
+            last_progress_t=(
+                round(self._last_progress_t, 3)
+                if self._last_progress_t is not None else None
+            ),
+        )
+        if self._workdir:
+            path = self._prom_path()
+            tmp = path + ".tmp"
+            os.makedirs(self._workdir, exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(prometheus_text(snap))
+            # Atomic publish: a scraper never reads a half-written file.
+            os.replace(tmp, path)
+        self._last_flush = time.time()
+        self.flushes += 1
+        return snap
+
+    def maybe_flush(self) -> "dict | None":
+        if time.time() - self._last_flush >= self.every_s:
+            return self.flush()
+        return None
+
+    def close(self) -> None:
+        """Final flush + close the owned RunLog (never one the caller
+        passed in — the trainer closes its own log after this)."""
+        self.flush()
+        if self._owns_log:
+            self._log.close()

@@ -1,0 +1,142 @@
+"""Timing spans + per-window stall attribution for the train loops.
+
+``span(name)`` is the one-liner every layer uses to time a block into a
+histogram. The design constraint carried over from the registry: a
+DISABLED registry must cost one branch — ``span`` returns a shared
+no-op context manager without allocating, so sprinkling spans through
+hot paths is free when telemetry is off.
+
+``StallClock`` is the trainer's per-log-window stall attribution
+(ISSUE 3 tentpole): the wall time of a logging window decomposes into
+
+    input_wait  — blocked in ``next(batches)``: the pipeline-fed gap
+                  (BENCH_r05's 10x) measured where it actually bites,
+    dispatch    — issuing the jit train step (async dispatch, so this
+                  is queue pressure, not device compute),
+    pause       — eval/checkpoint/persist blocks between steps,
+    other       — everything else (host-side Python, logging).
+
+The four fields land in the existing ``train`` JSONL records next to
+``images_per_sec_window`` and MUST sum to ``window_sec`` (the segments
+are disjoint sub-intervals of one monotonic window, so ``other`` is the
+exact remainder — pinned by tests/test_obs.py). A window dominated by
+``input_wait`` says "feed the chip" (tiered/hbm loader, more decode
+workers); one dominated by ``pause`` says "space out evals/saves"
+(train.save_every_evals); see docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+from jama16_retina_tpu.obs import registry as registry_lib
+
+
+class _Span:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._hist.observe(time.perf_counter() - self._t0)
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, registry: "registry_lib.Registry | None" = None,
+         buckets=registry_lib.DEFAULT_BUCKETS):
+    """Context manager timing its block into histogram ``name``
+    (seconds). Disabled registry -> the shared no-op (one branch, no
+    allocation)."""
+    reg = registry if registry is not None else registry_lib.default_registry()
+    if not reg.enabled:
+        return _NOOP
+    return _Span(reg.histogram(name, buckets=buckets))
+
+
+class StallClock:
+    """Per-log-window stall attribution shared by the train loops.
+
+    ``add(kind, dt)`` accumulates one measured segment; ``fields()``
+    returns the window's attribution dict and resets. When a registry
+    is attached, each segment also feeds a ``trainer.<kind>_s``
+    histogram so the periodic telemetry snapshot carries cross-window
+    quantiles (a single slow ``next(batches)`` shows up in p99 even
+    when the window average looks healthy).
+    """
+
+    KINDS = ("input", "dispatch", "pause")
+
+    def __init__(self, registry: "registry_lib.Registry | None" = None):
+        self._reg = registry
+        self._hists = {}
+        if registry is not None:
+            self._hists = {
+                k: registry.histogram(f"trainer.{k}_s") for k in self.KINDS
+            }
+        now = time.perf_counter()
+        self._window_start = now
+        self._acc = dict.fromkeys(self.KINDS, 0.0)
+
+    def add(self, kind: str, dt: float) -> None:
+        self._acc[kind] += dt
+        h = self._hists.get(kind)
+        if h is not None:
+            h.observe(dt)
+
+    def measure(self, kind: str):
+        """``with stalls.measure('input'): batch = next(batches)``"""
+        return _StallSegment(self, kind)
+
+    def fields(self) -> dict:
+        """The window's attribution, summing to window_sec by
+        construction; resets the window. Rounded AFTER computing the
+        remainder so the published fields stay self-consistent to the
+        rounding precision."""
+        now = time.perf_counter()
+        wall = now - self._window_start
+        # Segments are disjoint sub-intervals of [window_start, now),
+        # so their sum cannot exceed wall; clamp anyway against float
+        # accumulation error at very short windows.
+        other = max(0.0, wall - sum(self._acc.values()))
+        out = {
+            "window_sec": round(wall, 4),
+            "input_wait_sec": round(self._acc["input"], 4),
+            "dispatch_sec": round(self._acc["dispatch"], 4),
+            "pause_sec": round(self._acc["pause"], 4),
+            "other_sec": round(other, 4),
+        }
+        self._window_start = now
+        self._acc = dict.fromkeys(self.KINDS, 0.0)
+        return out
+
+
+class _StallSegment:
+    __slots__ = ("_clock", "_kind", "_t0")
+
+    def __init__(self, clock: StallClock, kind: str):
+        self._clock = clock
+        self._kind = kind
+
+    def __enter__(self) -> "_StallSegment":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._clock.add(self._kind, time.perf_counter() - self._t0)

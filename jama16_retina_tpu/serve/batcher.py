@@ -35,11 +35,17 @@ from typing import Callable
 
 import numpy as np
 
+from jama16_retina_tpu.obs import registry as obs_registry
+
 
 @dataclass
 class _Request:
     rows: np.ndarray
     future: Future = field(default_factory=Future)
+    # monotonic submit time: the end-to-end request latency histogram's
+    # start mark (resolved - submitted, including queue wait + coalesce
+    # window + inference + result slicing).
+    t_submit: float = field(default_factory=time.monotonic)
 
 
 _STOP = object()
@@ -60,6 +66,15 @@ class MicroBatcher:
     malformed request would only fail inside its coalesced window,
     taking innocent co-riders' futures down with it
     (ServingEngine.make_batcher pins the model's [S, S, 3] uint8 rows).
+
+    Telemetry (obs/; ``registry=None`` uses the process default):
+    ``serve.batcher.queue_depth`` gauge (requests waiting),
+    ``serve.batcher.window_fill`` histogram (rows/max_batch per flushed
+    window — persistently low fill says max_wait_ms closes windows
+    before coalescing pays), ``serve.request_latency_s`` histogram
+    (submit -> future resolved, end to end), and the close-path
+    counters ``serve.batcher.rejected_at_close`` /
+    ``serve.batcher.close_flushed_windows``.
     """
 
     def __init__(
@@ -70,6 +85,7 @@ class MicroBatcher:
         autostart: bool = True,
         row_shape: "tuple[int, ...] | None" = None,
         row_dtype=None,
+        registry: "obs_registry.Registry | None" = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -83,6 +99,19 @@ class MicroBatcher:
         self._closed = False
         self.batches_run = 0
         self.rows_run = 0
+        reg = registry if registry is not None else obs_registry.default_registry()
+        self._g_depth = reg.gauge("serve.batcher.queue_depth")
+        self._h_fill = reg.histogram(
+            "serve.batcher.window_fill",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
+        self._h_latency = reg.histogram("serve.request_latency_s")
+        self._c_batches = reg.counter("serve.batcher.batches")
+        self._c_rows = reg.counter("serve.batcher.rows")
+        self._c_rejected_closed = reg.counter("serve.batcher.rejected_at_close")
+        self._c_close_flushed = reg.counter(
+            "serve.batcher.close_flushed_windows"
+        )
         self._thread = threading.Thread(
             target=self._loop, name="jama16-serve-batcher", daemon=True
         )
@@ -115,9 +144,11 @@ class MicroBatcher:
             )
         with self._lock:
             if self._closed:
+                self._c_rejected_closed.inc()
                 raise RuntimeError("MicroBatcher is closed")
             req = _Request(rows)
             self._queue.put(req)
+            self._g_depth.add(1)
         return req.future
 
     def _loop(self) -> None:
@@ -142,11 +173,17 @@ class MicroBatcher:
                     break
                 window.append(nxt)
                 rows += nxt.rows.shape[0]
+            if stop_after:
+                # This window's flush is part of close(): its requests
+                # arrived before the sentinel and are served, not
+                # dropped — observable as close_flushed_windows.
+                self._c_close_flushed.inc()
             self._flush(window)
             if stop_after:
                 return
 
     def _flush(self, window: "list[_Request]") -> None:
+        self._g_depth.add(-len(window))
         try:
             flat = (
                 window[0].rows if len(window) == 1
@@ -160,6 +197,10 @@ class MicroBatcher:
                 )
             self.batches_run += 1
             self.rows_run += int(flat.shape[0])
+            self._c_batches.inc()
+            self._c_rows.inc(int(flat.shape[0]))
+            self._h_fill.observe(flat.shape[0] / self.max_batch)
+            now = time.monotonic()
             lo = 0
             for w in window:
                 hi = lo + w.rows.shape[0]
@@ -169,6 +210,7 @@ class MicroBatcher:
                 # request from poisoning its co-riders' futures.
                 try:
                     w.future.set_result(out[lo:hi])
+                    self._h_latency.observe(now - w.t_submit)
                 except InvalidStateError:
                     pass
                 lo = hi
@@ -204,6 +246,7 @@ class MicroBatcher:
                 if item is not _STOP:
                     pending.append(item)
             if pending:
+                self._c_close_flushed.inc()
                 self._flush(pending)
 
     def __enter__(self) -> "MicroBatcher":

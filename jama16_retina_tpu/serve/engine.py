@@ -36,6 +36,7 @@ from jama16_retina_tpu import models, train_lib
 from jama16_retina_tpu.configs import ExperimentConfig, ServeConfig
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.parallel import mesh as mesh_lib
 
 
@@ -102,10 +103,32 @@ class ServingEngine:
         model=None,
         mesh=None,
         state: "train_lib.TrainState | None" = None,
+        registry: "obs_registry.Registry | None" = None,
     ):
         self.cfg = cfg
         self.model = model if model is not None else models.build(cfg.model)
         self.mesh = mesh
+        # Telemetry (obs/): per-bucket pad-waste + compile counters, the
+        # in-flight chunk gauge, and a per-engine-call batch counter —
+        # the knobs-to-metrics map is in docs/OBSERVABILITY.md. Tests
+        # inject a Registry; production uses the process default.
+        self.registry = (
+            registry if registry is not None
+            else obs_registry.default_registry()
+        )
+        if registry is None:
+            # Same wiring rule as the trainer's run entry: the engine's
+            # own config decides whether the process-default registry
+            # records (a prior obs.enabled=false fit in this process
+            # must not silently mute serving telemetry).
+            self.registry.enabled = cfg.obs.enabled
+        self._c_rows = self.registry.counter("serve.engine.rows")
+        self._c_batches = self.registry.counter("serve.engine.batches")
+        self._g_in_flight = self.registry.gauge("serve.engine.in_flight")
+        # Per-bucket counter handles, created on a bucket's first use:
+        # the steady-state path is a plain dict hit — no f-string, no
+        # registry lock (the hot-path contract in obs/registry.py).
+        self._bucket_counters: dict = {}
         if state is None:
             if not member_dirs:
                 raise ValueError(
@@ -181,20 +204,36 @@ class ServingEngine:
 
         def drain_one():
             p, n = pending.popleft()
+            self._g_in_flight.set(len(pending))
             outs.append(np.asarray(jax.device_get(p))[:, :n])
 
         for lo in range(0, images.shape[0], self.max_batch):
             chunk = images[lo:lo + self.max_batch]
             bucket = self._bucket_for(chunk.shape[0])
-            if bucket > chunk.shape[0]:
-                pad = np.zeros(
-                    (bucket - chunk.shape[0], *chunk.shape[1:]), chunk.dtype
+            # Per-bucket telemetry: pad waste is the rows the bucket
+            # shape burns beyond the real chunk (the bucket-granularity
+            # cost the auto power-of-two ladder bounds at <=50%), and
+            # the compile counter ticks on a bucket's FIRST use — a
+            # production engine whose compile counters keep growing has
+            # a bucket set that defeats compile-once-per-bucket.
+            pad_rows = bucket - chunk.shape[0]
+            self._c_rows.inc(chunk.shape[0])
+            self._c_batches.inc()
+            c_pad = self._bucket_counters.get(bucket)
+            if c_pad is None:
+                c_pad = self._bucket_counters[bucket] = self.registry.counter(
+                    f"serve.pad_rows_b{bucket}"
                 )
+                self.registry.counter(f"serve.bucket_compiles_b{bucket}").inc()
+            c_pad.inc(pad_rows)
+            if pad_rows:
+                pad = np.zeros((pad_rows, *chunk.shape[1:]), chunk.dtype)
                 padded = np.concatenate([chunk, pad])
             else:
                 padded = chunk
             dev = self._step(self.state, {"image": self._place(padded)})
             pending.append((dev, chunk.shape[0]))
+            self._g_in_flight.set(len(pending))
             if len(pending) > max_in_flight:
                 drain_one()
         while pending:
@@ -224,4 +263,24 @@ class ServingEngine:
             max_wait_ms=self.cfg.serve.max_wait_ms,
             row_shape=(size, size, 3),
             row_dtype=np.uint8,
+            registry=self.registry,
+        )
+
+    def start_telemetry(self, workdir: str,
+                        every_s: "float | None" = None):
+        """A Snapshotter over this engine's registry: `telemetry` +
+        `heartbeat` JSONL records in ``workdir`` and an atomically
+        rewritten ``<workdir>/telemetry.prom`` per flush — the serving
+        twin of the trainer's periodic export (ISSUE 3 acceptance:
+        a ServingEngine session produces both artifacts). The caller
+        drives the cadence (``maybe_flush()`` between requests, or a
+        wrapper thread) and must ``close()`` it; the snapshotter owns
+        the RunLog it opens here. ``every_s`` defaults to the config's
+        ``obs.flush_every_s`` — the same knob the trainer honors."""
+        from jama16_retina_tpu.obs import export as obs_export
+
+        return obs_export.Snapshotter(
+            self.registry, workdir,
+            every_s=(every_s if every_s is not None
+                     else self.cfg.obs.flush_every_s),
         )

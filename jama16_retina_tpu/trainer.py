@@ -23,10 +23,46 @@ from jama16_retina_tpu.configs import ExperimentConfig
 from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import export as obs_export
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs.spans import StallClock
 from jama16_retina_tpu.parallel import mesh as mesh_lib
 from jama16_retina_tpu.utils import checkpoint as ckpt_lib
 from jama16_retina_tpu.utils import physics
 from jama16_retina_tpu.utils.logging import RunLog
+
+
+def _obs_begin_run(cfg: ExperimentConfig):
+    """Run-scope the process-wide registry: apply THIS run's enabled
+    flag and zero every metric in place, BEFORE the data pipelines are
+    built (their construction-time metrics — the tiered resident-tier
+    decode counts, the worker-count gauge — belong to this run).
+    Sequential ensemble members each fit() in one process; without the
+    reset, member m's telemetry snapshots would carry members 0..m-1's
+    cumulative counters and histogram quantiles."""
+    reg = obs_registry.default_registry()
+    reg.enabled = cfg.obs.enabled
+    reg.reset()
+    return reg
+
+
+def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str):
+    """(registry, StallClock, Snapshotter|None) for one train loop.
+
+    One copy of the wiring rule all three loops share (the registry was
+    already run-scoped by _obs_begin_run before the pipelines went up):
+    the StallClock feeds trainer.* histograms only when enabled, and
+    the Snapshotter reuses the run's own RunLog so
+    `telemetry`/`heartbeat` records land in the same JSONL (and its
+    per-process mirrors) as everything else."""
+    reg = obs_registry.default_registry()
+    stalls = StallClock(reg if cfg.obs.enabled else None)
+    snap = None
+    if cfg.obs.enabled:
+        snap = obs_export.Snapshotter(
+            reg, workdir, runlog=log, every_s=cfg.obs.flush_every_s
+        )
+    return reg, stalls, snap
 
 
 def _binary_eval_labels(grades: np.ndarray, head: str) -> np.ndarray:
@@ -791,6 +827,7 @@ def fit(
                   since_best=since_best)
 
     base_key = jax.random.key(seed)
+    _obs_begin_run(cfg)  # before the pipelines create their metrics
     # skip_batches=start_step: one batch per completed step, so a resumed
     # stream continues exactly where the interrupted one stopped
     # (pipeline determinism; SURVEY.md §5.4). Augment/dropout keys need
@@ -817,40 +854,52 @@ def fit(
 
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
+    _, stalls, snap = _telemetry_for(cfg, log, workdir)
     try:
         for step_i in range(start_step, cfg.train.steps):
             profiler.before_step(step_i)
-            batch = next(batches)
+            # Stall attribution (obs/spans.py): time blocked in next()
+            # is INPUT STARVATION — the pipeline-fed gap measured where
+            # it bites — and the train_step call is async dispatch
+            # pressure; both land in this window's `train` record.
+            with stalls.measure("input"):
+                batch = next(batches)
             if step_i == start_step and not cfg.train.debug:
                 train_step = _aot_with_ceiling(
                     cfg, mesh, clock, log, start_step,
                     train_step, state, batch, base_key,
                 )
-            state, m = train_step(state, batch, base_key)
+            with stalls.measure("dispatch"):
+                state, m = train_step(state, batch, base_key)
             clock.after_step()
+            if snap is not None:
+                snap.progress(step_i + 1)
             profiler.after_step(step_i, state)
 
             if (step_i + 1) % cfg.train.log_every == 0:
                 log.write(
                     "train", step=step_i + 1, loss=float(m["loss"]),
-                    **clock.fields(),
+                    **clock.fields(), **stalls.fields(),
                 )
+                if snap is not None:
+                    snap.maybe_flush()
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
                 clock.pause()
-                best_auc, best_step, since_best, stop, saved = _eval_and_track(
-                    cfg, log, ckpt, step_i + 1,
-                    lambda: predict_split(
-                        cfg, model, state, data_dir, "val", mesh,
-                        eval_step=eval_step, cache=val_cache,
-                    )[:2],
-                    lambda: jax.device_get(state),
-                    best_auc, best_step, since_best,
-                    save_due=_save_due(cfg, step_i + 1),
-                )
-                if saved:
-                    _persist_grain_state(grain_tee, workdir, step_i + 1,
-                                         kept_steps=ckpt.all_steps())
+                with stalls.measure("pause"):
+                    best_auc, best_step, since_best, stop, saved = _eval_and_track(
+                        cfg, log, ckpt, step_i + 1,
+                        lambda: predict_split(
+                            cfg, model, state, data_dir, "val", mesh,
+                            eval_step=eval_step, cache=val_cache,
+                        )[:2],
+                        lambda: jax.device_get(state),
+                        best_auc, best_step, since_best,
+                        save_due=_save_due(cfg, step_i + 1),
+                    )
+                    if saved:
+                        _persist_grain_state(grain_tee, workdir, step_i + 1,
+                                             kept_steps=ckpt.all_steps())
                 clock.resume()
                 if stop:
                     stopped_early = True
@@ -864,6 +913,8 @@ def fit(
 
     ckpt.wait()
     ckpt.close()
+    if snap is not None:
+        snap.close()  # final telemetry/heartbeat flush; log still open
     log.close()
     return {
         # None (not -inf) when no eval ever ran — e.g. --resume with the
@@ -1189,6 +1240,7 @@ def fit_ensemble_parallel(
                 ],
             )
 
+    _obs_begin_run(cfg)  # before the pipelines create their metrics
     stream = _train_stream(
         cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
         full_batches=True,
@@ -1212,10 +1264,12 @@ def fit_ensemble_parallel(
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
+    _, stalls, snap = _telemetry_for(cfg, log, workdir)
     try:
         for step_i in range(start_step, cfg.train.steps):
             profiler.before_step(step_i)
-            batch = next(batches)
+            with stalls.measure("input"):
+                batch = next(batches)
             if step_i == start_step and not cfg.train.debug:
                 # Images/call in the ceiling is the DATASET batch (all k
                 # members consume the same stream) while flops/call
@@ -1224,8 +1278,11 @@ def fit_ensemble_parallel(
                     cfg, mesh, clock, log, start_step,
                     train_step, state, batch, base_keys,
                 )
-            state, m_out = train_step(state, batch, base_keys)
+            with stalls.measure("dispatch"):
+                state, m_out = train_step(state, batch, base_keys)
             clock.after_step()
+            if snap is not None:
+                snap.progress(step_i + 1)
             profiler.after_step(step_i, state)
 
             if (step_i + 1) % cfg.train.log_every == 0:
@@ -1234,11 +1291,14 @@ def fit_ensemble_parallel(
                     "train", step=step_i + 1,
                     loss=round(float(losses.mean()), 6),
                     loss_per_member=[round(float(x), 6) for x in losses],
-                    **clock.fields(),
+                    **clock.fields(), **stalls.fields(),
                 )
+                if snap is not None:
+                    snap.maybe_flush()
 
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
                 clock.pause()
+                t_pause = time.perf_counter()
                 grades, probs = _predict_split_members(
                     cfg, state, data_dir, "val", mesh, eval_step,
                     cache=val_cache,
@@ -1289,6 +1349,7 @@ def fit_ensemble_parallel(
                         grain_tee, workdir, step_i + 1,
                         kept_steps=set.union(*[c.all_steps() for c in ckpts]),
                     )
+                stalls.add("pause", time.perf_counter() - t_pause)
                 clock.resume()
                 if stopping:
                     log.write("early_stop", step=step_i + 1,
@@ -1303,6 +1364,8 @@ def fit_ensemble_parallel(
     for c in ckpts:
         c.wait()
         c.close()
+    if snap is not None:
+        snap.close()
     log.close()
     return [
         {
@@ -1470,35 +1533,49 @@ def fit_tf(
         keras_model.optimizer.iterations.assign(start_step)
         log.write("resume", step=start_step)
 
+    _obs_begin_run(cfg)  # before the pipeline creates its metrics
     batches = _train_stream(cfg, data_dir, seed, skip_batches=start_step)
     best_auc, best_step, since_best = -np.inf, start_step, 0
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
+    _, stalls, snap = _telemetry_for(cfg, log, workdir)
     for step_i in range(start_step, tc.steps):
-        batch = next(batches)
-        # Per-step generator keyed on (seed, step): a resumed run draws
-        # the same augmentations an uninterrupted one would (the numpy
-        # analogue of fit's fold_in(base_key, step); SURVEY.md §5.4).
-        # augment_batch_np is the full numpy twin of the TPU path
-        # (includes normalize; a no-op pass-through when augment=false).
-        x = augment_lib.augment_batch_np(
-            np.random.default_rng((seed, step_i)), batch["image"], cfg.data
-        )
+        # Host augmentation counts as INPUT here: on this backend the
+        # data prep runs on host CPU ahead of the (synchronous) keras
+        # step, so it starves the step exactly like decode does.
+        with stalls.measure("input"):
+            batch = next(batches)
+            # Per-step generator keyed on (seed, step): a resumed run
+            # draws the same augmentations an uninterrupted one would
+            # (the numpy analogue of fit's fold_in(base_key, step);
+            # SURVEY.md §5.4). augment_batch_np is the full numpy twin
+            # of the TPU path (includes normalize; a no-op pass-through
+            # when augment=false).
+            x = augment_lib.augment_batch_np(
+                np.random.default_rng((seed, step_i)), batch["image"],
+                cfg.data,
+            )
         if cfg.model.head == "binary":
             y = (batch["grade"] >= 2).astype(np.float32)[:, None]
         else:
             y = np.eye(cfg.model.num_classes, dtype=np.float32)[
                 batch["grade"].astype(np.int64)
             ]
-        step_loss = float(keras_model.train_on_batch(x, y))
+        with stalls.measure("dispatch"):
+            step_loss = float(keras_model.train_on_batch(x, y))
         clock.after_step()
+        if snap is not None:
+            snap.progress(step_i + 1)
 
         if (step_i + 1) % tc.log_every == 0:
             log.write("train", step=step_i + 1, loss=step_loss,
-                      **clock.fields())
+                      **clock.fields(), **stalls.fields())
+            if snap is not None:
+                snap.maybe_flush()
 
         if (step_i + 1) % tc.eval_every == 0 or step_i + 1 == tc.steps:
             clock.pause()
+            t_pause = time.perf_counter()
             def _tf_state_for_save(step_now=step_i + 1):
                 params, batch_stats = transplant.transplant_from_keras(
                     keras_model, state0.params, state0.batch_stats
@@ -1515,6 +1592,7 @@ def fit_tf(
                 best_auc, best_step, since_best,
                 save_due=_save_due(cfg, step_i + 1),
             )
+            stalls.add("pause", time.perf_counter() - t_pause)
             clock.resume()
             if stop:
                 stopped_early = True
@@ -1522,6 +1600,8 @@ def fit_tf(
 
     ckpt.wait()
     ckpt.close()
+    if snap is not None:
+        snap.close()
     log.close()
     return {
         "best_auc": float(best_auc) if np.isfinite(best_auc) else None,

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import numbers
 import os
+import threading
 import time
 from typing import IO
 
@@ -49,6 +50,13 @@ class RunLog:
         self._fh: IO | None = None
         self._tb = None
         self._opened = False
+        # Serializes open+write+flush: the serve path's batcher worker
+        # and telemetry snapshotter write from background threads
+        # concurrently with the main loop, and interleaved write()/
+        # flush() pairs on one file handle can TEAR a JSONL line —
+        # which read_jsonl's torn-line skip would then silently drop
+        # on resume replay (ISSUE 3 satellite).
+        self._write_lock = threading.Lock()
 
     def _ensure_open(self) -> None:
         if self._opened:
@@ -71,12 +79,18 @@ class RunLog:
             )
 
     def write(self, kind: str, **fields) -> dict:
-        self._ensure_open()
         rec = {"kind": kind, "t": round(time.time(), 3), **fields}
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
+        line = json.dumps(rec) + "\n"
+        with self._write_lock:
+            self._ensure_open()
+            self._fh.write(line)
+            self._fh.flush()
         absl_logging.info("%s %s", kind, {k: v for k, v in fields.items()})
-        if self._tb is not None and "step" in fields:
+        # TB mirrors step-indexed scalar series only: heartbeats are
+        # liveness records (their step may legitimately be None when no
+        # loop body ran, and epoch-time payloads are not curves).
+        if (self._tb is not None and fields.get("step") is not None
+                and kind != "heartbeat"):
             import tensorflow as tf
 
             with self._tb.as_default():
@@ -89,8 +103,9 @@ class RunLog:
         return rec
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
+        with self._write_lock:
+            if self._fh is not None:
+                self._fh.close()
         if self._tb is not None:
             self._tb.close()
 

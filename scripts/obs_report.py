@@ -1,0 +1,375 @@
+#!/usr/bin/env python
+"""Render runtime telemetry (ISSUE 3) into a human-readable report.
+
+Input is either output of the obs subsystem:
+
+  * a RUN — a workdir (reads metrics.jsonl + its metrics.p{N}.jsonl
+    mirrors) or a single JSONL file: renders stall attribution
+    aggregated over the run's `train` records, the latest `telemetry`
+    snapshot (cache hit rates, decode-pool utilization, serve latency
+    quantiles), and the per-process heartbeat table;
+  * a SNAPSHOT — a .prom file (the atomic Prometheus-text snapshot
+    obs/export.py rewrites each flush): renders the same metric tables
+    from the scraped state.
+
+Exit-code mode (the SURVEY §5.3 wedged-host probe as a cron/CI
+one-liner):
+
+  python scripts/obs_report.py --check-heartbeats <workdir> \
+      [--max-age-s 300]
+
+exits 0 when every process's newest `heartbeat` record is younger than
+the threshold, 1 when any is stale (or carries a last_progress_t older
+than the threshold — a host that still FLUSHES but stopped advancing is
+wedged on a collective, the exact failure the mtime probe missed), and
+2 when no heartbeat exists at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def _read_jsonl(path: str) -> list:
+    """Torn-line-tolerant JSONL parse (a live run's last line may be
+    mid-flush) without importing the package's jax-adjacent modules."""
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return records
+
+
+def workdir_jsonl_files(workdir: str) -> list:
+    """metrics.jsonl + every metrics.p{N}.jsonl mirror, p0 first."""
+    main = os.path.join(workdir, "metrics.jsonl")
+    mirrors = sorted(glob.glob(os.path.join(workdir, "metrics.p*.jsonl")))
+    return [p for p in [main, *mirrors] if os.path.exists(p)]
+
+
+def load_records(path: str) -> list:
+    if os.path.isdir(path):
+        records = []
+        for p in workdir_jsonl_files(path):
+            records.extend(_read_jsonl(p))
+        return records
+    return _read_jsonl(path)
+
+
+def parse_prom(text: str) -> dict:
+    """Prometheus text -> the Registry.snapshot() shape (counters,
+    gauges, histograms with cumulative buckets/sum/count) so both input
+    kinds render through the same tables."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    types: dict = {}
+    hists: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        if "{" in name_part:
+            base, _, label = name_part.partition("{")
+            label = label.rstrip("}")
+            if base.endswith("_bucket") and label.startswith("le="):
+                h = hists.setdefault(base[:-len("_bucket")],
+                                     {"buckets": [], "sum": 0.0, "count": 0})
+                bound = label[3:].strip('"')
+                if bound != "+Inf":
+                    h["buckets"].append((float(bound), int(v)))
+                continue
+        base = name_part
+        if base.endswith("_sum") and base[:-4] in hists or (
+                base.endswith("_sum") and types.get(base[:-4]) == "histogram"):
+            hists.setdefault(base[:-4], {"buckets": [], "sum": 0.0,
+                                         "count": 0})["sum"] = v
+        elif base.endswith("_count") and types.get(base[:-6]) == "histogram":
+            hists.setdefault(base[:-6], {"buckets": [], "sum": 0.0,
+                                         "count": 0})["count"] = int(v)
+        elif types.get(base) == "counter":
+            out["counters"][base] = v
+        elif types.get(base) == "gauge":
+            out["gauges"][base] = v
+    for name, h in hists.items():
+        h["buckets"].sort()
+        total = h["count"]
+        h["mean"] = (h["sum"] / total) if total else None
+        for q in (0.5, 0.95, 0.99):
+            h[f"p{int(q * 100)}"] = _quantile(h["buckets"], total, q)
+        out["histograms"][name] = h
+    return out
+
+
+def _quantile(cum_buckets, total: int, q: float):
+    """histogram_quantile over (bound, cumulative_count) pairs — the
+    same rank interpolation obs/registry.py applies at snapshot time,
+    reconstructed from the cumulative series a .prom file carries."""
+    if not total or not cum_buckets:
+        return None
+    target = q * total
+    prev_cum, lo = 0, 0.0
+    for bound, cum in cum_buckets:
+        c = cum - prev_cum
+        if c and cum >= target:
+            frac = (target - prev_cum) / c
+            return lo + (bound - lo) * frac
+        prev_cum, lo = cum, bound
+    return cum_buckets[-1][0]
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v < 1.0:
+        return f"{v * 1e3:.2f} ms"
+    return f"{v:.3f} s"
+
+
+def _fmt_hist_value(name: str, v) -> str:
+    """Histograms named *_s record seconds; anything else (e.g. the
+    window_fill ratio) renders as a bare number."""
+    if name.endswith("_s"):
+        return _fmt_s(v)
+    return "-" if v is None else f"{v:.3f}"
+
+
+def _table(rows, headers) -> str:
+    rows = [[str(c) for c in r] for r in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def fmt(r):
+        return "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(headers), sep, *[fmt(r) for r in rows]])
+
+
+def render_stalls(records: list) -> str:
+    """Aggregate the per-window stall attribution of `train` records:
+    where the run's wall time actually went (the top-stalls table)."""
+    wins = [r for r in records if r.get("kind") == "train"
+            and "window_sec" in r]
+    if not wins:
+        return "stall attribution: no instrumented `train` records"
+    tot = {k: sum(r.get(k, 0.0) for r in wins)
+           for k in ("window_sec", "input_wait_sec", "dispatch_sec",
+                     "pause_sec", "other_sec")}
+    wall = tot["window_sec"] or 1e-9
+    rows = [
+        (name, f"{tot[key]:.2f}", f"{100 * tot[key] / wall:.1f}%")
+        for name, key in (
+            ("input wait (pipeline starvation)", "input_wait_sec"),
+            ("eval/checkpoint pause", "pause_sec"),
+            ("step dispatch", "dispatch_sec"),
+            ("other (host python, logging)", "other_sec"),
+        )
+    ]
+    worst = max(wins, key=lambda r: r.get("input_wait_sec", 0.0))
+    out = [
+        f"stall attribution over {len(wins)} train windows "
+        f"({wall:.2f} s wall):",
+        _table(rows, ("where", "seconds", "of wall")),
+        f"worst input-wait window: {worst.get('input_wait_sec', 0.0):.2f} s "
+        f"at step {worst.get('step', '?')}",
+    ]
+    return "\n".join(out)
+
+
+def render_snapshot(snap: dict) -> str:
+    out = []
+    counters, gauges = snap.get("counters", {}), snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+
+    def get(d, *names):
+        for n in names:
+            if n in d:
+                return d[n]
+        return None
+
+    # Derived headline rates first — the questions the raw tables answer.
+    derived = []
+    hit = get(counters, "data.tiered.resident_rows",
+              "data_tiered_resident_rows")
+    spill = get(counters, "data.tiered.streamed_rows",
+                "data_tiered_streamed_rows")
+    if hit is not None and spill is not None and (hit + spill) > 0:
+        derived.append((
+            "tiered HBM cache hit rate",
+            f"{100 * hit / (hit + spill):.1f}% "
+            f"({int(hit)} resident / {int(spill)} streamed rows)",
+        ))
+    busy = get(counters, "data.decode.busy_s", "data_decode_busy_s")
+    recs = get(counters, "data.decode.records", "data_decode_records")
+    if busy is not None and recs:
+        derived.append((
+            "decode pool", f"{int(recs)} records, "
+            f"{1e3 * busy / recs:.2f} ms/record decode",
+        ))
+    for key in ("serve.request_latency_s", "serve_request_latency_s"):
+        h = hists.get(key)
+        if h and h.get("count"):
+            derived.append((
+                "serve request latency",
+                f"p50 {_fmt_s(h.get('p50'))} / p95 {_fmt_s(h.get('p95'))} "
+                f"/ p99 {_fmt_s(h.get('p99'))} over {h['count']} requests",
+            ))
+    if derived:
+        out.append(_table(derived, ("derived", "value")))
+
+    if counters:
+        out.append(_table(
+            sorted((k, f"{v:g}") for k, v in counters.items()),
+            ("counter", "value"),
+        ))
+    if gauges:
+        out.append(_table(
+            sorted((k, f"{v:g}") for k, v in gauges.items()),
+            ("gauge", "value"),
+        ))
+    if hists:
+        rows = [
+            (k, h.get("count", 0), _fmt_hist_value(k, h.get("mean")),
+             _fmt_hist_value(k, h.get("p50")), _fmt_hist_value(k, h.get("p95")),
+             _fmt_hist_value(k, h.get("p99")))
+            for k, h in sorted(hists.items())
+        ]
+        out.append(_table(
+            rows, ("histogram", "n", "mean", "p50", "p95", "p99")
+        ))
+    return "\n\n".join(out) if out else "telemetry snapshot: empty"
+
+
+def latest_heartbeats(records: list) -> dict:
+    """process_index -> newest heartbeat record."""
+    beats: dict = {}
+    for r in records:
+        if r.get("kind") != "heartbeat":
+            continue
+        p = int(r.get("process_index", 0))
+        if p not in beats or r.get("t", 0) >= beats[p].get("t", 0):
+            beats[p] = r
+    return beats
+
+
+def render_heartbeats(records: list, now: "float | None" = None) -> str:
+    beats = latest_heartbeats(records)
+    if not beats:
+        return "heartbeats: none recorded"
+    now = time.time() if now is None else now
+    rows = [
+        (f"p{p}", b.get("step"),
+         f"{now - b['t']:.1f}s ago" if "t" in b else "-",
+         (f"{now - b['last_progress_t']:.1f}s ago"
+          if b.get("last_progress_t") else "-"))
+        for p, b in sorted(beats.items())
+    ]
+    return _table(rows, ("process", "step", "heartbeat", "last progress"))
+
+
+def check_heartbeats(workdir: str, max_age_s: float,
+                     now: "float | None" = None) -> tuple[int, str]:
+    """(exit_code, message): 0 fresh, 1 stale/wedged, 2 none found."""
+    records = load_records(workdir)
+    beats = latest_heartbeats(records)
+    now = time.time() if now is None else now
+    if not beats:
+        return 2, f"no heartbeat records under {workdir}"
+    stale = []
+    for p, b in sorted(beats.items()):
+        age = now - b.get("t", 0)
+        prog = b.get("last_progress_t")
+        prog_age = (now - prog) if prog else None
+        if age > max_age_s:
+            stale.append(f"p{p}: heartbeat {age:.0f}s old (> {max_age_s:.0f}s)")
+        elif prog_age is not None and prog_age > max_age_s:
+            # Flushing but not progressing: the wedged-on-a-collective
+            # shape the old mtime probe could not see.
+            stale.append(
+                f"p{p}: heartbeat fresh but no step progress for "
+                f"{prog_age:.0f}s (> {max_age_s:.0f}s) — wedged?"
+            )
+    if stale:
+        return 1, "\n".join(stale)
+    return 0, "\n".join(
+        f"p{p}: ok (step {b.get('step')}, "
+        f"heartbeat {now - b.get('t', 0):.0f}s old)"
+        for p, b in sorted(beats.items())
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "path", nargs="?",
+        help="workdir, metrics JSONL file, or telemetry.prom snapshot",
+    )
+    ap.add_argument(
+        "--check-heartbeats", metavar="WORKDIR", default=None,
+        help="exit-code mode: 0 all processes fresh, 1 any heartbeat/"
+             "progress older than --max-age-s, 2 no heartbeats",
+    )
+    ap.add_argument("--max-age-s", type=float, default=300.0)
+    args = ap.parse_args(argv)
+
+    if args.check_heartbeats:
+        code, msg = check_heartbeats(args.check_heartbeats, args.max_age_s)
+        print(msg)
+        return code
+    if not args.path:
+        ap.error("need a path (or --check-heartbeats WORKDIR)")
+
+    if args.path.endswith(".prom"):
+        with open(args.path) as f:
+            snap = parse_prom(f.read())
+        print(render_snapshot(snap))
+        return 0
+
+    records = load_records(args.path)
+    if not records:
+        print(f"no records under {args.path}")
+        return 2
+    print(render_stalls(records))
+    print()
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    if telemetry:
+        print(render_snapshot(telemetry[-1]))
+    else:
+        print("telemetry records: none (obs.enabled=false run?)")
+    print()
+    print(render_heartbeats(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

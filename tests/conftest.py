@@ -63,6 +63,7 @@ _QUICK_FILES = {
     "test_synthetic.py",    # fixture generator incl. shifted marginals
     "test_preprocess.py",   # fundus normalize, binning, writer
     "test_mesh.py",         # mesh factoring + distributed env gating
+    "test_obs.py",          # telemetry registry/export + instrumented fit
 }
 _QUICK_TESTS = {
     # one DP≡single-device pin through the compiler

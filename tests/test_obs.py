@@ -1,0 +1,643 @@
+"""Runtime telemetry subsystem (jama16_retina_tpu/obs/; ISSUE 3): the
+registry's thread-safety and quantile math, span/no-op semantics, the
+StallClock's sum-to-window invariant, the Snapshotter's JSONL +
+Prometheus + heartbeat exports, the serve path's close-observability
+counters, obs_report's rendering and heartbeat exit codes, and a short
+instrumented fit() producing every acceptance artifact end to end."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.obs import export as obs_export
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs.spans import StallClock, span
+from jama16_retina_tpu.serve.batcher import MicroBatcher
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+pytestmark = pytest.mark.obs
+
+
+def _load_obs_report():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(repo, "scripts", "obs_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = obs_registry.Registry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    g = reg.gauge("g")
+    g.set(7)
+    g.add(-2)
+    assert g.value == 5.0
+    h = reg.histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    s = h.snapshot()
+    assert s["count"] == 4 and s["sum"] == pytest.approx(105.0)
+    # Overflow observations clamp quantiles to the largest finite bound.
+    assert s["p99"] <= 4.0
+    assert s["p50"] <= s["p95"] <= s["p99"]
+    # Same name -> same object; same name, different kind -> loud.
+    assert reg.counter("c") is c
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("c")
+
+
+def test_registry_snapshot_shape():
+    reg = obs_registry.Registry()
+    reg.counter("a").inc()
+    reg.gauge("b").set(2)
+    reg.histogram("c").observe(0.01)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"a": 1.0}
+    assert snap["gauges"] == {"b": 2.0}
+    assert snap["histograms"]["c"]["count"] == 1
+
+
+def test_registry_disabled_is_noop_everywhere():
+    """The explicit no-op mode: a disabled registry's metric handles
+    stay valid but every op freezes — one branch, no state change."""
+    reg = obs_registry.Registry(enabled=False)
+    c, g, h = reg.counter("c"), reg.gauge("g"), reg.histogram("h")
+    c.inc(10)
+    g.set(5)
+    h.observe(1.0)
+    assert c.value == 0.0 and g.value == 0.0 and h.count == 0
+    # span() on a disabled registry returns the SHARED no-op context
+    # (no allocation on the hot path).
+    assert span("x", reg) is span("y", reg)
+    reg.enabled = True
+    c.inc()
+    assert c.value == 1.0
+
+
+def test_registry_ops_are_thread_safe():
+    """8 threads hammering one counter + one histogram lose no updates
+    (the serve path records from batcher worker + N submitters)."""
+    reg = obs_registry.Registry()
+    c = reg.counter("n")
+    h = reg.histogram("h", buckets=(0.5, 1.0))
+    n_threads, per = 8, 500
+
+    def work():
+        for _ in range(per):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per
+    s = h.snapshot()
+    assert s["count"] == n_threads * per
+    assert s["sum"] == pytest.approx(0.25 * n_threads * per)
+
+
+def test_histogram_quantiles_interpolate_sanely():
+    reg = obs_registry.Registry()
+    h = reg.histogram("h", buckets=tuple(float(b) for b in range(1, 11)))
+    for v in np.linspace(0.05, 9.95, 200):
+        h.observe(float(v))
+    s = h.snapshot()
+    # Uniform on [0, 10): quantiles land near q*10 (bucket resolution 1).
+    assert abs(s["p50"] - 5.0) < 1.0
+    assert abs(s["p95"] - 9.5) < 1.0
+    assert s["p50"] <= s["p95"] <= s["p99"]
+
+
+def test_span_records_into_histogram():
+    reg = obs_registry.Registry()
+    with span("timed", reg):
+        time.sleep(0.01)
+    s = reg.histogram("timed").snapshot()
+    assert s["count"] == 1
+    assert s["sum"] >= 0.009
+
+
+def test_registry_reset_zeroes_in_place():
+    """reset() zeroes values but keeps handles valid — the run-scoping
+    contract: metrics created at pipeline construction keep recording
+    into the new run after the trainer's per-run reset."""
+    reg = obs_registry.Registry()
+    c, g = reg.counter("c"), reg.gauge("g")
+    h = reg.histogram("h", buckets=(1.0,))
+    c.inc(5)
+    g.set(3)
+    h.observe(0.5)
+    reg.reset()
+    assert c.value == 0.0 and g.value == 0.0 and h.count == 0
+    c.inc()  # the pre-reset handle still feeds the registry
+    assert reg.snapshot()["counters"]["c"] == 1.0
+    assert reg.counter("c") is c
+
+
+def test_obs_begin_run_scopes_default_registry_per_run():
+    """Sequential ensemble members fit() one after another in one
+    process: each run's entry resets the shared default registry, so
+    member m's telemetry doesn't carry members 0..m-1's counts — and a
+    prior obs.enabled=false run doesn't mute the next one."""
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config
+
+    prev = obs_registry.set_default_registry(obs_registry.Registry())
+    try:
+        reg = obs_registry.default_registry()
+        c = reg.counter("data.decode.records")
+        c.inc(5)  # "member 0"'s leftovers
+        reg.enabled = False  # a disabled run came before
+        assert trainer._obs_begin_run(get_config("smoke")) is reg
+        assert reg.enabled is True  # smoke's default obs.enabled
+        assert c.value == 0.0
+        c.inc()
+        assert reg.snapshot()["counters"]["data.decode.records"] == 1.0
+    finally:
+        obs_registry.set_default_registry(prev)
+
+
+def test_default_registry_is_injectable():
+    prev = obs_registry.set_default_registry(obs_registry.Registry())
+    try:
+        obs_registry.default_registry().counter("x").inc()
+        assert obs_registry.default_registry().counter("x").value == 1.0
+    finally:
+        obs_registry.set_default_registry(prev)
+    assert "x" not in prev.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# StallClock: the trainer's window attribution
+# ---------------------------------------------------------------------------
+
+
+def test_stall_clock_fields_sum_to_window():
+    """The acceptance invariant: input + dispatch + pause + other ==
+    window wall time (disjoint measured segments; `other` is the exact
+    remainder)."""
+    reg = obs_registry.Registry()
+    sc = StallClock(reg)
+    with sc.measure("input"):
+        time.sleep(0.02)
+    with sc.measure("dispatch"):
+        time.sleep(0.005)
+    with sc.measure("pause"):
+        time.sleep(0.01)
+    time.sleep(0.005)  # unattributed host time -> other
+    f = sc.fields()
+    total = (f["input_wait_sec"] + f["dispatch_sec"] + f["pause_sec"]
+             + f["other_sec"])
+    assert total == pytest.approx(f["window_sec"], abs=2e-3)
+    assert f["input_wait_sec"] >= 0.018
+    assert f["other_sec"] >= 0.003
+    # Registry histograms saw each segment (cross-window quantiles).
+    assert reg.histogram("trainer.input_s").count == 1
+    # fields() resets the window.
+    f2 = sc.fields()
+    assert f2["input_wait_sec"] == 0.0 and f2["window_sec"] < f["window_sec"]
+
+
+# ---------------------------------------------------------------------------
+# Export: Snapshotter, prometheus text, heartbeat
+# ---------------------------------------------------------------------------
+
+
+def test_snapshotter_writes_telemetry_heartbeat_and_prom(tmp_path):
+    reg = obs_registry.Registry()
+    reg.counter("data.tiered.resident_rows").inc(70)
+    reg.counter("data.tiered.streamed_rows").inc(10)
+    reg.histogram("serve.request_latency_s").observe(0.012)
+    snap = obs_export.Snapshotter(reg, str(tmp_path), every_s=1e9)
+    snap.progress(42)
+    snap.flush()
+    snap.close()
+
+    recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+    telemetry = [r for r in recs if r["kind"] == "telemetry"]
+    beats = [r for r in recs if r["kind"] == "heartbeat"]
+    assert telemetry and beats
+    assert telemetry[0]["counters"]["data.tiered.resident_rows"] == 70
+    assert telemetry[0]["histograms"]["serve.request_latency_s"]["count"] == 1
+    # The explicit heartbeat payload: step + last_progress_t, per process.
+    assert beats[-1]["step"] == 42
+    assert beats[-1]["last_progress_t"] is not None
+    assert beats[-1]["process_index"] == 0
+
+    prom = (tmp_path / "telemetry.prom").read_text()
+    assert "# TYPE data_tiered_resident_rows counter" in prom
+    assert "data_tiered_resident_rows 70" in prom
+    assert 'serve_request_latency_s_bucket{le="+Inf"} 1' in prom
+    assert "serve_request_latency_s_count 1" in prom
+    # No torn temp file left behind (atomic publish).
+    assert not (tmp_path / "telemetry.prom.tmp").exists()
+
+
+def test_snapshotter_maybe_flush_honors_interval(tmp_path):
+    reg = obs_registry.Registry()
+    snap = obs_export.Snapshotter(reg, str(tmp_path), every_s=1e9)
+    assert snap.maybe_flush() is None  # interval not elapsed
+    assert snap.flushes == 0
+    snap.every_s = 0.0
+    assert snap.maybe_flush() is not None
+    assert snap.flushes == 1
+    snap.close()
+    assert snap.flushes == 2  # close always flushes
+
+
+def test_snapshotter_reuses_callers_runlog(tmp_path):
+    """The trainer path: telemetry records land in the run's OWN
+    metrics.jsonl, and close() does not close a log it doesn't own."""
+    from jama16_retina_tpu.utils.logging import RunLog
+
+    log = RunLog(str(tmp_path))
+    reg = obs_registry.Registry()
+    snap = obs_export.Snapshotter(reg, str(tmp_path), runlog=log,
+                                  every_s=1e9)
+    snap.flush()
+    snap.close()
+    log.write("train", step=1, loss=0.5)  # still open
+    log.close()
+    kinds = [r["kind"] for r in read_jsonl(str(tmp_path / "metrics.jsonl"))]
+    assert kinds.count("telemetry") == 2  # flush + close
+    assert kinds[-1] == "train"
+
+
+def test_prometheus_text_histogram_is_cumulative():
+    reg = obs_registry.Registry()
+    h = reg.histogram("lat", buckets=(1.0, 2.0))
+    for v in (0.5, 0.7, 1.5, 9.0):
+        h.observe(v)
+    text = obs_export.prometheus_text(reg.snapshot())
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="2"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+
+
+# ---------------------------------------------------------------------------
+# obs_report: rendering + heartbeat exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_obs_report_prom_roundtrip(tmp_path):
+    rep = _load_obs_report()
+    reg = obs_registry.Registry()
+    reg.counter("data.tiered.resident_rows").inc(700)
+    reg.counter("data.tiered.streamed_rows").inc(300)
+    reg.gauge("serve.batcher.queue_depth").set(3)
+    h = reg.histogram("serve.request_latency_s")
+    for v in (0.01, 0.02, 0.04):
+        h.observe(v)
+    text = obs_export.prometheus_text(reg.snapshot())
+    snap = rep.parse_prom(text)
+    assert snap["counters"]["data_tiered_resident_rows"] == 700
+    assert snap["gauges"]["serve_batcher_queue_depth"] == 3
+    hh = snap["histograms"]["serve_request_latency_s"]
+    assert hh["count"] == 3 and hh["p50"] <= hh["p99"]
+    out = rep.render_snapshot(snap)
+    assert "70.0%" in out  # cache hit rate 700/1000
+    assert "serve request latency" in out
+
+
+def test_obs_report_renders_stall_attribution():
+    rep = _load_obs_report()
+    records = [
+        {"kind": "train", "step": s, "window_sec": 1.0,
+         "input_wait_sec": 0.6, "dispatch_sec": 0.1, "pause_sec": 0.2,
+         "other_sec": 0.1}
+        for s in (10, 20)
+    ]
+    out = rep.render_stalls(records)
+    assert "input wait" in out and "60.0%" in out
+    assert "worst input-wait window" in out
+
+
+def _write_heartbeats(workdir, entries):
+    os.makedirs(workdir, exist_ok=True)
+    by_file: dict = {}
+    for proc, t, prog_t, step in entries:
+        name = "metrics.jsonl" if proc == 0 else f"metrics.p{proc}.jsonl"
+        by_file.setdefault(name, []).append(json.dumps({
+            "kind": "heartbeat", "t": t, "process_index": proc,
+            "step": step, "last_progress_t": prog_t,
+        }))
+    for name, lines in by_file.items():
+        with open(os.path.join(workdir, name), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+def test_check_heartbeats_exit_codes(tmp_path):
+    """The cron/CI one-liner (ISSUE 3 satellite): 0 fresh, 1 stale OR
+    wedged (fresh heartbeat, stalled progress), 2 none."""
+    rep = _load_obs_report()
+    now = 1_000_000.0
+
+    fresh = str(tmp_path / "fresh")
+    _write_heartbeats(fresh, [(0, now - 10, now - 10, 100),
+                              (1, now - 20, now - 20, 100)])
+    code, msg = rep.check_heartbeats(fresh, 300.0, now=now)
+    assert code == 0 and "ok" in msg
+
+    stale = str(tmp_path / "stale")
+    _write_heartbeats(stale, [(0, now - 10, now - 10, 100),
+                              (1, now - 999, now - 999, 80)])
+    code, msg = rep.check_heartbeats(stale, 300.0, now=now)
+    assert code == 1 and "p1" in msg
+
+    # Wedged: host keeps FLUSHING (fresh t) but stopped progressing —
+    # the failure shape the old mtime probe could not see.
+    wedged = str(tmp_path / "wedged")
+    _write_heartbeats(wedged, [(0, now - 10, now - 999, 100)])
+    code, msg = rep.check_heartbeats(wedged, 300.0, now=now)
+    assert code == 1 and "wedged" in msg
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    code, _ = rep.check_heartbeats(empty, 300.0, now=now)
+    assert code == 2
+
+
+def test_obs_report_cli_check_heartbeats(tmp_path):
+    rep = _load_obs_report()
+    w = str(tmp_path / "w")
+    _write_heartbeats(w, [(0, time.time(), time.time(), 5)])
+    assert rep.main(["--check-heartbeats", w, "--max-age-s", "300"]) == 0
+    assert rep.main(["--check-heartbeats", w, "--max-age-s", "0"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher close observability (ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _row_sums(rows):
+    return rows.reshape(rows.shape[0], -1).astype(np.float64).sum(axis=1)
+
+
+def test_batcher_close_during_in_flight_window_keeps_coriders(tmp_path):
+    """close() while a coalesced window is mid-inference neither
+    deadlocks nor silently drops co-riders: every already-submitted
+    future resolves with its own rows, the post-close submit is counted
+    in rejected_at_close, and the sentinel-terminated window lands in
+    close_flushed_windows."""
+    reg = obs_registry.Registry()
+    started = threading.Event()
+
+    def infer(rows):
+        started.set()
+        time.sleep(0.05)  # close() arrives while this window is in flight
+        return _row_sums(rows)
+
+    rows = np.arange(12, dtype=np.float64).reshape(3, 4)
+    b = MicroBatcher(infer, max_batch=2, max_wait_ms=5.0, registry=reg)
+    f0 = b.submit(rows[0:1])
+    f1 = b.submit(rows[1:2])
+    f2 = b.submit(rows[2:3])
+    assert started.wait(timeout=10)
+    t0 = time.monotonic()
+    b.close()  # joins the worker: must return, not deadlock
+    assert time.monotonic() - t0 < 10
+    for i, f in enumerate((f0, f1, f2)):
+        np.testing.assert_array_equal(
+            f.result(timeout=1), _row_sums(rows[i:i + 1])
+        )
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(rows[0:1])
+    assert reg.counter("serve.batcher.rejected_at_close").value == 1
+    # Queue drained back to empty; request latencies were recorded.
+    assert reg.gauge("serve.batcher.queue_depth").value == 0
+    assert reg.histogram("serve.request_latency_s").count == 3
+
+
+def test_batcher_close_flush_counters_on_unstarted_drain():
+    reg = obs_registry.Registry()
+    b = MicroBatcher(
+        lambda rows: _row_sums(rows), max_batch=8, autostart=False,
+        registry=reg,
+    )
+    futs = [b.submit(np.ones((1, 4))) for _ in range(3)]
+    b.close()  # never-started drain path
+    for f in futs:
+        np.testing.assert_array_equal(f.result(timeout=1), [4.0])
+    assert reg.counter("serve.batcher.close_flushed_windows").value == 1
+    assert reg.counter("serve.batcher.rows").value == 3
+    s = reg.histogram("serve.batcher.window_fill").snapshot()
+    assert s["count"] == 1 and s["sum"] == pytest.approx(3 / 8)
+
+
+def test_batcher_queue_depth_and_fill_metrics():
+    reg = obs_registry.Registry()
+    b = MicroBatcher(
+        lambda rows: _row_sums(rows), max_batch=4, max_wait_ms=50.0,
+        autostart=False, registry=reg,
+    )
+    for _ in range(4):
+        b.submit(np.ones((1, 4)))
+    assert reg.gauge("serve.batcher.queue_depth").value == 4
+    b.start()
+    b.close()
+    assert reg.gauge("serve.batcher.queue_depth").value == 0
+    assert reg.counter("serve.batcher.batches").value >= 1
+    assert reg.counter("serve.batcher.rows").value == 4
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine telemetry + a serving-session export
+# ---------------------------------------------------------------------------
+
+
+def _make_engine():
+    """A fresh k=2 smoke engine over an injected registry — each test
+    builds its own so counter assertions never depend on test order."""
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import ServeConfig, get_config, override
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg = override(get_config("smoke"), ["model.image_size=32"])
+    cfg = cfg.replace(serve=ServeConfig(max_batch=8, bucket_sizes=(4, 8)))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [0, 1])
+    state = jax.device_get(state)
+    reg = obs_registry.Registry()
+    engine = ServingEngine(cfg, model=model, state=state, registry=reg)
+    imgs = np.random.default_rng(0).integers(
+        0, 256, (6, 32, 32, 3), np.uint8
+    )
+    return engine, reg, imgs
+
+
+def test_engine_pad_and_compile_counters():
+    engine, reg, imgs = _make_engine()
+    engine.member_probs(imgs)  # 6 rows -> bucket 8, pad 2
+    assert reg.counter("serve.engine.rows").value == 6
+    assert reg.counter("serve.engine.batches").value == 1
+    assert reg.counter("serve.pad_rows_b8").value == 2
+    assert reg.counter("serve.bucket_compiles_b8").value == 1
+    engine.member_probs(imgs[:3])  # 3 rows -> bucket 4, pad 1
+    assert reg.counter("serve.pad_rows_b4").value == 1
+    assert reg.counter("serve.bucket_compiles_b4").value == 1
+    # Same buckets again: pad waste grows, compile counters do NOT.
+    engine.member_probs(imgs)
+    assert reg.counter("serve.pad_rows_b8").value == 4
+    assert reg.counter("serve.bucket_compiles_b8").value == 1
+    assert reg.gauge("serve.engine.in_flight").value == 0  # drained
+
+
+def test_engine_start_telemetry_defaults_to_config_cadence(tmp_path):
+    """start_telemetry honors obs.flush_every_s (the knob the trainer
+    uses) instead of a hardcoded cadence."""
+    engine, _, _ = _make_engine()
+    snap = engine.start_telemetry(str(tmp_path))
+    try:
+        assert snap.every_s == engine.cfg.obs.flush_every_s
+    finally:
+        snap.close()
+    snap2 = engine.start_telemetry(str(tmp_path), every_s=5.0)
+    try:
+        assert snap2.every_s == 5.0
+    finally:
+        snap2.close()
+
+
+def test_engine_session_produces_telemetry_artifacts(tmp_path):
+    """ISSUE 3 acceptance: a ServingEngine session emits `telemetry`
+    JSONL records AND <workdir>/telemetry.prom, renderable by
+    obs_report."""
+    engine, reg, imgs = _make_engine()
+    with engine.make_batcher() as b:
+        b.submit(imgs[:2]).result(timeout=60)
+    snap = engine.start_telemetry(str(tmp_path), every_s=1e9)
+    snap.close()  # final flush
+
+    recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+    telemetry = [r for r in recs if r["kind"] == "telemetry"]
+    assert telemetry
+    assert telemetry[-1]["counters"]["serve.engine.rows"] >= 2
+    assert telemetry[-1]["histograms"]["serve.request_latency_s"]["count"] >= 1
+    assert any(r["kind"] == "heartbeat" for r in recs)
+    prom = (tmp_path / "telemetry.prom").read_text()
+    assert "serve_engine_rows" in prom
+
+    rep = _load_obs_report()
+    out = rep.render_snapshot(rep.parse_prom(prom))
+    assert "serve request latency" in out
+    assert rep.main([str(tmp_path / "telemetry.prom")]) == 0
+
+
+# ---------------------------------------------------------------------------
+# End to end: an instrumented fit() produces every artifact
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_fit(tmp_path_factory):
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.data import tfrecord
+
+    data_dir = str(tmp_path_factory.mktemp("obs_data"))
+    tfrecord.write_synthetic_split(data_dir, "train", 48, 32, 2, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 16, 32, 1, seed=2)
+    cfg = override(get_config("smoke"), [
+        "model.image_size=32",
+        "train.steps=8", "train.eval_every=4", "train.log_every=2",
+        "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+        "obs.flush_every_s=0",  # flush at every log boundary
+    ])
+    workdir = str(tmp_path_factory.mktemp("obs_run"))
+    prev = obs_registry.set_default_registry(obs_registry.Registry())
+    try:
+        trainer.fit(cfg, data_dir, workdir, seed=0)
+    finally:
+        obs_registry.set_default_registry(prev)
+    return workdir
+
+
+def test_fit_train_records_carry_stall_attribution(obs_fit):
+    """Acceptance: `train` records carry input-wait/pause/dispatch
+    fields that sum consistently with window wall time."""
+    recs = read_jsonl(os.path.join(obs_fit, "metrics.jsonl"))
+    train = [r for r in recs if r["kind"] == "train"]
+    assert train
+    for r in train:
+        for k in ("window_sec", "input_wait_sec", "dispatch_sec",
+                  "pause_sec", "other_sec"):
+            assert k in r, (k, r)
+        total = (r["input_wait_sec"] + r["dispatch_sec"] + r["pause_sec"]
+                 + r["other_sec"])
+        assert total == pytest.approx(r["window_sec"], abs=2e-3), r
+
+
+def test_fit_emits_telemetry_heartbeat_and_prom(obs_fit):
+    recs = read_jsonl(os.path.join(obs_fit, "metrics.jsonl"))
+    telemetry = [r for r in recs if r["kind"] == "telemetry"]
+    beats = [r for r in recs if r["kind"] == "heartbeat"]
+    assert telemetry and beats
+    # The prefetch-depth gauge and trainer stall histograms made it in.
+    assert "data.prefetch.depth" in telemetry[-1]["gauges"]
+    assert telemetry[-1]["histograms"]["trainer.input_s"]["count"] > 0
+    assert beats[-1]["step"] == 8
+    assert beats[-1]["last_progress_t"] is not None
+    assert os.path.exists(os.path.join(obs_fit, "telemetry.prom"))
+
+
+def test_obs_report_renders_a_real_run(obs_fit, capsys):
+    rep = _load_obs_report()
+    assert rep.main([obs_fit]) == 0
+    out = capsys.readouterr().out
+    assert "stall attribution" in out
+    assert "heartbeat" in out
+    # The run just finished, so its heartbeat is fresh.
+    assert rep.main(["--check-heartbeats", obs_fit,
+                     "--max-age-s", "600"]) == 0
+
+
+def test_obs_disabled_run_writes_no_telemetry(tmp_path_factory):
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.data import tfrecord
+
+    data_dir = str(tmp_path_factory.mktemp("obs_off_data"))
+    tfrecord.write_synthetic_split(data_dir, "train", 16, 32, 1, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 8, 32, 1, seed=2)
+    cfg = override(get_config("smoke"), [
+        "model.image_size=32",
+        "train.steps=2", "train.eval_every=2", "train.log_every=1",
+        "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+        "obs.enabled=false",
+    ])
+    workdir = str(tmp_path_factory.mktemp("obs_off_run"))
+    prev = obs_registry.set_default_registry(obs_registry.Registry())
+    try:
+        trainer.fit(cfg, data_dir, workdir, seed=0)
+    finally:
+        obs_registry.set_default_registry(prev)
+        obs_registry.default_registry().enabled = True
+    recs = read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+    assert not [r for r in recs if r["kind"] in ("telemetry", "heartbeat")]
+    assert not os.path.exists(os.path.join(workdir, "telemetry.prom"))
+    # Stall attribution stays (it is part of the train record contract,
+    # not of the optional registry/export machinery).
+    train = [r for r in recs if r["kind"] == "train"]
+    assert train and all("input_wait_sec" in r for r in train)
