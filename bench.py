@@ -78,6 +78,13 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        runtime telemetry, PINNED within 2% of the
                        uninstrumented headline (_telemetry_overhead_guard;
                        also bounded per-op in tests/test_bench_guard.py).
+  device_only_tracing / tracing_overhead_pct / tracing_overhead_ok
+                     — the same window once more with the EVENT TRACER
+                       on as well (obs/trace.py; ISSUE 4): the span/
+                       StallClock call sites now additionally append
+                       ring-buffer trace events. Same ≤2% pin against
+                       the uninstrumented headline — the contract that
+                       lets obs.trace_enabled default on.
 
 Workload = the production config of record (BASELINE.json:7): Inception-v3,
 binary head, 299x299, global batch 32, aux head on, bf16 compute — the
@@ -311,14 +318,17 @@ def _gate_ensemble_speedup(extras: dict, rate: float,
     )
 
 
-def _instrumented_step(step, registry):
+def _instrumented_step(step, registry, tracer=None):
     """Wrap a train step with the SAME per-step telemetry ops the
     trainer's hot loop pays (obs/spans.StallClock segment timing into
     registry histograms + a step counter): what the telemetry-overhead
-    pin actually measures. Returns (wrapped_step, wrap_batch_iter)."""
+    pin actually measures. ``tracer`` (obs/trace.Tracer) additionally
+    routes each StallClock segment into the event timeline — the
+    tracing-overhead pin's workload. Returns (wrapped_step,
+    wrap_batch_iter)."""
     from jama16_retina_tpu.obs.spans import StallClock
 
-    stalls = StallClock(registry)
+    stalls = StallClock(registry, tracer=tracer)
     c_steps = registry.counter("bench.steps")
 
     def wrapped(state, batch, key):
@@ -336,32 +346,47 @@ def _instrumented_step(step, registry):
     return wrapped, wrap_batch_iter
 
 
-def _telemetry_overhead_guard(extras: dict, rate_on: float,
-                              rate_off: float,
-                              max_overhead: float = 0.02) -> bool:
-    """The ISSUE 3 overhead pin: device_only with telemetry enabled must
-    stay within ``max_overhead`` (2%) of disabled. Publishes the
-    measured overhead either way; a violation is flagged loudly in
-    ``telemetry_overhead_ok`` (and the log) instead of silently shipping
-    a slowed hot path. Negative overhead (telemetry run timed FASTER —
-    tunnel noise) clamps to 0 for the published percentage."""
+def _overhead_guard(extras: dict, key: str, rate_on: float,
+                    rate_off: float, max_overhead: float = 0.02) -> bool:
+    """The obs overhead pin (ISSUE 3 telemetry, ISSUE 4 tracing):
+    device_only with the instrumentation enabled must stay within
+    ``max_overhead`` (2%) of disabled. Publishes the measured overhead
+    either way under ``{key}_overhead_pct``; a violation is flagged
+    loudly in ``{key}_overhead_ok`` (and the log) instead of silently
+    shipping a slowed hot path. Negative overhead (instrumented run
+    timed FASTER — tunnel noise) clamps to 0 for the published
+    percentage."""
     overhead = 1.0 - rate_on / rate_off
-    extras["telemetry_overhead_pct"] = round(max(0.0, overhead) * 100, 2)
+    extras[f"{key}_overhead_pct"] = round(max(0.0, overhead) * 100, 2)
     ok = overhead <= max_overhead
-    extras["telemetry_overhead_ok"] = ok
+    extras[f"{key}_overhead_ok"] = ok
     if not ok:
         _log(
-            f"TELEMETRY OVERHEAD VIOLATION: instrumented device_only "
+            f"{key.upper()} OVERHEAD VIOLATION: instrumented device_only "
             f"{rate_on:.1f} img/s/chip is {overhead * 100:.1f}% below "
             f"uninstrumented {rate_off:.1f} (pin: <= "
             f"{max_overhead * 100:.0f}%) — the obs hot path regressed"
         )
     else:
         _log(
-            f"telemetry overhead: {extras['telemetry_overhead_pct']}% "
+            f"{key} overhead: {extras[f'{key}_overhead_pct']}% "
             f"(pin <= {max_overhead * 100:.0f}%)"
         )
     return ok
+
+
+def _telemetry_overhead_guard(extras: dict, rate_on: float,
+                              rate_off: float,
+                              max_overhead: float = 0.02) -> bool:
+    return _overhead_guard(extras, "telemetry", rate_on, rate_off,
+                           max_overhead)
+
+
+def _tracing_overhead_guard(extras: dict, rate_on: float,
+                            rate_off: float,
+                            max_overhead: float = 0.02) -> bool:
+    return _overhead_guard(extras, "tracing", rate_on, rate_off,
+                           max_overhead)
 
 
 def _latency_summary(latencies_ms) -> dict:
@@ -675,6 +700,35 @@ def main() -> None:
                 _telemetry_overhead_guard(extras, rate_t, device_only)
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"telemetry overhead bench failed: {type(e).__name__}: {e}")
+
+    # Tracing overhead pin (ISSUE 4): the same window once more with the
+    # event tracer ON as well — the span/StallClock call sites now
+    # additionally append per-thread ring-buffer events (obs/trace.py).
+    # Same 2% budget against the UNINSTRUMENTED headline — the contract
+    # that lets cfg.obs.trace_enabled default on.
+    if not headline_serialized:
+        try:
+            from jama16_retina_tpu.obs.registry import Registry
+            from jama16_retina_tpu.obs.trace import Tracer
+
+            tracer = Tracer(enabled=True, buffer_events=4096)
+            traced_step, wrap_iter_tr = _instrumented_step(
+                step, Registry(), tracer=tracer
+            )
+            rate_tr, state = _timed_steps(
+                traced_step, state,
+                wrap_iter_tr(lambda i: batches[i % N_DISTINCT_BATCHES]), key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_tr = _publish(
+                extras, "device_only_tracing", rate_tr,
+                flops_per_image, peak,
+                suffix=" (device_only + telemetry + event-trace ops)",
+            )
+            if rate_tr is not None:
+                _tracing_overhead_guard(extras, rate_tr, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"tracing overhead bench failed: {type(e).__name__}: {e}")
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.
     aug_imgs = jax.device_put(batches[0]["image"])

@@ -327,6 +327,25 @@ class ObsConfig:
     # `heartbeat` record). Checked from the train loop's logging cadence
     # — a flush never lands mid-step.
     flush_every_s: float = 60.0
+    # Event tracing (obs/trace.py; ISSUE 4): bounded per-thread ring
+    # buffers of begin/end/instant events — the flight recorder's
+    # black-box source and the Perfetto-loadable timeline behind
+    # `obs_report --trace-out`. On by default (a black box is only
+    # useful if it was recording): memory is bounded at
+    # trace_buffer_events per recording thread and the hot-path cost is
+    # pinned by bench.py's tracing_overhead_pct guard (same ≤2% budget
+    # as the telemetry pin). obs.enabled=false disables tracing too.
+    trace_enabled: bool = True
+    # Ring capacity per recording thread (events are overwritten oldest-
+    # first, never accumulated).
+    trace_buffer_events: int = 4096
+    # Slow-step anomaly trigger (obs/flightrec.py): a loop iteration
+    # above this factor × the rolling median of recent steps dumps a
+    # blackbox and requests the once-per-run profiler capture.
+    # <= 0 disables the trigger.
+    slow_step_factor: float = 4.0
+    # How many of the newest trace events a blackbox dump carries.
+    blackbox_events: int = 1024
 
 
 @dataclasses.dataclass(frozen=True)

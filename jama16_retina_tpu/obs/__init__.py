@@ -23,12 +23,29 @@ it system-wide:
     last_progress_t) replacing the implicit metrics.p{N}.jsonl-mtime
     probe of SURVEY.md §5.3.
 
+Event tracing + the black-box flight recorder (ISSUE 4) ride on top:
+
+  * ``trace``    — bounded per-thread ring buffers of timestamped
+    events with Chrome trace-event JSON export (Perfetto-loadable);
+    ``span()``/``StallClock`` call sites upgrade to trace events with
+    no call-site changes, and the serve path stamps request-scoped
+    segment events (queue-wait / window-fill / device / resolve) that
+    sum to ``serve.request_latency_s``.
+  * ``flightrec`` — anomaly-triggered dumps of last-N trace events +
+    registry snapshot + config to ``<workdir>/blackbox/`` on unhandled
+    exception, SIGTERM/SIGINT, non-finite loss, or a step above
+    ``obs.slow_step_factor`` × the rolling median — plus one
+    trigger-driven ``jax.profiler`` capture per run through the
+    trainer's ``_ProfilerWindow.arm``.
+
 Render either output with ``scripts/obs_report.py``; the metric-name
 glossary lives in docs/OBSERVABILITY.md. The hot-path cost is pinned by
-bench.py's telemetry-overhead guard (device_only with telemetry on must
-stay within 2% of off) and tests/test_bench_guard.py's per-op bound.
+bench.py's telemetry- and tracing-overhead guards (device_only with
+either enabled must stay within 2% of off) and
+tests/test_bench_guard.py's per-op bound.
 """
 
+from jama16_retina_tpu.obs.flightrec import FlightRecorder
 from jama16_retina_tpu.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -39,15 +56,26 @@ from jama16_retina_tpu.obs.registry import (
     set_default_registry,
 )
 from jama16_retina_tpu.obs.spans import StallClock, span
+from jama16_retina_tpu.obs.trace import (
+    Tracer,
+    chrome_trace,
+    default_tracer,
+    set_default_tracer,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
     "StallClock",
+    "Tracer",
+    "chrome_trace",
     "default_registry",
+    "default_tracer",
     "set_default_registry",
+    "set_default_tracer",
     "span",
 ]

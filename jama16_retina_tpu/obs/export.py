@@ -43,21 +43,39 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_help(text: str) -> str:
+    """Prometheus HELP text escaping: backslash and newline only (the
+    exposition-format rule; quotes are legal in HELP text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def prometheus_text(snapshot: dict) -> str:
     """Render a Registry.snapshot() as Prometheus text exposition
     (counters, gauges, and cumulative-``le`` histogram series with
-    ``_sum``/``_count``)."""
+    ``_sum``/``_count``). Metrics registered with a ``help:`` string
+    get a ``# HELP`` line before their ``# TYPE`` (the ordering strict
+    scrape parsers expect; pinned in tests)."""
+    help_by = snapshot.get("help", {})
+
+    def _help_line(lines: list, name: str, prom: str) -> None:
+        text = help_by.get(name)
+        if text:
+            lines.append(f"# HELP {prom} {_escape_help(text)}")
+
     lines: list[str] = []
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _prom_name(name)
+        _help_line(lines, name, n)
         lines.append(f"# TYPE {n} counter")
         lines.append(f"{n} {_fmt(v)}")
     for name, v in sorted(snapshot.get("gauges", {}).items()):
         n = _prom_name(name)
+        _help_line(lines, name, n)
         lines.append(f"# TYPE {n} gauge")
         lines.append(f"{n} {_fmt(v)}")
     for name, h in sorted(snapshot.get("histograms", {}).items()):
         n = _prom_name(name)
+        _help_line(lines, name, n)
         lines.append(f"# TYPE {n} histogram")
         for bound, cum in h["buckets"]:
             lines.append(f'{n}_bucket{{le="{_fmt(bound)}"}} {cum}')

@@ -1,0 +1,260 @@
+"""Black-box flight recorder: anomaly-triggered state dumps.
+
+Aggregate telemetry (obs/registry.py) and the event trace (obs/trace.py)
+are only useful if someone is LOOKING when the bad thing happens —
+EfficientNets-in-one-hour-scale training (PAPERS.md) relies on
+automatic straggler/anomaly capture precisely because nobody is. The
+recorder watches for four trigger shapes and, on any of them, dumps the
+process's last moments to ``<workdir>/blackbox/``:
+
+  * unhandled exception escaping the train loop (``record_exception``),
+  * SIGTERM / SIGINT (``install_signal_handlers`` converts the signal
+    to an in-band exception so the dump runs in NORMAL context — a
+    handler that snapshots locked registries directly could deadlock
+    against the interrupted frame's own metric lock),
+  * non-finite loss (``note_loss``: a cheap ``isfinite`` on the loss
+    the log path already fetched to host — no extra device sync),
+  * a slow step — wall time above ``slow_step_factor`` × the rolling
+    median of recent steps (``note_step_time``: one deque append and
+    one comparison per step; the median itself is recomputed only at
+    trigger-check cadence over a 64-step window).
+
+Each dump directory holds the last-N trace events (``trace.jsonl``, one
+event per line — readable even if the process dies mid-write), the full
+registry snapshot (``registry.json``), the run config (``config.json``)
+and a ``meta.json`` (reason/step/time/dropped-events). Dumps never
+touch the run's JSONL (RunLog stays owned by the trainer), are
+rate-limited to one per reason per run, and anomaly triggers can
+additionally request ONE short ``jax.profiler`` capture per run through
+``profile_hook`` (the trainer wires ``_ProfilerWindow.arm``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import statistics
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from jama16_retina_tpu.obs import registry as registry_lib
+from jama16_retina_tpu.obs import trace as trace_lib
+
+
+class FlightRecorder:
+    """One per run. ``enabled=False`` turns every hook into one branch.
+
+    ``config`` is any JSON-serializable mapping (the trainer passes
+    ``dataclasses.asdict(cfg)``); ``profile_hook`` is a zero-arg
+    callable invoked at most ONCE per run on NaN/slow-step anomalies.
+    """
+
+    STEP_WINDOW = 64          # rolling-median sample size
+    MIN_STEP_SAMPLES = 16     # no slow-step verdicts before this many
+
+    def __init__(
+        self,
+        workdir: str,
+        config: "dict | None" = None,
+        registry: "registry_lib.Registry | None" = None,
+        tracer: "trace_lib.Tracer | None" = None,
+        blackbox_events: int = 1024,
+        slow_step_factor: float = 4.0,
+        profile_hook=None,
+        enabled: bool = True,
+    ):
+        self.enabled = bool(enabled)
+        self.workdir = workdir
+        self.blackbox_dir = os.path.join(workdir, "blackbox")
+        self._config = config or {}
+        self._registry = (
+            registry if registry is not None
+            else registry_lib.default_registry()
+        )
+        self._tracer = (
+            tracer if tracer is not None else trace_lib.default_tracer()
+        )
+        self.blackbox_events = int(blackbox_events)
+        self.slow_step_factor = float(slow_step_factor)
+        self._profile_hook = profile_hook
+        self._profile_fired = False
+        self._step_times: deque = deque(maxlen=self.STEP_WINDOW)
+        self._step_median: "float | None" = None
+        self._steps_since_median = 0
+        self._last_step: "int | None" = None
+        self._dumped_reasons: set = set()
+        self._dump_seq = 0
+        self._dump_lock = threading.Lock()
+        self._prev_handlers: dict = {}
+        self._pending_signal: "int | None" = None
+        self.dumps: list[str] = []
+
+    # -- progress context --------------------------------------------------
+
+    def progress(self, step: int) -> None:
+        """Latest completed step — dump metadata, one attribute write."""
+        self._last_step = int(step)
+
+    # -- anomaly triggers --------------------------------------------------
+
+    def note_loss(self, loss, step: "int | None" = None) -> bool:
+        """Cheap non-finite sentinel on an ALREADY-FETCHED loss (scalar
+        or per-member array). Returns True when it triggered a dump."""
+        if not self.enabled:
+            return False
+        arr = np.asarray(loss, dtype=np.float64)
+        if arr.ndim == 0:
+            bad = not math.isfinite(float(arr))
+        else:
+            bad = not np.isfinite(arr).all()
+        if not bad:
+            return False
+        if step is not None:
+            self._last_step = int(step)
+        dumped = self.dump(
+            "nonfinite_loss",
+            loss=(repr(float(arr)) if arr.ndim == 0
+                  else [repr(float(x)) for x in arr.ravel()[:16]]),
+        ) is not None
+        self._request_profile()
+        return dumped
+
+    def note_step_time(self, dt: float, step: "int | None" = None) -> bool:
+        """Straggler detection: ``dt`` (seconds of one loop iteration,
+        eval/checkpoint pauses excluded by the caller) against
+        ``slow_step_factor`` × the rolling median of the last
+        ``STEP_WINDOW`` steps. Steady-state cost: one deque append, one
+        compare against a CACHED median (recomputed every 16 appends —
+        a 64-sample median shifts slowly), so the trainer can call this
+        every step under the 2% tracing-overhead budget."""
+        if not self.enabled:
+            return False
+        st = self._step_times
+        triggered = False
+        med = self._step_median
+        if (med is not None and med > 0
+                and dt > self.slow_step_factor * med):
+            if step is not None:
+                self._last_step = int(step)
+            triggered = self.dump(
+                "slow_step",
+                step_sec=round(dt, 6),
+                rolling_median_sec=round(med, 6),
+                factor=self.slow_step_factor,
+            ) is not None
+            self._request_profile()
+        st.append(dt)
+        self._steps_since_median += 1
+        if (self._steps_since_median >= 16
+                and len(st) >= self.MIN_STEP_SAMPLES):
+            # An anomalously slow step is IN the window it just joined;
+            # the median absorbs it (it would take window/2 slow steps
+            # to drag the threshold up), so back-to-back stragglers
+            # still compare against a healthy baseline.
+            self._step_median = statistics.median(st)
+            self._steps_since_median = 0
+        return triggered
+
+    def record_exception(self, exc: BaseException) -> "str | None":
+        """The unhandled-exception / signal trigger: call from the train
+        loop's ``except BaseException`` before re-raising."""
+        if not self.enabled:
+            return None
+        sig = self._pending_signal
+        if sig is not None:
+            self._pending_signal = None
+            reason = {
+                signal.SIGTERM: "sigterm", signal.SIGINT: "sigint",
+            }.get(sig, f"signal_{sig}")
+            return self.dump(reason, signal=int(sig))
+        if isinstance(exc, KeyboardInterrupt):
+            return self.dump("sigint", error=type(exc).__name__)
+        return self.dump(
+            "exception", error=f"{type(exc).__name__}: {exc}"
+        )
+
+    # -- signals -----------------------------------------------------------
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> in-band exception in the main thread, so
+        the dump happens in the trainer's normal except/finally path
+        (never inside async-signal context where a registry or RunLog
+        lock may already be held by the interrupted frame). No-op off
+        the main thread — signal.signal would raise there."""
+        if not self.enabled:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _handler(signum, frame):
+            self._pending_signal = signum
+            # SystemExit unwinds through the loop's except BaseException
+            # (which dumps) and its finally (which cleans up), exactly
+            # like any other fatal error.
+            raise SystemExit(128 + signum)
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._prev_handlers[sig] = signal.signal(sig, _handler)
+
+    def uninstall_signal_handlers(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+        self._prev_handlers = {}
+
+    # -- the dump ----------------------------------------------------------
+
+    def _request_profile(self) -> None:
+        """At most ONE trigger-driven profiler capture per run: a
+        pathological run (every step slow) must not turn the profiler
+        into the workload."""
+        if self._profile_fired or self._profile_hook is None:
+            return
+        self._profile_fired = True
+        try:
+            self._profile_hook()
+        except Exception:  # pragma: no cover - capture is best-effort
+            pass
+
+    def dump(self, reason: str, **meta) -> "str | None":
+        """Write one blackbox dump dir; returns its path, or None when
+        disabled / this reason already dumped this run (rate limit: the
+        FIRST occurrence carries the interesting state)."""
+        if not self.enabled:
+            return None
+        with self._dump_lock:
+            if reason in self._dumped_reasons:
+                return None
+            self._dumped_reasons.add(reason)
+            self._dump_seq += 1
+            seq = self._dump_seq
+        d = os.path.join(self.blackbox_dir, f"{seq:02d}-{reason}")
+        os.makedirs(d, exist_ok=True)
+        events = self._tracer.events(last_n=self.blackbox_events)
+        with open(os.path.join(d, "trace.jsonl"), "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        with open(os.path.join(d, "registry.json"), "w") as f:
+            json.dump(self._registry.snapshot(), f, indent=1)
+        with open(os.path.join(d, "config.json"), "w") as f:
+            json.dump(self._config, f, indent=1, default=str)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump({
+                "reason": reason,
+                "t": round(time.time(), 3),
+                "step": self._last_step,
+                "n_trace_events": len(events),
+                "trace_events_dropped": self._tracer.dropped(),
+                **meta,
+            }, f, indent=1)
+        self.dumps.append(d)
+        return d

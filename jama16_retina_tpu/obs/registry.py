@@ -243,11 +243,15 @@ class Registry:
 
     def snapshot(self) -> dict:
         """{'counters': {name: v}, 'gauges': {name: v},
-        'histograms': {name: Histogram.snapshot()}} — the one shape
-        every exporter (JSONL record, .prom file, obs_report) reads."""
+        'histograms': {name: Histogram.snapshot()}, 'help': {name:
+        text}} — the one shape every exporter (JSONL record, .prom
+        file, obs_report) reads. ``help`` carries only non-empty
+        strings (export.prometheus_text renders them as # HELP lines;
+        the JSONL exporter drops the map to keep records one line)."""
         with self._lock:
             metrics = list(self._metrics.values())
-        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {},
+                     "help": {}}
         for m in metrics:
             if isinstance(m, Counter):
                 out["counters"][m.name] = m.value
@@ -255,6 +259,8 @@ class Registry:
                 out["gauges"][m.name] = m.value
             elif isinstance(m, Histogram):
                 out["histograms"][m.name] = m.snapshot()
+            if getattr(m, "help", ""):
+                out["help"][m.name] = m.help
         return out
 
 

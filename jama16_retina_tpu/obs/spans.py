@@ -6,6 +6,15 @@ DISABLED registry must cost one branch — ``span`` returns a shared
 no-op context manager without allocating, so sprinkling spans through
 hot paths is free when telemetry is off.
 
+Event-tracing upgrade (ISSUE 4): when the process tracer
+(obs/trace.py) is enabled, the SAME ``span()`` call sites additionally
+emit a Chrome 'X' (complete) trace event — no call-site changes, and
+the both-disabled path is still the shared no-op. ``StallClock``
+segments get the same treatment: each measured ``trainer.<kind>``
+segment lands in the event timeline, so a step's input-wait/dispatch
+decomposition is visible per step in Perfetto, not just as cross-window
+quantiles.
+
 ``StallClock`` is the trainer's per-log-window stall attribution
 (ISSUE 3 tentpole): the wall time of a logging window decomposes into
 
@@ -30,20 +39,27 @@ from __future__ import annotations
 import time
 
 from jama16_retina_tpu.obs import registry as registry_lib
+from jama16_retina_tpu.obs import trace as trace_lib
 
 
 class _Span:
-    __slots__ = ("_hist", "_t0")
+    __slots__ = ("_hist", "_tracer", "_name", "_t0")
 
-    def __init__(self, hist):
+    def __init__(self, hist, tracer, name):
         self._hist = hist
+        self._tracer = tracer
+        self._name = name
 
     def __enter__(self) -> "_Span":
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> None:
-        self._hist.observe(time.perf_counter() - self._t0)
+        t1 = time.perf_counter()
+        if self._hist is not None:
+            self._hist.observe(t1 - self._t0)
+        if self._tracer is not None:
+            self._tracer.complete(self._name, self._t0, t1)
 
 
 class _NoopSpan:
@@ -60,14 +76,23 @@ _NOOP = _NoopSpan()
 
 
 def span(name: str, registry: "registry_lib.Registry | None" = None,
-         buckets=registry_lib.DEFAULT_BUCKETS):
+         buckets=registry_lib.DEFAULT_BUCKETS,
+         tracer: "trace_lib.Tracer | None" = None):
     """Context manager timing its block into histogram ``name``
-    (seconds). Disabled registry -> the shared no-op (one branch, no
-    allocation)."""
+    (seconds) AND — when the tracer is enabled — into the event
+    timeline as a complete event of the same name. Both disabled ->
+    the shared no-op (one branch each, no allocation)."""
     reg = registry if registry is not None else registry_lib.default_registry()
-    if not reg.enabled:
+    tr = tracer if tracer is not None else trace_lib.default_tracer()
+    reg_on = reg.enabled
+    tr_on = tr.enabled
+    if not reg_on and not tr_on:
         return _NOOP
-    return _Span(reg.histogram(name, buckets=buckets))
+    return _Span(
+        reg.histogram(name, buckets=buckets) if reg_on else None,
+        tr if tr_on else None,
+        name,
+    )
 
 
 class StallClock:
@@ -78,27 +103,45 @@ class StallClock:
     is attached, each segment also feeds a ``trainer.<kind>_s``
     histogram so the periodic telemetry snapshot carries cross-window
     quantiles (a single slow ``next(batches)`` shows up in p99 even
-    when the window average looks healthy).
+    when the window average looks healthy). When the tracer is enabled,
+    every segment — ``measure()`` context or direct ``add()`` —
+    additionally lands in the event timeline as ``trainer.<kind>``
+    (per-step causality, ISSUE 4).
     """
 
     KINDS = ("input", "dispatch", "pause")
 
-    def __init__(self, registry: "registry_lib.Registry | None" = None):
+    def __init__(self, registry: "registry_lib.Registry | None" = None,
+                 tracer: "trace_lib.Tracer | None" = None):
         self._reg = registry
         self._hists = {}
         if registry is not None:
             self._hists = {
                 k: registry.histogram(f"trainer.{k}_s") for k in self.KINDS
             }
+        self._tracer = (
+            tracer if tracer is not None else trace_lib.default_tracer()
+        )
+        self._trace_names = {k: f"trainer.{k}" for k in self.KINDS}
         now = time.perf_counter()
         self._window_start = now
         self._acc = dict.fromkeys(self.KINDS, 0.0)
 
-    def add(self, kind: str, dt: float) -> None:
+    def add(self, kind: str, dt: float, t0: "float | None" = None) -> None:
+        """Accumulate one measured segment. When the tracer is enabled
+        the segment also lands in the event timeline — ``t0`` (the
+        segment's perf_counter start) makes the event exact; without it
+        the segment is anchored as ending now, which is what every
+        direct ``add('pause', dt)`` call site does anyway (they add at
+        pause end)."""
         self._acc[kind] += dt
         h = self._hists.get(kind)
         if h is not None:
             h.observe(dt)
+        tr = self._tracer
+        if tr.enabled:
+            t1 = (t0 + dt) if t0 is not None else time.perf_counter()
+            tr.complete(self._trace_names[kind], t1 - dt, t1)
 
     def measure(self, kind: str):
         """``with stalls.measure('input'): batch = next(batches)``"""
@@ -139,4 +182,6 @@ class _StallSegment:
         return self
 
     def __exit__(self, *exc) -> None:
-        self._clock.add(self._kind, time.perf_counter() - self._t0)
+        self._clock.add(
+            self._kind, time.perf_counter() - self._t0, self._t0
+        )

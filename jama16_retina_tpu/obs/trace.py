@@ -1,0 +1,270 @@
+"""Event tracing: bounded per-thread ring buffers, Chrome-trace export.
+
+The registry (obs/registry.py) answers "how much / how often"; this
+module answers "what was the process doing, in order" — the event-level
+timeline the tf.data paper (PAPERS.md) shows bottleneck diagnosis needs,
+and the raw material the flight recorder (obs/flightrec.py) dumps when
+something goes wrong. Design constraints, in the registry's order:
+
+  * HOT-PATH CHEAP. Recording an event is one enabled-check, one
+    ``time.perf_counter()``, and one ring-slot assignment in a buffer
+    OWNED by the recording thread — no lock, no allocation beyond the
+    event tuple, no I/O. The cost is pinned by bench.py's
+    ``tracing_overhead_pct`` guard (same ≤2% budget as the telemetry
+    pin) and the per-op bound in tests/test_bench_guard.py.
+  * DISABLED == ONE BRANCH. Every record op checks ``enabled`` first;
+    ``span()``/``StallClock`` call sites (obs/spans.py) upgrade to
+    trace events with NO call-site changes and keep their shared-no-op
+    disabled path.
+  * BOUNDED BY CONSTRUCTION. Each thread's ring holds at most
+    ``buffer_events`` events; old events are overwritten, never
+    accumulated — a black-box recorder must be safe to leave on for a
+    30k-step run. Readers (``events()``) tolerate concurrent writers:
+    a torn slot at the wrap frontier is dropped, not crashed on.
+
+Timestamps are ``time.perf_counter()`` seconds (CLOCK_MONOTONIC on
+Linux — the same epoch ``time.monotonic()`` reads, which is what the
+serve batcher's request segments are stamped with). Export converts to
+the Chrome trace-event JSON the Perfetto UI / chrome://tracing load:
+``{"traceEvents": [{"name", "ph", "ts"(us), "pid", "tid", ...}]}``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+
+# Process-wide request/trace-id source: unique across engines/batchers
+# so one merged timeline never aliases two requests.
+_ids = itertools.count(1)
+
+
+def next_trace_id() -> int:
+    return next(_ids)
+
+
+class _Ring:
+    """Fixed-capacity overwrite-oldest event buffer, single-writer.
+
+    Only the owning thread appends; any thread may snapshot. Slot
+    assignment is atomic under the GIL, so a reader sees either the old
+    or the new event in a slot — never a torn tuple."""
+
+    __slots__ = ("cap", "buf", "n", "tid", "gen")
+
+    def __init__(self, cap: int, tid: int, gen: int):
+        self.cap = cap
+        self.buf = [None] * cap
+        self.n = 0  # events ever appended; n - cap of them overwritten
+        self.tid = tid
+        self.gen = gen
+
+    def append(self, ev) -> None:
+        self.buf[self.n % self.cap] = ev
+        self.n += 1
+
+    def snapshot(self) -> "tuple[list, int]":
+        """(events oldest-first, dropped_count) — tolerant of a
+        concurrent append racing the copy."""
+        n = self.n
+        buf = list(self.buf)
+        if n <= self.cap:
+            events = [e for e in buf[:n] if e is not None]
+        else:
+            i = n % self.cap
+            events = [e for e in buf[i:] + buf[:i] if e is not None]
+        return events, max(0, n - self.cap)
+
+
+class _NoopTrace:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopTrace":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopTrace()
+
+
+class _TraceSpan:
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_TraceSpan":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tracer.complete(
+            self._name, self._t0, time.perf_counter(), self._args
+        )
+
+
+class Tracer:
+    """Per-thread ring buffers of (ph, name, t0, dur, args) events.
+
+    ``enabled=False`` reduces every record op to one branch (handles
+    and rings stay valid). One process-wide default instance exists
+    (``default_tracer``); tests and embedded uses inject their own.
+    ``configure()`` re-arms it per run (the trainer's
+    ``_obs_begin_run`` twin of ``Registry.reset``).
+    """
+
+    # Retained-ring cap: rings are keyed by a unique ring id, NOT by
+    # thread ident (idents are REUSED once a thread exits — keying by
+    # them would let a new thread clobber a finished thread's ring,
+    # losing exactly the history a flight recorder must keep). The cap
+    # bounds memory under thread churn by evicting the oldest-
+    # registered ring; this codebase's recording threads are long-lived
+    # pools, so eviction is a pathological-case guard, not a hot path.
+    MAX_RINGS = 256
+
+    def __init__(self, enabled: bool = False, buffer_events: int = 4096):
+        self.enabled = enabled
+        self.buffer_events = max(1, int(buffer_events))
+        self._lock = threading.Lock()  # protects _rings registration only
+        self._rings: dict[int, _Ring] = {}
+        self._ring_ids = itertools.count()
+        self._local = threading.local()
+        # Export epoch: ts are published relative to tracer creation so
+        # Chrome timelines start near 0 instead of at host uptime.
+        self.epoch = time.perf_counter()
+        self._gen = 0
+
+    def _ring(self) -> _Ring:
+        r = getattr(self._local, "ring", None)
+        if r is None or r.gen != self._gen:
+            tid = threading.get_ident()
+            r = _Ring(self.buffer_events, tid, self._gen)
+            with self._lock:
+                self._rings[next(self._ring_ids)] = r
+                while len(self._rings) > self.MAX_RINGS:
+                    # dicts iterate in insertion order: drop the oldest.
+                    self._rings.pop(next(iter(self._rings)))
+            self._local.ring = r
+        return r
+
+    # -- recording (hot path) ---------------------------------------------
+
+    def instant(self, name: str, args: "dict | None" = None) -> None:
+        if not self.enabled:
+            return
+        self._ring().append(("i", name, time.perf_counter(), None, args))
+
+    def complete(self, name: str, t0: float, t1: float,
+                 args: "dict | None" = None) -> None:
+        """An explicit begin/end pair as one Chrome 'X' (complete)
+        event. ``t0``/``t1`` are perf_counter/monotonic seconds the
+        CALLER stamped — what lets the serve batcher publish segments
+        that sum exactly to its latency histogram's observation."""
+        if not self.enabled:
+            return
+        self._ring().append(("X", name, t0, max(0.0, t1 - t0), args))
+
+    def begin(self, name: str, args: "dict | None" = None) -> None:
+        if not self.enabled:
+            return
+        self._ring().append(("B", name, time.perf_counter(), None, args))
+
+    def end(self, name: str) -> None:
+        if not self.enabled:
+            return
+        self._ring().append(("E", name, time.perf_counter(), None, None))
+
+    def trace(self, name: str, args: "dict | None" = None):
+        """Context manager emitting one complete event (the trace twin
+        of ``span()``; disabled -> shared no-op, no allocation)."""
+        if not self.enabled:
+            return _NOOP
+        return _TraceSpan(self, name, args)
+
+    # -- control / export --------------------------------------------------
+
+    def configure(self, enabled: "bool | None" = None,
+                  buffer_events: "int | None" = None) -> None:
+        """Re-arm for a new run: apply knobs and CLEAR every ring (the
+        events belong to the previous run). Existing threads lazily
+        pick up fresh rings via the generation counter."""
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if buffer_events is not None:
+            self.buffer_events = max(1, int(buffer_events))
+        with self._lock:
+            self._gen += 1
+            self._rings = {}
+        self.epoch = time.perf_counter()
+
+    def clear(self) -> None:
+        self.configure()
+
+    def events(self, last_n: "int | None" = None) -> list[dict]:
+        """Snapshot every thread's ring as Chrome-shaped event dicts,
+        oldest first (merged by timestamp). ``last_n`` keeps only the
+        newest N — the flight recorder's ``blackbox_events`` window."""
+        with self._lock:
+            rings = list(self._rings.values())
+        pid = os.getpid()
+        out = []
+        for r in rings:
+            events, _ = r.snapshot()
+            for ph, name, t0, dur, args in events:
+                ev = {
+                    "name": name,
+                    "ph": ph,
+                    "ts": round((t0 - self.epoch) * 1e6, 3),
+                    "pid": pid,
+                    "tid": r.tid,
+                }
+                if ph == "X":
+                    ev["dur"] = round(dur * 1e6, 3)
+                if args:
+                    ev["args"] = dict(args)
+                out.append(ev)
+        out.sort(key=lambda e: e["ts"])
+        if last_n is not None and len(out) > last_n:
+            out = out[-last_n:]
+        return out
+
+    def dropped(self) -> int:
+        """Events overwritten since configure() — summed across rings
+        (flight-recorder dump metadata: how much history the window
+        could not hold)."""
+        with self._lock:
+            rings = list(self._rings.values())
+        return sum(r.snapshot()[1] for r in rings)
+
+
+def chrome_trace(events: list) -> dict:
+    """Wrap event dicts in the Chrome trace-event JSON object format
+    (Perfetto / chrome://tracing loadable)."""
+    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+
+
+def write_chrome_json(path: str, events: list) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(events), f)
+
+
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer every layer records into by default."""
+    return _default
+
+
+def set_default_tracer(tr: Tracer) -> Tracer:
+    """Swap the process-wide tracer (tests); returns the previous one."""
+    global _default
+    prev, _default = _default, tr
+    return prev

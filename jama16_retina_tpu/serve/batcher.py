@@ -36,6 +36,7 @@ from typing import Callable
 import numpy as np
 
 from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
 
 
 @dataclass
@@ -46,6 +47,13 @@ class _Request:
     # start mark (resolved - submitted, including queue wait + coalesce
     # window + inference + result slicing).
     t_submit: float = field(default_factory=time.monotonic)
+    # Request-scoped trace id (ISSUE 4): assigned at submit, rides the
+    # request through window fill -> flush -> engine forward -> future
+    # resolution, so its latency decomposes into named trace segments.
+    trace_id: int = field(default_factory=obs_trace.next_trace_id)
+    # monotonic time the worker popped this request off the queue (end
+    # of its queue-wait segment, start of its window-fill segment).
+    t_pop: float = 0.0
 
 
 _STOP = object()
@@ -75,6 +83,15 @@ class MicroBatcher:
     (submit -> future resolved, end to end), and the close-path
     counters ``serve.batcher.rejected_at_close`` /
     ``serve.batcher.close_flushed_windows``.
+
+    Request-scoped tracing (obs/trace.py; ``tracer=None`` uses the
+    process default): each submit is assigned a ``trace_id`` and, when
+    tracing is enabled, resolves with four complete events —
+    ``serve.request.{queue_wait,window_fill,device,resolve}`` — whose
+    durations tile the exact monotonic interval the latency histogram
+    observed, so any single request's latency decomposes from the
+    timeline (pinned by tests/test_trace.py, incl. on an 8-device
+    mesh engine).
     """
 
     def __init__(
@@ -86,6 +103,7 @@ class MicroBatcher:
         row_shape: "tuple[int, ...] | None" = None,
         row_dtype=None,
         registry: "obs_registry.Registry | None" = None,
+        tracer: "obs_trace.Tracer | None" = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -100,12 +118,24 @@ class MicroBatcher:
         self.batches_run = 0
         self.rows_run = 0
         reg = registry if registry is not None else obs_registry.default_registry()
-        self._g_depth = reg.gauge("serve.batcher.queue_depth")
+        self._tracer = (
+            tracer if tracer is not None else obs_trace.default_tracer()
+        )
+        self._g_depth = reg.gauge(
+            "serve.batcher.queue_depth",
+            help="requests waiting to coalesce into a window",
+        )
         self._h_fill = reg.histogram(
             "serve.batcher.window_fill",
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+            help="rows/max_batch per flushed window (low fill says "
+                 "max_wait_ms closes windows before coalescing pays)",
         )
-        self._h_latency = reg.histogram("serve.request_latency_s")
+        self._h_latency = reg.histogram(
+            "serve.request_latency_s",
+            help="end-to-end request latency: submit -> future resolved "
+                 "with host probabilities",
+        )
         self._c_batches = reg.counter("serve.batcher.batches")
         self._c_rows = reg.counter("serve.batcher.rows")
         self._c_rejected_closed = reg.counter("serve.batcher.rejected_at_close")
@@ -156,6 +186,7 @@ class MicroBatcher:
             item = self._queue.get()
             if item is _STOP:
                 return
+            item.t_pop = time.monotonic()
             window = [item]
             rows = item.rows.shape[0]
             deadline = time.monotonic() + self.max_wait_s
@@ -171,6 +202,7 @@ class MicroBatcher:
                 if nxt is _STOP:
                     stop_after = True
                     break
+                nxt.t_pop = time.monotonic()
                 window.append(nxt)
                 rows += nxt.rows.shape[0]
             if stop_after:
@@ -184,7 +216,16 @@ class MicroBatcher:
 
     def _flush(self, window: "list[_Request]") -> None:
         self._g_depth.add(-len(window))
+        # Segment timestamps (ISSUE 4): every request's latency is the
+        # SAME monotonic interval its trace segments tile — queue-wait
+        # [t_submit, t_pop) + window-fill [t_pop, t_flush) + device
+        # [t_flush, t_infer_done) + resolve [t_infer_done, now) sum to
+        # the serve.request_latency_s observation EXACTLY (one clock).
+        t_flush = time.monotonic()
         try:
+            for w in window:
+                if w.t_pop == 0.0:  # never-started close() drain
+                    w.t_pop = t_flush
             flat = (
                 window[0].rows if len(window) == 1
                 else np.concatenate([w.rows for w in window])
@@ -195,12 +236,14 @@ class MicroBatcher:
                     f"infer_fn returned {out.shape[0]} rows for "
                     f"{flat.shape[0]} inputs — row contract broken"
                 )
+            t_infer_done = time.monotonic()
             self.batches_run += 1
             self.rows_run += int(flat.shape[0])
             self._c_batches.inc()
             self._c_rows.inc(int(flat.shape[0]))
             self._h_fill.observe(flat.shape[0] / self.max_batch)
             now = time.monotonic()
+            tr = self._tracer
             lo = 0
             for w in window:
                 hi = lo + w.rows.shape[0]
@@ -211,6 +254,19 @@ class MicroBatcher:
                 try:
                     w.future.set_result(out[lo:hi])
                     self._h_latency.observe(now - w.t_submit)
+                    if tr.enabled:
+                        args = {
+                            "trace_id": w.trace_id,
+                            "rows": int(w.rows.shape[0]),
+                        }
+                        tr.complete("serve.request.queue_wait",
+                                    w.t_submit, w.t_pop, args)
+                        tr.complete("serve.request.window_fill",
+                                    w.t_pop, t_flush, args)
+                        tr.complete("serve.request.device",
+                                    t_flush, t_infer_done, args)
+                        tr.complete("serve.request.resolve",
+                                    t_infer_done, now, args)
                 except InvalidStateError:
                     pass
                 lo = hi

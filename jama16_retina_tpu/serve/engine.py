@@ -37,6 +37,8 @@ from jama16_retina_tpu.configs import ExperimentConfig, ServeConfig
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
 from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
+from jama16_retina_tpu.obs.spans import span
 from jama16_retina_tpu.parallel import mesh as mesh_lib
 
 
@@ -120,8 +122,15 @@ class ServingEngine:
             # Same wiring rule as the trainer's run entry: the engine's
             # own config decides whether the process-default registry
             # records (a prior obs.enabled=false fit in this process
-            # must not silently mute serving telemetry).
+            # must not silently mute serving telemetry). The process
+            # tracer gets the same treatment — a serving session never
+            # runs trainer._obs_begin_run, so obs.trace_enabled must be
+            # applied here for the batcher's request segments to record.
             self.registry.enabled = cfg.obs.enabled
+            obs_trace.default_tracer().configure(
+                enabled=cfg.obs.enabled and cfg.obs.trace_enabled,
+                buffer_events=cfg.obs.trace_buffer_events,
+            )
         self._c_rows = self.registry.counter("serve.engine.rows")
         self._c_batches = self.registry.counter("serve.engine.batches")
         self._g_in_flight = self.registry.gauge("serve.engine.in_flight")
@@ -205,7 +214,12 @@ class ServingEngine:
         def drain_one():
             p, n = pending.popleft()
             self._g_in_flight.set(len(pending))
-            outs.append(np.asarray(jax.device_get(p))[:, :n])
+            # span() (obs/spans.py) doubles as a trace event when the
+            # process tracer is on — the engine-internal sub-segments
+            # (pad / H2D+forward dispatch / device_get) nest inside the
+            # batcher's per-request `device` segment in the timeline.
+            with span("serve.engine.device_get_s", self.registry):
+                outs.append(np.asarray(jax.device_get(p))[:, :n])
 
         for lo in range(0, images.shape[0], self.max_batch):
             chunk = images[lo:lo + self.max_batch]
@@ -226,12 +240,17 @@ class ServingEngine:
                 )
                 self.registry.counter(f"serve.bucket_compiles_b{bucket}").inc()
             c_pad.inc(pad_rows)
-            if pad_rows:
-                pad = np.zeros((pad_rows, *chunk.shape[1:]), chunk.dtype)
-                padded = np.concatenate([chunk, pad])
-            else:
-                padded = chunk
-            dev = self._step(self.state, {"image": self._place(padded)})
+            with span("serve.engine.pad_s", self.registry):
+                if pad_rows:
+                    pad = np.zeros((pad_rows, *chunk.shape[1:]), chunk.dtype)
+                    padded = np.concatenate([chunk, pad])
+                else:
+                    padded = chunk
+            # One span over placement + dispatch: the forward is async
+            # (this times H2D staging and queue pressure, not device
+            # compute — device time is visible as the device_get drain).
+            with span("serve.engine.dispatch_s", self.registry):
+                dev = self._step(self.state, {"image": self._place(padded)})
             pending.append((dev, chunk.shape[0]))
             self._g_in_flight.set(len(pending))
             if len(pending) > max_in_flight:

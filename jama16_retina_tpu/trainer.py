@@ -24,7 +24,9 @@ from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
 from jama16_retina_tpu.obs import export as obs_export
+from jama16_retina_tpu.obs import flightrec as obs_flightrec
 from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
 from jama16_retina_tpu.obs.spans import StallClock
 from jama16_retina_tpu.parallel import mesh as mesh_lib
 from jama16_retina_tpu.utils import checkpoint as ckpt_lib
@@ -39,10 +41,16 @@ def _obs_begin_run(cfg: ExperimentConfig):
     decode counts, the worker-count gauge — belong to this run).
     Sequential ensemble members each fit() in one process; without the
     reset, member m's telemetry snapshots would carry members 0..m-1's
-    cumulative counters and histogram quantiles."""
+    cumulative counters and histogram quantiles. The process tracer
+    gets the same run-scoping (ISSUE 4): knobs applied, rings cleared —
+    a blackbox dump for member m must not replay member m-1's tail."""
     reg = obs_registry.default_registry()
     reg.enabled = cfg.obs.enabled
     reg.reset()
+    obs_trace.default_tracer().configure(
+        enabled=cfg.obs.enabled and cfg.obs.trace_enabled,
+        buffer_events=cfg.obs.trace_buffer_events,
+    )
     return reg
 
 
@@ -63,6 +71,29 @@ def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str):
             reg, workdir, runlog=log, every_s=cfg.obs.flush_every_s
         )
     return reg, stalls, snap
+
+
+def _flight_for(cfg: ExperimentConfig, workdir: str,
+                profiler: "_ProfilerWindow | None" = None):
+    """The run's FlightRecorder (obs/flightrec.py), or None when obs is
+    off. One wiring rule for all three loops: dumps carry THIS run's
+    config, record into the run-scoped default registry/tracer, and the
+    anomaly-triggered profiler capture routes through the run's
+    _ProfilerWindow (flax loops; fit_tf has no jax profiler to arm)."""
+    if not cfg.obs.enabled:
+        return None
+    import dataclasses
+
+    slow = cfg.obs.slow_step_factor
+    return obs_flightrec.FlightRecorder(
+        workdir,
+        config=dataclasses.asdict(cfg),
+        registry=obs_registry.default_registry(),
+        tracer=obs_trace.default_tracer(),
+        blackbox_events=cfg.obs.blackbox_events,
+        slow_step_factor=(slow if slow > 0 else float("inf")),
+        profile_hook=(profiler.arm if profiler is not None else None),
+    )
 
 
 def _binary_eval_labels(grades: np.ndarray, head: str) -> np.ndarray:
@@ -472,11 +503,23 @@ def _reconstruct_best_tracking(
 
 
 class _ProfilerWindow:
-    """The --profile_steps trace window (SURVEY.md §5.1), shared by the
-    single-model and member-parallel train loops: skip the compile+warmup
-    steps when the run is long enough, clamp the window inside short
-    runs, warn when no window fits, and never leak an open trace (the
-    next fit() in an ensemble would crash on start_trace)."""
+    """The jax.profiler capture window, shared by the single-model and
+    member-parallel train loops. Two ways to open it:
+
+      * the fixed --profile_steps window (SURVEY.md §5.1), planned at
+        construction exactly as before (skip the compile+warmup steps
+        when the run is long enough, clamp inside short runs, warn when
+        no window fits) — behavior unchanged (parity pinned by
+        tests/test_trace.py);
+      * ``arm(n)`` (ISSUE 4): a TRIGGER-DRIVEN short capture starting
+        at the next step boundary — the flight recorder's profile hook
+        on NaN/slow-step anomalies (once per run; the rate limit lives
+        in the FlightRecorder, and ``arm`` additionally refuses while a
+        capture is open so an anomaly inside the fixed window cannot
+        double-start the profiler).
+
+    Never leaks an open trace (the next fit() in an ensemble would
+    crash on start_trace)."""
 
     def __init__(self, cfg: ExperimentConfig, log: RunLog, workdir: str,
                  start_step: int):
@@ -485,6 +528,10 @@ class _ProfilerWindow:
         self._log = log
         self._start, self._stop = -1, -1
         self._tracing = False
+        self._fixed_done = False
+        self._arm = 0
+        self._n_capture = 0
+        self._trigger: "str | None" = None
         if self._steps > 0:
             remaining = cfg.train.steps - start_step
             if remaining < self._steps:
@@ -497,17 +544,46 @@ class _ProfilerWindow:
                 )
                 self._stop = self._start + self._steps
 
+    def arm(self, steps: int = 5) -> bool:
+        """Request a trigger-driven capture of ``steps`` steps starting
+        at the next step boundary. Refused (False) while a capture is
+        open or another request is pending."""
+        if self._tracing or self._arm > 0:
+            return False
+        self._arm = max(1, int(steps))
+        return True
+
     def before_step(self, step_i: int) -> None:
-        if step_i == self._start:
+        # The fixed window normally opens exactly at _start; if an
+        # anomaly capture is still open then (>= not ==), it opens at
+        # the first free step boundary after — the user asked for this
+        # window with --profile_steps, an anomaly must not silently
+        # cancel it (a deferred window running past train.steps is
+        # closed by finalize() with steps="truncated").
+        if (self._start >= 0 and step_i >= self._start
+                and not self._fixed_done and not self._tracing):
+            self._fixed_done = True
             jax.profiler.start_trace(self._dir)
             self._tracing = True
+            self._stop = step_i + self._steps
+            self._n_capture = self._steps
+            self._trigger = None
+        elif self._arm > 0 and not self._tracing:
+            n, self._arm = self._arm, 0
+            jax.profiler.start_trace(self._dir)
+            self._tracing = True
+            self._stop = step_i + n
+            self._n_capture = n
+            self._trigger = "anomaly"
 
     def after_step(self, step_i: int, state) -> None:
         if self._tracing and step_i + 1 >= self._stop:
             jax.block_until_ready(state)
             jax.profiler.stop_trace()
             self._tracing = False
-            self._log.write("profile", dir=self._dir, steps=self._steps)
+            extra = {"trigger": self._trigger} if self._trigger else {}
+            self._log.write("profile", dir=self._dir,
+                            steps=self._n_capture, **extra)
 
     def finalize(self) -> None:
         if self._tracing:
@@ -851,12 +927,16 @@ def fit(
     )
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
+    flight = _flight_for(cfg, workdir, profiler)
+    if flight is not None:
+        flight.install_signal_handlers()
 
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
     _, stalls, snap = _telemetry_for(cfg, log, workdir)
     try:
         for step_i in range(start_step, cfg.train.steps):
+            t_step = time.perf_counter()
             profiler.before_step(step_i)
             # Stall attribution (obs/spans.py): time blocked in next()
             # is INPUT STARVATION — the pipeline-fed gap measured where
@@ -874,11 +954,24 @@ def fit(
             clock.after_step()
             if snap is not None:
                 snap.progress(step_i + 1)
+            # Straggler sentinel: dt stops BEFORE profiler.after_step
+            # (closing a profiler window block_until_ready-syncs the
+            # whole device backlog — a legitimate pause that must not
+            # read as a slow step, exactly like the eval block below).
+            dt_step = time.perf_counter() - t_step
             profiler.after_step(step_i, state)
+            if flight is not None:
+                flight.progress(step_i + 1)
+                flight.note_step_time(dt_step, step=step_i + 1)
 
             if (step_i + 1) % cfg.train.log_every == 0:
+                loss = float(m["loss"])
+                if flight is not None:
+                    # Cheap non-finite sentinel on the ALREADY-fetched
+                    # loss (no extra device sync).
+                    flight.note_loss(loss, step=step_i + 1)
                 log.write(
-                    "train", step=step_i + 1, loss=float(m["loss"]),
+                    "train", step=step_i + 1, loss=loss,
                     **clock.fields(), **stalls.fields(),
                 )
                 if snap is not None:
@@ -904,10 +997,21 @@ def fit(
                 if stop:
                     stopped_early = True
                     break
+    except BaseException as e:
+        # Flight recorder (obs/flightrec.py): dump the black box for an
+        # unhandled exception — including SIGTERM/SIGINT, which the
+        # installed handlers convert to in-band exceptions so this dump
+        # runs in normal (not async-signal) context — then re-raise.
+        if flight is not None:
+            flight.record_exception(e)
+        raise
     finally:
         # Early stop / short runs / exceptions must not leak an open
-        # trace or a flipped global debug flag.
+        # trace, installed signal handlers, or a flipped global debug
+        # flag.
         profiler.finalize()
+        if flight is not None:
+            flight.uninstall_signal_handlers()
         if cfg.train.debug:
             jax.config.update("jax_debug_nans", prev_debug_nans)
 
@@ -1262,11 +1366,15 @@ def fit_ensemble_parallel(
     )
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
+    flight = _flight_for(cfg, workdir, profiler)
+    if flight is not None:
+        flight.install_signal_handlers()
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
     _, stalls, snap = _telemetry_for(cfg, log, workdir)
     try:
         for step_i in range(start_step, cfg.train.steps):
+            t_step = time.perf_counter()
             profiler.before_step(step_i)
             with stalls.measure("input"):
                 batch = next(batches)
@@ -1283,10 +1391,22 @@ def fit_ensemble_parallel(
             clock.after_step()
             if snap is not None:
                 snap.progress(step_i + 1)
+            # dt stops BEFORE profiler.after_step: a closing profiler
+            # window's block_until_ready sync must not read as a slow
+            # step (same exclusion as fit()).
+            dt_step = time.perf_counter() - t_step
             profiler.after_step(step_i, state)
+            if flight is not None:
+                flight.progress(step_i + 1)
+                flight.note_step_time(dt_step, step=step_i + 1)
 
             if (step_i + 1) % cfg.train.log_every == 0:
                 losses = np.asarray(jax.device_get(m_out["loss"]))
+                if flight is not None:
+                    # ANY member's non-finite loss trips the sentinel
+                    # (the members are independent; one diverging must
+                    # not hide in the mean).
+                    flight.note_loss(losses, step=step_i + 1)
                 log.write(
                     "train", step=step_i + 1,
                     loss=round(float(losses.mean()), 6),
@@ -1356,8 +1476,14 @@ def fit_ensemble_parallel(
                               best_step=[int(s) for s in best_step])
                     stopped_early = True
                     break
+    except BaseException as e:
+        if flight is not None:
+            flight.record_exception(e)
+        raise
     finally:
         profiler.finalize()
+        if flight is not None:
+            flight.uninstall_signal_handlers()
         if cfg.train.debug:
             jax.config.update("jax_debug_nans", prev_debug_nans)
 
@@ -1539,64 +1665,87 @@ def fit_tf(
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
     _, stalls, snap = _telemetry_for(cfg, log, workdir)
-    for step_i in range(start_step, tc.steps):
-        # Host augmentation counts as INPUT here: on this backend the
-        # data prep runs on host CPU ahead of the (synchronous) keras
-        # step, so it starves the step exactly like decode does.
-        with stalls.measure("input"):
-            batch = next(batches)
-            # Per-step generator keyed on (seed, step): a resumed run
-            # draws the same augmentations an uninterrupted one would
-            # (the numpy analogue of fit's fold_in(base_key, step);
-            # SURVEY.md §5.4). augment_batch_np is the full numpy twin
-            # of the TPU path (includes normalize; a no-op pass-through
-            # when augment=false).
-            x = augment_lib.augment_batch_np(
-                np.random.default_rng((seed, step_i)), batch["image"],
-                cfg.data,
-            )
-        if cfg.model.head == "binary":
-            y = (batch["grade"] >= 2).astype(np.float32)[:, None]
-        else:
-            y = np.eye(cfg.model.num_classes, dtype=np.float32)[
-                batch["grade"].astype(np.int64)
-            ]
-        with stalls.measure("dispatch"):
-            step_loss = float(keras_model.train_on_batch(x, y))
-        clock.after_step()
-        if snap is not None:
-            snap.progress(step_i + 1)
-
-        if (step_i + 1) % tc.log_every == 0:
-            log.write("train", step=step_i + 1, loss=step_loss,
-                      **clock.fields(), **stalls.fields())
+    # No jax profiler on this backend: the flight recorder's anomaly
+    # dumps still fire, with no capture hook to arm.
+    flight = _flight_for(cfg, workdir, profiler=None)
+    if flight is not None:
+        flight.install_signal_handlers()
+    try:
+        for step_i in range(start_step, tc.steps):
+            t_step = time.perf_counter()
+            # Host augmentation counts as INPUT here: on this backend the
+            # data prep runs on host CPU ahead of the (synchronous) keras
+            # step, so it starves the step exactly like decode does.
+            with stalls.measure("input"):
+                batch = next(batches)
+                # Per-step generator keyed on (seed, step): a resumed run
+                # draws the same augmentations an uninterrupted one would
+                # (the numpy analogue of fit's fold_in(base_key, step);
+                # SURVEY.md §5.4). augment_batch_np is the full numpy twin
+                # of the TPU path (includes normalize; a no-op pass-through
+                # when augment=false).
+                x = augment_lib.augment_batch_np(
+                    np.random.default_rng((seed, step_i)), batch["image"],
+                    cfg.data,
+                )
+            if cfg.model.head == "binary":
+                y = (batch["grade"] >= 2).astype(np.float32)[:, None]
+            else:
+                y = np.eye(cfg.model.num_classes, dtype=np.float32)[
+                    batch["grade"].astype(np.int64)
+                ]
+            with stalls.measure("dispatch"):
+                step_loss = float(keras_model.train_on_batch(x, y))
+            clock.after_step()
             if snap is not None:
-                snap.maybe_flush()
-
-        if (step_i + 1) % tc.eval_every == 0 or step_i + 1 == tc.steps:
-            clock.pause()
-            t_pause = time.perf_counter()
-            def _tf_state_for_save(step_now=step_i + 1):
-                params, batch_stats = transplant.transplant_from_keras(
-                    keras_model, state0.params, state0.batch_stats
-                )
-                return state0.replace(
-                    step=np.asarray(step_now, np.int32),
-                    params=params, batch_stats=batch_stats,
+                snap.progress(step_i + 1)
+            if flight is not None:
+                flight.progress(step_i + 1)
+                flight.note_step_time(
+                    time.perf_counter() - t_step, step=step_i + 1
                 )
 
-            best_auc, best_step, since_best, stop, _ = _eval_and_track(
-                cfg, log, ckpt, step_i + 1,
-                lambda: predict_split_tf(cfg, keras_model, data_dir, "val")[:2],
-                _tf_state_for_save,
-                best_auc, best_step, since_best,
-                save_due=_save_due(cfg, step_i + 1),
-            )
-            stalls.add("pause", time.perf_counter() - t_pause)
-            clock.resume()
-            if stop:
-                stopped_early = True
-                break
+            if (step_i + 1) % tc.log_every == 0:
+                if flight is not None:
+                    # train_on_batch already returned a host float; the
+                    # sentinel costs one isfinite.
+                    flight.note_loss(step_loss, step=step_i + 1)
+                log.write("train", step=step_i + 1, loss=step_loss,
+                          **clock.fields(), **stalls.fields())
+                if snap is not None:
+                    snap.maybe_flush()
+
+            if (step_i + 1) % tc.eval_every == 0 or step_i + 1 == tc.steps:
+                clock.pause()
+                t_pause = time.perf_counter()
+                def _tf_state_for_save(step_now=step_i + 1):
+                    params, batch_stats = transplant.transplant_from_keras(
+                        keras_model, state0.params, state0.batch_stats
+                    )
+                    return state0.replace(
+                        step=np.asarray(step_now, np.int32),
+                        params=params, batch_stats=batch_stats,
+                    )
+
+                best_auc, best_step, since_best, stop, _ = _eval_and_track(
+                    cfg, log, ckpt, step_i + 1,
+                    lambda: predict_split_tf(cfg, keras_model, data_dir, "val")[:2],
+                    _tf_state_for_save,
+                    best_auc, best_step, since_best,
+                    save_due=_save_due(cfg, step_i + 1),
+                )
+                stalls.add("pause", time.perf_counter() - t_pause)
+                clock.resume()
+                if stop:
+                    stopped_early = True
+                    break
+    except BaseException as e:
+        if flight is not None:
+            flight.record_exception(e)
+        raise
+    finally:
+        if flight is not None:
+            flight.uninstall_signal_handlers()
 
     ckpt.wait()
     ckpt.close()

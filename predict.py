@@ -84,6 +84,15 @@ _HOST_WORKERS = flags.DEFINE_integer(
     "derives one per host core up to 8. Output is worker-count-"
     "invariant, so this is a pure throughput knob",
 )
+_OBS_WORKDIR = flags.DEFINE_string(
+    "obs_workdir", "",
+    "emit `telemetry` + per-process `heartbeat` JSONL records (and the "
+    "atomic telemetry.prom snapshot) into this directory while the "
+    "batch runs, so `scripts/obs_report.py --check-heartbeats` covers "
+    "batch prediction jobs exactly like train loops (ISSUE 4 "
+    "satellite). Empty (default) emits nothing — stdout stays pure "
+    "prediction JSONL either way",
+)
 
 _EXTS = (".jpg", ".jpeg", ".png", ".tif", ".tiff", ".bmp")
 
@@ -140,6 +149,20 @@ def main(argv):
         dirs = ckpt_lib.discover_member_dirs(_CKPT.value)
     paths = _expand(list(_IMAGES.value))
 
+    # Heartbeats for batch prediction jobs (ISSUE 4 satellite): the
+    # snapshotter owns its RunLog in --obs_workdir; `step` counts
+    # forward-passed images, and close() always lands a final
+    # heartbeat, so --check-heartbeats distinguishes a finished batch
+    # from a wedged one.
+    snap = None
+    if _OBS_WORKDIR.value:
+        from jama16_retina_tpu.obs import export as obs_export
+
+        snap = obs_export.Snapshotter(
+            workdir=_OBS_WORKDIR.value, every_s=cfg.obs.flush_every_s,
+        )
+        snap.progress(0)
+
     # Host stage: fundus normalization parallelized across a worker pool
     # (serve/host.py) with worker-count-invariant output order — the
     # old serial per-image loop, minus the serialization.
@@ -154,6 +177,8 @@ def main(argv):
     for p, why in skipped:
         print(json.dumps({"image": p, "error": why}))
     if not kept:
+        if snap is not None:
+            snap.close()  # final heartbeat: the job ran, nothing scored
         sys.exit(1)
 
     model = models.build(cfg.model)  # flax tree = the checkpoint schema
@@ -180,7 +205,7 @@ def main(argv):
             block_lens.append(min(_BATCH.value, len(kept) - i))
         pre = None  # the padded batches are the only copy needed now
         prob_list = []
-        for d in dirs:
+        for mi, d in enumerate(dirs):
             state = trainer.restore_for_eval(cfg, model, d)
             tf_backend.load_flax_state(
                 keras_model, train_lib.eval_params(state), state.batch_stats
@@ -191,6 +216,11 @@ def main(argv):
                 )[:n]
                 for b, n in zip(batches, block_lens)
             ]))
+            if snap is not None:
+                # Step counts images scored: member mi+1 of K done means
+                # that fraction of the batch is through the forward.
+                snap.progress(len(kept) * (mi + 1) // len(dirs))
+                snap.maybe_flush()
         probs = metrics.ensemble_average(prob_list)
     else:
         # Serving engine (serve/engine.py): every member restored ONCE
@@ -207,7 +237,20 @@ def main(argv):
             bucket_sizes=(_BATCH.value,),
         ))
         engine = ServingEngine(cfg, dirs, model=model)
-        probs = engine.probs(pre.images)
+        if snap is None:
+            probs = engine.probs(pre.images)
+        else:
+            # Per-block calls so heartbeats advance DURING a long batch.
+            # Identical math to one call: engine.probs chunks at
+            # max_batch internally, and these blocks are exactly the
+            # chunks it would form (ensemble averaging is row-wise).
+            blocks = []
+            for i in range(0, len(kept), _BATCH.value):
+                blocks.append(engine.probs(pre.images[i:i + _BATCH.value]))
+                snap.progress(i + blocks[-1].shape[0])
+                snap.maybe_flush()
+            probs = (blocks[0] if len(blocks) == 1
+                     else np.concatenate(blocks))
 
     for p, pr, qual in zip(kept, probs, qualities):
         if cfg.model.head != "binary":
@@ -234,6 +277,10 @@ def main(argv):
             row["gradable"] = bool(qual >= _MIN_QUALITY.value)
         row["n_models"] = len(dirs)
         print(json.dumps(row))
+
+    if snap is not None:
+        snap.progress(len(kept))
+        snap.close()  # final flush: telemetry + heartbeat + .prom
 
     if skipped and _STRICT.value:
         # Every scored row is already on stdout; the nonzero exit tells

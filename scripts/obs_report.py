@@ -1,16 +1,28 @@
 #!/usr/bin/env python
-"""Render runtime telemetry (ISSUE 3) into a human-readable report.
+"""Render runtime telemetry (ISSUE 3) + event traces (ISSUE 4) into a
+human-readable report.
 
-Input is either output of the obs subsystem:
+Input is any output of the obs subsystem:
 
   * a RUN — a workdir (reads metrics.jsonl + its metrics.p{N}.jsonl
     mirrors) or a single JSONL file: renders stall attribution
     aggregated over the run's `train` records, the latest `telemetry`
     snapshot (cache hit rates, decode-pool utilization, serve latency
-    quantiles), and the per-process heartbeat table;
+    quantiles), the per-process heartbeat table, and — when the workdir
+    carries a `blackbox/` flight-recorder dump — the slowest-10
+    requests/steps with their segment breakdown;
   * a SNAPSHOT — a .prom file (the atomic Prometheus-text snapshot
     obs/export.py rewrites each flush): renders the same metric tables
-    from the scraped state.
+    from the scraped state;
+  * a TRACE — a flight-recorder dump dir (or its trace.jsonl, or an
+    already-exported Chrome .json): renders the slowest-10 tables, and
+    ``--trace-out chrome.json`` converts it to the Chrome trace-event
+    JSON that Perfetto (https://ui.perfetto.dev) / chrome://tracing
+    load directly.
+
+``--json`` switches every report above to one machine-readable JSON
+object on stdout (CI consumes the same stall/latency/slowest tables
+without scraping the human rendering).
 
 Exit-code mode (the SURVEY §5.3 wedged-host probe as a cron/CI
 one-liner):
@@ -175,19 +187,34 @@ def _table(rows, headers) -> str:
     return "\n".join([fmt(headers), sep, *[fmt(r) for r in rows]])
 
 
-def render_stalls(records: list) -> str:
-    """Aggregate the per-window stall attribution of `train` records:
-    where the run's wall time actually went (the top-stalls table)."""
+def stalls_summary(records: list) -> "dict | None":
+    """Aggregate the per-window stall attribution of `train` records
+    into one machine-readable dict (the --json twin of the top-stalls
+    table); None when the run carries no instrumented windows."""
     wins = [r for r in records if r.get("kind") == "train"
             and "window_sec" in r]
     if not wins:
-        return "stall attribution: no instrumented `train` records"
+        return None
     tot = {k: sum(r.get(k, 0.0) for r in wins)
            for k in ("window_sec", "input_wait_sec", "dispatch_sec",
                      "pause_sec", "other_sec")}
-    wall = tot["window_sec"] or 1e-9
+    worst = max(wins, key=lambda r: r.get("input_wait_sec", 0.0))
+    return {
+        "windows": len(wins),
+        **{k: round(v, 6) for k, v in tot.items()},
+        "worst_input_wait_sec": round(worst.get("input_wait_sec", 0.0), 6),
+        "worst_input_wait_step": worst.get("step"),
+    }
+
+
+def render_stalls(records: list) -> str:
+    """The top-stalls table: where the run's wall time actually went."""
+    s = stalls_summary(records)
+    if s is None:
+        return "stall attribution: no instrumented `train` records"
+    wall = s["window_sec"] or 1e-9
     rows = [
-        (name, f"{tot[key]:.2f}", f"{100 * tot[key] / wall:.1f}%")
+        (name, f"{s[key]:.2f}", f"{100 * s[key] / wall:.1f}%")
         for name, key in (
             ("input wait (pipeline starvation)", "input_wait_sec"),
             ("eval/checkpoint pause", "pause_sec"),
@@ -195,13 +222,12 @@ def render_stalls(records: list) -> str:
             ("other (host python, logging)", "other_sec"),
         )
     ]
-    worst = max(wins, key=lambda r: r.get("input_wait_sec", 0.0))
     out = [
-        f"stall attribution over {len(wins)} train windows "
+        f"stall attribution over {s['windows']} train windows "
         f"({wall:.2f} s wall):",
         _table(rows, ("where", "seconds", "of wall")),
-        f"worst input-wait window: {worst.get('input_wait_sec', 0.0):.2f} s "
-        f"at step {worst.get('step', '?')}",
+        f"worst input-wait window: {s['worst_input_wait_sec']:.2f} s "
+        f"at step {s['worst_input_wait_step'] or '?'}",
     ]
     return "\n".join(out)
 
@@ -297,6 +323,156 @@ def render_heartbeats(records: list, now: "float | None" = None) -> str:
     return _table(rows, ("process", "step", "heartbeat", "last progress"))
 
 
+# ---------------------------------------------------------------------------
+# Traces: flight-recorder dumps -> Chrome JSON + slowest-10 tables
+# ---------------------------------------------------------------------------
+
+_REQ_SEGMENTS = ("queue_wait", "window_fill", "device", "resolve")
+
+
+def find_trace(path: str) -> "str | None":
+    """Resolve a trace source: a trace.jsonl / exported .json file, a
+    flight-recorder dump dir containing trace.jsonl, or a workdir whose
+    blackbox/ holds dumps (newest dump wins)."""
+    if os.path.isfile(path):
+        name = os.path.basename(path)
+        if name.endswith(".json") or (name.endswith(".jsonl")
+                                      and name.startswith("trace")):
+            return path
+        return None
+    direct = os.path.join(path, "trace.jsonl")
+    if os.path.exists(direct):
+        return direct
+    dumps = sorted(glob.glob(os.path.join(path, "blackbox", "*",
+                                          "trace.jsonl")))
+    return dumps[-1] if dumps else None
+
+
+def load_trace_events(path: str) -> list:
+    """Event dicts from either dump format: trace.jsonl (one Chrome
+    event per line — readable even if the process died mid-write) or an
+    exported Chrome .json ({"traceEvents": [...]} or a bare list)."""
+    if path.endswith(".jsonl"):
+        return [e for e in _read_jsonl(path) if isinstance(e, dict)]
+    with open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", []) if isinstance(data, dict) else data
+    return [e for e in events if isinstance(e, dict)]
+
+
+def write_chrome_json(path: str, events: list) -> None:
+    """The Chrome trace-event JSON object format — loadable by the
+    Perfetto UI (https://ui.perfetto.dev) and chrome://tracing."""
+    with open(path, "w") as f:
+        json.dump({"traceEvents": list(events), "displayTimeUnit": "ms"}, f)
+
+
+def slowest_requests(events: list, n: int = 10) -> list:
+    """The n slowest serve requests with their segment breakdown.
+
+    Groups the batcher's complete events
+    (serve.request.{queue_wait,window_fill,device,resolve}) by the
+    trace_id each request carries; the four segments tile the exact
+    interval the request's serve.request_latency_s observation spans,
+    so total == recorded latency (one clock)."""
+    by_id: dict = {}
+    for e in events:
+        name = e.get("name", "")
+        if e.get("ph") != "X" or not name.startswith("serve.request."):
+            continue
+        seg = name[len("serve.request."):]
+        if seg not in _REQ_SEGMENTS:
+            continue
+        args = e.get("args", {})
+        tid = args.get("trace_id")
+        if tid is None:
+            continue
+        r = by_id.setdefault(tid, {"trace_id": tid,
+                                   "rows": args.get("rows")})
+        r[f"{seg}_ms"] = round(e.get("dur", 0.0) / 1e3, 3)
+    reqs = []
+    for r in by_id.values():
+        r["total_ms"] = round(
+            sum(r.get(f"{s}_ms", 0.0) for s in _REQ_SEGMENTS), 3
+        )
+        reqs.append(r)
+    reqs.sort(key=lambda r: -r["total_ms"])
+    return reqs[:n]
+
+
+def slowest_steps(events: list, n: int = 10) -> list:
+    """The n slowest trainer steps with their segment breakdown.
+
+    A step in the timeline is one trainer.input event and every
+    trainer.dispatch/trainer.pause that follows it (same thread, by
+    timestamp) until the next trainer.input — the StallClock segments
+    the `train` records aggregate per window, here per step."""
+    per_tid: dict = {}
+    for e in events:
+        name = e.get("name", "")
+        if e.get("ph") != "X" or not name.startswith("trainer."):
+            continue
+        seg = name[len("trainer."):]
+        if seg not in ("input", "dispatch", "pause"):
+            continue
+        per_tid.setdefault(e.get("tid"), []).append(
+            (e.get("ts", 0.0), seg, e.get("dur", 0.0))
+        )
+    steps = []
+    for tid, evs in per_tid.items():
+        evs.sort()
+        cur = None
+        for ts, seg, dur in evs:
+            if seg == "input":
+                if cur is not None:
+                    steps.append(cur)
+                cur = {"ts_ms": round(ts / 1e3, 3), "tid": tid,
+                       "input_ms": round(dur / 1e3, 3),
+                       "dispatch_ms": 0.0, "pause_ms": 0.0}
+            elif cur is not None:
+                cur[f"{seg}_ms"] = round(
+                    cur[f"{seg}_ms"] + dur / 1e3, 3
+                )
+        if cur is not None:
+            steps.append(cur)
+    for s in steps:
+        s["total_ms"] = round(
+            s["input_ms"] + s["dispatch_ms"] + s["pause_ms"], 3
+        )
+    steps.sort(key=lambda s: -s["total_ms"])
+    return steps[:n]
+
+
+def render_slowest(events: list, n: int = 10) -> str:
+    """Both slowest-10 tables (whichever the trace carries)."""
+    out = []
+    reqs = slowest_requests(events, n)
+    if reqs:
+        rows = [
+            (r["trace_id"], r.get("rows", "-"), f"{r['total_ms']:.3f}",
+             *(f"{r.get(f'{s}_ms', 0.0):.3f}" for s in _REQ_SEGMENTS))
+            for r in reqs
+        ]
+        out.append(f"slowest {len(rows)} serve requests (ms):\n" + _table(
+            rows, ("trace_id", "rows", "total", *_REQ_SEGMENTS)
+        ))
+    steps = slowest_steps(events, n)
+    if steps:
+        rows = [
+            (f"{s['ts_ms']:.1f}", f"{s['total_ms']:.3f}",
+             f"{s['input_ms']:.3f}", f"{s['dispatch_ms']:.3f}",
+             f"{s['pause_ms']:.3f}")
+            for s in steps
+        ]
+        out.append(f"slowest {len(rows)} trainer steps (ms):\n" + _table(
+            rows, ("ts", "total", "input", "dispatch", "pause")
+        ))
+    if not out:
+        return ("trace: no serve.request.*/trainer.* segment events "
+                "(tracing disabled, or the ring wrapped past them)")
+    return "\n\n".join(out)
+
+
 def check_heartbeats(workdir: str, max_age_s: float,
                      now: "float | None" = None) -> tuple[int, str]:
     """(exit_code, message): 0 fresh, 1 stale/wedged, 2 none found."""
@@ -332,7 +508,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument(
         "path", nargs="?",
-        help="workdir, metrics JSONL file, or telemetry.prom snapshot",
+        help="workdir, metrics JSONL file, telemetry.prom snapshot, or "
+             "a flight-recorder dump (dir / trace.jsonl / Chrome .json)",
     )
     ap.add_argument(
         "--check-heartbeats", metavar="WORKDIR", default=None,
@@ -340,6 +517,18 @@ def main(argv=None) -> int:
              "progress older than --max-age-s, 2 no heartbeats",
     )
     ap.add_argument("--max-age-s", type=float, default=300.0)
+    ap.add_argument(
+        "--trace-out", metavar="CHROME_JSON", default=None,
+        help="convert the blackbox/trace dump at PATH to Chrome "
+             "trace-event JSON (open in https://ui.perfetto.dev)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit one machine-readable JSON object instead of tables "
+             "(CI consumption of the stall/latency/slowest reports)",
+    )
+    ap.add_argument("--slowest", type=int, default=10, metavar="N",
+                    help="rows in the slowest-requests/steps tables")
     args = ap.parse_args(argv)
 
     if args.check_heartbeats:
@@ -352,22 +541,66 @@ def main(argv=None) -> int:
     if args.path.endswith(".prom"):
         with open(args.path) as f:
             snap = parse_prom(f.read())
-        print(render_snapshot(snap))
+        print(json.dumps({"snapshot": snap}) if args.json
+              else render_snapshot(snap))
+        return 0
+
+    trace_src = find_trace(args.path)
+    events = load_trace_events(trace_src) if trace_src else []
+    if args.trace_out:
+        if not events:
+            print(f"no trace dump found under {args.path}")
+            return 2
+        write_chrome_json(args.trace_out, events)
+        print(f"wrote {len(events)} events from {trace_src} to "
+              f"{args.trace_out} (load in https://ui.perfetto.dev)")
+        return 0
+
+    # A dump dir / trace file directly: the slowest tables alone.
+    if trace_src and (os.path.isfile(args.path)
+                      or os.path.samefile(
+                          os.path.dirname(trace_src), args.path)):
+        if args.json:
+            print(json.dumps({
+                "trace": trace_src, "n_events": len(events),
+                "slowest_requests": slowest_requests(events, args.slowest),
+                "slowest_steps": slowest_steps(events, args.slowest),
+            }))
+        else:
+            print(render_slowest(events, args.slowest))
         return 0
 
     records = load_records(args.path)
     if not records:
         print(f"no records under {args.path}")
         return 2
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    if args.json:
+        now = time.time()
+        print(json.dumps({
+            "stalls": stalls_summary(records),
+            "telemetry": telemetry[-1] if telemetry else None,
+            "heartbeats": {
+                f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
+                for p, b in sorted(latest_heartbeats(records).items())
+            },
+            "trace": trace_src,
+            "slowest_requests": slowest_requests(events, args.slowest),
+            "slowest_steps": slowest_steps(events, args.slowest),
+        }))
+        return 0
     print(render_stalls(records))
     print()
-    telemetry = [r for r in records if r.get("kind") == "telemetry"]
     if telemetry:
         print(render_snapshot(telemetry[-1]))
     else:
         print("telemetry records: none (obs.enabled=false run?)")
     print()
     print(render_heartbeats(records))
+    if events:
+        print()
+        print(f"[trace: {trace_src}]")
+        print(render_slowest(events, args.slowest))
     return 0
 
 

@@ -95,6 +95,19 @@ _QUICK_TESTS = {
     "test_serve.py::test_engine_bit_identical_to_sequential_path",
     "test_serve.py::test_batcher_coalesces_queued_requests",
     "test_serve.py::test_host_preprocess_is_worker_count_invariant",
+    # event tracing + flight recorder (ISSUE 4): the ring/export/
+    # trigger pins are numpy-cheap; the fit()-level dump and 8-device
+    # engine tests stay in the full tier (XLA compiles dominate there)
+    "test_trace.py::test_ring_wraparound_under_concurrent_writers",
+    "test_trace.py::test_chrome_json_valid_and_loadable",
+    "test_trace.py::test_span_upgrades_to_trace_event_without_callsite_changes",
+    "test_trace.py::test_stall_clock_segments_land_in_timeline",
+    "test_trace.py::test_note_loss_dumps_once_per_run",
+    "test_trace.py::test_sigterm_handler_converts_to_inband_exception",
+    "test_trace.py::test_profiler_window_profile_steps_parity",
+    "test_trace.py::test_obs_report_trace_out_converts_dump",
+    "test_trace.py::test_obs_report_json_output_for_run_and_dump",
+    "test_trace.py::test_prometheus_help_lines_scrape_parse_strict",
 }
 
 

@@ -233,6 +233,93 @@ def test_telemetry_ops_are_hot_path_cheap():
     assert per_seg < 100e-6, f"{per_seg * 1e6:.1f} us per segment"
 
 
+def test_tracing_overhead_guard_pins_two_percent():
+    """The ISSUE 4 twin of the telemetry pin: device_only with the
+    event tracer on must stay within 2% of the uninstrumented
+    headline, published under tracing_overhead_pct/_ok."""
+    extras = {}
+    assert bench._tracing_overhead_guard(extras, 990.0, 1000.0)
+    assert extras["tracing_overhead_ok"] is True
+    assert extras["tracing_overhead_pct"] == pytest.approx(1.0)
+    extras = {}
+    assert not bench._tracing_overhead_guard(extras, 950.0, 1000.0)
+    assert extras["tracing_overhead_ok"] is False
+    assert extras["tracing_overhead_pct"] == pytest.approx(5.0)
+    extras = {}
+    assert bench._tracing_overhead_guard(extras, 1010.0, 1000.0)
+    assert extras["tracing_overhead_pct"] == 0.0
+
+
+def test_tracing_ops_are_hot_path_cheap():
+    """Per-op bound backing the tracing pin off-chip (ISSUE 4): one
+    ring-buffer event append — the cost span()/StallClock call sites
+    add when tracing is on — must stay far under the per-step budget,
+    and the disabled path must cost one branch (bounded well below the
+    enabled path's own generous bound)."""
+    import time
+
+    from jama16_retina_tpu.obs.registry import Registry
+    from jama16_retina_tpu.obs.spans import StallClock
+    from jama16_retina_tpu.obs.trace import Tracer
+
+    tr = Tracer(enabled=True, buffer_events=4096)
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tr.complete("x", 0.0, 1.0)
+        tr.instant("i")
+    per_op = (time.perf_counter() - t0) / (2 * n)
+    assert per_op < 100e-6, f"{per_op * 1e6:.1f} us per trace append"
+
+    # A StallClock segment with BOTH sinks live (histogram + ring).
+    reg = Registry()
+    sc = StallClock(reg, tracer=tr)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with sc.measure("dispatch"):
+            pass
+    per_seg = (time.perf_counter() - t0) / n
+    assert per_seg < 100e-6, f"{per_seg * 1e6:.1f} us per traced segment"
+
+    off = Tracer(enabled=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        off.complete("x", 0.0, 1.0)
+        off.instant("i")
+    per_off = (time.perf_counter() - t0) / (2 * n)
+    assert per_off < 20e-6, f"{per_off * 1e6:.1f} us per disabled record"
+
+
+def test_instrumented_step_with_tracer_preserves_results():
+    """The tracing-overhead bench's workload (_instrumented_step with a
+    tracer) must change NOTHING about the step's math — only add ring
+    events alongside the registry observations."""
+    import jax
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu.obs.registry import Registry
+    from jama16_retina_tpu.obs.trace import Tracer
+
+    @jax.jit
+    def step(state, batch, key):
+        return state + batch.sum(), {"loss": state}
+
+    reg = Registry()
+    tr = Tracer(enabled=True, buffer_events=256)
+    wrapped, wrap_iter = bench._instrumented_step(step, reg, tracer=tr)
+    batch = jnp.ones((4,))
+    it = wrap_iter(lambda i: batch)
+    state = jnp.zeros(())
+    for i in range(5):
+        state, _ = wrapped(state, it(i), None)
+    assert float(state) == pytest.approx(20.0)
+    assert reg.counter("bench.steps").value == 5
+    assert reg.histogram("trainer.dispatch_s").count == 5
+    names = [e["name"] for e in tr.events()]
+    assert names.count("trainer.dispatch") == 5
+    assert names.count("trainer.input") == 5
+
+
 def test_timed_steps_counts_all_steps():
     """_timed_steps' fence discipline on CPU: a step that chains state
     through iterations yields a sane rate and the final state reflects

@@ -16,6 +16,7 @@ import pytest
 
 from jama16_retina_tpu.obs import export as obs_export
 from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
 from jama16_retina_tpu.obs.spans import StallClock, span
 from jama16_retina_tpu.serve.batcher import MicroBatcher
 from jama16_retina_tpu.utils.logging import read_jsonl
@@ -82,9 +83,13 @@ def test_registry_disabled_is_noop_everywhere():
     g.set(5)
     h.observe(1.0)
     assert c.value == 0.0 and g.value == 0.0 and h.count == 0
-    # span() on a disabled registry returns the SHARED no-op context
-    # (no allocation on the hot path).
-    assert span("x", reg) is span("y", reg)
+    # span() with both sinks disabled returns the SHARED no-op context
+    # (no allocation on the hot path). The tracer is injected for the
+    # same reason the registry is: the process defaults are enabled by
+    # any fit() earlier in the pytest session (ISSUE 4 upgraded span()
+    # to also feed the event timeline).
+    tr = obs_trace.Tracer(enabled=False)
+    assert span("x", reg, tracer=tr) is span("y", reg, tracer=tr)
     reg.enabled = True
     c.inc()
     assert c.value == 1.0

@@ -1,0 +1,928 @@
+"""Event tracing + black-box flight recorder (ISSUE 4): the per-thread
+ring buffer's wraparound under concurrent writers, Chrome trace-event
+JSON validity, the span()/StallClock upgrade with no call-site changes,
+the serve path's request-segment-sum property on an 8-device mesh, the
+FlightRecorder's four anomaly triggers (incl. NaN loss and SIGTERM
+through a real fit()), `_ProfilerWindow` --profile_steps parity +
+trigger-driven arm(), obs_report's trace conversion / slowest tables /
+--json output, and the # HELP/# TYPE exposition lines under a strict
+Prometheus parser."""
+
+import importlib.util
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.obs import export as obs_export
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
+from jama16_retina_tpu.obs.flightrec import FlightRecorder
+from jama16_retina_tpu.obs.spans import StallClock, span
+from jama16_retina_tpu.serve.batcher import MicroBatcher
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+pytestmark = [pytest.mark.obs, pytest.mark.trace]
+
+
+def _load_obs_report():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(repo, "scripts", "obs_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Tracer: ring buffers, disabled path, Chrome export
+# ---------------------------------------------------------------------------
+
+
+def test_trace_cm_records_complete_event():
+    tr = obs_trace.Tracer(enabled=True)
+    with tr.trace("work", {"k": 1}):
+        time.sleep(0.005)
+    tr.instant("marker")
+    evs = tr.events()
+    assert [e["name"] for e in evs] == ["work", "marker"]
+    x = evs[0]
+    assert x["ph"] == "X" and x["dur"] >= 4000  # us
+    assert x["args"] == {"k": 1}
+    assert evs[1]["ph"] == "i"
+
+
+def test_disabled_tracer_is_one_branch_noop():
+    """The disabled path: shared no-op context (no allocation), record
+    ops freeze, events() empty — what lets trace_enabled default on
+    under the 2% overhead pin."""
+    tr = obs_trace.Tracer(enabled=False)
+    assert tr.trace("a") is tr.trace("b")  # the SHARED no-op
+    with tr.trace("a"):
+        pass
+    tr.instant("i")
+    tr.begin("b")
+    tr.end("b")
+    tr.complete("c", 0.0, 1.0)
+    assert tr.events() == []
+    assert tr.dropped() == 0
+
+
+def test_ring_wraparound_under_concurrent_writers():
+    """ISSUE 4 satellite: N threads each hammer their OWN ring past
+    capacity; every thread keeps exactly its newest `cap` events (the
+    overwrite-oldest contract), dropped() accounts for the rest, and a
+    reader snapshotting DURING the writes neither crashes nor returns
+    torn events."""
+    cap, n_threads, per = 32, 4, 500
+    tr = obs_trace.Tracer(enabled=True, buffer_events=cap)
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        # Concurrent snapshots while writers are mid-wrap: every event
+        # returned must be well-formed (never a torn tuple).
+        while not stop.is_set():
+            for e in tr.events():
+                if not ("name" in e and "ts" in e and "ph" in e):
+                    torn.append(e)
+
+    def writer(t):
+        for i in range(per):
+            tr.instant(f"w{t}", {"seq": i})
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join()
+    assert torn == []
+    evs = tr.events()
+    assert len(evs) == n_threads * cap
+    # Per writer: exactly the newest `cap` sequence numbers survive.
+    for t in range(n_threads):
+        seqs = sorted(e["args"]["seq"] for e in evs
+                      if e["name"] == f"w{t}")
+        assert seqs == list(range(per - cap, per))
+    assert tr.dropped() == n_threads * (per - cap)
+    # Merged timeline is oldest-first.
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_events_last_n_keeps_newest():
+    tr = obs_trace.Tracer(enabled=True, buffer_events=64)
+    for i in range(10):
+        tr.instant("e", {"i": i})
+    tail = tr.events(last_n=3)
+    assert [e["args"]["i"] for e in tail] == [7, 8, 9]
+
+
+def test_configure_rearms_and_clears_rings():
+    """configure() is the run-scoping twin of Registry.reset(): knobs
+    applied, every ring cleared, and the SAME thread lazily picks up a
+    fresh ring (generation counter) — member m's blackbox must not
+    replay member m-1's tail."""
+    tr = obs_trace.Tracer(enabled=True, buffer_events=8)
+    tr.instant("old")
+    assert len(tr.events()) == 1
+    tr.configure(buffer_events=4)
+    assert tr.events() == []
+    tr.instant("new")  # same thread, new generation
+    evs = tr.events()
+    assert [e["name"] for e in evs] == ["new"]
+    assert tr.buffer_events == 4
+    tr.configure(enabled=False)
+    tr.instant("muted")
+    assert tr.events() == []
+
+
+def test_chrome_json_valid_and_loadable(tmp_path):
+    """ISSUE 4 satellite: the export is the Chrome trace-event JSON
+    object format — json.loads-able, every event carrying the required
+    ph/ts/pid/tid keys (what Perfetto / chrome://tracing validate)."""
+    tr = obs_trace.Tracer(enabled=True)
+    with tr.trace("outer", {"step": 1}):
+        tr.instant("inside")
+    tr.begin("phase")
+    tr.end("phase")
+    path = str(tmp_path / "chrome.json")
+    obs_trace.write_chrome_json(path, tr.events())
+    with open(path) as f:
+        data = json.loads(f.read())
+    assert data["displayTimeUnit"] == "ms"
+    evs = data["traceEvents"]
+    assert len(evs) == 4
+    for e in evs:
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            assert key in e, (key, e)
+        assert e["pid"] == os.getpid()
+        assert e["ts"] >= 0
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 1 and xs[0]["dur"] >= 0
+    assert {e["ph"] for e in evs} == {"X", "i", "B", "E"}
+
+
+# ---------------------------------------------------------------------------
+# span()/StallClock upgrade: trace events with no call-site changes
+# ---------------------------------------------------------------------------
+
+
+def test_span_upgrades_to_trace_event_without_callsite_changes():
+    """The tentpole's no-call-site-change contract: the SAME span()
+    call emits a registry observation, a trace event, or both,
+    depending only on what is enabled — and the both-disabled path is
+    still the shared no-op."""
+    reg_off = obs_registry.Registry(enabled=False)
+    reg_on = obs_registry.Registry()
+    tr = obs_trace.Tracer(enabled=True)
+
+    prev = obs_trace.set_default_tracer(tr)
+    try:
+        with span("seg", reg_off):  # registry muted, tracer on
+            time.sleep(0.002)
+        assert reg_off.histogram("seg").count == 0
+        evs = tr.events()
+        assert [e["name"] for e in evs] == ["seg"]
+        assert evs[0]["ph"] == "X" and evs[0]["dur"] >= 1000
+
+        with span("seg", reg_on):  # both on: histogram AND event
+            pass
+        assert reg_on.histogram("seg").count == 1
+        assert len(tr.events()) == 2
+
+        tr.configure(enabled=False)
+        off = obs_registry.Registry(enabled=False)
+        assert span("a", off) is span("b", off)  # both off -> shared no-op
+    finally:
+        obs_trace.set_default_tracer(prev)
+
+
+def test_stall_clock_segments_land_in_timeline():
+    """Each measured StallClock segment doubles as a trainer.<kind>
+    complete event whose duration matches the fields() attribution —
+    per-step causality in Perfetto, same numbers as the train record."""
+    reg = obs_registry.Registry()
+    tr = obs_trace.Tracer(enabled=True)
+    sc = StallClock(reg, tracer=tr)
+    with sc.measure("input"):
+        time.sleep(0.01)
+    with sc.measure("dispatch"):
+        time.sleep(0.002)
+    f = sc.fields()
+    evs = {e["name"]: e for e in tr.events()}
+    assert set(evs) == {"trainer.input", "trainer.dispatch"}
+    assert evs["trainer.input"]["dur"] / 1e6 == pytest.approx(
+        f["input_wait_sec"], abs=1e-4
+    )
+    assert evs["trainer.dispatch"]["dur"] / 1e6 == pytest.approx(
+        f["dispatch_sec"], abs=1e-4
+    )
+
+
+def test_obs_begin_run_configures_tracer():
+    """trainer._obs_begin_run applies the ObsConfig trace knobs to the
+    process tracer and clears prior-run events (the sequential-ensemble
+    run-scoping rule, extended to tracing)."""
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config, override
+
+    prev_reg = obs_registry.set_default_registry(obs_registry.Registry())
+    prev_tr = obs_trace.set_default_tracer(obs_trace.Tracer())
+    try:
+        tr = obs_trace.default_tracer()
+        tr.configure(enabled=True)
+        tr.instant("member0-leftover")
+        cfg = override(get_config("smoke"), ["obs.trace_buffer_events=128"])
+        trainer._obs_begin_run(cfg)
+        assert tr.enabled is True  # smoke defaults: obs on, tracing on
+        assert tr.buffer_events == 128
+        assert tr.events() == []  # prior run's tail cleared
+
+        trainer._obs_begin_run(
+            override(get_config("smoke"), ["obs.trace_enabled=false"])
+        )
+        assert tr.enabled is False
+    finally:
+        obs_registry.set_default_registry(prev_reg)
+        obs_trace.set_default_tracer(prev_tr)
+
+
+# ---------------------------------------------------------------------------
+# Serve: request segments sum to the recorded latency (8-device mesh)
+# ---------------------------------------------------------------------------
+
+
+_REQ_SEGMENTS = ("queue_wait", "window_fill", "device", "resolve")
+
+
+def _segment_totals(events):
+    """{trace_id: {segment: dur_s, 'total': sum}} from raw events."""
+    by_id = {}
+    for e in events:
+        name = e.get("name", "")
+        if not name.startswith("serve.request."):
+            continue
+        seg = name[len("serve.request."):]
+        by_id.setdefault(e["args"]["trace_id"], {})[seg] = e["dur"] / 1e6
+    for segs in by_id.values():
+        segs["total"] = sum(segs[s] for s in _REQ_SEGMENTS)
+    return by_id
+
+
+def test_request_segments_sum_to_latency_on_mesh():
+    """ISSUE 4 acceptance: on an 8-device mesh serve path, every
+    request's queue-wait/window-fill/device/resolve trace segments are
+    contiguous (each starts where the previous ended) and their sum
+    equals the serve.request_latency_s observation — one clock, so the
+    tiling is exact up to the export's microsecond rounding."""
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import ServeConfig, get_config, override
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg = override(get_config("smoke"), ["model.image_size=32"])
+    cfg = cfg.replace(serve=ServeConfig(max_batch=8, bucket_sizes=(8,)))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [0, 1])
+    state = jax.device_get(state)
+    mesh = mesh_lib.make_mesh()
+    assert int(np.prod(list(mesh.shape.values()))) == 8  # the conftest mesh
+    reg = obs_registry.Registry()
+    tr = obs_trace.Tracer(enabled=True)
+    engine = ServingEngine(cfg, model=model, state=state, mesh=mesh,
+                           registry=reg)
+    imgs = np.random.default_rng(0).integers(
+        0, 256, (6, 32, 32, 3), np.uint8
+    )
+    b = MicroBatcher(
+        engine.probs, max_batch=8, max_wait_ms=10.0, autostart=False,
+        registry=reg, tracer=tr,
+    )
+    futs = [b.submit(imgs[i:i + 2]) for i in range(0, 6, 2)]
+    b.start()
+    for f in futs:
+        assert f.result(timeout=120).shape[0] == 2
+    b.close()
+
+    evs = tr.events()
+    by_id = _segment_totals(evs)
+    assert len(by_id) == 3  # one trace id per request, no aliasing
+    for segs in by_id.values():
+        assert set(segs) == {*_REQ_SEGMENTS, "total"}
+    # Contiguity: within a request, segment k+1 starts where k ends
+    # (raw ts+dur in us; rounding tolerance only).
+    for tid in by_id:
+        req = sorted(
+            (e for e in evs if e.get("args", {}).get("trace_id") == tid),
+            key=lambda e: _REQ_SEGMENTS.index(
+                e["name"][len("serve.request."):]
+            ),
+        )
+        for a, bnext in zip(req, req[1:]):
+            assert a["ts"] + a["dur"] == pytest.approx(
+                bnext["ts"], abs=1e-2
+            )
+    # The sum property against the histogram the batcher ALREADY feeds:
+    # total latency across requests == summed segment durations.
+    h = reg.histogram("serve.request_latency_s").snapshot()
+    assert h["count"] == 3
+    segment_sum = sum(segs["total"] for segs in by_id.values())
+    assert segment_sum == pytest.approx(h["sum"], abs=1e-4)
+
+
+def test_serving_engine_applies_trace_config_to_default_tracer():
+    """A pure serving process never runs trainer._obs_begin_run: the
+    engine itself must apply obs.trace_enabled to the process tracer
+    (same rule as the registry), or request segments silently vanish."""
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import ServeConfig, get_config, override
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg = override(get_config("smoke"), ["model.image_size=32"])
+    cfg = cfg.replace(serve=ServeConfig(max_batch=4, bucket_sizes=(4,)))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [0])
+    state = jax.device_get(state)
+    prev_reg = obs_registry.set_default_registry(obs_registry.Registry())
+    prev_tr = obs_trace.set_default_tracer(obs_trace.Tracer())
+    try:
+        assert obs_trace.default_tracer().enabled is False
+        ServingEngine(cfg, model=model, state=state)
+        assert obs_trace.default_tracer().enabled is True
+        off = override(cfg, ["obs.trace_enabled=false"])
+        ServingEngine(off, model=model, state=state)
+        assert obs_trace.default_tracer().enabled is False
+    finally:
+        obs_registry.set_default_registry(prev_reg)
+        obs_trace.set_default_tracer(prev_tr)
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder: triggers, rate limit, dump completeness
+# ---------------------------------------------------------------------------
+
+
+def _recorder(tmp_path, **kw):
+    reg = obs_registry.Registry()
+    reg.counter("data.decode.records").inc(42)
+    tr = obs_trace.Tracer(enabled=True)
+    tr.instant("before-anomaly", {"step": 1})
+    fr = FlightRecorder(
+        str(tmp_path), config={"name": "t", "steps": 8},
+        registry=reg, tracer=tr, **kw,
+    )
+    return fr, reg, tr
+
+
+def _assert_complete_dump(d, reason, step=None):
+    """ISSUE 4 acceptance: a dump carries trace events + registry
+    snapshot + config (+ meta), all parseable."""
+    assert os.path.basename(d).endswith(reason)
+    with open(os.path.join(d, "trace.jsonl")) as f:
+        evs = [json.loads(line) for line in f]
+    assert evs and all("ph" in e and "ts" in e for e in evs)
+    with open(os.path.join(d, "registry.json")) as f:
+        snap = json.load(f)
+    assert snap["counters"]["data.decode.records"] == 42
+    with open(os.path.join(d, "config.json")) as f:
+        assert json.load(f)["name"] == "t"
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["reason"] == reason
+    assert meta["n_trace_events"] == len(evs)
+    if step is not None:
+        assert meta["step"] == step
+    return evs, meta
+
+
+def test_note_loss_dumps_once_per_run(tmp_path):
+    fr, _, _ = _recorder(tmp_path)
+    assert fr.note_loss(0.5) is False
+    assert os.listdir(str(tmp_path)) == []  # no dump dir until a trigger
+    assert fr.note_loss(float("nan"), step=7) is True
+    [d] = fr.dumps
+    _assert_complete_dump(d, "nonfinite_loss", step=7)
+    # Rate limit: the FIRST occurrence carries the interesting state.
+    assert fr.note_loss(float("inf"), step=8) is False
+    assert len(fr.dumps) == 1
+
+
+def test_note_loss_catches_any_member_in_array(tmp_path):
+    """fit_ensemble_parallel passes the per-member loss vector: one
+    diverging member must not hide in the mean."""
+    fr, _, _ = _recorder(tmp_path)
+    assert fr.note_loss(np.array([0.4, 0.5])) is False
+    assert fr.note_loss(np.array([0.4, np.inf])) is True
+
+
+def test_slow_step_trigger_uses_rolling_median(tmp_path):
+    fired = []
+    fr, _, _ = _recorder(tmp_path, slow_step_factor=3.0,
+                         profile_hook=lambda: fired.append(1))
+    # Warmup: no verdicts before the median exists (MIN_STEP_SAMPLES,
+    # refreshed every 16 appends) — a slow first step is not anomalous.
+    assert fr.note_step_time(0.5) is False
+    for _ in range(20):
+        assert fr.note_step_time(0.01) is False
+    assert fr.note_step_time(0.2, step=22) is True  # 20x median
+    [d] = fr.dumps
+    _, meta = _assert_complete_dump(d, "slow_step", step=22)
+    assert meta["rolling_median_sec"] == pytest.approx(0.01, abs=0.05)
+    # Per-reason rate limit + once-per-run profiler capture.
+    assert fr.note_step_time(0.3) is False
+    assert fired == [1]
+
+
+def test_profile_hook_fires_at_most_once_across_triggers(tmp_path):
+    fired = []
+    fr, _, _ = _recorder(tmp_path, profile_hook=lambda: fired.append(1))
+    for _ in range(20):
+        fr.note_step_time(0.01)
+    fr.note_step_time(1.0)   # slow-step anomaly -> capture
+    fr.note_loss(float("nan"))  # second anomaly: dump yes, capture no
+    assert len(fr.dumps) == 2
+    assert fired == [1]
+
+
+def test_record_exception_dump(tmp_path):
+    fr, _, _ = _recorder(tmp_path)
+    d = fr.record_exception(ValueError("boom"))
+    evs, meta = _assert_complete_dump(d, "exception")
+    assert "ValueError: boom" in meta["error"]
+
+
+def test_sigterm_handler_converts_to_inband_exception(tmp_path):
+    """install_signal_handlers: SIGTERM raises SystemExit(143) in the
+    main thread (the dump then runs in normal context, never inside an
+    async signal frame), and uninstall restores the previous handler."""
+    fr, _, _ = _recorder(tmp_path)
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    fr.install_signal_handlers()
+    try:
+        with pytest.raises(SystemExit) as ei:
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(1.0)  # deliver the pending signal
+        assert ei.value.code == 128 + signal.SIGTERM
+        d = fr.record_exception(ei.value)
+        _, meta = _assert_complete_dump(d, "sigterm")
+        assert meta["signal"] == int(signal.SIGTERM)
+    finally:
+        fr.uninstall_signal_handlers()
+    assert signal.getsignal(signal.SIGTERM) is prev_handler
+
+
+def test_disabled_recorder_is_noop(tmp_path):
+    fr, _, _ = _recorder(tmp_path, enabled=False)
+    assert fr.note_loss(float("nan")) is False
+    assert fr.note_step_time(100.0) is False
+    assert fr.record_exception(RuntimeError("x")) is None
+    fr.install_signal_handlers()  # no-op: no handler swapped in
+    assert not os.path.exists(fr.blackbox_dir)
+
+
+# ---------------------------------------------------------------------------
+# fit(): injected NaN loss and SIGTERM produce complete dumps
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trace_data(tmp_path_factory):
+    from jama16_retina_tpu.data import tfrecord
+
+    data_dir = str(tmp_path_factory.mktemp("trace_data"))
+    tfrecord.write_synthetic_split(data_dir, "train", 32, 32, 2, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 8, 32, 1, seed=2)
+    return data_dir
+
+
+def _trace_cfg():
+    from jama16_retina_tpu.configs import get_config, override
+
+    return override(get_config("smoke"), [
+        "model.image_size=32",
+        "train.steps=4", "train.eval_every=4", "train.log_every=2",
+        "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+        "obs.flush_every_s=0",
+    ])
+
+
+def _fit_with_step_tap(cfg, data_dir, workdir, tap, monkeypatch):
+    """Run trainer.fit with the real train step wrapped so ``tap`` sees
+    (call_index, metrics_dict) and may rewrite the metrics — the
+    injection point for NaN loss / mid-run signals."""
+    from jama16_retina_tpu import train_lib, trainer
+
+    real_factory = train_lib.make_train_step
+    calls = {"n": 0}
+
+    def factory(*a, **kw):
+        real_step = real_factory(*a, **kw)
+
+        def wrapped(state, batch, key):
+            state, m = real_step(state, batch, key)
+            calls["n"] += 1
+            m = tap(calls["n"], dict(m))
+            return state, m
+
+        return wrapped
+
+    monkeypatch.setattr(train_lib, "make_train_step", factory)
+    prev_reg = obs_registry.set_default_registry(obs_registry.Registry())
+    prev_tr = obs_trace.set_default_tracer(obs_trace.Tracer())
+    try:
+        trainer.fit(cfg, data_dir, workdir, seed=0)
+    finally:
+        obs_registry.set_default_registry(prev_reg)
+        obs_trace.set_default_tracer(prev_tr)
+
+
+def _assert_jsonl_uncorrupted(workdir):
+    """Every line of the run's metrics.jsonl parses — a dump mid-run
+    must never tear the log (it writes only under blackbox/)."""
+    path = os.path.join(workdir, "metrics.jsonl")
+    with open(path) as f:
+        raw = [line for line in f if line.strip()]
+    assert raw
+    parsed = [json.loads(line) for line in raw]  # raises on a torn line
+    assert len(parsed) == len(read_jsonl(path))
+    return parsed
+
+
+def test_fit_nan_loss_produces_blackbox_dump(trace_data, tmp_path,
+                                             monkeypatch):
+    """ISSUE 4 acceptance: an injected NaN loss mid-fit dumps a
+    complete blackbox (trace events incl. the trainer's StallClock
+    segments + registry snapshot + config) and the run's JSONL stays
+    intact — training continues (a bad loss is a signal, not a crash)."""
+
+    def tap(call, m):
+        if call == 2:  # lands on the step-2 log boundary
+            m["loss"] = np.float32(np.nan)
+        return m
+
+    workdir = str(tmp_path / "run")
+    _fit_with_step_tap(_trace_cfg(), trace_data, workdir, tap, monkeypatch)
+
+    dumps = sorted(os.listdir(os.path.join(workdir, "blackbox")))
+    assert len(dumps) == 1 and dumps[0].endswith("nonfinite_loss")
+    d = os.path.join(workdir, "blackbox", dumps[0])
+    with open(os.path.join(d, "trace.jsonl")) as f:
+        evs = [json.loads(line) for line in f]
+    # The tentpole end to end: span()/StallClock call sites landed in
+    # the dumped timeline with no call-site changes.
+    names = {e["name"] for e in evs}
+    assert "trainer.input" in names and "trainer.dispatch" in names
+    with open(os.path.join(d, "config.json")) as f:
+        assert json.load(f)["train"]["steps"] == 4
+    with open(os.path.join(d, "registry.json")) as f:
+        assert "counters" in json.load(f)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["reason"] == "nonfinite_loss" and meta["step"] == 2
+
+    recs = _assert_jsonl_uncorrupted(workdir)
+    # The run FINISHED: all 4 steps trained, eval + checkpoint landed.
+    assert [r["step"] for r in recs if r["kind"] == "train"] == [2, 4]
+    assert any(r["kind"] == "eval" for r in recs)
+
+
+def test_fit_sigterm_produces_blackbox_dump(trace_data, tmp_path,
+                                            monkeypatch):
+    """ISSUE 4 acceptance: SIGTERM mid-fit lands as SystemExit through
+    the loop's except path, dumps a complete blackbox, restores the
+    previous signal handler, and leaves the JSONL parseable."""
+    prev_handler = signal.getsignal(signal.SIGTERM)
+
+    def tap(call, m):
+        if call == 3:
+            # Delivered at the next bytecode boundary — inside the
+            # train loop, where the recorder's handlers are installed.
+            os.kill(os.getpid(), signal.SIGTERM)
+        return m
+
+    workdir = str(tmp_path / "run")
+    with pytest.raises(SystemExit) as ei:
+        _fit_with_step_tap(
+            _trace_cfg(), trace_data, workdir, tap, monkeypatch
+        )
+    assert ei.value.code == 128 + signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev_handler
+
+    dumps = sorted(os.listdir(os.path.join(workdir, "blackbox")))
+    assert len(dumps) == 1 and dumps[0].endswith("sigterm")
+    d = os.path.join(workdir, "blackbox", dumps[0])
+    for name in ("trace.jsonl", "registry.json", "config.json",
+                 "meta.json"):
+        assert os.path.exists(os.path.join(d, name)), name
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["reason"] == "sigterm"
+    assert meta["signal"] == int(signal.SIGTERM)
+
+    recs = _assert_jsonl_uncorrupted(workdir)
+    assert [r["step"] for r in recs if r["kind"] == "train"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# _ProfilerWindow: --profile_steps parity + trigger-driven arm()
+# ---------------------------------------------------------------------------
+
+
+class _FakeProfiler:
+    """Stub jax.profiler: records start/stop instead of tracing."""
+
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+@pytest.fixture()
+def fake_profiler(monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+def _drive(pw, steps, arm_at=None, arm_n=2):
+    """Simulate the train loop's before/after calls; returns the list
+    of step indices at which a capture was OPEN."""
+    open_steps = []
+    for i in range(steps):
+        if arm_at is not None and i == arm_at:
+            assert pw.arm(arm_n)
+        pw.before_step(i)
+        if pw._tracing:
+            open_steps.append(i)
+        pw.after_step(i, np.zeros(()))
+    pw.finalize()
+    return open_steps
+
+
+def test_profiler_window_profile_steps_parity(tmp_path, fake_profiler):
+    """ISSUE 4 satellite: --profile_steps behavior is UNCHANGED by the
+    arm() generalization — same planned window (skip 10 warmup steps,
+    clamp inside short runs, skip when nothing fits), one start/stop
+    pair, same `profile` record with no trigger field."""
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.utils.logging import RunLog
+
+    cfg = override(get_config("smoke"), [
+        "train.steps=20", "train.profile_steps=3",
+    ])
+    log = RunLog(str(tmp_path))
+    pw = trainer._ProfilerWindow(cfg, log, str(tmp_path), start_step=0)
+    open_steps = _drive(pw, 20)
+    assert open_steps == [10, 11, 12]  # skip-warmup rule: start+10
+    assert [c[0] for c in fake_profiler.calls] == ["start", "stop"]
+    log.close()
+    recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+    [prof] = [r for r in recs if r["kind"] == "profile"]
+    assert prof["steps"] == 3
+    assert "trigger" not in prof
+
+    # Short run: the window clamps to the end (seed behavior).
+    short = override(get_config("smoke"), [
+        "train.steps=5", "train.profile_steps=3",
+    ])
+    log2 = RunLog(str(tmp_path / "short"))
+    pw2 = trainer._ProfilerWindow(short, log2, str(tmp_path / "short"), 0)
+    assert _drive(pw2, 5) == [2, 3, 4]
+    log2.close()
+
+
+def test_profiler_window_arm_triggers_short_capture(tmp_path,
+                                                    fake_profiler):
+    """arm(n): a trigger-driven capture opens at the next step boundary
+    and the `profile` record carries trigger=anomaly — with no
+    --profile_steps window configured at all."""
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.utils.logging import RunLog
+
+    cfg = override(get_config("smoke"), [
+        "train.steps=20", "train.profile_steps=0",
+    ])
+    log = RunLog(str(tmp_path))
+    pw = trainer._ProfilerWindow(cfg, log, str(tmp_path), start_step=0)
+    open_steps = _drive(pw, 12, arm_at=5, arm_n=2)
+    assert open_steps == [5, 6]
+    log.close()
+    recs = read_jsonl(str(tmp_path / "metrics.jsonl"))
+    [prof] = [r for r in recs if r["kind"] == "profile"]
+    assert prof["steps"] == 2 and prof["trigger"] == "anomaly"
+
+
+def test_profiler_window_arm_refused_while_open(tmp_path, fake_profiler):
+    """An anomaly INSIDE the fixed --profile_steps window must not
+    double-start the profiler; a second arm while one is pending is
+    refused too."""
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.utils.logging import RunLog
+
+    cfg = override(get_config("smoke"), [
+        "train.steps=20", "train.profile_steps=4",
+    ])
+    log = RunLog(str(tmp_path))
+    pw = trainer._ProfilerWindow(cfg, log, str(tmp_path), start_step=0)
+    for i in range(11):
+        pw.before_step(i)
+        pw.after_step(i, np.zeros(()))
+    assert pw._tracing  # inside the fixed window (steps 10..13)
+    assert pw.arm(2) is False
+    pw.finalize()
+    assert pw.arm(2) is True
+    assert pw.arm(2) is False  # pending request
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# obs_report: --trace-out, slowest tables, --json
+# ---------------------------------------------------------------------------
+
+
+def _dump_with_serve_and_train_events(tmp_path):
+    """A blackbox dump whose timeline carries 2 serve requests and 2
+    trainer steps with known segment durations (seconds)."""
+    reg = obs_registry.Registry()
+    tr = obs_trace.Tracer(enabled=True)
+    t = 100.0
+    for tid, scale in ((1, 1.0), (2, 3.0)):  # request 2 is 3x slower
+        args = {"trace_id": tid, "rows": 4}
+        for seg, dur in (("queue_wait", 0.001), ("window_fill", 0.002),
+                         ("device", 0.010), ("resolve", 0.001)):
+            tr.complete(f"serve.request.{seg}", t, t + dur * scale, args)
+            t += dur * scale
+    for dur_in, dur_disp in ((0.005, 0.020), (0.050, 0.020)):
+        tr.complete("trainer.input", t, t + dur_in)
+        t += dur_in
+        tr.complete("trainer.dispatch", t, t + dur_disp)
+        t += dur_disp
+    fr = FlightRecorder(str(tmp_path), config={"name": "t"},
+                        registry=reg, tracer=tr)
+    return fr.dump("manual")
+
+
+def test_obs_report_trace_out_converts_dump(tmp_path, capsys):
+    rep = _load_obs_report()
+    d = _dump_with_serve_and_train_events(tmp_path)
+    out_json = str(tmp_path / "chrome.json")
+    assert rep.main([d, "--trace-out", out_json]) == 0
+    with open(out_json) as f:
+        data = json.load(f)
+    evs = data["traceEvents"]
+    assert len(evs) == 12
+    for e in evs:
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            assert key in e
+    # The workdir form resolves blackbox/<newest>/trace.jsonl itself.
+    out2 = str(tmp_path / "chrome2.json")
+    assert rep.main([str(tmp_path), "--trace-out", out2]) == 0
+    assert rep.main([str(tmp_path / "nothing-here"),
+                     "--trace-out", str(tmp_path / "x.json")]) == 2
+
+
+def test_obs_report_slowest_tables(tmp_path, capsys):
+    rep = _load_obs_report()
+    d = _dump_with_serve_and_train_events(tmp_path)
+    assert rep.main([d]) == 0
+    out = capsys.readouterr().out
+    assert "slowest 2 serve requests" in out
+    assert "slowest 2 trainer steps" in out
+
+    events = rep.load_trace_events(os.path.join(d, "trace.jsonl"))
+    reqs = rep.slowest_requests(events)
+    assert [r["trace_id"] for r in reqs] == [2, 1]  # slowest first
+    assert reqs[0]["total_ms"] == pytest.approx(42.0, abs=0.1)
+    assert reqs[0]["device_ms"] == pytest.approx(30.0, abs=0.1)
+    steps = rep.slowest_steps(events)
+    assert len(steps) == 2
+    assert steps[0]["input_ms"] == pytest.approx(50.0, abs=0.1)
+    assert steps[0]["total_ms"] == pytest.approx(70.0, abs=0.1)
+
+
+def test_obs_report_json_output_for_run_and_dump(tmp_path, capsys):
+    """--json: one machine-readable object per report form (the CI
+    consumption satellite)."""
+    rep = _load_obs_report()
+    d = _dump_with_serve_and_train_events(tmp_path / "w")
+    assert rep.main([d, "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["n_events"] == 12
+    assert [r["trace_id"] for r in obj["slowest_requests"]] == [2, 1]
+    assert len(obj["slowest_steps"]) == 2
+
+    # A run workdir: stalls + heartbeats + the dump it carries.
+    workdir = str(tmp_path / "w")
+    now = time.time()
+    with open(os.path.join(workdir, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "train", "step": 2, "window_sec": 1.0,
+            "input_wait_sec": 0.6, "dispatch_sec": 0.2,
+            "pause_sec": 0.1, "other_sec": 0.1,
+        }) + "\n")
+        f.write(json.dumps({
+            "kind": "heartbeat", "t": now, "process_index": 0,
+            "step": 2, "last_progress_t": now,
+        }) + "\n")
+    assert rep.main([workdir, "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["stalls"]["windows"] == 1
+    assert obj["stalls"]["input_wait_sec"] == pytest.approx(0.6)
+    assert obj["heartbeats"]["p0"]["step"] == 2
+    assert obj["slowest_requests"]  # the blackbox dump was picked up
+
+    # And the human rendering includes the trace section.
+    assert rep.main([workdir]) == 0
+    out = capsys.readouterr().out
+    assert "stall attribution" in out and "slowest" in out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus # HELP/# TYPE lines (strict-parser satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_help_lines_scrape_parse_strict():
+    """export.prometheus_text renders the registry's help: strings as
+    # HELP lines that a strict scrape parser accepts, with HELP
+    immediately before TYPE and exposition escaping applied."""
+    parser = pytest.importorskip("prometheus_client.parser")
+
+    reg = obs_registry.Registry()
+    reg.counter("serve.engine.rows",
+                help="rows forwarded through the engine").inc(7)
+    reg.gauge("serve.batcher.queue_depth",
+              help="requests waiting\nto coalesce").set(3)
+    reg.histogram("serve.request_latency_s", buckets=(0.1, 1.0),
+                  help="submit -> resolved").observe(0.05)
+    reg.counter("bench.steps").inc()  # no help: TYPE line only
+
+    text = obs_export.prometheus_text(reg.snapshot())
+    lines = text.splitlines()
+    for metric in ("serve_engine_rows", "serve_batcher_queue_depth",
+                   "serve_request_latency_s"):
+        h = lines.index(f"# HELP {metric} " + {
+            "serve_engine_rows": "rows forwarded through the engine",
+            "serve_batcher_queue_depth": "requests waiting\\nto coalesce",
+            "serve_request_latency_s": "submit -> resolved",
+        }[metric])
+        assert lines[h + 1].startswith(f"# TYPE {metric} ")
+    assert not any(line.startswith("# HELP bench_steps") for line in lines)
+
+    fams = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    assert fams["serve_engine_rows"].documentation == (
+        "rows forwarded through the engine"
+    )
+    assert fams["serve_engine_rows"].type == "counter"
+    assert fams["serve_batcher_queue_depth"].documentation == (
+        "requests waiting\nto coalesce"
+    )
+    hist = fams["serve_request_latency_s"]
+    assert hist.type == "histogram"
+    samples = {s.name: s for s in hist.samples
+               if not s.labels.get("le")}
+    assert samples["serve_request_latency_s_count"].value == 1
+    assert samples["serve_request_latency_s_sum"].value == pytest.approx(
+        0.05
+    )
+
+
+def test_batcher_metrics_carry_help_strings():
+    """The serve metrics the dashboards scrape ship with help: text
+    (the registry stores it; the .prom snapshot renders it)."""
+    reg = obs_registry.Registry()
+    MicroBatcher(lambda rows: rows, max_batch=2, autostart=False,
+                 registry=reg).close()
+    snap = reg.snapshot()
+    assert "serve.request_latency_s" in snap["help"]
+    assert "serve.batcher.queue_depth" in snap["help"]
+    # And the JSONL telemetry record shape stays one line: flush drops
+    # the help map (it is .prom-only).
+    text = obs_export.prometheus_text(snap)
+    assert "# HELP serve_request_latency_s" in text
