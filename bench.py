@@ -85,6 +85,15 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        ring-buffer trace events. Same ≤2% pin against
                        the uninstrumented headline — the contract that
                        lets obs.trace_enabled default on.
+  device_only_quality / quality_overhead_pct / quality_overhead_ok
+                     — the same window with the model-quality drift
+                       monitor (obs/quality.py; ISSUE 5) observing one
+                       host batch of images+scores per step (score
+                       binning, per-image input statistics, windowed
+                       PSI). Same ≤2% pin (_quality_overhead_guard):
+                       the contract that makes obs.quality safe to
+                       enable on a serving fleet. Disabled is one
+                       branch, strictly cheaper.
 
 Workload = the production config of record (BASELINE.json:7): Inception-v3,
 binary head, 299x299, global batch 32, aux head on, bf16 compute — the
@@ -386,6 +395,18 @@ def _tracing_overhead_guard(extras: dict, rate_on: float,
                             rate_off: float,
                             max_overhead: float = 0.02) -> bool:
     return _overhead_guard(extras, "tracing", rate_on, rate_off,
+                           max_overhead)
+
+
+def _quality_overhead_guard(extras: dict, rate_on: float,
+                            rate_off: float,
+                            max_overhead: float = 0.02) -> bool:
+    """ISSUE 5's pin: the drift monitor's per-batch observe (score
+    binning + per-image input statistics + windowed PSI publication)
+    enabled must stay within 2% of device_only — the contract that
+    makes obs.quality safe to enable on a production serving fleet.
+    The disabled path is strictly cheaper (one branch)."""
+    return _overhead_guard(extras, "quality", rate_on, rate_off,
                            max_overhead)
 
 
@@ -729,6 +750,56 @@ def main() -> None:
                 _tracing_overhead_guard(extras, rate_tr, device_only)
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"tracing overhead bench failed: {type(e).__name__}: {e}")
+
+    # Quality-monitor overhead pin (ISSUE 5): the same device_only
+    # window with a QualityMonitor observing one host batch of images +
+    # scores per step — the per-batch cost the serving engine pays when
+    # obs.quality is enabled (input-stat extraction dominates; PSI math
+    # runs only at window boundaries, which this window crosses).
+    if not headline_serialized:
+        try:
+            import dataclasses as _dc
+
+            from jama16_retina_tpu.configs import QualityConfig
+            from jama16_retina_tpu.obs import quality as quality_lib
+            from jama16_retina_tpu.obs.registry import Registry
+
+            qrng = np.random.default_rng(11)
+            qsize = cfg.model.image_size
+            qimgs = qrng.integers(
+                0, 256, (batch_size, qsize, qsize, 3), np.uint8
+            )
+            qscores = qrng.random(batch_size)
+            profile = quality_lib.build_profile(
+                qrng.random(4096),
+                stat_values=quality_lib.input_stat_values(qimgs),
+                thresholds=[{"threshold": 0.5}],
+            )
+            monitor = quality_lib.QualityMonitor(
+                _dc.replace(QualityConfig(), enabled=True,
+                            window_scores=batch_size * 4),
+                registry=Registry(), profile=profile,
+            )
+
+            def quality_step(s, batch, k):
+                out = step(s, batch, k)
+                monitor.observe(qimgs, qscores)
+                return out
+
+            rate_q, state = _timed_steps(
+                quality_step, state,
+                lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_q = _publish(
+                extras, "device_only_quality", rate_q,
+                flops_per_image, peak,
+                suffix=" (device_only + quality-monitor observe per batch)",
+            )
+            if rate_q is not None:
+                _quality_overhead_guard(extras, rate_q, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"quality overhead bench failed: {type(e).__name__}: {e}")
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.
     aug_imgs = jax.device_put(batches[0]["image"])

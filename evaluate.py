@@ -63,6 +63,15 @@ _SAVE_PROBS = flags.DEFINE_string(
     "write per-image ensemble-averaged probabilities (name, grade, "
     "prob[, per-class]) to this CSV for error analysis / recalibration",
 )
+_PROFILE_OUT = flags.DEFINE_string(
+    "profile_out", "",
+    "write the quality-observability reference profile (obs/quality.py: "
+    "score histogram, input-statistic histograms, base rate, operating "
+    "thresholds) for this checkpoint set on --split to this JSON — the "
+    "artifact serving's online drift monitor (obs.quality.profile_path) "
+    "compares live traffic against. Emit it on the split the thresholds "
+    "were chosen on (normally --split=val)",
+)
 _DEVICE = flags.DEFINE_enum(
     "device", "tpu", ["tpu", "cpu", "tf"],
     "backend gate (BASELINE.json:5): tpu/cpu run the Flax model under jit "
@@ -121,6 +130,7 @@ def main(argv):
         bootstrap=_BOOTSTRAP.value,
         save_probs=_SAVE_PROBS.value or None,
         calibrate=_CALIBRATE.value,
+        profile_out=_PROFILE_OUT.value or None,
     )
     print(json.dumps(report, indent=2))
 

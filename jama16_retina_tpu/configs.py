@@ -312,6 +312,69 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class QualityConfig:
+    """Model/data-quality observability (obs/quality.py, obs/alerts.py;
+    ISSUE 5) — the layer that watches the quantities the paper actually
+    reports (score distribution, input statistics, operating-point
+    behavior) instead of infra health.
+
+    Off by default: unlike the registry/tracer (whose cost is a branch),
+    the monitor needs a reference profile artifact to compare against
+    (``profile_path``, written by ``evaluate.py --profile_out`` or the
+    trainer's ``profile_out``). When disabled the serve hot path pays
+    exactly one branch per request (pinned by bench.py's
+    quality_overhead_pct guard when enabled: <= 2% of device_only).
+    """
+
+    enabled: bool = False
+    # Reference-profile artifact to drift-check against (JSON written by
+    # evaluate.py --profile_out / trainer profile_out). Empty + enabled
+    # = positive-rate/canary monitoring only, no PSI.
+    profile_path: str = ""
+    # Trainer end-of-fit: write the run's own reference profile (val
+    # split score/input histograms + operating thresholds) here. The
+    # canonical profile for a SERVED checkpoint is evaluate.py
+    # --profile_out on that checkpoint; this knob captures the final
+    # train state without a separate eval invocation.
+    profile_out: str = ""
+    # Scores per drift window: PSI is computed and the quality.* gauges
+    # republished every time this many live scores accumulate (tumbling
+    # windows — O(1) bin increments per request, window math at the
+    # boundary only).
+    window_scores: int = 256
+    # Histogram resolution over [0, 1] for scores AND input statistics.
+    # Must match the loaded profile's bins (load is checked).
+    score_bins: int = 20
+    # Default alert thresholds for the built-in drift rules
+    # (obs/alerts.py quality_rules): PSI > 0.2 is the standard
+    # "significant population shift" convention; input statistics get a
+    # slightly looser default (brightness/contrast jitter across clinics
+    # is expected at small PSI).
+    psi_alert: float = 0.2
+    input_psi_alert: float = 0.25
+    # Seconds a rule's condition must hold CONTINUOUSLY before it fires
+    # (the `for:` of the rule grammar); 0 fires on first breach.
+    alert_for_s: float = 0.0
+    # Extra declarative rules (obs/alerts.py syntax), e.g.
+    #   "serve.request_latency_s.p99 > 0.5 for 60 -> slo_breach"
+    #   "rate(serve.input_rejected) > 2 for 120"
+    alert_rules: tuple[str, ...] = ()
+    # Golden-set canary: an .npz (images [n,S,S,3] uint8, optional
+    # pinned scores) scored through the live engine on a cadence,
+    # asserting byte-stable output per (checkpoint, bucket) — catches
+    # silent numerical/preprocessing regressions distribution tests
+    # can't see. Empty disables.
+    canary_path: str = ""
+    # Seconds between canary runs on the live engine (<= 0: only
+    # explicit run_canary() calls).
+    canary_every_s: float = 300.0
+    # 0.0 = byte-stable comparison (the default contract); > 0 allows
+    # that absolute deviation (e.g. across a serving-stack migration
+    # where float-ulp drift is accepted).
+    canary_atol: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Runtime-telemetry config (jama16_retina_tpu/obs/; ISSUE 3).
 
@@ -346,6 +409,11 @@ class ObsConfig:
     slow_step_factor: float = 4.0
     # How many of the newest trace events a blackbox dump carries.
     blackbox_events: int = 1024
+    # Model/data-quality monitoring (ISSUE 5): online drift detection
+    # against a reference profile, golden-set canary, and SLO/alert
+    # rules. Nested because it is a subsystem, not a knob — override
+    # with obs.quality.<field>=value.
+    quality: QualityConfig = dataclasses.field(default_factory=QualityConfig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -461,21 +529,75 @@ def get_config(name: str) -> ExperimentConfig:
     return PRESETS[name]()
 
 
+def _unknown_field(parent, attr: str, item: str) -> ValueError:
+    """The loud unknown-key error with a did-you-mean hint: a typo'd
+    override silently not applying (or half-applying) is exactly the
+    failure mode nested configs like obs.quality.* invite."""
+    import difflib
+
+    if not dataclasses.is_dataclass(parent):
+        # An over-deep path (train.steps.x=1) walked past a leaf value;
+        # there are no fields to suggest from, but the error must still
+        # be the clean ValueError the CLI reports, not a TypeError.
+        return ValueError(
+            f"override {item!r} descends into {attr!r}, but the path "
+            f"already reached a {type(parent).__name__} value — remove "
+            "the extra segment"
+        )
+    names = [f.name for f in dataclasses.fields(parent)]
+    close = difflib.get_close_matches(attr, names, n=1)
+    hint = f"; did you mean {close[0]!r}?" if close else ""
+    return ValueError(
+        f"unknown config field {attr!r} in override {item!r}{hint} "
+        f"(valid {type(parent).__name__} fields: {', '.join(sorted(names))})"
+    )
+
+
 def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
-    """Apply ``section.field=value`` overrides (CLI --set flags)."""
+    """Apply ``section.field=value`` overrides (CLI --set flags).
+
+    Paths may nest through sub-configs (``obs.quality.enabled=true``);
+    every hop is validated against the dataclass it lands on, and an
+    unknown key raises with a did-you-mean listing the valid fields of
+    the config it missed on (the silent-typo failure mode of nested new
+    configs).
+    """
     for item in dotted:
         key, eq, raw = item.partition("=")
-        section_name, dot, field = key.partition(".")
-        if not eq or not dot or not field:
+        parts = key.split(".")
+        if not eq or len(parts) < 2 or not all(parts):
             raise ValueError(
                 f"malformed override {item!r}; expected section.field=value "
-                "(e.g. train.steps=100)"
+                "(e.g. train.steps=100 or obs.quality.enabled=true)"
             )
-        try:
-            section = getattr(cfg, section_name)
-            current = getattr(section, field)
-        except AttributeError as e:
-            raise ValueError(f"unknown config field in override {item!r}: {e}")
+        # Walk to the leaf's parent, validating each hop. Validation is
+        # against the dataclass FIELDS, not hasattr: a property (e.g.
+        # ModelConfig.num_classes) is readable but not replaceable, and
+        # must get the clean did-you-mean error, not a TypeError out of
+        # dataclasses.replace.
+        def _is_field(obj, name: str) -> bool:
+            return dataclasses.is_dataclass(obj) and any(
+                f.name == name for f in dataclasses.fields(obj)
+            )
+
+        chain = [cfg]
+        for p in parts[:-1]:
+            parent = chain[-1]
+            if not _is_field(parent, p):
+                raise _unknown_field(parent, p, item)
+            nxt = getattr(parent, p)
+            chain.append(nxt)
+        section = chain[-1]
+        field = parts[-1]
+        if not _is_field(section, field):
+            raise _unknown_field(section, field, item)
+        current = getattr(section, field)
+        if dataclasses.is_dataclass(current):
+            raise ValueError(
+                f"override {item!r} targets the config section "
+                f"{type(current).__name__}; set its fields individually "
+                f"(e.g. {key}.{dataclasses.fields(current)[0].name}=...)"
+            )
         try:
             if isinstance(current, bool):
                 value: object = raw.lower() in ("1", "true", "yes")
@@ -484,7 +606,7 @@ def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
             elif isinstance(current, float):
                 value = float(raw)
             elif isinstance(current, tuple):
-                parts = [p for p in raw.split(",") if p]
+                elems_raw = [p for p in raw.split(",") if p]
                 if current:
                     elem = type(current[0])
                 else:
@@ -501,7 +623,7 @@ def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
                         int if "int" in ann
                         else float if "float" in ann else str
                     )
-                value = tuple(elem(p) for p in parts)
+                value = tuple(elem(p) for p in elems_raw)
             else:
                 value = raw
         except ValueError:
@@ -509,6 +631,9 @@ def override(cfg: ExperimentConfig, dotted: Sequence[str]) -> ExperimentConfig:
                 f"bad value in override {item!r}: cannot parse {raw!r} as "
                 f"{type(current).__name__}"
             )
-        section = dataclasses.replace(section, **{field: value})
-        cfg = dataclasses.replace(cfg, **{section_name: section})
+        # Rebuild the frozen chain from the leaf outward.
+        obj: object = dataclasses.replace(section, **{field: value})
+        for parent, name in zip(reversed(chain[:-1]), reversed(parts[:-1])):
+            obj = dataclasses.replace(parent, **{name: obj})
+        cfg = obj
     return cfg

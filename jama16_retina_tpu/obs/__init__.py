@@ -38,6 +38,22 @@ Event tracing + the black-box flight recorder (ISSUE 4) ride on top:
     trigger-driven ``jax.profiler`` capture per run through the
     trainer's ``_ProfilerWindow.arm``.
 
+Model & data quality observability (ISSUE 5) closes the loop from
+infra health to MODEL health:
+
+  * ``quality``  — versioned reference profiles (val-split score +
+    input-statistic histograms, base rate, operating thresholds;
+    ``evaluate.py --profile_out``), the online ``QualityMonitor``
+    (windowed PSI/KL drift gauges ``quality.score_psi`` /
+    ``quality.input_psi.{stat}`` / ``quality.positive_rate`` through
+    this registry), and the byte-stable ``GoldenCanary``.
+  * ``alerts``   — declarative SLO rules (``metric OP threshold [for
+    SECONDS] [-> reason]``, incl. ``rate()`` burn-rate form) evaluated
+    at Snapshotter flush cadence; firing writes ``alert`` JSONL
+    records, trips the flight recorder's ``quality_drift`` /
+    ``slo_breach`` triggers (one dump per reason per run), and flips
+    ``scripts/obs_report.py --check-alerts`` exit status.
+
 Render either output with ``scripts/obs_report.py``; the metric-name
 glossary lives in docs/OBSERVABILITY.md. The hot-path cost is pinned by
 bench.py's telemetry- and tracing-overhead guards (device_only with
@@ -45,7 +61,17 @@ either enabled must stay within 2% of off) and
 tests/test_bench_guard.py's per-op bound.
 """
 
+from jama16_retina_tpu.obs.alerts import AlertManager, AlertRule, parse_rule
 from jama16_retina_tpu.obs.flightrec import FlightRecorder
+from jama16_retina_tpu.obs.quality import (
+    GoldenCanary,
+    QualityMonitor,
+    build_profile,
+    load_profile,
+    monitor_from_config,
+    psi,
+    save_profile,
+)
 from jama16_retina_tpu.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -65,16 +91,26 @@ from jama16_retina_tpu.obs.trace import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "AlertManager",
+    "AlertRule",
     "Counter",
     "FlightRecorder",
     "Gauge",
+    "GoldenCanary",
     "Histogram",
+    "QualityMonitor",
     "Registry",
     "StallClock",
     "Tracer",
+    "build_profile",
     "chrome_trace",
     "default_registry",
     "default_tracer",
+    "load_profile",
+    "monitor_from_config",
+    "parse_rule",
+    "psi",
+    "save_profile",
     "set_default_registry",
     "set_default_tracer",
     "span",

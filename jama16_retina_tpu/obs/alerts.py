@@ -1,0 +1,277 @@
+"""Declarative SLO / quality alert rules over registry snapshots
+(ISSUE 5).
+
+The drift monitor (obs/quality.py) publishes judgment as gauges; this
+module turns gauges into ACTIONS. A rule is
+
+    metric OP threshold [for SECONDS] [-> reason]
+
+e.g. ``quality.score_psi > 0.2 for 120 -> quality_drift`` or
+``serve.request_latency_s.p99 > 0.5 for 60``. Rules are evaluated
+against successive ``Registry.snapshot()`` dicts — normally at the
+Snapshotter's flush cadence, so alerting rides the existing telemetry
+heartbeat with no extra thread. Metric references resolve against
+gauges, then counters, then ``<histogram>.{p50,p95,p99,mean,count}``;
+``rate(counter)`` is the burn-rate form — the counter's per-second
+delta between consecutive snapshots (undefined on the first snapshot,
+so rate rules never fire cold).
+
+``for SECONDS`` is the Prometheus semantics: the condition must hold
+CONTINUOUSLY for that long before the rule transitions to FIRING. On
+the transition the manager
+
+  * writes one ``alert`` JSONL record (state=firing) through the run's
+    RunLog — and one more (state=resolved) when the condition clears;
+  * trips the flight recorder with the rule's ``reason``
+    (``quality_drift`` for the built-in drift/canary rules,
+    ``slo_breach`` for user rules by default) — PR 4's machinery caps
+    that at ONE dump per reason per run, so a persistently-firing rule
+    cannot fill the disk with black boxes;
+  * increments ``obs.alerts_fired``.
+
+A metric that does not exist in the snapshot makes the rule INACTIVE
+(condition false): quality rules are safe to install unconditionally —
+they only arm once the monitor starts publishing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import operator
+import re
+import time
+
+from jama16_retina_tpu.obs import registry as registry_lib
+
+_OPS = {
+    ">": operator.gt, ">=": operator.ge,
+    "<": operator.lt, "<=": operator.le,
+    "==": operator.eq, "!=": operator.ne,
+}
+
+_HIST_FIELDS = ("p50", "p95", "p99", "mean", "count", "sum")
+
+_RULE_RE = re.compile(
+    r"^\s*(?P<metric>rate\([A-Za-z0-9_.]+\)|[A-Za-z0-9_.]+)\s*"
+    r"(?P<op>>=|<=|==|!=|>|<)\s*"
+    r"(?P<threshold>[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)\s*"
+    r"(?:for\s+(?P<for>[0-9]*\.?[0-9]+)\s*s?)?\s*"
+    r"(?:->\s*(?P<reason>[A-Za-z0-9_]+))?\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    metric: str
+    op: str
+    threshold: float
+    for_seconds: float = 0.0
+    reason: str = "slo_breach"
+
+    @property
+    def name(self) -> str:
+        txt = f"{self.metric}{self.op}{self.threshold:g}"
+        if self.for_seconds:
+            txt += f" for {self.for_seconds:g}s"
+        return txt
+
+
+def parse_rule(text: str) -> AlertRule:
+    """One rule from the declarative grammar above; raises on anything
+    it cannot parse COMPLETELY (a half-understood alert rule is worse
+    than none)."""
+    m = _RULE_RE.match(text)
+    if not m:
+        raise ValueError(
+            f"cannot parse alert rule {text!r}; expected "
+            "'metric OP threshold [for SECONDS] [-> reason]', e.g. "
+            "'quality.score_psi > 0.2 for 120 -> quality_drift'"
+        )
+    if m.group("op") not in _OPS:  # pragma: no cover - regex pins these
+        raise ValueError(f"unknown operator in alert rule {text!r}")
+    return AlertRule(
+        metric=m.group("metric"),
+        op=m.group("op"),
+        threshold=float(m.group("threshold")),
+        for_seconds=float(m.group("for") or 0.0),
+        reason=m.group("reason") or "slo_breach",
+    )
+
+
+def quality_rules(qcfg) -> list:
+    """The rule set one QualityConfig implies: the built-in drift/canary
+    triad when the monitor is enabled (all reason=quality_drift — the
+    flight-recorder trigger the acceptance pins), plus every user rule
+    string. Empty when quality is off and no user rules exist."""
+    rules: list = []
+    if getattr(qcfg, "enabled", False):
+        f = float(getattr(qcfg, "alert_for_s", 0.0))
+        rules += [
+            AlertRule("quality.score_psi", ">", float(qcfg.psi_alert),
+                      for_seconds=f, reason="quality_drift"),
+            AlertRule("quality.input_psi_max", ">",
+                      float(qcfg.input_psi_alert),
+                      for_seconds=f, reason="quality_drift"),
+            AlertRule("quality.canary_ok", "<", 1.0,
+                      for_seconds=f, reason="quality_drift"),
+        ]
+    for text in getattr(qcfg, "alert_rules", ()) or ():
+        rules.append(parse_rule(text))
+    return rules
+
+
+def manager_for(cfg, workdir: str, registry=None) -> "AlertManager | None":
+    """The AlertManager a TRAINERLESS process (serving session, batch
+    predict) hangs on its Snapshotter: the rules ``cfg.obs.quality``
+    implies, wired to a fresh FlightRecorder over ``workdir`` so a
+    firing rule writes `alert` records AND trips its
+    ``quality_drift``/``slo_breach`` blackbox dump (one per reason per
+    run) exactly like a train run. None when obs is off or the config
+    implies no rules. One copy of this wiring — the trainer keeps its
+    own because its FlightRecorder carries profiler capture hooks and
+    step/loss sentinels no serving process has."""
+    from jama16_retina_tpu.obs import flightrec
+
+    if not cfg.obs.enabled:
+        return None
+    rules = quality_rules(cfg.obs.quality)
+    if not rules:
+        return None
+    flight = flightrec.FlightRecorder(
+        workdir,
+        config=dataclasses.asdict(cfg),
+        registry=registry,
+        blackbox_events=cfg.obs.blackbox_events,
+        # No step loop to watch in a serving/predict process.
+        slow_step_factor=float("inf"),
+    )
+    return AlertManager(rules, registry=registry, flight=flight)
+
+
+def resolve_metric(snapshot: dict, metric: str,
+                   prev: "dict | None" = None,
+                   dt: "float | None" = None) -> "float | None":
+    """A rule's metric reference against one snapshot; None = no data.
+    ``prev``/``dt`` feed the rate() form (previous snapshot and the
+    seconds between them)."""
+    if metric.startswith("rate(") and metric.endswith(")"):
+        inner = metric[len("rate("):-1]
+        if prev is None or not dt or dt <= 0:
+            return None
+        cur_v = snapshot.get("counters", {}).get(inner)
+        prev_v = prev.get("counters", {}).get(inner)
+        if cur_v is None or prev_v is None:
+            return None
+        return (cur_v - prev_v) / dt
+    gauges = snapshot.get("gauges", {})
+    if metric in gauges:
+        return float(gauges[metric])
+    counters = snapshot.get("counters", {})
+    if metric in counters:
+        return float(counters[metric])
+    base, _, field = metric.rpartition(".")
+    if field in _HIST_FIELDS:
+        h = snapshot.get("histograms", {}).get(base)
+        if h is not None and h.get(field) is not None:
+            return float(h[field])
+    return None
+
+
+class _RuleState:
+    __slots__ = ("since", "firing")
+
+    def __init__(self):
+        self.since: "float | None" = None
+        self.firing = False
+
+
+class AlertManager:
+    """Evaluate a rule set against successive registry snapshots.
+
+    One per process (trainer run or serving session); normally driven
+    by the Snapshotter's flush (``export.Snapshotter(alerts=...)``), so
+    alert latency == telemetry cadence. ``flight`` is the run's
+    FlightRecorder (or None): a rule's firing transition trips
+    ``flight.dump(rule.reason)``, deduped per reason per run by PR 4's
+    rate limit. Not thread-safe by design — exactly one flush loop
+    drives it (the Snapshotter contract).
+    """
+
+    def __init__(self, rules, registry: "registry_lib.Registry | None" = None,
+                 flight=None):
+        self.rules = [
+            r if isinstance(r, AlertRule) else parse_rule(r) for r in rules
+        ]
+        self._registry = (
+            registry if registry is not None
+            else registry_lib.default_registry()
+        )
+        self._flight = flight
+        self._state = {r.name: _RuleState() for r in self.rules}
+        self._prev_snapshot: "dict | None" = None
+        self._prev_t: "float | None" = None
+        self._c_fired = self._registry.counter(
+            "obs.alerts_fired",
+            help="alert rules that transitioned to firing this run",
+        )
+
+    def evaluate(self, snapshot: "dict | None" = None,
+                 now: "float | None" = None, runlog=None) -> list:
+        """One evaluation pass; returns the currently-FIRING rules as
+        dicts (rule/metric/value/threshold/for_s/reason). ``runlog``
+        receives the firing/resolved transition records."""
+        now = time.time() if now is None else now
+        if snapshot is None:
+            snapshot = self._registry.snapshot()
+        dt = (now - self._prev_t) if self._prev_t is not None else None
+        firing = []
+        for rule in self.rules:
+            st = self._state[rule.name]
+            value = resolve_metric(
+                snapshot, rule.metric, prev=self._prev_snapshot, dt=dt
+            )
+            cond = value is not None and _OPS[rule.op](value, rule.threshold)
+            if cond:
+                if st.since is None:
+                    st.since = now
+                held = now - st.since
+                if not st.firing and held >= rule.for_seconds:
+                    st.firing = True
+                    self._c_fired.inc()
+                    if runlog is not None:
+                        runlog.write(
+                            "alert", rule=rule.name, state="firing",
+                            metric=rule.metric, value=round(value, 6),
+                            threshold=rule.threshold,
+                            for_s=round(held, 3), reason=rule.reason,
+                        )
+                    if self._flight is not None:
+                        self._flight.dump(
+                            rule.reason, rule=rule.name,
+                            metric=rule.metric, value=round(value, 6),
+                            threshold=rule.threshold,
+                        )
+                if st.firing:
+                    firing.append({
+                        "rule": rule.name, "metric": rule.metric,
+                        "value": value, "threshold": rule.threshold,
+                        "for_s": held, "reason": rule.reason,
+                    })
+            else:
+                if st.firing and runlog is not None:
+                    runlog.write(
+                        "alert", rule=rule.name, state="resolved",
+                        metric=rule.metric,
+                        value=(round(value, 6) if value is not None
+                               else None),
+                        reason=rule.reason,
+                    )
+                st.since = None
+                st.firing = False
+        self._prev_snapshot = snapshot
+        self._prev_t = now
+        return firing
+
+    def firing(self) -> list:
+        """Rule names currently in the firing state (between evaluates)."""
+        return [name for name, st in self._state.items() if st.firing]

@@ -136,6 +136,7 @@ class Snapshotter:
         runlog=None,
         every_s: float = 60.0,
         prom_name: str = "telemetry.prom",
+        alerts=None,
     ):
         if not workdir and runlog is None:
             raise ValueError("Snapshotter needs a workdir and/or a runlog")
@@ -152,6 +153,13 @@ class Snapshotter:
         self._log = runlog
         self.every_s = float(every_s)
         self._prom_name = prom_name
+        # SLO/quality alerting (obs/alerts.py; ISSUE 5): the manager is
+        # evaluated on every flush against the snapshot just taken, so
+        # alert latency == telemetry cadence and `alert` records land
+        # in the same JSONL as the telemetry they fired on. Assignable
+        # after construction (predict.py builds the engine — and thus
+        # the rules' flight recorder — after its snapshotter).
+        self.alerts = alerts
         self._last_flush = time.time()
         self._step: "int | None" = None
         self._last_progress_t: "float | None" = None
@@ -190,6 +198,8 @@ class Snapshotter:
                 if self._last_progress_t is not None else None
             ),
         )
+        if self.alerts is not None:
+            self.alerts.evaluate(snapshot=snap, runlog=self._log)
         if self._workdir:
             path = self._prom_path()
             tmp = path + ".tmp"

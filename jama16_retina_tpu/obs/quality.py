@@ -1,0 +1,635 @@
+"""Model & data quality observability: reference profiles, online drift
+detection, and the golden-set canary (ISSUE 5 tentpole).
+
+PR 3/4 made the runtime's INFRA health visible (stall attribution,
+latency quantiles, flight-recorder dumps). What they cannot see is the
+quantity the paper actually ships: AUC and sensitivity at operating
+points chosen on a validation distribution — numbers that silently rot
+when the live input or score distribution drifts away from the one the
+thresholds were picked on. This module moves `evaluate.py`'s offline
+judgment online:
+
+  * REFERENCE PROFILE — a small versioned JSON artifact
+    (``build_profile``/``save_profile``/``load_profile``) holding the
+    validation split's score histogram (fixed bins over [0, 1]),
+    per-channel input-statistic histograms over the post-normalization
+    uint8 images (channel means, global std, gray brightness — the
+    statistics ``serve/host.py``'s fundus normalization determines),
+    the positive base rate, and the chosen operating thresholds.
+    Written by ``evaluate.py --profile_out`` (the canonical path for a
+    served checkpoint) or the trainer's ``obs.quality.profile_out``.
+
+  * ONLINE DRIFT MONITOR — ``QualityMonitor`` accumulates the same
+    histograms from live requests at O(1) bin increments per row
+    (vectorized per batch) and, every ``window_scores`` scores
+    (tumbling windows), computes PSI against the profile and publishes
+    ``quality.score_psi`` / ``quality.input_psi.{stat}`` /
+    ``quality.positive_rate`` gauges through the PR-3 registry — so
+    drift lands in `telemetry` JSONL records and ``telemetry.prom``
+    with no new export path, and obs/alerts.py rules can fire on it.
+
+  * GOLDEN-SET CANARY — ``GoldenCanary``: a pinned image set scored
+    through the live engine on a cadence, asserting byte-stable scores
+    per (checkpoint, bucket). Distribution tests can't catch a silent
+    numerical or preprocessing regression that shifts every score by
+    the same small amount; an exact-compare canary can.
+
+Disabled contract (the registry's, inherited): ``enabled=False`` makes
+``observe()`` one attribute read and one branch — pinned by bench.py's
+``quality_overhead_pct`` guard (monitor ENABLED must stay within 2% of
+device_only; disabled is strictly cheaper) and tests/test_quality.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.obs import registry as registry_lib
+
+PROFILE_VERSION = 1
+
+# The per-image input statistics the monitor and the profile share.
+# All are dimensionless in [0, 1] over the POST-normalization uint8
+# image (scaled by /255): per-channel means catch color-balance /
+# illumination drift (a new camera, a changed Ben-Graham flag), the
+# global std catches contrast collapse, gray brightness is the
+# headline exposure statistic.
+INPUT_STATS = ("mean_r", "mean_g", "mean_b", "std", "brightness")
+
+# Smoothing floor for PSI/KL proportions: a bin empty on one side must
+# not produce an infinite term (the standard epsilon convention).
+_EPS = 1e-4
+
+
+# ---------------------------------------------------------------------------
+# Histograms + divergences
+# ---------------------------------------------------------------------------
+
+
+def bin_counts(values: np.ndarray, bins: int) -> np.ndarray:
+    """Counts of ``values`` over ``bins`` uniform buckets spanning
+    [0, 1], out-of-range values clamped into the edge bins (scores are
+    probabilities by construction; input stats are bounded by their
+    definitions, so clamping only ever absorbs float dust)."""
+    v = np.asarray(values, np.float64).ravel()
+    idx = np.clip((v * bins).astype(np.int64), 0, bins - 1)
+    return np.bincount(idx, minlength=bins).astype(np.int64)
+
+
+def _proportions(counts: np.ndarray) -> np.ndarray:
+    c = np.asarray(counts, np.float64)
+    total = c.sum()
+    if total <= 0:
+        return np.full(c.shape, 1.0 / c.size)
+    return np.maximum(c / total, _EPS)
+
+
+def psi(ref_counts: np.ndarray, cur_counts: np.ndarray) -> float:
+    """Population Stability Index between two same-binning histograms:
+    sum((cur - ref) * ln(cur / ref)) over bin proportions. Symmetric in
+    sign of the shift; the industry reading is < 0.1 stable, 0.1-0.25
+    drifting, > 0.25 shifted (docs/OBSERVABILITY.md §Quality)."""
+    p = _proportions(ref_counts)
+    q = _proportions(cur_counts)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def psi_debiased(ref_counts: np.ndarray, cur_counts: np.ndarray) -> float:
+    """PSI minus its first-order small-sample expectation, clamped at 0.
+
+    A finite window drawn FROM the reference distribution still shows
+    positive PSI — asymptotically chi-square-like with expectation
+    ``(bins - 1) * (1/n_cur + 1/n_ref)`` (measured: 0.074 for a
+    256-score window over 20 bins, exactly the prediction). Publishing
+    the raw value would make the alert threshold mean "0.2 including
+    noise that scales with 1/window"; subtracting the expectation makes
+    ``quality.score_psi > 0.2`` mean "0.2 ABOVE sampling noise"
+    regardless of the configured window/bins. This is what the monitor
+    publishes; ``psi`` stays the textbook quantity."""
+    ref = np.asarray(ref_counts, np.float64)
+    cur = np.asarray(cur_counts, np.float64)
+    bias = (ref.size - 1) * (
+        1.0 / max(1.0, cur.sum()) + 1.0 / max(1.0, ref.sum())
+    )
+    return max(0.0, psi(ref, cur) - bias)
+
+
+def kl_divergence(ref_counts: np.ndarray, cur_counts: np.ndarray) -> float:
+    """KL(cur || ref) over bin proportions — the asymmetric companion
+    obs_report shows next to PSI for debugging which tail moved."""
+    p = _proportions(ref_counts)
+    q = _proportions(cur_counts)
+    return float(np.sum(q * np.log(q / p)))
+
+
+def input_stat_values(images: np.ndarray) -> dict:
+    """Per-image scalar statistics (INPUT_STATS) over uint8 images
+    [n, S, S, 3], vectorized in one pass: {stat: float64 [n]}."""
+    imgs = np.asarray(images)
+    if imgs.ndim != 4 or imgs.shape[-1] != 3:
+        raise ValueError(f"expected images [n, S, S, 3], got {imgs.shape}")
+    x = imgs.astype(np.float32) / 255.0
+    chan = x.mean(axis=(1, 2))  # [n, 3]
+    gray = chan @ np.array([0.299, 0.587, 0.114], np.float32)
+    return {
+        "mean_r": chan[:, 0].astype(np.float64),
+        "mean_g": chan[:, 1].astype(np.float64),
+        "mean_b": chan[:, 2].astype(np.float64),
+        "std": x.reshape(x.shape[0], -1).std(axis=1).astype(np.float64),
+        "brightness": gray.astype(np.float64),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Reference profile artifact
+# ---------------------------------------------------------------------------
+
+
+def build_profile(
+    scores: np.ndarray,
+    labels: "np.ndarray | None" = None,
+    stat_values: "dict | None" = None,
+    thresholds: "list | tuple" = (),
+    bins: int = 20,
+    meta: "dict | None" = None,
+) -> dict:
+    """The versioned reference artifact the online monitor compares
+    against. ``scores``: referable probabilities in [0, 1] (the binary
+    score every head reduces to); ``labels``: binary labels for the
+    base rate; ``stat_values``: ``input_stat_values``-shaped dict;
+    ``thresholds``: operating-point rows (each carrying at least
+    ``threshold``, normally also ``target_specificity``)."""
+    scores = np.asarray(scores, np.float64).ravel()
+    profile = {
+        "version": PROFILE_VERSION,
+        "kind": "quality_profile",
+        "bins": int(bins),
+        "n_examples": int(scores.size),
+        "score_hist": bin_counts(scores, bins).tolist(),
+        "base_rate": (
+            float(np.asarray(labels, np.float64).mean())
+            if labels is not None and np.asarray(labels).size else None
+        ),
+        "thresholds": [
+            {k: (float(v) if isinstance(v, (int, float, np.floating))
+                 else v)
+             for k, v in dict(t).items()}
+            for t in thresholds
+        ],
+        "input_stats": {
+            k: bin_counts(v, bins).tolist()
+            for k, v in (stat_values or {}).items()
+        },
+    }
+    if meta:
+        profile["meta"] = dict(meta)
+    return profile
+
+
+def save_profile(path: str, profile: dict) -> str:
+    """Atomic write (tmp + rename): a monitor loading mid-write must
+    never see a torn artifact — same publish rule as telemetry.prom."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(profile, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_profile(path: str) -> dict:
+    with open(path) as f:
+        profile = json.load(f)
+    v = profile.get("version")
+    if v != PROFILE_VERSION:
+        raise ValueError(
+            f"quality profile {path!r} has version {v!r}; this runtime "
+            f"reads version {PROFILE_VERSION} — re-emit it with "
+            "evaluate.py --profile_out"
+        )
+    if profile.get("kind") != "quality_profile":
+        raise ValueError(f"{path!r} is not a quality profile artifact")
+    return profile
+
+
+def split_input_stats(
+    data_dir: str, split: str, batch_size: int, image_size: int
+) -> dict:
+    """``input_stat_values`` over one epoch of an eval split — the
+    profile's input-histogram source. Imported lazily: profile emission
+    is an offline path and must not drag tf.data into the monitor."""
+    from jama16_retina_tpu.data import pipeline
+
+    acc: dict = {k: [] for k in INPUT_STATS}
+    # Force the single-process view (same rule as predict_split's
+    # offline path): eval_batches' default hands each host a LOCAL row
+    # block with a GLOBAL mask, and a shard-sliced mask would let a
+    # final batch's zero-padding rows into the histograms.
+    for batch in pipeline.eval_batches(
+        data_dir, split, batch_size, image_size,
+        process_index=0, process_count=1,
+    ):
+        keep = batch["mask"] > 0
+        img = batch["image"][keep]
+        if img.shape[0] == 0:
+            continue
+        stats = input_stat_values(img)
+        for k in INPUT_STATS:
+            acc[k].append(stats[k])
+    return {k: np.concatenate(v) if v else np.zeros((0,), np.float64)
+            for k, v in acc.items()}
+
+
+# ---------------------------------------------------------------------------
+# Golden-set canary
+# ---------------------------------------------------------------------------
+
+
+def save_canary(path: str, images: np.ndarray,
+                scores: "np.ndarray | None" = None) -> str:
+    """The canary artifact: pinned images plus (optionally) the pinned
+    scores for the (checkpoint, bucket) being served. Without scores
+    the first live run pins them (and a restart re-pins — persist the
+    scored form for cross-run byte-stability)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    payload = {"images": np.asarray(images, np.uint8)}
+    if scores is not None:
+        payload["scores"] = np.asarray(scores, np.float64)
+    # np.savez appends .npz itself when missing; return the name it
+    # actually wrote so the value feeds obs.quality.canary_path as-is.
+    out = path if path.endswith(".npz") else path + ".npz"
+    np.savez(out, **payload)
+    return out
+
+
+def load_canary_file(path: str) -> tuple:
+    """(images, scores|None) from a save_canary .npz."""
+    with np.load(path) as z:
+        images = np.asarray(z["images"], np.uint8)
+        scores = (np.asarray(z["scores"], np.float64)
+                  if "scores" in z.files else None)
+    return images, scores
+
+
+class GoldenCanary:
+    """Byte-stability sentinel over a pinned image set.
+
+    ``check(score_fn)`` scores the pinned images through the LIVE
+    scoring path and compares against the reference scores: the first
+    check pins them when none were provided. ``atol=0.0`` (default) is
+    exact comparison — the scores of a fixed (checkpoint, bucket) pair
+    are deterministic, so ANY deviation is a silent numerical or
+    preprocessing regression, exactly the class distribution tests
+    cannot see. Telemetry: ``quality.canary_ok`` (1/0 gauge, starts
+    optimistic at 1 so alert rules don't fire before the first run),
+    ``quality.canary_max_dev``, ``quality.canary_runs`` /
+    ``quality.canary_failures`` counters.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        reference_scores: "np.ndarray | None" = None,
+        atol: float = 0.0,
+        every_s: float = 300.0,
+        registry: "registry_lib.Registry | None" = None,
+    ):
+        self.images = np.asarray(images, np.uint8)
+        if self.images.ndim != 4 or self.images.shape[0] == 0:
+            raise ValueError(
+                f"canary needs images [n>=1, S, S, 3], got "
+                f"{self.images.shape}"
+            )
+        self.reference = (
+            np.asarray(reference_scores, np.float64)
+            if reference_scores is not None else None
+        )
+        self.atol = float(atol)
+        self.every_s = float(every_s)
+        reg = registry if registry is not None else registry_lib.default_registry()
+        self._g_ok = reg.gauge(
+            "quality.canary_ok",
+            help="1 while the last golden-set canary run matched its "
+                 "pinned scores; 0 after a deviation",
+        )
+        self._g_dev = reg.gauge(
+            "quality.canary_max_dev",
+            help="max |score - pinned| of the last canary run "
+                 "(-1 = score shape mismatched the pinned set)",
+        )
+        self._c_runs = reg.counter("quality.canary_runs")
+        self._c_failures = reg.counter(
+            "quality.canary_failures",
+            help="canary runs whose scores deviated from the pinned set",
+        )
+        self._g_ok.set(1.0)
+        self._last_run: "float | None" = None
+        self._claim_lock = threading.Lock()
+
+    def due(self, now: "float | None" = None) -> bool:
+        if self.every_s <= 0:
+            return False
+        if self._last_run is None:
+            return True
+        now = time.monotonic() if now is None else now
+        return (now - self._last_run) >= self.every_s
+
+    def claim_due(self, now: "float | None" = None) -> bool:
+        """Atomic due()+stamp: of several concurrent callers landing on
+        a cadence boundary (engine.probs is public and thread-safe),
+        exactly ONE wins the run slot — the others must not each pay a
+        full canary scoring pass on their live request."""
+        with self._claim_lock:
+            if not self.due(now):
+                return False
+            self._last_run = time.monotonic() if now is None else now
+            return True
+
+    def check(self, score_fn, now: "float | None" = None) -> dict:
+        """Score the pinned set through ``score_fn(images) -> [n]`` and
+        compare. Returns {'ok', 'pinned', 'max_abs_dev'}; publishes the
+        gauges/counters either way. A score_fn that RAISES (mis-sized
+        canary set, serving-path regression) is recorded as a canary
+        failure — dev sentinel -1, 'error' key in the result — instead
+        of propagating: the canary rides live probs() calls, and a
+        broken canary must page, not fail real requests every
+        ``every_s``."""
+        self._last_run = time.monotonic() if now is None else now
+        self._c_runs.inc()
+        try:
+            scores = np.asarray(score_fn(self.images), np.float64).ravel()
+        except Exception as e:  # noqa: BLE001 - any scoring failure
+            absl_logging.error(
+                "golden canary scoring failed: %s: %s", type(e).__name__, e
+            )
+            self._g_ok.set(0.0)
+            self._g_dev.set(-1.0)
+            self._c_failures.inc()
+            return {"ok": False, "pinned": False,
+                    "max_abs_dev": float("inf"),
+                    "error": f"{type(e).__name__}: {e}"}
+        if self.reference is None:
+            self.reference = scores
+            self._g_ok.set(1.0)
+            self._g_dev.set(0.0)
+            return {"ok": True, "pinned": True, "max_abs_dev": 0.0}
+        dev = float(np.max(np.abs(scores - self.reference))) \
+            if scores.shape == self.reference.shape else float("inf")
+        ok = (
+            scores.shape == self.reference.shape
+            and (np.array_equal(scores, self.reference) if self.atol == 0.0
+                 else bool(np.all(np.abs(scores - self.reference)
+                                  <= self.atol)))
+        )
+        self._g_ok.set(1.0 if ok else 0.0)
+        # A shape mismatch (checkpoint-head or canary-set swap) has no
+        # finite deviation; -1 keeps the failure distinguishable from
+        # "matched exactly" in telemetry instead of reporting 0.0.
+        self._g_dev.set(-1.0 if dev == float("inf") else dev)
+        if not ok:
+            self._c_failures.inc()
+        return {"ok": ok, "pinned": False, "max_abs_dev": dev}
+
+
+# ---------------------------------------------------------------------------
+# Online drift monitor
+# ---------------------------------------------------------------------------
+
+
+class QualityMonitor:
+    """Sliding-window drift detection against a reference profile.
+
+    ``observe(images, scores)`` is the one hot-path hook (the engine
+    calls it once per coalesced batch): O(1) bin increments per row,
+    vectorized; when ``window_scores`` scores have accumulated the
+    window closes — PSIs are computed against the profile and the
+    ``quality.*`` gauges republished — and a fresh window starts
+    (tumbling windows: every live score lands in exactly one window).
+
+    Publishes through the PR-3 registry (no new export path):
+
+      * ``quality.score_psi``        — live-vs-profile score-histogram PSI
+      * ``quality.score_kl``         — KL(live || profile), same window
+      * ``quality.input_psi.{stat}`` — one per INPUT_STATS entry
+      * ``quality.input_psi_max``    — max over stats (the alert handle)
+      * ``quality.positive_rate``    — fraction >= the profile's primary
+        operating threshold (compare against the profile's base rate)
+      * ``quality.windows`` / ``quality.scores`` counters, and
+        ``quality.profile_loaded`` = profile version (the obs_report
+        marker distinguishing "no profile configured" from "configured
+        but no data" — the exit-2 case of ``--check-alerts``).
+
+    ``enabled=False`` (or a disabled registry) costs one branch per
+    ``observe``. Thread-safe: the accumulate+maybe-publish section runs
+    under one lock (serve records from the batcher worker while tests/
+    bench drive their own threads).
+    """
+
+    def __init__(
+        self,
+        qcfg,
+        registry: "registry_lib.Registry | None" = None,
+        profile: "dict | None" = None,
+        canary: "GoldenCanary | None" = None,
+    ):
+        self.enabled = bool(getattr(qcfg, "enabled", True))
+        self._registry = (
+            registry if registry is not None
+            else registry_lib.default_registry()
+        )
+        self.canary = canary
+        if not self.enabled:
+            self.profile = None
+            return
+        self.bins = int(getattr(qcfg, "score_bins", 20))
+        self.window_scores = max(1, int(getattr(qcfg, "window_scores", 256)))
+        self.profile = profile
+        self._ref_scores = None
+        self._ref_stats: dict = {}
+        self.threshold = 0.5
+        if profile is not None:
+            if int(profile.get("bins", -1)) != self.bins:
+                raise ValueError(
+                    f"profile has {profile.get('bins')} bins but "
+                    f"obs.quality.score_bins={self.bins}; histograms must "
+                    "share binning to be comparable"
+                )
+            self._ref_scores = np.asarray(profile["score_hist"], np.float64)
+            self._ref_stats = {
+                k: np.asarray(v, np.float64)
+                for k, v in profile.get("input_stats", {}).items()
+                if k in INPUT_STATS
+            }
+            thr = profile.get("thresholds") or []
+            if thr and "threshold" in thr[0]:
+                self.threshold = float(thr[0]["threshold"])
+        reg = self._registry
+        self._lock = threading.Lock()
+        self._g_profile = reg.gauge(
+            "quality.profile_loaded",
+            help="version of the loaded reference profile (0 = none)",
+        )
+        self._g_profile.set(
+            float(profile["version"]) if profile is not None else 0.0
+        )
+        self._g_score_psi = reg.gauge(
+            "quality.score_psi",
+            help="debiased PSI of the live score histogram vs the "
+                 "reference profile, per tumbling window (0 = at "
+                 "sampling noise; >0.25 shifted)",
+        )
+        self._g_score_kl = reg.gauge("quality.score_kl")
+        self._g_pos_rate = reg.gauge(
+            "quality.positive_rate",
+            help="fraction of window scores above the profile's primary "
+                 "operating threshold (compare to its base_rate)",
+        )
+        self._g_input_max = reg.gauge(
+            "quality.input_psi_max",
+            help="max input-statistic PSI over "
+                 + "/".join(INPUT_STATS),
+        )
+        self._g_input = {
+            k: reg.gauge(f"quality.input_psi.{k}") for k in INPUT_STATS
+        }
+        self._c_windows = reg.counter(
+            "quality.windows",
+            help="closed drift windows (each republishes the quality "
+                 "gauges); 0 with a profile loaded means no quality data "
+                 "yet — obs_report --check-alerts exit 2",
+        )
+        self._c_scores = reg.counter("quality.scores")
+        self._reset_window_locked()
+
+    # -- internals ---------------------------------------------------------
+
+    def _reset_window_locked(self) -> None:
+        self._score_counts = np.zeros(self.bins, np.int64)
+        self._stat_counts = {
+            k: np.zeros(self.bins, np.int64) for k in INPUT_STATS
+        }
+        self._stat_n = 0
+        self._pos = 0
+        self._n = 0
+
+    def _publish_locked(self) -> None:
+        if self._ref_scores is not None:
+            self._g_score_psi.set(
+                psi_debiased(self._ref_scores, self._score_counts)
+            )
+            self._g_score_kl.set(
+                kl_divergence(self._ref_scores, self._score_counts)
+            )
+            worst = 0.0
+            if self._stat_n:
+                for k, ref in self._ref_stats.items():
+                    v = psi_debiased(ref, self._stat_counts[k])
+                    self._g_input[k].set(v)
+                    worst = max(worst, v)
+                self._g_input_max.set(worst)
+            else:
+                # Tumbling-window semantics: a window with no image
+                # statistics (score-only call sites, non-image batcher
+                # rows) carries no input-drift evidence — republish 0
+                # so a past drifted window's gauges can't stay latched
+                # and keep the input-PSI alert firing forever.
+                for g in self._g_input.values():
+                    g.set(0.0)
+                self._g_input_max.set(0.0)
+        self._g_pos_rate.set(self._pos / max(1, self._n))
+        self._c_windows.inc()
+        self._reset_window_locked()
+
+    # -- the hot-path hook -------------------------------------------------
+
+    def observe(self, images: "np.ndarray | None", scores: np.ndarray) -> None:
+        """One coalesced batch of live traffic: ``scores`` are the
+        ensemble-averaged probabilities the engine returned ([n] binary
+        or [n, C] multi — reduced to referable), ``images`` the
+        post-normalization uint8 rows they came from (None skips input
+        statistics, e.g. score-only call sites)."""
+        if not self.enabled or not self._registry.enabled:
+            return
+        s = np.asarray(scores, np.float64)
+        if s.ndim == 2:
+            from jama16_retina_tpu.eval import metrics
+
+            s = np.asarray(
+                metrics.referable_probs_from_multiclass(s), np.float64
+            )
+        s = s.ravel()
+        if s.size == 0:
+            return
+        score_add = bin_counts(s, self.bins)
+        pos_add = int((s >= self.threshold).sum())
+        # Input statistics are the dominant per-batch cost (a full
+        # per-pixel pass); only pay it when the profile carries
+        # reference histograms to compare against — the no-profile
+        # "positive-rate/canary only" mode must cost what it claims.
+        stats = (
+            input_stat_values(images)
+            if images is not None and self._ref_stats else None
+        )
+        with self._lock:
+            self._score_counts += score_add
+            self._pos += pos_add
+            self._n += s.size
+            self._c_scores.inc(s.size)
+            if stats is not None:
+                for k in INPUT_STATS:
+                    self._stat_counts[k] += bin_counts(stats[k], self.bins)
+                self._stat_n += s.size
+            if self._n >= self.window_scores:
+                self._publish_locked()
+
+    # -- canary ------------------------------------------------------------
+
+    def canary_due(self, now: "float | None" = None) -> bool:
+        return (
+            self.enabled and self.canary is not None
+            and self.canary.due(now)
+        )
+
+    def canary_claim(self, now: "float | None" = None) -> bool:
+        """canary_due with the run slot atomically claimed — the form
+        concurrent serving callers must use (GoldenCanary.claim_due)."""
+        return (
+            self.enabled and self.canary is not None
+            and self.canary.claim_due(now)
+        )
+
+    def run_canary(self, score_fn, now: "float | None" = None) -> "dict | None":
+        """Score the pinned set now (cadence bypassed); the engine's
+        score_fn must BYPASS observe() so canary traffic never pollutes
+        the drift windows (ServingEngine wires member_probs-based
+        scoring, not probs)."""
+        if not self.enabled or self.canary is None:
+            return None
+        return self.canary.check(score_fn, now=now)
+
+
+def monitor_from_config(qcfg, registry=None) -> "QualityMonitor | None":
+    """The one construction rule every entry point (engine, predict,
+    tests) shares: None when disabled; profile/canary artifacts loaded
+    from their configured paths — loudly, a typo'd path must not
+    silently disable drift detection."""
+    if not getattr(qcfg, "enabled", False):
+        return None
+    profile = load_profile(qcfg.profile_path) if qcfg.profile_path else None
+    canary = None
+    if qcfg.canary_path:
+        images, pinned = load_canary_file(qcfg.canary_path)
+        canary = GoldenCanary(
+            images, reference_scores=pinned, atol=qcfg.canary_atol,
+            every_s=qcfg.canary_every_s, registry=registry,
+        )
+    return QualityMonitor(
+        qcfg, registry=registry, profile=profile, canary=canary
+    )

@@ -92,6 +92,12 @@ class MicroBatcher:
     observed, so any single request's latency decomposes from the
     timeline (pinned by tests/test_trace.py, incl. on an 8-device
     mesh engine).
+
+    ``quality`` (obs/quality.py; ISSUE 5): a QualityMonitor fed each
+    flushed window's (rows, results) — for batchers over a BARE
+    ``infer_fn``. A batcher built by ``ServingEngine.make_batcher``
+    leaves this None: the engine already observes inside ``probs()``,
+    and a second hook here would double-count every row.
     """
 
     def __init__(
@@ -104,6 +110,7 @@ class MicroBatcher:
         row_dtype=None,
         registry: "obs_registry.Registry | None" = None,
         tracer: "obs_trace.Tracer | None" = None,
+        quality=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -121,6 +128,7 @@ class MicroBatcher:
         self._tracer = (
             tracer if tracer is not None else obs_trace.default_tracer()
         )
+        self._quality = quality
         self._g_depth = reg.gauge(
             "serve.batcher.queue_depth",
             help="requests waiting to coalesce into a window",
@@ -237,6 +245,14 @@ class MicroBatcher:
                     f"{flat.shape[0]} inputs — row contract broken"
                 )
             t_infer_done = time.monotonic()
+            if self._quality is not None:
+                # Worker-thread context; the monitor's observe is
+                # lock-guarded and O(rows) vectorized. Input statistics
+                # only make sense for image-shaped rows; anything else
+                # feeds score drift alone.
+                imgs = (flat if flat.ndim == 4 and flat.shape[-1] == 3
+                        else None)
+                self._quality.observe(imgs, out)
             self.batches_run += 1
             self.rows_run += int(flat.shape[0])
             self._c_batches.inc()

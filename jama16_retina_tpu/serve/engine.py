@@ -36,6 +36,7 @@ from jama16_retina_tpu import models, train_lib
 from jama16_retina_tpu.configs import ExperimentConfig, ServeConfig
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import quality as quality_lib
 from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.obs import trace as obs_trace
 from jama16_retina_tpu.obs.spans import span
@@ -134,6 +135,27 @@ class ServingEngine:
         self._c_rows = self.registry.counter("serve.engine.rows")
         self._c_batches = self.registry.counter("serve.engine.batches")
         self._g_in_flight = self.registry.gauge("serve.engine.in_flight")
+        # Model-quality observability (obs/quality.py; ISSUE 5): the
+        # drift monitor + golden canary, or None when obs.quality is
+        # off — the disabled serve path pays exactly one branch per
+        # probs() call. Artifacts (profile/canary) load HERE, at engine
+        # construction, so a typo'd path fails the session loudly
+        # instead of silently serving unmonitored.
+        self.quality = quality_lib.monitor_from_config(
+            cfg.obs.quality, registry=self.registry
+        ) if cfg.obs.enabled else None
+        if self.quality is not None and self.quality.canary is not None:
+            want = (cfg.model.image_size, cfg.model.image_size, 3)
+            got = tuple(self.quality.canary.images.shape[1:])
+            if got != want:
+                # Catch the mis-sized artifact at session start: the
+                # canary rides live probs() calls, and a shape error
+                # there would fail one real request per cadence tick.
+                raise ValueError(
+                    f"canary images are {got} but this engine serves "
+                    f"{want} (model.image_size={cfg.model.image_size}) — "
+                    "re-pin obs.quality.canary_path for this checkpoint"
+                )
         # Per-bucket counter handles, created on a bucket's first use:
         # the steady-state path is a plain dict hit — no f-string, no
         # registry lock (the hot-path contract in obs/registry.py).
@@ -264,8 +286,24 @@ class ServingEngine:
         metrics.ensemble_average (float64 mean over members) every other
         entry point applies, so a k=1 engine returns the member's probs
         exactly and a k>1 engine matches evaluate.py/predict.py
-        averaging bit for bit."""
-        return metrics.ensemble_average(list(self.member_probs(images)))
+        averaging bit for bit.
+
+        This is the quality-monitored serving surface (ISSUE 5): every
+        live batch feeds the drift monitor's windows, and the golden-set
+        canary runs here when its cadence is due — scored through
+        ``member_probs`` directly so canary traffic never pollutes the
+        drift histograms it guards."""
+        out = metrics.ensemble_average(list(self.member_probs(images)))
+        q = self.quality
+        if q is not None:
+            q.observe(images, out)
+            if q.canary_claim():
+                q.run_canary(
+                    lambda imgs: metrics.ensemble_average(
+                        list(self.member_probs(imgs))
+                    )
+                )
+        return out
 
     def make_batcher(self):
         """A MicroBatcher wired to this engine under cfg.serve's
@@ -286,7 +324,8 @@ class ServingEngine:
         )
 
     def start_telemetry(self, workdir: str,
-                        every_s: "float | None" = None):
+                        every_s: "float | None" = None,
+                        alerts=None):
         """A Snapshotter over this engine's registry: `telemetry` +
         `heartbeat` JSONL records in ``workdir`` and an atomically
         rewritten ``<workdir>/telemetry.prom`` per flush — the serving
@@ -295,11 +334,25 @@ class ServingEngine:
         drives the cadence (``maybe_flush()`` between requests, or a
         wrapper thread) and must ``close()`` it; the snapshotter owns
         the RunLog it opens here. ``every_s`` defaults to the config's
-        ``obs.flush_every_s`` — the same knob the trainer honors."""
+        ``obs.flush_every_s`` — the same knob the trainer honors.
+
+        SLO/quality alerting (ISSUE 5) rides the same flush: when the
+        config implies rules (obs.quality enabled and/or
+        obs.quality.alert_rules) and no ``alerts`` manager is injected,
+        one is built here with its own FlightRecorder over ``workdir``
+        — so a drifting serving session writes `alert` records AND
+        trips a ``quality_drift``/``slo_breach`` blackbox dump (one per
+        reason per run), exactly like a train run."""
+        from jama16_retina_tpu.obs import alerts as obs_alerts
         from jama16_retina_tpu.obs import export as obs_export
 
+        if alerts is None:
+            alerts = obs_alerts.manager_for(
+                self.cfg, workdir, registry=self.registry
+            )
         return obs_export.Snapshotter(
             self.registry, workdir,
             every_s=(every_s if every_s is not None
                      else self.cfg.obs.flush_every_s),
+            alerts=alerts,
         )

@@ -22,7 +22,48 @@ import dataclasses
 import numpy as np
 
 from jama16_retina_tpu.data.grain_pipeline import resolve_decode_workers
+from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.preprocess import fundus
+
+
+def reject_reason_slug(why: str) -> str:
+    """Skip-reason text -> the bounded counter vocabulary (ISSUE 5
+    satellite): a per-reason counter set must not grow one metric per
+    distinct error STRING, so free-text reasons map onto a small fixed
+    slug space. Unmatched reasons land in ``other`` (still counted)."""
+    if why == "unreadable":
+        return "decode_error"
+    if "too small" in why:
+        return "too_small"
+    if "no fundus found" in why:
+        return "not_fundus"
+    return "other"
+
+
+def _count_rejects(skipped, registry: "obs_registry.Registry | None") -> None:
+    """serve.input_rejected{reason} counters with help strings, so the
+    skip ledger surfaces in telemetry records, .prom files, and
+    obs_report's quality tables — not just predict.py's stderr JSON.
+    The --strict exit-2 contract is untouched (counting is additive)."""
+    if not skipped:
+        return
+    reg = registry if registry is not None else obs_registry.default_registry()
+    total = reg.counter(
+        "serve.input_rejected",
+        help="input images rejected before the forward pass, all reasons",
+    )
+    helps = {
+        "decode_error": "rejected: file unreadable / not a decodable image",
+        "too_small": "rejected: detected fundus radius below the minimum",
+        "not_fundus": "rejected: no fundus disc found in the frame",
+        "other": "rejected: uncategorized preprocessing failure",
+    }
+    for _, why in skipped:
+        slug = reject_reason_slug(why)
+        total.inc()
+        reg.counter(
+            f"serve.input_rejected.{slug}", help=helps.get(slug, "")
+        ).inc()
 
 
 @dataclasses.dataclass
@@ -57,11 +98,14 @@ def _load_one(path: str, image_size: int, ben_graham: bool):
 def preprocess_paths(
     paths: "list[str]", image_size: int, ben_graham: bool = False,
     workers: int = 0,
+    registry: "obs_registry.Registry | None" = None,
 ) -> PreprocessResult:
     """Normalize ``paths`` across a thread pool; worker-count-invariant.
 
     ``workers``: 0 auto-derives like data.decode_workers (one thread per
     host core up to 8, leaving a core for device dispatch).
+    ``registry``: sink for the per-reason ``serve.input_rejected{reason}``
+    data-quality counters (None = process default).
     """
     workers = resolve_decode_workers(workers)
 
@@ -93,6 +137,7 @@ def preprocess_paths(
         np.stack(canvases) if canvases
         else np.zeros((0, image_size, image_size, 3), np.uint8)
     )
+    _count_rejects(skipped, registry)
     return PreprocessResult(
         images=images, kept=kept, skipped=skipped, qualities=qualities
     )

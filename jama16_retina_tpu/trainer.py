@@ -23,6 +23,7 @@ from jama16_retina_tpu.configs import ExperimentConfig
 from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import alerts as obs_alerts
 from jama16_retina_tpu.obs import export as obs_export
 from jama16_retina_tpu.obs import flightrec as obs_flightrec
 from jama16_retina_tpu.obs import registry as obs_registry
@@ -54,7 +55,8 @@ def _obs_begin_run(cfg: ExperimentConfig):
     return reg
 
 
-def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str):
+def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str,
+                   flight=None):
     """(registry, StallClock, Snapshotter|None) for one train loop.
 
     One copy of the wiring rule all three loops share (the registry was
@@ -62,13 +64,27 @@ def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str):
     the StallClock feeds trainer.* histograms only when enabled, and
     the Snapshotter reuses the run's own RunLog so
     `telemetry`/`heartbeat` records land in the same JSONL (and its
-    per-process mirrors) as everything else."""
+    per-process mirrors) as everything else.
+
+    SLO/quality alerting (obs/alerts.py; ISSUE 5) rides the same flush
+    cadence: when the config implies rules (obs.quality enabled or
+    user alert_rules), the Snapshotter carries an AlertManager wired to
+    this run's FlightRecorder, so a firing rule writes `alert` records
+    into the run JSONL and trips a quality_drift/slo_breach blackbox
+    dump (one per reason per run)."""
     reg = obs_registry.default_registry()
     stalls = StallClock(reg if cfg.obs.enabled else None)
     snap = None
     if cfg.obs.enabled:
+        alerts = None
+        rules = obs_alerts.quality_rules(cfg.obs.quality)
+        if rules:
+            alerts = obs_alerts.AlertManager(
+                rules, registry=reg, flight=flight
+            )
         snap = obs_export.Snapshotter(
-            reg, workdir, runlog=log, every_s=cfg.obs.flush_every_s
+            reg, workdir, runlog=log, every_s=cfg.obs.flush_every_s,
+            alerts=alerts,
         )
     return reg, stalls, snap
 
@@ -94,6 +110,62 @@ def _flight_for(cfg: ExperimentConfig, workdir: str,
         slow_step_factor=(slow if slow > 0 else float("inf")),
         profile_hook=(profiler.arm if profiler is not None else None),
     )
+
+
+def _emit_quality_profile(
+    cfg: ExperimentConfig, data_dir: str, predict_fn, log: RunLog,
+) -> None:
+    """End-of-fit reference-profile artifact (obs/quality.py; ISSUE 5):
+    one more val prediction pass with the loop's own scorer, reduced to
+    the versioned drift profile (score histogram, input-stat histograms,
+    base rate, operating thresholds) the online monitor loads. All
+    THREE fit loops wire this (sequential, member-parallel, tf) — the
+    knob must not silently no-op on a backend. ``predict_fn() ->
+    (grades, probs)`` with probs already ensemble-averaged where
+    members exist ([n] binary or [n, C] multiclass). Captures the FINAL
+    train state; the canonical profile for a served checkpoint is
+    ``evaluate.py --profile_out`` on that checkpoint (same builder,
+    restored best state)."""
+    from jama16_retina_tpu.obs import quality as quality_lib
+
+    path = cfg.obs.quality.profile_out
+    # The prediction pass runs on EVERY process (sharded eval steps
+    # carry collectives; a process-0-only call would deadlock a
+    # multi-host run) ...
+    grades, probs = predict_fn()
+    # ... but the artifact itself is host-local: one writer, no
+    # last-writer-wins race on a shared-FS profile_out path, and one
+    # input-stat pass (split_input_stats already reads the full split
+    # in its forced single-process view).
+    if jax.process_index() != 0:
+        return
+    bin_labels = (grades >= 2).astype(np.float64)
+    scores = (
+        np.asarray(probs, np.float64) if cfg.model.head == "binary"
+        else np.asarray(
+            metrics.referable_probs_from_multiclass(probs), np.float64
+        )
+    )
+    # Operating thresholds need both classes on val; a degenerate split
+    # (smoke fixtures) still gets a profile, just without thresholds.
+    thresholds: list = []
+    if 0.0 < bin_labels.mean() < 1.0:
+        thresholds = [
+            metrics.sensitivity_at_specificity(bin_labels, scores, s).as_dict()
+            for s in cfg.eval.operating_specificities
+        ]
+    stats = quality_lib.split_input_stats(
+        data_dir, "val", cfg.eval.batch_size, cfg.model.image_size
+    )
+    profile = quality_lib.build_profile(
+        scores, labels=bin_labels, stat_values=stats,
+        thresholds=thresholds, bins=cfg.obs.quality.score_bins,
+        meta={"config": cfg.name, "split": "val",
+              "source": "trainer_end_of_fit"},
+    )
+    quality_lib.save_profile(path, profile)
+    log.write("quality_profile", path=path,
+              n_examples=profile["n_examples"])
 
 
 def _binary_eval_labels(grades: np.ndarray, head: str) -> np.ndarray:
@@ -933,7 +1005,7 @@ def fit(
 
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
-    _, stalls, snap = _telemetry_for(cfg, log, workdir)
+    _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
     try:
         for step_i in range(start_step, cfg.train.steps):
             t_step = time.perf_counter()
@@ -1017,6 +1089,15 @@ def fit(
 
     ckpt.wait()
     ckpt.close()
+    if cfg.obs.quality.profile_out:
+        _emit_quality_profile(
+            cfg, data_dir,
+            lambda: predict_split(
+                cfg, model, state, data_dir, "val", mesh,
+                eval_step=eval_step, cache=val_cache,
+            )[:2],
+            log,
+        )
     if snap is not None:
         snap.close()  # final telemetry/heartbeat flush; log still open
     log.close()
@@ -1371,7 +1452,7 @@ def fit_ensemble_parallel(
         flight.install_signal_handlers()
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
-    _, stalls, snap = _telemetry_for(cfg, log, workdir)
+    _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
     try:
         for step_i in range(start_step, cfg.train.steps):
             t_step = time.perf_counter()
@@ -1490,6 +1571,17 @@ def fit_ensemble_parallel(
     for c in ckpts:
         c.wait()
         c.close()
+    if cfg.obs.quality.profile_out:
+        def _ensemble_predict():
+            grades, probs = _predict_split_members(
+                cfg, state, data_dir, "val", mesh, eval_step,
+                cache=val_cache,
+            )
+            # Same reduction evaluate_checkpoints applies: float64 mean
+            # over members BEFORE any multiclass->referable collapse.
+            return grades, metrics.ensemble_average(list(probs))
+
+        _emit_quality_profile(cfg, data_dir, _ensemble_predict, log)
     if snap is not None:
         snap.close()
     log.close()
@@ -1664,10 +1756,10 @@ def fit_tf(
     best_auc, best_step, since_best = -np.inf, start_step, 0
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
-    _, stalls, snap = _telemetry_for(cfg, log, workdir)
     # No jax profiler on this backend: the flight recorder's anomaly
     # dumps still fire, with no capture hook to arm.
     flight = _flight_for(cfg, workdir, profiler=None)
+    _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
     if flight is not None:
         flight.install_signal_handlers()
     try:
@@ -1749,6 +1841,12 @@ def fit_tf(
 
     ckpt.wait()
     ckpt.close()
+    if cfg.obs.quality.profile_out:
+        _emit_quality_profile(
+            cfg, data_dir,
+            lambda: predict_split_tf(cfg, keras_model, data_dir, "val")[:2],
+            log,
+        )
     if snap is not None:
         snap.close()
     log.close()
@@ -1790,6 +1888,7 @@ def evaluate_checkpoints(
     bootstrap: int = 0,
     save_probs: str | None = None,
     calibrate: bool = False,
+    profile_out: str | None = None,
 ) -> dict:
     """Single- or multi-checkpoint (ensemble-averaged) evaluation
     (SURVEY.md §3.2; BASELINE.json:10 'averaged logits').
@@ -1811,6 +1910,12 @@ def evaluate_checkpoints(
     ``threshold_split``) and reports calibrated Brier/ECE on the eval
     split — AUC and ROC thresholds are rank-invariant under temperature,
     so only the calibration metrics change.
+    ``profile_out`` writes the versioned quality-observability reference
+    profile (obs/quality.py; ISSUE 5) for THIS checkpoint set on THIS
+    split: the ensemble score histogram, per-channel input-statistic
+    histograms, base rate, and the report's operating thresholds — the
+    artifact ``obs.quality.profile_path`` points serving at. Emit it on
+    the split the thresholds were chosen on (normally val).
     """
     if not ckpt_dirs:
         raise ValueError("need at least one checkpoint dir")
@@ -1940,6 +2045,32 @@ def evaluate_checkpoints(
             cfg.model.head, quality_by_name,
         )
         report["probs_file"] = save_probs
+    if profile_out:
+        from jama16_retina_tpu.obs import quality as quality_lib
+
+        eval_bin = (grades_by["eval"] >= 2).astype(np.float64)
+        scores = (
+            np.asarray(probs, np.float64) if cfg.model.head == "binary"
+            else np.asarray(
+                metrics.referable_probs_from_multiclass(probs), np.float64
+            )
+        )
+        stats = quality_lib.split_input_stats(
+            data_dir, split, cfg.eval.batch_size, cfg.model.image_size
+        )
+        profile = quality_lib.build_profile(
+            scores, labels=eval_bin, stat_values=stats,
+            thresholds=[
+                {"target_specificity": row["target_specificity"],
+                 "threshold": row["threshold"]}
+                for row in report["operating_points"]
+            ],
+            bins=cfg.obs.quality.score_bins,
+            meta={"config": cfg.name, "split": split,
+                  "n_models": len(ckpt_dirs), "source": "evaluate"},
+        )
+        quality_lib.save_profile(profile_out, profile)
+        report["profile_out"] = profile_out
     report["split"] = split
     report["n_models"] = len(ckpt_dirs)
     return report

@@ -156,12 +156,24 @@ def main(argv):
     # from a wedged one.
     snap = None
     if _OBS_WORKDIR.value:
+        from jama16_retina_tpu.obs import alerts as obs_alerts
         from jama16_retina_tpu.obs import export as obs_export
 
         snap = obs_export.Snapshotter(
             workdir=_OBS_WORKDIR.value, every_s=cfg.obs.flush_every_s,
         )
         snap.progress(0)
+        # Quality/SLO alerting for batch jobs (ISSUE 5): attached
+        # BEFORE any scoring on BOTH backends, so rules are evaluated
+        # at every mid-batch maybe_flush (not once at close — a
+        # `for S` rule needs the condition observed holding over
+        # time). Rules whose quality.* gauges don't exist yet are
+        # inactive, so the early attach costs nothing. A firing rule
+        # writes `alert` records into --obs_workdir's JSONL and trips
+        # a quality_drift/slo_breach blackbox dump there
+        # (obs_report --check-alerts is the CI probe). Both predict
+        # backends and the engine share the process-default registry.
+        snap.alerts = obs_alerts.manager_for(cfg, _OBS_WORKDIR.value)
 
     # Host stage: fundus normalization parallelized across a worker pool
     # (serve/host.py) with worker-count-invariant output order — the
@@ -222,6 +234,38 @@ def main(argv):
                 snap.progress(len(kept) * (mi + 1) // len(dirs))
                 snap.maybe_flush()
         probs = metrics.ensemble_average(prob_list)
+        if cfg.obs.enabled:
+            # ISSUE 5 on the legacy backend too: the tf path has no
+            # ServingEngine to host the drift monitor, so build it here
+            # — obs.quality configured on a batch job must never be a
+            # silent no-op (--check-alerts' exit-2 "configured but
+            # blind" probe keys off the profile_loaded gauge this
+            # publishes). Canary scores ride the same member loop the
+            # predictions used (weights reloaded per member).
+            from jama16_retina_tpu.obs import quality as quality_lib
+
+            monitor = quality_lib.monitor_from_config(cfg.obs.quality)
+            if monitor is not None:
+                off = 0
+                for b, n in zip(batches, block_lens):
+                    monitor.observe(b[:n], probs[off:off + n])
+                    off += n
+                if monitor.canary_claim():
+                    def _canary_scores(imgs):
+                        member = []
+                        for d in dirs:
+                            st = trainer.restore_for_eval(cfg, model, d)
+                            tf_backend.load_flax_state(
+                                keras_model, train_lib.eval_params(st),
+                                st.batch_stats,
+                            )
+                            member.append(tf_backend.predict_probs(
+                                keras_model, imgs, cfg.model.head,
+                                tta=cfg.eval.tta,
+                            ))
+                        return metrics.ensemble_average(member)
+
+                    monitor.run_canary(_canary_scores)
     else:
         # Serving engine (serve/engine.py): every member restored ONCE
         # into a device-resident stacked tree, one stacked forward per

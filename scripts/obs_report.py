@@ -35,6 +35,18 @@ the threshold, 1 when any is stale (or carries a last_progress_t older
 than the threshold — a host that still FLUSHES but stopped advancing is
 wedged on a collective, the exact failure the mtime probe missed), and
 2 when no heartbeat exists at all.
+
+Model-quality observability (ISSUE 5): runs whose registry carried the
+`quality.*` drift gauges additionally render a Quality section
+(score-PSI trend, positive rate, per-stat input PSI, canary status,
+per-reason input rejects, and per-rule alert state from `alert`
+records), and
+
+  python scripts/obs_report.py --check-alerts <workdir>
+
+is the alerting twin of --check-heartbeats: exit 0 quiet, 1 any rule
+firing, 2 a reference profile is configured but no drift window ever
+closed (monitored-but-blind).
 """
 
 from __future__ import annotations
@@ -473,6 +485,173 @@ def render_slowest(events: list, n: int = 10) -> str:
     return "\n\n".join(out)
 
 
+# ---------------------------------------------------------------------------
+# Quality: drift gauges, canary status, alert state (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+
+def _alert_states(records: list) -> dict:
+    """rule name -> its newest `alert` record (state firing/resolved)."""
+    states: dict = {}
+    for r in records:
+        if r.get("kind") != "alert" or "rule" not in r:
+            continue
+        prev = states.get(r["rule"])
+        if prev is None or r.get("t", 0) >= prev.get("t", 0):
+            states[r["rule"]] = r
+    return states
+
+
+def quality_summary(records: list) -> "dict | None":
+    """The Quality section's machine-readable form (--json twin):
+    score-PSI trend over telemetry snapshots, latest drift/positive-rate
+    gauges, canary status, per-reason input-reject counters, and the
+    per-rule alert state. None when the run carries neither quality
+    telemetry nor alert records."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    alerts = _alert_states(records)
+    q_telem = [
+        r for r in telemetry
+        if any(k.startswith("quality.") for k in r.get("gauges", {}))
+        or any(k.startswith("quality.") for k in r.get("counters", {}))
+    ]
+    if not q_telem and not alerts:
+        return None
+    latest = q_telem[-1] if q_telem else {"gauges": {}, "counters": {}}
+    gauges = latest.get("gauges", {})
+    counters = latest.get("counters", {})
+    trend = [
+        round(r["gauges"]["quality.score_psi"], 4)
+        for r in q_telem if "quality.score_psi" in r.get("gauges", {})
+    ]
+    out = {
+        "profile_loaded": bool(gauges.get("quality.profile_loaded", 0)),
+        "windows": int(counters.get("quality.windows", 0)),
+        "scores": int(counters.get("quality.scores", 0)),
+        "score_psi": gauges.get("quality.score_psi"),
+        "score_psi_trend": trend[-12:],
+        "positive_rate": gauges.get("quality.positive_rate"),
+        "input_psi": {
+            k[len("quality.input_psi."):]: round(v, 4)
+            for k, v in sorted(gauges.items())
+            if k.startswith("quality.input_psi.")
+        },
+        "input_psi_max": gauges.get("quality.input_psi_max"),
+        "canary": (
+            {
+                "ok": bool(gauges.get("quality.canary_ok", 0)),
+                "max_dev": gauges.get("quality.canary_max_dev"),
+                "runs": int(counters.get("quality.canary_runs", 0)),
+                "failures": int(
+                    counters.get("quality.canary_failures", 0)
+                ),
+            }
+            if "quality.canary_ok" in gauges else None
+        ),
+        "input_rejected": {
+            k[len("serve.input_rejected."):]: int(v)
+            for k, v in sorted(counters.items())
+            if k.startswith("serve.input_rejected.")
+        },
+        "alerts": [
+            {
+                "rule": name, "state": rec.get("state"),
+                "reason": rec.get("reason"),
+                "value": rec.get("value"),
+                "for_s": rec.get("for_s"),
+            }
+            for name, rec in sorted(alerts.items())
+        ],
+    }
+    return out
+
+
+def render_quality(records: list) -> "str | None":
+    s = quality_summary(records)
+    if s is None:
+        return None
+    out = []
+
+    def fmt(v, digits=4):
+        return "-" if v is None else f"{v:.{digits}f}"
+
+    rows = [
+        ("reference profile", "loaded" if s["profile_loaded"] else "none"),
+        ("drift windows closed", s["windows"]),
+        ("scores observed", s["scores"]),
+        ("score PSI (latest)", fmt(s["score_psi"])),
+        ("positive rate", fmt(s["positive_rate"])),
+        ("input PSI max", fmt(s["input_psi_max"])),
+    ]
+    if s["canary"]:
+        c = s["canary"]
+        rows.append((
+            "canary",
+            f"{'ok' if c['ok'] else 'FAILED'} "
+            f"({c['runs']} runs, {c['failures']} failures, "
+            f"max dev {fmt(c['max_dev'], 6)})",
+        ))
+    out.append("quality:\n" + _table(rows, ("signal", "value")))
+    if s["score_psi_trend"]:
+        out.append(
+            "score-PSI trend (oldest->newest): "
+            + " ".join(f"{v:.3f}" for v in s["score_psi_trend"])
+        )
+    if s["input_psi"]:
+        out.append(_table(
+            sorted(s["input_psi"].items()), ("input stat PSI", "value")
+        ))
+    if s["input_rejected"]:
+        out.append(_table(
+            sorted(s["input_rejected"].items()),
+            ("rejected inputs (reason)", "count"),
+        ))
+    if s["alerts"]:
+        rows = [
+            (a["rule"], a["state"] or "-", a["reason"] or "-",
+             "-" if a.get("value") is None else f"{a['value']:g}",
+             "-" if a.get("for_s") is None else f"{a['for_s']:.0f}s")
+            for a in s["alerts"]
+        ]
+        out.append("alerts:\n" + _table(
+            rows, ("rule", "state", "reason", "value", "for")
+        ))
+    return "\n\n".join(out)
+
+
+def check_alerts(workdir: str) -> tuple[int, str]:
+    """Exit-code mode mirroring --check-heartbeats: 0 quiet, 1 any rule
+    currently FIRING (last `alert` record per rule), 2 a reference
+    profile is configured (quality.profile_loaded gauge) but no drift
+    window ever closed — the monitor is wired but BLIND (too-large
+    window_scores, no traffic, or a muted registry)."""
+    records = load_records(workdir)
+    states = _alert_states(records)
+    firing = [
+        (name, rec) for name, rec in sorted(states.items())
+        if rec.get("state") == "firing"
+    ]
+    if firing:
+        return 1, "\n".join(
+            f"FIRING {name} ({rec.get('reason')}): value "
+            f"{rec.get('value')} vs {rec.get('threshold')}"
+            for name, rec in firing
+        )
+    s = quality_summary(records)
+    if s is not None and s["profile_loaded"] and s["windows"] == 0:
+        return 2, (
+            "quality profile configured but no drift window ever closed "
+            "— no quality data (check obs.quality.window_scores vs "
+            "traffic volume)"
+        )
+    if s is None:
+        return 0, "quiet (no quality telemetry or alert records)"
+    return 0, (
+        f"quiet ({s['windows']} windows, latest score PSI "
+        f"{s['score_psi']}, {len(s['alerts'])} rules seen)"
+    )
+
+
 def check_heartbeats(workdir: str, max_age_s: float,
                      now: "float | None" = None) -> tuple[int, str]:
     """(exit_code, message): 0 fresh, 1 stale/wedged, 2 none found."""
@@ -518,6 +697,11 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--max-age-s", type=float, default=300.0)
     ap.add_argument(
+        "--check-alerts", metavar="WORKDIR", default=None,
+        help="exit-code mode: 0 quiet, 1 any alert rule firing, 2 a "
+             "quality profile is configured but no drift data exists",
+    )
+    ap.add_argument(
         "--trace-out", metavar="CHROME_JSON", default=None,
         help="convert the blackbox/trace dump at PATH to Chrome "
              "trace-event JSON (open in https://ui.perfetto.dev)",
@@ -535,8 +719,13 @@ def main(argv=None) -> int:
         code, msg = check_heartbeats(args.check_heartbeats, args.max_age_s)
         print(msg)
         return code
+    if args.check_alerts:
+        code, msg = check_alerts(args.check_alerts)
+        print(msg)
+        return code
     if not args.path:
-        ap.error("need a path (or --check-heartbeats WORKDIR)")
+        ap.error("need a path (or --check-heartbeats / --check-alerts "
+                 "WORKDIR)")
 
     if args.path.endswith(".prom"):
         with open(args.path) as f:
@@ -580,6 +769,7 @@ def main(argv=None) -> int:
         print(json.dumps({
             "stalls": stalls_summary(records),
             "telemetry": telemetry[-1] if telemetry else None,
+            "quality": quality_summary(records),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
                 for p, b in sorted(latest_heartbeats(records).items())
@@ -595,6 +785,10 @@ def main(argv=None) -> int:
         print(render_snapshot(telemetry[-1]))
     else:
         print("telemetry records: none (obs.enabled=false run?)")
+    q = render_quality(records)
+    if q:
+        print()
+        print(q)
     print()
     print(render_heartbeats(records))
     if events:

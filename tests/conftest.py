@@ -108,6 +108,21 @@ _QUICK_TESTS = {
     "test_trace.py::test_obs_report_trace_out_converts_dump",
     "test_trace.py::test_obs_report_json_output_for_run_and_dump",
     "test_trace.py::test_prometheus_help_lines_scrape_parse_strict",
+    # model/data quality observability (ISSUE 5): the numpy-cheap
+    # drift/alert/report pins; the engine-backed canary tests and the
+    # end-to-end fit profile stay in the full tier (XLA compiles)
+    "test_quality.py::test_profile_roundtrip_and_version_check",
+    "test_quality.py::test_psi_debias_absorbs_small_sample_noise",
+    "test_quality.py::test_stationary_stream_fires_zero_alerts_over_20_windows",
+    "test_quality.py::test_score_distribution_shift_fires_within_3_windows",
+    "test_quality.py::test_input_brightness_shift_fires_within_3_windows",
+    "test_quality.py::test_canary_pins_then_detects_deviation",
+    "test_quality.py::test_parse_rule_grammar",
+    "test_quality.py::test_for_seconds_requires_continuous_hold",
+    "test_quality.py::test_alert_records_and_quality_drift_dump_once_per_run",
+    "test_quality.py::test_override_unknown_nested_key_did_you_mean",
+    "test_quality.py::test_check_alerts_exit_codes",
+    "test_quality.py::test_prom_rewrite_atomic_under_concurrent_reader",
 }
 
 

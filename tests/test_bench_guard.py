@@ -177,6 +177,69 @@ def test_telemetry_overhead_guard_pins_two_percent():
     assert extras["telemetry_overhead_pct"] == 0.0
 
 
+def test_quality_overhead_guard_pins_two_percent():
+    """The ISSUE 5 pin, same math as the telemetry/tracing guards: the
+    quality-monitor-instrumented device_only rate more than 2% below
+    the uninstrumented headline flags quality_overhead_ok=false; within
+    2% (or noise-faster, clamped to 0%) passes with the percentage
+    published either way."""
+    extras = {}
+    assert bench._quality_overhead_guard(extras, 985.0, 1000.0)
+    assert extras["quality_overhead_ok"] is True
+    assert extras["quality_overhead_pct"] == pytest.approx(1.5)
+    extras = {}
+    assert not bench._quality_overhead_guard(extras, 960.0, 1000.0)
+    assert extras["quality_overhead_ok"] is False
+    assert extras["quality_overhead_pct"] == pytest.approx(4.0)
+    extras = {}
+    assert bench._quality_overhead_guard(extras, 1005.0, 1000.0)
+    assert extras["quality_overhead_pct"] == 0.0
+
+
+def test_quality_observe_is_hot_path_cheap():
+    """Per-batch bound backing the bench pin off-chip: one observe()
+    over a serving-sized batch (score binning + per-image input stats +
+    amortized window publication) must stay far under a per-step
+    budget, and the DISABLED monitor must be branch-cheap."""
+    import dataclasses
+    import time
+
+    from jama16_retina_tpu.configs import QualityConfig
+    from jama16_retina_tpu.obs import quality as quality_lib
+    from jama16_retina_tpu.obs.registry import Registry
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (32, 64, 64, 3), np.uint8)
+    scores = rng.random(32)
+    prof = quality_lib.build_profile(
+        rng.random(4096),
+        stat_values=quality_lib.input_stat_values(imgs),
+        thresholds=[{"threshold": 0.5}],
+    )
+    mon = quality_lib.QualityMonitor(
+        dataclasses.replace(QualityConfig(), enabled=True,
+                            window_scores=128),
+        registry=Registry(), profile=prof,
+    )
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        mon.observe(imgs, scores)
+    per_batch = (time.perf_counter() - t0) / n
+    # ~50x headroom over the measured cost on this host; a 32-row
+    # observe above 10 ms/batch would blow the 2% bench budget anyway.
+    assert per_batch < 10e-3, f"{per_batch * 1e3:.2f} ms per observe"
+    off = quality_lib.QualityMonitor(
+        dataclasses.replace(QualityConfig(), enabled=False),
+        registry=Registry(),
+    )
+    t0 = time.perf_counter()
+    for _ in range(5000):
+        off.observe(imgs, scores)
+    per_off = (time.perf_counter() - t0) / 5000
+    assert per_off < 20e-6, f"{per_off * 1e6:.1f} us disabled observe"
+
+
 def test_instrumented_step_preserves_results_and_counts():
     """_instrumented_step (the overhead bench's workload) must change
     NOTHING about the step's math — only record around it — and its

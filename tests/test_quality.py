@@ -1,0 +1,976 @@
+"""Model & data quality observability (ISSUE 5): reference profiles,
+the online PSI drift monitor (injected score/brightness shifts fire
+within 3 windows; a stationary stream of 20+ windows fires nothing),
+the golden-set canary against a deliberately perturbed checkpoint, the
+alert rule grammar/state machine with its quality_drift flight-recorder
+trigger (exactly one dump per run, RunLog JSONL uncorrupted), the
+per-reason input-reject counters, the nested-override did-you-mean,
+obs_report's Quality section + --check-alerts exit codes, and the
+Snapshotter's atomic .prom rewrite under a concurrent reader."""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import QualityConfig, get_config, override
+from jama16_retina_tpu.obs import alerts as obs_alerts
+from jama16_retina_tpu.obs import export as obs_export
+from jama16_retina_tpu.obs import flightrec as obs_flightrec
+from jama16_retina_tpu.obs import quality as obs_quality
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+pytestmark = pytest.mark.quality
+
+WINDOW = 256
+BINS = 20
+
+
+def _load_obs_report():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(repo, "scripts", "obs_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _qcfg(**kw) -> QualityConfig:
+    base = dict(enabled=True, window_scores=WINDOW, score_bins=BINS)
+    base.update(kw)
+    return dataclasses.replace(QualityConfig(), **base)
+
+
+def _ref_scores(rng, n=8192):
+    return rng.beta(2.0, 5.0, n)
+
+
+def _ref_images(rng, n=WINDOW, size=16):
+    return rng.integers(0, 256, (n, size, size, 3), np.uint8)
+
+
+def _profile(rng):
+    imgs = _ref_images(rng, 1024)
+    return obs_quality.build_profile(
+        _ref_scores(rng),
+        labels=(_ref_scores(rng) > 0.5).astype(np.float64),
+        stat_values=obs_quality.input_stat_values(imgs),
+        thresholds=[{"target_specificity": 0.87, "threshold": 0.41}],
+        bins=BINS,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Profile artifact + divergences
+# ---------------------------------------------------------------------------
+
+
+def test_profile_roundtrip_and_version_check(tmp_path):
+    rng = np.random.default_rng(0)
+    prof = _profile(rng)
+    path = str(tmp_path / "profile.json")
+    obs_quality.save_profile(path, prof)
+    assert not os.path.exists(path + ".tmp")  # atomic publish
+    loaded = obs_quality.load_profile(path)
+    assert loaded["score_hist"] == prof["score_hist"]
+    assert loaded["bins"] == BINS
+    assert 0.0 < loaded["base_rate"] < 1.0
+    assert loaded["thresholds"][0]["threshold"] == pytest.approx(0.41)
+    assert set(loaded["input_stats"]) == set(obs_quality.INPUT_STATS)
+
+    bad = dict(prof, version=99)
+    bad_path = str(tmp_path / "bad.json")
+    with open(bad_path, "w") as f:
+        json.dump(bad, f)
+    with pytest.raises(ValueError, match="version"):
+        obs_quality.load_profile(bad_path)
+    with open(bad_path, "w") as f:
+        json.dump({"version": 1, "kind": "something_else"}, f)
+    with pytest.raises(ValueError, match="not a quality profile"):
+        obs_quality.load_profile(bad_path)
+
+
+def test_psi_identical_zero_shifted_large():
+    rng = np.random.default_rng(1)
+    a = obs_quality.bin_counts(_ref_scores(rng), BINS)
+    assert obs_quality.psi(a, a) == pytest.approx(0.0, abs=1e-12)
+    assert obs_quality.psi_debiased(a, a) == 0.0
+    shifted = obs_quality.bin_counts(
+        np.clip(_ref_scores(rng) + 0.3, 0, 1), BINS
+    )
+    assert obs_quality.psi(a, shifted) > 1.0
+    assert obs_quality.psi_debiased(a, shifted) > 1.0
+    assert obs_quality.kl_divergence(a, shifted) > 0.5
+
+
+def test_psi_debias_absorbs_small_sample_noise():
+    """The published gauge subtracts the (bins-1)/n sampling
+    expectation: same-distribution windows must sit near 0, NOT near
+    the raw chi2-scale noise floor that would eat the alert margin."""
+    rng = np.random.default_rng(2)
+    ref = obs_quality.bin_counts(_ref_scores(rng), BINS)
+    raw, debiased = [], []
+    for _ in range(50):
+        cur = obs_quality.bin_counts(rng.beta(2.0, 5.0, WINDOW), BINS)
+        raw.append(obs_quality.psi(ref, cur))
+        debiased.append(obs_quality.psi_debiased(ref, cur))
+    assert np.mean(raw) > 0.04  # the bias is real at this window size
+    assert max(debiased) < 0.15  # and the correction removes it
+
+
+def test_input_stat_values_shapes_and_ranges():
+    rng = np.random.default_rng(3)
+    imgs = _ref_images(rng, 32)
+    stats = obs_quality.input_stat_values(imgs)
+    assert set(stats) == set(obs_quality.INPUT_STATS)
+    for k, v in stats.items():
+        assert v.shape == (32,)
+        assert np.all(v >= 0.0) and np.all(v <= 1.0), k
+    white = np.full((2, 8, 8, 3), 255, np.uint8)
+    s = obs_quality.input_stat_values(white)
+    assert s["brightness"] == pytest.approx([1.0, 1.0])
+    assert s["std"] == pytest.approx([0.0, 0.0])
+    with pytest.raises(ValueError, match="images"):
+        obs_quality.input_stat_values(np.zeros((4, 8, 8)))
+
+
+# ---------------------------------------------------------------------------
+# Online drift monitor: the acceptance scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_stationary_stream_fires_zero_alerts_over_20_windows():
+    """Acceptance: >= 20 windows drawn from the SAME distribution as
+    the profile (fresh seed) never trip the built-in PSI rules."""
+    rng = np.random.default_rng(10)
+    prof = _profile(rng)
+    qcfg = _qcfg()
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(qcfg, registry=reg, profile=prof)
+    am = obs_alerts.AlertManager(
+        obs_alerts.quality_rules(qcfg), registry=reg
+    )
+    live = np.random.default_rng(777)
+    fired = []
+    for w in range(22):
+        mon.observe(_ref_images(live), live.beta(2.0, 5.0, WINDOW))
+        fired += am.evaluate(reg.snapshot(), now=float(w))
+    assert fired == []
+    snap = reg.snapshot()
+    assert snap["counters"]["quality.windows"] == 22
+    assert snap["gauges"]["quality.score_psi"] < 0.2
+    assert snap["gauges"]["quality.input_psi_max"] < 0.25
+    # Positive rate tracked against the profile's primary threshold.
+    assert 0.0 < snap["gauges"]["quality.positive_rate"] < 1.0
+
+
+def test_score_distribution_shift_fires_within_3_windows():
+    rng = np.random.default_rng(11)
+    prof = _profile(rng)
+    qcfg = _qcfg()
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(qcfg, registry=reg, profile=prof)
+    am = obs_alerts.AlertManager(
+        obs_alerts.quality_rules(qcfg), registry=reg
+    )
+    live = np.random.default_rng(778)
+    for w in range(3):
+        shifted = np.clip(live.beta(2.0, 5.0, WINDOW) + 0.25, 0, 1)
+        mon.observe(_ref_images(live), shifted)
+        fired = am.evaluate(reg.snapshot(), now=float(w))
+        if any(f["metric"] == "quality.score_psi" for f in fired):
+            break
+    else:
+        pytest.fail("score-PSI rule did not fire within 3 windows")
+    assert fired[0]["reason"] == "quality_drift"
+
+
+def test_input_brightness_shift_fires_within_3_windows():
+    rng = np.random.default_rng(12)
+    prof = _profile(rng)
+    qcfg = _qcfg()
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(qcfg, registry=reg, profile=prof)
+    am = obs_alerts.AlertManager(
+        obs_alerts.quality_rules(qcfg), registry=reg
+    )
+    live = np.random.default_rng(779)
+    for w in range(3):
+        bright = np.clip(
+            _ref_images(live).astype(np.int32) + 60, 0, 255
+        ).astype(np.uint8)
+        # Scores stay STATIONARY: only the input statistics moved.
+        mon.observe(bright, live.beta(2.0, 5.0, WINDOW))
+        fired = am.evaluate(reg.snapshot(), now=float(w))
+        if any(f["metric"] == "quality.input_psi_max" for f in fired):
+            break
+    else:
+        pytest.fail("input-PSI rule did not fire within 3 windows")
+    snap = reg.snapshot()
+    assert snap["gauges"]["quality.input_psi.brightness"] > 0.25
+    assert not any(f["metric"] == "quality.score_psi" for f in fired)
+
+
+def test_imageless_window_resets_input_psi_gauges():
+    """A window with no image statistics carries no input-drift
+    evidence: its close must republish the input-PSI gauges at 0 so a
+    past drifted window can't keep the input alert latched forever
+    (score-only call sites / non-image batcher rows)."""
+    rng = np.random.default_rng(15)
+    prof = _profile(rng)
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(
+        _qcfg(window_scores=WINDOW), registry=reg, profile=prof
+    )
+    live = np.random.default_rng(881)
+    bright = np.clip(
+        _ref_images(live).astype(np.int32) + 60, 0, 255
+    ).astype(np.uint8)
+    mon.observe(bright, live.beta(2.0, 5.0, WINDOW))
+    assert reg.snapshot()["gauges"]["quality.input_psi_max"] > 0.25
+    mon.observe(None, live.beta(2.0, 5.0, WINDOW))  # score-only window
+    snap = reg.snapshot()
+    assert snap["gauges"]["quality.input_psi_max"] == 0.0
+    assert snap["gauges"]["quality.input_psi.brightness"] == 0.0
+
+
+def test_no_profile_mode_skips_input_stat_extraction():
+    """enabled + no profile = positive-rate/canary monitoring only: the
+    per-pixel input-statistic pass (the dominant observe cost) must not
+    run when there are no reference histograms to compare against."""
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(_qcfg(window_scores=4), registry=reg)
+    mon.observe(_ref_images(np.random.default_rng(16), 4),
+                np.array([0.1, 0.2, 0.6, 0.9]))
+    snap = reg.snapshot()
+    assert snap["counters"]["quality.windows"] == 1
+    assert snap["gauges"]["quality.positive_rate"] == 0.5
+    assert mon._stat_n == 0  # stats never accumulated
+
+
+def test_monitor_multiclass_scores_reduce_to_referable():
+    rng = np.random.default_rng(13)
+    prof = _profile(rng)
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(
+        _qcfg(window_scores=8), registry=reg, profile=prof
+    )
+    probs5 = rng.dirichlet(np.ones(5), size=8)
+    mon.observe(None, probs5)  # images=None: score drift only
+    snap = reg.snapshot()
+    assert snap["counters"]["quality.scores"] == 8
+    assert snap["counters"]["quality.windows"] == 1
+
+
+def test_disabled_monitor_is_one_branch():
+    """Acceptance: obs.quality.enabled=False adds no per-request work
+    beyond one branch — no accumulators exist, no registry traffic."""
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(
+        _qcfg(enabled=False), registry=reg
+    )
+    mon.observe(_ref_images(np.random.default_rng(0), 4),
+                np.array([0.1, 0.2, 0.3, 0.4]))
+    snap = reg.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+    assert not hasattr(mon, "_score_counts")
+
+
+def test_monitor_rejects_mismatched_profile_bins():
+    rng = np.random.default_rng(14)
+    prof = _profile(rng)
+    with pytest.raises(ValueError, match="bins"):
+        obs_quality.QualityMonitor(
+            _qcfg(score_bins=10), registry=obs_registry.Registry(),
+            profile=prof,
+        )
+
+
+def test_monitor_thread_safe_accumulation():
+    rng = np.random.default_rng(15)
+    prof = _profile(rng)
+    reg = obs_registry.Registry()
+    mon = obs_quality.QualityMonitor(
+        _qcfg(window_scores=50), registry=reg, profile=prof
+    )
+    n_threads, per = 8, 40
+
+    def work(seed):
+        r = np.random.default_rng(seed)
+        for _ in range(per):
+            mon.observe(None, r.random(5))
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.snapshot()["counters"]["quality.scores"] == \
+        n_threads * per * 5
+    assert reg.snapshot()["counters"]["quality.windows"] == \
+        n_threads * per * 5 // 50
+
+
+# ---------------------------------------------------------------------------
+# Golden-set canary
+# ---------------------------------------------------------------------------
+
+
+def test_canary_pins_then_detects_deviation(tmp_path):
+    rng = np.random.default_rng(20)
+    imgs = _ref_images(rng, 4)
+    reg = obs_registry.Registry()
+    canary = obs_quality.GoldenCanary(
+        imgs, every_s=100.0, registry=reg
+    )
+    assert reg.snapshot()["gauges"]["quality.canary_ok"] == 1.0  # optimistic
+    stable = lambda im: im.reshape(im.shape[0], -1).mean(axis=1) / 255.0
+    r1 = canary.check(stable)
+    assert r1["pinned"] and r1["ok"]
+    r2 = canary.check(stable)
+    assert r2 == {"ok": True, "pinned": False, "max_abs_dev": 0.0}
+    drifted = lambda im: stable(im) + 1e-9  # one-ulp-scale regression
+    r3 = canary.check(drifted)
+    assert not r3["ok"] and r3["max_abs_dev"] > 0
+    snap = reg.snapshot()
+    assert snap["gauges"]["quality.canary_ok"] == 0.0
+    assert snap["counters"]["quality.canary_runs"] == 3
+    assert snap["counters"]["quality.canary_failures"] == 1
+
+    # Artifact roundtrip: images + pinned scores.
+    path = str(tmp_path / "canary.npz")
+    assert obs_quality.save_canary(path, imgs, stable(imgs)) == path
+    images, pinned = obs_quality.load_canary_file(path)
+    np.testing.assert_array_equal(images, imgs)
+    np.testing.assert_array_equal(pinned, stable(imgs))
+    # Extensionless path: the return names the file actually written
+    # (np.savez appends .npz), so it feeds canary_path as-is.
+    out = obs_quality.save_canary(str(tmp_path / "bare"), imgs)
+    assert out.endswith("bare.npz")
+    obs_quality.load_canary_file(out)
+
+
+def test_canary_shape_mismatch_publishes_sentinel_dev():
+    """A checkpoint-head or canary-set swap makes the live scores'
+    shape mismatch the pinned set: the run must FAIL with the -1
+    deviation sentinel, not report max dev 0.0 alongside canary_ok=0."""
+    rng = np.random.default_rng(22)
+    imgs = _ref_images(rng, 4)
+    reg = obs_registry.Registry()
+    canary = obs_quality.GoldenCanary(
+        imgs, reference_scores=np.zeros(4), registry=reg
+    )
+    r = canary.check(lambda im: np.zeros(im.shape[0] + 1))
+    assert not r["ok"] and r["max_abs_dev"] == float("inf")
+    snap = reg.snapshot()
+    assert snap["gauges"]["quality.canary_ok"] == 0.0
+    assert snap["gauges"]["quality.canary_max_dev"] == -1.0
+
+
+def test_canary_cadence():
+    rng = np.random.default_rng(21)
+    canary = obs_quality.GoldenCanary(
+        _ref_images(rng, 2), every_s=100.0,
+        registry=obs_registry.Registry(),
+    )
+    assert canary.due(now=0.0)  # never ran
+    canary.check(lambda im: np.zeros(im.shape[0]), now=0.0)
+    assert not canary.due(now=50.0)
+    assert canary.due(now=150.0)
+    # claim_due: exactly one concurrent caller wins the run slot.
+    assert canary.claim_due(now=150.0)
+    assert not canary.claim_due(now=150.0)
+    assert not canary.due(now=150.0)  # the claim stamped the cadence
+    never = obs_quality.GoldenCanary(
+        _ref_images(rng, 2), every_s=0.0,
+        registry=obs_registry.Registry(),
+    )
+    assert not never.due(now=1e9)  # cadence disabled
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    """A k=1 smoke engine state pair — original and perturbed — for the
+    canary-vs-checkpoint acceptance test (one XLA compile, shared)."""
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import ServeConfig
+
+    cfg = override(get_config("smoke"), ["model.image_size=32"])
+    cfg = cfg.replace(serve=ServeConfig(max_batch=8, bucket_sizes=(8,)))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [0])
+    state = jax.device_get(state)
+    perturbed = state.replace(
+        params=jax.tree.map(lambda x: x + 1e-2, state.params)
+    )
+    return cfg, model, state, perturbed
+
+
+def test_canary_detects_perturbed_checkpoint(tiny_engine_parts):
+    """Acceptance: the canary catches a checkpoint whose weights moved
+    — the silent-regression class PSI windows cannot see (every score
+    shifts a little; the distribution barely moves)."""
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg, model, state, perturbed = tiny_engine_parts
+    imgs = np.random.default_rng(30).integers(
+        0, 256, (4, 32, 32, 3), np.uint8
+    )
+    reg = obs_registry.Registry()
+    engine = ServingEngine(cfg, model=model, state=state, registry=reg)
+    canary = obs_quality.GoldenCanary(imgs, registry=reg)
+    assert canary.check(engine.probs)["pinned"]
+    assert canary.check(engine.probs)["ok"]  # same checkpoint: byte-stable
+
+    reg2 = obs_registry.Registry()
+    engine2 = ServingEngine(
+        cfg, model=model, state=perturbed, registry=reg2
+    )
+    canary2 = obs_quality.GoldenCanary(
+        imgs, reference_scores=canary.reference, registry=reg2
+    )
+    res = canary2.check(engine2.probs)
+    assert not res["ok"] and res["max_abs_dev"] > 0
+    assert reg2.snapshot()["gauges"]["quality.canary_ok"] == 0.0
+
+
+def test_engine_probs_feeds_monitor_and_canary(tiny_engine_parts, tmp_path):
+    """The serving hook end to end: a config-wired engine loads the
+    profile + canary artifacts, observes live probs() traffic, and runs
+    the due canary WITHOUT polluting the drift windows."""
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg, model, state, _ = tiny_engine_parts
+    rng = np.random.default_rng(31)
+    imgs = rng.integers(0, 256, (8, 32, 32, 3), np.uint8)
+    prof_path = str(tmp_path / "profile.json")
+    obs_quality.save_profile(prof_path, obs_quality.build_profile(
+        _ref_scores(rng),
+        stat_values=obs_quality.input_stat_values(imgs),
+        thresholds=[{"threshold": 0.5}], bins=BINS,
+    ))
+    canary_path = str(tmp_path / "canary.npz")
+    obs_quality.save_canary(canary_path, imgs[:2])
+    cfg_q = cfg.replace(obs=dataclasses.replace(
+        cfg.obs,
+        quality=_qcfg(window_scores=8, profile_path=prof_path,
+                      canary_path=canary_path, canary_every_s=1e9),
+    ))
+    reg = obs_registry.Registry()
+    engine = ServingEngine(cfg_q, model=model, state=state, registry=reg)
+    assert engine.quality is not None
+    engine.probs(imgs)
+    snap = reg.snapshot()
+    # Only the 8 live rows landed in the drift window — the canary's 2
+    # rows were scored through member_probs and stayed out.
+    assert snap["counters"]["quality.scores"] == 8
+    assert snap["counters"]["quality.windows"] == 1
+    assert snap["counters"]["quality.canary_runs"] == 1
+    assert snap["gauges"]["quality.canary_ok"] == 1.0
+    assert snap["gauges"]["quality.profile_loaded"] == 1.0
+
+    # Disabled quality -> no monitor object at all (one branch in probs).
+    engine_off = ServingEngine(
+        cfg, model=model, state=state, registry=obs_registry.Registry()
+    )
+    assert engine_off.quality is None
+
+
+def test_engine_rejects_mis_sized_canary(tiny_engine_parts, tmp_path):
+    """A canary .npz whose images don't match model.image_size must
+    fail ENGINE CONSTRUCTION loudly — caught at cadence time it would
+    fail one live probs() request per canary_every_s forever."""
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg, model, state, _ = tiny_engine_parts
+    rng = np.random.default_rng(33)
+    canary_path = str(tmp_path / "wrong.npz")
+    obs_quality.save_canary(
+        canary_path, rng.integers(0, 256, (2, 16, 16, 3), np.uint8)
+    )
+    cfg_q = cfg.replace(obs=dataclasses.replace(
+        cfg.obs, quality=_qcfg(canary_path=canary_path),
+    ))
+    with pytest.raises(ValueError, match="canary images are"):
+        ServingEngine(
+            cfg_q, model=model, state=state,
+            registry=obs_registry.Registry(),
+        )
+
+
+def test_canary_scoring_exception_is_isolated():
+    """A raising score_fn is a recorded canary FAILURE, not an
+    exception out of the live request the canary rode in on."""
+    rng = np.random.default_rng(34)
+    reg = obs_registry.Registry()
+    canary = obs_quality.GoldenCanary(
+        _ref_images(rng, 2), reference_scores=np.zeros(2), registry=reg
+    )
+
+    def broken(_):
+        raise RuntimeError("serving path regression")
+
+    r = canary.check(broken)
+    assert not r["ok"] and "RuntimeError" in r["error"]
+    snap = reg.snapshot()
+    assert snap["gauges"]["quality.canary_ok"] == 0.0
+    assert snap["gauges"]["quality.canary_max_dev"] == -1.0
+    assert snap["counters"]["quality.canary_failures"] == 1
+    # The cadence ticked: no tight retry loop on a persistent failure.
+    assert not canary.due(now=canary._last_run + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Alert rules + manager
+# ---------------------------------------------------------------------------
+
+
+def test_parse_rule_grammar():
+    r = obs_alerts.parse_rule(
+        "quality.score_psi > 0.2 for 120 -> quality_drift"
+    )
+    assert r == obs_alerts.AlertRule(
+        "quality.score_psi", ">", 0.2, 120.0, "quality_drift"
+    )
+    assert obs_alerts.parse_rule("serve.request_latency_s.p99<=0.5") == \
+        obs_alerts.AlertRule("serve.request_latency_s.p99", "<=", 0.5)
+    r2 = obs_alerts.parse_rule("rate(serve.input_rejected) > 2 for 60s")
+    assert r2.metric == "rate(serve.input_rejected)"
+    assert r2.for_seconds == 60.0 and r2.reason == "slo_breach"
+    for bad in ("nonsense", "a >", "> 3", "a ~ 3", "a > b"):
+        with pytest.raises(ValueError, match="alert rule"):
+            obs_alerts.parse_rule(bad)
+
+
+def test_resolve_metric_gauge_counter_histogram_rate():
+    reg = obs_registry.Registry()
+    reg.gauge("g").set(3.0)
+    reg.counter("c").inc(10)
+    h = reg.histogram("lat_s", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    snap = reg.snapshot()
+    assert obs_alerts.resolve_metric(snap, "g") == 3.0
+    assert obs_alerts.resolve_metric(snap, "c") == 10.0
+    assert obs_alerts.resolve_metric(snap, "lat_s.count") == 1.0
+    assert obs_alerts.resolve_metric(snap, "lat_s.p99") is not None
+    assert obs_alerts.resolve_metric(snap, "missing") is None
+    assert obs_alerts.resolve_metric(snap, "rate(c)") is None  # no prev
+    prev = {"counters": {"c": 4.0}}
+    assert obs_alerts.resolve_metric(snap, "rate(c)", prev=prev, dt=2.0) \
+        == pytest.approx(3.0)
+
+
+def test_for_seconds_requires_continuous_hold():
+    reg = obs_registry.Registry()
+    g = reg.gauge("m")
+    am = obs_alerts.AlertManager(
+        [obs_alerts.AlertRule("m", ">", 1.0, for_seconds=10.0)],
+        registry=reg,
+    )
+    g.set(5.0)
+    assert am.evaluate(reg.snapshot(), now=0.0) == []  # held 0s
+    assert am.evaluate(reg.snapshot(), now=5.0) == []  # held 5s
+    g.set(0.0)
+    assert am.evaluate(reg.snapshot(), now=8.0) == []  # reset
+    g.set(5.0)
+    assert am.evaluate(reg.snapshot(), now=9.0) == []
+    fired = am.evaluate(reg.snapshot(), now=20.0)  # held 11s
+    assert len(fired) == 1 and fired[0]["for_s"] == pytest.approx(11.0)
+    assert am.firing() == ["m>1 for 10s"]
+
+
+def test_alert_records_and_quality_drift_dump_once_per_run(tmp_path):
+    """Acceptance: a persistently-firing drift rule produces EXACTLY ONE
+    quality_drift blackbox dump per run, `alert` firing/resolved records
+    land in the RunLog, and the JSONL stays uncorrupted throughout."""
+    from jama16_retina_tpu.utils.logging import RunLog
+
+    workdir = str(tmp_path / "run")
+    reg = obs_registry.Registry()
+    g = reg.gauge("quality.score_psi")
+    flight = obs_flightrec.FlightRecorder(workdir, config={"x": 1},
+                                          registry=reg)
+    qcfg = _qcfg()
+    am = obs_alerts.AlertManager(
+        obs_alerts.quality_rules(qcfg), registry=reg, flight=flight
+    )
+    log = RunLog(workdir)
+    snap = obs_export.Snapshotter(reg, workdir, runlog=log, every_s=1e9,
+                                  alerts=am)
+    g.set(5.0)  # way over psi_alert
+    for _ in range(4):  # firing persists across flushes
+        snap.flush()
+    g.set(0.0)
+    snap.flush()  # resolves
+    snap.close()
+    log.close()
+
+    dumps = sorted(os.listdir(os.path.join(workdir, "blackbox")))
+    assert len(dumps) == 1 and dumps[0].endswith("quality_drift")
+    meta = json.load(open(os.path.join(
+        workdir, "blackbox", dumps[0], "meta.json"
+    )))
+    assert meta["reason"] == "quality_drift"
+    assert "score_psi" in meta["rule"]
+
+    # JSONL uncorrupted: every line parses, alert transitions recorded
+    # once each (not per flush).
+    path = os.path.join(workdir, "metrics.jsonl")
+    with open(path) as f:
+        lines = [l for l in f.read().splitlines() if l]
+    parsed = [json.loads(l) for l in lines]  # raises if torn
+    alerts = [r for r in parsed if r["kind"] == "alert"]
+    assert [a["state"] for a in alerts] == ["firing", "resolved"]
+    assert alerts[0]["reason"] == "quality_drift"
+    assert read_jsonl(path)  # the package reader agrees
+
+
+def test_quality_rules_from_config():
+    qcfg = _qcfg(alert_rules=("rate(serve.input_rejected) > 2 for 60",))
+    rules = obs_alerts.quality_rules(qcfg)
+    metrics_covered = {r.metric for r in rules}
+    assert {"quality.score_psi", "quality.input_psi_max",
+            "quality.canary_ok",
+            "rate(serve.input_rejected)"} == metrics_covered
+    built_in = [r for r in rules if r.metric.startswith("quality.")]
+    assert all(r.reason == "quality_drift" for r in built_in)
+    assert obs_alerts.quality_rules(_qcfg(enabled=False)) == []
+
+
+def test_manager_for_trainerless_wiring(tmp_path):
+    """The ONE wiring rule serving/predict share: rules implied by the
+    config, FlightRecorder over the workdir; None when obs is off or
+    no rules exist."""
+    cfg = get_config("smoke")
+    cfg_q = cfg.replace(obs=dataclasses.replace(cfg.obs, quality=_qcfg()))
+    reg = obs_registry.Registry()
+    am = obs_alerts.manager_for(cfg_q, str(tmp_path), registry=reg)
+    assert am is not None and len(am.rules) == 3
+    assert am._flight is not None and am._flight.workdir == str(tmp_path)
+    assert obs_alerts.manager_for(cfg, str(tmp_path)) is None  # quality off
+    cfg_off = cfg_q.replace(
+        obs=dataclasses.replace(cfg_q.obs, enabled=False)
+    )
+    assert obs_alerts.manager_for(cfg_off, str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Per-reason input-reject counters (serve/host.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_host_reject_reason_counters(tmp_path):
+    from jama16_retina_tpu.serve import host as serve_host
+
+    not_image = tmp_path / "junk.jpeg"
+    not_image.write_bytes(b"this is not an image")
+    blank = tmp_path / "blank.png"
+    import cv2
+
+    cv2.imwrite(str(blank), np.zeros((64, 64, 3), np.uint8))
+    reg = obs_registry.Registry()
+    res = serve_host.preprocess_paths(
+        [str(not_image), str(blank)], 32, workers=1, registry=reg
+    )
+    assert res.images.shape[0] == 0 and len(res.skipped) == 2
+    snap = reg.snapshot()
+    assert snap["counters"]["serve.input_rejected"] == 2
+    assert snap["counters"]["serve.input_rejected.decode_error"] == 1
+    assert snap["counters"]["serve.input_rejected.not_fundus"] == 1
+    # help: strings surface in the snapshot -> .prom # HELP lines.
+    assert "serve.input_rejected.decode_error" in snap["help"]
+    prom = obs_export.prometheus_text(snap)
+    assert "# HELP serve_input_rejected_decode_error" in prom
+
+
+def test_reject_reason_slugs():
+    from jama16_retina_tpu.serve.host import reject_reason_slug
+
+    assert reject_reason_slug("unreadable") == "decode_error"
+    assert reject_reason_slug(
+        "no fundus found: detected radius 3.0px too small"
+    ) == "too_small"
+    assert reject_reason_slug(
+        "no fundus found: no pixels above background threshold"
+    ) == "not_fundus"
+    assert reject_reason_slug("surprising new failure") == "other"
+
+
+# ---------------------------------------------------------------------------
+# Nested override did-you-mean (configs.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_override_nested_quality_fields():
+    cfg = get_config("smoke")
+    cfg = override(cfg, [
+        "obs.quality.enabled=true",
+        "obs.quality.window_scores=64",
+        "obs.quality.alert_rules=quality.score_psi>0.3 for 60,m<1",
+    ])
+    assert cfg.obs.quality.enabled is True
+    assert cfg.obs.quality.window_scores == 64
+    assert cfg.obs.quality.alert_rules == (
+        "quality.score_psi>0.3 for 60", "m<1",
+    )
+
+
+def test_override_unknown_nested_key_did_you_mean():
+    cfg = get_config("smoke")
+    with pytest.raises(ValueError) as e:
+        override(cfg, ["obs.quality.windw_scores=5"])
+    msg = str(e.value)
+    assert "did you mean 'window_scores'" in msg
+    assert "QualityConfig" in msg and "psi_alert" in msg
+    with pytest.raises(ValueError, match="did you mean 'quality'"):
+        override(cfg, ["obs.qality.enabled=true"])
+    with pytest.raises(ValueError, match="set its fields individually"):
+        override(cfg, ["obs.quality=1"])
+    # The flat paths keep their old behavior (typo still loud).
+    with pytest.raises(ValueError, match="did you mean 'steps'"):
+        override(cfg, ["train.stps=1"])
+    # An over-deep path (walked past a leaf value) is the clean
+    # ValueError too, not a dataclasses.fields TypeError.
+    with pytest.raises(ValueError, match="already reached a int value"):
+        override(cfg, ["train.steps.x=1"])
+    # A PROPERTY (readable, not replaceable) is an unknown FIELD, not a
+    # TypeError out of dataclasses.replace.
+    with pytest.raises(ValueError, match="unknown config field 'num_classes'"):
+        override(cfg, ["model.num_classes=5"])
+
+
+# ---------------------------------------------------------------------------
+# obs_report: Quality section + --check-alerts exit codes
+# ---------------------------------------------------------------------------
+
+
+def _write_quality_workdir(workdir, windows=3, firing=False,
+                           profile_loaded=True):
+    os.makedirs(workdir, exist_ok=True)
+    lines = []
+    for w in range(max(1, windows if windows else 1)):
+        gauges = {
+            "quality.profile_loaded": 1.0 if profile_loaded else 0.0,
+            "quality.positive_rate": 0.22,
+            "quality.canary_ok": 1.0,
+            "quality.canary_max_dev": 0.0,
+        }
+        if windows:
+            gauges["quality.score_psi"] = 0.05 * (w + 1)
+            gauges["quality.input_psi_max"] = 0.03
+            gauges["quality.input_psi.brightness"] = 0.03
+        lines.append(json.dumps({
+            "kind": "telemetry", "t": 1000.0 + w,
+            "counters": {"quality.windows": windows and w + 1,
+                         "quality.scores": 256 * (w + 1),
+                         "quality.canary_runs": 1,
+                         "serve.input_rejected.decode_error": 2},
+            "gauges": gauges, "histograms": {},
+        }))
+    if firing:
+        lines.append(json.dumps({
+            "kind": "alert", "t": 2000.0, "rule": "quality.score_psi>0.2",
+            "state": "firing", "metric": "quality.score_psi",
+            "value": 0.4, "threshold": 0.2, "for_s": 0.0,
+            "reason": "quality_drift",
+        }))
+    with open(os.path.join(workdir, "metrics.jsonl"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def test_obs_report_quality_section_text_and_json(tmp_path, capsys):
+    rep = _load_obs_report()
+    w = str(tmp_path / "w")
+    _write_quality_workdir(w, windows=3, firing=True)
+    assert rep.main([w]) == 0
+    out = capsys.readouterr().out
+    assert "quality:" in out
+    assert "score-PSI trend" in out
+    assert "0.050 0.100 0.150" in out
+    assert "rejected inputs" in out
+    assert "quality.score_psi>0.2" in out and "firing" in out
+    assert rep.main(["--json", w]) == 0
+    data = json.loads(capsys.readouterr().out)
+    q = data["quality"]
+    assert q["windows"] == 3
+    assert q["score_psi_trend"] == [0.05, 0.1, 0.15]
+    assert q["input_rejected"] == {"decode_error": 2}
+    assert q["alerts"][0]["state"] == "firing"
+
+
+def test_check_alerts_exit_codes(tmp_path, capsys):
+    rep = _load_obs_report()
+    quiet = str(tmp_path / "quiet")
+    _write_quality_workdir(quiet, windows=3, firing=False)
+    code, msg = rep.check_alerts(quiet)
+    assert code == 0 and "quiet" in msg
+
+    firing = str(tmp_path / "firing")
+    _write_quality_workdir(firing, windows=3, firing=True)
+    code, msg = rep.check_alerts(firing)
+    assert code == 1 and "FIRING" in msg
+
+    # Resolved later -> quiet again (last state per rule wins).
+    with open(os.path.join(firing, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "kind": "alert", "t": 3000.0,
+            "rule": "quality.score_psi>0.2", "state": "resolved",
+            "reason": "quality_drift",
+        }) + "\n")
+    assert rep.check_alerts(firing)[0] == 0
+
+    blind = str(tmp_path / "blind")
+    _write_quality_workdir(blind, windows=0, firing=False)
+    code, msg = rep.check_alerts(blind)
+    assert code == 2 and "no quality data" in msg
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert rep.check_alerts(empty)[0] == 0  # nothing configured: quiet
+
+    # CLI surface.
+    assert rep.main(["--check-alerts", quiet]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Snapshotter atomic .prom under a concurrent reader (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prom_rewrite_atomic_under_concurrent_reader(tmp_path):
+    """A reader re-reading telemetry.prom while serve-style threads
+    churn the quality gauges and the snapshotter rewrites must NEVER
+    observe a torn/partial file: every read parses as complete
+    Prometheus text (trailing newline, every # TYPE'd metric carries a
+    value line)."""
+    rep = _load_obs_report()
+    reg = obs_registry.Registry()
+    g_psi = reg.gauge("quality.score_psi")
+    g_rate = reg.gauge("quality.positive_rate")
+    c = reg.counter("quality.scores")
+    snap = obs_export.Snapshotter(reg, str(tmp_path), every_s=1e9)
+    snap.flush()
+    path = tmp_path / "telemetry.prom"
+    stop = threading.Event()
+    problems = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            g_psi.set(0.001 * (i % 997))
+            g_rate.set(0.5)
+            c.inc(7)
+            i += 1
+
+    def flusher():
+        while not stop.is_set():
+            snap.flush()
+
+    def reader():
+        while not stop.is_set():
+            text = path.read_text()
+            if not text.endswith("\n"):
+                problems.append("missing trailing newline (torn write)")
+                return
+            parsed = rep.parse_prom(text)
+            if "quality_score_psi" not in parsed["gauges"] or \
+                    "quality_scores" not in parsed["counters"]:
+                problems.append(f"partial snapshot: {sorted(parsed['gauges'])}")
+                return
+
+    threads = [threading.Thread(target=f)
+               for f in (churn, churn, flusher, reader, reader)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    snap.close()
+    assert not problems, problems
+    assert snap.flushes > 2  # the rewrite loop actually ran
+
+
+# ---------------------------------------------------------------------------
+# End to end: trainer end-of-fit profile artifact
+# ---------------------------------------------------------------------------
+
+
+def test_fit_emits_reference_profile(tmp_path_factory):
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.data import tfrecord
+
+    data_dir = str(tmp_path_factory.mktemp("q_data"))
+    tfrecord.write_synthetic_split(data_dir, "train", 32, 32, 2, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 16, 32, 1, seed=2)
+    workdir = str(tmp_path_factory.mktemp("q_run"))
+    prof_path = os.path.join(workdir, "profile.json")
+    cfg = override(get_config("smoke"), [
+        "model.image_size=32",
+        "train.steps=4", "train.eval_every=4", "train.log_every=2",
+        "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+        f"obs.quality.profile_out={prof_path}",
+    ])
+    prev = obs_registry.set_default_registry(obs_registry.Registry())
+    try:
+        trainer.fit(cfg, data_dir, workdir, seed=0)
+    finally:
+        obs_registry.set_default_registry(prev)
+    prof = obs_quality.load_profile(prof_path)
+    assert prof["n_examples"] == 16
+    assert sum(prof["score_hist"]) == 16
+    assert set(prof["input_stats"]) == set(obs_quality.INPUT_STATS)
+    assert prof["meta"]["source"] == "trainer_end_of_fit"
+    # The run logged the artifact emission.
+    recs = read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+    assert any(r["kind"] == "quality_profile" for r in recs)
+    # And the artifact round-trips into a working monitor.
+    mon = obs_quality.QualityMonitor(
+        _qcfg(window_scores=4), registry=obs_registry.Registry(),
+        profile=prof,
+    )
+    mon.observe(None, np.array([0.1, 0.4, 0.6, 0.9]))
+
+
+def test_fit_ensemble_parallel_emits_reference_profile(tmp_path_factory):
+    """obs.quality.profile_out must not silently no-op on the
+    member-parallel driver: the stacked run emits one profile over the
+    ensemble-AVERAGED val scores, same artifact contract as fit()."""
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.data import tfrecord
+
+    data_dir = str(tmp_path_factory.mktemp("qep_data"))
+    tfrecord.write_synthetic_split(data_dir, "train", 32, 32, 2, seed=1)
+    tfrecord.write_synthetic_split(data_dir, "val", 16, 32, 1, seed=2)
+    workdir = str(tmp_path_factory.mktemp("qep_run"))
+    prof_path = os.path.join(workdir, "profile.json")
+    cfg = override(get_config("smoke"), [
+        "model.image_size=32",
+        "train.ensemble_size=2", "train.ensemble_parallel=true",
+        "train.steps=4", "train.eval_every=4", "train.log_every=2",
+        "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+        f"obs.quality.profile_out={prof_path}",
+    ])
+    prev = obs_registry.set_default_registry(obs_registry.Registry())
+    try:
+        results = trainer.fit_ensemble(cfg, data_dir, workdir)
+    finally:
+        obs_registry.set_default_registry(prev)
+    assert [r["member"] for r in results] == [0, 1]
+    prof = obs_quality.load_profile(prof_path)
+    assert prof["n_examples"] == 16
+    assert sum(prof["score_hist"]) == 16
+    assert set(prof["input_stats"]) == set(obs_quality.INPUT_STATS)
+    recs = read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+    assert any(r["kind"] == "quality_profile" for r in recs)
