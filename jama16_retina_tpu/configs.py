@@ -120,6 +120,15 @@ class DataConfig:
     saturation_range: tuple[float, float] = (0.8, 1.2)
     hue_delta: float = 0.05
     rotate: bool = True  # fundus images have rotational symmetry
+    # Per-record poison quarantine (ISSUE 6): a record whose payload
+    # fails to decode (corrupt JPEG, truncated proto) is COUNTED
+    # (data.quarantined{reason}) and deterministically substituted with
+    # the next decodable record instead of killing the decode epoch on
+    # the caller thread. Applies to every path through
+    # grain_pipeline.ParallelDecoder — the hbm and tiered loaders; the
+    # tfdata/grain loaders keep their engines' own error semantics.
+    # False restores raise-through (debugging a specific bad shard).
+    quarantine_bad_records: bool = True
     # Route the color half of augmentation through the fused pallas
     # kernel (ops/pallas_augment.py, SURVEY.md N13) instead of the jnp
     # composition. Same math; one HBM pass. TPU-only (tests use the
@@ -309,6 +318,26 @@ class ServeConfig:
     # (serve/host.py; same resolution rule as data.decode_workers —
     # 0 = auto, one per host core up to 8).
     host_workers: int = 0
+    # --- Admission control / load shedding (ISSUE 6) -------------------
+    # Overload must degrade into FAST TYPED REJECTION, not unbounded
+    # queue growth and p99 collapse. Both thresholds default 0 = off
+    # (the bench overhead pin measures the disabled path at <= 2%);
+    # when set, MicroBatcher.submit raises serve.Overloaded instead of
+    # enqueueing, counted under serve.shed.queue_depth — and the same
+    # thresholds are installed as alert rules over the same gauges
+    # (obs/alerts.reliability_rules), so shedding and alerting can
+    # never disagree about what "overloaded" means.
+    # Max requests waiting in the batcher queue before submits shed.
+    shed_queue_depth: int = 0
+    # Max requests ADMITTED but not yet resolved (queued + in the
+    # window being inferred) before submits shed.
+    shed_in_flight: int = 0
+    # Default per-request deadline applied at submit when the caller
+    # passes none (ms; 0 = no deadline). A request whose deadline has
+    # passed when its window closes is failed with
+    # serve.DeadlineExceeded BEFORE any device work is spent on it,
+    # counted under serve.shed.deadline.
+    default_deadline_ms: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -414,6 +443,19 @@ class ObsConfig:
     # rules. Nested because it is a subsystem, not a knob — override
     # with obs.quality.<field>=value.
     quality: QualityConfig = dataclasses.field(default_factory=QualityConfig)
+    # --- Reliability (ISSUE 6) -----------------------------------------
+    # Deterministic fault-injection plan (obs/faultinject.py): a JSON
+    # spec string or a path to one, armed at run/engine start. The
+    # JAMA16_FAULTS env var overrides. Empty (the production value) =
+    # nothing armed; every fault seam then costs one branch (pinned by
+    # the bench robustness guard).
+    fault_plan: str = ""
+    # Sustained data-plane quarantine rate (records/s over a telemetry
+    # flush interval) above which the data_quarantine alert rule fires
+    # — one poison record is routine; a STREAM of them is systemic rot
+    # (a bad shard, a broken preprocessing deploy). <= 0 disables the
+    # rule.
+    quarantine_alert_per_s: float = 0.5
 
 
 @dataclasses.dataclass(frozen=True)

@@ -38,10 +38,13 @@ import time
 from typing import Any, Iterator, Sequence
 
 import numpy as np
+from absl import logging as absl_logging
 
 from jama16_retina_tpu.configs import DataConfig
 from jama16_retina_tpu.data import tfrecord
+from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.utils import retry as retry_lib
 
 
 class TFRecordIndex:
@@ -77,14 +80,11 @@ class TFRecordIndex:
     def __len__(self) -> int:
         return len(self._extents)
 
-    def read(self, i: int) -> bytes:
-        pi, off, length = self._extents[i]
-        # Descriptors are cached per shard — global shuffle has no read
-        # locality, so reopening per record would put an open/close
-        # syscall pair on every image of the train hot path. os.pread is
-        # a positioned read with no shared seek cursor: grain's reader
-        # THREADS (ReadOptions defaults to a thread pool even with
-        # worker_count=0) hit the same descriptor concurrently.
+    def _pread(self, pi: int, length: int, off: int) -> bytes:
+        """One positioned read through the fault seam: ``tfrecord.read``
+        chaos entries can raise (transient-I/O drill), add latency, or
+        corrupt the returned payload (poison-record drill) — unarmed it
+        costs one global read + branch."""
         fd = self._files.get(pi)
         if fd is None:
             # Locked first-open: two racing reader threads would both
@@ -93,7 +93,28 @@ class TFRecordIndex:
                 fd = self._files.get(pi)
                 if fd is None:
                     fd = self._files[pi] = os.open(self.paths[pi], os.O_RDONLY)
-        return os.pread(fd, length, off)
+        return faultinject.corrupt(
+            "tfrecord.read", os.pread(fd, length, off)
+        )
+
+    def read(self, i: int) -> bytes:
+        pi, off, length = self._extents[i]
+        # Descriptors are cached per shard — global shuffle has no read
+        # locality, so reopening per record would put an open/close
+        # syscall pair on every image of the train hot path. os.pread is
+        # a positioned read with no shared seek cursor: grain's reader
+        # THREADS (ReadOptions defaults to a thread pool even with
+        # worker_count=0) hit the same descriptor concurrently.
+        # Transient-I/O absorption (ISSUE 6): up to 3 backoff retries
+        # per read (utils/retry.py, counted under
+        # io.retries.tfrecord.read). Still-failing reads raise the
+        # original OSError — the decode layer's quarantine then owns
+        # the record. retry_call's quiet-path overhead is one closure
+        # frame per read, ~1000x under the decode it feeds.
+        return retry_lib.retry_call(
+            self._pread, pi, length, off,
+            attempts=4, site="tfrecord.read",
+        )
 
     # Keep the index picklable for grain worker processes: descriptors
     # and the lock are per-process state, recreated after unpickling.
@@ -189,10 +210,19 @@ class ParallelDecoder:
 
     def __init__(self, index: TFRecordIndex, image_size: int,
                  workers: int = 1,
-                 registry: "obs_registry.Registry | None" = None):
+                 registry: "obs_registry.Registry | None" = None,
+                 quarantine: bool = True):
         self.index = index
         self.image_size = image_size
         self.workers = max(1, int(workers))
+        # Poison-record quarantine (ISSUE 6): a payload that fails to
+        # decode is counted (data.quarantined{reason}) and
+        # deterministically SUBSTITUTED with the next decodable record
+        # instead of re-raising on the caller thread and killing the
+        # epoch. Substitution depends only on record ids, so the
+        # worker-count-invariance contract holds for poisoned shards
+        # too. quarantine=False restores raise-through (debugging).
+        self.quarantine = bool(quarantine)
         # Worker-utilization telemetry (obs/): records decoded and the
         # SUM of per-record decode time across all worker threads.
         # utilization = busy_s / (wall * workers) — obs_report divides;
@@ -204,6 +234,12 @@ class ParallelDecoder:
         )
         self._c_records = self._registry.counter("data.decode.records")
         self._c_busy = self._registry.counter("data.decode.busy_s")
+        self._c_quarantined = self._registry.counter(
+            "data.quarantined",
+            help="records skipped by the poison quarantine (corrupt "
+                 "payload / failed decode), all reasons; the "
+                 "data_quarantine alert rule reads this burn rate",
+        )
         self._registry.gauge("data.decode.workers").set(self.workers)
         self._pool = None
         if self.workers > 1:
@@ -213,17 +249,54 @@ class ParallelDecoder:
                 max_workers=self.workers, thread_name_prefix="jama16-decode"
             )
 
-    def _decode_one(self, i: int, n: "int | None" = None) -> dict:
-        if not self._registry.enabled:
-            return _decode_example(
-                self.index.read(i % n if n else i), self.image_size
-            )
-        t0 = time.perf_counter()
-        row = _decode_example(
+    def _read_decode(self, i: int, n: "int | None" = None) -> dict:
+        return _decode_example(
             self.index.read(i % n if n else i), self.image_size
         )
-        self._c_busy.inc(time.perf_counter() - t0)
-        self._c_records.inc()
+
+    def _quarantine_substitute(self, i: int, n: "int | None",
+                               exc: Exception) -> dict:
+        """Count the poison record and return the NEXT decodable record
+        (scanning forward, wrapping) — a pure function of record ids,
+        so batches stay worker-count- and schedule-invariant. Raises
+        only when EVERY record is undecodable (that is not a poison
+        record, that is a destroyed dataset)."""
+        total = n if n else len(self.index)
+        reason = (
+            "read_error" if isinstance(exc, OSError) else "decode_error"
+        )
+        self._c_quarantined.inc()
+        self._registry.counter(f"data.quarantined.{reason}").inc()
+        absl_logging.warning(
+            "record %d quarantined (%s: %s); substituting the next "
+            "decodable record", i, type(exc).__name__, exc,
+        )
+        for k in range(1, total):
+            j = (i + k) % total
+            try:
+                return self._read_decode(j, n)
+            except Exception:  # noqa: BLE001 - keep scanning
+                self._c_quarantined.inc()
+                continue
+        raise ValueError(
+            f"every record in the split failed to decode (started from "
+            f"record {i}) — this is not a poison record, the dataset "
+            "is destroyed"
+        ) from exc
+
+    def _decode_one(self, i: int, n: "int | None" = None) -> dict:
+        if not self._registry.enabled and not self.quarantine:
+            return self._read_decode(i, n)
+        t0 = time.perf_counter() if self._registry.enabled else 0.0
+        try:
+            row = self._read_decode(i, n)
+        except Exception as e:  # noqa: BLE001 - quarantine decides
+            if not self.quarantine:
+                raise
+            row = self._quarantine_substitute(i, n, e)
+        if self._registry.enabled:
+            self._c_busy.inc(time.perf_counter() - t0)
+            self._c_records.inc()
         return row
 
     def decode_batch(self, ids) -> dict:

@@ -280,13 +280,27 @@ def parse_fn():
     return parse
 
 
+def _transient_read_errors():
+    """The exception classes a whole-pass TFRecord read retries on
+    (ISSUE 6): filesystem/network hiccups — tf's UnavailableError (GCS/
+    NFS flaps surface as this) plus OSError. DataLossError is
+    deliberately NOT here: a torn/corrupt shard does not get better on
+    retry; it must raise (or be quarantined by the per-record decode
+    layer)."""
+    tf = _tf()
+    return (tf.errors.UnavailableError, OSError)
+
+
 def read_quality_by_name(paths: Sequence[str]) -> dict[bytes, float]:
     """-> {image/name: image/quality} for every record, without touching
     pixels (a light parse over the serialized stream). Used by evaluate's
     ``--save_probs`` to join the preprocessing gradability score onto
     per-image predictions (docs/QUALITY.md step 4: do misses correlate
     with low-quality captures?). Records written before the quality
-    feature existed come back as -1.0."""
+    feature existed come back as -1.0. Transient read failures retry
+    with bounded backoff (utils/retry.py)."""
+    from jama16_retina_tpu.utils import retry as retry_lib
+
     tf = _tf()
     spec = {
         "image/name": tf.io.FixedLenFeature([], tf.string, default_value=""),
@@ -294,19 +308,35 @@ def read_quality_by_name(paths: Sequence[str]) -> dict[bytes, float]:
             [], tf.float32, default_value=-1.0
         ),
     }
-    out: dict[bytes, float] = {}
-    ds = tf.data.TFRecordDataset(list(paths)).map(
-        lambda s: tf.io.parse_single_example(s, spec),
-        num_parallel_calls=tf.data.AUTOTUNE,
+
+    def _read() -> dict[bytes, float]:
+        out: dict[bytes, float] = {}
+        ds = tf.data.TFRecordDataset(list(paths)).map(
+            lambda s: tf.io.parse_single_example(s, spec),
+            num_parallel_calls=tf.data.AUTOTUNE,
+        )
+        for f in ds.as_numpy_iterator():
+            out[f["image/name"]] = float(f["image/quality"])
+        return out
+
+    return retry_lib.retry_call(
+        _read, attempts=3, retry_on=_transient_read_errors(),
+        site="tfrecord.quality_scan",
     )
-    for f in ds.as_numpy_iterator():
-        out[f["image/name"]] = float(f["image/quality"])
-    return out
 
 
 def count_records(paths: Sequence[str]) -> int:
+    from jama16_retina_tpu.utils import retry as retry_lib
+
     tf = _tf()
-    n = 0
-    for _ in tf.data.TFRecordDataset(list(paths)):
-        n += 1
-    return n
+
+    def _count() -> int:
+        n = 0
+        for _ in tf.data.TFRecordDataset(list(paths)):
+            n += 1
+        return n
+
+    return retry_lib.retry_call(
+        _count, attempts=3, retry_on=_transient_read_errors(),
+        site="tfrecord.count",
+    )

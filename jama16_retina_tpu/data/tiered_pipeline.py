@@ -288,7 +288,10 @@ def train_batches(
     )
     plan = _TierPlan(n, cfg.batch_size, capacity, seed)
     workers = resolve_decode_workers(cfg.decode_workers)
-    decoder = ParallelDecoder(index, image_size, workers=workers)
+    decoder = ParallelDecoder(
+        index, image_size, workers=workers,
+        quarantine=cfg.quarantine_bad_records,
+    )
 
     logging.info(
         "tiered loader: %d/%d rows HBM-resident (%.0f%%, %.1f MB over %d "
@@ -385,7 +388,10 @@ def host_reference_batches(
     index = TFRecordIndex(tfrecord.list_split(data_dir, split))
     n = len(index)
     plan = _TierPlan(n, cfg.batch_size, capacity_rows, seed)
-    decoder = ParallelDecoder(index, image_size, workers=1)
+    decoder = ParallelDecoder(
+        index, image_size, workers=1,
+        quarantine=cfg.quarantine_bad_records,
+    )
     step = skip_batches
     try:
         while True:

@@ -120,6 +120,43 @@ def quality_rules(qcfg) -> list:
     return rules
 
 
+def reliability_rules(cfg) -> list:
+    """The reliability rule set one ExperimentConfig implies (ISSUE 6).
+
+    Shedding thresholds are EXPRESSED as alert rules over the exact
+    gauges the MicroBatcher's shed decision reads
+    (``serve.batcher.{queue_depth,in_flight}``), so "we are shedding"
+    and "we are alerting" can never disagree; the quarantine rule
+    reads the data plane's ``data.quarantined`` burn rate (one poison
+    record is routine, a sustained stream is systemic rot); the reload
+    rule fires on any rejected rollout. Rules over metrics that never
+    get published are inactive — installing these unconditionally
+    costs nothing on runs that never shed/quarantine/reload."""
+    rules: list = []
+    sc = getattr(cfg, "serve", None)
+    oc = getattr(cfg, "obs", None)
+    if sc is not None:
+        if sc.shed_queue_depth > 0:
+            rules.append(AlertRule(
+                "serve.batcher.queue_depth", ">=",
+                float(sc.shed_queue_depth), reason="overload_shed",
+            ))
+        if sc.shed_in_flight > 0:
+            rules.append(AlertRule(
+                "serve.batcher.in_flight", ">=",
+                float(sc.shed_in_flight), reason="overload_shed",
+            ))
+    per_s = float(getattr(oc, "quarantine_alert_per_s", 0.0) or 0.0)
+    if per_s > 0:
+        rules.append(AlertRule(
+            "rate(data.quarantined)", ">", per_s, reason="data_quarantine",
+        ))
+    rules.append(AlertRule(
+        "rate(serve.reload_rejected)", ">", 0.0, reason="reload_rejected",
+    ))
+    return rules
+
+
 def manager_for(cfg, workdir: str, registry=None) -> "AlertManager | None":
     """The AlertManager a TRAINERLESS process (serving session, batch
     predict) hangs on its Snapshotter: the rules ``cfg.obs.quality``
@@ -134,7 +171,7 @@ def manager_for(cfg, workdir: str, registry=None) -> "AlertManager | None":
 
     if not cfg.obs.enabled:
         return None
-    rules = quality_rules(cfg.obs.quality)
+    rules = quality_rules(cfg.obs.quality) + reliability_rules(cfg)
     if not rules:
         return None
     flight = flightrec.FlightRecorder(

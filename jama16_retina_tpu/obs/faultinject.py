@@ -1,0 +1,275 @@
+"""Deterministic fault injection at named seams (ISSUE 6).
+
+Recovery paths that only run when production breaks are recovery paths
+that have never run. This module makes failure an INPUT: the data
+plane, checkpoint restore, and the serving engine each call
+``check(site)`` (or ``corrupt(site, data)``) at their seam, and a
+``FaultPlan`` armed for that site injects the configured fault — an
+exception on exactly the Nth call, added latency, or corrupted bytes —
+deterministically, so tests/test_faults.py and ``bench.py --chaos``
+drive every recovery path on demand.
+
+Sites wired in this codebase (the vocabulary docs/RELIABILITY.md
+tables use):
+
+    tfrecord.read    — TFRecordIndex.read (data/grain_pipeline.py)
+    host.decode      — serve/host._load_one (per-image file read)
+    ckpt.restore     — Checkpointer.restore (utils/checkpoint.py)
+    engine.dispatch  — ServingEngine per-chunk dispatch (serve/engine.py)
+    trainer.step     — the trainer loops' per-step boundary
+
+Zero overhead unarmed — the contract the bench guard pins: every seam
+reads ONE module-level global and branches; no dict lookup, no lock,
+no allocation happens until a plan is armed. Arming is process-global
+(``arm()``/``disarm()``) because the seams live across threads (the
+batcher worker, decode pools); per-site call counting under the plan's
+lock only costs anything while a plan is live.
+
+Plans come from code (tests), from a JSON spec string/file
+(``plan_from_spec``), or from the ``JAMA16_FAULTS`` environment
+variable (``plan_from_env`` — how ``bench.py --chaos`` and operators
+arm a real process). Spec shape, one entry per site:
+
+    {"tfrecord.read": {"kind": "error", "on_calls": [3],
+                       "error": "OSError", "message": "injected"},
+     "host.decode":   {"kind": "latency", "on_calls": [1, 2],
+                       "delay_s": 0.05},
+     "ckpt.restore":  {"kind": "corrupt", "on_calls": [1]}}
+
+``on_calls`` are 1-based per-site call ordinals — raise-on-Nth-call
+semantics, exactly reproducible run to run. ``"every": N`` fires on
+every Nth call instead (sustained-rot mode for the quarantine-rate
+alert). ``max_fires`` bounds total injections per site (default
+unbounded).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from absl import logging as absl_logging
+
+# Error classes a JSON spec may name. Deliberately small: injected
+# faults should look like the real faults the seams handle (transient
+# I/O, corrupt payloads, cancellation), not arbitrary types.
+_ERRORS = {
+    "OSError": OSError,
+    "IOError": IOError,
+    "ValueError": ValueError,
+    "RuntimeError": RuntimeError,
+    "TimeoutError": TimeoutError,
+}
+
+
+class InjectedFault(RuntimeError):
+    """Default exception for kind="error" entries that name no class —
+    unambiguous in logs/dumps: this failure was asked for."""
+
+
+@dataclass
+class FaultSite:
+    """One site's fault configuration inside a FaultPlan."""
+
+    kind: str = "error"            # error | latency | corrupt
+    on_calls: tuple = ()           # 1-based ordinals that fire
+    every: int = 0                 # fire on every Nth call (0 = off)
+    error: str = ""                # _ERRORS key; "" -> InjectedFault
+    message: str = "injected fault"
+    delay_s: float = 0.0           # latency kind: seconds to add
+    max_fires: int = 0             # 0 = unbounded
+    calls: int = 0                 # mutable: per-site call count
+    fires: int = 0                 # mutable: injections delivered
+
+    def should_fire(self) -> bool:
+        """Call-counted decision; caller holds the plan lock."""
+        self.calls += 1
+        if self.max_fires and self.fires >= self.max_fires:
+            return False
+        hit = self.calls in self.on_calls or (
+            self.every > 0 and self.calls % self.every == 0
+        )
+        if hit:
+            self.fires += 1
+        return hit
+
+    def make_error(self) -> BaseException:
+        cls = _ERRORS.get(self.error, InjectedFault)
+        return cls(f"{self.message} (injected, call {self.calls})")
+
+
+@dataclass
+class FaultPlan:
+    """A named-site fault schedule. Immutable site set after
+    construction; per-site call counters mutate under ``_lock`` (seams
+    fire from decode pools and the batcher worker concurrently)."""
+
+    sites: dict = field(default_factory=dict)  # site -> FaultSite
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def site(self, name: str) -> "FaultSite | None":
+        return self.sites.get(name)
+
+    def counts(self) -> dict:
+        """{site: {'calls': n, 'fires': m}} — what --chaos reports."""
+        with self._lock:
+            return {
+                name: {"calls": s.calls, "fires": s.fires}
+                for name, s in self.sites.items()
+            }
+
+
+def plan_from_spec(spec: "str | dict") -> FaultPlan:
+    """A FaultPlan from the JSON spec shape in the module docstring.
+    ``spec`` may be the JSON text itself, a path to a JSON file, or an
+    already-parsed dict. Unknown keys/kinds raise — a half-understood
+    chaos plan silently not injecting is the one failure mode a fault
+    harness must not have."""
+    if isinstance(spec, str):
+        if os.path.exists(spec):
+            with open(spec) as f:
+                spec = json.load(f)
+        else:
+            spec = json.loads(spec)
+    if not isinstance(spec, dict):
+        raise ValueError(f"fault spec must be a JSON object, got {spec!r}")
+    sites = {}
+    allowed = {"kind", "on_calls", "every", "error", "message",
+               "delay_s", "max_fires"}
+    for name, entry in spec.items():
+        unknown = set(entry) - allowed
+        if unknown:
+            raise ValueError(
+                f"fault site {name!r}: unknown keys {sorted(unknown)} "
+                f"(allowed: {sorted(allowed)})"
+            )
+        kind = entry.get("kind", "error")
+        if kind not in ("error", "latency", "corrupt"):
+            raise ValueError(
+                f"fault site {name!r}: unknown kind {kind!r} "
+                "(want error|latency|corrupt)"
+            )
+        err = entry.get("error", "")
+        if err and err not in _ERRORS:
+            raise ValueError(
+                f"fault site {name!r}: unknown error class {err!r} "
+                f"(allowed: {sorted(_ERRORS)})"
+            )
+        sites[name] = FaultSite(
+            kind=kind,
+            on_calls=tuple(int(c) for c in entry.get("on_calls", ())),
+            every=int(entry.get("every", 0)),
+            error=err,
+            message=str(entry.get("message", "injected fault")),
+            delay_s=float(entry.get("delay_s", 0.0)),
+            max_fires=int(entry.get("max_fires", 0)),
+        )
+    return FaultPlan(sites=sites)
+
+
+ENV_VAR = "JAMA16_FAULTS"
+
+
+def plan_from_env() -> "FaultPlan | None":
+    """The environment-driven arming path (operators / --chaos child
+    processes): ``JAMA16_FAULTS`` holds the JSON spec or a file path."""
+    raw = os.environ.get(ENV_VAR, "")
+    if not raw:
+        return None
+    return plan_from_spec(raw)
+
+
+# THE unarmed-cost contract: seams read this one global and branch.
+_active: "FaultPlan | None" = None
+
+
+def arm(plan: "FaultPlan | str | dict | None") -> "FaultPlan | None":
+    """Install ``plan`` process-wide (str/dict specs are parsed);
+    returns the previous plan so tests can restore it. ``None``
+    disarms."""
+    global _active
+    prev = _active
+    if plan is not None and not isinstance(plan, FaultPlan):
+        plan = plan_from_spec(plan)
+    _active = plan
+    if plan is not None:
+        absl_logging.warning(
+            "FAULT INJECTION ARMED at sites %s", sorted(plan.sites)
+        )
+    return prev
+
+
+def disarm() -> None:
+    arm(None)
+
+
+def active_plan() -> "FaultPlan | None":
+    return _active
+
+
+def arm_from_env_or_config(config_spec: str = "") -> None:
+    """The run-entry arming rule (trainer._obs_begin_run, ServingEngine
+    construction): the JAMA16_FAULTS env var wins, else the config's
+    ``obs.fault_plan`` spec, else leave whatever is armed alone (tests
+    arm programmatically before building the engine/trainer)."""
+    env = plan_from_env()
+    if env is not None:
+        arm(env)
+    elif config_spec:
+        arm(plan_from_spec(config_spec))
+
+
+def check(site: str) -> None:
+    """The seam hook. Unarmed: one global read + one branch. Armed:
+    count the call under the plan lock and deliver the configured
+    fault — raise (kind=error), sleep (kind=latency), or nothing here
+    (kind=corrupt is delivered via ``corrupt()``, which data-carrying
+    seams call instead)."""
+    plan = _active
+    if plan is None:
+        return
+    s = plan.site(site)
+    if s is None:
+        return
+    with plan._lock:
+        fire = s.should_fire()
+    if not fire:
+        return
+    if s.kind == "latency":
+        time.sleep(s.delay_s)
+        return
+    if s.kind == "error":
+        raise s.make_error()
+    # kind == "corrupt" at a non-data seam: nothing to corrupt; treat
+    # as an error so the plan is never silently inert.
+    raise s.make_error()
+
+
+def corrupt(site: str, data: bytes) -> bytes:
+    """Data-carrying seam hook (TFRecord payloads, image bytes):
+    returns ``data`` untouched unless an armed kind="corrupt" entry
+    fires, in which case the bytes are deterministically damaged
+    (truncated to half and XOR-flipped) so parsers downstream see a
+    genuinely corrupt payload, not a magic sentinel. kind="error"/
+    "latency" entries behave exactly like ``check``."""
+    plan = _active
+    if plan is None:
+        return data
+    s = plan.site(site)
+    if s is None:
+        return data
+    with plan._lock:
+        fire = s.should_fire()
+    if not fire:
+        return data
+    if s.kind == "latency":
+        time.sleep(s.delay_s)
+        return data
+    if s.kind == "error":
+        raise s.make_error()
+    half = data[: max(1, len(data) // 2)]
+    return bytes(b ^ 0xFF for b in half)

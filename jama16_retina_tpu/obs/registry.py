@@ -222,6 +222,15 @@ class Registry:
         return self._get_or_create(name, Histogram, buckets=buckets,
                                    help=help)
 
+    def remove(self, name: str) -> None:
+        """Retire a metric from snapshots. Existing handles stay valid
+        (their ops just stop being exported) — the bounded-vocabulary
+        escape hatch for legitimately generation-scoped metrics like
+        ``serve.gen{N}.rows``, whose population would otherwise grow
+        one counter per hot-swap for the life of a serving process."""
+        with self._lock:
+            self._metrics.pop(name, None)
+
     def reset(self) -> None:
         """Zero every registered metric IN PLACE — handles stay valid.
 

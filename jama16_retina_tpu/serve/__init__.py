@@ -23,7 +23,22 @@ predict.py rides this stack for --device={tpu,cpu}; bench.py's
 ``serve_*`` section measures it under the round-3 fenced discipline.
 """
 
-from jama16_retina_tpu.serve.batcher import MicroBatcher
-from jama16_retina_tpu.serve.engine import ServingEngine, resolve_buckets
+from jama16_retina_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Overloaded,
+)
+from jama16_retina_tpu.serve.engine import (
+    ReloadRejected,
+    ServingEngine,
+    resolve_buckets,
+)
 
-__all__ = ["MicroBatcher", "ServingEngine", "resolve_buckets"]
+__all__ = [
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "Overloaded",
+    "ReloadRejected",
+    "ServingEngine",
+    "resolve_buckets",
+]

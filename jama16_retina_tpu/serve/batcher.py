@@ -39,6 +39,21 @@ from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.obs import trace as obs_trace
 
 
+class Overloaded(RuntimeError):
+    """Typed submit-time rejection (ISSUE 6 admission control): the
+    batcher is over its configured queue-depth or in-flight threshold.
+    Raised BEFORE the request enqueues, so an overloaded server answers
+    in microseconds instead of letting p99 collapse — callers retry
+    elsewhere/later, exactly the load-shedding contract."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """Typed per-request deadline miss: the request's deadline had
+    already passed when its coalescing window closed, so no device work
+    was spent on it. Set as the future's exception (never raised on the
+    submitter thread — the submit itself succeeded)."""
+
+
 @dataclass
 class _Request:
     rows: np.ndarray
@@ -54,6 +69,10 @@ class _Request:
     # monotonic time the worker popped this request off the queue (end
     # of its queue-wait segment, start of its window-fill segment).
     t_pop: float = 0.0
+    # Absolute monotonic deadline (ISSUE 6), or None. Checked at
+    # window close: an expired request is failed with DeadlineExceeded
+    # before it burns any device work.
+    t_deadline: "float | None" = None
 
 
 _STOP = object()
@@ -82,7 +101,12 @@ class MicroBatcher:
     before coalescing pays), ``serve.request_latency_s`` histogram
     (submit -> future resolved, end to end), and the close-path
     counters ``serve.batcher.rejected_at_close`` /
-    ``serve.batcher.close_flushed_windows``.
+    ``serve.batcher.close_flushed_windows``. Reliability telemetry
+    (ISSUE 6): ``serve.batcher.in_flight`` gauge (admitted-unresolved
+    requests — the shedding threshold's own gauge, so alert rules and
+    the shed decision read the same number),
+    ``serve.batcher.window_errors``, and the shed counters
+    ``serve.shed.{queue_depth,in_flight,deadline}``.
 
     Request-scoped tracing (obs/trace.py; ``tracer=None`` uses the
     process default): each submit is assigned a ``trace_id`` and, when
@@ -111,6 +135,9 @@ class MicroBatcher:
         registry: "obs_registry.Registry | None" = None,
         tracer: "obs_trace.Tracer | None" = None,
         quality=None,
+        shed_queue_depth: int = 0,
+        shed_in_flight: int = 0,
+        default_deadline_ms: float = 0.0,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -119,6 +146,16 @@ class MicroBatcher:
         self._row_dtype = np.dtype(row_dtype) if row_dtype is not None else None
         self.max_batch = int(max_batch)
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        # Admission control (ISSUE 6): plain ints under self._lock, NOT
+        # gauge reads — the shed decision must work with a disabled
+        # registry and must not take a metric lock on every submit.
+        # 0 = that threshold off (the default; the bench overhead pin
+        # measures this disabled path).
+        self.shed_queue_depth = int(shed_queue_depth)
+        self.shed_in_flight = int(shed_in_flight)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self._n_queued = 0     # submitted, not yet popped into a window
+        self._n_in_flight = 0  # admitted, future not yet resolved/failed
         self._queue: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
         self._closed = False
@@ -150,6 +187,31 @@ class MicroBatcher:
         self._c_close_flushed = reg.counter(
             "serve.batcher.close_flushed_windows"
         )
+        self._g_in_flight = reg.gauge(
+            "serve.batcher.in_flight",
+            help="requests admitted but not yet resolved (the in-flight "
+                 "shedding threshold's gauge — alert rules read this)",
+        )
+        self._c_window_errors = reg.counter(
+            "serve.batcher.window_errors",
+            help="coalesced windows whose infer_fn raised; only that "
+                 "window's futures failed, the worker survived",
+        )
+        self._c_shed_queue = reg.counter(
+            "serve.shed.queue_depth",
+            help="submits rejected Overloaded at the queue-depth "
+                 "threshold (serve.shed_queue_depth)",
+        )
+        self._c_shed_in_flight = reg.counter(
+            "serve.shed.in_flight",
+            help="submits rejected Overloaded at the in-flight "
+                 "threshold (serve.shed_in_flight)",
+        )
+        self._c_shed_deadline = reg.counter(
+            "serve.shed.deadline",
+            help="requests whose deadline had passed at window close; "
+                 "failed DeadlineExceeded before any device work",
+        )
         self._thread = threading.Thread(
             target=self._loop, name="jama16-serve-batcher", daemon=True
         )
@@ -162,9 +224,21 @@ class MicroBatcher:
             self._started = True
             self._thread.start()
 
-    def submit(self, rows: np.ndarray) -> Future:
+    def submit(self, rows: np.ndarray,
+               deadline_ms: "float | None" = None) -> Future:
         """Enqueue ``rows`` ([n, ...], n >= 1); the Future resolves to
-        the per-row results for exactly those rows, in row order."""
+        the per-row results for exactly those rows, in row order.
+
+        ``deadline_ms``: relative per-request deadline (None falls back
+        to ``default_deadline_ms``; <= 0 = no deadline). An expired
+        request is failed with ``DeadlineExceeded`` at window close —
+        before any device work — never silently dropped.
+
+        Raises ``Overloaded`` (without enqueueing) when a configured
+        shedding threshold is exceeded: fast typed rejection is the
+        overload contract (ISSUE 6) — the caller learns in microseconds
+        that the server is saturated instead of joining an unbounded
+        queue and timing out."""
         rows = np.asarray(rows)
         if rows.ndim < 1 or rows.shape[0] == 0:
             raise ValueError(
@@ -180,13 +254,35 @@ class MicroBatcher:
             raise ValueError(
                 f"submit() rows must be {self._row_dtype}, got {rows.dtype}"
             )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
         with self._lock:
             if self._closed:
                 self._c_rejected_closed.inc()
                 raise RuntimeError("MicroBatcher is closed")
+            if (self.shed_queue_depth > 0
+                    and self._n_queued >= self.shed_queue_depth):
+                self._c_shed_queue.inc()
+                raise Overloaded(
+                    f"queue depth {self._n_queued} >= shed threshold "
+                    f"{self.shed_queue_depth}; request shed at submit"
+                )
+            if (self.shed_in_flight > 0
+                    and self._n_in_flight >= self.shed_in_flight):
+                self._c_shed_in_flight.inc()
+                raise Overloaded(
+                    f"{self._n_in_flight} requests in flight >= shed "
+                    f"threshold {self.shed_in_flight}; request shed at "
+                    "submit"
+                )
             req = _Request(rows)
+            if deadline_ms and deadline_ms > 0:
+                req.t_deadline = req.t_submit + deadline_ms / 1e3
+            self._n_queued += 1
+            self._n_in_flight += 1
             self._queue.put(req)
             self._g_depth.add(1)
+            self._g_in_flight.set(self._n_in_flight)
         return req.future
 
     def _loop(self) -> None:
@@ -218,18 +314,64 @@ class MicroBatcher:
                 # arrived before the sentinel and are served, not
                 # dropped — observable as close_flushed_windows.
                 self._c_close_flushed.inc()
-            self._flush(window)
+            try:
+                self._flush(window)
+            except BaseException as e:  # noqa: BLE001 - worker survival
+                # _flush's own handler already fails the window's
+                # futures on infer errors; this outer belt catches a
+                # failure in that handler itself (ISSUE 6 satellite:
+                # a worker-thread exception must never strand every
+                # queued future forever — the worker stays alive for
+                # the next window no matter what).
+                self._c_window_errors.inc()
+                for w in window:
+                    try:
+                        if not w.future.done():
+                            w.future.set_exception(e)
+                    except InvalidStateError:
+                        pass
             if stop_after:
                 return
 
     def _flush(self, window: "list[_Request]") -> None:
         self._g_depth.add(-len(window))
+        admitted = window
+        with self._lock:
+            self._n_queued -= len(window)
         # Segment timestamps (ISSUE 4): every request's latency is the
         # SAME monotonic interval its trace segments tile — queue-wait
         # [t_submit, t_pop) + window-fill [t_pop, t_flush) + device
         # [t_flush, t_infer_done) + resolve [t_infer_done, now) sum to
         # the serve.request_latency_s observation EXACTLY (one clock).
         t_flush = time.monotonic()
+        # Deadline-aware admission at window close (ISSUE 6): a request
+        # whose deadline already passed is failed with DeadlineExceeded
+        # HERE — before it consumes a slot in the coalesced forward —
+        # so under overload the device only ever works on requests
+        # whose callers are still waiting.
+        expired = [
+            w for w in window
+            if w.t_deadline is not None and t_flush > w.t_deadline
+        ]
+        if expired:
+            window = [w for w in window if w.t_deadline is None
+                      or t_flush <= w.t_deadline]
+            for w in expired:
+                self._c_shed_deadline.inc()
+                try:
+                    if not w.future.done():
+                        w.future.set_exception(DeadlineExceeded(
+                            f"deadline passed "
+                            f"{t_flush - w.t_deadline:.3f}s before its "
+                            "window closed; no device work was spent"
+                        ))
+                except InvalidStateError:
+                    pass
+        if not window:
+            with self._lock:
+                self._n_in_flight -= len(admitted)
+                self._g_in_flight.set(self._n_in_flight)
+            return
         try:
             for w in window:
                 if w.t_pop == 0.0:  # never-started close() drain
@@ -289,13 +431,21 @@ class MicroBatcher:
         except BaseException as e:  # noqa: BLE001 - futures carry it
             # Every request of the window learns the failure; the worker
             # survives to serve the next window (including a concurrent
-            # cancel() racing these set_exception calls).
+            # cancel() racing these set_exception calls). Counted so an
+            # engine that starts failing windows is visible in telemetry
+            # (serve.batcher.window_errors) long before anyone reads
+            # stderr.
+            self._c_window_errors.inc()
             for w in window:
                 try:
                     if not w.future.done():
                         w.future.set_exception(e)
                 except InvalidStateError:
                     pass
+        finally:
+            with self._lock:
+                self._n_in_flight -= len(admitted)
+                self._g_in_flight.set(self._n_in_flight)
 
     def close(self) -> None:
         """Stop accepting requests, flush everything already queued,

@@ -29,18 +29,53 @@ math:
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import numpy as np
+from absl import logging as absl_logging
 
 from jama16_retina_tpu import models, train_lib
 from jama16_retina_tpu.configs import ExperimentConfig, ServeConfig
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import quality as quality_lib
 from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.obs import trace as obs_trace
 from jama16_retina_tpu.obs.spans import span
 from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+
+class ReloadRejected(RuntimeError):
+    """A candidate checkpoint set failed its pre-swap gate (golden
+    canary deviation, or a warm-up forward error): the live generation
+    keeps serving, the candidate never took a request. Counted under
+    ``serve.reload_rejected`` and surfaced by the reliability alert
+    rule — a rejected rollout must page, not silently retry."""
+
+
+class _Generation:
+    """One immutable serving generation (ISSUE 6 hot swap).
+
+    Everything a request needs to complete is snapshotted here — the
+    device-resident stacked state, its member count, provenance, and a
+    per-generation row counter — so ``engine.reload()`` can build
+    generation N+1 entirely off the request path and swap the engine's
+    handle atomically (one Python reference assignment). In-flight
+    requests that already grabbed generation N finish on N's state;
+    new requests see N+1; no request ever observes a half-swapped
+    engine."""
+
+    __slots__ = ("gen_id", "state", "n_members", "member_dirs", "c_rows")
+
+    def __init__(self, gen_id: int, state, n_members: int,
+                 member_dirs, c_rows):
+        self.gen_id = gen_id
+        self.state = state
+        self.n_members = n_members
+        self.member_dirs = list(member_dirs) if member_dirs else None
+        self.c_rows = c_rows
 
 
 def resolve_buckets(sc: ServeConfig, divisor: int = 1) -> tuple[int, ...]:
@@ -160,28 +195,25 @@ class ServingEngine:
         # the steady-state path is a plain dict hit — no f-string, no
         # registry lock (the hot-path contract in obs/registry.py).
         self._bucket_counters: dict = {}
-        if state is None:
-            if not member_dirs:
-                raise ValueError(
-                    "ServingEngine needs member checkpoint dirs (or a "
-                    "pre-stacked state=)"
-                )
-            from jama16_retina_tpu import trainer
-
-            state = train_lib.stack_states([
-                trainer.restore_for_eval(cfg, self.model, d)
-                for d in member_dirs
-            ])
-        else:
-            # Serving never steps the optimizer; drop its moments from
-            # the device residency whatever the caller handed over.
-            state = state.replace(opt_state=None)
-        self.n_members = int(state.step.shape[0])
-        place = (
-            mesh_lib.replicated(mesh) if mesh is not None
-            else jax.local_devices()[0]
+        # Reliability wiring (ISSUE 6): the deterministic fault plan
+        # (obs/faultinject.py) arms at session start — env var wins,
+        # then obs.fault_plan; both empty leaves whatever a test armed.
+        faultinject.arm_from_env_or_config(cfg.obs.fault_plan)
+        self._c_reloads = self.registry.counter(
+            "serve.reloads",
+            help="hot-swap generation reloads that went live",
         )
-        self.state = jax.device_put(state, place)
+        self._c_reload_rejected = self.registry.counter(
+            "serve.reload_rejected",
+            help="candidate generations rejected before the swap "
+                 "(canary deviation / restore or warm-up failure); the "
+                 "old generation kept serving",
+        )
+        self._g_generation = self.registry.gauge(
+            "serve.generation",
+            help="currently-serving model generation (0 = the "
+                 "construction-time checkpoint set)",
+        )
         self._batch_sharding = (
             mesh_lib.batch_sharding(mesh) if mesh is not None else None
         )
@@ -195,6 +227,199 @@ class ServingEngine:
             if mesh is not None else 1
         )
         self.buckets = resolve_buckets(cfg.serve, divisor=divisor)
+        # One rollout at a time: two racing reload() calls would both
+        # derive gen_id N+1 from the same live handle and silently
+        # discard one swap (with its row attribution).
+        self._reload_lock = threading.Lock()
+        # Generation 0: the construction-time checkpoint set. Built
+        # unwarmed — the first request compiles, exactly the historical
+        # behavior bench's warmup accounting measures.
+        self._gen = self._build_generation(
+            0, member_dirs=member_dirs, state=state
+        )
+        self._gen.c_rows = self._register_gen_rows(0)
+        self._g_generation.set(0)
+
+    # -- generations (ISSUE 6 hot swap) -----------------------------------
+
+    @property
+    def state(self):
+        """The live generation's device-resident stacked state."""
+        return self._gen.state
+
+    @property
+    def n_members(self) -> int:
+        return self._gen.n_members
+
+    @property
+    def generation(self) -> int:
+        """Id of the generation new requests dispatch on."""
+        return self._gen.gen_id
+
+    # How many generations' row counters stay exported after a swap:
+    # the live one, the one draining its last in-flight requests, and a
+    # little history for the report — NOT one forever per reload (a
+    # server hot-swapping hourly for a month would otherwise grow ~720
+    # counters into every telemetry record and .prom snapshot).
+    GEN_ROWS_KEEP = 4
+
+    def _register_gen_rows(self, gen_id: int) -> "obs_registry.Counter":
+        """The exported per-generation row ledger, attached at go-live;
+        generations older than GEN_ROWS_KEEP are retired from snapshots
+        (their drained handles keep working, just unexported)."""
+        retire = gen_id - self.GEN_ROWS_KEEP
+        if retire >= 0:
+            self.registry.remove(f"serve.gen{retire}.rows")
+        return self.registry.counter(
+            f"serve.gen{gen_id}.rows",
+            help="rows served by this model generation (response "
+                 "attribution: the per-generation ledger)",
+        )
+
+    def _build_generation(self, gen_id: int, member_dirs=None,
+                          state: "train_lib.TrainState | None" = None,
+                          warm: bool = False) -> _Generation:
+        """Restore -> stack -> place -> (optionally) warm every bucket,
+        entirely off the request path: nothing here touches the live
+        ``self._gen``."""
+        if state is None:
+            if not member_dirs:
+                raise ValueError(
+                    "ServingEngine needs member checkpoint dirs (or a "
+                    "pre-stacked state=)"
+                )
+            from jama16_retina_tpu import trainer
+
+            state = train_lib.stack_states([
+                trainer.restore_for_eval(self.cfg, self.model, d)
+                for d in member_dirs
+            ])
+        else:
+            # Serving never steps the optimizer; drop its moments from
+            # the device residency whatever the caller handed over.
+            state = state.replace(opt_state=None)
+        n_members = int(state.step.shape[0])
+        place = (
+            mesh_lib.replicated(self.mesh) if self.mesh is not None
+            else jax.local_devices()[0]
+        )
+        gen = _Generation(
+            gen_id, jax.device_put(state, place), n_members, member_dirs,
+            # DETACHED counter (not registered): a candidate's gate
+            # scoring (canary through member_probs) must not pollute the
+            # exported per-generation row ledger — the registered
+            # counter is attached only when the generation goes LIVE
+            # (_register_gen_rows at construction / swap time).
+            obs_registry.Counter(f"serve.gen{gen_id}.rows", self.registry),
+        )
+        if warm:
+            # Every bucket forwarded once on the CANDIDATE state before
+            # it can take a request: the swap never hands a live caller
+            # a cold compile or a shape error the gate could have
+            # caught (the shared self._step jit cache makes repeat
+            # warms cheap — same shapes, same program).
+            size = self.cfg.model.image_size
+            for b in self.buckets:
+                zeros = np.zeros((b, size, size, 3), np.uint8)
+                jax.device_get(
+                    self._step(gen.state, {"image": self._place(zeros)})
+                )
+        return gen
+
+    def reload(self, member_dirs=None, *,
+               state: "train_lib.TrainState | None" = None) -> dict:
+        """Hot-swap to a new checkpoint set with ZERO dropped requests.
+
+        Generation N+1 is built completely off the request path
+        (restore -> stack -> device placement -> warm every bucket ->
+        golden-canary gate), then the engine's generation handle is
+        swapped in one atomic reference assignment: requests already
+        dispatched keep finishing on generation N, new requests land on
+        N+1. A candidate that fails its gate NEVER takes a request —
+        the old generation keeps serving, ``serve.reload_rejected``
+        increments (the reliability alert rule reads its rate), and
+        ``ReloadRejected`` (canary) or the restore's own error
+        propagates to the rollout driver.
+
+        Returns {'generation', 'n_members', 'canary_checked'[,
+        'canary_max_dev']} for the rollout driver's ledger. Reloads are
+        serialized (one rollout at a time); requests never block on the
+        lock — they read the handle, not the lock."""
+        with self._reload_lock:
+            return self._reload_locked(member_dirs, state)
+
+    def _reload_locked(self, member_dirs, state) -> dict:
+        cur = self._gen
+        new_id = cur.gen_id + 1
+        try:
+            gen = self._build_generation(
+                new_id, member_dirs=member_dirs, state=state, warm=True
+            )
+        except Exception:
+            # Restore/stack/warm failure: the candidate is unusable —
+            # same rejected-rollout ledger as a canary miss, original
+            # error kept (the corrupt-checkpoint message names the
+            # member and path; utils/checkpoint.py).
+            self._c_reload_rejected.inc()
+            raise
+        info: dict = {
+            "generation": new_id, "n_members": gen.n_members,
+            "canary_checked": False,
+        }
+        q = self.quality
+        canary = q.canary if q is not None else None
+        if canary is not None and canary.reference is not None:
+            # Score the pinned golden set THROUGH the candidate — a
+            # non-destructive twin of GoldenCanary.check: the live
+            # canary's gauges/cadence stay untouched (they describe the
+            # serving generation, which this candidate is not yet).
+            scores = np.asarray(
+                metrics.ensemble_average(list(
+                    self.member_probs(canary.images, _gen=gen)
+                )), np.float64,
+            ).ravel()
+            ref = canary.reference
+            dev = (
+                float(np.max(np.abs(scores - ref)))
+                if scores.shape == ref.shape else float("inf")
+            )
+            ok = scores.shape == ref.shape and (
+                np.array_equal(scores, ref) if canary.atol == 0.0
+                else bool(dev <= canary.atol)
+            )
+            info["canary_checked"] = True
+            info["canary_max_dev"] = (
+                None if dev == float("inf") else dev
+            )
+            if not ok:
+                self._c_reload_rejected.inc()
+                absl_logging.error(
+                    "reload rejected: candidate generation %d deviates "
+                    "from the golden canary (max dev %s, atol %g) — "
+                    "generation %d keeps serving",
+                    new_id, dev, canary.atol, cur.gen_id,
+                )
+                raise ReloadRejected(
+                    f"candidate generation {new_id} failed the golden "
+                    f"canary (max deviation {dev} vs atol "
+                    f"{canary.atol}); generation {cur.gen_id} keeps "
+                    "serving"
+                )
+        # Going live: attach the EXPORTED row ledger (gate-scoring rows
+        # stayed on the detached counter) and retire ledgers of long-
+        # drained generations, THEN swap — one reference assignment
+        # (atomic under the GIL). In-flight requests hold their own
+        # generation reference and complete on it; generation N's
+        # device buffers free once the last such request drains.
+        gen.c_rows = self._register_gen_rows(new_id)
+        self._gen = gen
+        self._c_reloads.inc()
+        self._g_generation.set(new_id)
+        absl_logging.info(
+            "serving generation %d live (%d members)", new_id,
+            gen.n_members,
+        )
+        return info
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -208,7 +433,8 @@ class ServingEngine:
             return pipeline.staged_put(padded, self._batch_sharding)
         return jax.device_put(padded, jax.local_devices()[0])
 
-    def member_probs(self, images: np.ndarray) -> np.ndarray:
+    def member_probs(self, images: np.ndarray, *,
+                     _gen: "_Generation | None" = None) -> np.ndarray:
         """uint8 images [n, S, S, 3] -> per-member probabilities
         [k, n] (binary) or [k, n, C] (multi head).
 
@@ -219,7 +445,12 @@ class ServingEngine:
         residency grow with request size — a 50k-image screening batch
         holds at most 3 chunks of buffers on device, not the whole
         input. Padding rows are trimmed off on host.
+
+        ``_gen`` (internal): pin the generation this call dispatches on
+        — the handle is read ONCE here, so a concurrent ``reload()``
+        never splits one request across two generations.
         """
+        gen = _gen if _gen is not None else self._gen
         images = np.asarray(images)
         if images.ndim != 4:
             raise ValueError(
@@ -254,6 +485,7 @@ class ServingEngine:
             # a bucket set that defeats compile-once-per-bucket.
             pad_rows = bucket - chunk.shape[0]
             self._c_rows.inc(chunk.shape[0])
+            gen.c_rows.inc(chunk.shape[0])
             self._c_batches.inc()
             c_pad = self._bucket_counters.get(bucket)
             if c_pad is None:
@@ -268,11 +500,16 @@ class ServingEngine:
                     padded = np.concatenate([chunk, pad])
                 else:
                     padded = chunk
+            # Fault seam (obs/faultinject.py site "engine.dispatch"):
+            # one global read + branch unarmed; armed chaos plans
+            # inject a dispatch failure here to drive the batcher's
+            # window-error recovery deterministically.
+            faultinject.check("engine.dispatch")
             # One span over placement + dispatch: the forward is async
             # (this times H2D staging and queue pressure, not device
             # compute — device time is visible as the device_get drain).
             with span("serve.engine.dispatch_s", self.registry):
-                dev = self._step(self.state, {"image": self._place(padded)})
+                dev = self._step(gen.state, {"image": self._place(padded)})
             pending.append((dev, chunk.shape[0]))
             self._g_in_flight.set(len(pending))
             if len(pending) > max_in_flight:
@@ -293,17 +530,30 @@ class ServingEngine:
         canary runs here when its cadence is due — scored through
         ``member_probs`` directly so canary traffic never pollutes the
         drift histograms it guards."""
-        out = metrics.ensemble_average(list(self.member_probs(images)))
+        return self.probs_with_generation(images)[0]
+
+    def probs_with_generation(
+        self, images: np.ndarray
+    ) -> "tuple[np.ndarray, int]":
+        """``probs`` plus the id of the generation that served the rows
+        (ISSUE 6 attribution: during a ``reload`` every response is
+        attributable to exactly ONE generation — the handle is read
+        once, before any dispatch, and pinned for the whole request
+        including its canary ride-along)."""
+        gen = self._gen
+        out = metrics.ensemble_average(
+            list(self.member_probs(images, _gen=gen))
+        )
         q = self.quality
         if q is not None:
             q.observe(images, out)
             if q.canary_claim():
                 q.run_canary(
                     lambda imgs: metrics.ensemble_average(
-                        list(self.member_probs(imgs))
+                        list(self.member_probs(imgs, _gen=gen))
                     )
                 )
-        return out
+        return out, gen.gen_id
 
     def make_batcher(self):
         """A MicroBatcher wired to this engine under cfg.serve's
@@ -321,6 +571,9 @@ class ServingEngine:
             row_shape=(size, size, 3),
             row_dtype=np.uint8,
             registry=self.registry,
+            shed_queue_depth=self.cfg.serve.shed_queue_depth,
+            shed_in_flight=self.cfg.serve.shed_in_flight,
+            default_deadline_ms=self.cfg.serve.default_deadline_ms,
         )
 
     def start_telemetry(self, workdir: str,

@@ -22,8 +22,10 @@ import dataclasses
 import numpy as np
 
 from jama16_retina_tpu.data.grain_pipeline import resolve_decode_workers
+from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.preprocess import fundus
+from jama16_retina_tpu.utils import retry as retry_lib
 
 
 def reject_reason_slug(why: str) -> str:
@@ -31,7 +33,7 @@ def reject_reason_slug(why: str) -> str:
     satellite): a per-reason counter set must not grow one metric per
     distinct error STRING, so free-text reasons map onto a small fixed
     slug space. Unmatched reasons land in ``other`` (still counted)."""
-    if why == "unreadable":
+    if why.startswith("unreadable"):
         return "decode_error"
     if "too small" in why:
         return "too_small"
@@ -74,31 +76,67 @@ class PreprocessResult:
     kept: list  # paths of the scored rows, aligned with images
     skipped: list  # (path, reason) pairs, input order
     qualities: list  # gradability score per kept row (fundus stats)
+    # Paths that hit a transient read error, were retried
+    # (utils/retry.py under --max_retries) and then SCORED — a separate
+    # ledger from `skipped` so --strict semantics stay exact: a retried
+    # success is not an incomplete batch (ISSUE 6 satellite).
+    retried: list = dataclasses.field(default_factory=list)
 
 
-def _load_one(path: str, image_size: int, ben_graham: bool):
-    """One path -> (error_reason | None, canvas | None, quality | None).
-    Total per row: unreadable files and blank frames become reasons, any
-    other exception propagates (a corrupt install must stay loud)."""
+def _load_one(path: str, image_size: int, ben_graham: bool,
+              max_retries: int = 0):
+    """One path -> (error_reason | None, canvas | None, quality | None,
+    retried: bool). Total per row: unreadable files and blank frames
+    become reasons, any other exception propagates (a corrupt install
+    must stay loud).
+
+    The file read routes through the ``host.decode`` fault seam
+    (obs/faultinject.py) and, with ``max_retries`` > 0, through the
+    shared bounded-backoff retry (utils/retry.py) — a transient NFS
+    flap on one image of a screening batch becomes a retried success,
+    not a reject."""
     import cv2
 
-    bgr = cv2.imread(path, cv2.IMREAD_COLOR)
+    tries = {"n": 0}
+
+    def _read() -> bytes:
+        tries["n"] += 1
+        with open(path, "rb") as f:
+            data = f.read()
+        # Fault seam: error-kind entries raise (the transient-I/O
+        # drill --max_retries absorbs), corrupt-kind entries damage
+        # the bytes (per-request reject drill).
+        return faultinject.corrupt("host.decode", data)
+
+    try:
+        if max_retries > 0:
+            data = retry_lib.retry_call(
+                _read, attempts=max_retries + 1, base_delay=0.02,
+                site="host.decode",
+            )
+        else:
+            data = _read()
+    except OSError as e:
+        return f"unreadable: {e}", None, None, tries["n"] > 1
+    retried = tries["n"] > 1
+    bgr = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
     if bgr is None:
-        return "unreadable", None, None
+        return "unreadable", None, None, retried
     try:
         canvas, q = fundus.resize_and_center_fundus(
             bgr[..., ::-1], diameter=image_size,
             ben_graham=ben_graham, with_quality=True,
         )
     except fundus.FundusNotFound as e:
-        return f"no fundus found: {e}", None, None
-    return None, canvas, float(q["quality"])
+        return f"no fundus found: {e}", None, None, retried
+    return None, canvas, float(q["quality"]), retried
 
 
 def preprocess_paths(
     paths: "list[str]", image_size: int, ben_graham: bool = False,
     workers: int = 0,
     registry: "obs_registry.Registry | None" = None,
+    max_retries: int = 0,
 ) -> PreprocessResult:
     """Normalize ``paths`` across a thread pool; worker-count-invariant.
 
@@ -106,11 +144,15 @@ def preprocess_paths(
     host core up to 8, leaving a core for device dispatch).
     ``registry``: sink for the per-reason ``serve.input_rejected{reason}``
     data-quality counters (None = process default).
+    ``max_retries``: per-image transient-read retries (utils/retry.py;
+    predict.py --max_retries). Retried-then-scored paths land in the
+    ``retried`` ledger AND the ``serve.input_retried`` counter —
+    separate from ``skipped``, so --strict stays exact.
     """
     workers = resolve_decode_workers(workers)
 
     def one(p):
-        return _load_one(p, image_size, ben_graham)
+        return _load_one(p, image_size, ben_graham, max_retries=max_retries)
 
     if workers <= 1 or len(paths) < 2:
         rows = [one(p) for p in paths]
@@ -125,11 +167,13 @@ def preprocess_paths(
             # worker finished first — the whole determinism contract.
             rows = list(pool.map(one, paths))
 
-    kept, skipped, qualities, canvases = [], [], [], []
-    for p, (why, canvas, quality) in zip(paths, rows):
+    kept, skipped, qualities, canvases, retried = [], [], [], [], []
+    for p, (why, canvas, quality, was_retried) in zip(paths, rows):
         if why is not None:
             skipped.append((p, why))
             continue
+        if was_retried:
+            retried.append(p)
         kept.append(p)
         canvases.append(canvas)
         qualities.append(quality)
@@ -138,6 +182,15 @@ def preprocess_paths(
         else np.zeros((0, image_size, image_size, 3), np.uint8)
     )
     _count_rejects(skipped, registry)
+    if retried:
+        reg = (registry if registry is not None
+               else obs_registry.default_registry())
+        reg.counter(
+            "serve.input_retried",
+            help="images that hit a transient read error, were retried "
+                 "and then SCORED (not part of the reject ledger)",
+        ).inc(len(retried))
     return PreprocessResult(
-        images=images, kept=kept, skipped=skipped, qualities=qualities
+        images=images, kept=kept, skipped=skipped, qualities=qualities,
+        retried=retried,
     )

@@ -25,6 +25,7 @@ from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
 from jama16_retina_tpu.obs import alerts as obs_alerts
 from jama16_retina_tpu.obs import export as obs_export
+from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import flightrec as obs_flightrec
 from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.obs import trace as obs_trace
@@ -52,6 +53,9 @@ def _obs_begin_run(cfg: ExperimentConfig):
         enabled=cfg.obs.enabled and cfg.obs.trace_enabled,
         buffer_events=cfg.obs.trace_buffer_events,
     )
+    # Deterministic fault plan (ISSUE 6; obs/faultinject.py): env var
+    # wins, then obs.fault_plan; both empty leaves a test-armed plan.
+    faultinject.arm_from_env_or_config(cfg.obs.fault_plan)
     return reg
 
 
@@ -77,7 +81,11 @@ def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str,
     snap = None
     if cfg.obs.enabled:
         alerts = None
-        rules = obs_alerts.quality_rules(cfg.obs.quality)
+        # Reliability rules (ISSUE 6: data-quarantine burn rate) ride
+        # the same manager as the quality rules; rules over metrics a
+        # train run never publishes stay inactive.
+        rules = (obs_alerts.quality_rules(cfg.obs.quality)
+                 + obs_alerts.reliability_rules(cfg))
         if rules:
             alerts = obs_alerts.AlertManager(
                 rules, registry=reg, flight=flight
@@ -883,6 +891,42 @@ def _eval_and_track(
     return best_auc, best_step, since_best, stop, saved
 
 
+def _is_preemption(e: BaseException) -> bool:
+    """SIGTERM/SIGINT arrive as in-band SystemExit/KeyboardInterrupt
+    (the flight recorder's handlers convert them; PR 4) — the shapes
+    that mean 'the scheduler wants this host', for which a final
+    durable resume point is worth the save."""
+    return isinstance(e, (SystemExit, KeyboardInterrupt))
+
+
+def _preempt_save(log: RunLog, step: int, save_fn,
+                  grain_tee, workdir: str) -> None:
+    """Preemption-safe shutdown (ISSUE 6): one unconditional latest/
+    checkpoint at the last COMPLETED step plus the worker-mode grain
+    state, written between the blackbox dump and process exit, so
+    ``train.resume=true`` continues exactly where the SIGTERM landed
+    instead of replaying from the last eval-time save (potentially
+    eval_every-1 steps of lost work per preemption — routine-preemption
+    economics, cf. supercomputer-scale training). ``save_fn(step)``
+    does the backend-specific save and returns whether it wrote.
+    Best-effort by design: a failing emergency save must not mask the
+    original signal's exit path."""
+    try:
+        saved = save_fn(step)
+        _persist_grain_state(grain_tee, workdir, step)
+        log.write("preempt_save", step=step, saved=bool(saved))
+        absl_logging.warning(
+            "preemption: saved resume checkpoint at step %d "
+            "(train.resume=true continues here)", step,
+        )
+    except Exception as e:  # noqa: BLE001 - exit path must proceed
+        absl_logging.error(
+            "preemption save at step %d failed: %s: %s — resume will "
+            "fall back to the last eval-time checkpoint",
+            step, type(e).__name__, e,
+        )
+
+
 def _run_meta_path(workdir: str) -> str:
     return os.path.join(workdir, "run_meta.json")
 
@@ -1005,10 +1049,15 @@ def fit(
 
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
+    last_step = start_step
     _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
     try:
         for step_i in range(start_step, cfg.train.steps):
             t_step = time.perf_counter()
+            # Fault seam (obs/faultinject.py site "trainer.step"): one
+            # global read + branch unarmed; chaos plans inject mid-run
+            # failure here to drive the preempt/resume path.
+            faultinject.check("trainer.step")
             profiler.before_step(step_i)
             # Stall attribution (obs/spans.py): time blocked in next()
             # is INPUT STARVATION — the pipeline-fed gap measured where
@@ -1023,6 +1072,7 @@ def fit(
                 )
             with stalls.measure("dispatch"):
                 state, m = train_step(state, batch, base_key)
+            last_step = step_i + 1
             clock.after_step()
             if snap is not None:
                 snap.progress(step_i + 1)
@@ -1076,6 +1126,13 @@ def fit(
         # runs in normal (not async-signal) context — then re-raise.
         if flight is not None:
             flight.record_exception(e)
+        if _is_preemption(e) and last_step > start_step:
+            def _save(step):
+                saved = ckpt.save_latest(step, jax.device_get(state))
+                ckpt.wait()  # durable BEFORE the process exits
+                return saved
+
+            _preempt_save(log, last_step, _save, grain_tee, workdir)
         raise
     finally:
         # Early stop / short runs / exceptions must not leak an open
@@ -1452,10 +1509,12 @@ def fit_ensemble_parallel(
         flight.install_signal_handlers()
     stopped_early = False
     clock = _ThroughputClock(cfg.data.batch_size)
+    last_step = start_step
     _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
     try:
         for step_i in range(start_step, cfg.train.steps):
             t_step = time.perf_counter()
+            faultinject.check("trainer.step")
             profiler.before_step(step_i)
             with stalls.measure("input"):
                 batch = next(batches)
@@ -1469,6 +1528,7 @@ def fit_ensemble_parallel(
                 )
             with stalls.measure("dispatch"):
                 state, m_out = train_step(state, batch, base_keys)
+            last_step = step_i + 1
             clock.after_step()
             if snap is not None:
                 snap.progress(step_i + 1)
@@ -1560,6 +1620,22 @@ def fit_ensemble_parallel(
     except BaseException as e:
         if flight is not None:
             flight.record_exception(e)
+        if _is_preemption(e) and last_step > start_step:
+            def _save(step):
+                # Every member in lock-step, same as the eval-time save
+                # — a preempted member-parallel run must stay a valid
+                # member-parallel workdir (all latests at ONE step).
+                host_state = jax.device_get(gather_state(state))
+                wrote = False
+                for m in range(k):
+                    wrote = ckpts[m].save_latest(
+                        step, train_lib.unstack_member(host_state, m)
+                    ) or wrote
+                for c in ckpts:
+                    c.wait()
+                return wrote
+
+            _preempt_save(log, last_step, _save, grain_tee, workdir)
         raise
     finally:
         profiler.finalize()

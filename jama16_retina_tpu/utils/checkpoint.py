@@ -22,6 +22,15 @@ from jama16_retina_tpu.train_lib import TrainState
 BEST_METRIC = "val_auc"
 
 
+class CheckpointError(RuntimeError):
+    """Actionable restore failure (ISSUE 6 satellite): names WHICH
+    checkpoint (directory + step) failed and WHY, instead of the deep
+    orbax/pytree traceback a truncated or corrupted checkpoint dir
+    otherwise surfaces as. Raised by ``Checkpointer.restore`` for both
+    ``trainer.restore_for_eval`` and ``ServingEngine`` construction;
+    the original exception rides as ``__cause__``."""
+
+
 def member_dir(checkpoint_dir: str, member: int) -> str:
     """One directory per ensemble member (reference R9/R11 layout)."""
     return os.path.join(checkpoint_dir, f"member_{member:02d}")
@@ -51,6 +60,7 @@ class Checkpointer:
     def __init__(self, directory: str, max_to_keep: int = 3):
         import zlib
 
+        self._directory = directory
         self._max_to_keep = max_to_keep
         # Distinct barrier_sync_key_prefix per manager AND per directory:
         # on multi-host runs the managers finalize async saves through
@@ -127,6 +137,23 @@ class Checkpointer:
             self._best_kept = sorted(self._best_kept + [metric])
             self._best_kept = self._best_kept[-self._max_to_keep:]
         self._latest.save(step, args=ocp.args.StandardSave(state))
+
+    def save_latest(self, step: int, state: TrainState) -> bool:
+        """Unconditional ``latest/``-only save — the preemption path
+        (ISSUE 6): a SIGTERM mid-run has no fresh val metric, and a
+        placeholder metric would poison ``best/`` retention, so only
+        the resume point is written. Returns False (no-op) when the
+        step is already saved — a preemption landing exactly on an
+        eval-step save must not collide with orbax's
+        StepAlreadyExistsError."""
+        if step in self._latest.all_steps():
+            return False
+        state = jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            state,
+        )
+        self._latest.save(step, args=ocp.args.StandardSave(state))
+        return True
 
     def _enters_best(self, metric: float) -> bool:
         # Decided from the in-memory view (see __init__) — deterministic
@@ -235,6 +262,40 @@ class Checkpointer:
             # Deleted steps' metrics must not suppress future best/ saves.
             self._rebuild_best_kept()
 
+    def _do_restore(self, mngr, step: int, abstract):
+        """One orbax restore through the reliability seams (ISSUE 6):
+        the ``ckpt.restore`` fault point, bounded-backoff retry on
+        transient I/O, and — for everything else (truncated arrays,
+        missing members, mangled metadata) — a CheckpointError naming
+        the directory and step, because 'which checkpoint broke' is the
+        first question the operator runbook asks and a 40-frame pytree
+        traceback does not answer it."""
+        from jama16_retina_tpu.obs import faultinject
+        from jama16_retina_tpu.utils import retry as retry_lib
+
+        def _once():
+            faultinject.check("ckpt.restore")
+            return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+        try:
+            return retry_lib.retry_call(
+                _once, attempts=3, site="ckpt.restore"
+            )
+        except OSError as e:
+            raise CheckpointError(
+                f"checkpoint restore failed with transient I/O errors "
+                f"after retries: step {step} under {self._directory!r} "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint at step {step} under {self._directory!r} "
+                f"is unreadable ({type(e).__name__}: {e}) — the "
+                "directory is likely truncated/corrupted (torn copy, "
+                "partial delete); restore another step (available: "
+                f"{sorted(self.all_steps())}) or re-save the member"
+            ) from e
+
     def restore(self, abstract_state: TrainState, step: int | None = None
                 ) -> TrainState:
         """Restore ``step`` if given (from whichever manager has it),
@@ -263,14 +324,12 @@ class Checkpointer:
                 abstract = abstract.replace(ema_params=None)
             else:  # legacy: saved before the field existed
                 fields = ("step", "params", "batch_stats", "opt_state")
-                restored = mngr.restore(
-                    step,
-                    args=ocp.args.StandardRestore(
-                        {f: getattr(abstract, f) for f in fields}
-                    ),
+                restored = self._do_restore(
+                    mngr, step,
+                    {f: getattr(abstract, f) for f in fields},
                 )
                 return TrainState(**restored, ema_params=None)
-        return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return self._do_restore(mngr, step, abstract)
 
     def close(self) -> None:
         self._best.close()

@@ -1,0 +1,107 @@
+"""Bounded exponential-backoff retry for transient I/O (ISSUE 6).
+
+One retry policy for the whole data/checkpoint plane instead of ad-hoc
+loops: TFRecord reads (data/grain_pipeline.TFRecordIndex), orbax
+checkpoint restore (utils/checkpoint.Checkpointer), and predict.py's
+per-image file reads all route transient failures through
+``retry_call``. Design constraints:
+
+  * BOUNDED. ``attempts`` is a hard cap — a permanently broken path
+    must surface the ORIGINAL exception (raised from the last attempt,
+    with the attempt count in the log), never spin forever. Retry is
+    for transience, not for masking rot; the quarantine layer
+    (data.quarantined counters) owns persistent badness.
+  * CHEAP WHEN QUIET. The first attempt pays one try/except frame and
+    nothing else — no clock reads, no telemetry — so retry wrappers are
+    safe on hot paths (a TFRecordIndex.read happens per training
+    image).
+  * OBSERVABLE WHEN LOUD. Every retried-then-attempted call increments
+    ``io.retries`` (and ``io.retries.{site}`` when a site name is
+    given) in the process registry, so a link that flaps surfaces in
+    telemetry/.prom/obs_report long before it hard-fails a run.
+  * DETERMINISTIC IN TESTS. The backoff sleeps through an injectable
+    ``sleep`` callable and the delays are a pure function of
+    (base_delay, attempt) — no jitter — so tests/test_faults.py can
+    pin the exact schedule with a recording fake.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable
+
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.obs import registry as obs_registry
+
+# The exception classes retry_call treats as transient by default:
+# filesystem/network hiccups. ValueError & friends (corrupt payloads)
+# are NOT here — a malformed record does not get better on retry; it
+# gets quarantined (data/grain_pipeline.py) or raised.
+DEFAULT_TRANSIENT: tuple = (OSError, IOError)
+
+
+def backoff_delays(attempts: int, base_delay: float,
+                   max_delay: float) -> Iterable[float]:
+    """The sleep schedule between attempts: base * 2^k, capped.
+    Pure function of its arguments (no jitter) — the determinism the
+    fault-injection tests pin."""
+    d = base_delay
+    for _ in range(max(0, attempts - 1)):
+        yield min(d, max_delay)
+        d *= 2.0
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    attempts: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    retry_on: tuple = DEFAULT_TRANSIENT,
+    site: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    registry: "obs_registry.Registry | None" = None,
+    **kwargs,
+):
+    """``fn(*args, **kwargs)`` with up to ``attempts`` tries.
+
+    Exceptions in ``retry_on`` trigger a backoff-and-retry; anything
+    else propagates immediately (corrupt data must not burn the retry
+    budget meant for transient I/O). The LAST attempt's exception is
+    re-raised unchanged, so callers' except clauses keep matching the
+    original type. ``site`` names the call site in the retry counters
+    (``io.retries.{site}``) and the warning log.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delays = backoff_delays(attempts, base_delay, max_delay)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if attempt == attempts:
+                absl_logging.warning(
+                    "retry budget exhausted%s after %d attempts: %s: %s",
+                    f" at {site}" if site else "", attempts,
+                    type(e).__name__, e,
+                )
+                raise
+            reg = (registry if registry is not None
+                   else obs_registry.default_registry())
+            reg.counter(
+                "io.retries",
+                help="transient I/O failures that were retried "
+                     "(utils/retry.py), all sites",
+            ).inc()
+            if site:
+                reg.counter(f"io.retries.{site}").inc()
+            delay = next(delays)
+            absl_logging.warning(
+                "transient %s%s (attempt %d/%d), retrying in %.3fs: %s",
+                type(e).__name__, f" at {site}" if site else "",
+                attempt, attempts, delay, e,
+            )
+            if delay > 0:
+                sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -78,6 +78,14 @@ _STRICT = flags.DEFINE_boolean(
     "check exit codes. Default keeps the per-row error JSON + exit 0 "
     "behavior when at least one image scored",
 )
+_MAX_RETRIES = flags.DEFINE_integer(
+    "max_retries", 0,
+    "per-image retries for TRANSIENT read errors (flaky NFS/network "
+    "mounts; utils/retry.py exponential backoff). A retried-then-"
+    "scored image is counted separately (serve.input_retried + a "
+    "'retried' field on its row) from rejects, so --strict semantics "
+    "stay exact: only genuinely skipped images exit 2",
+)
 _HOST_WORKERS = flags.DEFINE_integer(
     "host_workers", 0,
     "fundus-normalization worker threads (serve/host.py): 0 auto-"
@@ -140,6 +148,12 @@ def main(argv):
     cfg = configs.get_config(_CONFIG.value)
     if _SET.value:
         cfg = configs.override(cfg, _SET.value)
+    # Fault plan armed BEFORE the host preprocessing stage: the
+    # host.decode seam lives there, ahead of engine construction
+    # (obs/faultinject.py; env wins over obs.fault_plan).
+    from jama16_retina_tpu.obs import faultinject
+
+    faultinject.arm_from_env_or_config(cfg.obs.fault_plan)
     from jama16_retina_tpu.utils import checkpoint as ckpt_lib
 
     dirs = list(_ENSEMBLE.value)
@@ -184,8 +198,10 @@ def main(argv):
         # The flag wins; 0 falls through to the config knob, and 0 there
         # too means auto (resolve_decode_workers).
         workers=_HOST_WORKERS.value or cfg.serve.host_workers,
+        max_retries=_MAX_RETRIES.value,
     )
     kept, skipped, qualities = pre.kept, pre.skipped, pre.qualities
+    retried_paths = set(pre.retried)
     for p, why in skipped:
         print(json.dumps({"image": p, "error": why}))
     if not kept:
@@ -319,6 +335,11 @@ def main(argv):
         row["quality"] = round(float(qual), 4)
         if _MIN_QUALITY.value > 0:
             row["gradable"] = bool(qual >= _MIN_QUALITY.value)
+        if p in retried_paths:
+            # Transient-read survivor (--max_retries): scored like any
+            # other row, flagged so pipelines can spot a flaky mount
+            # without treating the batch as incomplete.
+            row["retried"] = True
         row["n_models"] = len(dirs)
         print(json.dumps(row))
 

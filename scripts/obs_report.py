@@ -486,6 +486,120 @@ def render_slowest(events: list, n: int = 10) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Reliability: shed/deadline/quarantine/window-error/reload ledger (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def reliability_summary(records: list) -> "dict | None":
+    """The Reliability section's machine-readable form (--json twin):
+    load-shedding and deadline-miss counters, data-plane quarantine +
+    retry ledger, batcher window errors, and the hot-swap reload state
+    (live generation + canary verdict). None when the run carries none
+    of these signals — a healthy run that never shed/quarantined/
+    reloaded renders nothing rather than a table of zeros."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    counters = latest.get("counters", {})
+    gauges = latest.get("gauges", {})
+    reloads = [r for r in records if r.get("kind") == "reload"]
+    preempts = [r for r in records if r.get("kind") == "preempt_save"]
+    interesting = (
+        any(k.startswith(("serve.shed.", "data.quarantined",
+                          "io.retries", "serve.reload"))
+            or k in ("serve.batcher.window_errors", "serve.reloads")
+            for k in counters)
+        or "serve.generation" in gauges
+        or reloads or preempts
+    )
+    if not interesting:
+        return None
+    out = {
+        "shed": {
+            k[len("serve.shed."):]: int(v)
+            for k, v in sorted(counters.items())
+            if k.startswith("serve.shed.")
+        },
+        "quarantined": int(counters.get("data.quarantined", 0)),
+        "quarantined_by_reason": {
+            k[len("data.quarantined."):]: int(v)
+            for k, v in sorted(counters.items())
+            if k.startswith("data.quarantined.")
+        },
+        "io_retries": int(counters.get("io.retries", 0)),
+        "io_retries_by_site": {
+            k[len("io.retries."):]: int(v)
+            for k, v in sorted(counters.items())
+            if k.startswith("io.retries.")
+        },
+        "input_retried": int(counters.get("serve.input_retried", 0)),
+        "window_errors": int(
+            counters.get("serve.batcher.window_errors", 0)
+        ),
+        "reloads": int(counters.get("serve.reloads", 0)),
+        "reload_rejected": int(counters.get("serve.reload_rejected", 0)),
+        "generation": (
+            int(gauges["serve.generation"])
+            if "serve.generation" in gauges else None
+        ),
+        "rows_by_generation": {
+            k[len("serve.gen"):-len(".rows")]: int(v)
+            for k, v in sorted(counters.items())
+            if k.startswith("serve.gen") and k.endswith(".rows")
+        },
+        "canary_ok": (
+            bool(gauges.get("quality.canary_ok", 0))
+            if "quality.canary_ok" in gauges else None
+        ),
+        "preempt_saves": [
+            {"step": r.get("step"), "saved": r.get("saved")}
+            for r in preempts
+        ],
+    }
+    return out
+
+
+def render_reliability(records: list) -> "str | None":
+    s = reliability_summary(records)
+    if s is None:
+        return None
+    rows = []
+    if s["generation"] is not None:
+        canary = ("-" if s["canary_ok"] is None
+                  else ("ok" if s["canary_ok"] else "FAILED"))
+        rows.append((
+            "serving generation",
+            f"{s['generation']} (canary {canary}, {s['reloads']} "
+            f"reloads, {s['reload_rejected']} rejected)",
+        ))
+    for reason, n in sorted(s["shed"].items()):
+        if n:  # zero-shed counters exist on every serving run
+            rows.append((f"shed ({reason})", n))
+    if s["quarantined"]:
+        by = ", ".join(f"{r}={n}" for r, n in
+                       sorted(s["quarantined_by_reason"].items()))
+        rows.append(("quarantined records",
+                     f"{s['quarantined']}" + (f" ({by})" if by else "")))
+    if s["io_retries"]:
+        by = ", ".join(f"{site}={n}" for site, n in
+                       sorted(s["io_retries_by_site"].items()))
+        rows.append(("transient I/O retries",
+                     f"{s['io_retries']}" + (f" ({by})" if by else "")))
+    if s["input_retried"]:
+        rows.append(("inputs retried then scored", s["input_retried"]))
+    if s["window_errors"]:
+        rows.append(("batcher window errors", s["window_errors"]))
+    if s["reloads"] or s["reload_rejected"]:
+        for g, n in sorted(s["rows_by_generation"].items()):
+            rows.append((f"rows served by gen {g}", n))
+    for p in s["preempt_saves"]:
+        rows.append(("preemption save",
+                     f"step {p['step']} (saved={p['saved']})"))
+    if not rows:
+        return None
+    return "reliability:\n" + _table(rows, ("signal", "value"))
+
+
+# ---------------------------------------------------------------------------
 # Quality: drift gauges, canary status, alert state (ISSUE 5)
 # ---------------------------------------------------------------------------
 
@@ -770,6 +884,7 @@ def main(argv=None) -> int:
             "stalls": stalls_summary(records),
             "telemetry": telemetry[-1] if telemetry else None,
             "quality": quality_summary(records),
+            "reliability": reliability_summary(records),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
                 for p, b in sorted(latest_heartbeats(records).items())
@@ -789,6 +904,10 @@ def main(argv=None) -> int:
     if q:
         print()
         print(q)
+    rel = render_reliability(records)
+    if rel:
+        print()
+        print(rel)
     print()
     print(render_heartbeats(records))
     if events:

@@ -123,6 +123,19 @@ _QUICK_TESTS = {
     "test_quality.py::test_override_unknown_nested_key_did_you_mean",
     "test_quality.py::test_check_alerts_exit_codes",
     "test_quality.py::test_prom_rewrite_atomic_under_concurrent_reader",
+    # fault tolerance (ISSUE 6): the numpy-cheap chaos pins — plan
+    # determinism, retry schedule, typed shedding/deadline, quarantine
+    # substitution, reliability rules/report; the engine-reload and
+    # kill-and-resume tests stay in the full tier (XLA compiles)
+    "test_faults.py::test_raise_on_nth_call_is_deterministic",
+    "test_faults.py::test_retry_schedule_and_exhaustion",
+    "test_faults.py::test_shed_rejects_typed_at_submit_and_counts",
+    "test_faults.py::test_expired_deadline_fails_typed_before_device_work",
+    "test_faults.py::test_injected_dispatch_fault_fails_one_window_worker_survives",
+    "test_faults.py::test_poison_record_quarantined_and_substituted",
+    "test_faults.py::test_reliability_rules_read_the_shed_gauges",
+    "test_faults.py::test_quarantine_rate_alert_fires_on_systemic_rot",
+    "test_faults.py::test_obs_report_reliability_section",
 }
 
 

@@ -649,15 +649,31 @@ def test_quality_rules_from_config():
 
 def test_manager_for_trainerless_wiring(tmp_path):
     """The ONE wiring rule serving/predict share: rules implied by the
-    config, FlightRecorder over the workdir; None when obs is off or
-    no rules exist."""
+    config, FlightRecorder over the workdir; None when obs is off.
+    Since ISSUE 6 the reliability rules (data-quarantine burn rate,
+    rejected-reload) ride along unconditionally — inactive until their
+    metrics exist — so a quality-off serving session still alerts on
+    data rot and failed rollouts."""
     cfg = get_config("smoke")
     cfg_q = cfg.replace(obs=dataclasses.replace(cfg.obs, quality=_qcfg()))
     reg = obs_registry.Registry()
     am = obs_alerts.manager_for(cfg_q, str(tmp_path), registry=reg)
-    assert am is not None and len(am.rules) == 3
+    assert am is not None
+    quality_rules = [r for r in am.rules
+                     if r.metric.startswith("quality.")]
+    rel_rules = [r for r in am.rules
+                 if not r.metric.startswith("quality.")]
+    assert len(quality_rules) == 3
+    assert {r.reason for r in rel_rules} == {
+        "data_quarantine", "reload_rejected"
+    }
     assert am._flight is not None and am._flight.workdir == str(tmp_path)
-    assert obs_alerts.manager_for(cfg, str(tmp_path)) is None  # quality off
+    # Quality off: the reliability rules alone still get a manager.
+    am_base = obs_alerts.manager_for(cfg, str(tmp_path))
+    assert am_base is not None
+    assert {r.reason for r in am_base.rules} == {
+        "data_quarantine", "reload_rejected"
+    }
     cfg_off = cfg_q.replace(
         obs=dataclasses.replace(cfg_q.obs, enabled=False)
     )
