@@ -71,8 +71,33 @@ class DataConfig:
     # with overlapped per-shard H2D staging, so throughput degrades
     # gracefully from the HBM-resident rate toward the streamed floor
     # instead of cliffing when the split outgrows HBM
-    # (data/tiered_pipeline.py). Same {'image','grade'} batch contract.
+    # (data/tiered_pipeline.py); "rawshard" = the tiered machinery over
+    # ahead-of-time transcoded raw array shards
+    # (scripts/transcode_shards.py + data/rawshard.py): decode/resize
+    # paid ONCE offline, steady-state reads are mmap row memcpys —
+    # bit-identical (post-decode) to the streamed path at the same
+    # seed. Same {'image','grade'} batch contract throughout.
     loader: str = "tfdata"
+    # Closed-loop ingest autotuner (data/autotune.py; ISSUE 7): the
+    # flax train loops observe their own stall attribution over
+    # tumbling log windows and adjust decode_workers / stage_depth /
+    # prefetch depth ONLINE (hill-climb with hysteresis, HBM-budget
+    # clamped). Every tunable knob is content-invariant, so a tuned
+    # run's batches — and final eval metrics — are bit-identical to
+    # the same seed with hand-set knobs. Off by default (the hand-set
+    # values below then apply verbatim).
+    autotune: bool = False
+    # Per-device memory-limit override (bytes, BEFORE the budget
+    # fraction) for every HBM-budget derivation (hbm/tiered residency
+    # gates, eval caches, the autotuner's staging headroom). 0 = detect
+    # from the runtime, falling back to the conservative 8 GB smallest-
+    # deployed-core assumption (hbm_pipeline.hbm_budget_bytes logs the
+    # fallback and names this knob).
+    hbm_budget_bytes: int = 0
+    # Directory of ahead-of-time transcoded raw shards for
+    # data.loader=rawshard. Empty = <data_dir>/rawshard<image_size>,
+    # the default scripts/transcode_shards.py writes to.
+    rawshard_dir: str = ""
     # Host decode worker THREADS for the tiered loader's streamed tier
     # and the hbm/tiered one-time resident load
     # (grain_pipeline.ParallelDecoder). 0 = auto: one per host core up

@@ -249,6 +249,35 @@ class ParallelDecoder:
                 max_workers=self.workers, thread_name_prefix="jama16-decode"
             )
 
+    def __len__(self) -> int:
+        return len(self.index)
+
+    def set_workers(self, n: int) -> None:
+        """Resize the decode pool live (the autotuner's decode_workers
+        knob; data/autotune.py). Output is worker-count-invariant by
+        the class contract, so this is a pure throughput adjustment.
+        Caller contract: invoked BETWEEN decode calls on the consuming
+        thread (the tiered fill loop polls it per batch) — never
+        concurrently with an in-flight decode_batch/decode_range."""
+        n = max(1, int(n))
+        if n == self.workers:
+            return
+        old = self._pool
+        self.workers = n
+        if n > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="jama16-decode"
+            )
+        else:
+            self._pool = None
+        if old is not None:
+            # No tasks are in flight (caller contract) — the old pool's
+            # idle threads just exit.
+            old.shutdown(wait=False)
+        self._registry.gauge("data.decode.workers").set(n)
+
     def _read_decode(self, i: int, n: "int | None" = None) -> dict:
         return _decode_example(
             self.index.read(i % n if n else i), self.image_size

@@ -109,6 +109,7 @@ def resident_row_capacity(
     n_devices: int = 1,
     max_fraction: float = 0.6,
     budget_bytes: "int | None" = None,
+    budget_base_bytes: int = 0,
 ) -> int:
     """How many dataset rows the HBM budget admits ACROSS the data axis
     — the partial-residency generalization of ``fits_in_hbm``'s
@@ -116,25 +117,37 @@ def resident_row_capacity(
     streams the rest; data/tiered_pipeline.py). ``budget_bytes``
     overrides the derivation with an explicit TOTAL resident budget
     (the tiered loader's ``tiered_resident_bytes`` knob; benches pin it
-    for reproducible partial-residency measurements)."""
+    for reproducible partial-residency measurements);
+    ``budget_base_bytes`` is the ``data.hbm_budget_bytes`` per-chip
+    memory-limit override the derivation consults when it does run."""
     total = (
         budget_bytes if budget_bytes is not None
-        else hbm_budget_bytes(max_fraction) * max(n_devices, 1)
+        else hbm_budget_bytes(
+            max_fraction, budget_base_bytes=budget_base_bytes
+        ) * max(n_devices, 1)
     )
     return max(0, total // row_bytes(image_size))
 
 
-def hbm_budget_bytes(max_fraction: float = 0.6) -> int:
+def hbm_budget_bytes(max_fraction: float = 0.6,
+                     budget_base_bytes: int = 0) -> int:
     """Per-chip HBM budget for the resident dataset: ``max_fraction`` of
     the device's memory limit when the runtime reports one. When it
-    reports none, assume the SMALLEST HBM of any deployed TPU core
-    (8 GB, v2/v3) rather than the v5e's 16 — an optimistic assumption
-    here is an OOM at upload time, and the fallback is disclosed in the
-    log the same way bench.py discloses its generous physics default
-    (ADVICE r3). The remaining fraction belongs to the model/optimizer/
-    activations (the flagship step's live set is ~2 GB)."""
+    reports none, the operator's ``data.hbm_budget_bytes`` override
+    (``budget_base_bytes`` > 0, the per-chip memory limit BEFORE the
+    fraction) wins; with neither, assume the SMALLEST HBM of any
+    deployed TPU core (8 GB, v2/v3) rather than the v5e's 16 — an
+    optimistic assumption here is an OOM at upload time, and the
+    fallback is disclosed in a log that names the knob that fixes it
+    (ISSUE 7). An explicit override also beats a reported limit: the
+    operator saying "budget for 16 GB" on a runtime that under-reports
+    must win, and the precedence is then one rule, not two. The
+    remaining fraction belongs to the model/optimizer/activations (the
+    flagship step's live set is ~2 GB)."""
     import jax
 
+    if budget_base_bytes and budget_base_bytes > 0:
+        return int(budget_base_bytes * max_fraction)
     limit = None
     try:
         stats = jax.local_devices()[0].memory_stats()
@@ -146,19 +159,24 @@ def hbm_budget_bytes(max_fraction: float = 0.6) -> int:
         limit = 8 * 1024**3
         logging.warning(
             "device reports no bytes_limit: assuming a conservative "
-            "%d GB HBM budget base (smallest deployed TPU core)",
+            "%d GB HBM budget base (smallest deployed TPU core) — set "
+            "data.hbm_budget_bytes to this chip's true per-device "
+            "memory limit to override",
             limit // 1024**3,
         )
     return int(limit * max_fraction)
 
 
 def fits_in_hbm(
-    n: int, image_size: int, n_devices: int = 1, max_fraction: float = 0.6
+    n: int, image_size: int, n_devices: int = 1, max_fraction: float = 0.6,
+    budget_base_bytes: int = 0,
 ) -> bool:
     """The size gate: the dataset shards row-wise across the mesh's data
     axis, so the per-chip share must fit the per-chip budget."""
     per_chip = dataset_bytes(n, image_size) / max(n_devices, 1)
-    return per_chip <= hbm_budget_bytes(max_fraction)
+    return per_chip <= hbm_budget_bytes(
+        max_fraction, budget_base_bytes=budget_base_bytes
+    )
 
 
 def _load_index_rows_sharded(index, n: int, image_size: int, mesh,
@@ -322,12 +340,16 @@ def train_batches(
     # 'member' axis of an ensemble mesh) — gating on total device count
     # would under-count per-chip bytes by the member-axis factor.
     n_dev = mesh.shape[mesh_lib._batch_axis(mesh)] if mesh is not None else 1
-    if not fits_in_hbm(n, image_size, n_dev, max_fraction):
+    budget_base = getattr(cfg, "hbm_budget_bytes", 0)
+    if not fits_in_hbm(n, image_size, n_dev, max_fraction,
+                       budget_base_bytes=budget_base):
         raise ValueError(
             f"{split} split ({dataset_bytes(n, image_size) / 1e9:.1f}"
             f" GB over {n_dev} chip(s)) exceeds the HBM-resident budget "
-            f"({hbm_budget_bytes(max_fraction) / 1e9:.1f} GB/chip); use the "
-            "tfdata or grain loader for datasets this size"
+            f"({hbm_budget_bytes(max_fraction, budget_base_bytes=budget_base) / 1e9:.1f}"
+            " GB/chip); use the tfdata or grain loader for datasets "
+            "this size, or set data.hbm_budget_bytes if this chip's "
+            "true memory limit is larger than the assumed base"
         )
     if multiprocess:
         images, grades = _load_index_rows_sharded(

@@ -377,7 +377,7 @@ def _rank_sharding_for(x, sharding):
 
 def device_prefetch(
     it: Iterator[dict], sharding=None, size: int = 2,
-    full_local: bool = False, per_shard: bool = False,
+    full_local: bool = False, per_shard: bool = False, knobs=None,
 ) -> Iterator[dict]:
     """Move batches to device ahead of consumption (double-buffering).
 
@@ -395,6 +395,12 @@ def device_prefetch(
     ``per_shard``: stage the single-process sharded put per device block
     (``staged_put``) so the H2D copies overlap the train step at shard
     granularity (DataConfig.stage_per_shard).
+
+    ``knobs`` (data/autotune.Knobs): when present, the queue depth is
+    the live ``prefetch_depth`` knob polled each iteration instead of
+    the static ``size`` — the ingest autotuner's prefetch control
+    (data.autotune). Depth is pure run-ahead: batch contents and order
+    are untouched, only how far ahead their H2D copies are issued.
     """
     from jama16_retina_tpu.obs import registry as obs_registry
 
@@ -449,7 +455,11 @@ def device_prefetch(
 
     for batch in it:
         queue.append(put(batch))
-        if len(queue) > size:
+        depth = size if knobs is None else knobs.prefetch_depth
+        # `while`, not `if`: a live depth DECREASE must let the queue
+        # drain below the old level (each generator pull then serves
+        # from the queue without appending until the new depth holds).
+        while len(queue) > depth:
             g_depth.set(len(queue) - 1)
             yield queue.popleft()
     while queue:

@@ -250,11 +250,28 @@ def train_batches(
     skip_batches: int = 0,
     mesh=None,
     max_fraction: float = 0.6,
+    knobs=None,
+    decoder_factory=None,
 ) -> Iterator[dict]:
     """Drop-in twin of pipeline.train_batches yielding DEVICE-resident
     batches whose rows mix the HBM-resident and streamed tiers.
     ``skip_batches`` is an O(1) counter offset (pure (seed, step)
-    semantics, same contract as the hbm loader)."""
+    semantics, same contract as the hbm loader).
+
+    ``knobs`` (data/autotune.Knobs): live decode_workers/stage_depth
+    the fill loop polls between batches — the ingest autotuner's
+    control surface. Both knobs are content-invariant (ParallelDecoder
+    worker invariance; stage depth is pure run-ahead), so a tuned run's
+    batch sequence is identical to a hand-set one.
+
+    ``decoder_factory`` (``(workers, quarantine) -> decoder``): swap
+    the record-decode stage while keeping ALL of this loader's
+    machinery — the residency plan, staging, combine jit, quarantine
+    substitution and telemetry. The decoder contract is
+    grain_pipeline.ParallelDecoder's surface (``__len__``,
+    ``decode_batch``, ``decode_range``, ``set_workers``, ``close``).
+    data/rawshard.py plugs its ahead-of-time-transcoded shards in
+    here."""
     import jax
 
     from jama16_retina_tpu.data.grain_pipeline import (
@@ -271,8 +288,19 @@ def train_batches(
             "grain/tfdata loaders on multi-process launches"
         )
 
-    index = TFRecordIndex(tfrecord.list_split(data_dir, split))
-    n = len(index)
+    workers = (
+        knobs.decode_workers if knobs is not None
+        else resolve_decode_workers(cfg.decode_workers)
+    )
+    if decoder_factory is None:
+        index = TFRecordIndex(tfrecord.list_split(data_dir, split))
+        decoder = ParallelDecoder(
+            index, image_size, workers=workers,
+            quarantine=cfg.quarantine_bad_records,
+        )
+    else:
+        decoder = decoder_factory(workers, cfg.quarantine_bad_records)
+    n = len(decoder)
     if n == 0:
         raise ValueError(f"no records under {data_dir}/{split}")
 
@@ -285,13 +313,9 @@ def train_batches(
             cfg.tiered_resident_bytes
             if cfg.tiered_resident_bytes >= 0 else None
         ),
+        budget_base_bytes=getattr(cfg, "hbm_budget_bytes", 0),
     )
     plan = _TierPlan(n, cfg.batch_size, capacity, seed)
-    workers = resolve_decode_workers(cfg.decode_workers)
-    decoder = ParallelDecoder(
-        index, image_size, workers=workers,
-        quarantine=cfg.quarantine_bad_records,
-    )
 
     logging.info(
         "tiered loader: %d/%d rows HBM-resident (%.0f%%, %.1f MB over %d "
@@ -356,6 +380,13 @@ def train_batches(
     step = skip_batches
     try:
         while True:
+            if knobs is not None:
+                # Live knob poll (one lock + int read each): a raised
+                # stage depth fills deeper on the next iteration, a
+                # lowered one just lets the queue drain to the new
+                # level; worker resizes land between decode calls.
+                decoder.set_workers(knobs.decode_workers)
+                depth = knobs.stage_depth
             while len(queue) <= depth:
                 queue.append(make_batch(step + len(queue)))
             g_depth.set(len(queue))

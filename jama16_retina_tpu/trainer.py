@@ -423,6 +423,7 @@ def _load_grain_state(cfg: ExperimentConfig, workdir: str,
 def _train_stream(
     cfg: ExperimentConfig, data_dir: str, seed: int, skip_batches: int,
     mesh=None, full_batches: bool = False, grain_state: bytes | None = None,
+    knobs=None,
 ):
     """Dispatch on data.loader (SURVEY.md N4): every loader yields the
     same {'image','grade'} batches and honors skip_batches, so the train
@@ -433,7 +434,14 @@ def _train_stream(
     ``full_batches``: every process reads the FULL global batch stream
     instead of its 1/P slice — the member-parallel driver's contract
     (its ('member','data') layout needs all rows on every host; see
-    pipeline.device_prefetch full_local)."""
+    pipeline.device_prefetch full_local).
+
+    ``knobs`` (data/autotune.Knobs; data.autotune=true): the live
+    decode-worker/stage-depth control surface for the loaders that
+    expose it (tiered, rawshard). tfdata/grain tune at the
+    device_prefetch layer only (their engines own their internal
+    parallelism), and the hbm loader has no steady-state host work to
+    tune — both ignore it here."""
     proc_kw = (
         {"process_index": 0, "process_count": 1} if full_batches else {}
     )
@@ -453,7 +461,17 @@ def _train_stream(
         # moot the same way it is for 'hbm' (one global stream).
         return tiered_pipeline.train_batches(
             data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
-            skip_batches=skip_batches, mesh=mesh,
+            skip_batches=skip_batches, mesh=mesh, knobs=knobs,
+        )
+    if cfg.data.loader == "rawshard":
+        from jama16_retina_tpu.data import rawshard
+
+        # The tiered machinery over ahead-of-time transcoded shards
+        # (scripts/transcode_shards.py): bit-identical batches, decode
+        # replaced by an mmap row copy (data/rawshard.py).
+        return rawshard.train_batches(
+            data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
+            skip_batches=skip_batches, mesh=mesh, knobs=knobs,
         )
     if cfg.data.loader == "grain":
         from jama16_retina_tpu.data import grain_pipeline
@@ -467,12 +485,24 @@ def _train_stream(
     if cfg.data.loader != "tfdata":
         raise ValueError(
             f"unknown data.loader {cfg.data.loader!r} "
-            "(want tfdata|grain|hbm|tiered)"
+            "(want tfdata|grain|hbm|tiered|rawshard)"
         )
     return pipeline.train_batches(
         data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
         skip_batches=skip_batches, **proc_kw,
     )
+
+
+def _autotune_for(cfg: ExperimentConfig, mesh=None):
+    """(knobs, tuner) when data.autotune is on, else (None, None).
+    Built AFTER _obs_begin_run (the tuner's gauges/counters belong to
+    this run) and BEFORE the pipelines (the loaders capture the knobs
+    at construction)."""
+    if not cfg.data.autotune:
+        return None, None
+    from jama16_retina_tpu.data import autotune as autotune_lib
+
+    return autotune_lib.for_config(cfg, mesh=mesh)
 
 
 def _best_tracking_update(
@@ -809,7 +839,7 @@ def _eval_cache_for(
     cannot pin 3x the gate by admitting each split individually), so the
     cache is never the one tenant that never asked (the train split's
     own gate allows up to 60%, and the train state needs the rest)."""
-    if cfg.data.loader not in ("hbm", "tiered"):
+    if cfg.data.loader not in ("hbm", "tiered", "rawshard"):
         return None
     from jama16_retina_tpu.data import hbm_pipeline
 
@@ -817,7 +847,10 @@ def _eval_cache_for(
     # the same per-(dir, split) cache the eval protocol already fills,
     # so the gate adds no second scan over the records.
     split_bytes = _eval_cache_bytes(cfg, data_dir, split)
-    if reserved_bytes + split_bytes <= 0.1 * hbm_pipeline.hbm_budget_bytes():
+    budget = hbm_pipeline.hbm_budget_bytes(
+        budget_base_bytes=cfg.data.hbm_budget_bytes
+    )
+    if reserved_bytes + split_bytes <= 0.1 * budget:
         return []
     absl_logging.warning(
         "%s split (%.1f MB + %.1f MB already cached) exceeds 10%% of the "
@@ -1020,6 +1053,11 @@ def fit(
 
     base_key = jax.random.key(seed)
     _obs_begin_run(cfg)  # before the pipelines create their metrics
+    # Closed-loop ingest autotuner (data/autotune.py; data.autotune):
+    # live content-invariant knobs the loaders poll, adjusted at every
+    # log-window boundary below from the same stall attribution the
+    # window's train record carries.
+    knobs, tuner = _autotune_for(cfg, mesh=mesh)
     # skip_batches=start_step: one batch per completed step, so a resumed
     # stream continues exactly where the interrupted one stopped
     # (pipeline determinism; SURVEY.md §5.4). Augment/dropout keys need
@@ -1027,6 +1065,7 @@ def fit(
     stream = _train_stream(
         cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
         grain_state=_load_grain_state(cfg, workdir, start_step),
+        knobs=knobs,
     )
     grain_tee = None
     if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
@@ -1040,6 +1079,7 @@ def fit(
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
         per_shard=cfg.data.stage_per_shard,
+        knobs=knobs,
     )
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
@@ -1092,10 +1132,19 @@ def fit(
                     # Cheap non-finite sentinel on the ALREADY-fetched
                     # loss (no extra device sync).
                     flight.note_loss(loss, step=step_i + 1)
+                stall_fields = stalls.fields()
                 log.write(
                     "train", step=step_i + 1, loss=loss,
-                    **clock.fields(), **stalls.fields(),
+                    **clock.fields(), **stall_fields,
                 )
+                if tuner is not None:
+                    # One tumbling tuner window per log window: the
+                    # stall attribution just computed IS the tuner's
+                    # starvation signal (observability as control).
+                    tuner.observe(
+                        stall_fields["window_sec"],
+                        stall_fields["input_wait_sec"],
+                    )
                 if snap is not None:
                     snap.maybe_flush()
 
@@ -1483,10 +1532,12 @@ def fit_ensemble_parallel(
             )
 
     _obs_begin_run(cfg)  # before the pipelines create their metrics
+    knobs, tuner = _autotune_for(cfg, mesh=mesh)
     stream = _train_stream(
         cfg, data_dir, seed, skip_batches=start_step, mesh=mesh,
         full_batches=True,
         grain_state=_load_grain_state(cfg, workdir, start_step),
+        knobs=knobs,
     )
     grain_tee = None
     if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
@@ -1501,6 +1552,7 @@ def fit_ensemble_parallel(
         sharding=mesh_lib.batch_sharding(mesh),
         size=cfg.data.prefetch_batches,
         full_local=True,
+        knobs=knobs,
     )
 
     profiler = _ProfilerWindow(cfg, log, workdir, start_step)
@@ -1548,12 +1600,18 @@ def fit_ensemble_parallel(
                     # (the members are independent; one diverging must
                     # not hide in the mean).
                     flight.note_loss(losses, step=step_i + 1)
+                stall_fields = stalls.fields()
                 log.write(
                     "train", step=step_i + 1,
                     loss=round(float(losses.mean()), 6),
                     loss_per_member=[round(float(x), 6) for x in losses],
-                    **clock.fields(), **stalls.fields(),
+                    **clock.fields(), **stall_fields,
                 )
+                if tuner is not None:
+                    tuner.observe(
+                        stall_fields["window_sec"],
+                        stall_fields["input_wait_sec"],
+                    )
                 if snap is not None:
                     snap.maybe_flush()
 
@@ -1734,11 +1792,18 @@ def fit_tf(
             "train.ema_decay is a flax-path feature; the legacy tf "
             "backend has no EMA shadow (see TrainConfig.ema_decay)"
         )
-    if cfg.data.loader in ("hbm", "tiered"):
+    if cfg.data.loader in ("hbm", "tiered", "rawshard"):
         raise ValueError(
             f"data.loader={cfg.data.loader!r} yields device-resident "
             "batches for the jit train step; the tf backend trains on "
             "host — use the tfdata or grain loader with --device=tf"
+        )
+    if cfg.data.autotune:
+        raise ValueError(
+            "data.autotune is wired into the flax train loops (the "
+            "tuner reads their stall attribution at log boundaries); "
+            "the legacy tf backend has no wiring — unset data.autotune "
+            "with --device=tf"
         )
     if cfg.data.loader == "grain" and cfg.data.grain_workers > 0:
         raise ValueError(

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Ahead-of-time transcode: TFRecord splits -> raw array shards.
+
+The offline half of ``data.loader=rawshard`` (data/rawshard.py; ISSUE
+7): decode + resize every record ONCE, here, so steady-state training
+reads mmap'd uint8 rows instead of paying a JPEG decode (or proto
+parse) per image per epoch. Output per split is
+``<split>-NNNNN-of-MMMMM.images.npy`` / ``.grades.npy`` shard pairs
+plus a versioned ``<split>.rawshard.json`` manifest (schema: docs/
+PERF.md §Data plane). Writes are atomic and the manifest advances
+after every durable shard, so an interrupted run RESUMES where it
+stopped — just re-run the same command.
+
+Usage:
+
+    python scripts/transcode_shards.py --data_dir /data/eyepacs \\
+        --splits train,val --image_size 299
+
+    # then train without per-epoch decode:
+    python train.py --data_dir /data/eyepacs --set data.loader=rawshard
+
+Records are decoded with the SAME rules the streamed tier applies
+online (including poison-record quarantine substitution), so the
+rawshard batches are bit-identical to the streamed path at the same
+seed — the transcode changes the encoding, never the data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--data_dir", required=True,
+        help="directory holding the source <split>-*.tfrecord shards",
+    )
+    parser.add_argument(
+        "--splits", default="train",
+        help="comma-separated split names to transcode (default: train; "
+             "eval splits rarely need it — they stream once per eval)",
+    )
+    parser.add_argument(
+        "--out_dir", default="",
+        help="output directory (default: <data_dir>/rawshard<image_size>, "
+             "where data.loader=rawshard looks without data.rawshard_dir)",
+    )
+    parser.add_argument(
+        "--image_size", type=int, default=299,
+        help="resize target — MUST match model.image_size at train time "
+             "(the loader refuses a size mismatch)",
+    )
+    parser.add_argument(
+        "--shard_records", type=int, default=256,
+        help="records per output shard (resume granularity; each shard "
+             "is ~records x size^2 x 3 bytes)",
+    )
+    parser.add_argument(
+        "--workers", type=int, default=0,
+        help="decode threads (0 = auto, one per host core up to 8)",
+    )
+    parser.add_argument(
+        "--no_resume", action="store_true",
+        help="rebuild every shard even when a matching manifest exists",
+    )
+    parser.add_argument(
+        "--no_quarantine", action="store_true",
+        help="fail loudly on a poison source record instead of baking "
+             "the streamed tier's deterministic substitution into the "
+             "shards",
+    )
+    args = parser.parse_args(argv)
+
+    from jama16_retina_tpu.data import rawshard
+
+    for split in [s for s in args.splits.split(",") if s]:
+        manifest = rawshard.transcode_split(
+            args.data_dir, split,
+            out_dir=args.out_dir or None,
+            image_size=args.image_size,
+            shard_records=args.shard_records,
+            workers=args.workers,
+            quarantine=not args.no_quarantine,
+            resume=not args.no_resume,
+        )
+        print(json.dumps({
+            "split": split,
+            "num_records": manifest["num_records"],
+            "num_shards": len(manifest["shards"]),
+            "image_size": manifest["image_size"],
+            "out_dir": args.out_dir or rawshard.default_shard_dir(
+                args.data_dir, args.image_size
+            ),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,6 +136,21 @@ _QUICK_TESTS = {
     "test_faults.py::test_reliability_rules_read_the_shed_gauges",
     "test_faults.py::test_quarantine_rate_alert_fires_on_systemic_rot",
     "test_faults.py::test_obs_report_reliability_section",
+    # self-tuning data plane (ISSUE 7): the numpy-cheap policy pins
+    # (pinned decision sequences, budget clamp, ratchet, determinism)
+    # and the rawshard manifest/bit-identity contract; the fit()-level
+    # bit-identity runs stay in the full tier (XLA compiles dominate)
+    "test_autotune.py::test_starved_decoder_converges_with_pinned_sequence",
+    "test_autotune.py::test_spill_thrash_clamps_to_budget_and_never_regrows",
+    "test_autotune.py::test_decay_that_starves_is_reverted_and_ratcheted",
+    "test_autotune.py::test_decide_is_deterministic",
+    "test_autotune.py::test_tuner_applies_knobs_and_records_telemetry",
+    "test_autotune.py::test_device_prefetch_depth_knob_drains_and_grows",
+    "test_rawshard.py::test_manifest_schema_and_counts",
+    "test_rawshard.py::test_transcode_resumes_from_durable_shards",
+    "test_rawshard.py::test_streamed_bit_identity_with_source",
+    "test_rawshard.py::test_loader_refuses_size_mismatch_and_staleness",
+    "test_rawshard.py::test_hbm_budget_override_and_fallback_warning",
 }
 
 

@@ -1,0 +1,336 @@
+"""Closed-loop ingest autotuner tests (data/autotune.py; ISSUE 7).
+
+Pins: the decision policy is a PURE function (same stats -> same
+adjustments), converges in bounded windows on the starved-decoder and
+spill-thrash synthetic scenarios with the exact decision sequence
+pinned, never oscillates (stationary stats reach a fixed point and
+stay there), never violates the HBM staging budget, and the knobs it
+turns are content-invariant — a fit() with the tuner live produces
+bit-identical train/eval metrics to the same seed with hand-set knobs.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import trainer
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.data import autotune, hbm_pipeline, tfrecord
+from jama16_retina_tpu.obs.registry import Registry
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+pytestmark = pytest.mark.autotune
+
+
+def _limits(**kw) -> autotune.Limits:
+    base = dict(
+        max_decode_workers=6,
+        hbm_headroom_bytes=100 * 10**6,
+        batch_bytes=10**6,
+    )
+    base.update(kw)
+    return autotune.Limits(**base)
+
+
+def _run_policy(model_wait, knobs, limits, n_windows=20, busy=None):
+    """Drive decide() against a closed-loop simulator: ``model_wait``
+    maps current knobs -> this window's input-wait fraction (the
+    system's response), ``busy`` -> decoder-pool utilization (defaults
+    to saturated while starved). Returns the full adjustment sequence.
+    """
+    state = autotune.ControlState()
+    seq = []
+    for _ in range(n_windows):
+        wait = model_wait(knobs)
+        stats = autotune.WindowStats(
+            window_sec=1.0,
+            input_wait_frac=wait,
+            decoder_busy_frac=(
+                busy(knobs) if busy is not None
+                else (0.9 if wait > autotune.HIGH_WATER else 0.1)
+            ),
+            spill_frac=1.0,
+        )
+        adjs, state = autotune.decide(stats, knobs, limits, state)
+        for a in adjs:
+            knobs[a.knob] = a.new
+            seq.append((a.knob, a.old, a.new, a.reason))
+    return seq
+
+
+def test_starved_decoder_converges_with_pinned_sequence():
+    """Saturated decode pool + a starved chip: the tuner raises
+    decode_workers one per window until the simulated wait clears,
+    then (after the quiet hysteresis) decays the run-ahead it never
+    needed — and reaches a fixed point well inside 20 windows."""
+    knobs = {"decode_workers": 1, "stage_depth": 2, "prefetch_depth": 2}
+    seq = _run_policy(
+        lambda k: max(0.0, 0.6 - 0.2 * (k["decode_workers"] - 1)),
+        knobs, _limits(), n_windows=20,
+    )
+    assert seq == [
+        ("decode_workers", 1, 2, "decoder_saturated"),
+        ("decode_workers", 2, 3, "decoder_saturated"),
+        ("decode_workers", 3, 4, "decoder_saturated"),
+        ("stage_depth", 2, 1, "quiet_decay"),
+        ("prefetch_depth", 2, 1, "quiet_decay"),
+    ]
+    assert knobs == {
+        "decode_workers": 4, "stage_depth": 1, "prefetch_depth": 1
+    }
+    # Fixed point: 20 more windows at the converged stats move nothing.
+    assert _run_policy(
+        lambda k: 0.0, knobs, _limits(), n_windows=20
+    ) == []
+
+
+def test_idle_decoder_raises_staging_not_workers():
+    """Starved chip but a near-idle decode pool: more threads cannot
+    help; the tuner must deepen the staged run-ahead instead."""
+    knobs = {"decode_workers": 2, "stage_depth": 2, "prefetch_depth": 2}
+    seq = _run_policy(
+        lambda k: max(0.0, 0.4 - 0.1 * (k["stage_depth"] - 2)),
+        knobs, _limits(), n_windows=8,
+        busy=lambda k: 0.1,
+    )
+    # depth 2 -> 5 clears the simulated wait into the dead band.
+    assert seq == [
+        ("stage_depth", 2, 3, "staging_shallow"),
+        ("stage_depth", 3, 4, "staging_shallow"),
+        ("stage_depth", 4, 5, "staging_shallow"),
+    ]
+    assert knobs["decode_workers"] == 2  # never touched
+
+
+def test_spill_thrash_clamps_to_budget_and_never_regrows():
+    """Spill-thrash scenario: a fully streamed plan whose staged
+    run-ahead exceeds the HBM headroom. The clamp lands FIRST (before
+    any hill-climbing), brings stage+prefetch inside the cap with a
+    pinned sequence, and no later starved window may grow past it."""
+    limits = _limits(hbm_headroom_bytes=6 * 10**6, batch_bytes=10**6)
+    # 6 batches of headroom minus the 2 in-flight fill batches the
+    # loaders hold at peak (tiered fill + prefetch append point).
+    assert autotune.staged_cap(limits, spill_frac=1.0) == 4
+    knobs = {"decode_workers": 2, "stage_depth": 8, "prefetch_depth": 4}
+    state = autotune.ControlState()
+    stats = autotune.WindowStats(1.0, 0.5, 0.2, 1.0)  # starved AND over
+    adjs, state = autotune.decide(stats, knobs, limits, state)
+    assert [(a.knob, a.old, a.new, a.reason) for a in adjs] == [
+        ("stage_depth", 8, 1, "hbm_budget"),
+        ("prefetch_depth", 4, 3, "hbm_budget"),
+    ]
+    for a in adjs:
+        knobs[a.knob] = a.new
+    # Starved forever after: increases stop at the cap, never past it.
+    for _ in range(30):
+        adjs, state = autotune.decide(
+            autotune.WindowStats(1.0, 0.5, 0.2, 1.0), knobs, limits, state
+        )
+        for a in adjs:
+            knobs[a.knob] = a.new
+        assert knobs["stage_depth"] + knobs["prefetch_depth"] <= 4
+    # A resident-heavy plan stages only the spilled fraction, so the
+    # same headroom admits proportionally more run-ahead.
+    assert autotune.staged_cap(limits, spill_frac=0.125) == 46
+    assert autotune.staged_cap(limits, spill_frac=0.0) is None
+
+
+def test_decay_that_starves_is_reverted_and_ratcheted():
+    """A quiet stream decays stage depth; when the decay itself starves
+    the next window, the tuner reverts it and NEVER decays that knob
+    below the reverted value again — the no-oscillation ratchet."""
+    knobs = {"decode_workers": 2, "stage_depth": 4, "prefetch_depth": 1}
+    # System model: depth >= 4 is comfortably quiet, depth < 4 starves.
+    seq = _run_policy(
+        lambda k: 0.0 if k["stage_depth"] >= 4 else 0.5,
+        knobs, _limits(), n_windows=30,
+        busy=lambda k: 0.1,
+    )
+    # Exactly one decay, exactly one revert, then a fixed point: the
+    # ratchet floor (4) blocks further stage decays and prefetch is
+    # already at its min, so 30 windows produce exactly these 2 moves.
+    assert seq == [
+        ("stage_depth", 4, 3, "quiet_decay"),
+        ("stage_depth", 3, 4, "decay_reverted"),
+    ]
+    assert knobs["stage_depth"] == 4
+
+
+def test_dead_band_holds_still():
+    knobs = {"decode_workers": 2, "stage_depth": 2, "prefetch_depth": 2}
+    mid = (autotune.HIGH_WATER + autotune.LOW_WATER) / 2
+    assert _run_policy(lambda k: mid, knobs, _limits(), n_windows=10) == []
+
+
+def test_short_window_carries_no_signal():
+    state = autotune.ControlState()
+    adjs, state2 = autotune.decide(
+        autotune.WindowStats(autotune.MIN_WINDOW_S / 2, 0.9, 0.9, 1.0),
+        {"decode_workers": 1, "stage_depth": 1, "prefetch_depth": 1},
+        _limits(), state,
+    )
+    assert adjs == () and state2 == state
+
+
+def test_decide_is_deterministic():
+    """Same stats stream in, same adjustment stream out — twice."""
+    def run():
+        knobs = {"decode_workers": 1, "stage_depth": 1, "prefetch_depth": 1}
+        rng = np.random.default_rng(7)
+        waits = rng.uniform(0.0, 0.6, 15)
+        busys = rng.uniform(0.0, 1.0, 15)
+        state = autotune.ControlState()
+        out = []
+        for wait, busy in zip(waits, busys):
+            adjs, state = autotune.decide(
+                autotune.WindowStats(1.0, float(wait), float(busy), 1.0),
+                knobs, _limits(), state,
+            )
+            for a in adjs:
+                knobs[a.knob] = a.new
+                out.append(a)
+        return out
+
+    assert run() == run()
+
+
+def test_tuner_applies_knobs_and_records_telemetry():
+    """IngestAutotuner.observe: reads registry deltas, applies decide's
+    adjustments to the live Knobs, and records counter + gauge + trace
+    event per adjustment (the data.autotune.* contract)."""
+    from jama16_retina_tpu.obs.trace import Tracer
+
+    reg = Registry()
+    tracer = Tracer(enabled=True, buffer_events=64)
+    knobs = autotune.Knobs(1, 2, 2)
+    tuner = autotune.IngestAutotuner(
+        knobs, _limits(), registry=reg, tracer=tracer
+    )
+    # Saturated decode pool: busy_s advances by ~the whole window.
+    reg.counter("data.decode.busy_s").inc(0.95)
+    adjs = tuner.observe(window_sec=1.0, input_wait_sec=0.5)
+    assert [(a.knob, a.new) for a in adjs] == [("decode_workers", 2)]
+    assert knobs.decode_workers == 2
+    assert reg.counter("data.autotune.adjustments").value == 1
+    assert reg.counter("data.autotune.adjust.decode_workers").value == 1
+    assert reg.gauge("data.autotune.decode_workers").value == 2
+    evs = [e for r, _ in [ring.snapshot() for ring in tracer._rings.values()]
+           for e in r]
+    names = [e[1] for e in evs]
+    assert "data.autotune.decode_workers" in names
+
+    # Window deltas: the SAME busy counter value next window reads as
+    # an idle pool (delta 0), not a saturated one.
+    adjs2 = tuner.observe(window_sec=1.0, input_wait_sec=0.5)
+    assert [(a.knob, a.reason) for a in adjs2] == [
+        ("stage_depth", "staging_shallow")
+    ]
+
+
+def test_for_config_starts_at_hand_set_values_and_reads_budget_override():
+    cfg = override(
+        get_config("smoke"),
+        ["data.decode_workers=3", "data.stage_depth=5",
+         "data.prefetch_batches=2", "data.autotune=true",
+         f"data.hbm_budget_bytes={4 * 1024**3}"],
+    )
+    knobs, tuner = autotune.for_config(cfg)
+    assert knobs.as_dict() == {
+        "decode_workers": 3, "stage_depth": 5, "prefetch_depth": 2,
+    }
+    # Staging headroom = 10% of the overridden per-chip HBM BUDGET
+    # (base x the 0.6 dataset fraction) — the exact eval-cache
+    # discipline (trainer._eval_cache_for gates at the same product).
+    assert tuner.limits.hbm_headroom_bytes == int(
+        0.1 * int(4 * 1024**3 * 0.6)
+    )
+    assert tuner.limits.batch_bytes == (
+        cfg.data.batch_size * hbm_pipeline.row_bytes(cfg.model.image_size)
+    )
+    assert tuner.limits.max_decode_workers >= 3
+
+
+def test_fit_autotuned_is_bit_identical_to_hand_set(tmp_path):
+    """The acceptance pin: data.autotune=true changes WHEN data
+    arrives, never WHAT arrives — train losses and eval AUCs of a
+    tuned run are bit-identical to the same seed with hand-set knobs
+    (tiered loader at partial residency, pessimal starting knobs so
+    the tuner actually moves)."""
+    d = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(d, "train", 48, 64, 3, seed=1)
+    tfrecord.write_synthetic_split(d, "val", 16, 64, 2, seed=2)
+    base = override(
+        get_config("smoke"),
+        ["data.loader=tiered", "train.steps=8", "train.eval_every=4",
+         "train.log_every=2", "data.batch_size=8", "eval.batch_size=8",
+         "data.decode_workers=1", "data.stage_depth=1",
+         "data.prefetch_batches=1", "train.lr_schedule=constant",
+         f"data.tiered_resident_bytes={hbm_pipeline.row_bytes(64) * 24}"],
+    )
+
+    def run(cfg, name):
+        w = str(tmp_path / name)
+        trainer.fit(cfg, d, w, seed=5)
+        recs = read_jsonl(os.path.join(w, "metrics.jsonl"))
+        return (
+            {r["step"]: r["loss"] for r in recs if r["kind"] == "train"},
+            {r["step"]: r["val_auc"] for r in recs if r["kind"] == "eval"},
+        )
+
+    loss_a, auc_a = run(base, "handset")
+    loss_b, auc_b = run(override(base, ["data.autotune=true"]), "tuned")
+    assert loss_a and auc_a
+    assert loss_a == loss_b
+    assert auc_a == auc_b
+
+
+def test_knobs_are_live_in_tiered_loader(tmp_path):
+    """A stage-depth raise deepens the fill on the next pull and a
+    worker resize lands in the decoder — batch contents untouched."""
+    from jama16_retina_tpu.data import tiered_pipeline
+    from jama16_retina_tpu.obs import registry as obs_registry
+
+    d = str(tmp_path / "data")
+    tfrecord.write_synthetic_split(d, "train", 32, 32, 2, seed=3)
+    from jama16_retina_tpu.configs import DataConfig
+
+    cfg = DataConfig(batch_size=8, tiered_resident_bytes=0)
+    knobs = autotune.Knobs(1, 1, 1)
+    it = tiered_pipeline.train_batches(d, "train", cfg, 32, seed=0,
+                                       knobs=knobs)
+    ref = tiered_pipeline.train_batches(d, "train", cfg, 32, seed=0)
+    for _ in range(2):
+        a, b = next(it), next(ref)
+        assert np.array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
+    knobs.set("stage_depth", 4)
+    knobs.set("decode_workers", 3)
+    for _ in range(4):
+        # ref first: both loaders write the shared stage-depth gauge,
+        # and the assertion below reads the tuned loader's last write.
+        b, a = next(ref), next(it)
+        assert np.array_equal(np.asarray(a["image"]), np.asarray(b["image"]))
+    reg = obs_registry.default_registry()
+    assert reg.gauge("data.decode.workers").value == 3
+    assert reg.gauge("data.tiered.stage_depth").value >= 4
+
+
+def test_device_prefetch_depth_knob_drains_and_grows():
+    """The prefetch queue follows the live knob: deeper after a raise,
+    drains below the old level after a cut, and every batch of the
+    underlying stream is yielded exactly once in order."""
+    from jama16_retina_tpu.data import pipeline as pipeline_lib
+
+    knobs = autotune.Knobs(1, 1, 3)
+    src = ({"i": np.asarray(i)} for i in range(20))
+    out = []
+    it = pipeline_lib.device_prefetch(src, sharding=None, size=99,
+                                      knobs=knobs)
+    for _ in range(5):
+        out.append(int(next(it)["i"]))
+    knobs.set("prefetch_depth", 1)
+    for _ in range(5):
+        out.append(int(next(it)["i"]))
+    out.extend(int(b["i"]) for b in it)
+    assert out == list(range(20))
