@@ -182,6 +182,14 @@ class TrainConfig:
     checkpoint_dir: str = "/tmp/retina_ckpt"
     max_to_keep: int = 3
     resume: bool = False
+    # Warm-start entry (ISSUE 8): a checkpoint directory whose best
+    # params/batch_stats (and EMA shadow, when both sides carry one)
+    # seed the run's initial state at step 0 — fresh optimizer, fresh
+    # schedule, full step budget. The lifecycle controller's RETRAIN
+    # phase fine-tunes the LIVE model on fresh data this way instead of
+    # training from random init. Ignored when resume finds an existing
+    # checkpoint in the workdir (a resumed run continues itself).
+    init_from: str = ""
     # Checkpoint every Nth eval (plus ALWAYS the final/early-stop eval,
     # so the run ends durable). 1 = the reference's save-every-eval
     # semantics. Raising it trades resume granularity and best-
@@ -363,6 +371,17 @@ class ServeConfig:
     # serve.DeadlineExceeded BEFORE any device work is spent on it,
     # counted under serve.shed.deadline.
     default_deadline_ms: float = 0.0
+    # --- Lifecycle / rollback (ISSUE 8) --------------------------------
+    # Seconds the engine RETAINS the previous generation's device-
+    # resident stacked tree after a hot swap: within this window
+    # ``engine.rollback()`` is one atomic handle re-swap (no restore
+    # from disk, no warm-up — the state is still resident and warm).
+    # 0 disables retention (rollback then needs the checkpoint dirs).
+    # The retained tree costs one extra model residency in HBM, exactly
+    # the transient ~2x a reload already needs; size the window to how
+    # long a post-swap regression takes to show (the lifecycle WATCH
+    # phase), not to "forever".
+    rollback_keep_s: float = 900.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -426,6 +445,67 @@ class QualityConfig:
     # that absolute deviation (e.g. across a serving-stack migration
     # where float-ulp drift is accepted).
     canary_atol: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class LifecycleConfig:
+    """Self-healing model lifecycle (jama16_retina_tpu/lifecycle/;
+    ISSUE 8) — the drift-to-retrain flywheel that turns PR-5 alerts
+    into actions: DRIFT_DETECTED -> RETRAIN (warm-start fine-tune) ->
+    GATE (named candidate gates) -> STAGED_ROLLOUT (shadow + promote)
+    -> WATCH (post-swap regression window) -> COMMIT or ROLLBACK.
+
+    Off by default: the controller only runs where an operator wires it
+    (``scripts/lifecycle_run.py`` or an ``AlertManager(on_fire=)``
+    trigger); these knobs shape what it does when it runs. Every
+    transition is journaled crash-safely under
+    ``<workdir>/lifecycle/`` (lifecycle/journal.py).
+    """
+
+    enabled: bool = False
+    # Alert-rule reasons that trigger a lifecycle cycle through the
+    # AlertManager(on_fire=) seam; reasons outside this set only log.
+    trigger_reasons: tuple[str, ...] = ("quality_drift",)
+    # Fine-tune budget for a RETRAIN candidate (0 = the full
+    # train.steps — usually far too much for a warm start).
+    retrain_steps: int = 0
+    # GATE thresholds. gate_canary_max_dev: max |candidate - live|
+    # score deviation on the golden canary images — a retrained model
+    # legitimately moves scores, so this is a LOOSE sanity bound
+    # against degenerate candidates (random-init divergence, a
+    # collapsed head), not the byte-stability atol the reload gate
+    # applies to same-model rollouts.
+    gate_canary_max_dev: float = 0.2
+    # Reference-profile parity: max debiased PSI of the candidate's
+    # val-split score histogram vs the loaded reference profile
+    # (-1 = reuse obs.quality.psi_alert).
+    gate_parity_psi_max: float = -1.0
+    # Operating-point AUC floor: candidate val AUC must be >= the live
+    # model's val AUC minus this delta.
+    gate_auc_floor_delta: float = 0.01
+    # Rows of the val split the parity/AUC gates score (0 = all; tests
+    # and smoke deployments cap it).
+    gate_eval_rows: int = 0
+    # STAGED_ROLLOUT: fraction of live requests shadow-scored through
+    # the candidate (deterministic every-Nth sampling), how many
+    # shadowed requests to collect before promoting, and the wall-clock
+    # budget to wait for them (shadow evidence is advisory — recorded
+    # in the journal, never a silent veto; an idle server promotes on
+    # timeout with whatever evidence exists, loudly).
+    shadow_fraction: float = 0.25
+    shadow_requests: int = 8
+    shadow_wait_s: float = 60.0
+    # WATCH: post-swap regression window. Each probe evaluates these
+    # declarative rules (obs/alerts.py grammar; plain metric/threshold
+    # forms only — rate() needs snapshot history the stateless probe
+    # does not keep, and is rejected at controller construction)
+    # against the live registry; ANY rule true = regression ->
+    # ROLLBACK. The default watches the golden canary, which the
+    # promote step re-pins to the candidate — so a post-swap canary
+    # failure is a genuine serving regression, not the model change.
+    watch_rules: tuple[str, ...] = ("quality.canary_ok < 1",)
+    watch_probes: int = 3
+    watch_interval_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -493,6 +573,9 @@ class ExperimentConfig:
     eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    lifecycle: LifecycleConfig = dataclasses.field(
+        default_factory=LifecycleConfig
+    )
 
     def replace(self, **sections) -> "ExperimentConfig":
         return dataclasses.replace(self, **sections)

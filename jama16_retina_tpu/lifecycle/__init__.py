@@ -1,0 +1,38 @@
+"""Self-healing model lifecycle (ISSUE 8): the drift-to-retrain
+flywheel that closes ROADMAP item 5.
+
+PR 5 *detects* drift (debiased-PSI gauges, golden canary, declarative
+alert rules) and PR 6 built the swap *mechanism* (atomic
+``engine.reload()`` with a canary gate); this package supplies the
+missing controller: a journaled state machine that turns a firing
+alert into retrain -> gate -> staged rollout -> watch -> commit or
+rollback, crash-safe at every step.
+
+  * ``journal``    — the atomic on-disk transition journal (tmp +
+    rename discipline; a controller killed at ANY state resumes
+    without repeating side effects).
+  * ``controller`` — LifecycleController: the state machine itself,
+    with seams for every expensive phase (retrain_fn / gate fns /
+    watch rules) so tests and the chaos harness drive it off-device.
+
+Operator surface: ``scripts/lifecycle_run.py`` (one-shot ``--step``
+and supervising ``--watch``), the ``serve.lifecycle.state`` gauge +
+``lifecycle.*`` counters, the Lifecycle section of
+``scripts/obs_report.py``, and docs/RELIABILITY.md §Lifecycle.
+"""
+
+from jama16_retina_tpu.lifecycle.controller import (
+    GateVerdict,
+    LifecycleController,
+    STATES,
+    TERMINAL_STATES,
+)
+from jama16_retina_tpu.lifecycle.journal import Journal
+
+__all__ = [
+    "GateVerdict",
+    "Journal",
+    "LifecycleController",
+    "STATES",
+    "TERMINAL_STATES",
+]

@@ -1,0 +1,888 @@
+"""The drift-to-retrain state machine (ISSUE 8 tentpole).
+
+One controller drives one serving deployment through the closed loop
+the ROADMAP's item 5 asks for:
+
+    IDLE --trigger(alert)--> DRIFT_DETECTED
+      --retrain--------> RETRAIN          (warm-start fine-tune, durable
+                                           candidate checkpoints)
+      --gates----------> GATE             (named verdicts: golden canary /
+                                           profile parity / AUC floor;
+                                           fail -> ROLLBACK)
+      --shadow+promote-> STAGED_ROLLOUT   (ServingEngine.begin_shadow over
+                                           live traffic, canary re-pin,
+                                           engine.reload swap, live pointer)
+      --regress-window-> WATCH            (declarative rules over the PR-5
+                                           quality gauges)
+      --------> COMMIT  or  ROLLBACK      (engine.rollback() re-swap to the
+                                           retained previous generation)
+
+Crash safety: every arrival is one atomic append to the on-disk
+journal (lifecycle/journal.py); each step is IDEMPOTENT (retrain skips
+members whose candidate checkpoints are durable, gates are pure
+evaluation, promote re-applies the live pointer, rollback re-swaps),
+so a controller killed at ANY state — including between a step's work
+and its journal append — resumes by re-running at most the one
+interrupted step and converges to the same terminal state. Proven by
+killing it at every state in tests/test_lifecycle.py.
+
+Seams: ``retrain_fn`` / ``gate_fns`` / ``watch rules`` are injectable
+(tests and ``bench.py --chaos`` drive the machine off-device in
+milliseconds); the defaults are the real thing — trainer.fit with
+``train.init_from`` warm start, engine-scored gates over the val
+split, registry-gauge watch probes. Fault seams ``lifecycle.retrain``
+/ ``lifecycle.gate`` / ``lifecycle.swap`` (obs/faultinject.py) inject
+failure at each phase; a gate that CANNOT run fails closed (a
+candidate you could not evaluate must not ship).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.configs import ExperimentConfig
+from jama16_retina_tpu.lifecycle.journal import Journal, _atomic_write_json
+from jama16_retina_tpu.obs import alerts as obs_alerts
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
+
+STATES = (
+    "IDLE", "DRIFT_DETECTED", "RETRAIN", "GATE", "STAGED_ROLLOUT",
+    "WATCH", "COMMIT", "ROLLBACK",
+)
+TERMINAL_STATES = ("COMMIT", "ROLLBACK")
+STATE_IDS = {name: i for i, name in enumerate(STATES)}
+
+
+@dataclasses.dataclass(frozen=True)
+class GateVerdict:
+    """One named gate's typed verdict over a candidate. ``skipped``
+    gates pass vacuously but say so (no artifact / no data to judge
+    with) — the journal records WHY a gate did not bind, instead of a
+    silent green."""
+
+    name: str
+    passed: bool
+    value: "float | None" = None
+    threshold: "float | None" = None
+    detail: str = ""
+    skipped: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name, "passed": bool(self.passed),
+            "value": (round(float(self.value), 6)
+                      if self.value is not None else None),
+            "threshold": (float(self.threshold)
+                          if self.threshold is not None else None),
+            "detail": self.detail, "skipped": bool(self.skipped),
+        }
+
+
+def _referable(scores: np.ndarray) -> np.ndarray:
+    """Ensemble-averaged scores -> referable probability [n] (the one
+    scalar every gate compares on), for either head."""
+    s = np.asarray(scores, np.float64)
+    if s.ndim == 2:
+        from jama16_retina_tpu.eval import metrics
+
+        s = np.asarray(
+            metrics.referable_probs_from_multiclass(s), np.float64
+        )
+    return s.ravel()
+
+
+class LifecycleController:
+    """One deployment's lifecycle state machine over a crash-safe
+    journal.
+
+    ``engine``: the live ServingEngine (None only for fully seam-
+    injected uses — the defaults for gate/rollout/rollback need one).
+    ``data_dir``: the dataset root (fresh training data + the val
+    split the gates score). ``live_member_dirs``: the deployment's
+    configured checkpoint set — the fallback identity of "the live
+    model" before the first promote writes the journal's live pointer.
+    ``runlog``: a RunLog to append ``lifecycle`` records to (the
+    serving session's own log, so obs_report renders the timeline);
+    None with a workdir opens one lazily on first write.
+    """
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        workdir: str,
+        *,
+        engine=None,
+        data_dir: str = "",
+        live_member_dirs=None,
+        registry: "obs_registry.Registry | None" = None,
+        runlog=None,
+        retrain_fn=None,
+        gate_fns=None,
+        sleep=time.sleep,
+    ):
+        self.cfg = cfg
+        self.lc = cfg.lifecycle
+        self.workdir = workdir
+        self.dir = os.path.join(workdir, "lifecycle")
+        self.engine = engine
+        self.data_dir = data_dir
+        self._live_fallback = (
+            list(live_member_dirs) if live_member_dirs else None
+        )
+        self.registry = (
+            registry if registry is not None
+            else (engine.registry if engine is not None
+                  else obs_registry.default_registry())
+        )
+        self._log = runlog
+        self._owns_log = False
+        self._retrain_fn = retrain_fn or _default_retrain
+        self._gate_fns = gate_fns  # None = the default engine gates
+        self._sleep = sleep
+        self.journal = Journal(self.dir, terminal_states=TERMINAL_STATES)
+        self._watch_rules = [
+            obs_alerts.parse_rule(r) for r in self.lc.watch_rules
+        ]
+        for r in self._watch_rules:
+            if r.metric.startswith("rate("):
+                # Watch probes are stateless single-snapshot checks
+                # (obs_alerts.rule_holds); a rate() form would resolve
+                # to no-data and read as vacuously healthy — the one
+                # failure mode a regression watch must not have.
+                raise ValueError(
+                    f"lifecycle.watch_rules entry {r.name!r}: rate() "
+                    "needs snapshot history, which the WATCH probe "
+                    "does not keep — watch a plain counter/gauge "
+                    "threshold instead"
+                )
+            if r.for_seconds:
+                # Same loud-refusal stance for the `for` clause: the
+                # probe has no continuous-hold state, so the latching
+                # protection the operator asked for would silently
+                # become fire-on-first-sample.
+                raise ValueError(
+                    f"lifecycle.watch_rules entry {r.name!r}: the "
+                    "'for N' clause needs continuous-hold tracking the "
+                    "WATCH probe does not keep — use "
+                    "lifecycle.watch_probes/watch_interval_s for "
+                    "sustained evidence instead"
+                )
+        # The candidate generation handle is CACHED between GATE and
+        # STAGED_ROLLOUT (same residency scores the gates and the
+        # shadow); it is pure in-memory acceleration — a resumed
+        # controller rebuilds it from the journaled candidate dirs.
+        self._candidate = None
+        self._gate_data = None
+        reg = self.registry
+        self._g_state = reg.gauge(
+            "serve.lifecycle.state",
+            help="lifecycle controller state: "
+                 + " ".join(f"{i}={n}" for n, i in STATE_IDS.items()),
+        )
+        self._c_transitions = reg.counter(
+            "lifecycle.transitions",
+            help="journaled lifecycle state transitions (all states)",
+        )
+        self._c_by_state = {
+            s: reg.counter(
+                f"lifecycle.transition.{s}",
+                help=f"lifecycle arrivals at {s}",
+            )
+            for s in STATES[1:]
+        }
+        self._c_retrains = reg.counter(
+            "lifecycle.retrains",
+            help="warm-start retrain phases completed (candidate "
+                 "checkpoint sets made durable)",
+        )
+        self._c_gate_rejects = reg.counter(
+            "lifecycle.gate_rejects",
+            help="candidates rejected at GATE (live model kept serving)",
+        )
+        self._c_promotes = reg.counter(
+            "lifecycle.promotes",
+            help="candidates promoted live via staged rollout",
+        )
+        self._c_rollbacks = reg.counter(
+            "lifecycle.rollbacks",
+            help="cycles that ended in ROLLBACK (gate reject or "
+                 "post-swap regression)",
+        )
+        self._c_commits = reg.counter(
+            "lifecycle.commits",
+            help="cycles that ended in COMMIT (candidate retained live)",
+        )
+        self._c_step_errors = reg.counter(
+            "lifecycle.step_errors",
+            help="lifecycle steps that raised (journal unadvanced; the "
+                 "step retries on the next drive)",
+        )
+        self._g_state.set(STATE_IDS.get(self.state, 0))
+        if engine is not None:
+            self.ensure_live()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self.journal.state or "IDLE"
+
+    def live_member_dirs(self) -> "list[str] | None":
+        """The checkpoint set that IS the live model right now: the
+        journal's live pointer once a promote/rollback wrote one, else
+        the deployment's configured set."""
+        live = self.journal.read_live()
+        if live is not None:
+            return live
+        if self._live_fallback is not None:
+            return list(self._live_fallback)
+        if self.engine is not None and self.engine._gen.member_dirs:
+            return list(self.engine._gen.member_dirs)
+        return None
+
+    def ensure_live(self) -> bool:
+        """Reconcile the engine with the journal's live pointer — the
+        resume half of crash-safe promotion: a swap is durable as the
+        pointer file, and re-applying it is an idempotent reload.
+        Returns True when a reload was applied."""
+        live = self.journal.read_live()
+        if live is None or self.engine is None:
+            return False
+        cur = self.engine._gen.member_dirs
+        if cur is not None and list(cur) == list(live):
+            return False
+        absl_logging.info(
+            "lifecycle resume: engine serves %s but the live pointer "
+            "names %s — reloading", cur, live,
+        )
+        self.engine.reload(live)
+        return True
+
+    # -- trigger (the AlertManager on_fire seam) ---------------------------
+
+    def on_alert(self, info: dict) -> bool:
+        """``AlertManager(on_fire=controller.on_alert)``: a firing rule
+        whose reason is in lifecycle.trigger_reasons opens a cycle —
+        alerts become actions. Refuses (False) while a cycle is open
+        (one rollout at a time) or for non-trigger reasons."""
+        if not self.lc.enabled:
+            return False
+        if info.get("reason") not in self.lc.trigger_reasons:
+            return False
+        return self.trigger(
+            reason=info.get("reason", "unknown"), rule=info.get("rule"),
+            value=info.get("value"), threshold=info.get("threshold"),
+        )
+
+    def trigger(self, reason: str = "manual", **detail) -> bool:
+        """Open a cycle at DRIFT_DETECTED. The entry snapshots the
+        CURRENT live checkpoint set — the identity ROLLBACK restores
+        and RETRAIN warm-starts from, pinned before anything moves."""
+        if self.journal.cycle_open():
+            absl_logging.warning(
+                "lifecycle trigger (%s) ignored: cycle %d is still at "
+                "%s", reason, self.journal.cycle, self.state,
+            )
+            return False
+        live = self.live_member_dirs()
+        self._arrive(
+            "DRIFT_DETECTED", cycle=self.journal.cycle + 1,
+            reason=reason, live_member_dirs=live,
+            **{k: v for k, v in detail.items() if v is not None},
+        )
+        return True
+
+    # -- driving -----------------------------------------------------------
+
+    def step(self) -> "dict | None":
+        """Execute ONE transition (the operator ``--step`` unit): do
+        the current state's work idempotently, then append the arrival
+        it produced. Returns the new journal entry, or None when there
+        is nothing to do (idle / terminal). A step that raises leaves
+        the journal unadvanced — re-driving retries exactly that step."""
+        state = self.state
+        if state == "IDLE" or state in TERMINAL_STATES:
+            return None
+        try:
+            if state == "DRIFT_DETECTED":
+                return self._step_retrain()
+            if state == "RETRAIN":
+                return self._step_gate()
+            if state == "GATE":
+                gate = self.journal.find("GATE")
+                if gate and not gate["passed"]:
+                    return self._step_rollback("gate_rejected")
+                return self._step_rollout()
+            if state == "STAGED_ROLLOUT":
+                return self._step_watch()
+            if state == "WATCH":
+                watch = self.journal.find("WATCH")
+                if watch and not watch["healthy"]:
+                    return self._step_rollback("watch_regression")
+                return self._step_commit()
+        except Exception:
+            self._c_step_errors.inc()
+            raise
+        raise AssertionError(f"unreachable lifecycle state {state!r}")
+
+    def run(self, max_steps: int = 16) -> str:
+        """Drive to a terminal state (the ``--watch`` supervisor's
+        inner loop); returns the terminal state. ``max_steps`` bounds
+        runaway (the longest cycle is 6 transitions)."""
+        for _ in range(max_steps):
+            if self.step() is None:
+                break
+        return self.state
+
+    # -- the steps ---------------------------------------------------------
+
+    def _arrive(self, state: str, cycle: "int | None" = None,
+                **payload) -> dict:
+        entry = self.journal.append(state, cycle=cycle, **payload)
+        self._g_state.set(STATE_IDS[state])
+        self._c_transitions.inc()
+        self._c_by_state[state].inc()
+        obs_trace.default_tracer().instant(
+            "lifecycle.transition",
+            args={"state": state, "cycle": entry["cycle"],
+                  "seq": entry["seq"]},
+        )
+        if self._log is None and self.workdir:
+            from jama16_retina_tpu.utils.logging import RunLog
+
+            self._log = RunLog(self.workdir)
+            self._owns_log = True
+        if self._log is not None:
+            self._log.write("lifecycle", **{
+                k: v for k, v in entry.items()
+                if k not in ("live_member_dirs", "member_dirs")
+            })
+        absl_logging.info(
+            "lifecycle: cycle %d -> %s", entry["cycle"], state
+        )
+        return entry
+
+    def _candidate_root(self) -> str:
+        return os.path.join(
+            self.dir, f"candidate-{self.journal.cycle:04d}"
+        )
+
+    def _step_retrain(self) -> dict:
+        faultinject.check("lifecycle.retrain")
+        member_dirs = self._retrain_fn(self, self._candidate_root())
+        self._c_retrains.inc()
+        return self._arrive(
+            "RETRAIN", cycle=self.journal.cycle,
+            member_dirs=list(member_dirs), n_members=len(member_dirs),
+        )
+
+    def _step_gate(self) -> dict:
+        member_dirs = self.journal.find("RETRAIN")["member_dirs"]
+        try:
+            faultinject.check("lifecycle.gate")
+            if self._gate_fns is not None:
+                fns = self._gate_fns
+            else:
+                if self.engine is None:
+                    raise RuntimeError(
+                        "default gates need a ServingEngine; pass "
+                        "gate_fns= or an engine"
+                    )
+                fns = [gate_golden_canary, gate_profile_parity,
+                       gate_auc_floor]
+            # warm=True: the gates only need scores, but this handle is
+            # REUSED by _step_rollout's shadow session, whose contract
+            # is that a sampled live request never eats a candidate
+            # compile — pay every bucket's warm-up here, off the
+            # request path.
+            self._candidate = (
+                self.engine.prepare_candidate(member_dirs, warm=True)
+                if self.engine is not None else None
+            )
+            verdicts = [fn(self, self._candidate) for fn in fns]
+        except Exception as e:  # noqa: BLE001 - gates fail CLOSED
+            # A gate that cannot run must not ship the candidate: the
+            # failure becomes a failing verdict, the cycle proceeds to
+            # ROLLBACK, the live model keeps serving.
+            absl_logging.error(
+                "lifecycle gate evaluation failed (failing closed): "
+                "%s: %s", type(e).__name__, e,
+            )
+            verdicts = [GateVerdict(
+                name="gate_error", passed=False,
+                detail=f"{type(e).__name__}: {e}",
+            )]
+        passed = all(v.passed for v in verdicts)
+        if not passed:
+            self._c_gate_rejects.inc()
+            self._candidate = None
+        return self._arrive(
+            "GATE", cycle=self.journal.cycle, passed=passed,
+            verdicts=[v.as_dict() for v in verdicts],
+        )
+
+    def _step_rollout(self) -> dict:
+        engine = self.engine
+        if engine is None:
+            raise RuntimeError("STAGED_ROLLOUT needs a ServingEngine")
+        member_dirs = self.journal.find("RETRAIN")["member_dirs"]
+        candidate = self._candidate
+        if candidate is None:  # resumed controller: rebuild from dirs
+            candidate = engine.prepare_candidate(member_dirs, warm=True)
+        if engine.shadow_report() is not None:
+            # A session abandoned by a step interrupted mid-rollout in
+            # THIS process; its evidence died with the interruption.
+            engine.end_shadow()
+        faultinject.check("lifecycle.swap")
+        engine.begin_shadow(
+            candidate=candidate, fraction=self.lc.shadow_fraction
+        )
+        deadline = time.monotonic() + self.lc.shadow_wait_s
+        while True:
+            rep = engine.shadow_report()
+            if rep is None:
+                # A concurrent reload/rollback (another driver, an ops
+                # script) cleared the session: this rollout's baseline
+                # died — abort the step; the journal holds at GATE and
+                # the next drive restarts the rollout cleanly.
+                raise RuntimeError(
+                    "shadow session cleared by a concurrent "
+                    "reload/rollback — rollout aborted; re-drive to "
+                    "retry against the new live generation"
+                )
+            if rep["requests"] >= self.lc.shadow_requests:
+                break
+            if time.monotonic() >= deadline:
+                absl_logging.warning(
+                    "lifecycle shadow window timed out at %s — "
+                    "promoting on partial evidence", rep,
+                )
+                break
+            self._sleep(0.02)
+        # Re-pin the golden canary to the CANDIDATE before the swap:
+        # a retrained model legitimately moves the pinned scores, and
+        # reload()'s byte-stability gate (plus the post-swap WATCH
+        # rules) must judge the model being shipped, not the one being
+        # replaced. The previous reference is backed up for ROLLBACK.
+        repin = self._repin_canary(candidate)
+        try:
+            report = engine.end_shadow(promote=True)
+        except Exception:
+            # The swap failed AFTER the canary was re-pinned to the
+            # candidate: the OLD model keeps serving, so the old
+            # reference must be the truth again — otherwise every
+            # cadence canary run until the retry fires false
+            # quality_drift alerts against the wrong pinned scores.
+            if repin:
+                self._restore_canary()
+            raise
+        reload_info = report.pop("reload")
+        self.journal.write_live(member_dirs)
+        self._candidate = None
+        self._c_promotes.inc()
+        return self._arrive(
+            "STAGED_ROLLOUT", cycle=self.journal.cycle,
+            generation=reload_info["generation"], shadow=report,
+            canary_repinned=repin,
+        )
+
+    def _run_live_canary(self) -> None:
+        """Refresh the golden-canary gauges against the LIVE generation
+        before a watch probe reads them: the gauge otherwise holds the
+        last cadence run's verdict — of the PRE-swap model (stale 1.0
+        makes the watch vacuous; a latched 0 from the triggering drift
+        would roll back every healthy canary-triggered promote)."""
+        from jama16_retina_tpu.eval import metrics
+
+        engine = self.engine
+        q = getattr(engine, "quality", None) if engine is not None \
+            else None
+        if q is None or q.canary is None:
+            return
+        q.run_canary(lambda imgs: metrics.ensemble_average(
+            list(engine.member_probs(imgs))
+        ))
+
+    def _step_watch(self) -> dict:
+        fired: list = []
+        probes = 0
+        for i in range(max(1, self.lc.watch_probes)):
+            if i:
+                self._sleep(self.lc.watch_interval_s)
+            self._run_live_canary()
+            snap = self.registry.snapshot()
+            probes += 1
+            fired = [
+                r.name for r in self._watch_rules
+                if obs_alerts.rule_holds(r, snap)
+            ]
+            if fired:
+                break
+        healthy = not fired
+        return self._arrive(
+            "WATCH", cycle=self.journal.cycle, healthy=healthy,
+            probes=probes, fired=fired,
+            rules=[r.name for r in self._watch_rules],
+        )
+
+    def _step_commit(self) -> dict:
+        rollout = self.journal.find("STAGED_ROLLOUT")
+        self._c_commits.inc()
+        self._gate_data = None  # cycle over: release the eval rows
+        if self.engine is not None and hasattr(self.engine,
+                                              "release_retained"):
+            # The watch judged the rollout healthy: holding the
+            # outgoing generation's device residency until the
+            # rollback window expires buys nothing now.
+            self.engine.release_retained()
+        return self._arrive(
+            "COMMIT", cycle=self.journal.cycle,
+            generation=rollout["generation"] if rollout else None,
+        )
+
+    def _step_rollback(self, cause: str) -> dict:
+        restored = None
+        rollout = self.journal.find("STAGED_ROLLOUT")
+        trigger = self.journal.find("DRIFT_DETECTED")
+        prev_dirs = (trigger or {}).get("live_member_dirs")
+        if rollout is not None:
+            # A swap happened this cycle: the DURABLE half of the
+            # undo — the live pointer naming the pre-cycle set again —
+            # happens first and unconditionally (a controller resumed
+            # without an engine must still stop the regressed
+            # candidate being what the next process serves).
+            if prev_dirs:
+                self.journal.write_live(prev_dirs)
+            # The canary artifact's undo is durable bookkeeping too —
+            # it must happen with or without an in-process engine, and
+            # BEFORE any reload fallback (the gate judges the restored
+            # reference).
+            self._restore_canary()
+            if self.engine is not None:
+                # Put the previous model back in-process too —
+                # instantly off the retained generation when the
+                # window holds, else a full reload from the pre-cycle
+                # checkpoint set the trigger entry pinned.
+                from jama16_retina_tpu.serve.engine import (
+                    RollbackUnavailable,
+                )
+
+                try:
+                    restored = self.engine.rollback()
+                except RollbackUnavailable as e:
+                    if not prev_dirs:
+                        raise RuntimeError(
+                            "rollback needs the pre-cycle checkpoint "
+                            "set but the trigger entry pinned none"
+                        ) from e
+                    absl_logging.warning(
+                        "instant rollback unavailable (%s); reloading "
+                        "the pre-cycle checkpoint set", e,
+                    )
+                    restored = self.engine.reload(prev_dirs)
+                else:
+                    if not prev_dirs and self.engine._gen.member_dirs:
+                        # The trigger entry pinned no pre-cycle set
+                        # (journal-only trigger with no --ckpt): the
+                        # restored generation's own provenance is the
+                        # durable truth the pointer must record —
+                        # otherwise the next process would rebuild
+                        # from the regressed candidate.
+                        self.journal.write_live(
+                            list(self.engine._gen.member_dirs)
+                        )
+        # rollout None: nothing was promoted — the live model never
+        # stopped serving; rollback is the cycle's terminal
+        # bookkeeping.
+        self._candidate = None
+        self._gate_data = None  # cycle over: release the eval rows
+        self._c_rollbacks.inc()
+        return self._arrive(
+            "ROLLBACK", cycle=self.journal.cycle, cause=cause,
+            swapped=rollout is not None,
+            restored_generation=(
+                restored.get("generation") if restored else None
+            ),
+        )
+
+    # -- canary custody across promote/rollback ----------------------------
+
+    def _canary_backup_path(self) -> str:
+        return os.path.join(
+            self.dir, f"canary-pre-{self.journal.cycle:04d}.npz"
+        )
+
+    def _repin_canary(self, candidate) -> bool:
+        """Score the golden set through the candidate and make those
+        scores the pinned reference (in-memory + the on-disk artifact
+        when one is configured), backing up the previous reference for
+        ROLLBACK. Returns whether a re-pin happened. Idempotent: a
+        crash between re-pin and swap re-runs this with identical
+        scores (same state, same program)."""
+        from jama16_retina_tpu.obs import quality as quality_lib
+
+        engine = self.engine
+        q = engine.quality if engine is not None else None
+        canary = q.canary if q is not None else None
+        if canary is None or candidate is None:
+            return False
+        scores = self._canary_scores(candidate)
+        backup = self._canary_backup_path()
+        if canary.reference is not None and not os.path.exists(backup):
+            quality_lib.save_canary(
+                backup, canary.images, scores=canary.reference
+            )
+        canary.reference = scores
+        path = self.cfg.obs.quality.canary_path
+        if path:
+            quality_lib.save_canary(path, canary.images, scores=scores)
+        return True
+
+    def _restore_canary(self) -> bool:
+        """Undo ``_repin_canary`` from its backup (ROLLBACK path): the
+        previous model is live again, so the previous pinned scores are
+        the truth again — the DURABLE artifact is restored even when
+        this controller has no engine (a resumed engineless rollback
+        must not leave the next serving process loading the rejected
+        candidate's reference and false-alerting forever)."""
+        from jama16_retina_tpu.obs import quality as quality_lib
+
+        backup = self._canary_backup_path()
+        if not os.path.exists(backup):
+            return False
+        images, ref = quality_lib.load_canary_file(backup)
+        path = self.cfg.obs.quality.canary_path
+        if path:
+            quality_lib.save_canary(path, images, scores=ref)
+        engine = self.engine
+        q = engine.quality if engine is not None else None
+        canary = q.canary if q is not None else None
+        if canary is not None:
+            canary.reference = ref
+            canary._g_ok.set(1.0)  # the restored model matches again
+        return True
+
+    # -- gate data ---------------------------------------------------------
+
+    def _gate_eval_data(self):
+        """(images, grades) of the val split for the parity/AUC gates,
+        decoded through the data plane's own machinery (bounded by
+        lifecycle.gate_eval_rows) and cached for THIS CYCLE only — the
+        array is released at the cycle's terminal state, so a
+        long-lived --watch supervisor neither pins gigabytes of host
+        RAM between cycles nor judges a later cycle against stale
+        rows. None when no data_dir/split exists — those gates then
+        skip, loudly."""
+        cycle = self.journal.cycle
+        if self._gate_data is not None and self._gate_data[0] == cycle:
+            return self._gate_data[1]
+        self._gate_data = None
+        if not self.data_dir:
+            return None
+        from jama16_retina_tpu.data import tfrecord
+        from jama16_retina_tpu.data.grain_pipeline import (
+            ParallelDecoder,
+            TFRecordIndex,
+            resolve_decode_workers,
+        )
+
+        try:
+            paths = tfrecord.list_split(self.data_dir, "val")
+        except (FileNotFoundError, ValueError):
+            return None
+        if not paths:
+            return None
+        index = TFRecordIndex(paths)
+        n = len(index)
+        if self.lc.gate_eval_rows > 0:
+            n = min(n, self.lc.gate_eval_rows)
+        # A detached registry: gate-time decode counters must not bleed
+        # into the serving session's data-plane telemetry (and its
+        # quarantine burn-rate alert).
+        dec = ParallelDecoder(
+            index, self.cfg.model.image_size,
+            workers=resolve_decode_workers(0),
+            registry=obs_registry.Registry(),
+        )
+        try:
+            batch = dec.decode_batch(range(n))
+        finally:
+            dec.close()
+        self._gate_data = (
+            cycle, (batch["image"], np.asarray(batch["grade"]))
+        )
+        return self._gate_data[1]
+
+    def _score_gen(self, gen, images: np.ndarray) -> np.ndarray:
+        """Referable probabilities [n] through one generation — the
+        scalar the parity/AUC gates compare on (either head)."""
+        from jama16_retina_tpu.eval import metrics
+
+        return _referable(metrics.ensemble_average(
+            list(self.engine.member_probs(images, _gen=gen))
+        ))
+
+    def _canary_scores(self, gen) -> np.ndarray:
+        """Golden-set scores through one generation in the ENGINE'S
+        canary convention — raw ensemble-averaged output, raveled
+        ([n] binary, [n*C] multi) — NOT referable-collapsed: the
+        pinned reference, the reload gate, and every cadence canary
+        run all use this shape, and a lifecycle that compared or
+        re-pinned in another shape would mismatch every multi-head
+        cycle."""
+        from jama16_retina_tpu.eval import metrics
+
+        return np.asarray(metrics.ensemble_average(
+            list(self.engine.member_probs(
+                self.engine.quality.canary.images, _gen=gen
+            ))
+        ), np.float64).ravel()
+
+
+# ---------------------------------------------------------------------------
+# Default seams: warm-start retrain + the three named gates
+# ---------------------------------------------------------------------------
+
+
+def _default_retrain(ctl: LifecycleController, cand_root: str) -> list:
+    """Warm-start fine-tune every live member on fresh data
+    (trainer.fit with train.init_from; the RETRAIN phase's real
+    implementation). Idempotent per member: a durable candidate (its
+    RETRAIN_DONE marker written after fit returned) is reused on
+    resume, and fit's own train.resume continues a member interrupted
+    mid-run — kill -9 during RETRAIN repeats no completed work."""
+    from jama16_retina_tpu import trainer
+
+    live = ctl.live_member_dirs()
+    if not live:
+        raise RuntimeError(
+            "RETRAIN needs the live checkpoint set (live_member_dirs= "
+            "or a journal live pointer)"
+        )
+    if not ctl.data_dir:
+        raise RuntimeError("RETRAIN needs data_dir= (fresh training data)")
+    cfg = ctl.cfg
+    steps = ctl.lc.retrain_steps or cfg.train.steps
+    cycle = ctl.journal.cycle
+    out = []
+    for m, src in enumerate(live):
+        dst = os.path.join(cand_root, f"member_{m:02d}")
+        marker = os.path.join(dst, "RETRAIN_DONE.json")
+        if os.path.exists(marker):
+            out.append(dst)
+            continue
+        mcfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, init_from=src, steps=steps, resume=True,
+        ))
+        result = trainer.fit(
+            mcfg, ctl.data_dir, dst,
+            seed=cfg.train.seed + 1000 * (cycle + 1) + m,
+        )
+        _atomic_write_json(marker, {
+            "cycle": cycle, "init_from": src, "steps": steps,
+            "best_auc": result.get("best_auc"),
+            "t": round(time.time(), 3),
+        })
+        out.append(dst)
+    return out
+
+
+def gate_golden_canary(ctl: LifecycleController,
+                       candidate) -> GateVerdict:
+    """Sanity bound on the golden set: |candidate - pinned reference|
+    must stay under lifecycle.gate_canary_max_dev. Loose by design —
+    a fine-tuned model moves scores; a degenerate candidate (random
+    divergence, collapsed head) moves them wildly."""
+    q = ctl.engine.quality if ctl.engine is not None else None
+    canary = q.canary if q is not None else None
+    if canary is None or canary.reference is None:
+        return GateVerdict(
+            name="golden_canary", passed=True, skipped=True,
+            detail="no canary artifact configured/pinned",
+        )
+    scores = ctl._canary_scores(candidate)
+    ref = np.asarray(canary.reference, np.float64).ravel()
+    if scores.shape != ref.shape:
+        return GateVerdict(
+            name="golden_canary", passed=False,
+            detail=f"score shape {scores.shape} vs pinned {ref.shape}",
+        )
+    dev = float(np.max(np.abs(scores - ref)))
+    thr = float(ctl.lc.gate_canary_max_dev)
+    return GateVerdict(
+        name="golden_canary", passed=dev <= thr, value=dev,
+        threshold=thr,
+    )
+
+
+def gate_profile_parity(ctl: LifecycleController,
+                        candidate) -> GateVerdict:
+    """Debiased PSI of the candidate's val-split score histogram vs
+    the loaded reference profile — the same statistic the online drift
+    monitor publishes, applied pre-swap."""
+    from jama16_retina_tpu.obs import quality as quality_lib
+
+    q = ctl.engine.quality if ctl.engine is not None else None
+    profile = q.profile if q is not None else None
+    if profile is None:
+        return GateVerdict(
+            name="profile_parity", passed=True, skipped=True,
+            detail="no reference profile loaded",
+        )
+    data = ctl._gate_eval_data()
+    if data is None:
+        return GateVerdict(
+            name="profile_parity", passed=True, skipped=True,
+            detail="no val split available to score",
+        )
+    images, _ = data
+    scores = ctl._score_gen(candidate, images)
+    counts = quality_lib.bin_counts(scores, int(profile["bins"]))
+    value = quality_lib.psi_debiased(
+        np.asarray(profile["score_hist"], np.float64), counts
+    )
+    thr = float(ctl.lc.gate_parity_psi_max)
+    if thr < 0:
+        thr = float(ctl.cfg.obs.quality.psi_alert)
+    return GateVerdict(
+        name="profile_parity", passed=value <= thr, value=value,
+        threshold=thr,
+    )
+
+
+def gate_auc_floor(ctl: LifecycleController, candidate) -> GateVerdict:
+    """Operating-point floor: candidate val AUC >= live val AUC -
+    lifecycle.gate_auc_floor_delta, both scored on the same rows
+    through the same engine path."""
+    from jama16_retina_tpu.eval import metrics
+
+    data = ctl._gate_eval_data()
+    if data is None:
+        return GateVerdict(
+            name="auc_floor", passed=True, skipped=True,
+            detail="no val split available to score",
+        )
+    images, grades = data
+    labels = (np.asarray(grades) >= 2).astype(np.float64)
+    if not (0.0 < labels.mean() < 1.0):
+        return GateVerdict(
+            name="auc_floor", passed=True, skipped=True,
+            detail="val split is single-class; AUC undefined",
+        )
+    auc_cand = metrics.roc_auc(labels, ctl._score_gen(candidate, images))
+    auc_live = metrics.roc_auc(
+        labels, ctl._score_gen(ctl.engine._gen, images)
+    )
+    delta = float(ctl.lc.gate_auc_floor_delta)
+    return GateVerdict(
+        name="auc_floor", passed=auc_cand >= auc_live - delta,
+        value=float(auc_cand), threshold=float(auc_live - delta),
+        detail=f"live_auc={auc_live:.6f}",
+    )

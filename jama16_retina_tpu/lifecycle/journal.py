@@ -1,0 +1,158 @@
+"""Crash-safe lifecycle journal: the controller's only durable state.
+
+The state machine in lifecycle/controller.py performs one idempotent
+step per transition and appends the arrival record HERE; the whole
+file is rewritten atomically (tmp + fsync + os.replace — the same
+discipline as rawshard manifests) on every append, so a reader (or a
+controller resuming after kill -9) sees either the journal before the
+transition or after it, never a torn file. A ``.tmp`` leftover from a
+mid-write kill is ignored and overwritten by the next append.
+
+Entries are append-only dicts:
+
+    {"seq": N, "cycle": C, "state": "<STATE>", "t": <unix>, ...payload}
+
+``state`` names the state the controller has ARRIVED at, with that
+state's work complete — e.g. a ``RETRAIN`` entry means the candidate
+checkpoints it lists are durable on disk. One journal spans many
+cycles (one cycle per drift trigger); ``cycle_entries()`` returns the
+entries of the newest cycle, which is all a resuming controller needs.
+
+Alongside the journal lives the LIVE POINTER (``live.json``, same
+atomic write): the checkpoint set the serving engine should currently
+be built from. The promote and rollback steps update it BEFORE
+journaling their transition, so re-applying a half-done swap after a
+crash is an idempotent pointer read + reload, not a guess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+FORMAT = "jama16.lifecycle"
+VERSION = 1
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class Journal:
+    """The append-only, atomically rewritten transition journal.
+
+    Construct over a directory (created on first append); an existing
+    journal file loads immediately — version-checked, and a torn or
+    unparseable file refuses loudly (a lifecycle controller must never
+    silently restart a half-done rollout from scratch).
+    """
+
+    def __init__(self, journal_dir: str, terminal_states=("COMMIT",
+                                                          "ROLLBACK")):
+        self.dir = journal_dir
+        self.path = os.path.join(journal_dir, "journal.json")
+        self.live_path = os.path.join(journal_dir, "live.json")
+        self._terminal = tuple(terminal_states)
+        self.entries: list[dict] = []
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                raise ValueError(
+                    f"lifecycle journal {self.path} is unreadable "
+                    f"({type(e).__name__}: {e}); refusing to guess at "
+                    "rollout state — inspect or move it aside"
+                ) from e
+            if doc.get("format") != FORMAT or doc.get("version") != VERSION:
+                raise ValueError(
+                    f"lifecycle journal {self.path} has format "
+                    f"{doc.get('format')!r} v{doc.get('version')!r}; this "
+                    f"code reads {FORMAT} v{VERSION}"
+                )
+            self.entries = list(doc.get("entries", ()))
+
+    # -- reads -------------------------------------------------------------
+
+    def refresh(self) -> None:
+        """Re-read entries from disk — the supervising ``--watch``
+        process picks up a ``--trigger`` appended by another invocation
+        this way. Writers never interleave by protocol (trigger appends
+        only to a CLOSED cycle, the supervisor only to an open one)."""
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self.entries = list(json.load(f).get("entries", ()))
+
+    @property
+    def state(self) -> "str | None":
+        """State of the newest entry (None = journal empty/idle)."""
+        return self.entries[-1]["state"] if self.entries else None
+
+    @property
+    def cycle(self) -> int:
+        """Newest cycle id (-1 before the first trigger)."""
+        return self.entries[-1]["cycle"] if self.entries else -1
+
+    def cycle_entries(self, cycle: "int | None" = None) -> list[dict]:
+        """Entries of ``cycle`` (default: the newest one) — everything
+        a resuming controller needs to pick up where the dead one
+        stopped."""
+        c = self.cycle if cycle is None else cycle
+        return [e for e in self.entries if e["cycle"] == c]
+
+    def cycle_open(self) -> bool:
+        """True while the newest cycle has not reached a terminal
+        state — exactly when trigger() must refuse to start another."""
+        return bool(self.entries) and self.state not in self._terminal
+
+    def find(self, state: str, cycle: "int | None" = None) -> "dict | None":
+        """The newest entry for ``state`` within one cycle (the
+        idempotency lookup: 'did this step already complete?')."""
+        for e in reversed(self.cycle_entries(cycle)):
+            if e["state"] == state:
+                return e
+        return None
+
+    # -- writes ------------------------------------------------------------
+
+    def append(self, state: str, cycle: "int | None" = None,
+               **payload) -> dict:
+        """One completed transition, durably. Returns the entry."""
+        entry = {
+            "seq": len(self.entries),
+            "cycle": self.cycle + 1 if cycle is None else cycle,
+            "state": state,
+            "t": round(time.time(), 3),
+            **payload,
+        }
+        self.entries.append(entry)
+        os.makedirs(self.dir, exist_ok=True)
+        _atomic_write_json(self.path, {
+            "format": FORMAT, "version": VERSION, "entries": self.entries,
+        })
+        return entry
+
+    # -- the live pointer --------------------------------------------------
+
+    def read_live(self) -> "list[str] | None":
+        """The blessed serving checkpoint set (None = never written:
+        serve whatever the deployment config names)."""
+        if not os.path.exists(self.live_path):
+            return None
+        with open(self.live_path) as f:
+            return list(json.load(f)["member_dirs"])
+
+    def write_live(self, member_dirs) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        _atomic_write_json(self.live_path, {
+            "format": FORMAT, "version": VERSION,
+            "member_dirs": list(member_dirs),
+            "t": round(time.time(), 3),
+        })

@@ -41,6 +41,8 @@ import operator
 import re
 import time
 
+from absl import logging as absl_logging
+
 from jama16_retina_tpu.obs import registry as registry_lib
 
 _OPS = {
@@ -157,7 +159,8 @@ def reliability_rules(cfg) -> list:
     return rules
 
 
-def manager_for(cfg, workdir: str, registry=None) -> "AlertManager | None":
+def manager_for(cfg, workdir: str, registry=None,
+                on_fire=None) -> "AlertManager | None":
     """The AlertManager a TRAINERLESS process (serving session, batch
     predict) hangs on its Snapshotter: the rules ``cfg.obs.quality``
     implies, wired to a fresh FlightRecorder over ``workdir`` so a
@@ -182,7 +185,8 @@ def manager_for(cfg, workdir: str, registry=None) -> "AlertManager | None":
         # No step loop to watch in a serving/predict process.
         slow_step_factor=float("inf"),
     )
-    return AlertManager(rules, registry=registry, flight=flight)
+    return AlertManager(rules, registry=registry, flight=flight,
+                        on_fire=on_fire)
 
 
 def resolve_metric(snapshot: dict, metric: str,
@@ -214,6 +218,15 @@ def resolve_metric(snapshot: dict, metric: str,
     return None
 
 
+def rule_holds(rule: AlertRule, snapshot: dict) -> bool:
+    """One stateless evaluation of a rule's CONDITION against one
+    snapshot — no `for` latching, no rate() history. The lifecycle
+    WATCH phase uses this to probe its regression rules at its own
+    cadence; a missing metric is False (no evidence, no regression)."""
+    value = resolve_metric(snapshot, rule.metric)
+    return value is not None and _OPS[rule.op](value, rule.threshold)
+
+
 class _RuleState:
     __slots__ = ("since", "firing")
 
@@ -235,7 +248,7 @@ class AlertManager:
     """
 
     def __init__(self, rules, registry: "registry_lib.Registry | None" = None,
-                 flight=None):
+                 flight=None, on_fire=None):
         self.rules = [
             r if isinstance(r, AlertRule) else parse_rule(r) for r in rules
         ]
@@ -244,12 +257,25 @@ class AlertManager:
             else registry_lib.default_registry()
         )
         self._flight = flight
+        # The action seam (ISSUE 8): ``on_fire(info_dict)`` runs ONCE
+        # per rule transition to firing — never re-invoked while the
+        # rule stays latched — so alerts become actions (the lifecycle
+        # controller's trigger rides here). Callback exceptions are
+        # COUNTED (obs.alert_callback_errors) and logged, never raised
+        # into the Snapshotter's flush thread: a broken action handler
+        # must not kill telemetry export.
+        self.on_fire = on_fire
         self._state = {r.name: _RuleState() for r in self.rules}
         self._prev_snapshot: "dict | None" = None
         self._prev_t: "float | None" = None
         self._c_fired = self._registry.counter(
             "obs.alerts_fired",
             help="alert rules that transitioned to firing this run",
+        )
+        self._c_cb_errors = self._registry.counter(
+            "obs.alert_callback_errors",
+            help="exceptions raised by the on_fire callback (swallowed; "
+                 "the flush thread survives)",
         )
 
     def evaluate(self, snapshot: "dict | None" = None,
@@ -288,6 +314,20 @@ class AlertManager:
                             metric=rule.metric, value=round(value, 6),
                             threshold=rule.threshold,
                         )
+                    if self.on_fire is not None:
+                        try:
+                            self.on_fire({
+                                "rule": rule.name, "metric": rule.metric,
+                                "value": value,
+                                "threshold": rule.threshold,
+                                "for_s": held, "reason": rule.reason,
+                            })
+                        except Exception as e:  # noqa: BLE001
+                            self._c_cb_errors.inc()
+                            absl_logging.error(
+                                "alert on_fire callback failed for %s: "
+                                "%s: %s", rule.name, type(e).__name__, e,
+                            )
                 if st.firing:
                     firing.append({
                         "rule": rule.name, "metric": rule.metric,

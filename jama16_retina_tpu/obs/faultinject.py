@@ -12,11 +12,17 @@ drive every recovery path on demand.
 Sites wired in this codebase (the vocabulary docs/RELIABILITY.md
 tables use):
 
-    tfrecord.read    — TFRecordIndex.read (data/grain_pipeline.py)
-    host.decode      — serve/host._load_one (per-image file read)
-    ckpt.restore     — Checkpointer.restore (utils/checkpoint.py)
-    engine.dispatch  — ServingEngine per-chunk dispatch (serve/engine.py)
-    trainer.step     — the trainer loops' per-step boundary
+    tfrecord.read      — TFRecordIndex.read (data/grain_pipeline.py)
+    host.decode        — serve/host._load_one (per-image file read)
+    ckpt.restore       — Checkpointer.restore (utils/checkpoint.py)
+    engine.dispatch    — ServingEngine per-chunk dispatch (serve/engine.py)
+    trainer.step       — the trainer loops' per-step boundary
+    lifecycle.retrain  — LifecycleController RETRAIN phase entry
+    lifecycle.gate     — LifecycleController GATE evaluation (an
+                         injected error here FAILS CLOSED: the
+                         candidate is rejected, the cycle rolls back)
+    lifecycle.swap     — LifecycleController STAGED_ROLLOUT promote
+                         (lifecycle/controller.py)
 
 Zero overhead unarmed — the contract the bench guard pins: every seam
 reads ONE module-level global and branches; no dict lookup, no lock,

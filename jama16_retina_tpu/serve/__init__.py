@@ -30,6 +30,7 @@ from jama16_retina_tpu.serve.batcher import (
 )
 from jama16_retina_tpu.serve.engine import (
     ReloadRejected,
+    RollbackUnavailable,
     ServingEngine,
     resolve_buckets,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "MicroBatcher",
     "Overloaded",
     "ReloadRejected",
+    "RollbackUnavailable",
     "ServingEngine",
     "resolve_buckets",
 ]

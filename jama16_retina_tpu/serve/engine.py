@@ -30,6 +30,7 @@ math:
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -53,6 +54,77 @@ class ReloadRejected(RuntimeError):
     keeps serving, the candidate never took a request. Counted under
     ``serve.reload_rejected`` and surfaced by the reliability alert
     rule — a rejected rollout must page, not silently retry."""
+
+
+class RollbackUnavailable(RuntimeError):
+    """``engine.rollback()`` was asked for an instant re-swap but no
+    previous generation is retained (never swapped, already rolled
+    back, or the ``serve.rollback_keep_s`` window expired and the tree
+    was released). The caller must fall back to ``reload()`` from the
+    previous checkpoint set on disk."""
+
+
+class _ShadowSession:
+    """One candidate generation shadow-scoring a deterministic fraction
+    of live traffic (ISSUE 8 STAGED_ROLLOUT).
+
+    Sampling is every-Nth *request* (N = round(1/fraction)), counted
+    under a lock — deterministic under a fixed request sequence, no
+    RNG. A sampled request pays the candidate forward on its own
+    thread (the standard shadow price: that request's latency roughly
+    doubles); a shadow-scoring failure is COUNTED
+    (``serve.shadow.errors``), never raised into the live request.
+    Comparison evidence (rows, max/mean |candidate - live|) is what
+    the lifecycle journal records before a promote — advisory, not a
+    gate: a retrained candidate legitimately moves scores.
+    """
+
+    __slots__ = ("gen", "member_dirs", "every", "count", "requests",
+                 "rows", "max_abs_dev", "sum_abs_dev", "errors", "lock")
+
+    def __init__(self, gen: "_Generation", member_dirs, fraction: float):
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(
+                f"shadow fraction must be in (0, 1], got {fraction}"
+            )
+        self.gen = gen
+        self.member_dirs = list(member_dirs) if member_dirs else None
+        self.every = max(1, int(round(1.0 / fraction)))
+        self.count = 0
+        self.requests = 0
+        self.rows = 0
+        self.max_abs_dev = 0.0
+        self.sum_abs_dev = 0.0
+        self.errors = 0
+        self.lock = threading.Lock()
+
+    def claim(self) -> bool:
+        """Deterministic sampling decision for one live request."""
+        with self.lock:
+            self.count += 1
+            return self.count % self.every == 0
+
+    def record(self, live: np.ndarray, shadow: np.ndarray) -> None:
+        dev = np.abs(
+            np.asarray(shadow, np.float64) - np.asarray(live, np.float64)
+        )
+        with self.lock:
+            self.requests += 1
+            self.rows += int(dev.shape[0]) if dev.ndim else 1
+            self.max_abs_dev = max(self.max_abs_dev, float(dev.max()))
+            self.sum_abs_dev += float(dev.sum())
+
+    def report(self) -> dict:
+        with self.lock:
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "errors": self.errors,
+                "max_abs_dev": round(self.max_abs_dev, 9),
+                "mean_abs_dev": round(
+                    self.sum_abs_dev / self.rows, 9
+                ) if self.rows else None,
+            }
 
 
 class _Generation:
@@ -214,6 +286,33 @@ class ServingEngine:
             help="currently-serving model generation (0 = the "
                  "construction-time checkpoint set)",
         )
+        # Lifecycle seams (ISSUE 8): instant rollback off the retained
+        # previous generation, and the shadow-scoring session a staged
+        # rollout samples live traffic through.
+        self._c_rollbacks = self.registry.counter(
+            "serve.rollbacks",
+            help="instant re-swaps to the retained previous generation "
+                 "(lifecycle ROLLBACK; no restore from disk)",
+        )
+        self._c_shadow_requests = self.registry.counter(
+            "serve.shadow.requests",
+            help="live requests shadow-scored through a staged-rollout "
+                 "candidate generation",
+        )
+        self._c_shadow_rows = self.registry.counter("serve.shadow.rows")
+        self._c_shadow_errors = self.registry.counter(
+            "serve.shadow.errors",
+            help="shadow-scoring failures (counted, never raised into "
+                 "the live request they rode)",
+        )
+        self._g_shadow_dev = self.registry.gauge(
+            "serve.shadow.max_abs_dev",
+            help="running max |candidate - live| score deviation over "
+                 "the current shadow session",
+        )
+        self._prev_gen: "_Generation | None" = None
+        self._prev_gen_t: float = 0.0
+        self._shadow: "_ShadowSession | None" = None
         self._batch_sharding = (
             mesh_lib.batch_sharding(mesh) if mesh is not None else None
         )
@@ -348,9 +447,34 @@ class ServingEngine:
         with self._reload_lock:
             return self._reload_locked(member_dirs, state)
 
+    def _release_retained_locked(self, why: str) -> None:
+        """Drop the retained generation BEFORE building a candidate:
+        a new rollout supersedes the old rollback target (rolling back
+        across two swaps is not a thing — the pre-pre-swap model is a
+        reload-from-disk decision, not an instant re-swap), and
+        holding it through the build would put live + retained +
+        candidate (3x) on the device at once. Peak residency during
+        any reload therefore stays at the ~2x PR 6 documented."""
+        if self._prev_gen is None:
+            return
+        absl_logging.info(
+            "releasing retained generation %d (%s)",
+            self._prev_gen.gen_id, why,
+        )
+        self._prev_gen = None
+
+    def release_retained(self) -> None:
+        """Explicitly drop the retained previous generation (frees its
+        device residency). The lifecycle controller calls this at
+        COMMIT — once the post-swap watch judged the rollout healthy,
+        paying 2x HBM until the window expires buys nothing."""
+        with self._reload_lock:
+            self._prev_gen = None
+
     def _reload_locked(self, member_dirs, state) -> dict:
         cur = self._gen
         new_id = cur.gen_id + 1
+        self._release_retained_locked("superseded by a new rollout")
         try:
             gen = self._build_generation(
                 new_id, member_dirs=member_dirs, state=state, warm=True
@@ -412,6 +536,17 @@ class ServingEngine:
         # generation reference and complete on it; generation N's
         # device buffers free once the last such request drains.
         gen.c_rows = self._register_gen_rows(new_id)
+        # Retain the outgoing generation for serve.rollback_keep_s
+        # (ISSUE 8): within that window rollback() is one handle
+        # re-swap — the old stacked tree is still device-resident and
+        # warm, no restore from disk. Costs one extra model residency,
+        # the same transient ~2x a reload already needs.
+        if self.cfg.serve.rollback_keep_s > 0:
+            self._prev_gen = cur
+            self._prev_gen_t = time.monotonic()
+        # Any shadow session described the OLD live generation; a swap
+        # invalidates its comparison baseline.
+        self._shadow = None
         self._gen = gen
         self._c_reloads.inc()
         self._g_generation.set(new_id)
@@ -420,6 +555,148 @@ class ServingEngine:
             gen.n_members,
         )
         return info
+
+    def rollback(self) -> dict:
+        """Instant re-swap to the retained previous generation
+        (ISSUE 8 lifecycle ROLLBACK): one atomic handle assignment —
+        the previous stacked tree is still device-resident from the
+        retention window, so no restore, no warm-up, no canary pass
+        stands between "regression detected" and "old model serving".
+
+        The restored state is minted as a NEW generation (ids stay
+        monotonic, the per-generation row ledger stays unambiguous).
+        Raises :class:`RollbackUnavailable` when nothing is retained
+        (never swapped / already rolled back) or the
+        ``serve.rollback_keep_s`` window expired — callers fall back
+        to ``reload()`` from the previous checkpoint set on disk.
+        Returns {'generation', 'restored_from', 'n_members'}."""
+        with self._reload_lock:
+            prev = self._prev_gen
+            keep_s = self.cfg.serve.rollback_keep_s
+            if prev is None:
+                raise RollbackUnavailable(
+                    "no previous generation retained (never swapped, or "
+                    "already rolled back); reload() the previous "
+                    "checkpoint set instead"
+                )
+            age = time.monotonic() - self._prev_gen_t
+            if keep_s <= 0 or age > keep_s:
+                self._prev_gen = None
+                raise RollbackUnavailable(
+                    f"retained generation {prev.gen_id} expired "
+                    f"({age:.0f}s old vs serve.rollback_keep_s="
+                    f"{keep_s:g}); reload() the previous checkpoint set "
+                    "instead"
+                )
+            cur = self._gen
+            new_id = cur.gen_id + 1
+            gen = _Generation(
+                new_id, prev.state, prev.n_members, prev.member_dirs,
+                self._register_gen_rows(new_id),
+            )
+            self._prev_gen = None  # one rollback per swap, by design
+            self._shadow = None
+            self._gen = gen
+            self._c_rollbacks.inc()
+            self._g_generation.set(new_id)
+            absl_logging.warning(
+                "ROLLBACK: generation %d live again as generation %d "
+                "(was serving %d)", prev.gen_id, new_id, cur.gen_id,
+            )
+            return {
+                "generation": new_id,
+                "restored_from": prev.gen_id,
+                "n_members": gen.n_members,
+            }
+
+    # -- staged-rollout shadow seam (ISSUE 8) ------------------------------
+
+    def prepare_candidate(self, member_dirs=None, *,
+                          state: "train_lib.TrainState | None" = None,
+                          warm: bool = False):
+        """Build a candidate generation handle entirely off the
+        request path — restore, stack, device-place, optionally warm —
+        WITHOUT installing it anywhere. The lifecycle GATE phase scores
+        through the handle via ``member_probs(images, _gen=handle)``;
+        its rows land on a detached counter, never the live ledger."""
+        return self._build_generation(
+            self._gen.gen_id + 1, member_dirs=member_dirs, state=state,
+            warm=warm,
+        )
+
+    def begin_shadow(self, member_dirs=None, *,
+                     state: "train_lib.TrainState | None" = None,
+                     candidate=None, fraction: float = 0.25) -> dict:
+        """Start shadow-scoring a deterministic fraction of live
+        requests through a candidate generation. Pass ``candidate``
+        (a ``prepare_candidate`` handle, reused so the gate and the
+        shadow score the same residency) or checkpoint ``member_dirs``/
+        ``state`` to build one here (warmed: a sampled live request
+        must never eat a candidate compile). One session at a time;
+        a reload/rollback clears the session (its baseline died)."""
+        with self._reload_lock:
+            if self._shadow is not None:
+                raise RuntimeError(
+                    "a shadow session is already active; end_shadow() "
+                    "it first"
+                )
+            if candidate is None:
+                candidate = self._build_generation(
+                    self._gen.gen_id + 1, member_dirs=member_dirs,
+                    state=state, warm=True,
+                )
+            self._shadow = _ShadowSession(
+                candidate, candidate.member_dirs, fraction
+            )
+            return {"fraction": fraction, "every": self._shadow.every}
+
+    def shadow_report(self) -> "dict | None":
+        """Comparison evidence of the active session (None = none)."""
+        sh = self._shadow
+        return sh.report() if sh is not None else None
+
+    def end_shadow(self, promote: bool = False) -> "dict | None":
+        """Stop sampling; with ``promote=True`` swap the candidate live
+        through the full ``reload()`` path (warm + canary gate + atomic
+        swap + retention of the outgoing generation). Returns the final
+        shadow report (plus reload info under 'reload' on promote).
+        The session is CLAIMED under the reload lock — of two racing
+        enders exactly one gets the report (and the promote); the
+        reload itself runs after release (it re-takes the lock)."""
+        with self._reload_lock:
+            sh = self._shadow
+            self._shadow = None
+        if sh is None:
+            return None
+        report = sh.report()
+        if promote:
+            report = dict(report)
+            report["reload"] = self.reload(
+                member_dirs=sh.member_dirs, state=sh.gen.state
+            )
+        return report
+
+    def _shadow_sample(self, sh: "_ShadowSession", images: np.ndarray,
+                      live_out: np.ndarray) -> None:
+        """Score one sampled live request through the candidate; any
+        failure is counted, logged, and swallowed — shadow evidence
+        must never fail the live request it rode."""
+        try:
+            shadow_out = metrics.ensemble_average(
+                list(self.member_probs(images, _gen=sh.gen))
+            )
+            sh.record(live_out, shadow_out)
+            self._c_shadow_requests.inc()
+            self._c_shadow_rows.inc(images.shape[0])
+            self._g_shadow_dev.set(sh.max_abs_dev)
+        except Exception as e:  # noqa: BLE001 - advisory path
+            with sh.lock:
+                sh.errors += 1
+            self._c_shadow_errors.inc()
+            absl_logging.error(
+                "shadow scoring failed (live request unaffected): "
+                "%s: %s", type(e).__name__, e,
+            )
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -544,6 +821,12 @@ class ServingEngine:
         out = metrics.ensemble_average(
             list(self.member_probs(images, _gen=gen))
         )
+        # Staged-rollout shadow (ISSUE 8): a deterministic fraction of
+        # live requests also scores through the candidate generation;
+        # inactive = one attribute read + branch.
+        sh = self._shadow
+        if sh is not None and sh.claim():
+            self._shadow_sample(sh, images, out)
         q = self.quality
         if q is not None:
             q.observe(images, out)

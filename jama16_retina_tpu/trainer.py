@@ -994,6 +994,28 @@ def _load_or_write_run_meta(
     return seed
 
 
+def _warm_start_state(cfg: ExperimentConfig, model, state, mesh):
+    """Seed a fresh step-0 state with the best checkpoint under
+    ``cfg.train.init_from`` (ISSUE 8 warm-start entry): params and
+    batch_stats transplant; the optimizer, schedule, and step counter
+    stay fresh — a fine-tune is a NEW run that starts from good
+    weights, not a resume. When this run carries an EMA shadow, it
+    seeds from the donor's shadow (or its params when the donor has
+    none) so the first evals don't average against random init.
+    restore_for_eval owns the EMA/legacy tree reconciliation; an
+    architecture mismatch surfaces as its loud restore error."""
+    donor = restore_for_eval(cfg, model, cfg.train.init_from)
+    updates = {"params": donor.params, "batch_stats": donor.batch_stats}
+    if state.ema_params is not None:
+        updates["ema_params"] = (
+            donor.ema_params if donor.ema_params is not None
+            else donor.params
+        )
+    return jax.device_put(
+        state.replace(**updates), mesh_lib.replicated(mesh)
+    )
+
+
 def fit(
     cfg: ExperimentConfig,
     data_dir: str,
@@ -1050,6 +1072,11 @@ def fit(
         log.write("resume", step=start_step,
                   best_auc=(round(best_auc, 5) if np.isfinite(best_auc) else None),
                   since_best=since_best)
+    elif cfg.train.init_from:
+        # Warm start (never when a resume found a checkpoint above: a
+        # resumed run continues ITSELF; the donor only seeds step 0).
+        state = _warm_start_state(cfg, model, state, mesh)
+        log.write("warm_start", init_from=cfg.train.init_from)
 
     base_key = jax.random.key(seed)
     _obs_begin_run(cfg)  # before the pipelines create their metrics
@@ -1348,6 +1375,14 @@ def fit_ensemble_parallel(
     replicated first (an ICI all-gather) so device_get is host-legal.
     """
     k = cfg.train.ensemble_size
+    if cfg.train.init_from:
+        raise ValueError(
+            "train.init_from warm-starts ONE member from ONE checkpoint "
+            "dir; the member-parallel driver would seed every stacked "
+            "member identically (diversity collapse). Fine-tune members "
+            "through sequential fit() calls — the lifecycle controller's "
+            "RETRAIN phase does exactly that"
+        )
     mesh = mesh_lib.make_ensemble_mesh(k, cfg.parallel.num_devices)
     prev_debug_nans = jax.config.jax_debug_nans
     if cfg.train.debug:
@@ -1791,6 +1826,12 @@ def fit_tf(
         raise ValueError(
             "train.ema_decay is a flax-path feature; the legacy tf "
             "backend has no EMA shadow (see TrainConfig.ema_decay)"
+        )
+    if cfg.train.init_from:
+        raise ValueError(
+            "train.init_from warm-starts from an orbax (flax) "
+            "checkpoint; the legacy tf backend cannot load one — "
+            "fine-tune on the flax path"
         )
     if cfg.data.loader in ("hbm", "tiered", "rawshard"):
         raise ValueError(

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Operator entry point for the self-healing model lifecycle (ISSUE 8).
+
+Drives jama16_retina_tpu/lifecycle over a serving deployment's workdir:
+
+    # current journal state + live pointer + last cycle's timeline:
+    python scripts/lifecycle_run.py --workdir /serve/wd --status
+
+    # open a cycle by hand (what an AlertManager(on_fire=) trigger
+    # does autonomously inside a serving session):
+    python scripts/lifecycle_run.py --workdir /serve/wd --trigger manual
+
+    # one-shot: execute exactly ONE journaled transition and exit —
+    # the auditable unit; re-run until COMMIT/ROLLBACK, killing it at
+    # any point is safe (the journal resumes it):
+    python scripts/lifecycle_run.py --workdir /serve/wd \\
+        --data_dir /data/eyepacs --ckpt /ckpt/member_00 --step
+
+    # supervise: poll the journal, drive any open cycle to terminal,
+    # pick up --trigger appends from other invocations:
+    python scripts/lifecycle_run.py --workdir /serve/wd \\
+        --data_dir /data/eyepacs --ckpt /ckpt/member_00 --watch
+
+--step/--watch build a real ServingEngine from the journal's live
+pointer (falling back to --ckpt) so gates, shadow scoring, promote,
+and rollback run against real model state. --status and --trigger
+touch only the journal — no engine, no accelerator.
+
+Exit codes: 0 ok (for --step: transition applied or nothing to do);
+2 the cycle reached ROLLBACK this invocation (the operator's cue to
+look at the journal's gate verdicts / watch evidence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_controller(cfg, args):
+    from jama16_retina_tpu.lifecycle import Journal, LifecycleController
+    from jama16_retina_tpu.serve import ServingEngine
+
+    journal = Journal(os.path.join(args.workdir, "lifecycle"))
+    live = journal.read_live() or list(args.ckpt or ())
+    if not live:
+        raise SystemExit(
+            "need the live checkpoint set: --ckpt member_dir [...] "
+            "(or a journal live pointer from a previous promote)"
+        )
+    engine = ServingEngine(cfg, live)
+    return LifecycleController(
+        cfg, args.workdir, engine=engine, data_dir=args.data_dir,
+        live_member_dirs=live,
+    )
+
+
+def _status(args) -> int:
+    from jama16_retina_tpu.lifecycle import Journal
+
+    journal = Journal(os.path.join(args.workdir, "lifecycle"))
+    out = {
+        "state": journal.state or "IDLE",
+        "cycle": journal.cycle,
+        "cycle_open": journal.cycle_open(),
+        "live_member_dirs": journal.read_live(),
+        "timeline": [
+            {k: v for k, v in e.items() if k != "live_member_dirs"}
+            for e in journal.cycle_entries()
+        ],
+    }
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"state: {out['state']}  (cycle {out['cycle']}, "
+              f"{'open' if out['cycle_open'] else 'closed'})")
+        print(f"live:  {out['live_member_dirs'] or '(deployment config)'}")
+        for e in out["timeline"]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("seq", "cycle", "state", "t")}
+            print(f"  [{e['seq']}] {e['state']}"
+                  + (f"  {extra}" if extra else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--workdir", required=True,
+                        help="the serving deployment's workdir (journal "
+                             "lives under <workdir>/lifecycle)")
+    parser.add_argument("--data_dir", default="",
+                        help="dataset root: fresh training data for "
+                             "RETRAIN + the val split the gates score")
+    parser.add_argument("--ckpt", nargs="*", default=None, metavar="DIR",
+                        help="live member checkpoint dirs (the fallback "
+                             "identity before the first promote writes "
+                             "the live pointer)")
+    parser.add_argument("--config", default="eyepacs_binary",
+                        help="config preset name")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="SECTION.FIELD=VALUE", dest="overrides",
+                        help="config overrides (repeatable), e.g. "
+                             "--set lifecycle.retrain_steps=2000")
+    parser.add_argument("--status", action="store_true",
+                        help="print journal state and exit (no engine)")
+    parser.add_argument("--trigger", default=None, metavar="REASON",
+                        help="open a cycle at DRIFT_DETECTED (refused "
+                             "while one is open); journal-only")
+    parser.add_argument("--step", action="store_true",
+                        help="one-shot: execute exactly one transition")
+    parser.add_argument("--watch", action="store_true",
+                        help="supervise: drive open cycles to terminal, "
+                             "polling the journal for new triggers")
+    parser.add_argument("--poll_s", type=float, default=30.0,
+                        help="--watch idle poll interval")
+    parser.add_argument("--max_cycles", type=int, default=0,
+                        help="--watch: exit after this many terminal "
+                             "cycles (0 = run until interrupted)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable --status/--step output")
+    args = parser.parse_args(argv)
+
+    from jama16_retina_tpu.configs import get_config, override
+
+    cfg = override(get_config(args.config), args.overrides)
+
+    if args.status:
+        return _status(args)
+
+    if args.trigger is not None and not (args.step or args.watch):
+        # Journal-only trigger: no engine, no accelerator — safe from a
+        # cron job or an alert webhook handler.
+        from jama16_retina_tpu.lifecycle import Journal, TERMINAL_STATES
+
+        journal = Journal(os.path.join(args.workdir, "lifecycle"),
+                          terminal_states=TERMINAL_STATES)
+        if journal.cycle_open():
+            print(f"refused: cycle {journal.cycle} is open at "
+                  f"{journal.state}")
+            return 0
+        live = journal.read_live() or list(args.ckpt or ())
+        journal.append(
+            "DRIFT_DETECTED", cycle=journal.cycle + 1,
+            reason=args.trigger, live_member_dirs=live or None,
+            source="lifecycle_run",
+        )
+        print(f"cycle {journal.cycle} opened (reason={args.trigger})")
+        return 0
+
+    if not (args.step or args.watch):
+        parser.error("pick a mode: --status, --trigger, --step or --watch")
+
+    ctl = _build_controller(cfg, args)
+    if args.trigger is not None:
+        ctl.trigger(reason=args.trigger)
+
+    if args.step:
+        entry = ctl.step()
+        if args.json:
+            print(json.dumps({
+                "applied": entry is not None, "state": ctl.state,
+                "entry": ({k: v for k, v in entry.items()
+                           if k != "live_member_dirs"}
+                          if entry else None),
+            }))
+        elif entry is None:
+            print(f"nothing to do (state {ctl.state})")
+        else:
+            print(f"-> {entry['state']} (cycle {entry['cycle']}, "
+                  f"seq {entry['seq']})")
+        return 2 if ctl.state == "ROLLBACK" and entry is not None else 0
+
+    # --watch: the supervisor loop. A transient step failure (flaky
+    # read mid-retrain, a momentary restore error) leaves the journal
+    # unadvanced by design — the supervisor's job is to KEEP DRIVING,
+    # not to die with a traceback and silently end self-healing.
+    done = 0
+    try:
+        while True:
+            ctl.journal.refresh()
+            if ctl.journal.cycle_open():
+                try:
+                    terminal = ctl.run()
+                except Exception as e:  # noqa: BLE001 - retried step
+                    print(f"step failed at {ctl.state} "
+                          f"({type(e).__name__}: {e}); retrying in "
+                          f"{args.poll_s:g}s")
+                    time.sleep(args.poll_s)
+                    continue
+                if ctl.journal.cycle_open():
+                    continue  # run() bounded out mid-cycle: keep going
+                done += 1
+                print(f"cycle {ctl.journal.cycle} -> {terminal}")
+                if args.max_cycles and done >= args.max_cycles:
+                    return 2 if terminal == "ROLLBACK" else 0
+            else:
+                time.sleep(args.poll_s)
+    except KeyboardInterrupt:
+        print(f"\nstopped at {ctl.state} (journal resumes it)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

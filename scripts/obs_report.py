@@ -600,6 +600,125 @@ def render_reliability(records: list) -> "str | None":
 
 
 # ---------------------------------------------------------------------------
+# Lifecycle: controller state, transition timeline, gate verdicts (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+# Mirror of lifecycle/controller.py STATES (this script reads JSONL
+# standalone — no package import): index = serve.lifecycle.state gauge.
+_LIFECYCLE_STATES = (
+    "IDLE", "DRIFT_DETECTED", "RETRAIN", "GATE", "STAGED_ROLLOUT",
+    "WATCH", "COMMIT", "ROLLBACK",
+)
+
+
+def lifecycle_summary(records: list) -> "dict | None":
+    """The Lifecycle section's machine-readable form (--json twin):
+    current controller state, the newest cycle's transition timeline,
+    its gate verdicts / shadow evidence / watch outcome, and the
+    cumulative retrain/promote/rollback/commit ledger. None when the
+    run carries no lifecycle records or counters — a deployment that
+    never closed the loop renders nothing."""
+    lc = [r for r in records if r.get("kind") == "lifecycle"]
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    counters = latest.get("counters", {})
+    gauges = latest.get("gauges", {})
+    has_counters = any(k.startswith("lifecycle.") for k in counters)
+    if not lc and not has_counters:
+        return None
+    state = lc[-1]["state"] if lc else None
+    if state is None and "serve.lifecycle.state" in gauges:
+        idx = int(gauges["serve.lifecycle.state"])
+        if 0 <= idx < len(_LIFECYCLE_STATES):
+            state = _LIFECYCLE_STATES[idx]
+    cycle = lc[-1].get("cycle") if lc else None
+    timeline = [r for r in lc if r.get("cycle") == cycle]
+    by_state = {r["state"]: r for r in timeline}
+    gate = by_state.get("GATE")
+    rollout = by_state.get("STAGED_ROLLOUT")
+    watch = by_state.get("WATCH")
+    rollback = by_state.get("ROLLBACK")
+    return {
+        "state": state,
+        "cycle": cycle,
+        "timeline": [
+            {"seq": r.get("seq"), "state": r.get("state"),
+             "t": r.get("t")}
+            for r in timeline
+        ],
+        "gate_passed": gate.get("passed") if gate else None,
+        "gate_verdicts": gate.get("verdicts", []) if gate else [],
+        "shadow": rollout.get("shadow") if rollout else None,
+        "generation": rollout.get("generation") if rollout else None,
+        "watch_healthy": watch.get("healthy") if watch else None,
+        "watch_fired": watch.get("fired", []) if watch else [],
+        "rollback_cause": rollback.get("cause") if rollback else None,
+        "retrains": int(counters.get("lifecycle.retrains", 0)),
+        "gate_rejects": int(counters.get("lifecycle.gate_rejects", 0)),
+        "promotes": int(counters.get("lifecycle.promotes", 0)),
+        "rollbacks": int(counters.get("lifecycle.rollbacks", 0)),
+        "commits": int(counters.get("lifecycle.commits", 0)),
+    }
+
+
+def render_lifecycle(records: list) -> "str | None":
+    s = lifecycle_summary(records)
+    if s is None:
+        return None
+    rows = [("state", s["state"] or "-")]
+    if s["cycle"] is not None:
+        rows.append(("cycle", s["cycle"]))
+    # The cumulative ledger lives in telemetry counters — present only
+    # when a long-lived process (serving session, --watch supervisor)
+    # exported them; one-shot --step invocations carry none.
+    if any(s[k] for k in ("retrains", "gate_rejects", "promotes",
+                          "rollbacks", "commits")):
+        rows.append((
+            "ledger",
+            f"{s['retrains']} retrains, {s['gate_rejects']} gate rejects, "
+            f"{s['promotes']} promotes, {s['rollbacks']} rollbacks, "
+            f"{s['commits']} commits",
+        ))
+    if s["generation"] is not None:
+        rows.append(("promoted generation", s["generation"]))
+    if s["shadow"]:
+        sh = s["shadow"]
+        rows.append((
+            "shadow evidence",
+            f"{sh.get('requests')} requests / {sh.get('rows')} rows, "
+            f"max dev {sh.get('max_abs_dev')}",
+        ))
+    if s["watch_healthy"] is not None:
+        rows.append((
+            "watch",
+            "healthy" if s["watch_healthy"]
+            else f"REGRESSION ({', '.join(s['watch_fired'])})",
+        ))
+    if s["rollback_cause"]:
+        rows.append(("rollback cause", s["rollback_cause"]))
+    out = ["lifecycle:\n" + _table(rows, ("signal", "value"))]
+    if s["timeline"]:
+        out.append(
+            "transitions (newest cycle): "
+            + " -> ".join(r["state"] for r in s["timeline"])
+        )
+    if s["gate_verdicts"]:
+        vrows = [
+            (v.get("name"),
+             "skip" if v.get("skipped")
+             else ("pass" if v.get("passed") else "FAIL"),
+             "-" if v.get("value") is None else f"{v['value']:.4f}",
+             "-" if v.get("threshold") is None else f"{v['threshold']:.4f}",
+             v.get("detail") or "-")
+            for v in s["gate_verdicts"]
+        ]
+        out.append("gate verdicts:\n" + _table(
+            vrows, ("gate", "verdict", "value", "threshold", "detail")
+        ))
+    return "\n\n".join(out)
+
+
+# ---------------------------------------------------------------------------
 # Quality: drift gauges, canary status, alert state (ISSUE 5)
 # ---------------------------------------------------------------------------
 
@@ -885,6 +1004,7 @@ def main(argv=None) -> int:
             "telemetry": telemetry[-1] if telemetry else None,
             "quality": quality_summary(records),
             "reliability": reliability_summary(records),
+            "lifecycle": lifecycle_summary(records),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
                 for p, b in sorted(latest_heartbeats(records).items())
@@ -908,6 +1028,10 @@ def main(argv=None) -> int:
     if rel:
         print()
         print(rel)
+    lcy = render_lifecycle(records)
+    if lcy:
+        print()
+        print(lcy)
     print()
     print(render_heartbeats(records))
     if events:

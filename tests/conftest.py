@@ -146,6 +146,22 @@ _QUICK_TESTS = {
     "test_autotune.py::test_decide_is_deterministic",
     "test_autotune.py::test_tuner_applies_knobs_and_records_telemetry",
     "test_autotune.py::test_device_prefetch_depth_knob_drains_and_grows",
+    # self-healing model lifecycle (ISSUE 8): the numpy-cheap policy
+    # pins — journal crash-safety, state-machine sequences, fail-closed
+    # gates, kill-at-every-state resume, the on_fire action seam, and
+    # the operator surfaces; the real-engine rollback/shadow and the
+    # e2e chaos drive stay in the full tier (XLA compiles dominate)
+    "test_lifecycle.py::test_journal_atomic_append_and_resume",
+    "test_lifecycle.py::test_journal_version_check_and_live_pointer",
+    "test_lifecycle.py::test_state_machine_happy_path_commits",
+    "test_lifecycle.py::test_gate_failure_rolls_back_without_touching_the_engine",
+    "test_lifecycle.py::test_injected_gate_fault_fails_closed",
+    "test_lifecycle.py::test_watch_regression_triggers_rollback_and_restores_pointer",
+    "test_lifecycle.py::test_kill_at_every_state_resumes_to_same_terminal",
+    "test_lifecycle.py::test_on_fire_fires_once_per_transition_never_while_latched",
+    "test_lifecycle.py::test_on_fire_exception_counted_not_raised",
+    "test_lifecycle.py::test_obs_report_lifecycle_section",
+    "test_lifecycle.py::test_lifecycle_run_cli_trigger_and_status",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

@@ -347,12 +347,62 @@ def test_unarmed_fault_site_costs_one_branch():
 def test_chaos_smoke_recovers_every_path():
     """bench.py --chaos off-chip: the deterministic chaos drive must
     report ok with every site's injection delivered (the bench-level
-    proof each recovery path actually ran)."""
+    proof each recovery path actually ran) — including the three
+    ISSUE 8 lifecycle sites (transient retrain, fail-closed gate that
+    must end in ROLLBACK with the journal intact, transient swap)."""
     extras = {}
     bench._chaos_smoke(extras)
     assert extras["chaos_ok"] is True
     assert extras["chaos_injections"]["tfrecord.read"] == 1
     assert extras["chaos_injections"]["engine.dispatch"] == 1
+    assert extras["chaos_injections"]["lifecycle.retrain"] == 1
+    assert extras["chaos_injections"]["lifecycle.gate"] == 1
+    assert extras["chaos_injections"]["lifecycle.swap"] == 1
+
+
+def test_lifecycle_overhead_guard_pins_two_percent():
+    """The ISSUE 8 pin, same shared guard math: device_only with the
+    self-healing layer attached but idle (unarmed lifecycle fault
+    site + idle-shadow branch + on_fire-carrying alert evaluate at a
+    10-step cadence) must stay within 2%."""
+    extras = {}
+    assert bench._lifecycle_overhead_guard(extras, 990.0, 1000.0)
+    assert extras["lifecycle_overhead_ok"] is True
+    assert extras["lifecycle_overhead_pct"] == pytest.approx(1.0)
+    extras = {}
+    assert not bench._lifecycle_overhead_guard(extras, 950.0, 1000.0)
+    assert extras["lifecycle_overhead_ok"] is False
+    assert extras["lifecycle_overhead_pct"] == pytest.approx(5.0)
+    extras = {}
+    assert bench._lifecycle_overhead_guard(extras, 1010.0, 1000.0)
+    assert extras["lifecycle_overhead_pct"] == 0.0
+
+
+def test_idle_alert_evaluate_with_on_fire_is_cheap():
+    """Per-op bound backing the lifecycle pin off-chip: one
+    AlertManager.evaluate over a small registry with an installed (but
+    never firing) on_fire callback — the per-window cost the idle
+    controller adds at flush cadence — stays well under a millisecond,
+    and the callback is never invoked while quiet."""
+    import time
+
+    from jama16_retina_tpu.obs import alerts as obs_alerts
+    from jama16_retina_tpu.obs.registry import Registry
+
+    reg = Registry()
+    reg.gauge("quality.canary_ok").set(1.0)
+    fired = []
+    mgr = obs_alerts.AlertManager(
+        [obs_alerts.AlertRule("quality.canary_ok", "<", 1.0)],
+        registry=reg, on_fire=fired.append,
+    )
+    n = 2_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        mgr.evaluate(now=float(i))
+    per_eval = (time.perf_counter() - t0) / n
+    assert not fired
+    assert per_eval < 1e-3, f"{per_eval * 1e6:.1f} us per idle evaluate"
 
 
 def test_tracing_overhead_guard_pins_two_percent():

@@ -1,0 +1,1341 @@
+"""Self-healing model lifecycle (ISSUE 8): the journaled drift-to-
+retrain controller proven crash-safe — kill it at every state and it
+resumes to the same terminal with no repeated side effects — plus the
+engine's instant rollback / shadow seams, the AlertManager on_fire
+trigger, the warm-start trainer entry, and the end-to-end chaos drive
+(drift alert -> retrain -> degraded candidate rejected at GATE with
+zero dropped requests -> good candidate promotes -> injected post-swap
+regression -> automatic ROLLBACK)."""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib, trainer
+from jama16_retina_tpu.configs import ServeConfig, get_config, override
+from jama16_retina_tpu.data import tfrecord
+from jama16_retina_tpu.lifecycle import (
+    GateVerdict,
+    Journal,
+    LifecycleController,
+    TERMINAL_STATES,
+)
+from jama16_retina_tpu.obs import alerts as obs_alerts
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import quality as quality_lib
+from jama16_retina_tpu.obs.registry import Registry
+from jama16_retina_tpu.serve import (
+    ReloadRejected,
+    RollbackUnavailable,
+    ServingEngine,
+)
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+pytestmark = pytest.mark.lifecycle
+
+SIZE = 32
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faultinject.disarm()
+
+
+def _ctl_cfg(extra=()):
+    return override(get_config("smoke"), [
+        f"model.image_size={SIZE}",
+        "lifecycle.enabled=true",
+        "lifecycle.watch_probes=1",
+        "lifecycle.watch_interval_s=0",
+        "lifecycle.shadow_wait_s=0.2",
+        "lifecycle.shadow_requests=1",
+        "serve.rollback_keep_s=900",
+        *extra,
+    ])
+
+
+class FakeEngine:
+    """Duck-typed swap surface for controller-policy tests: records
+    every lifecycle-visible action so assertions can pin what the
+    controller did (the REAL engine's swap/shadow/rollback is pinned
+    separately below and in tests/test_faults.py)."""
+
+    def __init__(self, registry=None, live_dirs=("live",)):
+        self.registry = registry if registry is not None else Registry()
+        self.quality = None
+        self._gen = type("G", (), {"member_dirs": list(live_dirs)})()
+        self.actions: list = []
+        self._shadow_active = False
+
+    def prepare_candidate(self, member_dirs=None, state=None, warm=False):
+        self.actions.append(("prepare", tuple(member_dirs or ()), warm))
+        return object()
+
+    def begin_shadow(self, candidate=None, fraction=0.25, **kw):
+        self._shadow_active = True
+        self.actions.append(("begin_shadow", fraction))
+        return {"fraction": fraction, "every": 1}
+
+    def shadow_report(self):
+        if not self._shadow_active:
+            return None
+        return {"requests": 5, "rows": 5, "errors": 0,
+                "max_abs_dev": 0.01, "mean_abs_dev": 0.005}
+
+    def end_shadow(self, promote=False):
+        self._shadow_active = False
+        self.actions.append(("end_shadow", promote))
+        out = {"requests": 5, "rows": 5, "errors": 0,
+               "max_abs_dev": 0.01, "mean_abs_dev": 0.005}
+        if promote:
+            out["reload"] = {"generation": 1, "n_members": 1}
+        return out
+
+    def reload(self, member_dirs=None, state=None):
+        self.actions.append(("reload", tuple(member_dirs or ())))
+        self._gen = type("G", (), {"member_dirs": list(member_dirs)})()
+        return {"generation": 1, "n_members": 1}
+
+    def rollback(self):
+        self.actions.append(("rollback",))
+        return {"generation": 2, "restored_from": 0, "n_members": 1}
+
+
+def _pass_gate(name="fake"):
+    return lambda ctl, cand: GateVerdict(name, True, 0.0, 1.0)
+
+
+def _fail_gate(name="fake"):
+    return lambda ctl, cand: GateVerdict(name, False, 9.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Journal: atomic append, resume, live pointer
+# ---------------------------------------------------------------------------
+
+
+def test_journal_atomic_append_and_resume(tmp_path):
+    d = str(tmp_path / "lc")
+    j = Journal(d)
+    assert j.state is None and j.cycle == -1 and not j.cycle_open()
+    j.append("DRIFT_DETECTED", cycle=0, reason="drift")
+    j.append("RETRAIN", cycle=0, member_dirs=["a", "b"])
+    # A .tmp leftover from a mid-write kill is inert.
+    open(os.path.join(d, "journal.json.tmp.999"), "w").write("{gar")
+    j2 = Journal(d)
+    assert j2.state == "RETRAIN" and j2.cycle_open()
+    assert j2.find("DRIFT_DETECTED")["reason"] == "drift"
+    assert [e["seq"] for e in j2.entries] == [0, 1]
+    # Terminal closes the cycle; the next append opens a new one.
+    j2.append("ROLLBACK", cycle=0, cause="test")
+    assert not j2.cycle_open()
+    j2.append("DRIFT_DETECTED", reason="again")
+    assert j2.cycle == 1 and len(j2.cycle_entries()) == 1
+    # A torn journal FILE refuses loudly instead of restarting a
+    # half-done rollout from scratch.
+    with open(os.path.join(d, "journal.json"), "w") as f:
+        f.write('{"format": "jama16.lifecycle", "version')
+    with pytest.raises(ValueError, match="unreadable"):
+        Journal(d)
+
+
+def test_journal_version_check_and_live_pointer(tmp_path):
+    d = str(tmp_path / "lc")
+    j = Journal(d)
+    assert j.read_live() is None
+    j.write_live(["/ckpt/m0", "/ckpt/m1"])
+    assert Journal(d).read_live() == ["/ckpt/m0", "/ckpt/m1"]
+    j.append("DRIFT_DETECTED", cycle=0)
+    with open(os.path.join(d, "journal.json")) as f:
+        doc = json.load(f)
+    doc["version"] = 99
+    with open(os.path.join(d, "journal.json"), "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="v99"):
+        Journal(d)
+
+
+def test_journal_refresh_picks_up_external_append(tmp_path):
+    d = str(tmp_path / "lc")
+    a, b = Journal(d), Journal(d)
+    a.append("DRIFT_DETECTED", cycle=0, reason="x")
+    assert b.state is None
+    b.refresh()
+    assert b.state == "DRIFT_DETECTED"
+
+
+# ---------------------------------------------------------------------------
+# Controller policy (seam-injected, off-device)
+# ---------------------------------------------------------------------------
+
+
+def test_state_machine_happy_path_commits(tmp_path):
+    reg = Registry()
+    eng = FakeEngine(reg)
+    retrains = []
+    ctl = LifecycleController(
+        _ctl_cfg(), str(tmp_path), engine=eng, registry=reg,
+        retrain_fn=lambda c, root: retrains.append(root) or ["cand"],
+        gate_fns=[_pass_gate()], live_member_dirs=["live"],
+        sleep=lambda s: None,
+    )
+    assert ctl.state == "IDLE" and ctl.step() is None
+    assert ctl.trigger(reason="quality_drift")
+    assert ctl.run() == "COMMIT"
+    states = [e["state"] for e in ctl.journal.cycle_entries()]
+    assert states == ["DRIFT_DETECTED", "RETRAIN", "GATE",
+                      "STAGED_ROLLOUT", "WATCH", "COMMIT"]
+    assert len(retrains) == 1
+    assert ctl.journal.read_live() == ["cand"]
+    snap = reg.snapshot()
+    assert snap["gauges"]["serve.lifecycle.state"] == \
+        float(len(states))  # COMMIT = index 6
+    assert snap["counters"]["lifecycle.transitions"] == len(states)
+    assert snap["counters"]["lifecycle.retrains"] == 1
+    assert snap["counters"]["lifecycle.commits"] == 1
+    assert snap["counters"]["lifecycle.rollbacks"] == 0
+    # The shadow ran and promoted through end_shadow(promote=True),
+    # over a candidate WARMED at gate time (a sampled live request
+    # must never eat a candidate compile).
+    assert ("end_shadow", True) in eng.actions
+    assert ("prepare", ("cand",), True) in eng.actions
+    # `lifecycle` records landed in the workdir JSONL for obs_report.
+    recs = read_jsonl(os.path.join(str(tmp_path), "metrics.jsonl"))
+    assert [r["state"] for r in recs if r["kind"] == "lifecycle"] == states
+
+
+def test_gate_failure_rolls_back_without_touching_the_engine(tmp_path):
+    reg = Registry()
+    eng = FakeEngine(reg)
+    ctl = LifecycleController(
+        _ctl_cfg(), str(tmp_path), engine=eng, registry=reg,
+        retrain_fn=lambda c, root: ["cand"],
+        gate_fns=[_pass_gate("a"), _fail_gate("b")],
+        live_member_dirs=["live"], sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    assert ctl.run() == "ROLLBACK"
+    gate = ctl.journal.find("GATE")
+    assert gate["passed"] is False
+    assert [v["name"] for v in gate["verdicts"]] == ["a", "b"]
+    rb = ctl.journal.find("ROLLBACK")
+    assert rb["cause"] == "gate_rejected" and rb["swapped"] is False
+    # Nothing was promoted: no swap action ever reached the engine and
+    # the live pointer never moved.
+    assert not any(a[0] in ("begin_shadow", "end_shadow", "reload",
+                            "rollback") for a in eng.actions)
+    assert ctl.journal.read_live() is None
+    assert reg.snapshot()["counters"]["lifecycle.gate_rejects"] == 1
+
+
+def test_injected_gate_fault_fails_closed(tmp_path):
+    """The lifecycle.gate chaos site: a gate that CANNOT run must not
+    ship the candidate — the exception becomes a failing gate_error
+    verdict and the cycle terminates in ROLLBACK, journal intact."""
+    faultinject.arm({"lifecycle.gate": {"kind": "error", "on_calls": [1],
+                                        "error": "RuntimeError"}})
+    ctl = LifecycleController(
+        _ctl_cfg(), str(tmp_path), registry=Registry(),
+        retrain_fn=lambda c, root: ["cand"], gate_fns=[_pass_gate()],
+        live_member_dirs=["live"], sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    assert ctl.run() == "ROLLBACK"
+    gate = ctl.journal.find("GATE")
+    assert gate["passed"] is False
+    assert gate["verdicts"][0]["name"] == "gate_error"
+    assert "RuntimeError" in gate["verdicts"][0]["detail"]
+    assert Journal(ctl.journal.dir).state == "ROLLBACK"
+
+
+def test_watch_regression_triggers_rollback_and_restores_pointer(tmp_path):
+    reg = Registry()
+    eng = FakeEngine(reg)
+    ctl = LifecycleController(
+        _ctl_cfg(), str(tmp_path), engine=eng, registry=reg,
+        retrain_fn=lambda c, root: ["cand"], gate_fns=[_pass_gate()],
+        live_member_dirs=["live"], sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    # Drive to WATCH, then inject the regression the default rule
+    # (quality.canary_ok < 1) watches for.
+    for _ in range(3):
+        ctl.step()
+    assert ctl.state == "STAGED_ROLLOUT"
+    assert ctl.journal.read_live() == ["cand"]
+    reg.gauge("quality.canary_ok").set(0.0)
+    assert ctl.run() == "ROLLBACK"
+    watch = ctl.journal.find("WATCH")
+    assert watch["healthy"] is False
+    assert watch["fired"] == ["quality.canary_ok<1"]
+    rb = ctl.journal.find("ROLLBACK")
+    assert rb["cause"] == "watch_regression" and rb["swapped"] is True
+    assert rb["restored_generation"] == 2
+    assert ("rollback",) in eng.actions
+    # The live pointer names the pre-cycle set again.
+    assert ctl.journal.read_live() == ["live"]
+    assert reg.snapshot()["counters"]["lifecycle.rollbacks"] == 1
+
+
+def test_trigger_refused_while_cycle_open_and_on_alert_filters(tmp_path):
+    ctl = LifecycleController(
+        _ctl_cfg(), str(tmp_path), registry=Registry(),
+        retrain_fn=lambda c, root: ["cand"], gate_fns=[_pass_gate()],
+        live_member_dirs=["live"], sleep=lambda s: None,
+    )
+    # Reasons outside lifecycle.trigger_reasons never open a cycle.
+    assert not ctl.on_alert({"reason": "slo_breach", "rule": "r"})
+    assert ctl.state == "IDLE"
+    assert ctl.on_alert({"reason": "quality_drift", "rule": "r",
+                         "value": 0.5, "threshold": 0.2})
+    drift = ctl.journal.find("DRIFT_DETECTED")
+    assert drift["rule"] == "r" and drift["value"] == 0.5
+    # One rollout at a time.
+    assert not ctl.trigger(reason="quality_drift")
+    assert not ctl.on_alert({"reason": "quality_drift", "rule": "r2"})
+    assert len(ctl.journal.entries) == 1
+
+
+def test_watch_rules_reject_rate_forms(tmp_path):
+    """rate() needs snapshot history the stateless WATCH probe does
+    not keep — a rule that could never fire must refuse at
+    construction, not read as vacuously healthy."""
+    cfg = override(_ctl_cfg(), [
+        "lifecycle.watch_rules=rate(serve.reload_rejected)>0",
+    ])
+    with pytest.raises(ValueError, match="rate\\(\\) needs"):
+        LifecycleController(cfg, str(tmp_path), registry=Registry(),
+                            live_member_dirs=["live"])
+    # Same loud refusal for the `for` latching clause: the stateless
+    # probe would silently turn it into fire-on-first-sample.
+    cfg2 = override(_ctl_cfg(), [
+        "lifecycle.watch_rules=quality.score_psi > 0.2 for 120",
+    ])
+    with pytest.raises(ValueError, match="'for N' clause"):
+        LifecycleController(cfg2, str(tmp_path), registry=Registry(),
+                            live_member_dirs=["live"])
+
+
+def test_rollback_without_engine_still_restores_live_pointer(tmp_path):
+    """A controller resumed WITHOUT an engine after a completed swap
+    must still rewrite the durable live pointer at ROLLBACK — the next
+    process builds its engine from that pointer, and it must not name
+    the regressed candidate."""
+    wd = str(tmp_path)
+    j = Journal(os.path.join(wd, "lifecycle"),
+                terminal_states=TERMINAL_STATES)
+    j.append("DRIFT_DETECTED", cycle=0, reason="quality_drift",
+             live_member_dirs=["old"])
+    j.append("RETRAIN", cycle=0, member_dirs=["cand"])
+    j.append("GATE", cycle=0, passed=True, verdicts=[])
+    j.append("STAGED_ROLLOUT", cycle=0, generation=1, shadow={},
+             canary_repinned=False)
+    j.append("WATCH", cycle=0, healthy=False, probes=1,
+             fired=["quality.canary_ok<1"], rules=[])
+    j.write_live(["cand"])
+    ctl = LifecycleController(_ctl_cfg(), wd, registry=Registry(),
+                              sleep=lambda s: None)
+    assert ctl.run() == "ROLLBACK"
+    rb = ctl.journal.find("ROLLBACK")
+    assert rb["swapped"] is True and rb["restored_generation"] is None
+    assert ctl.journal.read_live() == ["old"]
+
+
+def test_rollback_without_pinned_dirs_records_restored_provenance(
+        tmp_path):
+    """A cycle whose trigger pinned NO pre-cycle set (journal-only
+    trigger with no --ckpt) must still leave the live pointer naming
+    the model the engine rolled back TO, not the regressed candidate."""
+    wd = str(tmp_path)
+    j = Journal(os.path.join(wd, "lifecycle"),
+                terminal_states=TERMINAL_STATES)
+    j.append("DRIFT_DETECTED", cycle=0, reason="quality_drift",
+             live_member_dirs=None)
+    j.append("RETRAIN", cycle=0, member_dirs=["cand"])
+    j.append("GATE", cycle=0, passed=True, verdicts=[])
+    j.append("STAGED_ROLLOUT", cycle=0, generation=1, shadow={},
+             canary_repinned=False)
+    j.append("WATCH", cycle=0, healthy=False, probes=1,
+             fired=["quality.canary_ok<1"], rules=[])
+    j.write_live(["cand"])
+    eng = FakeEngine(Registry(), live_dirs=("restored",))
+    # ensure_live at construction must not "reconcile" to the
+    # regressed candidate mid-rollback — hand it the matching view.
+    eng._gen.member_dirs = ["cand"]
+    ctl = LifecycleController(_ctl_cfg(), wd, engine=eng,
+                              registry=eng.registry,
+                              sleep=lambda s: None)
+    eng._gen.member_dirs = ["restored"]  # what rollback() re-swaps to
+    assert ctl.run() == "ROLLBACK"
+    assert ("rollback",) in eng.actions
+    assert ctl.journal.read_live() == ["restored"]
+
+
+def test_reload_releases_superseded_retained_generation(smoke_ckpt):
+    """A new rollout supersedes the old rollback target: the retained
+    generation is released BEFORE the candidate builds (peak residency
+    during any reload stays at the documented ~2x, never 3x), and the
+    newly outgoing generation takes its place."""
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=Registry())
+    engine.reload(dirs_b)
+    assert engine._prev_gen is not None and engine._prev_gen.gen_id == 0
+    engine.reload(dirs_a)
+    # gen0's retained handle was dropped before the build; gen1 is the
+    # rollback target now.
+    assert engine._prev_gen is not None
+    assert engine._prev_gen.gen_id == 1
+    info = engine.rollback()
+    assert info["restored_from"] == 1
+
+
+def test_multi_head_canary_convention_matches_engine(tmp_path):
+    """The lifecycle's canary scoring/re-pin must use the ENGINE'S
+    convention — raw ensemble output raveled ([n*C] for the multi
+    head), not referable-collapsed [n]: a shape mismatch would reject
+    every multi-head cycle at GATE and fail every promote's reload
+    gate."""
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={SIZE}", "model.head=multi",
+    ])
+    model = models.build(cfg.model)
+    state = train_lib.stack_states([
+        train_lib.create_state(cfg, model, jax.random.key(0))[0]
+    ])
+    from jama16_retina_tpu.eval import metrics as metrics_lib
+
+    canary_imgs = np.random.default_rng(19).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    scfg = _serve_cfg(cfg)
+    probe = ServingEngine(scfg, state=state, model=model,
+                          registry=Registry())
+    pinned = np.asarray(metrics_lib.ensemble_average(
+        list(probe.member_probs(canary_imgs))
+    ), np.float64).ravel()
+    assert pinned.shape == (4 * 5,)  # the raw multi-head convention
+    canary_path = quality_lib.save_canary(
+        str(tmp_path / "canary"), canary_imgs, scores=pinned
+    )
+    ecfg = override(scfg.replace(obs=dataclasses.replace(
+        scfg.obs, quality=dataclasses.replace(
+            scfg.obs.quality, enabled=True, canary_path=canary_path,
+            canary_every_s=0.0),
+    )), ["lifecycle.enabled=true"])
+    reg = Registry()
+    engine = ServingEngine(ecfg, state=state, model=model, registry=reg)
+    ctl = LifecycleController(ecfg, str(tmp_path / "wd"), engine=engine,
+                              registry=reg, sleep=lambda s: None)
+    from jama16_retina_tpu.lifecycle import controller as ctl_lib
+
+    cand = engine.prepare_candidate(state=state)
+    # Same weights => exact match in the shared convention.
+    v = ctl_lib.gate_golden_canary(ctl, cand)
+    assert not v.skipped and v.passed and v.value == 0.0
+    # And a re-pin writes the shape the reload gate/cadence runs read.
+    assert ctl._repin_canary(cand) is True
+    assert engine.quality.canary.reference.shape == pinned.shape
+    np.testing.assert_array_equal(engine.quality.canary.reference,
+                                  pinned)
+
+
+def test_end_shadow_claims_session_exactly_once(smoke_ckpt):
+    """Two racing end_shadow callers must resolve to exactly one
+    winner (the claim happens under the reload lock) — a double
+    promote would mint two generations from one rollout."""
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=Registry())
+    engine.begin_shadow(dirs_b, fraction=1.0)
+    outs = []
+    threads = [
+        threading.Thread(target=lambda: outs.append(engine.end_shadow()))
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(o is not None for o in outs) == 1
+
+
+def test_engineless_rollback_restores_canary_artifact(tmp_path):
+    """A resumed controller WITHOUT an engine must still restore the
+    on-disk canary artifact at ROLLBACK — the next serving process
+    loads that reference, and the candidate's scores left pinned there
+    would false-alert against the restored model forever."""
+    canary_imgs = np.random.default_rng(23).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    old_ref = np.linspace(0.1, 0.4, 4)
+    cand_ref = old_ref + 0.3
+    canary_path = quality_lib.save_canary(
+        str(tmp_path / "canary"), canary_imgs, scores=cand_ref
+    )  # what a completed promote left behind
+    wd = str(tmp_path / "wd")
+    cfg = override(_ctl_cfg(), [
+        "obs.quality.enabled=true",
+        f"obs.quality.canary_path={canary_path}",
+    ])
+    j = Journal(os.path.join(wd, "lifecycle"),
+                terminal_states=TERMINAL_STATES)
+    j.append("DRIFT_DETECTED", cycle=0, reason="quality_drift",
+             live_member_dirs=["old"])
+    j.append("RETRAIN", cycle=0, member_dirs=["cand"])
+    j.append("GATE", cycle=0, passed=True, verdicts=[])
+    j.append("STAGED_ROLLOUT", cycle=0, generation=1, shadow={},
+             canary_repinned=True)
+    j.append("WATCH", cycle=0, healthy=False, probes=1, fired=["r"],
+             rules=[])
+    os.makedirs(os.path.join(wd, "lifecycle"), exist_ok=True)
+    quality_lib.save_canary(
+        os.path.join(wd, "lifecycle", "canary-pre-0000"),
+        canary_imgs, scores=old_ref,
+    )  # the backup the promote wrote
+    ctl = LifecycleController(cfg, wd, registry=Registry(),
+                              sleep=lambda s: None)
+    assert ctl.run() == "ROLLBACK"
+    _, restored = quality_lib.load_canary_file(canary_path)
+    np.testing.assert_array_equal(restored, old_ref)
+
+
+def test_commit_releases_retained_generation(tmp_path, smoke_ckpt):
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    lcfg = override(_serve_cfg(cfg), [
+        "lifecycle.enabled=true", "lifecycle.watch_probes=1",
+        "lifecycle.watch_interval_s=0", "lifecycle.shadow_wait_s=0",
+        "lifecycle.shadow_requests=1",
+        "lifecycle.gate_canary_max_dev=0.5",
+    ])
+    reg = Registry()
+    engine = ServingEngine(lcfg, dirs_a, model=model, registry=reg)
+    ctl = LifecycleController(
+        lcfg, str(tmp_path), engine=engine, registry=reg,
+        retrain_fn=lambda c, root: dirs_b, live_member_dirs=dirs_a,
+        sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    assert ctl.run() == "COMMIT"
+    # The healthy rollout released the outgoing generation's residency.
+    assert engine._prev_gen is None
+    with pytest.raises(RollbackUnavailable):
+        engine.rollback()
+
+
+def test_disabled_lifecycle_ignores_alerts(tmp_path):
+    cfg = override(_ctl_cfg(), ["lifecycle.enabled=false"])
+    ctl = LifecycleController(
+        cfg, str(tmp_path), registry=Registry(),
+        retrain_fn=lambda c, root: ["cand"], gate_fns=[_pass_gate()],
+        live_member_dirs=["live"],
+    )
+    assert not ctl.on_alert({"reason": "quality_drift", "rule": "r"})
+    assert ctl.state == "IDLE"
+
+
+def test_kill_at_every_state_resumes_to_same_terminal(tmp_path):
+    """THE crash-safety acceptance (seam level): abandon the controller
+    after every journaled state — exactly what kill -9 leaves behind,
+    since the journal is the only durable state and each append is
+    atomic — and a fresh controller over the same journal converges to
+    the same terminal sequence with the expensive side effect (retrain)
+    executed exactly once across all incarnations."""
+    def build(wd, retrains, reg=None):
+        eng = FakeEngine(reg if reg is not None else Registry())
+        return LifecycleController(
+            _ctl_cfg(), wd, engine=eng, registry=eng.registry,
+            retrain_fn=lambda c, root: retrains.append(root) or ["cand"],
+            gate_fns=[_pass_gate()], live_member_dirs=["live"],
+            sleep=lambda s: None,
+        )
+
+    # Reference: uninterrupted run.
+    ref_retrains: list = []
+    ref = build(str(tmp_path / "ref"), ref_retrains)
+    ref.trigger(reason="quality_drift")
+    assert ref.run() == "COMMIT"
+    ref_states = [e["state"] for e in ref.journal.cycle_entries()]
+
+    for k in range(1, len(ref_states)):
+        wd = str(tmp_path / f"kill_at_{k}")
+        retrains: list = []
+        ctl = build(wd, retrains)
+        ctl.trigger(reason="quality_drift")
+        for _ in range(k - 1):
+            ctl.step()
+        assert [e["state"] for e in ctl.journal.cycle_entries()] == \
+            ref_states[:k]
+        del ctl  # kill -9: no cleanup code runs, only the journal survives
+        resumed = build(wd, retrains)
+        assert resumed.run() == "COMMIT"
+        assert [e["state"] for e in resumed.journal.cycle_entries()] == \
+            ref_states
+        # The retrain side effect ran exactly once in total: in the
+        # first incarnation iff it reached RETRAIN, else in the second.
+        assert len(retrains) == 1
+        assert resumed.journal.read_live() == ["cand"]
+
+
+def test_kill9_subprocess_resumes(tmp_path):
+    """The literal form: a child process SIGKILLs itself mid-cycle
+    (inside its gate evaluation, after RETRAIN was journaled); the
+    parent resumes the SAME on-disk journal to COMMIT without re-
+    running the retrain."""
+    wd = str(tmp_path / "wd")
+    marker = str(tmp_path / "retrain_ran")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    driver = f"""
+import os, signal, sys
+sys.path.insert(0, {json.dumps(repo)})
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.lifecycle import LifecycleController
+
+cfg = override(get_config("smoke"), [
+    "lifecycle.enabled=true", "lifecycle.watch_probes=1",
+    "lifecycle.watch_interval_s=0",
+])
+
+def retrain(ctl, root):
+    open({json.dumps(marker)}, "a").write("ran\\n")
+    return ["cand"]
+
+def kill_gate(ctl, cand):
+    os.kill(os.getpid(), signal.SIGKILL)
+
+ctl = LifecycleController(cfg, {json.dumps(wd)}, retrain_fn=retrain,
+                          gate_fns=[kill_gate], live_member_dirs=["live"],
+                          sleep=lambda s: None)
+ctl.trigger(reason="quality_drift")
+ctl.run()
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", driver], env=env,
+                          capture_output=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+    j = Journal(os.path.join(wd, "lifecycle"))
+    assert j.state == "RETRAIN"  # durable exactly up to the kill point
+    with open(marker) as f:
+        assert f.read() == "ran\n"
+    # Resume in-process: no second retrain, terminal COMMIT.
+    eng = FakeEngine(Registry())
+    resumed = LifecycleController(
+        _ctl_cfg(), wd, engine=eng, registry=eng.registry,
+        retrain_fn=lambda c, root: (_ for _ in ()).throw(
+            AssertionError("retrain repeated after resume")),
+        gate_fns=[_pass_gate()], live_member_dirs=["live"],
+        sleep=lambda s: None,
+    )
+    assert resumed.run() == "COMMIT"
+    with open(marker) as f:
+        assert f.read() == "ran\n"
+
+
+def test_step_error_holds_journal_position_and_counts(tmp_path):
+    reg = Registry()
+    faultinject.arm({"lifecycle.retrain": {"kind": "error",
+                                           "on_calls": [1],
+                                           "error": "RuntimeError"}})
+    ctl = LifecycleController(
+        _ctl_cfg(), str(tmp_path), registry=reg,
+        retrain_fn=lambda c, root: ["cand"], gate_fns=[_fail_gate()],
+        live_member_dirs=["live"], sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    with pytest.raises(RuntimeError):
+        ctl.step()
+    assert ctl.state == "DRIFT_DETECTED"  # journal unadvanced
+    assert reg.snapshot()["counters"]["lifecycle.step_errors"] == 1
+    ctl.step()  # the transient fault cleared: retries exactly this step
+    assert ctl.state == "RETRAIN"
+
+
+# ---------------------------------------------------------------------------
+# AlertManager on_fire seam
+# ---------------------------------------------------------------------------
+
+
+def test_on_fire_fires_once_per_transition_never_while_latched():
+    reg = Registry()
+    g = reg.gauge("quality.score_psi")
+    fired = []
+    mgr = obs_alerts.AlertManager(
+        [obs_alerts.AlertRule("quality.score_psi", ">", 0.2,
+                              reason="quality_drift")],
+        registry=reg, on_fire=fired.append,
+    )
+    g.set(0.5)
+    mgr.evaluate(now=0.0)
+    assert len(fired) == 1
+    assert fired[0]["reason"] == "quality_drift"
+    assert fired[0]["rule"] == "quality.score_psi>0.2"
+    # Latched: still firing, no re-invocation.
+    mgr.evaluate(now=1.0)
+    mgr.evaluate(now=2.0)
+    assert len(fired) == 1
+    # Resolve, then a NEW transition fires again.
+    g.set(0.0)
+    mgr.evaluate(now=3.0)
+    g.set(0.5)
+    mgr.evaluate(now=4.0)
+    assert len(fired) == 2
+
+
+def test_on_fire_exception_counted_not_raised():
+    reg = Registry()
+    g = reg.gauge("quality.score_psi")
+
+    def boom(info):
+        raise RuntimeError("handler broken")
+
+    mgr = obs_alerts.AlertManager(
+        [obs_alerts.AlertRule("quality.score_psi", ">", 0.2)],
+        registry=reg, on_fire=boom,
+    )
+    g.set(0.5)
+    firing = mgr.evaluate(now=0.0)  # must not raise
+    assert len(firing) == 1  # the rule still latched and reported
+    assert reg.counter("obs.alert_callback_errors").value == 1
+    assert reg.counter("obs.alerts_fired").value == 1
+
+
+def test_manager_for_threads_on_fire_through(tmp_path):
+    cfg = override(get_config("smoke"), ["obs.quality.enabled=true"])
+    fired = []
+    cb = fired.append
+    mgr = obs_alerts.manager_for(
+        cfg, str(tmp_path), registry=Registry(), on_fire=cb,
+    )
+    assert mgr is not None and mgr.on_fire is cb
+
+
+def test_rule_holds_is_stateless():
+    rule = obs_alerts.parse_rule("quality.canary_ok < 1")
+    assert not obs_alerts.rule_holds(rule, {"gauges": {}})  # no data
+    assert obs_alerts.rule_holds(
+        rule, {"gauges": {"quality.canary_ok": 0.0}})
+    assert not obs_alerts.rule_holds(
+        rule, {"gauges": {"quality.canary_ok": 1.0}})
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine: retained-generation rollback + shadow seam (real engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke_ckpt(tmp_path_factory):
+    cfg = override(get_config("smoke"), [f"model.image_size={SIZE}"])
+    model = models.build(cfg.model)
+    root = tmp_path_factory.mktemp("ckpt")
+    sets = {}
+    for tag, base in (("a", 0), ("b", 100)):
+        dirs = []
+        for m in range(2):
+            state, _ = train_lib.create_state(
+                cfg, model, jax.random.key(base + m)
+            )
+            d = str(root / f"{tag}_member_{m:02d}")
+            ck = ckpt_lib.Checkpointer(d)
+            ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+            ck.wait()
+            ck.close()
+            dirs.append(d)
+        sets[tag] = dirs
+    return cfg, model, sets["a"], sets["b"]
+
+
+def _serve_cfg(cfg, extra=()):
+    scfg = cfg.replace(serve=ServeConfig(
+        max_batch=4, max_wait_ms=5.0, bucket_sizes=(4,),
+        rollback_keep_s=900.0,
+    ))
+    return override(scfg, list(extra)) if extra else scfg
+
+
+def test_engine_rollback_instant_after_swap(smoke_ckpt):
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    reg = Registry()
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=reg)
+    imgs = np.random.default_rng(3).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    ref_a = engine.probs(imgs)
+    with pytest.raises(RollbackUnavailable, match="never swapped"):
+        engine.rollback()
+    engine.reload(dirs_b)
+    ref_b = engine.probs(imgs)
+    assert not np.array_equal(ref_a, ref_b)
+    info = engine.rollback()
+    assert info["restored_from"] == 0 and info["generation"] == 2
+    np.testing.assert_array_equal(engine.probs(imgs), ref_a)
+    assert engine.generation == 2
+    assert reg.counter("serve.rollbacks").value == 1
+    # One rollback per swap: the retained handle was consumed.
+    with pytest.raises(RollbackUnavailable):
+        engine.rollback()
+
+
+def test_engine_rollback_expiry_honors_keep_window(smoke_ckpt):
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    scfg = _serve_cfg(cfg, ("serve.rollback_keep_s=0.0",))
+    engine = ServingEngine(scfg, dirs_a, model=model, registry=Registry())
+    engine.reload(dirs_b)
+    # keep_s=0 disables retention entirely: nothing to re-swap.
+    with pytest.raises(RollbackUnavailable):
+        engine.rollback()
+
+
+def test_shadow_samples_deterministic_fraction(smoke_ckpt):
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    reg = Registry()
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=reg)
+    imgs = np.random.default_rng(5).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    ref_a = engine.probs(imgs)
+    engine.begin_shadow(dirs_b, fraction=0.5)
+    with pytest.raises(RuntimeError, match="already active"):
+        engine.begin_shadow(dirs_b, fraction=0.5)
+    for _ in range(4):
+        # Live responses stay generation-0 exact while shadowed.
+        np.testing.assert_array_equal(engine.probs(imgs), ref_a)
+    rep = engine.shadow_report()
+    assert rep["requests"] == 2  # every-2nd of 4 requests, no RNG
+    assert rep["rows"] == 8 and rep["errors"] == 0
+    assert rep["max_abs_dev"] > 0  # different weights really scored
+    assert reg.counter("serve.shadow.requests").value == 2
+    # end without promote: nothing swapped.
+    out = engine.end_shadow()
+    assert out["requests"] == 2 and "reload" not in out
+    assert engine.generation == 0 and engine.shadow_report() is None
+
+
+def test_shadow_promote_via_reload_retains_rollback(smoke_ckpt):
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    reg = Registry()
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=reg)
+    imgs = np.random.default_rng(6).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    ref_a = engine.probs(imgs)
+    engine.begin_shadow(dirs_b, fraction=1.0)
+    engine.probs(imgs)
+    out = engine.end_shadow(promote=True)
+    assert out["reload"]["generation"] == 1
+    assert engine.generation == 1
+    ref_b = engine.probs(imgs)
+    assert not np.array_equal(ref_a, ref_b)
+    assert reg.counter("serve.reloads").value == 1
+    # The promote went through the full reload path: the outgoing
+    # generation was retained, so the rollback seam works immediately.
+    engine.rollback()
+    np.testing.assert_array_equal(engine.probs(imgs), ref_a)
+
+
+def test_shadow_error_counted_never_fails_live_request(smoke_ckpt):
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    reg = Registry()
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=reg)
+    imgs = np.random.default_rng(8).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    ref_a = engine.probs(imgs)
+    engine.begin_shadow(dirs_b, fraction=1.0)
+    # The shadowed request is one live dispatch (armed call 1) plus
+    # one shadow dispatch (armed call 2): fail exactly the shadow's.
+    faultinject.arm({"engine.dispatch": {"kind": "error",
+                                         "on_calls": [2],
+                                         "error": "RuntimeError"}})
+    np.testing.assert_array_equal(engine.probs(imgs), ref_a)
+    faultinject.disarm()
+    rep = engine.shadow_report()
+    assert rep["errors"] == 1 and rep["requests"] == 0
+    assert reg.counter("serve.shadow.errors").value == 1
+
+
+# ---------------------------------------------------------------------------
+# Warm-start trainer entry
+# ---------------------------------------------------------------------------
+
+
+def _fit_cfg(extra=()):
+    return override(get_config("smoke"), [
+        f"model.image_size={SIZE}",
+        "train.steps=6", "train.eval_every=3", "train.log_every=2",
+        "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+        "obs.flush_every_s=0", *extra,
+    ])
+
+
+@pytest.fixture(scope="module")
+def fit_data(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("fit_data"))
+    tfrecord.write_synthetic_split(d, "train", 32, SIZE, 2, seed=1)
+    tfrecord.write_synthetic_split(d, "val", 8, SIZE, 1, seed=2)
+    return d
+
+
+@pytest.fixture(scope="module")
+def donor_run(fit_data, tmp_path_factory):
+    wd = str(tmp_path_factory.mktemp("donor"))
+    trainer.fit(_fit_cfg(), fit_data, wd, seed=0)
+    return wd
+
+
+def test_warm_start_transplants_donor_weights(fit_data, donor_run,
+                                              tmp_path):
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    cfg = _fit_cfg((f"train.init_from={donor_run}",))
+    wd = str(tmp_path / "warm")
+    trainer.fit(cfg, fit_data, wd, seed=5)
+    recs = read_jsonl(os.path.join(wd, "metrics.jsonl"))
+    ws = [r for r in recs if r["kind"] == "warm_start"]
+    assert len(ws) == 1 and ws[0]["init_from"] == donor_run
+    # The transplant itself: donor best params == the warm state's
+    # step-0 params, step counter and optimizer fresh.
+    model = models.build(cfg.model)
+    mesh = mesh_lib.make_mesh(0)
+    donor = trainer.restore_for_eval(cfg, model, donor_run)
+    fresh, _ = train_lib.create_state(cfg, model, jax.random.key(5))
+    warm = trainer._warm_start_state(cfg, model, fresh, mesh)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(donor.params)),
+        jax.tree_util.tree_leaves(jax.device_get(warm.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+    assert int(jax.device_get(warm.step)) == 0
+
+
+def test_warm_start_resume_wins_over_init_from(fit_data, donor_run,
+                                               tmp_path, monkeypatch):
+    """A resumed run continues ITSELF: init_from only seeds step 0."""
+    cfg = _fit_cfg((f"train.init_from={donor_run}",
+                    "train.resume=true"))
+    wd = str(tmp_path / "resumed")
+    trainer.fit(cfg, fit_data, wd, seed=7)  # fresh workdir: warm start
+    recs = read_jsonl(os.path.join(wd, "metrics.jsonl"))
+    assert [r["kind"] for r in recs].count("warm_start") == 1
+    # Second run resumes at steps-complete; NO second warm_start.
+    trainer.fit(cfg, fit_data, wd, seed=7)
+    recs = read_jsonl(os.path.join(wd, "metrics.jsonl"))
+    assert [r["kind"] for r in recs].count("warm_start") == 1
+
+
+def test_warm_start_refused_off_the_flax_fit_path(fit_data, tmp_path):
+    cfg = _fit_cfg(("train.init_from=/nope", "train.ensemble_size=2",
+                    "train.ensemble_parallel=true",
+                    "train.ensemble_parallel_force=true"))
+    with pytest.raises(ValueError, match="init_from"):
+        trainer.fit_ensemble_parallel(cfg, fit_data, str(tmp_path / "p"))
+    cfg_tf = _fit_cfg(("train.init_from=/nope",))
+    with pytest.raises(ValueError, match="init_from"):
+        trainer.fit_tf(cfg_tf, fit_data, str(tmp_path / "tf"))
+
+
+def test_default_retrain_is_idempotent(fit_data, donor_run, tmp_path,
+                                       monkeypatch):
+    """The RETRAIN phase's resume contract: a durable candidate (its
+    marker written after fit returned) is never retrained again."""
+    from jama16_retina_tpu.lifecycle import controller as ctl_lib
+
+    cfg = _ctl_cfg(("lifecycle.retrain_steps=2", "train.log_every=2",
+                    "train.eval_every=2", "data.batch_size=8",
+                    "data.augment=false", "eval.batch_size=8",
+                    "obs.flush_every_s=0"))
+    ctl = LifecycleController(
+        cfg, str(tmp_path), registry=Registry(), data_dir=fit_data,
+        live_member_dirs=[donor_run], gate_fns=[_pass_gate()],
+        sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    calls = {"n": 0}
+    real_fit = trainer.fit
+
+    def counting_fit(*a, **kw):
+        calls["n"] += 1
+        return real_fit(*a, **kw)
+
+    monkeypatch.setattr(trainer, "fit", counting_fit)
+    root = ctl._candidate_root()
+    dirs1 = ctl_lib._default_retrain(ctl, root)
+    assert calls["n"] == 1
+    assert os.path.exists(os.path.join(dirs1[0], "RETRAIN_DONE.json"))
+    # Warm start really flowed through: the candidate's run log says so.
+    recs = read_jsonl(os.path.join(dirs1[0], "metrics.jsonl"))
+    ws = [r for r in recs if r["kind"] == "warm_start"]
+    assert len(ws) == 1 and ws[0]["init_from"] == donor_run
+    # Re-run (the resumed controller's path): marker short-circuits.
+    dirs2 = ctl_lib._default_retrain(ctl, root)
+    assert dirs2 == dirs1 and calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end chaos drive (the ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_drift_alert_gate_reject_promote_and_auto_rollback(
+        smoke_ckpt, tmp_path):
+    """Synthetic drift fires the alert -> the on_fire trigger opens a
+    cycle -> a deliberately-degraded candidate is REJECTED at GATE
+    while live traffic never drops a request -> a good candidate
+    promotes through shadow + reload -> an injected post-swap
+    regression trips the WATCH rules -> automatic ROLLBACK restores
+    the original generation bit-exactly."""
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    rng = np.random.default_rng(11)
+    canary_imgs = rng.integers(0, 256, (4, SIZE, SIZE, 3), np.uint8)
+
+    # Pin the canary to checkpoint set A (the live model).
+    probe = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                          registry=Registry())
+    from jama16_retina_tpu.eval import metrics as metrics_lib
+
+    pinned = metrics_lib.ensemble_average(
+        list(probe.member_probs(canary_imgs))
+    )
+    canary_path = quality_lib.save_canary(
+        str(tmp_path / "canary"), canary_imgs, scores=pinned
+    )
+    qcfg_kw = dict(enabled=True, canary_path=canary_path,
+                   canary_every_s=0.0)
+    base = _serve_cfg(cfg)
+    ecfg = base.replace(obs=dataclasses.replace(
+        base.obs, quality=dataclasses.replace(
+            base.obs.quality, **qcfg_kw),
+    ))
+    # Cycle 1: a DEGRADED candidate must fail the canary gate (its
+    # golden-set scores deviate beyond the tight bound).
+    c1 = override(ecfg, [
+        "lifecycle.enabled=true", "lifecycle.watch_probes=1",
+        "lifecycle.watch_interval_s=0", "lifecycle.shadow_wait_s=2.0",
+        "lifecycle.shadow_requests=2", "lifecycle.shadow_fraction=1",
+        "lifecycle.gate_canary_max_dev=0.000001",
+    ])
+    reg = Registry()
+    engine = ServingEngine(c1, dirs_a, model=model, registry=reg)
+    wd = str(tmp_path / "wd")
+    ctl = LifecycleController(
+        c1, wd, engine=engine, registry=reg,
+        retrain_fn=lambda c, root: dirs_b,  # degraded: foreign weights
+        live_member_dirs=dirs_a, sleep=lambda s: None,
+    )
+
+    # The trigger seam: a drifted score window -> PSI gauge -> alert
+    # rule fires -> on_fire opens the cycle. (The score stream is
+    # synthetic; the seam under test is alert -> action.)
+    profile = quality_lib.build_profile(
+        rng.uniform(0.4, 0.6, 2048), bins=c1.obs.quality.score_bins
+    )
+    monitor = quality_lib.QualityMonitor(
+        dataclasses.replace(c1.obs.quality, window_scores=256),
+        registry=reg, profile=profile,
+    )
+    mgr = obs_alerts.AlertManager(
+        obs_alerts.quality_rules(c1.obs.quality),
+        registry=reg, on_fire=ctl.on_alert,
+    )
+    mgr.evaluate(now=0.0)
+    assert ctl.state == "IDLE"
+    monitor.observe(None, rng.uniform(0.85, 0.99, 256))  # drifted window
+    firing = mgr.evaluate(now=1.0)
+    assert any(f["reason"] == "quality_drift" for f in firing)
+    assert ctl.state == "DRIFT_DETECTED"
+
+    # Live traffic storms THROUGH both cycles; zero dropped requests.
+    imgs = rng.integers(0, 256, (4, SIZE, SIZE, 3), np.uint8)
+    ref_a = engine.probs(imgs)
+    failures: list = []
+    results: list = []
+    stop = threading.Event()
+
+    def storm():
+        while not stop.is_set():
+            try:
+                results.append(engine.probs_with_generation(imgs))
+            except Exception as e:  # noqa: BLE001 - zero-drop assert
+                failures.append(e)
+
+    threads = [threading.Thread(target=storm) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        # Cycle 1: REJECTED at GATE; the live model keeps serving.
+        assert ctl.run() == "ROLLBACK"
+        gate = ctl.journal.find("GATE")
+        assert gate["passed"] is False
+        verdict = {v["name"]: v for v in gate["verdicts"]}
+        assert verdict["golden_canary"]["passed"] is False
+        rb = ctl.journal.find("ROLLBACK")
+        assert rb["cause"] == "gate_rejected" and rb["swapped"] is False
+        assert engine.generation == 0
+        np.testing.assert_array_equal(engine.probs(imgs), ref_a)
+
+        # Cycle 2: the same candidate under the operator-tuned loose
+        # bound is a GOOD candidate — promotes through shadow+reload.
+        c2 = override(c1, ["lifecycle.gate_canary_max_dev=0.5"])
+        ctl2 = LifecycleController(
+            c2, wd, engine=engine, registry=reg,
+            retrain_fn=lambda c, root: dirs_b,
+            live_member_dirs=dirs_a, sleep=lambda s: None,
+        )
+        assert ctl2.trigger(reason="quality_drift")
+        for _ in range(3):
+            ctl2.step()
+        assert ctl2.state == "STAGED_ROLLOUT"
+        rollout = ctl2.journal.find("STAGED_ROLLOUT")
+        assert rollout["shadow"]["requests"] >= 2  # real live traffic
+        assert rollout["canary_repinned"] is True
+        assert engine.generation == 1
+        assert ctl2.journal.read_live() == dirs_b
+
+        # Injected post-swap regression: perturb the pinned reference
+        # so the LIVE canary run (WATCH refreshes it per probe — a
+        # stale latched gauge must not be the evidence) genuinely
+        # deviates, exactly the shape of a silent serving regression.
+        engine.quality.canary.reference = (
+            engine.quality.canary.reference + 0.25
+        )
+        assert ctl2.run() == "ROLLBACK"
+        rb2 = ctl2.journal.find("ROLLBACK")
+        assert rb2["cause"] == "watch_regression" and rb2["swapped"]
+        assert ctl2.journal.read_live() == dirs_a
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+    assert not failures, failures
+    assert results
+    # Rollback restored checkpoint set A bit-exactly, on a NEW gen id.
+    np.testing.assert_array_equal(engine.probs(imgs), ref_a)
+    assert engine.generation == 2
+    # Canary custody: the reference is set A's pinned scores again.
+    np.testing.assert_array_equal(
+        engine.quality.canary.reference,
+        np.asarray(pinned, np.float64).ravel(),
+    )
+    # Every stormed response was attributable and bit-exact for its gen.
+    engine_b = ServingEngine(_serve_cfg(cfg), dirs_b, model=model,
+                             registry=Registry())
+    ref_b = engine_b.probs(imgs)
+    for out, gen in results:
+        expect = ref_b if gen == 1 else ref_a
+        np.testing.assert_array_equal(out, expect)
+
+
+def test_failed_promote_restores_canary_reference(smoke_ckpt, tmp_path,
+                                                  monkeypatch):
+    """The swap failing AFTER the canary was re-pinned to the
+    candidate must put the OLD pinned scores back — otherwise every
+    cadence canary run until the retry fires false quality_drift
+    alerts against the wrong reference — and the retry (fault cleared)
+    must still promote cleanly."""
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    rng = np.random.default_rng(17)
+    canary_imgs = rng.integers(0, 256, (4, SIZE, SIZE, 3), np.uint8)
+    probe = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                          registry=Registry())
+    from jama16_retina_tpu.eval import metrics as metrics_lib
+
+    pinned = np.asarray(metrics_lib.ensemble_average(
+        list(probe.member_probs(canary_imgs))
+    ), np.float64).ravel()
+    canary_path = quality_lib.save_canary(
+        str(tmp_path / "canary"), canary_imgs, scores=pinned
+    )
+    base = _serve_cfg(cfg)
+    ecfg = override(base.replace(obs=dataclasses.replace(
+        base.obs, quality=dataclasses.replace(
+            base.obs.quality, enabled=True, canary_path=canary_path,
+            canary_every_s=0.0),
+    )), [
+        "lifecycle.enabled=true", "lifecycle.watch_probes=1",
+        "lifecycle.watch_interval_s=0", "lifecycle.shadow_wait_s=0",
+        "lifecycle.gate_canary_max_dev=0.5",
+    ])
+    reg = Registry()
+    engine = ServingEngine(ecfg, dirs_a, model=model, registry=reg)
+    ctl = LifecycleController(
+        ecfg, str(tmp_path / "wd"), engine=engine, registry=reg,
+        retrain_fn=lambda c, root: dirs_b, live_member_dirs=dirs_a,
+        sleep=lambda s: None,
+    )
+    ctl.trigger(reason="quality_drift")
+    ctl.step()  # RETRAIN
+    ctl.step()  # GATE (passes under the loose bound)
+    real_reload = engine.reload
+
+    def broken_reload(*a, **kw):
+        raise RuntimeError("transient swap failure")
+
+    monkeypatch.setattr(engine, "reload", broken_reload)
+    with pytest.raises(RuntimeError, match="transient swap"):
+        ctl.step()
+    # Journal held at GATE, and the canary reference is set A's again.
+    assert ctl.state == "GATE" and engine.generation == 0
+    np.testing.assert_array_equal(engine.quality.canary.reference,
+                                  pinned)
+    # Retry with the fault cleared: promotes, reference re-pinned to
+    # the candidate (which the reload gate then accepted).
+    monkeypatch.setattr(engine, "reload", real_reload)
+    ctl.step()
+    assert ctl.state == "STAGED_ROLLOUT" and engine.generation == 1
+    assert not np.array_equal(engine.quality.canary.reference, pinned)
+
+
+def test_resumed_controller_reconciles_engine_to_live_pointer(
+        smoke_ckpt, tmp_path):
+    """Kill -9 after the promote: a restarted serving process comes up
+    on the OLD checkpoint set, and the resuming controller's
+    ensure_live() reload makes the journal's promoted set live again
+    before the cycle continues."""
+    cfg, model, dirs_a, dirs_b = smoke_ckpt
+    wd = str(tmp_path / "wd")
+    j = Journal(os.path.join(wd, "lifecycle"),
+                terminal_states=TERMINAL_STATES)
+    j.append("DRIFT_DETECTED", cycle=0, reason="quality_drift",
+             live_member_dirs=dirs_a)
+    j.append("RETRAIN", cycle=0, member_dirs=dirs_b)
+    j.append("GATE", cycle=0, passed=True, verdicts=[])
+    j.append("STAGED_ROLLOUT", cycle=0, generation=1,
+             shadow={"requests": 1}, canary_repinned=False)
+    j.write_live(dirs_b)
+
+    reg = Registry()
+    engine = ServingEngine(_serve_cfg(cfg), dirs_a, model=model,
+                           registry=reg)  # the restarted process: old set
+    imgs = np.random.default_rng(13).integers(
+        0, 256, (4, SIZE, SIZE, 3), np.uint8
+    )
+    ref_a = engine.probs(imgs)
+    lcfg = override(_serve_cfg(cfg), [
+        "lifecycle.enabled=true", "lifecycle.watch_probes=1",
+        "lifecycle.watch_interval_s=0",
+    ])
+    ctl = LifecycleController(
+        lcfg, wd, engine=engine, registry=reg,
+        live_member_dirs=dirs_a, sleep=lambda s: None,
+    )
+    # Construction reconciled: the promoted set serves again.
+    assert engine.generation == 1
+    assert not np.array_equal(engine.probs(imgs), ref_a)
+    # And the cycle continues from WATCH to its terminal.
+    assert ctl.run() in ("COMMIT", "ROLLBACK")
+
+
+# ---------------------------------------------------------------------------
+# Operator surfaces: lifecycle_run CLI + obs_report section
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lifecycle_run_cli_trigger_and_status(tmp_path, capsys):
+    cli = _load_script("lifecycle_run")
+    wd = str(tmp_path / "wd")
+    assert cli.main(["--workdir", wd, "--config", "smoke",
+                     "--status"]) == 0
+    assert "IDLE" in capsys.readouterr().out
+    assert cli.main(["--workdir", wd, "--config", "smoke",
+                     "--trigger", "manual",
+                     "--ckpt", "/ckpt/m0"]) == 0
+    assert "opened" in capsys.readouterr().out
+    # Refused while open.
+    assert cli.main(["--workdir", wd, "--config", "smoke",
+                     "--trigger", "manual"]) == 0
+    assert "refused" in capsys.readouterr().out
+    assert cli.main(["--workdir", wd, "--config", "smoke",
+                     "--status", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["state"] == "DRIFT_DETECTED" and doc["cycle_open"]
+    assert doc["timeline"][0]["reason"] == "manual"
+    j = Journal(os.path.join(wd, "lifecycle"))
+    assert j.find("DRIFT_DETECTED")["live_member_dirs"] == ["/ckpt/m0"]
+
+
+def test_obs_report_lifecycle_section(tmp_path):
+    obs_report = _load_script("obs_report")
+    records = [
+        {"kind": "lifecycle", "t": 1.0, "seq": 0, "cycle": 0,
+         "state": "DRIFT_DETECTED", "reason": "quality_drift"},
+        {"kind": "lifecycle", "t": 2.0, "seq": 1, "cycle": 0,
+         "state": "RETRAIN", "n_members": 2},
+        {"kind": "lifecycle", "t": 3.0, "seq": 2, "cycle": 0,
+         "state": "GATE", "passed": False,
+         "verdicts": [
+             {"name": "golden_canary", "passed": False, "value": 0.41,
+              "threshold": 0.2, "detail": "", "skipped": False},
+             {"name": "profile_parity", "passed": True, "value": None,
+              "threshold": None, "detail": "no profile",
+              "skipped": True},
+         ]},
+        {"kind": "lifecycle", "t": 4.0, "seq": 3, "cycle": 0,
+         "state": "ROLLBACK", "cause": "gate_rejected",
+         "swapped": False},
+        {"kind": "telemetry", "t": 5.0,
+         "counters": {"lifecycle.retrains": 1,
+                      "lifecycle.gate_rejects": 1,
+                      "lifecycle.rollbacks": 1,
+                      "lifecycle.transitions": 4},
+         "gauges": {"serve.lifecycle.state": 7}},
+    ]
+    s = obs_report.lifecycle_summary(records)
+    assert s["state"] == "ROLLBACK" and s["cycle"] == 0
+    assert s["gate_passed"] is False
+    assert s["rollback_cause"] == "gate_rejected"
+    assert s["retrains"] == 1 and s["rollbacks"] == 1
+    assert [t["state"] for t in s["timeline"]] == [
+        "DRIFT_DETECTED", "RETRAIN", "GATE", "ROLLBACK"
+    ]
+    text = obs_report.render_lifecycle(records)
+    assert "lifecycle:" in text and "gate verdicts:" in text
+    assert "golden_canary" in text and "FAIL" in text
+    assert "DRIFT_DETECTED -> RETRAIN -> GATE -> ROLLBACK" in text
+    # Gauge-only runs (no lifecycle records yet) still render state.
+    s2 = obs_report.lifecycle_summary([records[-1]])
+    assert s2["state"] == "ROLLBACK"
+    # A run with no lifecycle signals renders nothing.
+    assert obs_report.lifecycle_summary(
+        [{"kind": "telemetry", "counters": {"x": 1}, "gauges": {}}]
+    ) is None
+
+
+def test_obs_report_json_carries_lifecycle(tmp_path, capsys):
+    obs_report = _load_script("obs_report")
+    wd = str(tmp_path)
+    with open(os.path.join(wd, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "lifecycle", "t": 1.0, "seq": 0, "cycle": 0,
+            "state": "COMMIT", "generation": 3,
+        }) + "\n")
+    assert obs_report.main([wd, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["lifecycle"]["state"] == "COMMIT"
