@@ -390,7 +390,11 @@ def _instrumented_step(step, registry, tracer=None):
     from jama16_retina_tpu.obs.spans import StallClock
 
     stalls = StallClock(registry, tracer=tracer)
-    c_steps = registry.counter("bench.steps")
+    c_steps = registry.counter(
+        "bench.steps",
+        help="train steps executed by bench.py's instrumented "
+             "overhead-pin workload",
+    )
 
     def wrapped(state, batch, key):
         with stalls.measure("dispatch"):

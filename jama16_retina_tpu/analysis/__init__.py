@@ -1,0 +1,55 @@
+"""graftlint — an AST-driven contract checker for this repo's
+conventions (ISSUE 9).
+
+Five hard rules over the package + scripts + entry scripts, each with
+file:line findings and stable suppression keys:
+
+  * ``metrics``  — registry-metric contract: literal ``layer.noun``
+    names, help text, no kind/help conflicts, and a round-trip against
+    the docs/OBSERVABILITY.md + docs/RELIABILITY.md glossary tables;
+  * ``config``   — every config knob has a consumer and a doc mention;
+    every literal alert/watch rule string parses under obs/alerts.py's
+    grammar with the watch-context restrictions applied;
+  * ``faults``   — every fault site fired, armed, or documented
+    resolves to ``obs/faultinject.SITES`` (and every declared site is
+    fired and documented);
+  * ``locks``    — lock-guarded attributes of threaded classes are
+    never written bare;
+  * ``purity``   — declared-deterministic scopes never call clocks or
+    entropy sources directly (injected-clock parameters excepted);
+
+plus the ``pytest-marks`` hygiene rule over tests/.
+
+Run: ``python -m jama16_retina_tpu.analysis`` or
+``python scripts/graftlint.py`` (``--json`` for machines; exit 0
+clean / 1 findings / 2 internal error). Suppressions live in
+``.graftlint.json`` at the repo root, one justification each.
+"""
+
+from __future__ import annotations
+
+from jama16_retina_tpu.analysis.core import (  # noqa: F401
+    Corpus,
+    Finding,
+    run_rules,
+)
+from jama16_retina_tpu.analysis.rule_config import ConfigRule  # noqa: F401
+from jama16_retina_tpu.analysis.rule_faults import FaultsRule  # noqa: F401
+from jama16_retina_tpu.analysis.rule_locks import LocksRule  # noqa: F401
+from jama16_retina_tpu.analysis.rule_metrics import MetricsRule  # noqa: F401
+from jama16_retina_tpu.analysis.rule_purity import PurityRule  # noqa: F401
+from jama16_retina_tpu.analysis.rule_pytest import (  # noqa: F401
+    PytestMarksRule,
+)
+
+
+def default_rules() -> list:
+    """The full rule set, in the order findings group best."""
+    return [
+        MetricsRule(),
+        ConfigRule(),
+        FaultsRule(),
+        LocksRule(),
+        PurityRule(),
+        PytestMarksRule(),
+    ]

@@ -1,0 +1,99 @@
+"""graftlint CLI: ``python -m jama16_retina_tpu.analysis [flags]``.
+
+Exit codes: 0 clean, 1 findings, 2 internal error (the contract
+scripts/ci_checks.sh and tests/test_analysis.py pin).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+from jama16_retina_tpu import analysis
+from jama16_retina_tpu.analysis import core, report
+
+
+def _detect_root(start: str) -> str:
+    """Walk up from ``start`` to the directory containing the package
+    (running from a subdir of the repo should still lint the repo)."""
+    d = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(d, "jama16_retina_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.abspath(start)
+        d = parent
+
+
+def main(argv: "list | None" = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(analysis.__doc__ or "").splitlines()[0],
+    )
+    p.add_argument("--root", default="",
+                   help="repo root (default: auto-detect from cwd)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule names and exit 0")
+    p.add_argument("--suppressions", default="",
+                   help=f"suppression file (default: "
+                        f"<root>/{core.SUPPRESSIONS_BASENAME})")
+    p.add_argument("--baseline", default="",
+                   help="accepted-findings file to subtract")
+    p.add_argument("--write-baseline", default="",
+                   help="write current findings as a baseline file, "
+                        "then exit 0")
+    args = p.parse_args(argv)
+
+    rules = analysis.default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(r.name)
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"graftlint: unknown rule(s): {sorted(unknown)} "
+                  f"(have: {[r.name for r in rules]})", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+    try:
+        root = args.root or _detect_root(os.getcwd())
+        corpus = core.Corpus(root)
+        if not corpus.py:
+            # An empty corpus would make every rule vacuously pass — a
+            # mis-pointed --root must be loud, never a silent clean.
+            print(f"graftlint: no Python files found under {corpus.root} "
+                  f"(expected a {corpus.package}/ package); wrong --root?",
+                  file=sys.stderr)
+            return 2
+        baseline = (core.load_baseline(args.baseline)
+                    if args.baseline else None)
+        findings = core.run_rules(
+            corpus, rules,
+            suppressions_path=(args.suppressions or None),
+            baseline=baseline,
+        )
+        if args.write_baseline:
+            core.write_baseline(args.write_baseline, findings)
+            print(f"graftlint: wrote {len(findings)} accepted finding(s) "
+                  f"to {args.write_baseline}")
+            return 0
+        out = (report.render_json(findings, rules, corpus.root)
+               if args.as_json else report.render_text(findings, rules))
+        print(out)
+        return 1 if findings else 0
+    except Exception:  # noqa: BLE001 - the exit-2 contract
+        traceback.print_exc()
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

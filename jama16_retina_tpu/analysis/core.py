@@ -1,0 +1,367 @@
+"""graftlint core: the corpus model, Finding shape, and runner.
+
+ISSUE 9 tentpole — the contract checker for the conventions eight PRs
+of growth now rest on. The stack's correctness invariants are mostly
+*social* contracts: every registry metric carries help text and a
+glossary row, every config knob has a consumer and a doc line, alert
+rules parse, fault sites exist before ``bench --chaos`` fires them,
+threaded classes keep their lock discipline, declared-deterministic
+code stays pure. None of those are visible to the type checker or the
+test suite until they break in production. graftlint makes each one a
+machine-checked lint rule over the repo's own ASTs and docs — the
+"machine-checkable dataflow contracts" operability lever the TF paper
+credits (PAPERS.md), applied to a research codebase.
+
+Design constraints:
+
+  * ONE PARSE. Every rule reads the same ``Corpus`` — files are read
+    and ``ast.parse``d exactly once, docs are read once — so the full
+    repo lints in well under the 10 s budget the bench guard pins.
+  * STABLE KEYS, NOT LINE NUMBERS. Every Finding carries a ``key``
+    derived from names (file::Class.method.attr, metric::<name>, …),
+    so suppressions and baselines survive unrelated edits.
+  * SUPPRESSION IS LOUD. Each suppression entry in ``.graftlint.json``
+    must carry a non-empty ``reason``; entries that no longer match
+    anything are themselves findings — the suppression file can only
+    shrink toward honesty, never silently rot.
+  * EXIT CODES ARE THE API. 0 clean / 1 findings / 2 internal error —
+    scripts/ci_checks.sh and test_lint_repo_clean consume nothing
+    else (the ``--json`` reporter exists for humans and dashboards).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One contract violation, pointing at a file:line.
+
+    ``rule`` is the coarse rule name (the enable/disable unit);
+    ``code`` the specific check (``metrics.help-missing``); ``key`` the
+    stable suppression/baseline identity (no line numbers).
+    """
+
+    rule: str
+    code: str
+    path: str
+    line: int
+    message: str
+    key: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.code}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# The sentinel a dynamic fragment of a metric name canonicalizes to
+# (f-string interpolations). Display form is "{*}" — the NUL char keeps
+# canonical names unambiguous (no legal metric name contains it).
+WILDCARD = "\x00"
+
+
+def display_name(canonical: str) -> str:
+    """Human/suppression form of a canonical (wildcarded) name."""
+    return canonical.replace(WILDCARD, "{*}")
+
+
+def literal_str(node) -> "str | None":
+    """Resolve an AST expression to a string: plain constants verbatim,
+    f-strings with every interpolated fragment collapsed to WILDCARD.
+    None = not statically resolvable (a Name, a .format() call, …)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(WILDCARD)
+        return "".join(parts)
+    return None
+
+
+def dotted(node) -> "str | None":
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _annotate_scopes(tree: ast.AST) -> None:
+    """Stamp every node with ``_graft_scope`` — the enclosing
+    ``Class.method`` / function qualname / ``<module>`` — the stable
+    half of every per-site suppression key."""
+
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                child_scope = (
+                    f"{scope}.{child.name}" if scope != "<module>"
+                    else child.name
+                )
+            child._graft_scope = child_scope  # noqa: SLF001
+            visit(child, child_scope)
+
+    tree._graft_scope = "<module>"  # noqa: SLF001
+    visit(tree, "<module>")
+
+
+def scope_of(node) -> str:
+    return getattr(node, "_graft_scope", "<module>")
+
+
+class PyFile:
+    """One parsed source file of the corpus."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel
+        self.path = os.path.join(root, rel)
+        with open(self.path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=rel)
+        _annotate_scopes(self.tree)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+# The top-level entry scripts the lint walk covers beside the package
+# and scripts/ (the ISSUE 9 scope list).
+TOP_LEVEL_FILES = ("bench.py", "train.py", "predict.py", "evaluate.py")
+
+
+class Corpus:
+    """Everything one lint run reads, loaded once and shared by every
+    rule: the package + scripts + entry-point ASTs (``py``), the doc
+    texts (``docs``: README.md + docs/*.md), the test ASTs (``tests``,
+    used only by the pytest-marks rule), and pytest.ini."""
+
+    def __init__(self, root: str, package: str = "jama16_retina_tpu",
+                 scripts_dir: str = "scripts",
+                 top_level: tuple = TOP_LEVEL_FILES,
+                 tests_dir: str = "tests"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.py: list[PyFile] = []
+        self.tests: list[PyFile] = []
+        self.parse_errors: list[Finding] = []
+        rels: list[str] = []
+        pkg_dir = os.path.join(self.root, package)
+        for base, dirs, files in os.walk(pkg_dir):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    rels.append(os.path.relpath(os.path.join(base, f),
+                                                self.root))
+        sdir = os.path.join(self.root, scripts_dir)
+        if os.path.isdir(sdir):
+            for f in sorted(os.listdir(sdir)):
+                if f.endswith(".py"):
+                    rels.append(os.path.join(scripts_dir, f))
+        for f in top_level:
+            if os.path.exists(os.path.join(self.root, f)):
+                rels.append(f)
+        for rel in rels:
+            self._load(rel, self.py)
+        tdir = os.path.join(self.root, tests_dir)
+        if os.path.isdir(tdir):
+            for f in sorted(os.listdir(tdir)):
+                if f.endswith(".py"):
+                    self._load(os.path.join(tests_dir, f), self.tests)
+        self.docs: dict[str, str] = {}
+        readme = os.path.join(self.root, "README.md")
+        if os.path.exists(readme):
+            self.docs["README.md"] = _read(readme)
+        ddir = os.path.join(self.root, "docs")
+        if os.path.isdir(ddir):
+            for f in sorted(os.listdir(ddir)):
+                if f.endswith(".md"):
+                    self.docs[os.path.join("docs", f)] = _read(
+                        os.path.join(ddir, f)
+                    )
+        ini = os.path.join(self.root, "pytest.ini")
+        self.pytest_ini = _read(ini) if os.path.exists(ini) else None
+
+    def _load(self, rel: str, into: list) -> None:
+        try:
+            into.append(PyFile(self.root, rel))
+        except SyntaxError as e:
+            self.parse_errors.append(Finding(
+                rule="core", code="core.parse-error", path=rel,
+                line=int(e.lineno or 0),
+                message=f"cannot parse: {e.msg}", key=f"{rel}::parse",
+            ))
+
+    def find_py(self, suffix: str) -> "PyFile | None":
+        """The scanned file whose repo-relative path ends with
+        ``suffix`` (rules locate configs.py / faultinject.py this way,
+        so fixture mini-repos can use any layout)."""
+        for pf in self.py:
+            if pf.rel.endswith(suffix):
+                return pf
+        return None
+
+    def doc_named(self, basename: str) -> "tuple[str, str] | None":
+        """(rel, text) of the doc with this basename, if present."""
+        for rel, text in self.docs.items():
+            if os.path.basename(rel) == basename:
+                return rel, text
+        return None
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+# --- Suppressions ---------------------------------------------------------
+
+SUPPRESSIONS_BASENAME = ".graftlint.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    code: str
+    key: str
+    reason: str
+
+    def matches(self, f: Finding) -> bool:
+        if self.key != f.key:
+            return False
+        return (self.code == f.code or self.code == f.rule
+                or f.code.startswith(self.code + "."))
+
+
+def load_suppressions(path: str) -> tuple[list[Suppression], list[Finding]]:
+    """Parse the suppression file; malformed entries (and entries with
+    no justification) come back as findings — a suppression that
+    cannot say WHY it exists does not suppress anything."""
+    sups: list[Suppression] = []
+    findings: list[Finding] = []
+    if not os.path.exists(path):
+        return sups, findings
+    rel = os.path.basename(path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        findings.append(Finding(
+            rule="core", code="core.suppressions-unreadable", path=rel,
+            line=0, message=f"cannot read suppression file: {e}",
+            key="suppressions::file",
+        ))
+        return sups, findings
+    for i, entry in enumerate(doc.get("suppressions", ())):
+        code = str(entry.get("code", "")).strip()
+        key = str(entry.get("key", "")).strip()
+        reason = str(entry.get("reason", "")).strip()
+        if not code or not key:
+            findings.append(Finding(
+                rule="core", code="core.suppression-malformed", path=rel,
+                line=0,
+                message=f"suppression #{i} needs both 'code' and 'key'",
+                key=f"suppressions::entry{i}",
+            ))
+            continue
+        if not reason:
+            findings.append(Finding(
+                rule="core", code="core.suppression-no-reason", path=rel,
+                line=0,
+                message=(f"suppression ({code!r}, {key!r}) carries no "
+                         "justification; every suppression must say why"),
+                key=f"suppressions::{code}::{key}",
+            ))
+            continue
+        sups.append(Suppression(code=code, key=key, reason=reason))
+    return sups, findings
+
+
+def apply_suppressions(
+    findings: list, sups: list, enabled_rules: "set | None" = None
+) -> tuple[list, list]:
+    """(kept findings, findings for suppressions that matched nothing).
+    An unused suppression is reported so the file tracks reality —
+    but only when the rule it suppresses actually ran (a --rules
+    subset must not misreport the whole-set suppression file)."""
+    kept: list[Finding] = []
+    used = [False] * len(sups)
+    for f in findings:
+        hit = False
+        for i, s in enumerate(sups):
+            if s.matches(f):
+                used[i] = True
+                hit = True
+        if not hit:
+            kept.append(f)
+    unused = []
+    for i, s in enumerate(sups):
+        if used[i]:
+            continue
+        if enabled_rules is not None \
+                and s.code.split(".")[0] not in enabled_rules:
+            continue
+        unused.append(Finding(
+            rule="core", code="core.suppression-unused",
+            path=SUPPRESSIONS_BASENAME, line=0,
+            message=(f"suppression ({s.code!r}, {s.key!r}) matched no "
+                     "finding; delete it"),
+            key=f"suppressions::unused::{s.code}::{s.key}",
+        ))
+    return kept, unused
+
+
+# --- Baseline -------------------------------------------------------------
+
+def load_baseline(path: str) -> set:
+    """Accepted (code, key) pairs from a --write-baseline file."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {(e["code"], e["key"]) for e in doc.get("accepted", ())}
+
+
+def write_baseline(path: str, findings: list) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"accepted": [{"code": x.code, "key": x.key} for x in findings]},
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+
+
+# --- Runner ---------------------------------------------------------------
+
+def run_rules(corpus: Corpus, rules, suppressions_path: "str | None" = None,
+              baseline: "set | None" = None) -> list:
+    """All enabled rules over one corpus; suppressions and baseline
+    applied. Returns findings sorted by (path, line, code)."""
+    findings: list[Finding] = list(corpus.parse_errors)
+    for rule in rules:
+        findings.extend(rule.run(corpus))
+    if suppressions_path is None:
+        suppressions_path = os.path.join(corpus.root, SUPPRESSIONS_BASENAME)
+    sups, sup_findings = load_suppressions(suppressions_path)
+    enabled = {r.name for r in rules} | {"core"}
+    findings, unused = apply_suppressions(findings, sups, enabled)
+    findings.extend(sup_findings)
+    findings.extend(unused)
+    if baseline:
+        findings = [f for f in findings if (f.code, f.key) not in baseline]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.code, f.key))

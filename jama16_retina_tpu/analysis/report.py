@@ -1,0 +1,45 @@
+"""graftlint reporters: text and ``--json`` over one finding list.
+
+Exit-code contract (the CI API): 0 clean, 1 findings, 2 internal
+error. The JSON shape is stable: ``{"root", "rules", "findings":
+[{rule, code, path, line, message, key}], "counts": {code: n}}``.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def render_text(findings: list, rules: list) -> str:
+    lines = [f.render() for f in findings]
+    if findings:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.code] = counts.get(f.code, 0) + 1
+        summary = ", ".join(f"{c} {code}" for code, c in sorted(
+            counts.items()))
+        lines.append("")
+        lines.append(
+            f"graftlint: {len(findings)} finding"
+            f"{'s' if len(findings) != 1 else ''} ({summary})"
+        )
+    else:
+        lines.append(
+            f"graftlint: clean ({', '.join(r.name for r in rules)})"
+        )
+    return "\n".join(lines)
+
+
+def render_json(findings: list, rules: list, root: str) -> str:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    return json.dumps(
+        {
+            "root": root,
+            "rules": [r.name for r in rules],
+            "findings": [f.as_dict() for f in findings],
+            "counts": counts,
+        },
+        indent=1, sort_keys=True,
+    )

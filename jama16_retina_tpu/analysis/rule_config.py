@@ -1,0 +1,258 @@
+"""graftlint rule ``config``: the config-knob and alert-grammar
+contract (ISSUE 9).
+
+Half one — dead/undocumented knobs: every dataclass field reachable
+from the root config class in configs.py must be
+
+  * READ somewhere outside configs.py (an ``x.<field>`` attribute load
+    or a literal ``getattr(x, "<field>")``) — a knob nothing consumes
+    is a lie in the CLI surface; and
+  * NAMED in README.md or docs/*.md — a knob an operator cannot
+    discover is configuration by code-reading.
+
+The consumer check is name-based (vulture-style): a field is "alive"
+if ANY attribute read in scope uses its name. That is deliberately
+conservative — cross-section name collisions can mask a dead knob, but
+the check never cries wolf on a live one.
+
+Half two — alert/watch rule strings: every literal rule string in
+code, docs, and the config defaults must parse COMPLETELY under
+``obs/alerts.py``'s grammar (the real parser is imported — one
+grammar, zero drift), with the context rules applied: strings bound to
+``watch_rules`` (the lifecycle WATCH probe is stateless) may use
+neither ``rate()`` (needs snapshot history) nor ``for N`` (latching
+semantics the probe would silently drop). Doc spans are pre-filtered
+to comparison-shaped backtick spans so prose never false-positives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from jama16_retina_tpu.analysis import core
+
+# Field-name contexts that carry alert-grammar rule strings, and the
+# grammar context each implies.
+RULE_FIELDS = {"alert_rules": "alert", "watch_rules": "watch"}
+
+# A doc backtick span that is meant to be a rule: metric-ish token,
+# comparison operator, numeric threshold.
+_DOC_RULE_RE = re.compile(
+    r"^(?:rate\()?[A-Za-z_][A-Za-z0-9_.]*\)?\s*(?:>=|<=|==|!=|>|<)\s*"
+    r"[-+]?[0-9.]"
+)
+
+_WORD_RE = re.compile(r"[A-Za-z0-9_]+")
+
+ROOT_CLASSES = ("ExperimentConfig", "Config")
+
+
+def _dataclass_fields(tree: ast.AST) -> "dict[str, list]":
+    """{class_name: [(field, annotation_src, default_node, lineno)]}
+    for every @dataclass in the module."""
+    out: dict[str, list] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        deco = [core.dotted(d.func) if isinstance(d, ast.Call)
+                else core.dotted(d) for d in node.decorator_list]
+        if not any(d and d.split(".")[-1] == "dataclass" for d in deco):
+            continue
+        fields = []
+        for stmt in node.body:
+            if (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                fields.append((
+                    stmt.target.id, ast.unparse(stmt.annotation),
+                    stmt.value, stmt.lineno,
+                ))
+        out[node.name] = fields
+    return out
+
+
+def _reachable(classes: "dict[str, list]") -> "list[tuple[str, tuple]]":
+    """[(class_name, field_tuple)] for every field of every dataclass
+    reachable from the root class through field annotations."""
+    root = next((r for r in ROOT_CLASSES if r in classes), None)
+    if root is None:
+        return []
+    seen, queue, out = {root}, [root], []
+    while queue:
+        cls = queue.pop(0)
+        for f in classes[cls]:
+            out.append((cls, f))
+            for name in _WORD_RE.findall(f[1]):
+                if name in classes and name not in seen:
+                    seen.add(name)
+                    queue.append(name)
+    return out
+
+
+def _attribute_reads(corpus: "core.Corpus", skip_rel: str) -> set:
+    """Every attribute name read (plus literal getattr names) anywhere
+    in scope outside the configs module."""
+    reads: set[str] = set()
+    for pf in corpus.py:
+        if pf.rel == skip_rel:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load):
+                reads.add(node.attr)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "getattr" and len(node.args) >= 2):
+                lit = core.literal_str(node.args[1])
+                if lit:
+                    reads.add(lit)
+    return reads
+
+
+def _doc_words(corpus: "core.Corpus") -> set:
+    words: set[str] = set()
+    for text in corpus.docs.values():
+        words.update(_WORD_RE.findall(text))
+    return words
+
+
+def check_rule_string(text: str, context: str) -> "str | None":
+    """None = fine; else the violation message. ``context`` is
+    "alert" (full grammar) or "watch" (stateless probe: no rate(),
+    no for-latching)."""
+    from jama16_retina_tpu.obs import alerts as alerts_lib
+
+    try:
+        rule = alerts_lib.parse_rule(text)
+    except ValueError as e:
+        return str(e)
+    if context == "watch":
+        if rule.metric.startswith("rate("):
+            return ("rate() needs snapshot history; the stateless "
+                    "watch_rules probe has none (rejected at controller "
+                    "construction)")
+        if rule.for_seconds:
+            return ("'for N' latches over successive evaluations; the "
+                    "stateless watch_rules probe would turn it into "
+                    "fire-on-first-sample")
+    return None
+
+
+def _tuple_strs(node) -> list:
+    """Literal strings inside a tuple/list expression node."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return []
+    out = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            out.append(elt.value)
+    return out
+
+
+class ConfigRule:
+    name = "config"
+
+    def __init__(self, configs_suffix: str = "configs.py"):
+        self.configs_suffix = configs_suffix
+
+    def run(self, corpus: "core.Corpus") -> list:
+        findings: list = []
+        cfg_pf = corpus.find_py(self.configs_suffix)
+        if cfg_pf is not None:
+            findings.extend(self._check_knobs(corpus, cfg_pf))
+            findings.extend(self._check_config_rule_strings(cfg_pf))
+        findings.extend(self._check_code_rule_strings(corpus, cfg_pf))
+        findings.extend(self._check_doc_rule_strings(corpus))
+        return findings
+
+    def _check_knobs(self, corpus, cfg_pf) -> list:
+        findings: list = []
+        classes = _dataclass_fields(cfg_pf.tree)
+        reads = _attribute_reads(corpus, cfg_pf.rel)
+        doc_words = _doc_words(corpus)
+        for cls, (field, _ann, _default, lineno) in _reachable(classes):
+            if field not in reads:
+                findings.append(core.Finding(
+                    rule=self.name, code="config.dead-knob",
+                    path=cfg_pf.rel, line=lineno,
+                    message=(f"{cls}.{field} is never read outside "
+                             f"{cfg_pf.rel} — a knob nothing consumes; "
+                             "wire it or delete it"),
+                    key=f"knob::{cls}.{field}",
+                ))
+            if corpus.docs and field not in doc_words:
+                findings.append(core.Finding(
+                    rule=self.name, code="config.undocumented-knob",
+                    path=cfg_pf.rel, line=lineno,
+                    message=(f"{cls}.{field} is named nowhere in "
+                             "README.md or docs/ — operators cannot "
+                             "discover it"),
+                    key=f"knob::{cls}.{field}",
+                ))
+        return findings
+
+    def _check_config_rule_strings(self, cfg_pf) -> list:
+        """Defaults of alert_rules/watch_rules fields in configs."""
+        findings: list = []
+        for node in ast.walk(cfg_pf.tree):
+            if (isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and node.target.id in RULE_FIELDS
+                    and node.value is not None):
+                ctx = RULE_FIELDS[node.target.id]
+                for text in _tuple_strs(node.value):
+                    findings.extend(self._rule_finding(
+                        cfg_pf.rel, node.lineno, text, ctx
+                    ))
+        return findings
+
+    def _check_code_rule_strings(self, corpus, cfg_pf) -> list:
+        """Keyword args named alert_rules/watch_rules and literal
+        parse_rule(...) arguments anywhere in scope."""
+        findings: list = []
+        for pf in corpus.py:
+            if cfg_pf is not None and pf.rel == cfg_pf.rel:
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in RULE_FIELDS:
+                        ctx = RULE_FIELDS[kw.arg]
+                        for text in _tuple_strs(kw.value):
+                            findings.extend(self._rule_finding(
+                                pf.rel, node.lineno, text, ctx
+                            ))
+                fn = core.dotted(node.func) or ""
+                if fn.split(".")[-1] == "parse_rule" and node.args:
+                    text = core.literal_str(node.args[0])
+                    if text is not None and core.WILDCARD not in text:
+                        findings.extend(self._rule_finding(
+                            pf.rel, node.lineno, text, "alert"
+                        ))
+        return findings
+
+    def _check_doc_rule_strings(self, corpus) -> list:
+        findings: list = []
+        for rel, text in sorted(corpus.docs.items()):
+            for lineno, line in enumerate(text.splitlines(), start=1):
+                for span in re.findall(r"`([^`]+)`", line):
+                    if not _DOC_RULE_RE.match(span):
+                        continue
+                    ctx = "watch" if "watch_rules" in line else "alert"
+                    findings.extend(self._rule_finding(
+                        rel, lineno, span, ctx
+                    ))
+        return findings
+
+    def _rule_finding(self, rel, lineno, text, ctx) -> list:
+        why = check_rule_string(text, ctx)
+        if why is None:
+            return []
+        code = ("config.watch-context" if ctx == "watch"
+                and "probe" in why else "config.alert-grammar")
+        return [core.Finding(
+            rule=self.name, code=code, path=rel, line=lineno,
+            message=f"rule string {text!r}: {why}",
+            key=f"{rel}::rule::{text}",
+        )]

@@ -1,0 +1,234 @@
+"""graftlint rule ``faults``: the fault-site contract (ISSUE 9).
+
+``obs/faultinject.py`` owns the canonical declared-site registry
+(``SITES``: name -> one-line docstring). This rule pins three
+populations to it so ``bench --chaos`` and docs/RELIABILITY.md's
+failure matrix can never drift from the code:
+
+  * FIRED — literal site names at ``faultinject.check("…")`` /
+    ``faultinject.corrupt("…", …)`` seams;
+  * ARMED — literal site keys in plan specs handed to ``arm()`` /
+    ``plan_from_spec()`` (dict literals and inline JSON strings);
+  * DOCUMENTED — site-shaped backtick spans in RELIABILITY.md's
+    fault-injection section, plus JSON spec keys in its fenced code
+    blocks.
+
+Every fired/armed/documented site must be declared; every declared
+site must be fired by at least one real seam (a site nothing calls is
+a chaos plan that silently never injects — the one failure mode a
+fault harness must not have) and documented in RELIABILITY.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+
+from jama16_retina_tpu.analysis import core
+
+_SITE_SPAN_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+_DOC_SECTION = "fault injection"
+
+
+def declared_sites(pf) -> "dict[str, int] | None":
+    """{site: lineno} from the module-level ``SITES`` dict literal;
+    None when the module declares no registry."""
+    for node in pf.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "SITES"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            out = {}
+            for k in node.value.keys:
+                lit = core.literal_str(k) if k is not None else None
+                if lit is not None:
+                    out[lit] = k.lineno
+            return out
+    return None
+
+
+def _fired_sites(corpus, registry_rel) -> list:
+    """[(rel, lineno, site | None)] for every check/corrupt seam."""
+    out = []
+    for pf in corpus.py:
+        if pf.rel == registry_rel:
+            continue
+        # Bare-name imports: from ...faultinject import check, corrupt
+        bare = set()
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.ImportFrom) and node.module
+                    and node.module.split(".")[-1] == "faultinject"):
+                for a in node.names:
+                    if a.name in ("check", "corrupt"):
+                        bare.add(a.asname or a.name)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_seam = False
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("check", "corrupt")):
+                recv = core.dotted(fn.value) or ""
+                is_seam = recv.split(".")[-1] == "faultinject"
+            elif isinstance(fn, ast.Name) and fn.id in bare:
+                is_seam = True
+            if not is_seam:
+                continue
+            site = (core.literal_str(node.args[0]) if node.args else None)
+            out.append((pf.rel, node.lineno, site))
+    return out
+
+
+def _armed_sites(corpus, registry_rel) -> list:
+    """[(rel, lineno, site)] for literal spec keys at arm() /
+    plan_from_spec() call sites (dict literals and JSON strings)."""
+    out = []
+    for pf in corpus.py:
+        if pf.rel == registry_rel:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = core.dotted(node.func) or ""
+            if fn.split(".")[-1] not in ("arm", "plan_from_spec"):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            keys: list[str] = []
+            if isinstance(arg, ast.Dict):
+                keys = [core.literal_str(k) for k in arg.keys
+                        if k is not None]
+                keys = [k for k in keys if k]
+            elif (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                try:
+                    doc = json.loads(arg.value)
+                    if isinstance(doc, dict):
+                        keys = list(doc)
+                except json.JSONDecodeError:
+                    pass
+            for k in keys:
+                out.append((pf.rel, node.lineno, k))
+    return out
+
+
+def _documented_sites(corpus) -> list:
+    """[(rel, lineno, site)] from RELIABILITY.md: site-shaped backtick
+    spans inside the fault-injection section, and JSON object keys in
+    fenced code blocks anywhere in the doc."""
+    found = corpus.doc_named("RELIABILITY.md")
+    if found is None:
+        return []
+    rel, text = found
+    out = []
+    in_section = False
+    in_fence = False
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if line.startswith("```"):
+            in_fence = not in_fence
+            continue
+        if in_fence:
+            for m in re.finditer(r'"([a-z0-9_.]+)"\s*:\s*\{', line):
+                if _SITE_SPAN_RE.match(m.group(1)):
+                    out.append((rel, lineno, m.group(1)))
+            continue
+        if line.startswith("## "):
+            in_section = _DOC_SECTION in line.lower()
+            continue
+        if not in_section:
+            continue
+        for span in re.findall(r"`([^`]+)`", line):
+            if _SITE_SPAN_RE.match(span):
+                out.append((rel, lineno, span))
+    return out
+
+
+class FaultsRule:
+    name = "faults"
+
+    def __init__(self, registry_suffix: str = "faultinject.py"):
+        self.registry_suffix = registry_suffix
+
+    def run(self, corpus: "core.Corpus") -> list:
+        findings: list = []
+        reg_pf = corpus.find_py(self.registry_suffix)
+        if reg_pf is None:
+            return findings  # fixture corpus without the subsystem
+        sites = declared_sites(reg_pf)
+        if sites is None:
+            findings.append(core.Finding(
+                rule=self.name, code="faults.no-registry",
+                path=reg_pf.rel, line=1,
+                message=("no module-level SITES dict literal — the "
+                         "canonical declared-site registry is missing"),
+                key="faults::registry",
+            ))
+            return findings
+        fired = _fired_sites(corpus, reg_pf.rel)
+        for rel, lineno, site in fired:
+            if site is None:
+                findings.append(core.Finding(
+                    rule=self.name, code="faults.non-literal-site",
+                    path=rel, line=lineno,
+                    message=("fault seam site name is not a string "
+                             "literal; the declared-site contract cannot "
+                             "see it"),
+                    key=f"{rel}::faultseam",
+                ))
+            elif core.WILDCARD not in site and site not in sites:
+                findings.append(core.Finding(
+                    rule=self.name, code="faults.unknown-site",
+                    path=rel, line=lineno,
+                    message=(f"fault site {site!r} is fired here but not "
+                             "declared in faultinject.SITES — bench "
+                             "--chaos could never arm it by its real "
+                             "name"),
+                    key=f"site::{site}",
+                ))
+        for rel, lineno, site in _armed_sites(corpus, reg_pf.rel):
+            if site not in sites:
+                findings.append(core.Finding(
+                    rule=self.name, code="faults.unknown-site",
+                    path=rel, line=lineno,
+                    message=(f"fault plan arms site {site!r}, which is "
+                             "not declared in faultinject.SITES — the "
+                             "plan would silently never fire"),
+                    key=f"site::{site}",
+                ))
+        documented = _documented_sites(corpus)
+        for rel, lineno, site in documented:
+            if site not in sites:
+                findings.append(core.Finding(
+                    rule=self.name, code="faults.doc-unknown-site",
+                    path=rel, line=lineno,
+                    message=(f"RELIABILITY.md documents fault site "
+                             f"{site!r}, which is not declared in "
+                             "faultinject.SITES"),
+                    key=f"site::{site}",
+                ))
+        fired_names = {s for _, _, s in fired if s}
+        doc_names = {s for _, _, s in documented}
+        for site, lineno in sorted(sites.items()):
+            if site not in fired_names:
+                findings.append(core.Finding(
+                    rule=self.name, code="faults.never-fired",
+                    path=reg_pf.rel, line=lineno,
+                    message=(f"declared fault site {site!r} has no "
+                             "check()/corrupt() seam anywhere in the "
+                             "lint scope — a site nothing calls never "
+                             "injects"),
+                    key=f"site::{site}",
+                ))
+            if doc_names and site not in doc_names:
+                findings.append(core.Finding(
+                    rule=self.name, code="faults.undocumented-site",
+                    path=reg_pf.rel, line=lineno,
+                    message=(f"declared fault site {site!r} is absent "
+                             "from RELIABILITY.md's fault-injection "
+                             "section"),
+                    key=f"site::{site}",
+                ))
+        return findings

@@ -1,0 +1,149 @@
+"""graftlint rule ``locks``: lock discipline in threaded classes
+(ISSUE 9).
+
+The stack's shared-state classes (MicroBatcher worker vs submitters,
+Snapshotter flush thread, ``ServingEngine.reload`` vs the request
+path, the lifecycle ``--watch`` supervisor) rely on a convention no
+tool verifies: state that is lock-guarded is ALWAYS lock-guarded.
+This rule checks exactly that, per class:
+
+  * a class OWNS a lock when some method assigns
+    ``self.X = threading.Lock()/RLock()/Condition()``;
+  * an attribute is GUARDED when any non-``__init__`` method writes it
+    inside a ``with self.<lockfield>:`` block (or inside a method
+    whose name ends in ``_locked`` — the caller-holds-the-lock
+    convention);
+  * a write to a guarded attribute OUTSIDE any lock block, in any
+    method except ``__init__``/``__post_init__`` (construction
+    happens-before publication) and ``*_locked`` helpers, is a
+    finding.
+
+The shape is deliberately low-noise: attributes that are never
+lock-guarded anywhere are not judged (plenty of single-writer fields
+are legitimately lock-free), but an attribute the class itself says
+needs the lock must never be torn by a bare write on another thread's
+entry path. Intentional exceptions (e.g. a setup method documented as
+single-threaded) go in ``.graftlint.json`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from jama16_retina_tpu.analysis import core
+
+LOCK_TYPES = ("Lock", "RLock", "Condition")
+
+_CTOR_METHODS = ("__init__", "__post_init__")
+
+
+def _lock_fields(cls: ast.ClassDef) -> set:
+    """self attributes assigned a threading.Lock/RLock/Condition."""
+    fields: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        fn = core.dotted(v.func) or ""
+        if fn.split(".")[-1] not in LOCK_TYPES:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                fields.add(t.attr)
+    return fields
+
+
+def _self_attr_of_target(target) -> "str | None":
+    """The self attribute a single assignment target writes (directly,
+    or through a subscript on it — ``self.d[k] = v`` mutates ``d``)."""
+    node = target
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _writes_in(node, under_lock: bool, lock_fields: set, out: list) -> None:
+    """Recursively collect (attr, lineno, under_lock) writes, tracking
+    ``with self.<lock>:`` nesting lexically."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs have their own thread semantics
+        locked = under_lock
+        if isinstance(child, ast.With):
+            for item in child.items:
+                ctx = item.context_expr
+                if (isinstance(ctx, ast.Attribute)
+                        and isinstance(ctx.value, ast.Name)
+                        and ctx.value.id == "self"
+                        and ctx.attr in lock_fields):
+                    locked = True
+        targets = []
+        if isinstance(child, ast.Assign):
+            targets = list(child.targets)
+        elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+            targets = [child.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                attr = _self_attr_of_target(e)
+                if attr is not None:
+                    out.append((attr, child.lineno, locked))
+        _writes_in(child, locked, lock_fields, out)
+
+
+class LocksRule:
+    name = "locks"
+
+    def run(self, corpus: "core.Corpus") -> list:
+        findings: list = []
+        for pf in corpus.py:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(pf, node))
+        return findings
+
+    def _check_class(self, pf, cls: ast.ClassDef) -> list:
+        lock_fields = _lock_fields(cls)
+        if not lock_fields:
+            return []
+        # (method, attr, lineno, under_lock) for every self-write.
+        writes = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            method_writes: list = []
+            _writes_in(stmt, False, lock_fields, method_writes)
+            for attr, lineno, locked in method_writes:
+                writes.append((stmt.name, attr, lineno, locked))
+        guarded: set[str] = set()
+        for method, attr, _lineno, locked in writes:
+            if method in _CTOR_METHODS or attr in lock_fields:
+                continue
+            if locked or method.endswith("_locked"):
+                guarded.add(attr)
+        findings = []
+        for method, attr, lineno, locked in writes:
+            if (attr not in guarded or locked
+                    or method in _CTOR_METHODS
+                    or method.endswith("_locked")):
+                continue
+            findings.append(core.Finding(
+                rule=self.name, code="locks.unguarded-write",
+                path=pf.rel, line=lineno,
+                message=(f"{cls.name}.{method} writes self.{attr} without "
+                         f"holding the lock, but {cls.name} guards that "
+                         "attribute elsewhere (written under "
+                         f"`with self.<{'/'.join(sorted(lock_fields))}>`); "
+                         "a cross-thread entry path through here can "
+                         "tear it — take the lock, or suppress with a "
+                         "justification in .graftlint.json"),
+                key=f"{pf.rel}::{cls.name}.{method}.{attr}",
+            ))
+        return findings

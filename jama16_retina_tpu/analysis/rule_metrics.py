@@ -1,0 +1,283 @@
+"""graftlint rule ``metrics``: the registry-metric contract (ISSUE 9).
+
+Every ``registry.counter/gauge/histogram(name, ...)`` call site in the
+lint scope must:
+
+  * pass a statically resolvable name (a string literal or an f-string
+    — interpolated fragments become wildcards that match the glossary's
+    ``{placeholder}`` patterns);
+  * match the ``layer.noun[.sub]`` grammar: >= 2 dot-separated
+    lowercase ``[a-z0-9_]`` segments, first segment alphabetic-led;
+  * (per NAME, because the registry is get-or-create and most metrics
+    have one registration site plus read-only access sites) carry a
+    non-empty ``help=`` string at at least one site;
+  * never reuse a name with a conflicting kind or a conflicting
+    non-empty help text;
+  * round-trip against the metric glossary TABLES in
+    docs/OBSERVABILITY.md + docs/RELIABILITY.md: an undocumented code
+    metric and a documented-but-nonexistent glossary row are both
+    findings, so the docs can never drift from the code.
+
+Glossary table convention (what the docs satellite installs): any
+markdown table in those two docs whose header row contains "Metric"
+and "Kind"; each row's first cell is a backtick-quoted name pattern,
+second cell the kind. Patterns may use ``{placeholder}`` / ``<ph>``
+for dynamic fragments and ``{a,b,c}`` for literal alternation.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from jama16_retina_tpu.analysis import core
+
+KINDS = ("counter", "gauge", "histogram")
+
+_SEGMENT_RE = re.compile(r"^[a-z0-9_\x00]+$")
+_FIRST_SEGMENT_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# What a glossary {placeholder} may stand for: any run of name chars,
+# dots included (a fault-site placeholder like io.retries.{site}
+# expands to dotted site names).
+_PLACEHOLDER_RE = r"[A-Za-z0-9_.\x00]+"
+
+_GLOSSARY_DOCS = ("OBSERVABILITY.md", "RELIABILITY.md")
+
+
+def name_grammar_ok(canonical: str) -> bool:
+    segments = canonical.split(".")
+    if len(segments) < 2:
+        return False
+    if not _FIRST_SEGMENT_RE.match(segments[0].replace(core.WILDCARD, "x")):
+        return False
+    return all(_SEGMENT_RE.match(s) for s in segments)
+
+
+def pattern_regex(pattern: str) -> "re.Pattern":
+    """A glossary name pattern -> regex over canonical code names.
+    ``{a,b,c}`` alternations additionally accept a code-side wildcard
+    (an f-string fragment can only be checked to the pattern level)."""
+    out = []
+    for tok in re.split(r"(\{[^}]*\}|<[^>]*>)", pattern):
+        if not tok:
+            continue
+        if tok[0] in "{<":
+            inner = tok[1:-1]
+            if "," in inner and tok[0] == "{":
+                alts = [re.escape(a.strip()) for a in inner.split(",")]
+                out.append("(?:" + "|".join(alts + [core.WILDCARD]) + ")")
+            else:
+                out.append(_PLACEHOLDER_RE)
+        else:
+            out.append(re.escape(tok))
+    return re.compile("".join(out) + r"\Z")
+
+
+def parse_glossaries(corpus: "core.Corpus") -> "tuple[list, bool]":
+    """((rel, line, pattern, kind) rows, any_glossary_doc_present)."""
+    entries = []
+    present = False
+    for basename in _GLOSSARY_DOCS:
+        found = corpus.doc_named(basename)
+        if found is None:
+            continue
+        present = True
+        rel, text = found
+        in_table = False
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            stripped = line.strip()
+            if not (stripped.startswith("|") and stripped.endswith("|")):
+                in_table = False
+                continue
+            cells = [c.strip() for c in stripped.strip("|").split("|")]
+            if len(cells) < 2:
+                in_table = False
+                continue
+            low = [c.lower() for c in cells]
+            if "metric" in low[0] and "kind" in low[1]:
+                in_table = True
+                continue
+            if set(cells[0]) <= {"-", ":", " "}:
+                continue
+            if not in_table:
+                continue
+            m = re.search(r"`([^`]+)`", cells[0])
+            k = re.search(r"counter|gauge|histogram", cells[1].lower())
+            if m and k:
+                entries.append((rel, lineno, m.group(1), k.group(0)))
+    return entries, present
+
+
+class _Site:
+    __slots__ = ("pf", "node", "kind", "canonical", "help")
+
+    def __init__(self, pf, node, kind, canonical, help_):
+        self.pf = pf
+        self.node = node
+        self.kind = kind
+        self.canonical = canonical
+        self.help = help_  # str literal | "<dynamic>" | None
+
+
+# help= passed as a non-literal expression (e.g. a dict lookup): treat
+# as present — the contract is "help exists", not "help is static".
+_DYNAMIC = "<dynamic>"
+
+
+def _registry_receiver(node: ast.Call) -> bool:
+    """Is the receiver of this .counter/.gauge/.histogram call a
+    registry? Pins the rule to registry-like names (``reg``,
+    ``registry``, ``self._registry``, …) and ``default_registry()``
+    calls, so ordinary numeric code (``np.histogram(...)``) never
+    false-positives. A registry bound to an unconventional local name
+    is missed — the conservative direction for a lint."""
+    recv = node.func.value
+    if isinstance(recv, ast.Call):
+        fn = core.dotted(recv.func) or ""
+        return fn.split(".")[-1] == "default_registry"
+    chain = core.dotted(recv)
+    if chain is None:
+        return False
+    tail = chain.split(".")[-1].lstrip("_")
+    return tail in ("reg", "registry")
+
+
+class MetricsRule:
+    name = "metrics"
+
+    def run(self, corpus: "core.Corpus") -> list:
+        findings: list = []
+        sites: list[_Site] = []
+        for pf in corpus.py:
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in KINDS
+                        and _registry_receiver(node)):
+                    continue
+                help_ = None
+                for kw in node.keywords:
+                    if kw.arg == "help":
+                        v = core.literal_str(kw.value)
+                        help_ = v if v is not None else _DYNAMIC
+                name_node = node.args[0] if node.args else None
+                canonical = (core.literal_str(name_node)
+                             if name_node is not None else None)
+                if canonical is None:
+                    findings.append(core.Finding(
+                        rule=self.name, code="metrics.non-literal-name",
+                        path=pf.rel, line=node.lineno,
+                        message=(f".{node.func.attr}() name is not a "
+                                 "resolvable literal; metric names must be "
+                                 "static so the glossary round-trip can "
+                                 "see them"),
+                        key=f"{pf.rel}::{core.scope_of(node)}",
+                    ))
+                    continue
+                sites.append(_Site(pf, node, node.func.attr, canonical,
+                                   help_))
+                if not name_grammar_ok(canonical):
+                    findings.append(core.Finding(
+                        rule=self.name, code="metrics.name-grammar",
+                        path=pf.rel, line=node.lineno,
+                        message=(f"metric name "
+                                 f"{core.display_name(canonical)!r} does "
+                                 "not match the layer.noun[.sub] grammar "
+                                 "(>= 2 lowercase [a-z0-9_] dotted "
+                                 "segments)"),
+                        key=f"metric::{core.display_name(canonical)}",
+                    ))
+        by_name: dict[str, list[_Site]] = {}
+        for s in sites:
+            by_name.setdefault(s.canonical, []).append(s)
+        for canonical, group in sorted(by_name.items()):
+            disp = core.display_name(canonical)
+            first = group[0]
+            kinds = sorted({s.kind for s in group})
+            if len(kinds) > 1:
+                where = ", ".join(sorted(
+                    f"{s.pf.rel}:{s.node.lineno} ({s.kind})" for s in group
+                ))
+                findings.append(core.Finding(
+                    rule=self.name, code="metrics.kind-conflict",
+                    path=first.pf.rel, line=first.node.lineno,
+                    message=(f"metric {disp!r} is registered with "
+                             f"conflicting kinds: {where}"),
+                    key=f"metric::{disp}",
+                ))
+            helps = sorted({
+                s.help for s in group
+                if s.help not in (None, "", _DYNAMIC)
+            })
+            if len(helps) > 1:
+                findings.append(core.Finding(
+                    rule=self.name, code="metrics.help-conflict",
+                    path=first.pf.rel, line=first.node.lineno,
+                    message=(f"metric {disp!r} carries {len(helps)} "
+                             "different help texts; one metric, one "
+                             "meaning"),
+                    key=f"metric::{disp}",
+                ))
+            has_help = any(
+                s.help == _DYNAMIC or (s.help is not None and s.help.strip())
+                for s in group
+            )
+            if not has_help:
+                findings.append(core.Finding(
+                    rule=self.name, code="metrics.help-missing",
+                    path=first.pf.rel, line=first.node.lineno,
+                    message=(f"metric {disp!r} has no non-empty help= at "
+                             "any registration site; exporters render "
+                             "help as the # HELP line operators read"),
+                    key=f"metric::{disp}",
+                ))
+        entries, glossary_present = parse_glossaries(corpus)
+        if not glossary_present:
+            return findings  # fixture corpus without glossary docs
+        if sites and not entries:
+            findings.append(core.Finding(
+                rule=self.name, code="metrics.no-glossary",
+                path=_GLOSSARY_DOCS[0], line=0,
+                message=("no metric glossary table found (a table whose "
+                         "header has Metric|Kind columns) — the metric "
+                         "round-trip has nothing to check against"),
+                key="glossary::missing",
+            ))
+            return findings
+        compiled = [
+            (rel, lineno, pat, kind, pattern_regex(pat))
+            for rel, lineno, pat, kind in entries
+        ]
+        for canonical, group in sorted(by_name.items()):
+            disp = core.display_name(canonical)
+            kinds = {s.kind for s in group}
+            hit = any(
+                kind in kinds and rx.match(canonical)
+                for _, _, _, kind, rx in compiled
+            )
+            if not hit:
+                first = group[0]
+                findings.append(core.Finding(
+                    rule=self.name, code="metrics.undocumented",
+                    path=first.pf.rel, line=first.node.lineno,
+                    message=(f"metric {disp!r} ({'/'.join(sorted(kinds))}) "
+                             "has no glossary row in "
+                             f"{' or '.join(_GLOSSARY_DOCS)}"),
+                    key=f"metric::{disp}",
+                ))
+        for rel, lineno, pat, kind, rx in compiled:
+            hit = any(
+                kind in {s.kind for s in group} and rx.match(canonical)
+                for canonical, group in by_name.items()
+            )
+            if not hit:
+                findings.append(core.Finding(
+                    rule=self.name, code="metrics.doc-orphan",
+                    path=rel, line=lineno,
+                    message=(f"glossary row {pat!r} ({kind}) matches no "
+                             "metric registered anywhere in the lint "
+                             "scope — stale docs or a typo'd pattern"),
+                    key=f"glossary::{pat}",
+                ))
+        return findings

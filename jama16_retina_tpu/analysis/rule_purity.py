@@ -1,0 +1,138 @@
+"""graftlint rule ``purity``: declared-deterministic code must stay
+pure (ISSUE 9).
+
+The repo leans on determinism pins — bit-identical autotune decisions,
+journal resume idempotency, exact retry schedules — and determinism
+only holds if purity is *enforced*, not assumed (the portable-
+deterministic-pipelines paper in PAPERS.md makes the same point for
+CNN inference). Scopes declared deterministic must not call wall
+clocks or entropy sources directly: ``time.time``/``monotonic``/
+``perf_counter``/``sleep``, ``random.*``, ``numpy.random.*``,
+``os.urandom``, ``uuid.*``, ``datetime.now`` and friends.
+
+The injected-clock escape is structural, not an allowlist: a call
+through a parameter (``self._now()``, ``sleep(delay)`` where ``sleep``
+is an argument defaulting to ``time.sleep``) never resolves to a
+banned dotted name — referencing ``time.time`` as a default value is
+fine, *calling* it inside the scope is not. That is exactly the
+"inject the clock at the seam" pattern the journal and retry modules
+use.
+
+Declared scopes come from the rule's target list (module paths or
+``module::function``) plus any function whose ``def`` line carries a
+``# graftlint: deterministic`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from jama16_retina_tpu.analysis import core
+
+BANNED = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.sleep",
+    "os.urandom", "uuid.uuid1", "uuid.uuid4",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+BANNED_PREFIXES = ("random.", "numpy.random.", "secrets.")
+
+# The declared-deterministic scopes of THIS repo (ISSUE 9): the
+# autotuner's decision policy, the lifecycle journal, and the retry
+# schedule. Fixture tests pass their own targets.
+DEFAULT_TARGETS = (
+    "jama16_retina_tpu/data/autotune.py::decide",
+    "jama16_retina_tpu/data/autotune.py::staged_cap",
+    "jama16_retina_tpu/lifecycle/journal.py",
+    "jama16_retina_tpu/utils/retry.py",
+)
+
+PRAGMA = "graftlint: deterministic"
+
+
+def _aliases(tree: ast.AST) -> dict:
+    """{local name: dotted origin} from the module's imports."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _resolve_call(node: ast.Call, aliases: dict) -> "str | None":
+    chain = core.dotted(node.func)
+    if chain is None:
+        return None
+    root, _, rest = chain.partition(".")
+    if root in aliases:
+        origin = aliases[root]
+        return f"{origin}.{rest}" if rest else origin
+    return chain
+
+
+def _banned(full: str) -> bool:
+    if full in BANNED:
+        return True
+    return any(full == p[:-1] or full.startswith(p)
+               for p in BANNED_PREFIXES)
+
+
+class PurityRule:
+    name = "purity"
+
+    def __init__(self, targets: tuple = DEFAULT_TARGETS):
+        self.targets = tuple(targets)
+
+    def run(self, corpus: "core.Corpus") -> list:
+        findings: list = []
+        module_targets = set()
+        func_targets: dict[str, set] = {}
+        for t in self.targets:
+            path, sep, func = t.partition("::")
+            if sep:
+                func_targets.setdefault(path, set()).add(func)
+            else:
+                module_targets.add(path)
+        for pf in corpus.py:
+            scopes: list[tuple[str, ast.AST]] = []
+            if any(pf.rel.endswith(m) for m in module_targets):
+                scopes.append((f"{pf.rel}::<module>", pf.tree))
+            wanted = set()
+            for path, funcs in func_targets.items():
+                if pf.rel.endswith(path):
+                    wanted |= funcs
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                pragma = PRAGMA in pf.line_text(node.lineno)
+                if node.name in wanted or pragma:
+                    scopes.append((f"{pf.rel}::{node.name}", node))
+            if not scopes:
+                continue
+            aliases = _aliases(pf.tree)
+            seen: set[int] = set()
+            for scope_name, scope_node in scopes:
+                for node in ast.walk(scope_node):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    seen.add(id(node))
+                    full = _resolve_call(node, aliases)
+                    if full is None or not _banned(full):
+                        continue
+                    findings.append(core.Finding(
+                        rule=self.name, code="purity.impure-call",
+                        path=pf.rel, line=node.lineno,
+                        message=(f"{scope_name.split('::')[-1]} is "
+                                 f"declared deterministic but calls "
+                                 f"{full}(); inject the clock/entropy "
+                                 "source as a parameter instead"),
+                        key=f"{scope_name}::{full}",
+                    ))
+        return findings

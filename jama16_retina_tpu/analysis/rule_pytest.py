@@ -1,0 +1,84 @@
+"""graftlint rule ``pytest-marks``: test-marker hygiene (ISSUE 9
+satellite).
+
+Every ``@pytest.mark.<name>`` used under tests/ must be registered in
+pytest.ini's ``markers`` section. pytest only warns on unknown marks —
+which means a typo'd tier marker (``@pytest.mark.quik``) silently
+drops a test from every ``-m`` selection, the exact failure mode the
+curated quick tier cannot afford. Built-in marks (parametrize, skipif,
+…) are allowlisted.
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+
+from jama16_retina_tpu.analysis import core
+
+BUILTIN_MARKS = frozenset({
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings",
+})
+
+
+def registered_marks(pytest_ini: str) -> "set | None":
+    """Marker names from pytest.ini's [pytest] markers value; None when
+    the file has no markers section to check against."""
+    cp = configparser.ConfigParser()
+    try:
+        cp.read_string(pytest_ini)
+    except configparser.Error:
+        return None
+    for section in ("pytest", "tool:pytest"):
+        if cp.has_option(section, "markers"):
+            names = set()
+            for line in cp.get(section, "markers").splitlines():
+                line = line.strip()
+                if line:
+                    names.add(line.split(":")[0].split("(")[0].strip())
+            return names
+    return None
+
+
+class PytestMarksRule:
+    name = "pytest-marks"
+
+    def run(self, corpus: "core.Corpus") -> list:
+        if corpus.pytest_ini is None or not corpus.tests:
+            return []
+        registered = registered_marks(corpus.pytest_ini)
+        if registered is None:
+            return [core.Finding(
+                rule=self.name, code="pytest-marks.no-markers-section",
+                path="pytest.ini", line=0,
+                message=("pytest.ini has no [pytest] markers section; "
+                         "marks cannot be validated"),
+                key="pytest::markers-section",
+            )]
+        findings: list = []
+        seen: set[str] = set()
+        for pf in corpus.tests:
+            for node in ast.walk(pf.tree):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "mark"
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == "pytest"):
+                    continue
+                mark = node.attr
+                if mark in BUILTIN_MARKS or mark in registered:
+                    continue
+                if mark in seen:
+                    continue
+                seen.add(mark)
+                findings.append(core.Finding(
+                    rule=self.name, code="pytest-marks.unregistered-mark",
+                    path=pf.rel, line=node.lineno,
+                    message=(f"@pytest.mark.{mark} is not registered in "
+                             "pytest.ini [pytest] markers — pytest only "
+                             "warns, and a typo'd tier mark silently "
+                             "drops tests from -m selections"),
+                    key=f"mark::{mark}",
+                ))
+        return findings

@@ -56,8 +56,13 @@ class ModelConfig:
 class DataConfig:
     """Input pipeline config (reference: lib/dataset tf.data over TFRecords)."""
 
+    # Split roots: one dataset directory holds train/val/test TFRecord
+    # splits by name (data/tfrecord.py layout); train.py defaults its
+    # --data_dir to train_dir, evaluate.py to test_dir. (A val_dir knob
+    # existed through PR 8 but was consumed by nothing — the loaders
+    # resolve the val split inside data_dir — and graftlint's dead-knob
+    # rule retired it.)
     train_dir: str = ""
-    val_dir: str = ""
     test_dir: str = ""
     batch_size: int = 32  # global batch across all devices (BASELINE.json:7)
     # Train-stream loader (SURVEY.md N4): "tfdata" = tf.data stream with

@@ -347,6 +347,9 @@ class IngestAutotuner:
         self._tracer = (
             tracer if tracer is not None else obs_trace.default_tracer()
         )
+        # Read-side handles: the owning loaders register these with
+        # their help text (grain_pipeline / tiered_pipeline); the tuner
+        # only reads deltas.
         self._c_busy = self._reg.counter("data.decode.busy_s")
         self._c_hit = self._reg.counter("data.tiered.resident_rows")
         self._c_spill = self._reg.counter("data.tiered.streamed_rows")
@@ -366,7 +369,11 @@ class IngestAutotuner:
             "spill": self._c_spill.value,
         }
         for k in Knobs.FIELDS:
-            self._reg.gauge(f"data.autotune.{k}").set(knobs.get(k))
+            self._reg.gauge(
+                f"data.autotune.{k}",
+                help="current value of this live ingest knob "
+                     "(decode_workers/stage_depth/prefetch_depth)",
+            ).set(knobs.get(k))
 
     def window_stats(self, window_sec: float,
                      input_wait_sec: float) -> WindowStats:
@@ -401,7 +408,10 @@ class IngestAutotuner:
         for a in adjs:
             self.knobs.set(a.knob, a.new)
             self._c_adjust.inc()
-            self._reg.counter(f"data.autotune.adjust.{a.knob}").inc()
+            self._reg.counter(
+                f"data.autotune.adjust.{a.knob}",
+                help="autotuner adjustments applied to this one knob",
+            ).inc()
             self._reg.gauge(f"data.autotune.{a.knob}").set(a.new)
             self._tracer.instant(
                 f"data.autotune.{a.knob}",

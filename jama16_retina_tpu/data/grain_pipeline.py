@@ -232,15 +232,26 @@ class ParallelDecoder:
             registry if registry is not None
             else obs_registry.default_registry()
         )
-        self._c_records = self._registry.counter("data.decode.records")
-        self._c_busy = self._registry.counter("data.decode.busy_s")
+        self._c_records = self._registry.counter(
+            "data.decode.records",
+            help="records decoded by the parallel host decode pool",
+        )
+        self._c_busy = self._registry.counter(
+            "data.decode.busy_s",
+            help="summed per-record decode seconds across pool workers; "
+                 "utilization = delta / (wall x workers)",
+        )
         self._c_quarantined = self._registry.counter(
             "data.quarantined",
             help="records skipped by the poison quarantine (corrupt "
                  "payload / failed decode), all reasons; the "
                  "data_quarantine alert rule reads this burn rate",
         )
-        self._registry.gauge("data.decode.workers").set(self.workers)
+        self._registry.gauge(
+            "data.decode.workers",
+            help="decode threads in the parallel host pool (live-"
+                 "resized by the ingest autotuner)",
+        ).set(self.workers)
         self._pool = None
         if self.workers > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -295,7 +306,11 @@ class ParallelDecoder:
             "read_error" if isinstance(exc, OSError) else "decode_error"
         )
         self._c_quarantined.inc()
-        self._registry.counter(f"data.quarantined.{reason}").inc()
+        self._registry.counter(
+            f"data.quarantined.{reason}",
+            help="poison records quarantined for this one reason "
+                 "(decode_error/read_error)",
+        ).inc()
         absl_logging.warning(
             "record %d quarantined (%s: %s); substituting the next "
             "decodable record", i, type(exc).__name__, exc,

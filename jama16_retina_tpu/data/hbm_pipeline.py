@@ -364,8 +364,16 @@ def train_batches(
     from jama16_retina_tpu.obs import registry as obs_registry
 
     reg = obs_registry.default_registry()
-    reg.gauge("data.hbm.resident_rows").set(n)
-    c_gather = reg.counter("data.hbm.gather_batches")
+    reg.gauge(
+        "data.hbm.resident_rows",
+        help="rows of the split pinned device-resident by the hbm "
+             "loader (the 100%-hit endpoint)",
+    ).set(n)
+    c_gather = reg.counter(
+        "data.hbm.gather_batches",
+        help="batches served as pure on-device gathers (zero "
+             "steady-state H2D)",
+    )
     step = skip_batches
     while True:
         batch = get_batch(step)

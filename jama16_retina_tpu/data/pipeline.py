@@ -409,7 +409,11 @@ def device_prefetch(
     # discipline keeps it at `size` structurally — the gauge surfaces
     # the EFFECTIVE depth config (incl. the drain tail) in snapshots;
     # host-can't-keep-up shows as trainer input_wait_sec, not here.
-    g_depth = obs_registry.default_registry().gauge("data.prefetch.depth")
+    g_depth = obs_registry.default_registry().gauge(
+        "data.prefetch.depth",
+        help="batches staged ahead of the one being yielded in "
+             "device_prefetch (the effective run-ahead config)",
+    )
     queue: collections.deque = collections.deque()
     multiprocess = jax.process_count() > 1
 

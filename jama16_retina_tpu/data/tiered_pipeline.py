@@ -334,11 +334,29 @@ def train_batches(
     # depth, so host-side starvation surfaces as trainer input_wait_sec
     # and in decode_batch_s, not as a sagging depth).
     reg = obs_registry.default_registry()
-    c_hit = reg.counter("data.tiered.resident_rows")
-    c_spill = reg.counter("data.tiered.streamed_rows")
-    g_depth = reg.gauge("data.tiered.stage_depth")
-    h_decode = reg.histogram("data.tiered.decode_batch_s")
-    reg.gauge("data.tiered.resident_rows_pinned").set(plan.n_res)
+    c_hit = reg.counter(
+        "data.tiered.resident_rows",
+        help="batch rows served from the resident HBM tier (cache "
+             "hits: on-device gather, zero H2D)",
+    )
+    c_spill = reg.counter(
+        "data.tiered.streamed_rows",
+        help="batch rows streamed through host decode + staged H2D "
+             "(spills); hit rate = resident / (resident + streamed)",
+    )
+    g_depth = reg.gauge(
+        "data.tiered.stage_depth",
+        help="the tiered loader's staging-queue depth (decode+H2D "
+             "run-ahead; the data.stage_depth target)",
+    )
+    h_decode = reg.histogram(
+        "data.tiered.decode_batch_s",
+        help="streamed-tier decode seconds per batch",
+    )
+    reg.gauge(
+        "data.tiered.resident_rows_pinned",
+        help="rows the HBM budget admitted into the resident tier",
+    ).set(plan.n_res)
 
     res_images = res_grades = None
     if plan.n_res:

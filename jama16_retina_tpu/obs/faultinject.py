@@ -9,20 +9,13 @@ exception on exactly the Nth call, added latency, or corrupted bytes —
 deterministically, so tests/test_faults.py and ``bench.py --chaos``
 drive every recovery path on demand.
 
-Sites wired in this codebase (the vocabulary docs/RELIABILITY.md
-tables use):
-
-    tfrecord.read      — TFRecordIndex.read (data/grain_pipeline.py)
-    host.decode        — serve/host._load_one (per-image file read)
-    ckpt.restore       — Checkpointer.restore (utils/checkpoint.py)
-    engine.dispatch    — ServingEngine per-chunk dispatch (serve/engine.py)
-    trainer.step       — the trainer loops' per-step boundary
-    lifecycle.retrain  — LifecycleController RETRAIN phase entry
-    lifecycle.gate     — LifecycleController GATE evaluation (an
-                         injected error here FAILS CLOSED: the
-                         candidate is rejected, the cycle rolls back)
-    lifecycle.swap     — LifecycleController STAGED_ROLLOUT promote
-                         (lifecycle/controller.py)
+``SITES`` below is the CANONICAL declared-site registry — the one
+vocabulary the seams, plan specs, bench --chaos, and
+docs/RELIABILITY.md's tables all resolve against. ``arm()`` and
+``plan_from_spec()`` validate every plan against it with a
+did-you-mean, so a typo'd chaos plan refuses loudly instead of
+silently never firing; graftlint's ``faults`` rule pins the
+code/docs populations to it statically (ISSUE 9).
 
 Zero overhead unarmed — the contract the bench guard pins: every seam
 reads ONE module-level global and branches; no dict lookup, no lock,
@@ -58,6 +51,28 @@ import time
 from dataclasses import dataclass, field
 
 from absl import logging as absl_logging
+
+# The canonical declared-site registry (ISSUE 9): every fault site the
+# codebase fires, every plan key an operator may arm, and every site
+# docs/RELIABILITY.md's failure matrix names. Adding a seam REQUIRES a
+# row here (graftlint faults.unknown-site otherwise); a row whose seam
+# disappears is flagged the other way (faults.never-fired).
+SITES = {
+    "tfrecord.read": "TFRecordIndex.read payload read "
+                     "(data/grain_pipeline.py)",
+    "host.decode": "serve/host per-image file read before fundus "
+                   "normalization",
+    "ckpt.restore": "Checkpointer.restore (utils/checkpoint.py)",
+    "engine.dispatch": "ServingEngine per-chunk dispatch "
+                       "(serve/engine.py)",
+    "trainer.step": "the trainer loops' per-step boundary",
+    "lifecycle.retrain": "LifecycleController RETRAIN phase entry",
+    "lifecycle.gate": "LifecycleController GATE evaluation (an injected "
+                      "error FAILS CLOSED: candidate rejected, cycle "
+                      "rolls back)",
+    "lifecycle.swap": "LifecycleController STAGED_ROLLOUT promote "
+                      "(lifecycle/controller.py)",
+}
 
 # Error classes a JSON spec may name. Deliberately small: injected
 # faults should look like the real faults the seams handle (transient
@@ -128,13 +143,34 @@ class FaultPlan:
                 for name, s in self.sites.items()
             }
 
+    def validate_sites(self) -> None:
+        """Every site of this plan must be declared in ``SITES`` —
+        raises with a did-you-mean otherwise. A plan naming a site the
+        code never fires is a chaos drill that silently tests nothing
+        (ISSUE 9 satellite)."""
+        import difflib
 
-def plan_from_spec(spec: "str | dict") -> FaultPlan:
+        for name in self.sites:
+            if name in SITES:
+                continue
+            close = difflib.get_close_matches(name, sorted(SITES), n=1)
+            hint = f"; did you mean {close[0]!r}?" if close else ""
+            raise ValueError(
+                f"unknown fault site {name!r}{hint} (declared sites: "
+                f"{', '.join(sorted(SITES))}) — an unknown site would "
+                "never fire; pass allow_unknown=True only to test the "
+                "fault machinery itself"
+            )
+
+
+def plan_from_spec(spec: "str | dict",
+                   allow_unknown: bool = False) -> FaultPlan:
     """A FaultPlan from the JSON spec shape in the module docstring.
     ``spec`` may be the JSON text itself, a path to a JSON file, or an
-    already-parsed dict. Unknown keys/kinds raise — a half-understood
-    chaos plan silently not injecting is the one failure mode a fault
-    harness must not have."""
+    already-parsed dict. Unknown keys/kinds — and, unless
+    ``allow_unknown``, site names outside ``SITES`` — raise: a
+    half-understood chaos plan silently not injecting is the one
+    failure mode a fault harness must not have."""
     if isinstance(spec, str):
         if os.path.exists(spec):
             with open(spec) as f:
@@ -174,7 +210,10 @@ def plan_from_spec(spec: "str | dict") -> FaultPlan:
             delay_s=float(entry.get("delay_s", 0.0)),
             max_fires=int(entry.get("max_fires", 0)),
         )
-    return FaultPlan(sites=sites)
+    plan = FaultPlan(sites=sites)
+    if not allow_unknown:
+        plan.validate_sites()
+    return plan
 
 
 ENV_VAR = "JAMA16_FAULTS"
@@ -193,14 +232,20 @@ def plan_from_env() -> "FaultPlan | None":
 _active: "FaultPlan | None" = None
 
 
-def arm(plan: "FaultPlan | str | dict | None") -> "FaultPlan | None":
+def arm(plan: "FaultPlan | str | dict | None",
+        allow_unknown: bool = False) -> "FaultPlan | None":
     """Install ``plan`` process-wide (str/dict specs are parsed);
     returns the previous plan so tests can restore it. ``None``
-    disarms."""
+    disarms. Site names are validated against ``SITES`` (did-you-mean
+    on a miss) unless ``allow_unknown`` — arming an undeclared site is
+    a drill that silently injects nothing."""
     global _active
     prev = _active
-    if plan is not None and not isinstance(plan, FaultPlan):
-        plan = plan_from_spec(plan)
+    if plan is not None:
+        if not isinstance(plan, FaultPlan):
+            plan = plan_from_spec(plan, allow_unknown=allow_unknown)
+        elif not allow_unknown:
+            plan.validate_sites()
     _active = plan
     if plan is not None:
         absl_logging.warning(

@@ -324,7 +324,11 @@ class GoldenCanary:
             help="max |score - pinned| of the last canary run "
                  "(-1 = score shape mismatched the pinned set)",
         )
-        self._c_runs = reg.counter("quality.canary_runs")
+        self._c_runs = reg.counter(
+            "quality.canary_runs",
+            help="golden-set canary scoring passes attempted (cadence "
+                 "ticks + explicit runs)",
+        )
         self._c_failures = reg.counter(
             "quality.canary_failures",
             help="canary runs whose scores deviated from the pinned set",
@@ -361,7 +365,11 @@ class GoldenCanary:
         of propagating: the canary rides live probs() calls, and a
         broken canary must page, not fail real requests every
         ``every_s``."""
-        self._last_run = time.monotonic() if now is None else now
+        with self._claim_lock:
+            # The cadence stamp is claim_due()'s test-and-set state; an
+            # explicit check() (controller gates, tests) must not tear
+            # it under a concurrent claim (graftlint: locks rule).
+            self._last_run = time.monotonic() if now is None else now
         self._c_runs.inc()
         try:
             scores = np.asarray(score_fn(self.images), np.float64).ravel()
@@ -485,7 +493,11 @@ class QualityMonitor:
                  "reference profile, per tumbling window (0 = at "
                  "sampling noise; >0.25 shifted)",
         )
-        self._g_score_kl = reg.gauge("quality.score_kl")
+        self._g_score_kl = reg.gauge(
+            "quality.score_kl",
+            help="KL(live score histogram || reference profile) over "
+                 "the same tumbling window as quality.score_psi",
+        )
         self._g_pos_rate = reg.gauge(
             "quality.positive_rate",
             help="fraction of window scores above the profile's primary "
@@ -497,7 +509,12 @@ class QualityMonitor:
                  + "/".join(INPUT_STATS),
         )
         self._g_input = {
-            k: reg.gauge(f"quality.input_psi.{k}") for k in INPUT_STATS
+            k: reg.gauge(
+                f"quality.input_psi.{k}",
+                help="debiased PSI of one post-normalization input "
+                     "statistic vs the reference profile "
+                     f"({'/'.join(INPUT_STATS)})",
+            ) for k in INPUT_STATS
         }
         self._c_windows = reg.counter(
             "quality.windows",
@@ -505,7 +522,11 @@ class QualityMonitor:
                  "gauges); 0 with a profile loaded means no quality data "
                  "yet — obs_report --check-alerts exit 2",
         )
-        self._c_scores = reg.counter("quality.scores")
+        self._c_scores = reg.counter(
+            "quality.scores",
+            help="live scores observed by the drift monitor (canary "
+                 "traffic excluded)",
+        )
         self._reset_window_locked()
 
     # -- internals ---------------------------------------------------------

@@ -117,7 +117,12 @@ class StallClock:
         self._hists = {}
         if registry is not None:
             self._hists = {
-                k: registry.histogram(f"trainer.{k}_s") for k in self.KINDS
+                k: registry.histogram(
+                    f"trainer.{k}_s",
+                    help="per-segment stall attribution of the train "
+                         "loop (input/dispatch/pause), cross-window "
+                         "quantiles",
+                ) for k in self.KINDS
             }
         self._tracer = (
             tracer if tracer is not None else trace_lib.default_tracer()

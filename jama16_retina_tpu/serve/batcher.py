@@ -181,11 +181,23 @@ class MicroBatcher:
             help="end-to-end request latency: submit -> future resolved "
                  "with host probabilities",
         )
-        self._c_batches = reg.counter("serve.batcher.batches")
-        self._c_rows = reg.counter("serve.batcher.rows")
-        self._c_rejected_closed = reg.counter("serve.batcher.rejected_at_close")
+        self._c_batches = reg.counter(
+            "serve.batcher.batches",
+            help="coalesced windows flushed to the engine",
+        )
+        self._c_rows = reg.counter(
+            "serve.batcher.rows",
+            help="request rows flushed through coalesced windows",
+        )
+        self._c_rejected_closed = reg.counter(
+            "serve.batcher.rejected_at_close",
+            help="submits refused because the batcher was already "
+                 "closed",
+        )
         self._c_close_flushed = reg.counter(
-            "serve.batcher.close_flushed_windows"
+            "serve.batcher.close_flushed_windows",
+            help="in-flight windows flushed (served, not dropped) "
+                 "during close()",
         )
         self._g_in_flight = reg.gauge(
             "serve.batcher.in_flight",

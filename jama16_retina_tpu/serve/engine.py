@@ -239,9 +239,20 @@ class ServingEngine:
                 enabled=cfg.obs.enabled and cfg.obs.trace_enabled,
                 buffer_events=cfg.obs.trace_buffer_events,
             )
-        self._c_rows = self.registry.counter("serve.engine.rows")
-        self._c_batches = self.registry.counter("serve.engine.batches")
-        self._g_in_flight = self.registry.gauge("serve.engine.in_flight")
+        self._c_rows = self.registry.counter(
+            "serve.engine.rows",
+            help="real (pre-padding) rows the engine forwarded",
+        )
+        self._c_batches = self.registry.counter(
+            "serve.engine.batches",
+            help="bucketed chunks dispatched through the stacked "
+                 "forward",
+        )
+        self._g_in_flight = self.registry.gauge(
+            "serve.engine.in_flight",
+            help="engine chunks dispatched but not yet fetched (the "
+                 "bounded dispatch window)",
+        )
         # Model-quality observability (obs/quality.py; ISSUE 5): the
         # drift monitor + golden canary, or None when obs.quality is
         # off — the disabled serve path pays exactly one branch per
@@ -299,7 +310,10 @@ class ServingEngine:
             help="live requests shadow-scored through a staged-rollout "
                  "candidate generation",
         )
-        self._c_shadow_rows = self.registry.counter("serve.shadow.rows")
+        self._c_shadow_rows = self.registry.counter(
+            "serve.shadow.rows",
+            help="rows shadow-scored through a staged-rollout candidate",
+        )
         self._c_shadow_errors = self.registry.counter(
             "serve.shadow.errors",
             help="shadow-scoring failures (counted, never raised into "
@@ -767,9 +781,15 @@ class ServingEngine:
             c_pad = self._bucket_counters.get(bucket)
             if c_pad is None:
                 c_pad = self._bucket_counters[bucket] = self.registry.counter(
-                    f"serve.pad_rows_b{bucket}"
+                    f"serve.pad_rows_b{bucket}",
+                    help="pad waste: rows this bucket shape burned "
+                         "beyond real chunk rows",
                 )
-                self.registry.counter(f"serve.bucket_compiles_b{bucket}").inc()
+                self.registry.counter(
+                    f"serve.bucket_compiles_b{bucket}",
+                    help="ticks on this bucket's FIRST use; growth after "
+                         "warmup defeats compile-once-per-bucket",
+                ).inc()
             c_pad.inc(pad_rows)
             with span("serve.engine.pad_s", self.registry):
                 if pad_rows:

@@ -95,7 +95,11 @@ def retry_call(
                      "(utils/retry.py), all sites",
             ).inc()
             if site:
-                reg.counter(f"io.retries.{site}").inc()
+                reg.counter(
+                    f"io.retries.{site}",
+                    help="transient I/O failures retried at this one "
+                         "call site",
+                ).inc()
             delay = next(delays)
             absl_logging.warning(
                 "transient %s%s (attempt %d/%d), retrying in %.3fs: %s",

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# The one CI entry point (ISSUE 9 satellite): contract lint + the
+# curated quick test tier, fail-fast, machine-readable lint output.
+#
+#   bash scripts/ci_checks.sh            # lint + quick tier (~5 min)
+#   bash scripts/ci_checks.sh --lint-only
+#
+# graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
+# script propagates the first failure. See README §Development.
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$ROOT"
+
+echo "== graftlint (contract checker) =="
+python scripts/graftlint.py --json
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo "== quick test tier =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest -m quick -q \
+    -p no:cacheprovider

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""graftlint entry point: ``python scripts/graftlint.py [flags]``.
+
+Thin wrapper over ``python -m jama16_retina_tpu.analysis`` that pins
+the repo root to this checkout, so it works from any cwd. Exit codes:
+0 clean, 1 findings, 2 internal error. See docs/OBSERVABILITY.md and
+docs/RELIABILITY.md ("checked by graftlint") for what the rules pin.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from jama16_retina_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if not any(a.startswith("--root") for a in argv):
+        argv = [f"--root={_ROOT}"] + argv
+    sys.exit(main(argv))

@@ -64,6 +64,7 @@ _QUICK_FILES = {
     "test_preprocess.py",   # fundus normalize, binning, writer
     "test_mesh.py",         # mesh factoring + distributed env gating
     "test_obs.py",          # telemetry registry/export + instrumented fit
+    "test_analysis.py",     # graftlint rules + repo-lints-clean gate
 }
 _QUICK_TESTS = {
     # one DP≡single-device pin through the compiler

@@ -1,0 +1,781 @@
+"""graftlint (jama16_retina_tpu/analysis/) — ISSUE 9.
+
+Per rule: at least one purpose-built BAD fixture that must fire and one
+GOOD fixture that must stay quiet, exercised through the real Corpus
+loader over tmp mini-repos. Plus the CLI exit-code contract (0/1/2),
+the suppression/justification machinery, THE tier-1 gate
+``test_lint_repo_clean`` (the repo itself must lint clean forever), and
+the consolidated ``configs.override()`` dotted-path edge cases the
+config rule's grammar checking depends on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from jama16_retina_tpu import configs
+from jama16_retina_tpu.analysis import (
+    ConfigRule,
+    Corpus,
+    FaultsRule,
+    LocksRule,
+    MetricsRule,
+    PurityRule,
+    PytestMarksRule,
+    default_rules,
+)
+from jama16_retina_tpu.analysis import core as lint_core
+from jama16_retina_tpu.analysis.__main__ import main as lint_main
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_repo(tmp_path, files: dict, package: str = "pkg") -> Corpus:
+    """A mini-repo on disk -> Corpus (same loader the CLI uses)."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return Corpus(str(tmp_path), package=package)
+
+
+def run_rule(rule, corpus):
+    return rule.run(corpus)
+
+
+def codes(findings) -> set:
+    return {f.code for f in findings}
+
+
+GLOSSARY_HEADER = "| Metric | Kind | Meaning |\n|---|---|---|\n"
+
+
+# ---------------------------------------------------------------------------
+# metrics rule
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_fires_on_missing_help_and_undocumented(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "reg.counter('layer.thing')\n"
+            "reg.gauge('layer.other', help='fine')\n"
+        ),
+        "docs/OBSERVABILITY.md": (
+            "# obs\n\n" + GLOSSARY_HEADER
+            + "| `layer.other` | gauge | ok |\n"
+        ),
+    })
+    found = run_rule(MetricsRule(), corpus)
+    assert "metrics.help-missing" in codes(found)
+    assert "metrics.undocumented" in codes(found)
+    # file:line pointing at the offending registration
+    f = next(x for x in found if x.code == "metrics.help-missing")
+    assert f.path == "pkg/mod.py" and f.line == 1
+
+
+def test_metrics_quiet_on_documented_helped_metrics(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "for k in ('a', 'b'):\n"
+            "    reg.counter(f'layer.sub.{k}', help='per-k count')\n"
+            "reg.histogram('layer.lat_s', help='latency')\n"
+        ),
+        "docs/OBSERVABILITY.md": (
+            "# obs\n\n" + GLOSSARY_HEADER
+            + "| `layer.sub.{key}` | counter | per-key |\n"
+            + "| `layer.lat_s` | histogram | latency |\n"
+        ),
+    })
+    assert run_rule(MetricsRule(), corpus) == []
+
+
+def test_metrics_kind_conflict_and_grammar(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "reg.counter('layer.x', help='h')\n"
+            "reg.gauge('layer.x', help='h')\n"
+            "reg.counter('NotDotted', help='h')\n"
+        ),
+    })
+    found = run_rule(MetricsRule(), corpus)
+    assert "metrics.kind-conflict" in codes(found)
+    assert "metrics.name-grammar" in codes(found)
+
+
+def test_metrics_doc_orphan_and_help_conflict(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "reg.counter('layer.x', help='one meaning')\n"
+            "reg.counter('layer.x', help='another meaning')\n"
+        ),
+        "docs/RELIABILITY.md": (
+            "# rel\n\n" + GLOSSARY_HEADER
+            + "| `layer.x` | counter | ok |\n"
+            + "| `layer.gone` | counter | stale row |\n"
+        ),
+    })
+    found = run_rule(MetricsRule(), corpus)
+    assert "metrics.doc-orphan" in codes(found)
+    assert "metrics.help-conflict" in codes(found)
+    orphan = next(x for x in found if x.code == "metrics.doc-orphan")
+    assert orphan.path == "docs/RELIABILITY.md" and "layer.gone" in \
+        orphan.message
+
+
+def test_metrics_non_literal_name_fires(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": "def f(name):\n    return reg.histogram(name)\n",
+    })
+    assert "metrics.non-literal-name" in codes(
+        run_rule(MetricsRule(), corpus))
+
+
+def test_metrics_ignores_non_registry_receivers(tmp_path):
+    """np.histogram() and friends are numeric code, not metric
+    registrations — the rule pins the receiver to registry-like names
+    (review fix: a stray numpy call must never fail CI)."""
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "import numpy as np\n"
+            "def f(xs, registry):\n"
+            "    h, edges = np.histogram(xs, bins=50)\n"
+            "    stats.counter(xs)\n"
+            "    registry.counter('layer.x', help='h')\n"
+            "    lib.default_registry().gauge('layer.y', help='h')\n"
+            "    return h, edges\n"
+        ),
+        "docs/OBSERVABILITY.md": (
+            "# obs\n\n" + GLOSSARY_HEADER
+            + "| `layer.x` | counter | ok |\n"
+            + "| `layer.y` | gauge | ok |\n"
+        ),
+    })
+    assert run_rule(MetricsRule(), corpus) == []
+
+
+# ---------------------------------------------------------------------------
+# config rule
+# ---------------------------------------------------------------------------
+
+_CONFIGS_SRC = """\
+import dataclasses
+
+@dataclasses.dataclass(frozen=True)
+class SubConfig:
+    used_knob: int = 1
+    dead_knob: int = 2
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    sub: SubConfig = dataclasses.field(default_factory=SubConfig)
+    alert_rules: tuple = ()
+    watch_rules: tuple = ("m.ok < 1",)
+"""
+
+
+def test_config_dead_and_undocumented_knob(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/configs.py": _CONFIGS_SRC,
+        "pkg/user.py": (
+            "def f(cfg):\n"
+            "    return (cfg.sub.used_knob, cfg.alert_rules, "
+            "cfg.watch_rules)\n"
+        ),
+        "docs/X.md": "documents used_knob and sub and alert_rules "
+                     "and watch_rules\n",
+    })
+    found = run_rule(ConfigRule(), corpus)
+    dead = [f for f in found if f.code == "config.dead-knob"]
+    assert [f.key for f in dead] == ["knob::SubConfig.dead_knob"]
+    undoc = [f for f in found if f.code == "config.undocumented-knob"]
+    assert {f.key for f in undoc} == {"knob::SubConfig.dead_knob"}
+
+
+def test_config_quiet_when_knobs_consumed_and_documented(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/configs.py": _CONFIGS_SRC.replace("dead_knob", "live_knob"),
+        "pkg/user.py": (
+            "def f(cfg):\n"
+            "    _ = (cfg.alert_rules, cfg.watch_rules)\n"
+            "    return cfg.sub.used_knob + getattr(cfg.sub, 'live_knob')\n"
+        ),
+        "docs/X.md": "used_knob live_knob sub alert_rules watch_rules\n",
+    })
+    assert run_rule(ConfigRule(), corpus) == []
+
+
+def test_config_alert_grammar_in_defaults_and_docs(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/configs.py": _CONFIGS_SRC.replace(
+            'alert_rules: tuple = ()',
+            'alert_rules: tuple = ("quality.x >> 3",)',
+        ),
+        "pkg/user.py": "def f(c): return (c.sub.used_knob, c.sub.dead_knob,"
+                       " c.sub, c.alert_rules, c.watch_rules)\n",
+        "docs/X.md": (
+            "used_knob dead_knob sub alert_rules watch_rules\n"
+            "A good rule: `m.lat > 0.5 for 60 -> slo`\n"
+            "A bad rule: `m.lat > 0.5 oops`\n"
+        ),
+    })
+    found = run_rule(ConfigRule(), corpus)
+    bad = [f for f in found if f.code == "config.alert-grammar"]
+    assert {f.path for f in bad} == {"pkg/configs.py", "docs/X.md"}
+    assert all("cannot parse" in f.message for f in bad)
+
+
+def test_config_watch_context_rejects_rate_and_for(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/configs.py": _CONFIGS_SRC.replace(
+            'watch_rules: tuple = ("m.ok < 1",)',
+            'watch_rules: tuple = ("rate(m.x) > 0", "m.ok < 1 for 30")',
+        ),
+        "pkg/user.py": "def f(c): return (c.sub.used_knob, c.sub.dead_knob,"
+                       " c.sub, c.alert_rules, c.watch_rules)\n",
+        "docs/X.md": "used_knob dead_knob sub alert_rules watch_rules\n",
+    })
+    found = run_rule(ConfigRule(), corpus)
+    watch = [f for f in found if f.code == "config.watch-context"]
+    assert len(watch) == 2
+    assert any("rate()" in f.message for f in watch)
+    assert any("for N" in f.message or "'for" in f.message for f in watch)
+
+
+def test_config_watch_context_quiet_on_plain_rule(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": "mgr = Controller(watch_rules=('m.ok < 1',))\n",
+    })
+    assert run_rule(ConfigRule(), corpus) == []
+
+
+# ---------------------------------------------------------------------------
+# faults rule
+# ---------------------------------------------------------------------------
+
+_FAULTS_DECL = (
+    "SITES = {\n"
+    "    'a.read': 'seam a',\n"
+    "    'b.step': 'seam b',\n"
+    "}\n"
+)
+
+_RELIABILITY_DOC = (
+    "# rel\n\n## Fault injection howto\n\n"
+    "Sites: `a.read`, `b.step`.\n"
+)
+
+
+def test_faults_quiet_when_populations_agree(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/obs/faultinject.py": _FAULTS_DECL,
+        "pkg/mod.py": (
+            "from pkg.obs import faultinject\n"
+            "def f():\n"
+            "    faultinject.check('a.read')\n"
+            "    faultinject.corrupt('b.step', b'x')\n"
+        ),
+        "docs/RELIABILITY.md": _RELIABILITY_DOC,
+    })
+    assert run_rule(FaultsRule(), corpus) == []
+
+
+def test_faults_fires_on_undeclared_and_unfired_sites(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/obs/faultinject.py": _FAULTS_DECL,
+        "pkg/mod.py": (
+            "from pkg.obs import faultinject\n"
+            "def f():\n"
+            "    faultinject.check('a.read')\n"
+            "    faultinject.check('c.ghost')\n"
+            "    faultinject.arm({'d.ghost': {'kind': 'error'}})\n"
+        ),
+        "docs/RELIABILITY.md": _RELIABILITY_DOC + "Also `e.ghost`.\n",
+    })
+    found = run_rule(FaultsRule(), corpus)
+    unknown = {f.key for f in found if f.code == "faults.unknown-site"}
+    assert unknown == {"site::c.ghost", "site::d.ghost"}
+    assert {f.key for f in found if f.code == "faults.doc-unknown-site"} \
+        == {"site::e.ghost"}
+    # b.step is declared + documented but never fired
+    assert {f.key for f in found if f.code == "faults.never-fired"} \
+        == {"site::b.step"}
+
+
+def test_faults_undocumented_declared_site(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/obs/faultinject.py": _FAULTS_DECL,
+        "pkg/mod.py": (
+            "from pkg.obs import faultinject\n"
+            "def f():\n"
+            "    faultinject.check('a.read')\n"
+            "    faultinject.check('b.step')\n"
+        ),
+        "docs/RELIABILITY.md": (
+            "# rel\n\n## Fault injection howto\n\nSites: `a.read`.\n"
+        ),
+    })
+    found = run_rule(FaultsRule(), corpus)
+    assert {f.key for f in found if f.code == "faults.undocumented-site"} \
+        == {"site::b.step"}
+
+
+# ---------------------------------------------------------------------------
+# locks rule
+# ---------------------------------------------------------------------------
+
+
+def test_locks_fires_on_unguarded_cross_thread_write(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "import threading\n"
+            "class Shared:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "    def safe(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+            "    def racy(self):\n"
+            "        self._n = 0\n"
+        ),
+    })
+    found = run_rule(LocksRule(), corpus)
+    assert [f.code for f in found] == ["locks.unguarded-write"]
+    assert found[0].key == "pkg/mod.py::Shared.racy._n"
+    assert found[0].line == 10
+
+
+def test_locks_quiet_on_disciplined_class(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "import threading\n"
+            "class Shared:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n"
+            "        self._free = 0\n"
+            "    def safe(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n"
+            "    def _bump_locked(self):\n"
+            "        self._n += 1\n"  # caller-holds-the-lock convention
+            "    def single_writer(self):\n"
+            "        self._free = 1\n"  # never lock-guarded: not judged
+        ),
+    })
+    assert run_rule(LocksRule(), corpus) == []
+
+
+def test_locks_subscript_write_counts(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/mod.py": (
+            "import threading\n"
+            "class Shared:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._d = {}\n"
+            "    def safe(self, k):\n"
+            "        with self._lock:\n"
+            "            self._d[k] = 1\n"
+            "    def racy(self, k):\n"
+            "        self._d[k] = 2\n"
+        ),
+    })
+    found = run_rule(LocksRule(), corpus)
+    assert [f.key for f in found] == ["pkg/mod.py::Shared.racy._d"]
+
+
+# ---------------------------------------------------------------------------
+# purity rule
+# ---------------------------------------------------------------------------
+
+
+def test_purity_fires_on_clock_and_entropy_calls(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/sched.py": (
+            "import time, random\n"
+            "def decide(x):\n"
+            "    return x + time.time() + random.random()\n"
+        ),
+    })
+    found = run_rule(
+        PurityRule(targets=("pkg/sched.py::decide",)), corpus)
+    assert {f.key.split("::")[-1] for f in found} \
+        == {"time.time", "random.random"}
+    assert all(f.code == "purity.impure-call" for f in found)
+
+
+def test_purity_quiet_with_injected_clock(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/sched.py": (
+            "import time\n"
+            "def decide(x, now_fn=time.time):\n"
+            "    return x + now_fn()\n"  # call rides the injected seam
+        ),
+    })
+    assert run_rule(
+        PurityRule(targets=("pkg/sched.py::decide",)), corpus) == []
+
+
+def test_purity_module_target_and_pragma(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pkg/journal.py": (
+            "import os\n"
+            "def stamp():\n"
+            "    return os.urandom(8)\n"
+        ),
+        "pkg/other.py": (
+            "from datetime import datetime\n"
+            "def tagged():  # graftlint: deterministic\n"
+            "    return datetime.now()\n"
+        ),
+    })
+    found = run_rule(PurityRule(targets=("pkg/journal.py",)), corpus)
+    assert {f.key.split("::")[-1] for f in found} \
+        == {"os.urandom", "datetime.datetime.now"}
+
+
+# ---------------------------------------------------------------------------
+# pytest-marks rule
+# ---------------------------------------------------------------------------
+
+_PYTEST_INI = (
+    "[pytest]\n"
+    "markers =\n"
+    "    tier_a: registered marker\n"
+)
+
+
+def test_pytest_marks_fires_on_unregistered(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pytest.ini": _PYTEST_INI,
+        "tests/test_x.py": (
+            "import pytest\n"
+            "@pytest.mark.tier_b\n"
+            "def test_a():\n    pass\n"
+        ),
+    })
+    found = run_rule(PytestMarksRule(), corpus)
+    assert [f.key for f in found] == ["mark::tier_b"]
+
+
+def test_pytest_marks_quiet_on_registered_and_builtin(tmp_path):
+    corpus = make_repo(tmp_path, {
+        "pytest.ini": _PYTEST_INI,
+        "tests/test_x.py": (
+            "import pytest\n"
+            "@pytest.mark.tier_a\n"
+            "@pytest.mark.parametrize('v', [1])\n"
+            "def test_a(v):\n    pass\n"
+        ),
+    })
+    assert run_rule(PytestMarksRule(), corpus) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+
+def _lock_fixture_files(racy: bool) -> dict:
+    body = (
+        "import threading\n"
+        "class Shared:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def safe(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+    )
+    if racy:
+        body += "    def racy(self):\n        self._n = 0\n"
+    return {"jama16_retina_tpu/mod.py": body}
+
+
+def test_suppression_needs_reason_and_tracks_usage(tmp_path):
+    files = _lock_fixture_files(racy=True)
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    corpus = Corpus(str(tmp_path))
+    sup_path = tmp_path / ".graftlint.json"
+    # With a justified suppression: finding is hidden.
+    sup_path.write_text(json.dumps({"suppressions": [{
+        "code": "locks.unguarded-write",
+        "key": "jama16_retina_tpu/mod.py::Shared.racy._n",
+        "reason": "single-threaded setup path, documented",
+    }]}))
+    found = lint_core.run_rules(corpus, [LocksRule()],
+                                suppressions_path=str(sup_path))
+    assert found == []
+    # Without a reason: the suppression itself is the finding and the
+    # original violation still reports.
+    sup_path.write_text(json.dumps({"suppressions": [{
+        "code": "locks.unguarded-write",
+        "key": "jama16_retina_tpu/mod.py::Shared.racy._n",
+    }]}))
+    found = lint_core.run_rules(corpus, [LocksRule()],
+                                suppressions_path=str(sup_path))
+    assert codes(found) == {"core.suppression-no-reason",
+                            "locks.unguarded-write"}
+    # A suppression matching nothing is reported as unused.
+    sup_path.write_text(json.dumps({"suppressions": [{
+        "code": "locks.unguarded-write",
+        "key": "jama16_retina_tpu/mod.py::Shared.gone._n",
+        "reason": "stale",
+    }]}))
+    found = lint_core.run_rules(corpus, [LocksRule()],
+                                suppressions_path=str(sup_path))
+    assert codes(found) == {"core.suppression-unused",
+                            "locks.unguarded-write"}
+
+
+def test_rule_subset_does_not_misreport_other_rules_suppressions(tmp_path):
+    """A --rules subset run must not flag the whole-set suppression
+    file as unused (only suppressions of rules that RAN are judged)."""
+    files = _lock_fixture_files(racy=False)
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    sup_path = tmp_path / ".graftlint.json"
+    sup_path.write_text(json.dumps({"suppressions": [{
+        "code": "metrics.non-literal-name",
+        "key": "jama16_retina_tpu/other.py::helper",
+        "reason": "generic helper",
+    }]}))
+    corpus = Corpus(str(tmp_path))
+    # locks-only run: the metrics suppression is out of scope -> quiet.
+    assert lint_core.run_rules(corpus, [LocksRule()],
+                               suppressions_path=str(sup_path)) == []
+    # Full run (metrics included): now it IS unused.
+    found = lint_core.run_rules(corpus, [LocksRule(), MetricsRule()],
+                                suppressions_path=str(sup_path))
+    assert codes(found) == {"core.suppression-unused"}
+
+
+def test_baseline_subtracts_accepted_findings(tmp_path):
+    files = _lock_fixture_files(racy=True)
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    corpus = Corpus(str(tmp_path))
+    found = lint_core.run_rules(corpus, [LocksRule()])
+    assert len(found) == 1
+    base = tmp_path / "baseline.json"
+    lint_core.write_baseline(str(base), found)
+    again = lint_core.run_rules(
+        corpus, [LocksRule()],
+        baseline=lint_core.load_baseline(str(base)),
+    )
+    assert again == []
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (the acceptance bullets: each class of violation
+# flips a clean fixture repo's exit code to 1 with a file:line finding
+# naming the violated rule)
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+
+
+def _cli(tmp_path, *args) -> int:
+    return lint_main(["--root", str(tmp_path), *args])
+
+
+def test_cli_clean_repo_exits_0_and_json_shape(tmp_path, capsys):
+    _write(tmp_path, _lock_fixture_files(racy=False))
+    assert _cli(tmp_path, "--json") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == [] and "locks" in doc["rules"]
+
+
+def test_cli_deleting_a_glossary_line_flips_to_1(tmp_path, capsys):
+    files = {
+        "jama16_retina_tpu/mod.py": (
+            "reg.counter('layer.x', help='h')\n"
+            "reg.gauge('layer.y', help='h')\n"
+        ),
+        "docs/OBSERVABILITY.md": (
+            "# obs\n\n" + GLOSSARY_HEADER
+            + "| `layer.x` | counter | ok |\n"
+            + "| `layer.y` | gauge | ok |\n"
+        ),
+    }
+    _write(tmp_path, files)
+    assert _cli(tmp_path) == 0
+    capsys.readouterr()
+    # Delete one glossary row -> exit 1 with a finding naming the rule.
+    (tmp_path / "docs/OBSERVABILITY.md").write_text(
+        "# obs\n\n" + GLOSSARY_HEADER
+        + "| `layer.y` | gauge | ok |\n")
+    assert _cli(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "metrics.undocumented" in out
+    assert "jama16_retina_tpu/mod.py:1" in out
+
+
+def test_cli_unregistered_fault_site_flips_to_1(tmp_path, capsys):
+    files = {
+        "jama16_retina_tpu/obs/faultinject.py": _FAULTS_DECL,
+        "jama16_retina_tpu/mod.py": (
+            "from jama16_retina_tpu.obs import faultinject\n"
+            "def f():\n"
+            "    faultinject.check('a.read')\n"
+            "    faultinject.check('b.step')\n"
+        ),
+        "docs/RELIABILITY.md": _RELIABILITY_DOC,
+    }
+    _write(tmp_path, files)
+    assert _cli(tmp_path) == 0
+    capsys.readouterr()
+    (tmp_path / "jama16_retina_tpu/mod.py").write_text(
+        "from jama16_retina_tpu.obs import faultinject\n"
+        "def f():\n"
+        "    faultinject.check('a.read')\n"
+        "    faultinject.check('b.step')\n"
+        "    faultinject.check('never.declared')\n"
+    )
+    assert _cli(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "faults.unknown-site" in out
+    assert "jama16_retina_tpu/mod.py:5" in out
+
+
+def test_cli_unguarded_write_flips_to_1(tmp_path, capsys):
+    _write(tmp_path, _lock_fixture_files(racy=False))
+    assert _cli(tmp_path) == 0
+    capsys.readouterr()
+    _write(tmp_path, _lock_fixture_files(racy=True))
+    assert _cli(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "locks.unguarded-write" in out
+    assert "jama16_retina_tpu/mod.py:" in out
+
+
+def test_cli_unknown_rule_exits_2(tmp_path):
+    assert _cli(tmp_path, "--rules", "nonsense") == 2
+
+
+def test_cli_empty_corpus_exits_2_not_clean(tmp_path):
+    """A mis-pointed --root must be loud (review fix): zero scanned
+    files would make every rule vacuously pass."""
+    (tmp_path / "empty").mkdir()
+    assert _cli(tmp_path / "empty") == 2
+
+
+def test_cli_rule_subset_and_list_rules(tmp_path, capsys):
+    _write(tmp_path, _lock_fixture_files(racy=True))
+    assert _cli(tmp_path, "--rules", "purity") == 0  # locks not selected
+    capsys.readouterr()
+    assert lint_main(["--list-rules"]) == 0
+    names = capsys.readouterr().out.split()
+    assert {"metrics", "config", "faults", "locks", "purity"} <= set(names)
+
+
+# ---------------------------------------------------------------------------
+# THE tier-1 gate: this repository lints clean, forever
+# ---------------------------------------------------------------------------
+
+
+def test_lint_repo_clean():
+    corpus = Corpus(REPO_ROOT)
+    found = lint_core.run_rules(corpus, default_rules())
+    assert found == [], (
+        "graftlint found contract violations:\n"
+        + "\n".join(f.render() for f in found)
+    )
+
+
+def test_repo_fault_sites_registry_matches_wired_seams():
+    """The declared vocabulary is exactly the seams PR 6/8 wired."""
+    from jama16_retina_tpu.obs import faultinject
+
+    assert set(faultinject.SITES) == {
+        "tfrecord.read", "host.decode", "ckpt.restore", "engine.dispatch",
+        "trainer.step", "lifecycle.retrain", "lifecycle.gate",
+        "lifecycle.swap",
+    }
+    assert all(desc for desc in faultinject.SITES.values())
+
+
+# ---------------------------------------------------------------------------
+# configs.override() dotted-path edge cases (ISSUE 9 satellite —
+# consolidated here because the config rule's grammar/context checks
+# ride the same override surface)
+# ---------------------------------------------------------------------------
+
+
+class TestOverrideEdgeCases:
+    def test_empty_default_int_tuple_parses_ints(self):
+        cfg = configs.get_config("smoke")
+        out = configs.override(cfg, ["serve.bucket_sizes=8,16,32"])
+        assert out.serve.bucket_sizes == (8, 16, 32)
+
+    def test_empty_default_str_tuple_stays_str(self):
+        cfg = configs.get_config("smoke")
+        out = configs.override(cfg, ["eval.ensemble_dirs=20260801,ckpt2"])
+        assert out.eval.ensemble_dirs == ("20260801", "ckpt2")
+
+    def test_nonempty_float_tuple_uses_element_type(self):
+        cfg = configs.get_config("smoke")
+        out = configs.override(cfg, ["data.contrast_range=0.5,1.5"])
+        assert out.data.contrast_range == (0.5, 1.5)
+
+    def test_nested_unknown_key_did_you_mean(self):
+        cfg = configs.get_config("smoke")
+        with pytest.raises(ValueError) as e:
+            configs.override(cfg, ["obs.quality.enabledd=true"])
+        assert "did you mean 'enabled'" in str(e.value)
+        assert "QualityConfig" in str(e.value)  # valid-field listing
+
+    def test_unknown_middle_segment_did_you_mean(self):
+        cfg = configs.get_config("smoke")
+        with pytest.raises(ValueError) as e:
+            configs.override(cfg, ["obs.qualiti.enabled=true"])
+        assert "did you mean 'quality'" in str(e.value)
+
+    def test_section_assignment_rejected(self):
+        cfg = configs.get_config("smoke")
+        with pytest.raises(ValueError, match="set its fields individually"):
+            configs.override(cfg, ["obs.quality=on"])
+
+    def test_over_deep_path_is_clean_valueerror(self):
+        cfg = configs.get_config("smoke")
+        with pytest.raises(ValueError, match="remove the extra segment"):
+            configs.override(cfg, ["train.steps.x=1"])
+
+    def test_property_is_not_a_field(self):
+        cfg = configs.get_config("smoke")
+        with pytest.raises(ValueError, match="unknown config field"):
+            configs.override(cfg, ["model.num_classes=3"])
+
+    def test_bad_value_names_the_override(self):
+        cfg = configs.get_config("smoke")
+        with pytest.raises(ValueError, match="train.steps=banana"):
+            configs.override(cfg, ["train.steps=banana"])
+
+    def test_nested_override_applies(self):
+        cfg = configs.get_config("smoke")
+        out = configs.override(
+            cfg, ["obs.quality.enabled=true", "obs.quality.window_scores=64"]
+        )
+        assert out.obs.quality.enabled is True
+        assert out.obs.quality.window_scores == 64
+        # untouched siblings survive the frozen-chain rebuild
+        assert out.obs.flush_every_s == cfg.obs.flush_every_s

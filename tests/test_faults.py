@@ -77,15 +77,38 @@ def test_fault_plan_spec_parse_and_validation(tmp_path):
         faultinject.plan_from_spec({"x": {"error": "SystemExit"}})
 
 
+def test_unknown_site_rejected_with_did_you_mean():
+    """ISSUE 9 satellite: a typo'd site refuses loudly at arm time
+    (naming the close match) instead of silently never firing."""
+    with pytest.raises(ValueError, match="did you mean 'trainer.step'"):
+        faultinject.plan_from_spec({"trainer.stpe": {"kind": "error"}})
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faultinject.arm({"nonsense.site": {"kind": "error"}})
+    # arm() validates pre-built FaultPlan instances too.
+    plan = faultinject.plan_from_spec(
+        {"bogus.seam": {"kind": "error"}}, allow_unknown=True
+    )
+    with pytest.raises(ValueError, match="bogus.seam"):
+        faultinject.arm(plan)
+    assert faultinject.active_plan() is None
+    # Every DECLARED site arms cleanly.
+    ok = faultinject.plan_from_spec(
+        {s: {"kind": "error", "on_calls": [1]} for s in faultinject.SITES}
+    )
+    faultinject.arm(ok)
+    faultinject.disarm()
+
+
 def test_raise_on_nth_call_is_deterministic():
     """The whole point of the harness: the SAME plan injects at the
     SAME call ordinals, run after run."""
     for _ in range(3):
         plan = faultinject.plan_from_spec(
             {"s": {"kind": "error", "on_calls": [2, 4],
-                   "error": "ValueError"}}
+                   "error": "ValueError"}},
+            allow_unknown=True,  # synthetic site: machinery test
         )
-        faultinject.arm(plan)
+        faultinject.arm(plan, allow_unknown=True)
         outcomes = []
         for _i in range(5):
             try:
@@ -100,9 +123,10 @@ def test_raise_on_nth_call_is_deterministic():
 
 def test_every_n_and_max_fires_modes():
     plan = faultinject.plan_from_spec(
-        {"s": {"kind": "error", "every": 2, "max_fires": 2}}
+        {"s": {"kind": "error", "every": 2, "max_fires": 2}},
+        allow_unknown=True,
     )
-    faultinject.arm(plan)
+    faultinject.arm(plan, allow_unknown=True)
     fired = 0
     for _ in range(10):
         try:
@@ -113,21 +137,23 @@ def test_every_n_and_max_fires_modes():
 
 
 def test_corrupt_seam_damages_bytes_deterministically():
-    faultinject.arm({"s": {"kind": "corrupt", "on_calls": [2]}})
+    faultinject.arm({"s": {"kind": "corrupt", "on_calls": [2]}},
+                    allow_unknown=True)
     data = b"hello world payload"
     assert faultinject.corrupt("s", data) == data
     bad = faultinject.corrupt("s", data)
     assert bad != data and len(bad) == len(data) // 2
     assert faultinject.corrupt("s", data) == data
     # Deterministic damage: the same input corrupts identically.
-    faultinject.arm({"s": {"kind": "corrupt", "on_calls": [1]}})
+    faultinject.arm({"s": {"kind": "corrupt", "on_calls": [1]}},
+                    allow_unknown=True)
     assert faultinject.corrupt("s", data) == bad
 
 
 def test_unarmed_check_is_noop_and_unknown_site_inert():
     faultinject.disarm()
     faultinject.check("anything")  # no plan: pure branch
-    faultinject.arm({"s": {"kind": "error"}})
+    faultinject.arm({"s": {"kind": "error"}}, allow_unknown=True)
     faultinject.check("other.site")  # armed plan, unlisted site: inert
 
 
