@@ -509,6 +509,19 @@ def _robustness_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _cheappath_overhead_guard(extras: dict, rate_on: float,
+                              rate_off: float,
+                              max_overhead: float = 0.02) -> bool:
+    """ISSUE 10's pin, same shared math: device_only plus the per-batch
+    bookkeeping the cheap-path layer adds OFF-DEVICE — the cascade's
+    escalation-band mask + row counters and the compile-cache's
+    per-bucket executable-table lookup — must stay within 2% of the
+    uninstrumented headline. The contract that lets the cascade/cache
+    wrappers sit on every request instead of behind a build flag."""
+    return _overhead_guard(extras, "cheappath", rate_on, rate_off,
+                           max_overhead)
+
+
 def _chaos_smoke(extras: dict) -> None:
     """``--chaos``: deterministically drive every recovery path the
     reliability layer claims, off-device (tiny batcher + fake infer +
@@ -555,6 +568,11 @@ def _chaos_smoke(extras: dict) -> None:
         "lifecycle.swap": {"kind": "error", "on_calls": [1],
                            "error": "RuntimeError",
                            "message": "chaos swap"},
+        # Compile cache (ISSUE 10): the first entry load fails — must
+        # degrade to a counted recompile, never surface to a request.
+        "serve.compile_cache.load": {"kind": "error", "on_calls": [1],
+                                     "error": "OSError",
+                                     "message": "chaos cache load"},
     })
     prev = faultinject.arm(plan)
     try:
@@ -613,6 +631,35 @@ def _chaos_smoke(extras: dict) -> None:
         ok &= reg.counter("serve.batcher.window_errors").value >= 1
         ok &= reg.counter("serve.shed.deadline").value >= 1
         ok &= reg.counter("serve.shed.queue_depth").value >= 1
+
+        # 2b) Compile cache (ISSUE 10): the injected first load fails
+        #     and must degrade to a counted miss (the recompile path),
+        #     the second load hits, and a directory built for another
+        #     fingerprint is refused loudly, never served.
+        import jax
+        import jax.numpy as jnp
+
+        from jama16_retina_tpu.serve.compilecache import (
+            CompileCache,
+            CompileCacheStale,
+        )
+
+        with tempfile.TemporaryDirectory() as cd:
+            cache = CompileCache(cd, {"probe": 1}, registry=reg)
+            probe = jax.jit(lambda x: x + 1).lower(
+                jnp.zeros((2,), jnp.float32)
+            ).compile()
+            saved = cache.save("probe", probe)
+            ok &= cache.load("probe") is None  # injected: degrade
+            ok &= reg.counter("serve.compile_cache.misses").value >= 1
+            if saved:  # backends without executable serialization skip
+                ok &= cache.load("probe") is not None  # real deserialize
+                ok &= reg.counter("serve.compile_cache.hits").value >= 1
+            try:
+                CompileCache(cd, {"probe": 2}, registry=reg)
+                ok = False  # stale fingerprint must refuse
+            except CompileCacheStale:
+                pass
 
         # 3) Lifecycle plane (ISSUE 8): the journaled state machine
         #    driven through all three injected fault sites, off-device
@@ -931,6 +978,12 @@ def main() -> None:
         help="skip the autotuned-ingest section (pipeline_fed_autotuned: "
              "the closed-loop tuner converging from pessimal knobs; its "
              "convergence windows cost ~60 extra train steps)",
+    )
+    parser.add_argument(
+        "--skip_frontier", action="store_true",
+        help="skip the serve_frontier latency/throughput sweep "
+             "(serve.bucket_sizes x concurrency; one serving compile "
+             "per swept bucket)",
     )
     parser.add_argument(
         "--chaos", action="store_true",
@@ -1273,6 +1326,53 @@ def main() -> None:
                      f"actions {l_actions}")
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"lifecycle overhead bench failed: "
+                 f"{type(e).__name__}: {e}")
+
+    # Cheap-path overhead pin (ISSUE 10): the same device_only window
+    # plus the per-batch host bookkeeping the cascade + compile-cache
+    # layer adds to every request — the escalation-band mask over a
+    # batch of scores, the student/escalated row counters, and the
+    # per-bucket compiled-executable table lookup the engine's dispatch
+    # now performs. Same ≤2% budget, shared guard math.
+    if not headline_serialized:
+        try:
+            from jama16_retina_tpu.obs.registry import Registry
+
+            cp_reg = Registry()
+            c_student = cp_reg.counter("serve.cascade.student_rows")
+            c_escal = cp_reg.counter("serve.cascade.escalated_rows")
+            compiled_table = {batch_size: step}
+            cp_thresholds = (0.5,)
+            cp_band = 0.05
+            cp_scores = np.random.default_rng(13).random(batch_size)
+
+            def cheappath_step(s, batch, k):
+                fn = compiled_table.get(batch_size, step)
+                out = fn(s, batch, k)
+                mask = np.zeros(batch_size, bool)
+                for thr in cp_thresholds:
+                    mask |= np.abs(cp_scores - thr) <= cp_band
+                c_student.inc(batch_size)
+                n_esc = int(mask.sum())
+                if n_esc:
+                    c_escal.inc(n_esc)
+                return out
+
+            rate_cp, state = _timed_steps(
+                cheappath_step, state,
+                lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_cp = _publish(
+                extras, "device_only_cheappath", rate_cp,
+                flops_per_image, peak,
+                suffix=" (device_only + cascade band mask/counters + "
+                       "compiled-table lookup per batch)",
+            )
+            if rate_cp is not None:
+                _cheappath_overhead_guard(extras, rate_cp, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"cheap-path overhead bench failed: "
                  f"{type(e).__name__}: {e}")
 
     if args.chaos:
@@ -1846,6 +1946,183 @@ def main() -> None:
                 )
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"serve bench failed: {type(e).__name__}: {e}")
+
+        # Per-dtype serving rows (ISSUE 10): the SAME k=4 stacked
+        # workload with the engine's precision axis at bf16 (cast
+        # stacked params — half the weight HBM traffic) and int8 (AQT
+        # per-channel weight quantization, dequant fused into the one
+        # serving program). Each row's physics guard uses its own
+        # compiled program's FLOPs; the _vs_fp32 ratio is the dial's
+        # measured payoff on this chip.
+        try:
+            for d in ("bf16", "int8"):
+                dcfg = serve_cfg.replace(serve=dataclasses.replace(
+                    serve_cfg.serve, dtype=d,
+                ))
+                eng_d = ServingEngine(
+                    dcfg, model=model, mesh=mesh, state=st4
+                )
+                flops_d = _flops_of(
+                    eng_d._step, eng_d.state, {"image": imgs}
+                )
+                eng_d.probs(imgs)  # compile + warm
+                n_calls = 25
+                t0 = time.perf_counter()
+                for _ in range(n_calls):
+                    eng_d.probs(imgs)
+                dt = time.perf_counter() - t0
+                rate_d = _publish(
+                    extras, f"serve_dtype_{d}_images_per_sec",
+                    n_calls * eval_bs / dt / n_dev,
+                    flops_d / eval_bs if flops_d else None, peak,
+                    suffix=f" (k=4 stacked engine, serve.dtype={d})",
+                )
+                if rate_d is not None and rate4 is not None:
+                    extras[f"serve_dtype_{d}_vs_fp32"] = round(
+                        rate_d / rate4, 2
+                    )
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"serve dtype bench failed: {type(e).__name__}: {e}")
+
+        # Distilled-cascade speedup (ISSUE 10 acceptance): student (k=1)
+        # scores everything, only rows inside the escalation band pay
+        # the k=4 stacked ensemble. The band is CALIBRATED on the
+        # student's own score distribution so ~15% of rows escalate —
+        # the <=20% regime the >=2x acceptance bar names (a synthetic
+        # stand-in for "most traffic is nowhere near the operating
+        # thresholds", which random-init members cannot exhibit
+        # naturally).
+        try:
+            from jama16_retina_tpu.serve.cascade import CascadeEngine
+
+            s_scores = np.asarray(eng1.probs(imgs), np.float64)
+            thr = float(np.quantile(s_scores, 0.85))
+            band = float(np.quantile(np.abs(s_scores - thr), 0.15))
+            casc_cfg = serve_cfg.replace(serve=dataclasses.replace(
+                serve_cfg.serve,
+                cascade_band=band, cascade_thresholds=(thr,),
+            ))
+            casc = CascadeEngine(casc_cfg, eng1, eng4)
+            casc.probs(imgs)  # warm both halves through the cascade
+            c_student = casc._c_student_rows.value
+            c_escal = casc._c_escalated_rows.value
+            n_calls = 25
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                casc.probs(imgs)
+            dt = time.perf_counter() - t0
+            d_student = casc._c_student_rows.value - c_student
+            d_escal = casc._c_escalated_rows.value - c_escal
+            frac = d_escal / max(1.0, d_student)
+            rate_c = _publish(
+                extras, "serve_cascade_images_per_sec",
+                n_calls * eval_bs / dt / n_dev,
+                serve_flops / eval_bs if serve_flops else None, peak,
+                suffix=(f" (distilled cascade, {frac:.0%} of rows "
+                        "escalated to the k=4 ensemble)"),
+            )
+            extras["cascade_escalated_fraction"] = round(frac, 3)
+            if rate_c is not None and rate4 is not None:
+                extras["cascade_speedup"] = round(rate_c / rate4, 2)
+                _log(f"cascade_speedup: {extras['cascade_speedup']}x "
+                     f"over the always-stacked k=4 baseline")
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"cascade bench failed: {type(e).__name__}: {e}")
+
+        # Zero cold-start (ISSUE 10): construction -> first served
+        # request, cold (empty persistent compile cache: every bucket
+        # is one real AOT compile, saved) vs warm (a second engine over
+        # the SAME cache: every bucket deserializes). The warm number
+        # is what an engine restart / reload-candidate warmup costs
+        # with the cache populated. (On repeat bench invocations the
+        # cold row may understate a true first-boot compile: jax's own
+        # persistent compilation cache — enabled process-wide above —
+        # can pre-warm the lower+compile; the hit/miss counters in
+        # tests pin the reuse contract exactly.)
+        try:
+            import shutil
+            import tempfile
+
+            cache_dir = os.path.join(
+                tempfile.gettempdir(), "retina_bench_serve_cache"
+            )
+            shutil.rmtree(cache_dir, ignore_errors=True)
+            cache_cfg = serve_cfg.replace(serve=dataclasses.replace(
+                serve_cfg.serve, compile_cache_dir=cache_dir,
+            ))
+            t0 = time.perf_counter()
+            eng_cold = ServingEngine(
+                cache_cfg, model=model, mesh=mesh, state=st4
+            )
+            eng_cold.probs(imgs)
+            cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            eng_warm = ServingEngine(
+                cache_cfg, model=model, mesh=mesh, state=st4
+            )
+            eng_warm.probs(imgs)
+            warm = time.perf_counter() - t0
+            extras["serve_cold_start_sec"] = round(cold, 2)
+            extras["serve_warm_start_sec"] = round(warm, 2)
+            extras["serve_warm_start_frac"] = round(warm / cold, 3)
+            _log(f"serve cold start {cold:.2f}s -> warm restart "
+                 f"{warm:.2f}s ({warm / cold:.1%}) off the persistent "
+                 "compile cache")
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"compile-cache bench failed: {type(e).__name__}: {e}")
+
+        # Latency/throughput frontier (ISSUE 10 satellite; "Batch Size
+        # Influence on GPU/TPU Performance", PAPERS.md): sweep
+        # serve.bucket_sizes x offered concurrency instead of the PR-2
+        # spot checks, so bucket policy is chosen from a MEASURED
+        # frontier. One serving compile per swept bucket.
+        if not args.skip_frontier:
+            try:
+                frontier = []
+                for b in sorted({8, 16, eval_bs}):
+                    fcfg = cfg.replace(serve=dataclasses.replace(
+                        cfg.serve, max_batch=b, bucket_sizes=(b,),
+                        max_wait_ms=2.0,
+                    ))
+                    eng_f = ServingEngine(
+                        fcfg, model=model, mesh=mesh, state=st4
+                    )
+                    eng_f.probs(imgs[:b])  # compile + warm
+                    one = imgs[:1]
+                    for conc in (1, 8, 32):
+                        batcher = eng_f.make_batcher()
+                        try:
+                            lats, window = _offered_load(
+                                batcher.submit, conc, 20,
+                                lambda w, i: one,
+                            )
+                        finally:
+                            batcher.close()
+                        s = _latency_summary(lats)
+                        rate = len(lats) / window / n_dev
+                        guarded = _physics_guard(
+                            f"serve_frontier_b{b}_c{conc}", rate,
+                            flops4_per_image, peak,
+                        )
+                        frontier.append({
+                            "bucket": int(b),
+                            "concurrency": int(conc),
+                            "images_per_sec": (
+                                round(rate, 2) if guarded is not None
+                                else None
+                            ),
+                            "p50_ms": s["p50_ms"],
+                            "p99_ms": s["p99_ms"],
+                        })
+                        _log(
+                            f"frontier b{b} c{conc}: "
+                            f"{rate:.1f} img/s, p50 {s['p50_ms']} ms / "
+                            f"p99 {s['p99_ms']} ms"
+                        )
+                extras["serve_frontier"] = frontier
+            except Exception as e:  # pragma: no cover - bench emits JSON
+                _log(f"serve frontier bench failed: "
+                     f"{type(e).__name__}: {e}")
 
     extras["device_only"] = round(device_only, 2)
     print(json.dumps({

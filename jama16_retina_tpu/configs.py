@@ -195,6 +195,17 @@ class TrainConfig:
     # training from random init. Ignored when resume finds an existing
     # checkpoint in the workdir (a resumed run continues itself).
     init_from: str = ""
+    # Ensemble distillation (ISSUE 10 cascade): an ensemble root (or
+    # single checkpoint dir) whose members are restored ONCE into a
+    # device-resident stacked teacher; the run's loss then trains the
+    # student against the teacher's AVERAGED SOFT SCORES on each batch
+    # (sigmoid-BCE with soft targets on the binary head, soft-target CE
+    # on the multi head) instead of the dataset's hard grades. The
+    # student is what serve.cascade_student_dir points a CascadeEngine
+    # at; combine with init_from to warm-start it from a teacher
+    # member. Teacher members must share model.* with this run (same
+    # checkpoint schema). Empty disables (hard labels, the default).
+    distill_from: str = ""
     # Checkpoint every Nth eval (plus ALWAYS the final/early-stop eval,
     # so the run ends durable). 1 = the reference's save-every-eval
     # semantics. Raising it trades resume granularity and best-
@@ -376,6 +387,49 @@ class ServeConfig:
     # serve.DeadlineExceeded BEFORE any device work is spent on it,
     # counted under serve.shed.deadline.
     default_deadline_ms: float = 0.0
+    # --- Cheap-path serving (ISSUE 10) ---------------------------------
+    # Inference dtype of the stacked serving tree: "fp32" (restored
+    # params verbatim — the bit-identity default every parity pin rides),
+    # "bf16" (float params cast to bfloat16 at stacking: half the weight
+    # HBM traffic, float-level score drift), or "int8" (rank>=2 kernels
+    # quantized to symmetric per-output-channel int8 via AQT, dequantized
+    # inside the one serving program so HBM holds int8 + scales). Non-
+    # fp32 engines are REFUSED at construction (typed DtypeRejected)
+    # when their golden-canary deviation exceeds dtype_canary_max_dev —
+    # a quantized engine must prove operating-point parity before it can
+    # take a request (serve/quantize.py; docs/PERF.md §Cheap-path).
+    dtype: str = "fp32"
+    # Max |score - pinned canary| a non-fp32 engine may show at its
+    # construction gate (only binds when a pinned golden canary is
+    # configured; fp32 keeps the byte-stability contract instead).
+    dtype_canary_max_dev: float = 0.05
+    # Distilled-cascade escalation half-width: requests first score
+    # through the student engine, and only rows whose referable score
+    # lands within this band of ANY cascade_thresholds entry re-score
+    # through the full stacked ensemble (serve/cascade.py). 0 escalates
+    # only exact threshold hits; the operating band is a measured
+    # quality/cost dial — AUC at the operating points is gated before a
+    # cascade goes live (CascadeEngine.go_live).
+    cascade_band: float = 0.05
+    # Operating thresholds the cascade escalates around (normally the
+    # evaluate.py operating points the deployment screens at). Empty =
+    # (0.5,), the neutral decision boundary.
+    cascade_thresholds: tuple[float, ...] = ()
+    # Student checkpoint dir (the train.distill_from product) that makes
+    # predict.py serve through a CascadeEngine: student always scores,
+    # the full --checkpoint_dir ensemble only sees escalated rows.
+    # Empty keeps the plain ensemble engine.
+    cascade_student_dir: str = ""
+    # Persistent AOT compilation cache (serve/compilecache.py): per
+    # (bucket, mesh shape, dtype, member count) serialized executables
+    # under a model-fingerprinted directory, written atomically with the
+    # rawshard-manifest discipline. A warm engine restart deserializes
+    # instead of recompiling — seconds instead of the ~79 s BENCH_r01
+    # cold start. A corrupt/missing entry degrades to a COUNTED
+    # recompile (serve.compile_cache.misses), never a failed request; a
+    # directory built for a different model fingerprint is refused with
+    # a typed error naming the rebuild command. Empty disables.
+    compile_cache_dir: str = ""
     # --- Lifecycle / rollback (ISSUE 8) --------------------------------
     # Seconds the engine RETAINS the previous generation's device-
     # resident stacked tree after a hot swap: within this window

@@ -131,6 +131,21 @@ class LifecycleController:
         self.lc = cfg.lifecycle
         self.workdir = workdir
         self.dir = os.path.join(workdir, "lifecycle")
+        # Cascade-aware rollout (ISSUE 10): a CascadeEngine unwraps to
+        # its ENSEMBLE half — drift retrains, gates, shadow scoring,
+        # swap, and rollback all act on the expensive stacked model,
+        # while the distilled student keeps serving the cheap path
+        # through every phase (the cascade's probs() reads the
+        # ensemble's live generation handle on each escalation, so a
+        # promote is visible to cascade traffic the same atomic swap
+        # it is to direct traffic). The student itself is retrained
+        # offline (train.distill_from against the new ensemble) and
+        # replaced by constructing a fresh cascade.
+        self.cascade = None
+        if (engine is not None and hasattr(engine, "student")
+                and hasattr(engine, "ensemble")):
+            self.cascade = engine
+            engine = engine.ensemble
         self.engine = engine
         self.data_dir = data_dir
         self._live_fallback = (

@@ -65,6 +65,10 @@ SITES = {
     "ckpt.restore": "Checkpointer.restore (utils/checkpoint.py)",
     "engine.dispatch": "ServingEngine per-chunk dispatch "
                        "(serve/engine.py)",
+    "serve.compile_cache.load": "persistent AOT compile-cache entry "
+                                "deserialize (serve/compilecache.py; a "
+                                "failed load degrades to a counted "
+                                "recompile, never a failed request)",
     "trainer.step": "the trainer loops' per-step boundary",
     "lifecycle.retrain": "LifecycleController RETRAIN phase entry",
     "lifecycle.gate": "LifecycleController GATE evaluation (an injected "

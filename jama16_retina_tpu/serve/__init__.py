@@ -2,7 +2,7 @@
 device-resident stacked ensembles (the first subsystem on the serving
 half of the ROADMAP north star).
 
-Three layers, composable and individually testable:
+Layers, composable and individually testable:
 
   * ``engine``  — ServingEngine: restore every ensemble member ONCE,
     stack them into one device-resident [k] parameter tree
@@ -18,6 +18,15 @@ Three layers, composable and individually testable:
   * ``host``    — the host stage: fundus normalization parallelized
     across a worker pool with worker-count-invariant output order
     (the ParallelDecoder pattern applied to raw photographs).
+  * ``cascade`` — CascadeEngine (ISSUE 10): a distilled student scores
+    every row, only scores inside ``serve.cascade_band`` of the
+    operating thresholds escalate to the full stacked ensemble —
+    gated by golden-canary + operating-point parity before go-live.
+  * ``quantize`` — the ``serve.dtype`` axis (fp32/bf16/int8-via-AQT
+    stacked-state transforms), canary-gated at engine construction.
+  * ``compilecache`` — persistent per-(bucket, mesh, dtype, k) AOT
+    executable cache: engine restart deserializes in seconds instead
+    of re-paying the ~79 s warmup+compile (docs/PERF.md §Cheap-path).
 
 predict.py rides this stack for --device={tpu,cpu}; bench.py's
 ``serve_*`` section measures it under the round-3 fenced discipline.
@@ -28,7 +37,13 @@ from jama16_retina_tpu.serve.batcher import (
     MicroBatcher,
     Overloaded,
 )
+from jama16_retina_tpu.serve.cascade import CascadeEngine, CascadeRejected
+from jama16_retina_tpu.serve.compilecache import (
+    CompileCache,
+    CompileCacheStale,
+)
 from jama16_retina_tpu.serve.engine import (
+    DtypeRejected,
     ReloadRejected,
     RollbackUnavailable,
     ServingEngine,
@@ -36,7 +51,12 @@ from jama16_retina_tpu.serve.engine import (
 )
 
 __all__ = [
+    "CascadeEngine",
+    "CascadeRejected",
+    "CompileCache",
+    "CompileCacheStale",
     "DeadlineExceeded",
+    "DtypeRejected",
     "MicroBatcher",
     "Overloaded",
     "ReloadRejected",

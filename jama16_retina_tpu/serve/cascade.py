@@ -1,0 +1,334 @@
+"""Distilled ensemble cascade: cheap by default, expensive by exception
+(ISSUE 10 tentpole).
+
+The k-member stacked ensemble pays k member-forwards for EVERY row, yet
+almost all screening traffic is nowhere near the operating thresholds —
+the region where ensemble averaging actually changes decisions. The
+cascade makes that asymmetry structural:
+
+  * a distilled STUDENT (one model trained on the live ensemble's
+    averaged soft scores; ``train.distill_from``) scores every request —
+    ~1/k the FLOPs of the stacked ensemble;
+  * only rows whose student referable score lands within
+    ``serve.cascade_band`` of ANY ``serve.cascade_thresholds`` entry
+    ESCALATE to the full stacked ensemble, whose scores replace the
+    student's for exactly those rows;
+  * everything else ships the student score untouched.
+
+With <=20% of traffic in the band, effective ensemble-throughput is
+>=2x the always-stacked baseline (benched as ``cascade_speedup``); the
+edges degenerate correctly — band 0 escalates only exact threshold
+hits, a band covering [0, 1] escalates everything (= the plain
+ensemble, bit for bit).
+
+Quality is pinned BEFORE a cascade config can go live, through the
+same PR-5/PR-8 gate machinery reload candidates pass
+(lifecycle.GateVerdict): ``go_live()`` evaluates the ``golden_canary``
+verdict (cascade scores vs the pinned golden set) and the ``auc_floor``
+verdict (cascade AUC on labeled rows >= full-ensemble AUC - delta, with
+per-operating-threshold sensitivity/specificity in the detail) and
+raises typed :class:`CascadeRejected` on any failure — a cascade that
+moves the operating points never takes a request.
+
+Lifecycle: the controller treats a CascadeEngine as its ensemble half
+(lifecycle/controller.py unwraps it) — drift-triggered retrains swap
+the STACKED ensemble under the cascade while the student keeps serving
+the cheap path; ``reload``/``rollback``/``release_retained`` delegate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.configs import ExperimentConfig
+from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import registry as obs_registry
+
+
+class CascadeRejected(RuntimeError):
+    """The cascade failed its go-live gate (golden-canary deviation or
+    an operating-point AUC floor miss): the student/band pair must not
+    serve — retrain the student (train.distill_from), widen the band,
+    or serve the plain ensemble."""
+
+
+def _referable(scores: np.ndarray) -> np.ndarray:
+    """Scores -> referable probability [n] for either head (the scalar
+    the escalation band and both gates compare on)."""
+    s = np.asarray(scores, np.float64)
+    if s.ndim == 2:
+        s = np.asarray(
+            metrics.referable_probs_from_multiclass(s), np.float64
+        )
+    return s.ravel()
+
+
+class CascadeEngine:
+    """Student-first scoring with band-escalation to the full ensemble.
+
+    ``student`` / ``ensemble``: two ServingEngines (or any objects with
+    the engine's ``probs`` row contract — tests stub them); the student
+    is normally a k=1 engine over the ``train.distill_from`` product,
+    the ensemble the full stacked tree. Engines share one registry so
+    the cascade's counters land in the same telemetry snapshots.
+
+    Thresholds/band come from ``cfg.serve.cascade_thresholds`` /
+    ``cfg.serve.cascade_band``; empty thresholds default to (0.5,).
+
+    ``quality``: an optional QualityMonitor fed the MERGED scores (the
+    distribution the deployment actually serves). When one is passed,
+    build the two sub-engines with ``obs.quality`` disabled — otherwise
+    each half would double-observe its own partial view (predict.py's
+    cascade path wires exactly this).
+    """
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        student,
+        ensemble,
+        registry: "obs_registry.Registry | None" = None,
+        quality=None,
+    ):
+        self.cfg = cfg
+        sc = cfg.serve
+        self.band = float(sc.cascade_band)
+        if self.band < 0:
+            raise ValueError(
+                f"serve.cascade_band must be >= 0, got {self.band}"
+            )
+        self.thresholds = tuple(
+            float(t) for t in (sc.cascade_thresholds or (0.5,))
+        )
+        bad = [t for t in self.thresholds if not 0.0 <= t <= 1.0]
+        if bad:
+            raise ValueError(
+                f"serve.cascade_thresholds must lie in [0, 1]: {bad}"
+            )
+        self.student = student
+        self.ensemble = ensemble
+        self.registry = (
+            registry if registry is not None
+            else getattr(ensemble, "registry",
+                         obs_registry.default_registry())
+        )
+        self._c_student_rows = self.registry.counter(
+            "serve.cascade.student_rows",
+            help="rows scored by the distilled student (every cascade "
+                 "row passes here first)",
+        )
+        self._c_escalated_rows = self.registry.counter(
+            "serve.cascade.escalated_rows",
+            help="rows whose student score landed inside the "
+                 "escalation band and re-scored through the full "
+                 "stacked ensemble (escalation rate = escalated / "
+                 "student rows)",
+        )
+        self.quality = quality
+
+    # -- escalation policy -------------------------------------------------
+
+    def escalation_mask(self, referable: np.ndarray) -> np.ndarray:
+        """True where a student referable score is within ``band`` of
+        any operating threshold — the rows ensemble averaging could
+        plausibly flip."""
+        r = np.asarray(referable, np.float64).ravel()
+        mask = np.zeros(r.shape, bool)
+        for thr in self.thresholds:
+            mask |= np.abs(r - thr) <= self.band
+        return mask
+
+    # -- the serving surface -----------------------------------------------
+
+    def _probs_raw(self, images: np.ndarray) -> np.ndarray:
+        """Score + merge, no quality hook — what the canary scores
+        through (canary traffic must never pollute the drift windows,
+        the same bypass ServingEngine's member_probs-based canary
+        wiring applies)."""
+        out = np.asarray(self.student.probs(images))
+        n = int(out.shape[0])
+        self._c_student_rows.inc(n)
+        mask = self.escalation_mask(_referable(out))
+        if mask.any():
+            out = np.array(out)
+            esc = np.asarray(self.ensemble.probs(images[mask]))
+            out[mask] = esc
+            self._c_escalated_rows.inc(int(mask.sum()))
+        return out
+
+    def probs(self, images: np.ndarray) -> np.ndarray:
+        """The cascade's row contract (MicroBatcher-compatible): row i
+        of the output is row i's score — the student's, or the full
+        ensemble's when the student landed in the escalation band."""
+        out = self._probs_raw(images)
+        q = self.quality
+        if q is not None:
+            # Drift windows see the MERGED distribution — the scores the
+            # deployment serves; the canary rides the full cascade path
+            # so a student/band regression trips it, not just an
+            # ensemble one.
+            q.observe(images, out)
+            if q.canary_claim():
+                q.run_canary(self._probs_raw)
+        return out
+
+    def make_batcher(self):
+        """A MicroBatcher over the cascade under cfg.serve's coalescing
+        knobs — the same construction ServingEngine.make_batcher uses,
+        with the cascade's probs as the infer_fn."""
+        from jama16_retina_tpu.serve.batcher import MicroBatcher
+
+        size = self.cfg.model.image_size
+        return MicroBatcher(
+            self.probs,
+            max_batch=self.cfg.serve.max_batch,
+            max_wait_ms=self.cfg.serve.max_wait_ms,
+            row_shape=(size, size, 3),
+            row_dtype=np.uint8,
+            registry=self.registry,
+            shed_queue_depth=self.cfg.serve.shed_queue_depth,
+            shed_in_flight=self.cfg.serve.shed_in_flight,
+            default_deadline_ms=self.cfg.serve.default_deadline_ms,
+        )
+
+    # -- lifecycle delegation ----------------------------------------------
+    # A drift-triggered retrain replaces the EXPENSIVE model: reload/
+    # rollback land on the stacked ensemble while the student keeps
+    # serving the cheap path (the controller unwraps a CascadeEngine to
+    # its ensemble half; the student is retrained offline via
+    # train.distill_from against the new ensemble and swapped by
+    # constructing a fresh cascade).
+
+    @property
+    def generation(self) -> int:
+        return self.ensemble.generation
+
+    def reload(self, member_dirs=None, *, state=None) -> dict:
+        return self.ensemble.reload(member_dirs, state=state)
+
+    def rollback(self) -> dict:
+        return self.ensemble.rollback()
+
+    def release_retained(self) -> None:
+        self.ensemble.release_retained()
+
+    # -- the go-live gate ---------------------------------------------------
+
+    def gate(self, images: "np.ndarray | None" = None,
+             grades: "np.ndarray | None" = None) -> list:
+        """The named GateVerdicts a cascade config must pass before it
+        serves (the PR-8 gate vocabulary, applied to the cascade-vs-
+        ensemble comparison):
+
+          * ``golden_canary`` — cascade scores on the pinned golden set
+            within ``lifecycle.gate_canary_max_dev`` of the reference
+            (skipped, loudly, when no canary is configured/pinned);
+          * ``auc_floor`` — on labeled rows, cascade AUC >= full-
+            ensemble AUC - ``lifecycle.gate_auc_floor_delta``, and
+            sensitivity/specificity at every operating threshold within
+            the same delta (the operating-point parity half); skipped
+            when no labeled rows are provided.
+        """
+        from jama16_retina_tpu.lifecycle.controller import GateVerdict
+
+        verdicts = [self._gate_golden_canary(GateVerdict)]
+        verdicts.append(
+            self._gate_auc_floor(GateVerdict, images, grades)
+        )
+        return verdicts
+
+    def _gate_golden_canary(self, GateVerdict):
+        # The cascade's own monitor (the predict.py wiring) carries the
+        # pinned canary when one is injected; a bare cascade over a
+        # quality-enabled ensemble engine falls back to that engine's.
+        q = (self.quality if self.quality is not None
+             else getattr(self.ensemble, "quality", None))
+        canary = q.canary if q is not None else None
+        if canary is None or canary.reference is None:
+            return GateVerdict(
+                name="golden_canary", passed=True, skipped=True,
+                detail="no canary artifact configured/pinned",
+            )
+        scores = _referable(self._probs_raw(canary.images))
+        ref = _referable(canary.reference)
+        if scores.shape != ref.shape:
+            return GateVerdict(
+                name="golden_canary", passed=False,
+                detail=f"score shape {scores.shape} vs pinned {ref.shape}",
+            )
+        dev = float(np.max(np.abs(scores - ref)))
+        thr = float(self.cfg.lifecycle.gate_canary_max_dev)
+        return GateVerdict(
+            name="golden_canary", passed=dev <= thr, value=dev,
+            threshold=thr,
+        )
+
+    def _gate_auc_floor(self, GateVerdict, images, grades):
+        if images is None or grades is None:
+            return GateVerdict(
+                name="auc_floor", passed=True, skipped=True,
+                detail="no labeled rows provided to score",
+            )
+        labels = (np.asarray(grades) >= 2).astype(np.float64)
+        if not (0.0 < labels.mean() < 1.0):
+            return GateVerdict(
+                name="auc_floor", passed=True, skipped=True,
+                detail="gate rows are single-class; AUC undefined",
+            )
+        casc = _referable(self._probs_raw(images))
+        full = _referable(self.ensemble.probs(images))
+        auc_casc = metrics.roc_auc(labels, casc)
+        auc_full = metrics.roc_auc(labels, full)
+        delta = float(self.cfg.lifecycle.gate_auc_floor_delta)
+        # Operating-point parity: at every cascade threshold the
+        # decisions' sensitivity/specificity must track the full
+        # ensemble within the same delta — AUC alone can hide a local
+        # swap exactly at the screening thresholds. (Both classes are
+        # non-empty here: the single-class case skipped above.)
+        op_ok, op_detail = True, []
+        for thr in self.thresholds:
+            cm_c = metrics.confusion_at_threshold(labels, casc, thr)
+            cm_f = metrics.confusion_at_threshold(labels, full, thr)
+            op_ok &= (
+                cm_c["sensitivity"] >= cm_f["sensitivity"] - delta
+                and cm_c["specificity"] >= cm_f["specificity"] - delta
+            )
+            op_detail.append(
+                f"thr={thr:g}: sens {cm_c['sensitivity']:.4f} vs "
+                f"{cm_f['sensitivity']:.4f}, spec "
+                f"{cm_c['specificity']:.4f} vs {cm_f['specificity']:.4f}"
+            )
+        return GateVerdict(
+            name="auc_floor",
+            passed=bool(auc_casc >= auc_full - delta) and bool(op_ok),
+            value=float(auc_casc), threshold=float(auc_full - delta),
+            detail=f"full_auc={auc_full:.6f}; " + "; ".join(op_detail),
+        )
+
+    def go_live(self, images: "np.ndarray | None" = None,
+                grades: "np.ndarray | None" = None) -> list:
+        """Run the gates; raise typed :class:`CascadeRejected` naming
+        every failing verdict, else return the verdicts (journal-ready
+        ``as_dict`` rows). A cascade config that cannot prove operating-
+        point parity never serves."""
+        verdicts = self.gate(images, grades)
+        failed = [v for v in verdicts if not v.passed]
+        if failed:
+            raise CascadeRejected(
+                "cascade refused at go-live: "
+                + "; ".join(
+                    f"{v.name} (value={v.value}, threshold="
+                    f"{v.threshold}, {v.detail})"
+                    for v in failed
+                )
+            )
+        absl_logging.info(
+            "cascade live: band %.4g around thresholds %s (%s)",
+            self.band, self.thresholds,
+            ", ".join(
+                f"{v.name}={'skip' if v.skipped else 'pass'}"
+                for v in verdicts
+            ),
+        )
+        return verdicts

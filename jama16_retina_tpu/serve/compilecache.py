@@ -1,0 +1,252 @@
+"""Persistent AOT compilation cache for the serving engine (ISSUE 10
+zero cold-start).
+
+Every ``ServingEngine`` restart used to re-pay the full warmup+compile
+bill — ~79 s on the bench TPU (BENCH_r01) — because jit's in-process
+cache dies with the process. This module makes the compiled serving
+program a durable artifact instead: each per-bucket executable is
+AOT-compiled once, serialized with ``jax.experimental.
+serialize_executable``, and written under a model-fingerprinted cache
+directory; the next engine (restart, ``engine.reload()`` candidate
+warm-up, another process on the same host) deserializes in milliseconds
+instead of recompiling.
+
+Layout, all writes atomic (tmp + os.replace — the rawshard-manifest
+discipline, so a concurrent reader never sees a torn entry):
+
+    <serve.compile_cache_dir>/
+      MANIFEST.json                      # version + fingerprint + detail
+      exec_b{B}_m{mesh}_{dtype}_k{K}.jex # one serialized executable per
+                                         # (bucket, mesh shape, dtype,
+                                         #  member count) key
+
+Failure semantics, in order of loudness:
+
+  * STALE FINGERPRINT — the directory's manifest names a different
+    (model, dtype, jax, backend) tuple than this engine: REFUSED at
+    construction with :class:`CompileCacheStale` naming the rebuild
+    command. Silently serving executables compiled for another model is
+    the one corruption this cache must never absorb.
+  * CORRUPT / MISSING ENTRY — degrades to a COUNTED recompile
+    (``serve.compile_cache.misses``); a cache problem must never fail a
+    request. The load seam carries the ``serve.compile_cache.load``
+    fault site so ``bench.py --chaos`` / tests drive exactly this path.
+  * SERIALIZATION UNSUPPORTED (exotic backends) — save failures are
+    logged and swallowed; the engine keeps its freshly compiled
+    executable and simply stays cold across restarts.
+
+Telemetry: ``serve.compile_cache.{hits,misses}`` counters and the
+``serve.compile_cache.load_sec`` gauge (summed deserialize seconds of
+the last warm-up) — obs_report's Serving-cost section renders the hit
+ratio next to the engine's warm-up time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.obs import faultinject
+
+CACHE_VERSION = 1
+
+
+class CompileCacheStale(RuntimeError):
+    """The cache directory was built for a different model fingerprint.
+    Refused loudly: deserializing another model's executables would
+    serve wrong math or crash mid-request. The message names the
+    rebuild command."""
+
+
+def model_fingerprint(cfg, mesh=None, n_devices: "int | None" = None) -> dict:
+    """The identity a cached executable is only valid for: everything
+    that changes the lowered serving program — model architecture knobs,
+    member form, TTA, mesh shape, and the jax/backend pair that produced
+    the serialization format. The serving DTYPE is deliberately NOT
+    here: it is part of every entry key instead, so one cache directory
+    serves a model's fp32/bf16/int8 engines side by side."""
+    import jax
+
+    if n_devices is None:
+        n_devices = int(mesh.devices.size) if mesh is not None else 1
+    m = cfg.model
+    return {
+        "arch": m.arch,
+        "head": m.head,
+        "image_size": int(m.image_size),
+        "compute_dtype": m.compute_dtype,
+        "aux_head": bool(m.aux_head),
+        "stem_s2d": bool(m.stem_s2d),
+        "member_parallel": bool(cfg.serve.member_parallel),
+        "tta": bool(cfg.eval.tta),
+        "n_devices": int(n_devices),
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+    }
+
+
+def fingerprint_hash(fp: dict) -> str:
+    blob = json.dumps(fp, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _atomic_write_bytes(path: str, blob: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+class CompileCache:
+    """One engine's handle on the on-disk executable cache.
+
+    Construction validates (or writes) the manifest; ``load``/``save``
+    move individual executables. Counters are registered on the
+    engine's registry so cache behavior lands in telemetry snapshots.
+    """
+
+    def __init__(self, path: str, fingerprint: dict, registry=None):
+        from jama16_retina_tpu.obs import registry as obs_registry
+
+        self.dir = os.path.abspath(path)
+        self.fingerprint = dict(fingerprint)
+        self.fp_hash = fingerprint_hash(self.fingerprint)
+        os.makedirs(self.dir, exist_ok=True)
+        reg = (registry if registry is not None
+               else obs_registry.default_registry())
+        self.c_hits = reg.counter(
+            "serve.compile_cache.hits",
+            help="per-bucket serving executables deserialized from the "
+                 "persistent compile cache instead of compiled",
+        )
+        self.c_misses = reg.counter(
+            "serve.compile_cache.misses",
+            help="per-bucket serving compiles the cache could not "
+                 "serve (cold entry, corrupt/injected load failure) — "
+                 "each one is a real XLA compile",
+        )
+        self.g_load_sec = reg.gauge(
+            "serve.compile_cache.load_sec",
+            help="summed deserialize seconds of the last engine "
+                 "warm-up's cache loads (the warm-restart bill)",
+        )
+        self._check_or_write_manifest()
+
+    # -- manifest ----------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "MANIFEST.json")
+
+    def _check_or_write_manifest(self) -> None:
+        path = self._manifest_path()
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    manifest = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                raise CompileCacheStale(
+                    f"compile cache manifest {path!r} is unreadable "
+                    f"({type(e).__name__}: {e}); rebuild: delete the "
+                    f"directory (rm -r {self.dir}) and re-warm one "
+                    "engine (any predict.py/bench.py run with "
+                    "serve.compile_cache_dir set)"
+                )
+            if manifest.get("version") != CACHE_VERSION:
+                raise CompileCacheStale(
+                    f"compile cache at {self.dir} is format version "
+                    f"{manifest.get('version')!r}; this runtime writes "
+                    f"{CACHE_VERSION} — rebuild: rm -r {self.dir} and "
+                    "re-warm one engine"
+                )
+            if manifest.get("fingerprint") != self.fp_hash:
+                theirs = manifest.get("detail", {})
+                diff = sorted(
+                    k for k in set(theirs) | set(self.fingerprint)
+                    if theirs.get(k) != self.fingerprint.get(k)
+                )
+                raise CompileCacheStale(
+                    f"compile cache at {self.dir} was built for "
+                    f"fingerprint {manifest.get('fingerprint')} but this "
+                    f"engine is {self.fp_hash} (differing fields: "
+                    f"{', '.join(diff) or 'unknown'}); executables "
+                    "compiled for another model must not serve — "
+                    f"rebuild: rm -r {self.dir} (or point "
+                    "serve.compile_cache_dir at a per-model directory) "
+                    "and re-warm one engine construction"
+                )
+            return
+        blob = json.dumps({
+            "version": CACHE_VERSION,
+            "fingerprint": self.fp_hash,
+            "detail": self.fingerprint,
+        }, indent=1, sort_keys=True).encode()
+        _atomic_write_bytes(path, blob)
+
+    # -- entries -----------------------------------------------------------
+
+    def entry_key(self, bucket: int, mesh_shape, dtype: str,
+                  n_members: int) -> str:
+        mesh_s = "x".join(str(int(d)) for d in mesh_shape) or "1"
+        return f"b{int(bucket)}_m{mesh_s}_{dtype}_k{int(n_members)}"
+
+    def entry_path(self, key: str) -> str:
+        return os.path.join(self.dir, f"exec_{key}.jex")
+
+    def load(self, key: str):
+        """Deserialize one executable, or None on ANY failure — a
+        missing, corrupt, or fault-injected entry is a counted
+        recompile (``serve.compile_cache.misses``; the caller compiles
+        and saves), never an error that could reach a request. A
+        successful deserialize counts a hit."""
+        path = self.entry_path(key)
+        try:
+            # Fault seam (obs/faultinject.py site
+            # "serve.compile_cache.load"): one global read + branch
+            # unarmed; armed chaos plans fail this load to prove the
+            # degrade-to-recompile contract end to end.
+            faultinject.check("serve.compile_cache.load")
+            if not os.path.exists(path):
+                self.c_misses.inc()
+                return None
+            from jax.experimental import serialize_executable
+
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            fn = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as e:  # noqa: BLE001 - degrade, never fail
+            absl_logging.warning(
+                "compile cache entry %s unusable (%s: %s); recompiling",
+                path, type(e).__name__, e,
+            )
+            self.c_misses.inc()
+            return None
+        self.c_hits.inc()
+        return fn
+
+    def save(self, key: str, compiled) -> bool:
+        """Serialize one freshly compiled executable; failures are
+        logged and swallowed (the engine keeps its in-memory
+        executable — it just stays cold across restarts)."""
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled
+            )
+            _atomic_write_bytes(
+                self.entry_path(key),
+                pickle.dumps((payload, in_tree, out_tree)),
+            )
+            return True
+        except Exception as e:  # noqa: BLE001 - cache is best-effort
+            absl_logging.warning(
+                "compile cache save failed for %s (%s: %s); engine "
+                "stays cold across restarts", key, type(e).__name__, e,
+            )
+            return False

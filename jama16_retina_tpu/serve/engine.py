@@ -46,6 +46,8 @@ from jama16_retina_tpu.obs import registry as obs_registry
 from jama16_retina_tpu.obs import trace as obs_trace
 from jama16_retina_tpu.obs.spans import span
 from jama16_retina_tpu.parallel import mesh as mesh_lib
+from jama16_retina_tpu.serve import compilecache, quantize
+from jama16_retina_tpu.serve.quantize import DtypeRejected
 
 
 class ReloadRejected(RuntimeError):
@@ -330,9 +332,20 @@ class ServingEngine:
         self._batch_sharding = (
             mesh_lib.batch_sharding(mesh) if mesh is not None else None
         )
+        # Cheap-path serving (ISSUE 10): the engine's precision axis.
+        # fp32 keeps every program/path byte-identical to before the
+        # axis existed; bf16/int8 transform the stacked state at build
+        # time (serve/quantize.py) and are canary-gated below.
+        self.dtype = quantize.check_dtype(cfg.serve.dtype)
+        self._c_dtype_rows = self.registry.counter(
+            f"serve.dtype_rows.{self.dtype}",
+            help="real rows forwarded by an engine of this serving "
+                 "dtype (per-dtype traffic share; fp32/bf16/int8)",
+        )
         self._step = train_lib.make_serving_step(
             cfg, self.model, mesh=mesh,
             member_parallel=cfg.serve.member_parallel,
+            param_transform=quantize.dequant_transform(self.dtype),
         )
         self.max_batch = int(cfg.serve.max_batch)
         divisor = (
@@ -344,14 +357,42 @@ class ServingEngine:
         # derive gen_id N+1 from the same live handle and silently
         # discard one swap (with its row attribution).
         self._reload_lock = threading.Lock()
-        # Generation 0: the construction-time checkpoint set. Built
-        # unwarmed — the first request compiles, exactly the historical
-        # behavior bench's warmup accounting measures.
+        # Persistent AOT compile cache (ISSUE 10 zero cold-start):
+        # per-(bucket, mesh, dtype, k) serialized executables under a
+        # model-fingerprinted directory. Opened BEFORE generation 0 so
+        # a stale-fingerprint directory refuses the session up front
+        # (CompileCacheStale names the rebuild command) instead of
+        # after a full restore.
+        self._compiled: dict = {}
+        self._compiled_k: "int | None" = None
+        self._cache = (
+            compilecache.CompileCache(
+                cfg.serve.compile_cache_dir,
+                compilecache.model_fingerprint(cfg, mesh=mesh),
+                registry=self.registry,
+            )
+            if cfg.serve.compile_cache_dir else None
+        )
+        self._g_warmup_sec = self.registry.gauge(
+            "serve.engine.warmup_sec",
+            help="seconds from engine construction to every bucket "
+                 "executable ready (cache-warmed restarts are the "
+                 "serve_warm_start_sec story; 0 = no compile cache "
+                 "configured, first request pays the compile)",
+        )
+        # Generation 0: the construction-time checkpoint set. Without a
+        # compile cache it is built unwarmed — the first request
+        # compiles, exactly the historical behavior bench's warmup
+        # accounting measures; with one, every bucket is AOT-compiled
+        # or deserialized here, so the first request is already warm.
         self._gen = self._build_generation(
             0, member_dirs=member_dirs, state=state
         )
         self._gen.c_rows = self._register_gen_rows(0)
         self._g_generation.set(0)
+        if self._cache is not None:
+            self._warm_from_cache(self._gen)
+        self._dtype_construction_gate()
 
     # -- generations (ISSUE 6 hot swap) -----------------------------------
 
@@ -411,6 +452,11 @@ class ServingEngine:
             # Serving never steps the optimizer; drop its moments from
             # the device residency whatever the caller handed over.
             state = state.replace(opt_state=None)
+        # Serving dtype transform (ISSUE 10; serve/quantize.py):
+        # fp32 = identity, bf16 = cast, int8 = Q8Leaf quantization.
+        # Idempotent, so a candidate state that already went through a
+        # generation build (begin_shadow -> promote) is untouched.
+        state = quantize.state_for_dtype(state, self.dtype)
         n_members = int(state.step.shape[0])
         place = (
             mesh_lib.replicated(self.mesh) if self.mesh is not None
@@ -429,15 +475,125 @@ class ServingEngine:
             # Every bucket forwarded once on the CANDIDATE state before
             # it can take a request: the swap never hands a live caller
             # a cold compile or a shape error the gate could have
-            # caught (the shared self._step jit cache makes repeat
-            # warms cheap — same shapes, same program).
+            # caught (the shared self._step jit cache — or the
+            # compile-cache executables, when member counts match —
+            # makes repeat warms cheap: same shapes, same program).
             size = self.cfg.model.image_size
             for b in self.buckets:
                 zeros = np.zeros((b, size, size, 3), np.uint8)
-                jax.device_get(
-                    self._step(gen.state, {"image": self._place(zeros)})
-                )
+                jax.device_get(self._dispatch_fn(b, gen)(
+                    gen.state, {"image": self._place(zeros)}
+                ))
         return gen
+
+    def _dispatch_fn(self, bucket: int, gen: "_Generation"):
+        """The executable one chunk dispatches through: the persistent-
+        cache AOT executable when one exists for this bucket AND the
+        generation's member count matches what it was compiled for
+        (a reload to a different k changes the stacked shapes), else
+        the shared jit fast path."""
+        if gen.n_members == self._compiled_k:
+            fn = self._compiled.get(bucket)
+            if fn is not None:
+                return fn
+        return self._step
+
+    def _warm_from_cache(self, gen: "_Generation") -> None:
+        """Populate the per-bucket executable table from the persistent
+        compile cache (hit: deserialize, ms) or by AOT-compiling and
+        saving (miss: one real compile, exactly what the first request
+        would have paid — now paid here, once, durable). Sets
+        ``serve.engine.warmup_sec``; after this every bucket serves its
+        first request warm."""
+        t0 = time.monotonic()
+        size = self.cfg.model.image_size
+        mesh_shape = (
+            tuple(self.mesh.devices.shape) if self.mesh is not None
+            else (1,)
+        )
+        load_sec = 0.0
+        for b in self.buckets:
+            zeros = np.zeros((b, size, size, 3), np.uint8)
+            placed = self._place(zeros)
+            key = self._cache.entry_key(
+                b, mesh_shape, self.dtype, gen.n_members
+            )
+            t_load = time.monotonic()
+            fn = self._cache.load(key)  # counts its own hit/miss
+            load_sec += time.monotonic() - t_load
+            if fn is not None:
+                # Proof-run the DESERIALIZED executable before a live
+                # request rides it. A loaded entry that cannot actually
+                # run here (an entry-key collision across an engine
+                # change the fingerprint missed, a runtime-version
+                # surprise) is one more degrade-to-recompile case —
+                # the cache contract, not a failed session.
+                try:
+                    jax.device_get(fn(gen.state, {"image": placed}))
+                except Exception as e:  # noqa: BLE001 - degrade
+                    absl_logging.warning(
+                        "cached executable %s deserialized but failed "
+                        "its proof-run (%s: %s); recompiling",
+                        key, type(e).__name__, e,
+                    )
+                    self._cache.c_misses.inc()
+                    fn = None
+            if fn is None:
+                fn = self._step.lower(
+                    gen.state, {"image": placed}
+                ).compile()
+                self._cache.save(key, fn)
+                # Fresh-compile proof-run: a failure HERE is a real
+                # engine/model error and must propagate.
+                jax.device_get(fn(gen.state, {"image": placed}))
+            self._compiled[b] = fn
+        self._compiled_k = gen.n_members
+        self._cache.g_load_sec.set(load_sec)
+        self._g_warmup_sec.set(time.monotonic() - t0)
+
+    def _dtype_construction_gate(self) -> None:
+        """The quantized-engine quality gate (ISSUE 10): a non-fp32
+        engine with a PINNED golden canary must reproduce the pinned
+        scores within ``serve.dtype_canary_max_dev`` or it is refused
+        HERE — before any request — with typed :class:`DtypeRejected`.
+        fp32 engines skip (their contract is the canary's own
+        byte-stability check); engines without a pinned canary serve
+        ungated, loudly."""
+        if self.dtype == "fp32":
+            return
+        q = self.quality
+        canary = q.canary if q is not None else None
+        if canary is None or canary.reference is None:
+            absl_logging.warning(
+                "serve.dtype=%s engine has no pinned golden canary; "
+                "the quantized numerics are UNGATED — pin one via "
+                "obs.quality.canary_path for the construction-time "
+                "parity check", self.dtype,
+            )
+            return
+        scores = np.asarray(
+            metrics.ensemble_average(list(
+                self.member_probs(canary.images, _gen=self._gen)
+            )), np.float64,
+        ).ravel()
+        ref = np.asarray(canary.reference, np.float64).ravel()
+        dev = (
+            float(np.max(np.abs(scores - ref)))
+            if scores.shape == ref.shape else float("inf")
+        )
+        bound = float(self.cfg.serve.dtype_canary_max_dev)
+        if dev > bound:
+            raise DtypeRejected(
+                f"serve.dtype={self.dtype} deviates from the pinned "
+                f"golden canary by {dev:.6g} (> serve."
+                f"dtype_canary_max_dev={bound:g}); the quantized engine "
+                "never took a request — serve fp32, or loosen the bound "
+                "deliberately with this deviation in hand"
+            )
+        absl_logging.info(
+            "serve.dtype=%s passed the golden-canary gate "
+            "(max dev %.6g <= %g)", self.dtype, dev, bound,
+        )
 
     def reload(self, member_dirs=None, *,
                state: "train_lib.TrainState | None" = None) -> dict:
@@ -776,6 +932,7 @@ class ServingEngine:
             # a bucket set that defeats compile-once-per-bucket.
             pad_rows = bucket - chunk.shape[0]
             self._c_rows.inc(chunk.shape[0])
+            self._c_dtype_rows.inc(chunk.shape[0])
             gen.c_rows.inc(chunk.shape[0])
             self._c_batches.inc()
             c_pad = self._bucket_counters.get(bucket)
@@ -805,8 +962,12 @@ class ServingEngine:
             # One span over placement + dispatch: the forward is async
             # (this times H2D staging and queue pressure, not device
             # compute — device time is visible as the device_get drain).
+            # Dispatch rides the persistent-cache AOT executable when
+            # one matches this (bucket, member count), else the jit.
             with span("serve.engine.dispatch_s", self.registry):
-                dev = self._step(gen.state, {"image": self._place(padded)})
+                dev = self._dispatch_fn(bucket, gen)(
+                    gen.state, {"image": self._place(padded)}
+                )
             pending.append((dev, chunk.shape[0]))
             self._g_in_flight.set(len(pending))
             if len(pending) > max_in_flight:

@@ -1,0 +1,190 @@
+"""Serving dtype axis: fp32 / bf16 / int8 stacked-state transforms
+(ISSUE 10 cheap-path serving).
+
+The serve hot path's arithmetic is already bf16 on TPU
+(model.compute_dtype), but the stacked parameter tree restores and
+resides in fp32 — every forward streams full-width weights out of HBM.
+``serve.dtype`` trades that width for throughput, per engine:
+
+  * ``fp32`` — restored params verbatim. The bit-identity default: every
+    parity pin (engine vs sequential path, predict.py byte-identical
+    JSONL) rides this mode unchanged.
+  * ``bf16`` — float params (and the EMA shadow, when carried) cast to
+    bfloat16 at stacking: half the weight HBM traffic. BatchNorm
+    statistics stay float32 — stored moments are a numerically
+    sensitive sum-of-squares, and casting them buys ~nothing.
+  * ``int8`` — rank>=2 kernels quantized to symmetric per-output-channel
+    int8 (via AQT when importable — it ships in this container's
+    site-packages — else a hand-rolled fallback with identical
+    semantics, logged). The device residency is int8 values + float32
+    scales wrapped in :class:`Q8Leaf`; ``dequant_transform`` runs INSIDE
+    the one serving program (train_lib.make_serving_step
+    ``param_transform``), so XLA fuses the dequant into the forward and
+    no full-width copy of the tree ever persists.
+
+Quality gate: a non-fp32 engine is REFUSED at construction
+(:class:`DtypeRejected`) when its golden-canary deviation exceeds
+``serve.dtype_canary_max_dev`` — the same golden-canary +
+operating-point parity path every reload candidate passes, applied to
+the numerics change instead of a weights change (serve/engine.py).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from absl import logging as absl_logging
+
+SERVE_DTYPES = ("fp32", "bf16", "int8")
+
+
+class DtypeRejected(RuntimeError):
+    """A non-fp32 serving dtype failed its golden-canary construction
+    gate: the quantized engine's scores deviate from the pinned
+    reference by more than ``serve.dtype_canary_max_dev``, so it never
+    takes a request — rebuild with ``serve.dtype=fp32`` (or loosen the
+    bound deliberately, with the deviation in hand)."""
+
+
+class Q8Leaf(flax.struct.PyTreeNode):
+    """One int8-quantized parameter leaf: ``q`` (int8 values) and ``s``
+    (float32 per-output-channel scales, broadcastable to ``q``).
+    A pytree node — device_put/jit trace it like any array pair —
+    deliberately NOT a dict, which flax param trees would descend into.
+    """
+
+    q: jnp.ndarray
+    s: jnp.ndarray
+
+
+def check_dtype(dtype: str) -> str:
+    if dtype not in SERVE_DTYPES:
+        raise ValueError(
+            f"unknown serve.dtype {dtype!r}; choose one of "
+            f"{'/'.join(SERVE_DTYPES)}"
+        )
+    return dtype
+
+
+def _is_q8(x) -> bool:
+    return isinstance(x, Q8Leaf)
+
+
+def _quantize_leaf(p: jnp.ndarray) -> Q8Leaf:
+    """Symmetric int8 for one STACKED kernel [k, ..., out_channels]:
+    calibration reduces over the middle axes only, keeping the member
+    axis (0) and the output-channel axis (-1) — one scale per
+    (member, channel) pair. Pooling across members would let the
+    largest-magnitude member's amax set every member's scale and
+    collapse smaller members to a handful of int8 levels (ensemble
+    members train from independent seeds; their kernel magnitudes
+    legitimately differ)."""
+    axes = tuple(range(1, p.ndim - 1))
+    try:
+        from aqt.jax.v2 import aqt_quantizer
+
+        qt, _ = aqt_quantizer.quantizer_make(8).quant(
+            jnp.asarray(p), calibration_axes=axes
+        )
+        scale = qt.scale[0]
+        for extra in qt.scale[1:]:  # pragma: no cover - single-scale quantizers
+            scale = scale * extra
+        return Q8Leaf(
+            q=jnp.asarray(qt.qvalue, jnp.int8),
+            s=jnp.asarray(scale, jnp.float32),
+        )
+    except ImportError:
+        # Container without AQT: same math by hand (symmetric, clip at
+        # the int8 range, scale = amax/127 with a zero-guard).
+        absl_logging.warning(
+            "AQT unavailable; int8 serving dtype using the built-in "
+            "symmetric quantizer (identical semantics)"
+        )
+        p = jnp.asarray(p, jnp.float32)
+        amax = jnp.max(jnp.abs(p), axis=axes, keepdims=True)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(p / scale), -127, 127).astype(jnp.int8)
+        return Q8Leaf(q=q, s=jnp.asarray(scale, jnp.float32))
+
+
+def _cast_tree_bf16(tree):
+    def cast(p):
+        if _is_q8(p):
+            return p
+        if jnp.issubdtype(jnp.result_type(p), jnp.floating):
+            return jnp.asarray(p, jnp.bfloat16)
+        return p
+
+    return jax.tree.map(cast, tree, is_leaf=_is_q8)
+
+
+def _quantize_tree_int8(tree):
+    def q(p):
+        if _is_q8(p):  # idempotent: a reload of an already-quantized
+            return p   # candidate state must not double-quantize
+        # ndim >= 3 on the STACKED tree = rank>=2 kernels (conv/dense
+        # weights under their leading [k] member axis). Stacked biases
+        # and BatchNorm affine params are [k, O] (ndim 2) and stay
+        # float — the weights-only contract: quantizing them buys ~no
+        # HBM traffic and adds avoidable logit error.
+        if (hasattr(p, "ndim") and p.ndim >= 3
+                and jnp.issubdtype(jnp.result_type(p), jnp.floating)):
+            return _quantize_leaf(p)
+        return p
+
+    return jax.tree.map(q, tree, is_leaf=_is_q8)
+
+
+def state_for_dtype(state, dtype: str):
+    """The eager, pre-placement transform of a stacked serving state
+    (engine._build_generation): fp32 is identity; bf16 casts the params
+    and EMA shadow (BatchNorm statistics stay float32); int8 wraps
+    rank>=2 float kernels in :class:`Q8Leaf`. Idempotent — reloading a
+    candidate built from an already-transformed state is a no-op."""
+    check_dtype(dtype)
+    if dtype == "fp32":
+        return state
+    if dtype == "bf16":
+        return state.replace(
+            params=_cast_tree_bf16(state.params),
+            ema_params=(
+                _cast_tree_bf16(state.ema_params)
+                if state.ema_params is not None else None
+            ),
+        )
+    return state.replace(
+        params=_quantize_tree_int8(state.params),
+        ema_params=(
+            _quantize_tree_int8(state.ema_params)
+            if state.ema_params is not None else None
+        ),
+    )
+
+
+def _dequant_tree(tree):
+    return jax.tree.map(
+        lambda p: (jnp.asarray(p.q, jnp.float32) * p.s) if _is_q8(p) else p,
+        tree, is_leaf=_is_q8,
+    )
+
+
+def dequant_transform(dtype: str):
+    """The traced half (make_serving_step ``param_transform``): None for
+    fp32/bf16 (their params feed the forward directly); for int8 a
+    state->state map that dequantizes every Q8Leaf inside the serving
+    program, so the dequant fuses and HBM holds int8+scales."""
+    check_dtype(dtype)
+    if dtype != "int8":
+        return None
+
+    def transform(state):
+        return state.replace(
+            params=_dequant_tree(state.params),
+            ema_params=(
+                _dequant_tree(state.ema_params)
+                if state.ema_params is not None else None
+            ),
+        )
+
+    return transform

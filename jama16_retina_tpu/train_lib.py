@@ -208,8 +208,23 @@ def _probs(logits: jnp.ndarray, head: str) -> jnp.ndarray:
     return jax.nn.softmax(logits, axis=-1)
 
 
+def _distill_loss(logits: jnp.ndarray, soft: jnp.ndarray,
+                  head: str) -> jnp.ndarray:
+    """Soft-target loss against the teacher ensemble's averaged scores
+    (TrainConfig.distill_from; ISSUE 10 cascade): the binary head's BCE
+    accepts a probability target directly, the multi head trains on the
+    teacher's full [B, C] distribution. Label smoothing is deliberately
+    NOT applied — the teacher's scores already carry the softness the
+    student is meant to absorb."""
+    if head == "binary":
+        return optax.sigmoid_binary_cross_entropy(
+            logits[:, 0], soft
+        ).mean()
+    return optax.softmax_cross_entropy(logits, soft).mean()
+
+
 def loss_fn(params, batch_stats, model, images, grades, dropout_rng,
-            cfg: ExperimentConfig, train: bool):
+            cfg: ExperimentConfig, train: bool, soft=None):
     labels = _labels_from_grades(grades, cfg.model.head)
     variables = {"params": params, "batch_stats": batch_stats}
     if train:
@@ -221,6 +236,16 @@ def loss_fn(params, batch_stats, model, images, grades, dropout_rng,
     else:
         logits, aux = model.apply(variables, images, train=False)
         new_stats = batch_stats
+    if soft is not None:
+        # Distillation (train.distill_from): the student's target is the
+        # teacher's soft score, hard grades untouched (they still ride
+        # the batch for eval-side AUC).
+        loss = _distill_loss(logits, soft, cfg.model.head)
+        if aux is not None:
+            loss = loss + cfg.model.aux_weight * _distill_loss(
+                aux, soft, cfg.model.head
+            )
+        return loss, (logits, new_stats)
     smoothing = cfg.train.label_smoothing
     loss = _head_loss(logits, labels, cfg.model.head, smoothing, None)
     if aux is not None:
@@ -274,20 +299,25 @@ def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
         chex.assert_type(images, jnp.float32)
         chex.assert_equal_shape([images, batch["image"]])
 
+    # Teacher soft targets ride the batch dict when distillation is on
+    # (trainer wraps the stream); absent key = the hard-label default,
+    # so every other step form is byte-for-byte unchanged.
+    soft = batch.get("soft")
+
     fn = loss_fn
     if loss_axis is not None:
         def fn(params, batch_stats, model, images, grades, dropout_rng,
-               cfg, train):
+               cfg, train, soft=None):
             loss, aux = loss_fn(
                 params, batch_stats, model, images, grades, dropout_rng,
-                cfg, train,
+                cfg, train, soft=soft,
             )
             return jax.lax.pmean(loss, loss_axis), aux
 
     grad_fn = jax.value_and_grad(fn, has_aux=True)
     (loss, (logits, new_stats)), grads = grad_fn(
         state.params, state.batch_stats, model, images, batch["grade"],
-        dropout_key, cfg, True,
+        dropout_key, cfg, True, soft,
     )
     return loss, logits, new_stats, grads
 
@@ -780,7 +810,8 @@ def stack_states(states: "list[TrainState]") -> TrainState:
 
 
 def make_serving_step(
-    cfg: ExperimentConfig, model, mesh=None, member_parallel: bool = False
+    cfg: ExperimentConfig, model, mesh=None, member_parallel: bool = False,
+    param_transform: "Callable | None" = None,
 ) -> Callable:
     """Stacked-state forward for the serving engine (serve/engine.py):
     ``(stacked state [k], {'image': u8[B,S,S,3]}) -> probs [k, B(, C)]``.
@@ -802,11 +833,20 @@ def make_serving_step(
     make_eval_step); member-axis sharding stays the training-side
     make_ensemble_eval_step's job.
 
+    ``param_transform`` (ISSUE 10 serve.dtype): applied to the stacked
+    state INSIDE the one serving program — the int8 path's dequantize
+    (serve/quantize.py), so device residency stays int8+scales and the
+    dequant fuses into the forward instead of costing a second dispatch.
+    None (the default) leaves the program byte-identical to before the
+    hook existed.
+
     Same EMA/TTA semantics as every other eval surface (_eval_probs).
     """
     cfg = _pallas_safe_cfg(cfg, mesh, "serving step")
 
     def step(state: TrainState, batch: dict):
+        if param_transform is not None:
+            state = param_transform(state)
         images = augment_lib.normalize(batch["image"])
 
         def fwd(st):

@@ -289,14 +289,73 @@ def main(argv):
         # shapes — and therefore the probabilities — are bit-identical
         # to the sequential per-member path this replaced
         # (tests/test_serve.py pins both levels).
-        from jama16_retina_tpu.serve import ServingEngine
+        from jama16_retina_tpu.serve import CascadeEngine, ServingEngine
 
         cfg = cfg.replace(serve=dataclasses.replace(
             cfg.serve,
             max_batch=_BATCH.value,
             bucket_sizes=(_BATCH.value,),
         ))
-        engine = ServingEngine(cfg, dirs, model=model)
+        if cfg.serve.cascade_student_dir:
+            # Cheap-path serving (ISSUE 10): the distilled student
+            # scores every image; only rows inside serve.cascade_band
+            # of the operating thresholds pay the full stacked
+            # ensemble. Quality observability moves UP to the cascade
+            # (the merged scores are what this batch serves), so the
+            # sub-engines are built with the engine-level monitor off —
+            # EXCEPT the ensemble half under a non-fp32 dtype with a
+            # configured canary: the DtypeRejected construction gate
+            # needs the engine-level pinned canary, so quality stays on
+            # there, on a DETACHED registry (its monitor's gauges must
+            # not collide with the cascade's merged-view monitor). The
+            # student's dtype numerics are gated transitively by the
+            # cascade's go-live canary below, which scores the full
+            # student->escalation path at the serving dtype.
+            from jama16_retina_tpu.obs import quality as quality_lib
+            from jama16_retina_tpu.obs import registry as obs_registry
+
+            sub = cfg.replace(obs=dataclasses.replace(
+                cfg.obs, quality=dataclasses.replace(
+                    cfg.obs.quality, enabled=False,
+                ),
+            ))
+            student_dirs = ckpt_lib.discover_member_dirs(
+                cfg.serve.cascade_student_dir
+            )
+            if (cfg.serve.dtype != "fp32"
+                    and cfg.obs.quality.enabled
+                    and cfg.obs.quality.canary_path):
+                ensemble = ServingEngine(
+                    cfg, dirs, model=model,
+                    registry=obs_registry.Registry(),
+                )
+                # The monitor existed to arm the one-shot construction
+                # gate; steady-state quality lives on the CASCADE below
+                # (merged scores). Detach it so escalated traffic
+                # doesn't feed band-biased drift windows or re-score
+                # the golden set on the engine's canary cadence.
+                ensemble.quality = None
+            else:
+                ensemble = ServingEngine(sub, dirs, model=model)
+            engine = CascadeEngine(
+                cfg,
+                ServingEngine(sub, student_dirs, model=model),
+                ensemble,
+                registry=obs_registry.default_registry(),
+                quality=(
+                    quality_lib.monitor_from_config(cfg.obs.quality)
+                    if cfg.obs.enabled else None
+                ),
+            )
+            # The go-live gate (serve/cascade.py): with a pinned golden
+            # canary configured the cascade must reproduce it within
+            # lifecycle.gate_canary_max_dev or this batch refuses
+            # loudly (typed CascadeRejected) — a student/band pair that
+            # moves the operating points never scores a screening
+            # batch. Without gate artifacts the verdicts record skips.
+            engine.go_live()
+        else:
+            engine = ServingEngine(cfg, dirs, model=model)
         if snap is None:
             probs = engine.probs(pre.images)
         else:

@@ -600,6 +600,101 @@ def render_reliability(records: list) -> "str | None":
 
 
 # ---------------------------------------------------------------------------
+# Serving cost: cascade escalation, dtype traffic, compile cache (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def serving_cost_summary(records: list) -> "dict | None":
+    """The Serving-cost section's machine-readable form (--json twin):
+    cascade escalation rate (escalated / student rows), per-dtype
+    traffic share (serve.dtype_rows.*), persistent compile-cache hit
+    ratio, and the engine's cold-start bill (warmup seconds + cache
+    deserialize seconds). None when the run carries none of the
+    cheap-path signals — a plain fp32 uncached engine renders nothing
+    new."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    counters = latest.get("counters", {})
+    gauges = latest.get("gauges", {})
+    dtype_rows = {
+        k[len("serve.dtype_rows."):]: int(v)
+        for k, v in sorted(counters.items())
+        if k.startswith("serve.dtype_rows.") and v
+    }
+    student = int(counters.get("serve.cascade.student_rows", 0))
+    escalated = int(counters.get("serve.cascade.escalated_rows", 0))
+    hits = int(counters.get("serve.compile_cache.hits", 0))
+    misses = int(counters.get("serve.compile_cache.misses", 0))
+    warmup = gauges.get("serve.engine.warmup_sec")
+    interesting = (
+        student or hits or misses or warmup
+        or any(d != "fp32" for d in dtype_rows)
+    )
+    if not interesting:
+        return None
+    total_dtype = sum(dtype_rows.values())
+    return {
+        "cascade": (
+            {
+                "student_rows": student,
+                "escalated_rows": escalated,
+                "escalation_rate": round(escalated / student, 4),
+            }
+            if student else None
+        ),
+        "dtype_rows": dtype_rows,
+        "dtype_share": {
+            d: round(n / total_dtype, 4) for d, n in dtype_rows.items()
+        } if total_dtype else {},
+        "compile_cache": (
+            {
+                "hits": hits,
+                "misses": misses,
+                "hit_ratio": round(hits / (hits + misses), 4),
+                "load_sec": gauges.get("serve.compile_cache.load_sec"),
+            }
+            if hits or misses else None
+        ),
+        "warmup_sec": warmup,
+    }
+
+
+def render_serving_cost(records: list) -> "str | None":
+    s = serving_cost_summary(records)
+    if s is None:
+        return None
+    rows = []
+    if s["cascade"]:
+        c = s["cascade"]
+        rows.append((
+            "cascade escalation",
+            f"{c['escalation_rate']:.1%} ({c['escalated_rows']} of "
+            f"{c['student_rows']} rows paid the full ensemble)",
+        ))
+    for d, share in sorted(s["dtype_share"].items()):
+        rows.append((
+            f"traffic at dtype {d}",
+            f"{share:.1%} ({s['dtype_rows'][d]} rows)",
+        ))
+    if s["compile_cache"]:
+        cc = s["compile_cache"]
+        load = cc.get("load_sec")
+        rows.append((
+            "compile cache",
+            f"{cc['hit_ratio']:.0%} hit ratio ({cc['hits']} hits / "
+            f"{cc['misses']} compiles"
+            + (f", {load:.2f}s deserialize" if load is not None else "")
+            + ")",
+        ))
+    if s["warmup_sec"] is not None:
+        rows.append(("engine warm-up (cold-start)",
+                     f"{s['warmup_sec']:.2f}s to every bucket ready"))
+    if not rows:
+        return None
+    return "serving cost:\n" + _table(rows, ("signal", "value"))
+
+
+# ---------------------------------------------------------------------------
 # Lifecycle: controller state, transition timeline, gate verdicts (ISSUE 8)
 # ---------------------------------------------------------------------------
 
@@ -1004,6 +1099,7 @@ def main(argv=None) -> int:
             "telemetry": telemetry[-1] if telemetry else None,
             "quality": quality_summary(records),
             "reliability": reliability_summary(records),
+            "serving_cost": serving_cost_summary(records),
             "lifecycle": lifecycle_summary(records),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
@@ -1028,6 +1124,10 @@ def main(argv=None) -> int:
     if rel:
         print()
         print(rel)
+    sc = render_serving_cost(records)
+    if sc:
+        print()
+        print(sc)
     lcy = render_lifecycle(records)
     if lcy:
         print()

@@ -163,6 +163,15 @@ _QUICK_TESTS = {
     "test_lifecycle.py::test_on_fire_exception_counted_not_raised",
     "test_lifecycle.py::test_obs_report_lifecycle_section",
     "test_lifecycle.py::test_lifecycle_run_cli_trigger_and_status",
+    # cheap-path serving (ISSUE 10): the numpy-cheap policy pins —
+    # escalation-band routing incl. both edges, the go-live gate's
+    # garbage-student refusal, and the compile cache's stale-fingerprint
+    # refusal; the real-engine dtype/cache/batcher tests stay in the
+    # full tier (XLA compiles dominate)
+    "test_cascade.py::test_escalation_band_routes_exactly_the_banded_rows",
+    "test_cascade.py::test_all_escalate_and_none_escalate_edges",
+    "test_cascade.py::test_gate_refuses_garbage_student_and_admits_faithful_one",
+    "test_cascade.py::test_compile_cache_stale_fingerprint_refused",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

@@ -1,0 +1,601 @@
+"""Cheap-path serving (ISSUE 10): the distilled cascade's escalation-band
+routing (incl. the all-escalate / none-escalate edges), operating-point
+parity gating, the bf16/int8 dtype axis with its canary construction
+gate, the persistent compile cache (hit/miss/stale-refusal, the
+restart-reuses-cache pin via compile-counter deltas, injected-fault
+degrade), cascade under the MicroBatcher with reload/rollback, and the
+train.distill_from soft-target recipe."""
+
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import models, train_lib
+from jama16_retina_tpu.configs import ServeConfig, get_config, override
+from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import quality as quality_lib
+from jama16_retina_tpu.obs.registry import Registry
+from jama16_retina_tpu.serve import (
+    CascadeEngine,
+    CascadeRejected,
+    CompileCache,
+    CompileCacheStale,
+    DtypeRejected,
+    ServingEngine,
+)
+from jama16_retina_tpu.serve.quantize import Q8Leaf
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+pytestmark = pytest.mark.cascade
+
+K = 2
+N_IMGS = 12
+SIZE = 32
+
+
+def _cfg(**serve_kw):
+    cfg = override(get_config("smoke"), [f"model.image_size={SIZE}"])
+    return cfg.replace(serve=ServeConfig(
+        max_batch=8, max_wait_ms=20.0, bucket_sizes=(4, 8), **serve_kw,
+    ))
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    """Smoke-model ensemble checkpoints + the fp32 engines a cascade
+    composes: a k=1 'student' (member 0 alone — the perfectly faithful
+    distillation stand-in) and the k=2 stacked ensemble."""
+    root = tmp_path_factory.mktemp("cascade")
+    cfg = _cfg()
+    model = models.build(cfg.model)
+    dirs = []
+    for m in range(K):
+        state, _ = train_lib.create_state(cfg, model, jax.random.key(m))
+        d = str(root / f"member_{m:02d}")
+        ck = ckpt_lib.Checkpointer(d)
+        ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+        ck.wait()
+        ck.close()
+        dirs.append(d)
+    st1, _ = train_lib.create_ensemble_state(cfg, model, [0])
+    st2, _ = train_lib.create_ensemble_state(cfg, model, [0, 1])
+    student = ServingEngine(cfg, model=model, state=st1,
+                            registry=Registry())
+    ensemble = ServingEngine(cfg, model=model, state=st2,
+                             registry=Registry())
+    imgs = np.random.default_rng(0).integers(
+        0, 256, (N_IMGS, SIZE, SIZE, 3), np.uint8
+    )
+    return cfg, model, dirs, st1, st2, student, ensemble, imgs
+
+
+class _StubEngine:
+    """Duck-typed engine half for routing/gate tests: fixed per-row
+    scores keyed by row index (rows are [n, 1] arrays whose single
+    value IS the index), plus a call ledger."""
+
+    def __init__(self, scores, registry=None):
+        self.scores = np.asarray(scores, np.float64)
+        self.registry = registry if registry is not None else Registry()
+        self.calls = []
+
+    def probs(self, rows):
+        idx = np.asarray(rows).reshape(len(rows), -1)[:, 0].astype(int)
+        self.calls.append(idx.tolist())
+        return self.scores[idx]
+
+
+def _stub_rows(n):
+    return np.arange(n, dtype=np.float64).reshape(n, 1)
+
+
+# ---------------------------------------------------------------------------
+# Escalation-band routing (stub engines: pure policy, no XLA)
+# ---------------------------------------------------------------------------
+
+
+def test_escalation_band_routes_exactly_the_banded_rows():
+    student = _StubEngine([0.1, 0.48, 0.52, 0.9, 0.5])
+    ensemble = _StubEngine([0.9, 0.8, 0.7, 0.6, 0.5])
+    reg = Registry()
+    cfg = _cfg(cascade_band=0.05, cascade_thresholds=(0.5,))
+    casc = CascadeEngine(cfg, student, ensemble, registry=reg)
+    out = casc.probs(_stub_rows(5))
+    # rows 1, 2, 4 sit within 0.05 of the 0.5 threshold -> ensemble
+    np.testing.assert_array_equal(out, [0.1, 0.8, 0.7, 0.9, 0.5])
+    assert ensemble.calls == [[1, 2, 4]]
+    assert reg.counter("serve.cascade.student_rows").value == 5
+    assert reg.counter("serve.cascade.escalated_rows").value == 3
+
+
+def test_multiple_thresholds_union_the_bands():
+    student = _StubEngine([0.2, 0.86, 0.5, 0.97])
+    ensemble = _StubEngine([0.0, 0.1, 0.2, 0.3])
+    cfg = _cfg(cascade_band=0.02, cascade_thresholds=(0.87, 0.98))
+    casc = CascadeEngine(cfg, student, ensemble, registry=Registry())
+    out = casc.probs(_stub_rows(4))
+    np.testing.assert_array_equal(out, [0.2, 0.1, 0.5, 0.3])
+
+
+def test_all_escalate_and_none_escalate_edges():
+    student = _StubEngine([0.1, 0.4, 0.6, 0.9])
+    ensemble = _StubEngine([0.5, 0.5, 0.5, 0.5])
+    # Band covering [0, 1]: the cascade IS the plain ensemble.
+    cfg_all = _cfg(cascade_band=1.0, cascade_thresholds=(0.5,))
+    reg_all = Registry()
+    out = CascadeEngine(cfg_all, student, ensemble,
+                        registry=reg_all).probs(_stub_rows(4))
+    np.testing.assert_array_equal(out, [0.5] * 4)
+    assert reg_all.counter("serve.cascade.escalated_rows").value == 4
+    # Band 0 with no score exactly AT a threshold: pure student — the
+    # ensemble is never invoked at all.
+    student2 = _StubEngine([0.1, 0.4, 0.6, 0.9])
+    ensemble2 = _StubEngine([0.5, 0.5, 0.5, 0.5])
+    cfg_none = _cfg(cascade_band=0.0, cascade_thresholds=(0.5,))
+    reg_none = Registry()
+    out = CascadeEngine(cfg_none, student2, ensemble2,
+                        registry=reg_none).probs(_stub_rows(4))
+    np.testing.assert_array_equal(out, [0.1, 0.4, 0.6, 0.9])
+    assert ensemble2.calls == []
+    assert reg_none.counter("serve.cascade.escalated_rows").value == 0
+    # Band 0 still escalates an EXACT threshold hit (<= semantics).
+    student3 = _StubEngine([0.5, 0.4])
+    ensemble3 = _StubEngine([0.7, 0.7])
+    out = CascadeEngine(cfg_none, student3, ensemble3,
+                        registry=Registry()).probs(_stub_rows(2))
+    np.testing.assert_array_equal(out, [0.7, 0.4])
+
+
+def test_band_and_threshold_validation():
+    with pytest.raises(ValueError, match="cascade_band"):
+        CascadeEngine(_cfg(cascade_band=-0.1), _StubEngine([0.5]),
+                      _StubEngine([0.5]), registry=Registry())
+    with pytest.raises(ValueError, match="cascade_thresholds"):
+        CascadeEngine(_cfg(cascade_thresholds=(1.5,)),
+                      _StubEngine([0.5]), _StubEngine([0.5]),
+                      registry=Registry())
+
+
+# ---------------------------------------------------------------------------
+# Go-live gate: golden canary + operating-point AUC parity
+# ---------------------------------------------------------------------------
+
+
+def test_gate_refuses_garbage_student_and_admits_faithful_one():
+    """The auc_floor verdict must catch a student whose scores invert
+    the ensemble's ranking (band 0: nothing escalates, the student's
+    errors ship) — and pass a student identical to the ensemble."""
+    n = 40
+    rng = np.random.default_rng(3)
+    full = rng.uniform(0.05, 0.95, n)
+    grades = np.where(full >= 0.5, 3, 0)  # ensemble AUC = 1.0
+    rows = _stub_rows(n)
+    cfg = _cfg(cascade_band=0.0, cascade_thresholds=(0.5,))
+    garbage = CascadeEngine(
+        cfg, _StubEngine(1.0 - full), _StubEngine(full),
+        registry=Registry(),
+    )
+    with pytest.raises(CascadeRejected, match="auc_floor"):
+        garbage.go_live(rows, grades)
+    faithful = CascadeEngine(
+        cfg, _StubEngine(full), _StubEngine(full), registry=Registry(),
+    )
+    verdicts = faithful.go_live(rows, grades)
+    by_name = {v.name: v for v in verdicts}
+    assert by_name["auc_floor"].passed and not by_name["auc_floor"].skipped
+    # No canary configured on stub halves: skipped, loudly, not silent.
+    assert by_name["golden_canary"].skipped
+
+
+def test_gate_canary_binds_through_the_cascades_own_monitor():
+    """The predict.py wiring: sub-engines quality-off, the monitor (and
+    its pinned canary) injected on the CASCADE — the golden_canary
+    verdict must read that canary, not skip (the review-caught gap)."""
+    imgs = np.zeros((4, 1), np.float64)  # stub rows: index-valued
+    student = _StubEngine([0.1, 0.2, 0.3, 0.4])
+    ensemble = _StubEngine([0.9, 0.9, 0.9, 0.9])
+    pinned = np.array([0.1, 0.2, 0.3, 0.4])
+    canary = quality_lib.GoldenCanary(
+        np.zeros((4, 8, 8, 3), np.uint8), reference_scores=pinned,
+        registry=Registry(),
+    )
+    # Patch the canary's images to the stub row shape the halves score.
+    canary.images = _stub_rows(4)
+    monitor = quality_lib.QualityMonitor(
+        type("Q", (), {"enabled": True, "score_bins": 20,
+                       "window_scores": 256})(),
+        registry=Registry(), canary=canary,
+    )
+    cfg = _cfg(cascade_band=0.0, cascade_thresholds=(0.99,))
+    casc = CascadeEngine(cfg, student, ensemble, registry=Registry(),
+                         quality=monitor)
+    v = {x.name: x for x in casc.gate()}["golden_canary"]
+    assert not v.skipped and v.passed and v.value == 0.0
+    # A deviating pinned set fails the same verdict (never a skip).
+    canary.reference = pinned + 10.0
+    with pytest.raises(CascadeRejected, match="golden_canary"):
+        casc.go_live()
+
+
+def test_gate_skips_without_labeled_rows():
+    casc = CascadeEngine(
+        _cfg(), _StubEngine([0.5]), _StubEngine([0.5]),
+        registry=Registry(),
+    )
+    verdicts = casc.go_live()
+    assert all(v.passed for v in verdicts)
+    assert all(v.skipped for v in verdicts)
+
+
+def test_operating_point_parity_with_real_engines(setup):
+    """Faithful-student cascade (student == ensemble halves) over the
+    real engine path: merged scores equal the plain ensemble's exactly,
+    so the gate's AUC and per-threshold sensitivities match bit for
+    bit and go-live admits."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    casc_cfg = _cfg(cascade_band=0.01, cascade_thresholds=(0.5,))
+    casc = CascadeEngine(casc_cfg, ensemble, ensemble,
+                         registry=Registry())
+    grades = np.asarray([0, 3] * (N_IMGS // 2), np.int32)
+    verdicts = casc.go_live(imgs, grades)
+    by_name = {v.name: v for v in verdicts}
+    assert by_name["auc_floor"].passed
+    np.testing.assert_array_equal(
+        casc.probs(imgs), ensemble.probs(imgs)
+    )
+
+
+def test_cascade_rows_bitmatch_their_source_engine(setup):
+    """Escalated rows are bitwise the ensemble's, everything else
+    bitwise the student's — the cascade adds routing, never new math."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    s_scores = np.asarray(student.probs(imgs), np.float64)
+    # Calibrate a band that splits the request: escalate ~half.
+    thr = float(np.median(s_scores))
+    band = float(np.quantile(np.abs(s_scores - thr), 0.4))
+    casc_cfg = _cfg(cascade_band=band, cascade_thresholds=(thr,))
+    casc = CascadeEngine(casc_cfg, student, ensemble,
+                         registry=Registry())
+    mask = casc.escalation_mask(s_scores)
+    assert 0 < mask.sum() < N_IMGS, "fixture must split the request"
+    out = casc.probs(imgs)
+    np.testing.assert_array_equal(out[~mask], s_scores[~mask])
+    np.testing.assert_array_equal(
+        out[mask], np.asarray(ensemble.probs(imgs[mask]))
+    )
+
+
+# ---------------------------------------------------------------------------
+# serve.dtype: bf16/int8 numerics + the canary construction gate
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_engines_close_to_fp32_and_int8_resident(setup):
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    ref = np.asarray(ensemble.probs(imgs), np.float64)
+    for d, atol in (("bf16", 0.02), ("int8", 0.05)):
+        dcfg = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, dtype=d,
+        ))
+        eng = ServingEngine(dcfg, model=model, state=st2,
+                            registry=Registry())
+        got = np.asarray(eng.probs(imgs), np.float64)
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, atol=atol, err_msg=d)
+    # int8 residency: every rank>=2 kernel is a Q8Leaf (int8 + scale).
+    i8cfg = cfg.replace(serve=dataclasses.replace(cfg.serve, dtype="int8"))
+    eng8 = ServingEngine(i8cfg, model=model, state=st2,
+                         registry=Registry())
+    q8 = [
+        leaf for leaf in jax.tree.leaves(
+            eng8.state.params, is_leaf=lambda x: isinstance(x, Q8Leaf)
+        ) if isinstance(leaf, Q8Leaf)
+    ]
+    assert q8, "int8 engine carries no quantized leaves"
+    assert all(np.asarray(leaf.q).dtype == np.int8 for leaf in q8)
+
+
+def test_int8_scales_are_per_member_and_biases_stay_float():
+    """The review-caught quantizer contracts: calibration keeps the
+    member axis (a 100x-heavier member must not set every member's
+    scale) and stacked 1-D params (biases, BN affine: [k, O]) stay
+    float — weights-only quantization."""
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu.serve import quantize
+
+    k0 = np.random.default_rng(0).normal(size=(3, 3, 4, 8)).astype(
+        np.float32
+    )
+    stacked = np.stack([k0, k0 * 100.0])
+    leaf = quantize._quantize_leaf(jnp.asarray(stacked))
+    s = np.asarray(leaf.s, np.float64)
+    assert s.shape[0] == 2 and s.shape[-1] == 8
+    np.testing.assert_allclose(
+        s[1].ravel(), s[0].ravel() * 100.0, rtol=1e-4
+    )
+    deq = np.asarray(leaf.q, np.float64) * s
+    for m in range(2):  # both members keep full int8 resolution
+        np.testing.assert_allclose(
+            deq[m], stacked[m],
+            atol=float(np.abs(stacked[m]).max()) / 100,
+        )
+    tree = {
+        "kernel": jnp.asarray(stacked),
+        "bias": jnp.zeros((2, 8), jnp.float32),
+    }
+    out = quantize._quantize_tree_int8(tree)
+    assert isinstance(out["kernel"], Q8Leaf)
+    assert not isinstance(out["bias"], Q8Leaf)
+
+
+def test_unknown_dtype_refused():
+    cfg = _cfg(dtype="fp16")
+    with pytest.raises(ValueError, match="serve.dtype"):
+        ServingEngine(cfg, model=models.build(cfg.model),
+                      state=None, member_dirs=None, registry=Registry())
+
+
+def test_dtype_canary_gate_refuses_then_admits(setup, tmp_path):
+    """bf16/int8 engines with a PINNED golden canary: a bound tighter
+    than the quantization error refuses construction with typed
+    DtypeRejected (the engine never takes a request); a deliberate
+    loose bound admits. fp32 is exempt (byte-stability is its own
+    contract)."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    canary_imgs = imgs[:8]
+    pinned = np.asarray(metrics.ensemble_average(list(
+        ensemble.member_probs(canary_imgs)
+    )), np.float64).ravel()
+    path = quality_lib.save_canary(
+        str(tmp_path / "canary.npz"), canary_imgs, scores=pinned
+    )
+
+    def cfg_for(dtype, bound):
+        c = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, dtype=dtype, dtype_canary_max_dev=bound,
+        ))
+        return c.replace(obs=dataclasses.replace(
+            c.obs, quality=dataclasses.replace(
+                c.obs.quality, enabled=True, canary_path=path,
+                canary_every_s=0.0,
+            ),
+        ))
+
+    for d in ("bf16", "int8"):
+        with pytest.raises(DtypeRejected, match=d):
+            ServingEngine(cfg_for(d, 0.0), model=model, state=st2,
+                          registry=Registry())
+        eng = ServingEngine(cfg_for(d, 0.5), model=model, state=st2,
+                            registry=Registry())
+        assert eng.probs(imgs).shape == (N_IMGS,)
+    # fp32 with bound 0: not gated (identity transform).
+    eng = ServingEngine(cfg_for("fp32", 0.0), model=model, state=st2,
+                        registry=Registry())
+    assert eng.probs(imgs).shape == (N_IMGS,)
+
+
+# ---------------------------------------------------------------------------
+# Persistent compile cache
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_miss_then_restart_hits_and_bitmatches(setup,
+                                                             tmp_path):
+    """THE warm-restart pin (ISSUE 10 acceptance, via compile-counter
+    deltas): a cold engine compiles every bucket (misses == buckets,
+    durable saves); a second engine over the same cache deserializes
+    every bucket (hits == buckets, ZERO compiles) and serves bit-
+    identical probabilities."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    ccfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, compile_cache_dir=str(tmp_path / "cache"),
+    ))
+    reg_a = Registry()
+    eng_a = ServingEngine(ccfg, model=model, state=st2, registry=reg_a)
+    n_buckets = len(eng_a.buckets)
+    assert reg_a.counter("serve.compile_cache.misses").value == n_buckets
+    assert reg_a.counter("serve.compile_cache.hits").value == 0
+    p_a = eng_a.probs(imgs)
+    reg_b = Registry()
+    eng_b = ServingEngine(ccfg, model=model, state=st2, registry=reg_b)
+    assert reg_b.counter("serve.compile_cache.hits").value == n_buckets
+    assert reg_b.counter("serve.compile_cache.misses").value == 0
+    assert reg_b.gauge("serve.engine.warmup_sec").value > 0
+    np.testing.assert_array_equal(p_a, eng_b.probs(imgs))
+    # The cached program is the SAME math as the uncached engine's.
+    np.testing.assert_array_equal(p_a, ensemble.probs(imgs))
+
+
+def test_compile_cache_stale_fingerprint_refused(tmp_path):
+    d = str(tmp_path / "cache")
+    CompileCache(d, {"arch": "a", "image_size": 32}, registry=Registry())
+    with pytest.raises(CompileCacheStale) as ei:
+        CompileCache(d, {"arch": "a", "image_size": 64},
+                     registry=Registry())
+    # The refusal names the directory and the rebuild command.
+    assert d in str(ei.value) and "rm -r" in str(ei.value)
+    assert "image_size" in str(ei.value)
+
+
+def test_compile_cache_corrupt_entry_degrades_to_recompile(setup,
+                                                           tmp_path):
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    cache_dir = str(tmp_path / "cache")
+    ccfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, compile_cache_dir=cache_dir,
+    ))
+    ServingEngine(ccfg, model=model, state=st2, registry=Registry())
+    entries = sorted(
+        f for f in os.listdir(cache_dir) if f.endswith(".jex")
+    )
+    assert len(entries) == 2
+    with open(os.path.join(cache_dir, entries[0]), "wb") as f:
+        f.write(b"corrupt")
+    reg = Registry()
+    eng = ServingEngine(ccfg, model=model, state=st2, registry=reg)
+    assert reg.counter("serve.compile_cache.misses").value == 1
+    assert reg.counter("serve.compile_cache.hits").value == 1
+    # Degraded to recompile — requests still serve, bit-identically.
+    np.testing.assert_array_equal(eng.probs(imgs), ensemble.probs(imgs))
+
+
+def test_compile_cache_injected_load_fault_counts_recompile(setup,
+                                                            tmp_path):
+    """The serve.compile_cache.load chaos site: an injected load
+    failure is a counted miss + recompile, never a failed engine."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    ccfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, compile_cache_dir=str(tmp_path / "cache"),
+    ))
+    ServingEngine(ccfg, model=model, state=st2, registry=Registry())
+    prev = faultinject.arm({
+        "serve.compile_cache.load": {
+            "kind": "error", "on_calls": [1], "error": "OSError",
+            "message": "chaos cache load",
+        },
+    })
+    try:
+        reg = Registry()
+        eng = ServingEngine(ccfg, model=model, state=st2, registry=reg)
+        assert reg.counter("serve.compile_cache.misses").value == 1
+        assert reg.counter("serve.compile_cache.hits").value == 1
+        assert eng.probs(imgs).shape == (N_IMGS,)
+    finally:
+        faultinject.arm(prev)
+
+
+# ---------------------------------------------------------------------------
+# Cascade under the MicroBatcher + reload/rollback
+# ---------------------------------------------------------------------------
+
+
+def test_cascade_under_batcher_with_reload_and_rollback(setup):
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    casc_cfg = _cfg(cascade_band=1.0, cascade_thresholds=(0.5,))
+    ens = ServingEngine(casc_cfg, model=model, state=st2,
+                        registry=Registry())
+    casc = CascadeEngine(casc_cfg, student, ens, registry=Registry())
+    expect = casc.probs(imgs)
+    batcher = casc.make_batcher()
+    try:
+        futures = [batcher.submit(imgs[i:i + 3])
+                   for i in range(0, N_IMGS, 3)]
+        got = np.concatenate([f.result(timeout=60) for f in futures])
+        np.testing.assert_array_equal(got, expect)
+        # Hot-swap the EXPENSIVE half under live cascade traffic: the
+        # student keeps serving; escalations land on the new
+        # generation (band 1.0 -> everything escalates, so the swap is
+        # fully visible in the output).
+        st_new, _ = train_lib.create_ensemble_state(
+            casc_cfg, model, [7, 8]
+        )
+        info = casc.reload(state=st_new)
+        assert info["generation"] == 1 == casc.generation
+        swapped = np.concatenate([
+            batcher.submit(imgs[i:i + 3]).result(timeout=60)
+            for i in range(0, N_IMGS, 3)
+        ])
+        np.testing.assert_array_equal(
+            swapped, np.asarray(ens.probs(imgs))
+        )
+        assert not np.array_equal(swapped, expect)
+        # Instant rollback restores the pre-swap scores.
+        rb = casc.rollback()
+        assert rb["restored_from"] == 0
+        rolled = np.concatenate([
+            batcher.submit(imgs[i:i + 3]).result(timeout=60)
+            for i in range(0, N_IMGS, 3)
+        ])
+        np.testing.assert_array_equal(rolled, expect)
+    finally:
+        batcher.close()
+
+
+def test_lifecycle_controller_unwraps_cascade(tmp_path):
+    """Cascade-aware lifecycle: a controller handed a CascadeEngine
+    drives the ENSEMBLE half (retrain/gate/swap/rollback) while the
+    student stays the cheap path."""
+    from jama16_retina_tpu.lifecycle import LifecycleController
+
+    cfg = override(_cfg(), ["lifecycle.enabled=true"])
+    student = _StubEngine([0.5], registry=Registry())
+
+    class _FakeEnsemble:
+        registry = Registry()
+        quality = None
+        _gen = type("G", (), {"member_dirs": ["live"]})()
+
+        def probs(self, rows):
+            return np.full((len(rows),), 0.5)
+
+    casc = CascadeEngine(cfg, student, _FakeEnsemble(),
+                         registry=Registry())
+    ctl = LifecycleController(cfg, str(tmp_path), engine=casc)
+    assert ctl.cascade is casc
+    assert ctl.engine is casc.ensemble
+
+
+# ---------------------------------------------------------------------------
+# Distillation recipe (train.distill_from)
+# ---------------------------------------------------------------------------
+
+
+def test_distill_soft_targets_change_the_loss(setup):
+    """The jit step trains on the teacher's soft scores when the batch
+    carries them: same images/grades, different 'soft' -> different
+    loss; no 'soft' key -> the hard-label loss, unchanged."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=None,
+                                     donate=False)
+    rng = np.random.default_rng(1)
+    base = {
+        "image": rng.integers(0, 256, (8, SIZE, SIZE, 3), np.uint8),
+        "grade": rng.integers(0, 5, (8,), np.int32),
+    }
+    key = jax.random.key(2)
+    _, m_hard = step(state, base, key)
+    _, m_soft_lo = step(state, {**base, "soft": np.full(8, 0.1, np.float32)},
+                        key)
+    _, m_soft_hi = step(state, {**base, "soft": np.full(8, 0.9, np.float32)},
+                        key)
+    losses = {float(m_hard["loss"]), float(m_soft_lo["loss"]),
+              float(m_soft_hi["loss"])}
+    assert len(losses) == 3, "soft targets must actually drive the loss"
+
+
+def test_fit_distill_from_trains_student(setup, tmp_path):
+    """End to end: trainer.fit with train.distill_from restores the
+    teacher ensemble once, attaches soft scores to every batch, and
+    trains/evals/checkpoints normally (the distill record lands in the
+    run log)."""
+    import json
+
+    from jama16_retina_tpu import trainer
+    from jama16_retina_tpu.data import tfrecord
+
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    data_dir = str(tmp_path / "data")
+    for split, n in (("train", 24), ("val", 16)):
+        tfrecord.write_synthetic_split(
+            data_dir, split, n, image_size=SIZE, num_shards=1, seed=3
+        )
+    root = os.path.dirname(dirs[0])
+    dcfg = cfg.replace(train=dataclasses.replace(
+        cfg.train,
+        distill_from=root, steps=2, eval_every=2, log_every=1,
+        checkpoint_dir=str(tmp_path / "student"),
+    ))
+    out = trainer.fit(dcfg, data_dir, str(tmp_path / "student"))
+    assert out["best_auc"] is not None
+    records = [
+        json.loads(line) for line in
+        open(os.path.join(tmp_path, "student", "metrics.jsonl"))
+    ]
+    kinds = {r.get("kind") for r in records}
+    assert "distill" in kinds and "eval" in kinds
