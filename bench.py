@@ -986,6 +986,17 @@ def main() -> None:
              "per swept bucket)",
     )
     parser.add_argument(
+        "--skip_time_to_auc", action="store_true",
+        help="skip the time-to-AUC rows (ISSUE 11: two smoke-scale "
+             "fit_ensemble runs — fp32 and bf16 — through "
+             "scripts/time_to_auc.py; the accepted north-star metric "
+             "lands in the trajectory JSON as time_to_auc_sec_*)",
+    )
+    parser.add_argument(
+        "--time_to_auc_target", type=float, default=0.95,
+        help="fixed target val AUC for the time_to_auc_sec_* rows",
+    )
+    parser.add_argument(
         "--chaos", action="store_true",
         help="run the deterministic fault-injection smoke (ISSUE 6): "
              "arm a FaultPlan, drive poison-record quarantine, batcher "
@@ -1375,6 +1386,155 @@ def main() -> None:
             _log(f"cheap-path overhead bench failed: "
                  f"{type(e).__name__}: {e}")
 
+    # Raw-speed train rows (ISSUE 11), mirroring the serve_dtype_*
+    # pattern: the SAME device-only window with the train-side precision
+    # axis at bf16 (fp32 master weights; train_lib._bf16_params), and —
+    # where Mosaic lowers — the fused Pallas step path on top. Each
+    # row's physics guard uses its own compiled program's FLOPs; the
+    # _vs_fp32 ratios are the dials' measured payoff on this chip.
+    if not headline_serialized:
+        try:
+            bf16_cfg = cfg.replace(train=dataclasses.replace(
+                cfg.train, dtype="bf16"))
+            step_b, state_b, batches_b, key_b = build_train_fixture(
+                bf16_cfg, mesh, batch_size
+            )
+            flops_b = _flops_of(step_b, state_b, batches_b[0], key_b)
+            rate_b, _ = _timed_steps(
+                step_b, state_b,
+                lambda i: batches_b[i % N_DISTINCT_BATCHES], key_b,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_b = _publish(
+                extras, "train_dtype_bf16_images_per_sec", rate_b,
+                flops_b / batch_size if flops_b else None, peak,
+                suffix=" (train.dtype=bf16, fp32 master weights)",
+            )
+            if rate_b is not None:
+                extras["train_dtype_bf16_vs_fp32"] = round(
+                    rate_b / device_only, 2
+                )
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"train dtype bench failed: {type(e).__name__}: {e}")
+
+        # Fused-kernel rows only where Mosaic actually lowers: off-TPU
+        # the kernels run in interpret mode — a correctness harness
+        # that would bench Python, not the fused path.
+        if jax.default_backend() == "tpu":
+            try:
+                fused_cfg = cfg.replace(train=dataclasses.replace(
+                    cfg.train, dtype="bf16", use_pallas_fused=True))
+                step_f, state_f, batches_f, key_f = build_train_fixture(
+                    fused_cfg, mesh, batch_size
+                )
+                flops_f = _flops_of(step_f, state_f, batches_f[0], key_f)
+                rate_f, _ = _timed_steps(
+                    step_f, state_f,
+                    lambda i: batches_f[i % N_DISTINCT_BATCHES], key_f,
+                    TIMED_STEPS, batch_size, n_dev,
+                )
+                rate_f = _publish(
+                    extras, "train_fused_images_per_sec", rate_f,
+                    flops_f / batch_size if flops_f else None, peak,
+                    suffix=" (train.dtype=bf16 + train.use_pallas_fused: "
+                           "fused normalize+augment and fused adamw)",
+                )
+                if rate_f is not None:
+                    extras["train_fused_vs_fp32"] = round(
+                        rate_f / device_only, 2
+                    )
+            except Exception as e:  # pragma: no cover - bench emits JSON
+                _log(f"train fused bench failed: {type(e).__name__}: {e}")
+        else:
+            _log("train_fused rows skipped: Mosaic needs the TPU "
+                 "backend (interpret mode would bench Python)")
+
+    # Checkpoint-save / eval stall rows (ISSUE 11): seconds the step
+    # loop BLOCKS at a boundary — sync (the before) vs async/overlapped
+    # (the after). Self-fencing: the sync save returns after the orbax
+    # write was handed off with the host state materialized, and the
+    # overlapped eval's residual stall is the result() join.
+    try:
+        import shutil
+        import tempfile as _tf
+
+        from jama16_retina_tpu import trainer as trainer_lib
+        from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+        # Two separate Checkpointer dirs: orbax pins a manager's saves
+        # to ONE thread (finalize-thread affinity), and these two rows
+        # deliberately save from different threads.
+        ck_dir = _tf.mkdtemp(prefix="bench_ckpt_stall_")
+        ck = ckpt_lib.Checkpointer(ck_dir, max_to_keep=1)
+        t0 = time.perf_counter()
+        ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+        extras["ckpt_save_stall_sync_sec"] = round(
+            time.perf_counter() - t0, 3
+        )
+        ck.wait()
+        ck.close()
+        ck_dir2 = _tf.mkdtemp(prefix="bench_ckpt_stall_async_")
+        ck2 = ckpt_lib.Checkpointer(ck_dir2, max_to_keep=1)
+        saver = ckpt_lib.AsyncSaver()
+        t0 = time.perf_counter()
+        snap_state = trainer_lib._state_snapshot(state)
+        saver.submit(lambda: ck2.save(
+            1, jax.device_get(snap_state), {"val_auc": 0.5}
+        ))
+        extras["ckpt_save_stall_sec"] = round(
+            time.perf_counter() - t0, 3
+        )
+        saver.drain()
+        saver.close()
+        ck2.wait()
+        ck2.close()
+        shutil.rmtree(ck_dir, ignore_errors=True)
+        shutil.rmtree(ck_dir2, ignore_errors=True)
+        _log(f"ckpt save stall: sync {extras['ckpt_save_stall_sync_sec']}s "
+             f"-> async {extras['ckpt_save_stall_sec']}s")
+
+        # Eval stall: one full val-style forward pass + host AUC,
+        # blocking the loop (before) vs overlapped behind train steps
+        # with only the tail join left on the loop (after).
+        ev_step = train_lib.make_eval_step(cfg, model, mesh=mesh)
+        ev_batch = mesh_lib.shard_batch(
+            {"image": rng.integers(
+                0, 256, (cfg.eval.batch_size, size, size, 3), np.uint8
+            )},
+            mesh,
+        )
+        ev_labels = rng.integers(0, 2, (cfg.eval.batch_size,))
+
+        def eval_pass(src):
+            from jama16_retina_tpu.eval import metrics as metrics_lib
+
+            for _ in range(5):
+                probs = np.asarray(jax.device_get(ev_step(src, ev_batch)))
+            if ev_labels.min() != ev_labels.max():
+                metrics_lib.roc_auc(ev_labels.astype(np.float64), probs)
+            return True
+
+        eval_pass(state)  # compile + warm
+        t0 = time.perf_counter()
+        eval_pass(state)
+        extras["eval_stall_blocking_sec"] = round(
+            time.perf_counter() - t0, 3
+        )
+        snap_state = trainer_lib._state_snapshot(state)
+        job = trainer_lib._BgJob(lambda: eval_pass(snap_state))
+        stall = 0.0
+        for i in range(10):
+            state, _ = step(state, batches[i % N_DISTINCT_BATCHES], key)
+        t0 = time.perf_counter()
+        job.result()
+        stall += time.perf_counter() - t0
+        _fence(state)
+        extras["eval_stall_sec"] = round(stall, 3)
+        _log(f"eval stall: blocking {extras['eval_stall_blocking_sec']}s "
+             f"-> overlapped residual {extras['eval_stall_sec']}s")
+    except Exception as e:  # pragma: no cover - bench must emit JSON
+        _log(f"stall rows bench failed: {type(e).__name__}: {e}")
+
     if args.chaos:
         _chaos_smoke(extras)
 
@@ -1427,6 +1587,22 @@ def main() -> None:
                 dirs["raw"], "train", cfg.data, size, seed=0, mesh=mesh
             )
             _fence(next(hbm_it)["image"])  # decode + upload + first gather
+            extras["hbm_load_first_sec"] = round(time.time() - t0, 2)
+            # Warm-state-explicit re-measure (ISSUE 11 bench-noise fix):
+            # the first-touch number swung 22.18 -> 2.73 s across rounds
+            # (BENCH_r03 vs r05) with whatever page-cache/tf-graph state
+            # the earlier host sections happened to leave behind. A
+            # second construction over the same files is
+            # deterministically WARM — that is the trajectory-comparable
+            # number, published under the historical hbm_load_sec key;
+            # the ambient first-touch stays alongside as
+            # hbm_load_first_sec (cold only on a truly cold host).
+            del hbm_it  # release the first copy's device residency
+            t0 = time.time()
+            hbm_it = hbm_pipeline.train_batches(
+                dirs["raw"], "train", cfg.data, size, seed=0, mesh=mesh
+            )
+            _fence(next(hbm_it)["image"])
             extras["hbm_load_sec"] = round(time.time() - t0, 2)
             rate, state = _timed_steps(
                 step, state, lambda i: next(hbm_it), key,
@@ -2123,6 +2299,46 @@ def main() -> None:
             except Exception as e:  # pragma: no cover - bench emits JSON
                 _log(f"serve frontier bench failed: "
                      f"{type(e).__name__}: {e}")
+
+    # Time-to-AUC rows (ISSUE 11): the north-star's FIRST clause lands
+    # in the trajectory JSON instead of living only in the side script.
+    # Two smoke-scale fit_ensemble runs (member-parallel, hbm loader)
+    # through scripts/time_to_auc.py's own harness: fp32, then bf16 at
+    # the same seed/recipe — wall seconds from trainer start to the
+    # first ensemble-val crossing of the fixed target.
+    if not args.skip_time_to_auc:
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "time_to_auc",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "time_to_auc.py"),
+            )
+            tta = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(tta)
+            common = [
+                "--smoke", "--k", "2", "--steps", "120",
+                "--eval_every", "20", "--train_n", "256",
+                "--val_n", "128", "--test_n", "128", "--bootstrap", "50",
+                "--target", str(args.time_to_auc_target),
+            ]
+            extras["time_to_auc_target"] = args.time_to_auc_target
+            r32 = tta.main(common, print_json=False)
+            extras["time_to_auc_sec_fp32"] = r32["value"]
+            _log(f"time_to_auc fp32: {r32['value']} s to AUC >= "
+                 f"{args.time_to_auc_target} (crossed={r32['crossed']})")
+            rbf = tta.main(common + ["--train_dtype", "bf16"],
+                           print_json=False)
+            extras["time_to_auc_sec_bf16"] = rbf["value"]
+            _log(f"time_to_auc bf16: {rbf['value']} s to AUC >= "
+                 f"{args.time_to_auc_target} (crossed={rbf['crossed']})")
+            if r32["value"] and rbf["value"]:
+                extras["time_to_auc_bf16_speedup"] = round(
+                    r32["value"] / rbf["value"], 2
+                )
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"time_to_auc bench failed: {type(e).__name__}: {e}")
 
     extras["device_only"] = round(device_only, 2)
     print(json.dumps({

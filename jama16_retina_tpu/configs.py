@@ -173,6 +173,68 @@ class TrainConfig:
     steps: int = 30000
     eval_every: int = 500
     log_every: int = 50
+    # --- Raw-speed training (ISSUE 11) --------------------------------
+    # Train-step numerics: "fp32" keeps every existing bit-identity pin
+    # (params, grads, and optimizer all float32 — the default);
+    # "bf16" runs forward/backward on a bfloat16 CAST of the params
+    # while the float32 MASTER weights keep taking the optimizer update
+    # (mixed precision with fp32 master weights). Loss-scale-free by
+    # design: bf16 shares float32's exponent range, so gradients
+    # neither overflow nor underflow the way fp16 ones do. Gated by the
+    # golden-curve parity check below — the train-side mirror of the
+    # serve.dtype canary gate (PR 10). Flax loops only (fit_tf refuses).
+    dtype: str = "fp32"
+    # Pinned fp32 golden curve for the dtype gate: a metrics.jsonl (or
+    # the JSON list of its eval records) from an fp32 run of the SAME
+    # config/seed. When set and train.dtype != fp32, every eval's val
+    # AUC is compared against the pinned curve at the same step; drift
+    # beyond dtype_curve_tol raises train_lib.DtypeCurveRejected — the
+    # run is REFUSED, not silently shipped. Empty = ungated (logged).
+    dtype_curve_ref: str = ""
+    # Max |val_auc - pinned fp32 val_auc| at matching steps before a
+    # non-fp32 run is refused.
+    dtype_curve_tol: float = 0.02
+    # Fused Pallas step path (ops/pallas_augment.fused_normalize_color_
+    # jitter + ops/pallas_opt.py): (a) normalize+color-augment in ONE
+    # kernel pass with the per-image contrast means computed in-kernel
+    # (the separate XLA reduce pass disappears); (b) the adamw update
+    # as one fused pass over params/grads/moments per leaf instead of
+    # the optax tree-map chain. adamw without gradient clipping only
+    # (validated loudly); routed off (with a log) on >1-device GSPMD
+    # meshes exactly like data.use_pallas (Mosaic kernels cannot be
+    # auto-partitioned). Off by default; fp32-reference pins in
+    # tests/test_mixedprec.py.
+    use_pallas_fused: bool = False
+    # Gradient accumulation: split each data.batch_size batch into this
+    # many sequential micro-batches INSIDE the one jit step (grads
+    # averaged in the step's compute dtype, one optimizer update per
+    # recipe batch). Decouples the device's per-forward batch
+    # (batch_size/accum_steps — what bounds activation HBM) from the
+    # recipe batch (what the optimizer sees), feeding large-batch
+    # recipes. batch_size must divide evenly; BatchNorm sees micro-
+    # batch moments (ghost batch norm — the large-batch literature's
+    # default). 1 = off (the step program is byte-identical to before
+    # the knob existed).
+    accum_steps: int = 1
+    # Async checkpointing (utils/checkpoint.AsyncSaver): eval-time saves
+    # snapshot the state on-device (one HBM copy) and hand the
+    # device->host fetch + orbax write to a background worker, so the
+    # step loop never blocks on checkpoint I/O (the k=4 stacked fetch is
+    # ~48 s on this environment's tunnel). The SIGTERM preemption save
+    # drains the worker first; kill -9 mid-save leaves only an
+    # uncommitted orbax tmp step (invisible to resume). Single-process
+    # flax loops only (multi-host gathers cannot run off-thread).
+    async_save: bool = False
+    # Eval overlap: dispatch the whole eval block (val predict -> AUC ->
+    # best-tracking -> save) on a background worker over an on-device
+    # snapshot of the state, so training continues through what used to
+    # be the eval pause. Eval RESULTS are identical (same snapshot, same
+    # math — pinned); only their arrival is late: early stopping fires
+    # when the overlapped eval completes, a few steps after its
+    # boundary. Implies async saves (orbax pins a manager's saves to
+    # one thread, so the AsyncSaver worker is the save thread whenever
+    # overlap is on). Single-process flax loops only.
+    eval_overlap: bool = False
     learning_rate: float = 1e-3
     lr_schedule: str = "cosine"  # constant | cosine | warmup_cosine
     warmup_steps: int = 500

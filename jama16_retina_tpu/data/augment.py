@@ -183,6 +183,7 @@ def augment_batch(
     cfg: DataConfig,
     interpret: bool = False,
     debug: bool = False,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """uint8 NHWC batch -> augmented float32 [-1,1] batch (train path).
 
@@ -191,6 +192,13 @@ def augment_batch(
     commute with per-pixel color ops (the contrast mean is permutation-
     invariant), so applying color first is numerically equivalent to the
     jnp path's geometric-first order.
+
+    ``fused`` (train.use_pallas_fused; ISSUE 11) goes one step further:
+    the per-image contrast means are accumulated INSIDE the kernel
+    (pallas_augment.fused_normalize_color_jitter), so the separate
+    channel-means reduce pass over the uint8 batch disappears too —
+    normalize + color jitter is one Mosaic program. Wins over
+    ``use_pallas`` when both are set.
 
     ``debug`` (the trainer passes train.debug, SURVEY.md §5.2): chex
     shape/dtype asserts on the contract this function silently assumes —
@@ -205,7 +213,7 @@ def augment_batch(
     if not cfg.augment:
         return normalize(images_u8)
     params = _draw_params(key, images_u8.shape[0], cfg)
-    if cfg.use_pallas:
+    if cfg.use_pallas or fused:
         from jama16_retina_tpu.ops import pallas_augment as pk
 
         # Mosaic only lowers on TPU; on any other backend (CPU tests,
@@ -213,16 +221,28 @@ def augment_batch(
         # the kernel's interpret mode so use_pallas configs run anywhere.
         interpret = interpret or jax.default_backend() != "tpu"
 
-        affine, offset = pk.color_affine_from_params(
-            pk.channel_means_u8(images_u8),
-            params["brightness"],
-            params["contrast"],
-            params["sat_hue"][:, 0],
-            params["sat_hue"][:, 1] * (2.0 * jnp.pi),
-        )
-        imgs = pk.fused_color_jitter(
-            images_u8, affine, offset, interpret=interpret
-        )
+        if fused:
+            imgs = pk.fused_normalize_color_jitter(
+                images_u8,
+                pk.chroma_matrix(
+                    params["sat_hue"][:, 0],
+                    params["sat_hue"][:, 1] * (2.0 * jnp.pi),
+                ),
+                params["contrast"],
+                params["brightness"],
+                interpret=interpret,
+            )
+        else:
+            affine, offset = pk.color_affine_from_params(
+                pk.channel_means_u8(images_u8),
+                params["brightness"],
+                params["contrast"],
+                params["sat_hue"][:, 0],
+                params["sat_hue"][:, 1] * (2.0 * jnp.pi),
+            )
+            imgs = pk.fused_color_jitter(
+                images_u8, affine, offset, interpret=interpret
+            )
         return jax.vmap(lambda im, p: _geometric_one(im, p, cfg))(imgs, params)
     imgs = normalize(images_u8)
     return jax.vmap(lambda im, p: _augment_one(im, p, cfg))(imgs, params)

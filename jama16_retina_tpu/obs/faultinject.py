@@ -63,6 +63,11 @@ SITES = {
     "host.decode": "serve/host per-image file read before fundus "
                    "normalization",
     "ckpt.restore": "Checkpointer.restore (utils/checkpoint.py)",
+    "ckpt.save": "Checkpointer save write — fires in Checkpointer.save/"
+                 "save_latest before the orbax write, on whichever "
+                 "thread runs it (the train loop, or the AsyncSaver "
+                 "worker under train.async_save); latency plans widen "
+                 "the in-flight-save window for kill drills",
     "engine.dispatch": "ServingEngine per-chunk dispatch "
                        "(serve/engine.py)",
     "serve.compile_cache.load": "persistent AOT compile-cache entry "

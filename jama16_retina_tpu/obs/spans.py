@@ -22,10 +22,13 @@ quantiles.
                   (BENCH_r05's 10x) measured where it actually bites,
     dispatch    — issuing the jit train step (async dispatch, so this
                   is queue pressure, not device compute),
-    pause       — eval/checkpoint/persist blocks between steps,
+    pause       — eval/persist blocks between steps,
+    save        — checkpoint fetch + write blocking the loop (ISSUE 11:
+                  split from ``pause`` so the async-save reclaim is a
+                  first-class number),
     other       — everything else (host-side Python, logging).
 
-The four fields land in the existing ``train`` JSONL records next to
+The five fields land in the existing ``train`` JSONL records next to
 ``images_per_sec_window`` and MUST sum to ``window_sec`` (the segments
 are disjoint sub-intervals of one monotonic window, so ``other`` is the
 exact remainder — pinned by tests/test_obs.py). A window dominated by
@@ -109,7 +112,7 @@ class StallClock:
     (per-step causality, ISSUE 4).
     """
 
-    KINDS = ("input", "dispatch", "pause")
+    KINDS = ("input", "dispatch", "pause", "save")
 
     def __init__(self, registry: "registry_lib.Registry | None" = None,
                  tracer: "trace_lib.Tracer | None" = None):
@@ -120,8 +123,8 @@ class StallClock:
                 k: registry.histogram(
                     f"trainer.{k}_s",
                     help="per-segment stall attribution of the train "
-                         "loop (input/dispatch/pause), cross-window "
-                         "quantiles",
+                         "loop (input/dispatch/pause/save), cross-"
+                         "window quantiles",
                 ) for k in self.KINDS
             }
         self._tracer = (
@@ -168,6 +171,11 @@ class StallClock:
             "input_wait_sec": round(self._acc["input"], 4),
             "dispatch_sec": round(self._acc["dispatch"], 4),
             "pause_sec": round(self._acc["pause"], 4),
+            # Checkpoint-save stall (ISSUE 11): the slice of 'pause' that
+            # is checkpoint I/O, split out so the async-save win — and
+            # any regression — is attributable. train.async_save drives
+            # this toward 0 (the fetch+write runs off-loop).
+            "save_sec": round(self._acc["save"], 4),
             "other_sec": round(other, 4),
         }
         self._window_start = now

@@ -87,23 +87,17 @@ def fused_color_jitter(
     return jnp.transpose(out[:, :, :P].reshape(B, 3, H, W), (0, 2, 3, 1))
 
 
-def color_affine_from_params(
-    means: jnp.ndarray,  # [B, 3] per-image channel means of (x/127.5 - 1)
-    brightness: jnp.ndarray,  # [B]
-    contrast: jnp.ndarray,  # [B]
+def chroma_matrix(
     saturation: jnp.ndarray,  # [B]
     hue_theta: jnp.ndarray,  # [B] radians
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Collapse the augment params into (A [B,3,3], o [B,3]).
-
-    Matches data/augment.py exactly: v = contrast*(t - mean) + mean +
-    brightness, then YIQ chroma rotation M @ v. (The jnp path computes
-    the contrast mean *after* brightness, but the mean of t + b is
-    mean(t) + b, so the algebra is identical.)
-    """
+) -> jnp.ndarray:
+    """[B, 3, 3] YIQ chroma rotation/scaling in RGB space — the
+    mean-independent half of the collapsed color affine, shared by
+    ``color_affine_from_params`` and the fused kernel (whose offsets
+    need the in-kernel means)."""
     from jama16_retina_tpu.data.augment import _RGB2YIQ, _YIQ2RGB
 
-    B = means.shape[0]
+    B = saturation.shape[0]
     cos = jnp.cos(hue_theta) * saturation
     sin = jnp.sin(hue_theta) * saturation
     zeros = jnp.zeros((B,))
@@ -124,13 +118,133 @@ def color_affine_from_params(
     # two paths bit-compatible.
     eye = jnp.eye(3, dtype=rot.dtype)
     hp = jax.lax.Precision.HIGHEST
-    m_chroma = eye + jnp.einsum(
+    return eye + jnp.einsum(
         "ij,bjk,kl->bil", _YIQ2RGB, rot - eye, _RGB2YIQ, precision=hp
     )
+
+
+def color_affine_from_params(
+    means: jnp.ndarray,  # [B, 3] per-image channel means of (x/127.5 - 1)
+    brightness: jnp.ndarray,  # [B]
+    contrast: jnp.ndarray,  # [B]
+    saturation: jnp.ndarray,  # [B]
+    hue_theta: jnp.ndarray,  # [B] radians
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Collapse the augment params into (A [B,3,3], o [B,3]).
+
+    Matches data/augment.py exactly: v = contrast*(t - mean) + mean +
+    brightness, then YIQ chroma rotation M @ v. (The jnp path computes
+    the contrast mean *after* brightness, but the mean of t + b is
+    mean(t) + b, so the algebra is identical.)
+    """
+    m_chroma = chroma_matrix(saturation, hue_theta)
+    hp = jax.lax.Precision.HIGHEST
     affine = contrast[:, None, None] * m_chroma
     o_pre = means * (1.0 - contrast[:, None]) + brightness[:, None]
     offset = jnp.einsum("bij,bj->bi", m_chroma, o_pre, precision=hp)
     return affine, offset
+
+
+def _fused_kernel(m_ref, cb_ref, x_ref, out_ref, acc_ref, *, n_pixels):
+    """Two-phase body of ``fused_normalize_color_jitter``: phase 0
+    accumulates the raw uint8 channel sums of image ``b`` into VMEM
+    scratch (zero padding contributes zero, so the true-pixel count
+    ``n_pixels`` divides out exactly); phase 1 derives the per-image
+    mean + affine from the scratch and streams the normalized, jittered
+    pixels out. The grid is sequential on TPU (and in interpret mode),
+    so phase 0 of an image always completes before its phase 1 reads
+    the accumulator."""
+    phase = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(phase == 0)
+    def _accumulate():
+        @pl.when(j == 0)
+        def _reset():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        raw = x_ref[0].astype(jnp.int32).astype(jnp.float32)  # [3, CHUNK]
+        acc_ref[...] += jnp.sum(raw, axis=1, keepdims=True)
+
+    @pl.when(phase == 1)
+    def _apply():
+        # channel_means_u8 semantics: mean of (x/127.5 - 1) over the
+        # TRUE pixels = sum(u8)/(P*127.5) - 1.
+        mean = acc_ref[...] * (1.0 / (n_pixels * 127.5)) - 1.0  # [3, 1]
+        m = m_ref[0]  # [3, 3] chroma matrix
+        c = cb_ref[0, 0, 0]  # contrast
+        o_pre_r = mean[0, 0] * (1.0 - c) + cb_ref[0, 1, 0]
+        o_pre_g = mean[1, 0] * (1.0 - c) + cb_ref[0, 1, 0]
+        o_pre_b = mean[2, 0] * (1.0 - c) + cb_ref[0, 1, 0]
+        x = x_ref[0].astype(jnp.int32).astype(jnp.float32)
+        x = x * (1.0 / 127.5) - 1.0
+        r, g, b = x[0], x[1], x[2]
+        rows = []
+        for ci in range(3):
+            off = (
+                m[ci, 0] * o_pre_r + m[ci, 1] * o_pre_g
+                + m[ci, 2] * o_pre_b
+            )
+            rows.append(
+                jnp.clip(
+                    c * (m[ci, 0] * r + m[ci, 1] * g + m[ci, 2] * b)
+                    + off,
+                    -1.0,
+                    1.0,
+                )
+            )
+        out_ref[0] = jnp.stack(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_normalize_color_jitter(
+    images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
+    m_chroma: jnp.ndarray,  # [B, 3, 3] f32 — chroma_matrix output
+    contrast: jnp.ndarray,  # [B] f32
+    brightness: jnp.ndarray,  # [B] f32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """ISSUE 11: normalize + color jitter with the per-image contrast
+    means computed IN-KERNEL — the separate ``channel_means_u8`` XLA
+    reduce pass over the uint8 batch disappears, leaving one fused
+    Mosaic program per batch (``train.use_pallas_fused``).
+
+    Same math as ``channel_means_u8`` + ``color_affine_from_params`` +
+    ``fused_color_jitter`` (the affine is expanded in-kernel from the
+    chroma matrix, contrast, brightness, and the accumulated mean);
+    parity with the jnp composition is pinned to float tolerance in
+    tests/test_mixedprec.py. Returns [B, H, W, 3] float32 in [-1, 1].
+    """
+    B, H, W, _ = images_u8.shape
+    P = H * W
+    P_pad = -(-P // _CHUNK) * _CHUNK
+    x = jnp.transpose(images_u8, (0, 3, 1, 2)).reshape(B, 3, P)
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, P_pad - P)))
+    cb = jnp.stack(
+        [contrast.astype(jnp.float32), brightness.astype(jnp.float32)],
+        axis=1,
+    )[..., None]  # [B, 2, 1]
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    out = pl.pallas_call(
+        functools.partial(_fused_kernel, n_pixels=P),
+        out_shape=jax.ShapeDtypeStruct((B, 3, P_pad), jnp.float32),
+        grid=(B, 2, P_pad // _CHUNK),
+        in_specs=[
+            pl.BlockSpec((1, 3, 3), lambda b, ph, j: (b, 0, 0)),
+            pl.BlockSpec((1, 2, 1), lambda b, ph, j: (b, 0, 0)),
+            pl.BlockSpec((1, 3, _CHUNK), lambda b, ph, j: (b, 0, j)),
+        ],
+        # Phase 0 parks the (unwritten) out block on chunk 0; the block
+        # index only changes — and the buffer only writes back — after
+        # phase 1 has filled it.
+        out_specs=pl.BlockSpec((1, 3, _CHUNK), lambda b, ph, j: (b, 0, j * ph)),
+        scratch_shapes=[pltpu.VMEM((3, 1), jnp.float32)],
+        interpret=interpret,
+    )(m_chroma, cb, x)
+
+    return jnp.transpose(out[:, :, :P].reshape(B, 3, H, W), (0, 2, 3, 1))
 
 
 def channel_means_u8(images_u8: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,153 @@
+"""Fused Pallas optimizer update (ISSUE 11: the raw-speed train step).
+
+The optax adamw chain (``scale_by_adam`` -> ``add_decayed_weights`` ->
+``scale_by_learning_rate``) walks the parameter tree three times and
+materializes an intermediate update tree between stages — on an
+HBM-bound step that is several extra passes over params-sized arrays.
+This kernel does the WHOLE update in one pass per leaf: ``(param, grad,
+mu, nu)`` stream through VMEM once and ``(param', mu', nu')`` stream
+out, with the Adam moment math, bias correction, decoupled weight
+decay, and learning-rate scale applied element-wise on the VPU.
+
+Contract: byte-compatible with ``optax.adamw(make_schedule(tc),
+weight_decay=tc.weight_decay, mask=_decay_mask)`` — the SAME opt_state
+pytree structure (``ScaleByAdamState``, ``MaskedState(EmptyState)``,
+``ScaleByScheduleState``) goes in and comes out, so checkpoints, resume,
+and donation never see which path computed the update. Numerics are
+pinned against the optax reference in tests/test_mixedprec.py
+(element-wise math in the same order; float-ulp tolerance).
+
+Gated by ``train.use_pallas_fused`` (train_lib.validate_train_knobs
+restricts it to unclipped adamw); transparently interprets off-TPU like
+the augment kernel, so fused configs run anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+
+from jama16_retina_tpu.configs import TrainConfig
+
+_LANE = 128
+_BLOCK_ROWS = 256  # 256x128 f32 = 128 KiB/buffer; 8 buffers ~ 1 MiB VMEM
+
+# optax.adamw defaults — make_optimizer passes only (schedule,
+# weight_decay, mask), so these are the values the optax path runs.
+_B1, _B2, _EPS = 0.9, 0.999, 1e-8
+
+
+def _adamw_kernel(sc_ref, p_ref, g_ref, mu_ref, nu_ref,
+                  out_p, out_mu, out_nu, *, wd: float):
+    """One block of the fused update. ``sc_ref`` carries the traced
+    scalars: [lr, 1/(1-b1^t), 1/(1-b2^t)]; ``wd`` is the leaf's
+    effective decoupled weight decay (0.0 for mask-excluded leaves —
+    train_lib._decay_mask's rank<2 set), baked statically."""
+    lr = sc_ref[0, 0]
+    c1 = sc_ref[0, 1]
+    c2 = sc_ref[0, 2]
+    g = g_ref[...]
+    mu = _B1 * mu_ref[...] + (1.0 - _B1) * g
+    nu = _B2 * nu_ref[...] + (1.0 - _B2) * g * g
+    update = (mu * c1) / (jnp.sqrt(nu * c2) + _EPS)
+    p = p_ref[...]
+    if wd:
+        update = update + wd * p
+    out_p[...] = p - lr * update
+    out_mu[...] = mu
+    out_nu[...] = nu
+
+
+def _leaf_update(p, g, mu, nu, scalars, wd: float, interpret: bool):
+    """Fused update of one leaf: flatten -> lane-tile pad -> one grid
+    pass -> unpad. Zero padding is self-consistent (0 grads keep 0
+    moments and 0 params at 0: sqrt(0)+eps never divides by zero)."""
+    shape, n = p.shape, p.size
+    rows = -(-n // _LANE)
+    block_rows = min(_BLOCK_ROWS, rows)
+    rows_pad = -(-rows // block_rows) * block_rows
+
+    def prep(x):
+        flat = x.reshape(-1).astype(jnp.float32)
+        flat = jnp.pad(flat, (0, rows_pad * _LANE - n))
+        return flat.reshape(rows_pad, _LANE)
+
+    grid = (rows_pad // block_rows,)
+    out_p, out_mu, out_nu = pl.pallas_call(
+        functools.partial(_adamw_kernel, wd=wd),
+        out_shape=[
+            jax.ShapeDtypeStruct((rows_pad, _LANE), jnp.float32)
+        ] * 3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 3), lambda i: (0, 0)),
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0))
+        ] * 3,
+        interpret=interpret,
+    )(scalars, prep(p), prep(g), prep(mu), prep(nu))
+
+    def unpad(x):
+        return x.reshape(-1)[:n].reshape(shape)
+
+    return unpad(out_p), unpad(out_mu), unpad(out_nu)
+
+
+def fused_adamw_update(tc: TrainConfig, params, grads, opt_state):
+    """The ``train.use_pallas_fused`` twin of ``tx.update`` +
+    ``optax.apply_updates`` for the adamw chain: returns ``(new_params,
+    new_opt_state)`` with the optax state structure preserved exactly.
+
+    Traced scalars (schedule LR at the schedule's own count, Adam bias
+    corrections at count+1) are computed once in XLA and ride into the
+    kernel as a 3-vector; everything params-shaped runs in the fused
+    pass."""
+    from jama16_retina_tpu import train_lib
+
+    adam, masked, sched_state = opt_state
+    count_inc = optax.safe_int32_increment(adam.count)
+    t = count_inc.astype(jnp.float32)
+    c1 = 1.0 / (1.0 - _B1 ** t)
+    c2 = 1.0 / (1.0 - _B2 ** t)
+    # scale_by_learning_rate reads the schedule at ITS pre-increment
+    # count (optax.scale_by_schedule semantics).
+    lr = train_lib.make_schedule(tc)(sched_state.count)
+    scalars = jnp.stack(
+        [jnp.asarray(lr, jnp.float32), c1, c2]
+    ).reshape(1, 3)
+
+    mask = train_lib._decay_mask(params)
+    interpret = jax.default_backend() != "tpu"
+    wd = float(tc.weight_decay)
+
+    out = jax.tree.map(
+        lambda p, g, m, v, decayed: _leaf_update(
+            p, g, m, v, scalars, wd if decayed else 0.0, interpret
+        ),
+        params, grads, adam.mu, adam.nu, mask,
+    )
+
+    def pick(i):
+        return jax.tree.map(
+            lambda t3: t3[i], out,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+
+    new_params, new_mu, new_nu = pick(0), pick(1), pick(2)
+    new_state = (
+        optax.ScaleByAdamState(count=count_inc, mu=new_mu, nu=new_nu),
+        masked,
+        optax.ScaleByScheduleState(
+            count=optax.safe_int32_increment(sched_state.count)
+        ),
+    )
+    return new_params, new_state

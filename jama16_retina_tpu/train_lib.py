@@ -86,6 +86,15 @@ from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.parallel import mesh as mesh_lib
 
 
+class DtypeCurveRejected(RuntimeError):
+    """A non-fp32 training run drifted beyond ``train.dtype_curve_tol``
+    of the pinned fp32 golden curve (``train.dtype_curve_ref``) — the
+    train-side mirror of serve/quantize's DtypeRejected (PR 10): a
+    cheaper numerics mode must PROVE quality parity or be refused, never
+    silently shipped. Raised from the eval block of the flax train
+    loops; the run stops with the violating step and both AUCs named."""
+
+
 class TrainState(flax.struct.PyTreeNode):
     step: jnp.ndarray
     params: Any
@@ -180,6 +189,62 @@ def create_state(
     return state, tx
 
 
+def _bf16_params(params):
+    """bfloat16 CAST of the float32 master weights — the mixed-precision
+    forward/backward view (train.dtype=bf16). Only inexact leaves cast;
+    the master tree is untouched (the optimizer keeps updating it in
+    float32). Loss-scale-free: bf16 keeps float32's exponent range, so
+    gradients cannot under/overflow the way fp16 ones do."""
+    return jax.tree.map(
+        lambda p: (
+            p.astype(jnp.bfloat16)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p
+        ),
+        params,
+    )
+
+
+def _f32_grads(grads):
+    """Gradients back to float32 before the optimizer — the other half
+    of the master-weight discipline (a bf16 Adam moment would quantize
+    the update direction every step)."""
+    return jax.tree.map(
+        lambda g: (
+            g.astype(jnp.float32)
+            if jnp.issubdtype(g.dtype, jnp.floating) else g
+        ),
+        grads,
+    )
+
+
+def validate_train_knobs(tc: TrainConfig) -> None:
+    """Loud validation of the raw-speed knobs (ISSUE 11) shared by every
+    step factory — a typo'd dtype or an unsupported fused-optimizer
+    combination must refuse at construction, not mistrain silently."""
+    if tc.dtype not in ("fp32", "bf16"):
+        raise ValueError(
+            f"unknown train.dtype {tc.dtype!r} (want fp32|bf16)"
+        )
+    if tc.accum_steps < 1:
+        raise ValueError(
+            f"train.accum_steps={tc.accum_steps} must be >= 1"
+        )
+    if tc.use_pallas_fused:
+        if tc.optimizer != "adamw":
+            raise ValueError(
+                "train.use_pallas_fused implements the fused optimizer "
+                f"update for adamw only (got {tc.optimizer!r}); unset "
+                "the flag or switch optimizers"
+            )
+        if tc.gradient_clip_norm > 0:
+            raise ValueError(
+                "train.use_pallas_fused cannot compose with "
+                "train.gradient_clip_norm (the fused kernel replaces "
+                "the whole optax chain; the clip transform would be "
+                "silently dropped) — disable one of the two"
+            )
+
+
 def _labels_from_grades(grades: jnp.ndarray, head: str) -> jnp.ndarray:
     if head == "binary":
         # ICDR grade >= 2 -> referable DR (reference R3 binning).
@@ -236,6 +301,14 @@ def loss_fn(params, batch_stats, model, images, grades, dropout_rng,
     else:
         logits, aux = model.apply(variables, images, train=False)
         new_stats = batch_stats
+    if cfg.train.dtype == "bf16":
+        # Mixed precision stops at the head: the loss reduction runs in
+        # float32 (bf16's 8-bit mantissa is too coarse for log-prob
+        # sums). A no-op on the fp32 path, where the heads already emit
+        # float32 — so the existing bit-identity pins never see it.
+        logits = logits.astype(jnp.float32)
+        if aux is not None:
+            aux = aux.astype(jnp.float32)
     if soft is not None:
         # Distillation (train.distill_from): the student's target is the
         # teacher's soft score, hard grades untouched (they still ride
@@ -291,7 +364,8 @@ def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
         key = jax.random.fold_in(key, augment_key_extra)
     aug_key, dropout_key = jax.random.split(key)
     images = augment_lib.augment_batch(
-        aug_key, batch["image"], cfg.data, debug=debug
+        aug_key, batch["image"], cfg.data, debug=debug,
+        fused=cfg.train.use_pallas_fused,
     )
     if debug:
         import chex
@@ -315,18 +389,91 @@ def _step_impl(state: TrainState, batch: dict, base_key: jax.Array,
             return jax.lax.pmean(loss, loss_axis), aux
 
     grad_fn = jax.value_and_grad(fn, has_aux=True)
-    (loss, (logits, new_stats)), grads = grad_fn(
-        state.params, state.batch_stats, model, images, batch["grade"],
-        dropout_key, cfg, True, soft,
+    # Mixed precision (train.dtype=bf16; ISSUE 11): forward/backward
+    # differentiate a bf16 CAST of the params; the float32 masters in
+    # ``state`` are what the optimizer updates. fp32 leaves the tree
+    # untouched, so the existing golden pins ride the identical program.
+    params = (
+        _bf16_params(state.params) if cfg.train.dtype == "bf16"
+        else state.params
     )
-    return loss, logits, new_stats, grads
+    accum = cfg.train.accum_steps
+    if accum <= 1:
+        (loss, (logits, new_stats)), grads = grad_fn(
+            params, state.batch_stats, model, images, batch["grade"],
+            dropout_key, cfg, True, soft,
+        )
+        return loss.astype(jnp.float32), logits, new_stats, _f32_grads(grads)
+
+    # Gradient accumulation (train.accum_steps): the RECIPE batch was
+    # augmented above in one draw (identical pixels to accum=1); it now
+    # splits into ``accum`` sequential micro-batches inside this same
+    # program — per-forward activation memory drops by accum× while the
+    # optimizer still sees one recipe-batch update. Grads accumulate in
+    # float32 regardless of train.dtype (master-weight discipline);
+    # BatchNorm normalizes by micro-batch moments (ghost batch norm)
+    # and its running stats thread through the scan in micro order.
+    n = images.shape[0]
+    if n % accum != 0:
+        raise ValueError(
+            f"train.accum_steps={accum} must divide the batch size "
+            f"{n} evenly"
+        )
+    micro = n // accum
+
+    def _split(x):
+        return x.reshape((accum, micro) + x.shape[1:])
+
+    xs = (
+        _split(images),
+        _split(batch["grade"]),
+        None if soft is None else _split(soft),
+        jax.random.split(dropout_key, accum),
+    )
+    zero_grads = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+    def body(carry, x):
+        stats, acc = carry
+        imgs_m, grades_m, soft_m, dk = x
+        (l, (_, new_st)), g = grad_fn(
+            params, stats, model, imgs_m, grades_m, dk, cfg, True, soft_m,
+        )
+        acc = jax.tree.map(
+            lambda a, gi: a + gi.astype(jnp.float32) * (1.0 / accum),
+            acc, g,
+        )
+        return (new_st, acc), l.astype(jnp.float32)
+
+    (new_stats, grads), losses = jax.lax.scan(
+        body, (state.batch_stats, zero_grads), xs
+    )
+    # Equal-size micros: the mean of micro-mean losses IS the recipe-
+    # batch mean loss, and the accumulated grads are its gradient —
+    # pinned N×micro ≡ 1×full-batch in tests/test_mixedprec.py.
+    return losses.mean(), None, new_stats, grads
 
 
 def _apply_update(
-    state: TrainState, grads, new_stats, tx, ema_decay: float = 0.0
+    state: TrainState, grads, new_stats, tx, tc: TrainConfig
 ) -> TrainState:
-    updates, new_opt = tx.update(grads, state.opt_state, state.params)
-    new_params = optax.apply_updates(state.params, updates)
+    ema_decay = tc.ema_decay
+    if tc.use_pallas_fused:
+        # Fused optimizer update (ISSUE 11; ops/pallas_opt.py): one
+        # kernel pass per leaf over (param, grad, mu, nu) replaces the
+        # optax tree-map chain. Same math, same opt_state structure —
+        # checkpoints and resume are oblivious (pinned vs optax in
+        # tests/test_mixedprec.py). validate_train_knobs already
+        # restricted this path to unclipped adamw.
+        from jama16_retina_tpu.ops import pallas_opt
+
+        new_params, new_opt = pallas_opt.fused_adamw_update(
+            tc, state.params, grads, state.opt_state
+        )
+    else:
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
     ema = state.ema_params
     if ema is not None and ema_decay > 0:
         ema = jax.tree.map(
@@ -356,18 +503,26 @@ def _pallas_safe_cfg(cfg: ExperimentConfig, mesh, context: str):
     and XLA fuses and partitions the jnp form freely. Single-device
     programs (every bench/artifact on this one-chip host) keep the
     kernel. Logged so a multi-chip run's ~2% end-to-end delta is
-    traceable to this routing."""
-    if not (cfg.data.use_pallas and _mesh_devices(mesh) > 1):
+    traceable to this routing.
+
+    ``train.use_pallas_fused`` (ISSUE 11) routes off under exactly the
+    same condition — the fused normalize+augment and fused optimizer
+    kernels are Mosaic programs too."""
+    pallas_on = cfg.data.use_pallas or cfg.train.use_pallas_fused
+    if not (pallas_on and _mesh_devices(mesh) > 1):
         return cfg
     import dataclasses
 
     absl_logging.info(
-        "%s: use_pallas routed to the jnp composition on a %d-device "
-        "mesh (Mosaic kernels cannot be auto-partitioned)",
+        "%s: use_pallas/use_pallas_fused routed to the jnp/optax "
+        "compositions on a %d-device mesh (Mosaic kernels cannot be "
+        "auto-partitioned)",
         context, _mesh_devices(mesh),
     )
     return dataclasses.replace(
-        cfg, data=dataclasses.replace(cfg.data, use_pallas=False)
+        cfg,
+        data=dataclasses.replace(cfg.data, use_pallas=False),
+        train=dataclasses.replace(cfg.train, use_pallas_fused=False),
     )
 
 
@@ -383,6 +538,7 @@ def make_train_step(
     under jax_debug_nans, whose op-by-op re-execution needs the inputs
     to still be alive.
     """
+    validate_train_knobs(cfg.train)
     cfg = _pallas_safe_cfg(cfg, mesh, "train step")
 
     def step(state: TrainState, batch: dict, base_key: jax.Array):
@@ -390,7 +546,7 @@ def make_train_step(
             state, batch, base_key, model, cfg
         )
         new_state = _apply_update(
-            state, grads, new_stats, tx, cfg.train.ema_decay
+            state, grads, new_stats, tx, cfg.train
         )
         return new_state, {"loss": loss}
 
@@ -448,6 +604,7 @@ def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):
     moments psum over replicas (N8). Used by tests to pin the jit path's
     semantics; state is replicated per-device, batch is [n_dev, B/n_dev, ...].
     """
+    validate_train_knobs(cfg.train)
 
     def step(state: TrainState, batch: dict, base_key: jax.Array):
         loss, logits, new_stats, grads = _step_impl(
@@ -457,7 +614,7 @@ def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):
         grads = jax.lax.pmean(grads, axis)
         loss = jax.lax.pmean(loss, axis)
         new_state = _apply_update(
-            state, grads, new_stats, tx, cfg.train.ema_decay
+            state, grads, new_stats, tx, cfg.train
         )
         return new_state, {"loss": loss}
 
@@ -654,6 +811,17 @@ def make_ensemble_train_step(
     same distribution, different stream (both are valid training
     randomness; parity tests compare with augmentation off).
     """
+    validate_train_knobs(cfg.train)
+    if cfg.train.use_pallas_fused:
+        # The stacked-member vmap would have to batch every Mosaic
+        # kernel launch (vmap-of-pallas_call); the fused path is a
+        # single-model step optimization — refuse rather than ship an
+        # untested lowering.
+        raise ValueError(
+            "train.use_pallas_fused is a single-model step path; the "
+            "member-parallel ensemble step vmaps the whole step and "
+            "cannot batch the Mosaic kernels — unset one of the two"
+        )
     cfg = _pallas_safe_cfg(cfg, mesh, "ensemble train step")
     if manual_data:
         if mesh is None or "data" not in mesh.axis_names:
@@ -680,7 +848,7 @@ def make_ensemble_train_step(
         def one(st, bk):
             loss, _, new_stats, grads = _step_impl(st, batch, bk, model, cfg)
             return (
-                _apply_update(st, grads, new_stats, tx, cfg.train.ema_decay),
+                _apply_update(st, grads, new_stats, tx, cfg.train),
                 loss,
             )
 
@@ -709,7 +877,7 @@ def make_ensemble_train_step(
                 )
                 return (
                     _apply_update(
-                        st, grads, new_stats, tx, cfg.train.ema_decay
+                        st, grads, new_stats, tx, cfg.train
                     ),
                     loss,
                 )

@@ -885,6 +885,8 @@ def _eval_and_track(
     predict_fn, state_for_save,
     best_auc: float, best_step: int, since_best: int,
     save_due: bool = True,
+    save_fn=None,
+    curve_gate: "_DtypeCurveGate | None" = None,
 ) -> tuple[float, int, int, bool, bool]:
     """The per-eval-interval block shared by every backend's train loop:
     val predict -> referable-DR AUC (the 5-class head collapses to
@@ -899,7 +901,15 @@ def _eval_and_track(
     save; a stopping eval ALWAYS saves so the run ends durable. The
     eval record is logged BEFORE the save so time-to-target artifacts
     timestamp the moment the AUC was known, not the fetch behind it.
-    Returns (..., stop, saved)."""
+    Returns (..., stop, saved).
+
+    ``save_fn(step, auc)`` (ISSUE 11) overrides the default
+    ``ckpt.save(step, state_for_save(), ...)`` — the flax loops route
+    saves through it for async/stall-attributed checkpointing.
+    ``curve_gate`` is the train.dtype golden-curve parity gate, checked
+    AFTER the eval record lands (the refusing trajectory stays visible
+    in the JSONL) and BEFORE any save (a drifted state must not become
+    a resume point)."""
     grades, probs = predict_fn()
     bin_probs = (
         probs if cfg.model.head == "binary"
@@ -915,10 +925,15 @@ def _eval_and_track(
     # resumed run's best tracking). best_auc is display-only.
     log.write("eval", step=step, val_auc=float(auc),
               best_auc=round(best_auc, 5), since_best=since_best)
+    if curve_gate is not None:
+        curve_gate.check(step, float(auc))
     stop = since_best >= cfg.train.early_stop_patience
     saved = save_due or stop
     if saved:
-        ckpt.save(step, state_for_save(), {"val_auc": auc})
+        if save_fn is not None:
+            save_fn(step, float(auc))
+        else:
+            ckpt.save(step, state_for_save(), {"val_auc": auc})
     if stop:
         log.write("early_stop", step=step, best_step=best_step)
     return best_auc, best_step, since_best, stop, saved
@@ -958,6 +973,133 @@ def _preempt_save(log: RunLog, step: int, save_fn,
             "fall back to the last eval-time checkpoint",
             step, type(e).__name__, e,
         )
+
+
+def _state_snapshot(state):
+    """On-device copy of the train state — one fast HBM pass, no host
+    round-trip — so a background eval/save (train.eval_overlap /
+    train.async_save) never reads buffers the next DONATING train step
+    is about to consume. ``x + 0`` forces a fresh output buffer (a jit
+    identity would alias the input). Costs one transient extra state
+    residency, the same class of documented trade as serve's rollback
+    retention. Module-level jit: one trace per state structure, cached
+    across every boundary of the run."""
+    return _SNAPSHOT_JIT(state)
+
+
+_SNAPSHOT_JIT = jax.jit(lambda s: jax.tree.map(lambda x: x + 0, s))
+
+
+def _async_knobs_guard(cfg: ExperimentConfig) -> None:
+    """train.async_save / train.eval_overlap are single-process
+    features: their work runs on background threads, and a multi-host
+    state gather is a COLLECTIVE — all hosts must enter it together,
+    which unsynchronized per-host threads cannot guarantee. Refuse
+    loudly rather than deadlock the pod."""
+    if (cfg.train.async_save or cfg.train.eval_overlap) \
+            and jax.process_count() > 1:
+        raise ValueError(
+            "train.async_save/train.eval_overlap run their state "
+            "gathers on background threads and cannot participate in "
+            "multi-host collectives — unset them on multi-process runs"
+        )
+
+
+class _BgJob:
+    """One background eval/save job (train.eval_overlap): runs ``fn`` on
+    a daemon thread; ``result()`` joins and re-raises the job's
+    exception in the caller — so a DtypeCurveRejected (or any eval
+    failure) from the overlapped block still stops the run loudly, at
+    the next collect point instead of mid-boundary."""
+
+    def __init__(self, fn):
+        import threading
+
+        self._fn = fn
+        self._result = None
+        self._err: "BaseException | None" = None
+        self._t = threading.Thread(
+            target=self._run, daemon=True, name="eval-overlap"
+        )
+        self._t.start()
+
+    def _run(self) -> None:
+        try:
+            self._result = self._fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised in result()
+            self._err = e
+
+    def done(self) -> bool:
+        return not self._t.is_alive()
+
+    def result(self):
+        self._t.join()
+        if self._err is not None:
+            raise self._err
+        return self._result
+
+
+class _DtypeCurveGate:
+    """The train-side golden-curve parity gate (ISSUE 11), mirroring
+    serve/quantize's canary gate: a non-fp32 run must track the pinned
+    fp32 eval-AUC trajectory (``train.dtype_curve_ref`` — a metrics
+    JSONL from an fp32 run of the same config/seed) within
+    ``train.dtype_curve_tol`` at every matching step, or the run is
+    REFUSED (train_lib.DtypeCurveRejected), not silently shipped.
+    fp32 runs and ref-less non-fp32 runs (logged as ungated) no-op."""
+
+    def __init__(self, cfg: ExperimentConfig):
+        self._ref: "dict | None" = None
+        self._tol = cfg.train.dtype_curve_tol
+        self._dtype = cfg.train.dtype
+        if cfg.train.dtype == "fp32":
+            return
+        path = cfg.train.dtype_curve_ref
+        if not path:
+            absl_logging.warning(
+                "train.dtype=%s runs UNGATED: no train.dtype_curve_ref "
+                "golden curve is pinned — eval-AUC parity with fp32 is "
+                "not being checked", cfg.train.dtype,
+            )
+            return
+        from jama16_retina_tpu.utils.logging import read_jsonl
+
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"train.dtype_curve_ref {path!r} does not exist — pin "
+                "an fp32 run's metrics.jsonl (or unset the knob to run "
+                "ungated)"
+            )
+        ref: dict = {}
+        for r in read_jsonl(path):
+            if r.get("kind") != "eval" or r.get("step") is None:
+                continue
+            auc = r.get("ensemble_val_auc", r.get("val_auc"))
+            if auc is not None and int(r["step"]) not in ref:
+                ref[int(r["step"])] = float(auc)
+        if not ref:
+            raise ValueError(
+                f"train.dtype_curve_ref {path!r} holds no eval records "
+                "— point it at the fp32 run's metrics.jsonl"
+            )
+        self._ref = ref
+
+    def check(self, step: int, auc: float) -> None:
+        if self._ref is None:
+            return
+        ref = self._ref.get(int(step))
+        if ref is None:
+            return
+        if abs(float(auc) - ref) > self._tol:
+            raise train_lib.DtypeCurveRejected(
+                f"train.dtype={self._dtype} drifted from the pinned "
+                f"fp32 golden curve at step {step}: val AUC "
+                f"{float(auc):.5f} vs pinned {ref:.5f} "
+                f"(|Δ|={abs(float(auc) - ref):.5f} > "
+                f"train.dtype_curve_tol={self._tol}) — the cheap "
+                "numerics mode is refused; retrain in fp32 or widen "
+                "the tolerance deliberately"
+            )
 
 
 def _run_meta_path(workdir: str) -> str:
@@ -1087,6 +1229,20 @@ def fit(
     ckpt = ckpt_lib.Checkpointer(
         os.path.abspath(workdir), max_to_keep=cfg.train.max_to_keep
     )
+    # Raw-speed training (ISSUE 11): async checkpoint worker, eval
+    # overlap, and the train.dtype golden-curve parity gate. Overlap
+    # implies the async worker: orbax pins all of a manager's saves to
+    # ONE thread (its finalize-thread reset is save-thread-affine), and
+    # per-eval _BgJob threads would violate that — the single AsyncSaver
+    # worker is the one save thread either way.
+    _async_knobs_guard(cfg)
+    curve_gate = _DtypeCurveGate(cfg)
+    overlap = cfg.train.eval_overlap
+    saver = (
+        ckpt_lib.AsyncSaver()
+        if (cfg.train.async_save or overlap) else None
+    )
+    eval_job: "_BgJob | None" = None
 
     start_step = 0
     best_auc, best_step, since_best = -np.inf, 0, 0
@@ -1162,6 +1318,90 @@ def fit(
     clock = _ThroughputClock(cfg.data.batch_size)
     last_step = start_step
     _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
+
+    save_stall = [0.0]
+    # Preemption latch (review fix): the SIGTERM path must not spend
+    # its grace window joining an in-flight overlapped EVAL — it only
+    # needs the already-queued SAVES settled. Once set, a still-running
+    # _BgJob skips its own save; the emergency latest/-only save then
+    # rides the same worker queue behind anything already submitted.
+    preempted = {"flag": False}
+
+    def _save_fn(step_now: int, auc: float) -> None:
+        """The eval-time save, stall-attributed (the new 'save'
+        segment). Sync: the device->host fetch + orbax write block here
+        (old behavior, now measured). Async (train.async_save): an
+        on-device snapshot + queue put is the whole stall — the fetch
+        and write run on the AsyncSaver worker."""
+        t0 = time.perf_counter()
+        if saver is not None:
+            snap_state = _state_snapshot(state)
+
+            def _do(snap_state=snap_state, step_now=step_now, auc=auc):
+                ckpt.save(step_now, jax.device_get(snap_state),
+                          {"val_auc": auc})
+                _persist_grain_state(grain_tee, workdir, step_now,
+                                     kept_steps=ckpt.all_steps())
+
+            saver.submit(_do)
+        else:
+            ckpt.save(step_now, jax.device_get(state), {"val_auc": auc})
+            _persist_grain_state(grain_tee, workdir, step_now,
+                                 kept_steps=ckpt.all_steps())
+        dt = time.perf_counter() - t0
+        stalls.add("save", dt)
+        save_stall[0] += dt
+
+    def _submit_eval(step_now: int) -> _BgJob:
+        """Dispatch the whole eval block (val predict -> AUC -> gate ->
+        best tracking -> save) over an on-device snapshot on a
+        background thread (train.eval_overlap); training continues
+        through what used to be the eval pause."""
+        snap_state = _state_snapshot(state)
+        ba, bs, sb = best_auc, best_step, since_best
+
+        def _overlap_save(step_now: int, auc: float,
+                          snap_state=snap_state) -> None:
+            if preempted["flag"]:
+                # The emergency latest/ save owns the exit path; a
+                # boundary save racing it could leave latest/ on an
+                # older step.
+                return
+
+            # The job's snapshot IS the save source — never touch the
+            # live (donated) state from this thread.
+            def _do():
+                # Re-checked on the WORKER too: the eval thread can pass
+                # the check above just before the latch sets, but the
+                # flag is always set before the emergency job enqueues —
+                # so by the time a late boundary save reaches the worker
+                # it sees the latch and cannot roll latest/ back.
+                if preempted["flag"]:
+                    return
+                ckpt.save(step_now, jax.device_get(snap_state),
+                          {"val_auc": auc})
+                _persist_grain_state(grain_tee, workdir, step_now,
+                                     kept_steps=ckpt.all_steps())
+
+            if saver is not None:
+                saver.submit(_do)
+            else:
+                _do()
+
+        def job():
+            return _eval_and_track(
+                cfg, log, ckpt, step_now,
+                lambda: predict_split(
+                    cfg, model, snap_state, data_dir, "val", mesh,
+                    eval_step=eval_step, cache=val_cache,
+                )[:2],
+                lambda: jax.device_get(snap_state),
+                ba, bs, sb, save_due=_save_due(cfg, step_now),
+                save_fn=_overlap_save, curve_gate=curve_gate,
+            )
+
+        return _BgJob(job)
+
     try:
         for step_i in range(start_step, cfg.train.steps):
             t_step = time.perf_counter()
@@ -1219,9 +1459,39 @@ def fit(
                 if snap is not None:
                     snap.maybe_flush()
 
+            # Overlapped-eval completion poll (train.eval_overlap):
+            # collect a finished background eval the step after it
+            # lands, so early stopping / a DtypeCurveRejected fires at
+            # most one step late instead of at the next boundary.
+            if eval_job is not None and eval_job.done():
+                best_auc, best_step, since_best, stop, _ = eval_job.result()
+                eval_job = None
+                if stop:
+                    stopped_early = True
+                    break
+
             if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
-                clock.pause()
-                with stalls.measure("pause"):
+                if overlap:
+                    if eval_job is not None:
+                        # One eval in flight at a time: the previous
+                        # boundary's job must land (its best-tracking
+                        # chains into this one). Normally long done —
+                        # this wait is the only stall overlap keeps.
+                        clock.pause()
+                        with stalls.measure("pause"):
+                            best_auc, best_step, since_best, stop, _ = (
+                                eval_job.result()
+                            )
+                        eval_job = None
+                        clock.resume()
+                        if stop:
+                            stopped_early = True
+                            break
+                    eval_job = _submit_eval(step_i + 1)
+                else:
+                    clock.pause()
+                    t_pause = time.perf_counter()
+                    save_stall[0] = 0.0
                     best_auc, best_step, since_best, stop, saved = _eval_and_track(
                         cfg, log, ckpt, step_i + 1,
                         lambda: predict_split(
@@ -1231,14 +1501,19 @@ def fit(
                         lambda: jax.device_get(state),
                         best_auc, best_step, since_best,
                         save_due=_save_due(cfg, step_i + 1),
+                        save_fn=_save_fn, curve_gate=curve_gate,
                     )
-                    if saved:
-                        _persist_grain_state(grain_tee, workdir, step_i + 1,
-                                             kept_steps=ckpt.all_steps())
-                clock.resume()
-                if stop:
-                    stopped_early = True
-                    break
+                    # 'pause' is the eval-only remainder: _save_fn
+                    # already attributed its own blocking time to the
+                    # disjoint 'save' segment.
+                    stalls.add("pause", max(
+                        0.0,
+                        time.perf_counter() - t_pause - save_stall[0],
+                    ))
+                    clock.resume()
+                    if stop:
+                        stopped_early = True
+                        break
     except BaseException as e:
         # Flight recorder (obs/flightrec.py): dump the black box for an
         # unhandled exception — including SIGTERM/SIGINT, which the
@@ -1247,7 +1522,35 @@ def fit(
         if flight is not None:
             flight.record_exception(e)
         if _is_preemption(e) and last_step > start_step:
+            # Do NOT join an in-flight overlapped EVAL — its predict
+            # pass can cost most of the SIGTERM grace window (review
+            # fix). Latch the preempt flag so the job skips its own
+            # save, then settle only the already-QUEUED saves; the
+            # emergency save rides the same worker queue behind them
+            # (one save thread per manager — the orbax affinity rule).
+            preempted["flag"] = True
+            if saver is not None:
+                try:
+                    saver.drain()
+                except BaseException:  # noqa: BLE001 - exit path
+                    pass
             def _save(step):
+                # With an AsyncSaver the emergency save rides the SAME
+                # worker thread every other save used — orbax pins a
+                # manager's saves to one thread (finalize-thread reset
+                # is save-thread-affine).
+                if saver is not None:
+                    out = {"saved": False}
+
+                    def _do():
+                        out["saved"] = ckpt.save_latest(
+                            step, jax.device_get(state)
+                        )
+
+                    saver.submit(_do)
+                    saver.drain()
+                    ckpt.wait()
+                    return out["saved"]
                 saved = ckpt.save_latest(step, jax.device_get(state))
                 ckpt.wait()  # durable BEFORE the process exits
                 return saved
@@ -1264,6 +1567,16 @@ def fit(
         if cfg.train.debug:
             jax.config.update("jax_debug_nans", prev_debug_nans)
 
+    # Collect the tail (ISSUE 11): an overlapped final eval and any
+    # queued async saves must land before the checkpointer closes —
+    # their exceptions (incl. DtypeCurveRejected) surface here.
+    if eval_job is not None:
+        best_auc, best_step, since_best, stop, _ = eval_job.result()
+        eval_job = None
+        if stop:
+            stopped_early = True
+    if saver is not None:
+        saver.close()
     ckpt.wait()
     ckpt.close()
     if cfg.obs.quality.profile_out:
@@ -1517,6 +1830,19 @@ def fit_ensemble_parallel(
         )
         for m in range(k)
     ]
+    # Raw-speed training (ISSUE 11): async checkpoint worker, eval
+    # overlap, and the train.dtype golden-curve parity gate (checked on
+    # the ENSEMBLE val AUC — the quantity this driver optimizes for).
+    # Overlap implies the async worker (one save thread per manager —
+    # the orbax finalize-thread affinity rule; see fit()).
+    _async_knobs_guard(cfg)
+    curve_gate = _DtypeCurveGate(cfg)
+    overlap = cfg.train.eval_overlap
+    saver = (
+        ckpt_lib.AsyncSaver()
+        if (cfg.train.async_save or overlap) else None
+    )
+    eval_job: "_BgJob | None" = None
 
     start_step = 0
     best_auc = np.full((k,), -np.inf)
@@ -1642,6 +1968,95 @@ def fit_ensemble_parallel(
     clock = _ThroughputClock(cfg.data.batch_size)
     last_step = start_step
     _, stalls, snap = _telemetry_for(cfg, log, workdir, flight=flight)
+
+    save_stall = [0.0]
+    # Preemption latch — same contract as fit(): a still-running
+    # overlapped eval skips its save once the exit path owns latest/.
+    preempted = {"flag": False}
+
+    def _eval_members(step_now, snap_state, ba, bs, sb,
+                      stable: bool, attribute: bool):
+        """One full member-parallel eval block: predict -> per-member
+        AUCs -> dtype-curve gate -> best tracking -> lock-step save.
+        Runs inline (``attribute=True`` stall-attributes the save to
+        the 'save' segment) or as an overlapped _BgJob over an
+        on-device snapshot (``stable=True``: the snapshot is already
+        safe against the next step's donation)."""
+        grades, probs = _predict_split_members(
+            cfg, snap_state, data_dir, "val", mesh, eval_step,
+            cache=val_cache,
+        )
+        bin_labels = (grades >= 2).astype(np.float64)
+        member_probs = [
+            p if cfg.model.head == "binary"
+            else metrics.referable_probs_from_multiclass(p)
+            for p in probs
+        ]
+        aucs = np.array([
+            metrics.roc_auc(bin_labels, p) for p in member_probs
+        ])
+        ens_auc = metrics.roc_auc(
+            bin_labels, metrics.ensemble_average(member_probs)
+        )
+        ba, bs, sb = _best_tracking_update(
+            aucs, ba, bs, sb, step_now, cfg.train.min_delta
+        )
+        # Full precision on val_auc_per_member — the resume replay
+        # source (same note as _eval_and_track). Logged BEFORE the
+        # checkpoint fetch so time-to-target artifacts timestamp when
+        # the AUC was known.
+        log.write(
+            "eval", step=step_now,
+            val_auc_per_member=[float(a) for a in aucs],
+            ensemble_val_auc=round(float(ens_auc), 5),
+            best_auc_per_member=[round(float(a), 5) for a in ba],
+        )
+        curve_gate.check(step_now, float(ens_auc))
+        stopping = bool(np.all(sb >= cfg.train.early_stop_patience))
+        if (_save_due(cfg, step_now) or stopping) and not preempted["flag"]:
+            # The dominant per-eval cost when saves are due: the
+            # stacked state is k full train states (1.56 GB at k=4
+            # flagship scale) fetched device->host — sync, that fetch
+            # blocks here (train.save_every_evals spaces these out,
+            # docs/PERF.md §Eval); under train.async_save only an
+            # on-device snapshot + queue put does.
+            t0 = time.perf_counter()
+            src = snap_state if stable else (
+                _state_snapshot(snap_state) if saver is not None
+                else snap_state
+            )
+
+            def _do(src=src, step_now=step_now, aucs=aucs):
+                # Worker-side latch re-check (same race note as fit()'s
+                # _overlap_save): a late boundary save must never land
+                # behind the emergency save and roll latest/ back.
+                if preempted["flag"]:
+                    return
+                host_state = jax.device_get(gather_state(src))
+                for m in range(k):
+                    ckpts[m].save(
+                        step_now,
+                        train_lib.unstack_member(host_state, m),
+                        {"val_auc": float(aucs[m])},
+                    )
+                _persist_grain_state(
+                    grain_tee, workdir, step_now,
+                    kept_steps=set.union(*[c.all_steps() for c in ckpts]),
+                )
+
+            if saver is not None:
+                saver.submit(_do)
+            else:
+                _do()
+            if attribute:
+                dt = time.perf_counter() - t0
+                stalls.add("save", dt)
+                save_stall[0] += dt
+        if stopping:
+            log.write("early_stop", step=step_now,
+                      best_step=[int(s) for s in bs])
+        return ba, bs, sb, stopping
+
     try:
         for step_i in range(start_step, cfg.train.steps):
             t_step = time.perf_counter()
@@ -1694,80 +2109,84 @@ def fit_ensemble_parallel(
                 if snap is not None:
                     snap.maybe_flush()
 
-            if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
-                clock.pause()
-                t_pause = time.perf_counter()
-                grades, probs = _predict_split_members(
-                    cfg, state, data_dir, "val", mesh, eval_step,
-                    cache=val_cache,
-                )
-                bin_labels = (grades >= 2).astype(np.float64)
-                member_probs = [
-                    p if cfg.model.head == "binary"
-                    else metrics.referable_probs_from_multiclass(p)
-                    for p in probs
-                ]
-                aucs = np.array([
-                    metrics.roc_auc(bin_labels, p) for p in member_probs
-                ])
-                ens_auc = metrics.roc_auc(
-                    bin_labels, metrics.ensemble_average(member_probs)
-                )
-                best_auc, best_step, since_best = _best_tracking_update(
-                    aucs, best_auc, best_step, since_best, step_i + 1,
-                    cfg.train.min_delta,
-                )
-                # Full precision on val_auc_per_member — the resume
-                # replay source (same note as _eval_and_track). Logged
-                # BEFORE the checkpoint fetch so time-to-target
-                # artifacts timestamp when the AUC was known.
-                log.write(
-                    "eval", step=step_i + 1,
-                    val_auc_per_member=[float(a) for a in aucs],
-                    ensemble_val_auc=round(float(ens_auc), 5),
-                    best_auc_per_member=[round(float(a), 5) for a in best_auc],
-                )
-                stopping = bool(
-                    np.all(since_best >= cfg.train.early_stop_patience)
-                )
-                if _save_due(cfg, step_i + 1) or stopping:
-                    # The dominant per-eval cost when saves are due: the
-                    # stacked state is k full train states (1.56 GB at
-                    # k=4 flagship scale) fetched device->host here —
-                    # train.save_every_evals spaces these out
-                    # (docs/PERF.md §Eval).
-                    host_state = jax.device_get(gather_state(state))
-                    for m in range(k):
-                        ckpts[m].save(
-                            step_i + 1,
-                            train_lib.unstack_member(host_state, m),
-                            {"val_auc": float(aucs[m])},
-                        )
-                    _persist_grain_state(
-                        grain_tee, workdir, step_i + 1,
-                        kept_steps=set.union(*[c.all_steps() for c in ckpts]),
-                    )
-                stalls.add("pause", time.perf_counter() - t_pause)
-                clock.resume()
+            # Overlapped-eval completion poll (same contract as fit()).
+            if eval_job is not None and eval_job.done():
+                best_auc, best_step, since_best, stopping = eval_job.result()
+                eval_job = None
                 if stopping:
-                    log.write("early_stop", step=step_i + 1,
-                              best_step=[int(s) for s in best_step])
                     stopped_early = True
                     break
+
+            if (step_i + 1) % cfg.train.eval_every == 0 or step_i + 1 == cfg.train.steps:
+                if overlap:
+                    if eval_job is not None:
+                        clock.pause()
+                        with stalls.measure("pause"):
+                            best_auc, best_step, since_best, stopping = (
+                                eval_job.result()
+                            )
+                        eval_job = None
+                        clock.resume()
+                        if stopping:
+                            stopped_early = True
+                            break
+                    snap_state = _state_snapshot(state)
+                    eval_job = _BgJob(
+                        lambda step_now=step_i + 1, snap_state=snap_state,
+                        ba=best_auc, bs=best_step, sb=since_best:
+                        _eval_members(step_now, snap_state, ba, bs, sb,
+                                      stable=True, attribute=False)
+                    )
+                else:
+                    clock.pause()
+                    t_pause = time.perf_counter()
+                    save_stall[0] = 0.0
+                    best_auc, best_step, since_best, stopping = _eval_members(
+                        step_i + 1, state, best_auc, best_step, since_best,
+                        stable=False, attribute=True,
+                    )
+                    stalls.add("pause", max(
+                        0.0,
+                        time.perf_counter() - t_pause - save_stall[0],
+                    ))
+                    clock.resume()
+                    if stopping:
+                        stopped_early = True
+                        break
     except BaseException as e:
         if flight is not None:
             flight.record_exception(e)
         if _is_preemption(e) and last_step > start_step:
+            # Latch-then-drain, never join the in-flight eval (same
+            # grace-window rationale as fit()'s preempt path).
+            preempted["flag"] = True
+            if saver is not None:
+                try:
+                    saver.drain()
+                except BaseException:  # noqa: BLE001 - exit path
+                    pass
             def _save(step):
                 # Every member in lock-step, same as the eval-time save
                 # — a preempted member-parallel run must stay a valid
                 # member-parallel workdir (all latests at ONE step).
-                host_state = jax.device_get(gather_state(state))
-                wrote = False
-                for m in range(k):
-                    wrote = ckpts[m].save_latest(
-                        step, train_lib.unstack_member(host_state, m)
-                    ) or wrote
+                def _do():
+                    host_state = jax.device_get(gather_state(state))
+                    wrote = False
+                    for m in range(k):
+                        wrote = ckpts[m].save_latest(
+                            step, train_lib.unstack_member(host_state, m)
+                        ) or wrote
+                    return wrote
+
+                if saver is not None:
+                    # Same one-save-thread rule as fit()'s preempt path.
+                    out = {"saved": False}
+                    saver.submit(lambda: out.__setitem__("saved", _do()))
+                    saver.drain()
+                    for c in ckpts:
+                        c.wait()
+                    return out["saved"]
+                wrote = _do()
                 for c in ckpts:
                     c.wait()
                 return wrote
@@ -1781,6 +2200,15 @@ def fit_ensemble_parallel(
         if cfg.train.debug:
             jax.config.update("jax_debug_nans", prev_debug_nans)
 
+    # Tail collection (ISSUE 11), mirroring fit(): the overlapped final
+    # eval and queued async saves land before the checkpointers close.
+    if eval_job is not None:
+        best_auc, best_step, since_best, stopping = eval_job.result()
+        eval_job = None
+        if stopping:
+            stopped_early = True
+    if saver is not None:
+        saver.close()
     for c in ckpts:
         c.wait()
         c.close()
@@ -1897,6 +2325,33 @@ def fit_tf(
             "persistence wired into the flax drivers — a long tf run "
             "would train fine but never be resumable. Use "
             "grain_workers=0 (or the flax path) with --device=tf"
+        )
+    # Raw-speed knobs (ISSUE 11) are flax-path features; house style is
+    # to refuse loudly rather than silently train without them.
+    if cfg.train.dtype != "fp32":
+        raise ValueError(
+            f"train.dtype={cfg.train.dtype!r} is a flax-path feature "
+            "(bf16 master-weight mixed precision lives in the jit train "
+            "step); the legacy tf backend trains fp32 only"
+        )
+    if cfg.train.use_pallas_fused:
+        raise ValueError(
+            "train.use_pallas_fused is a flax-path feature (Mosaic "
+            "kernels inside the jit step); unset it with --device=tf"
+        )
+    if cfg.train.accum_steps > 1:
+        raise ValueError(
+            "train.accum_steps>1 is implemented inside the flax jit "
+            "step; the legacy tf backend has no accumulation wiring — "
+            "a silently un-accumulated run would train a different "
+            "recipe. Unset it with --device=tf"
+        )
+    if cfg.train.async_save or cfg.train.eval_overlap:
+        raise ValueError(
+            "train.async_save/train.eval_overlap are wired into the "
+            "flax train loops (snapshot + background worker); the "
+            "legacy tf backend saves synchronously — unset them with "
+            "--device=tf"
         )
     seed = cfg.train.seed if seed is None else seed
     seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)

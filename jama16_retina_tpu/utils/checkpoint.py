@@ -119,6 +119,20 @@ class Checkpointer:
         would actually enter the top-k by metric — otherwise orbax would
         serialize the full state just to delete it during retention,
         doubling checkpoint IO on every non-improving eval."""
+        from jama16_retina_tpu.obs import faultinject
+
+        # Fault seam (ISSUE 11; obs/faultinject.py "ckpt.save"): one
+        # global read + branch unarmed. Latency plans here widen the
+        # in-flight-save window so the kill -9 drill in tests/
+        # test_faults.py can land inside it deterministically.
+        faultinject.check("ckpt.save")
+        # orbax refuses a new save while the previous one's async
+        # finalize is still running (CheckpointManager asserts
+        # _finalize_thread is None) — settle it first. Normally
+        # instant; only a save cadence outpacing finalization (e.g.
+        # back-to-back AsyncSaver jobs) ever waits here.
+        self._best.wait_until_finished()
+        self._latest.wait_until_finished()
         # Numpy SCALARS (np.int32 etc., e.g. a stacked state's step
         # counter after unstack_member's x[m] indexing) are rejected by
         # older orbax StandardSave ("Unsupported type"); 0-d ndarrays
@@ -146,6 +160,12 @@ class Checkpointer:
         step is already saved — a preemption landing exactly on an
         eval-step save must not collide with orbax's
         StepAlreadyExistsError."""
+        from jama16_retina_tpu.obs import faultinject
+
+        faultinject.check("ckpt.save")
+        # Same previous-save settling rule as save() — the preemption
+        # path may land while an eval-time async save is finalizing.
+        self._latest.wait_until_finished()
         if step in self._latest.all_steps():
             return False
         state = jax.tree.map(
@@ -341,3 +361,78 @@ def abstract_like(state: TrainState) -> TrainState:
     return jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), state
     )
+
+
+class AsyncSaver:
+    """Background checkpoint writer (``train.async_save``; ISSUE 11).
+
+    One worker thread executes submitted save jobs strictly in
+    submission order, so the step loop's stall at a save boundary
+    shrinks to an on-device state snapshot plus a queue put — the
+    device->host fetch (the ~48 s dominant cost at k=4 flagship scale
+    on this environment, docs/PERF.md §Eval) and the orbax write both
+    run off-loop. A job is a zero-arg callable; the trainer closes the
+    snapshot, the Checkpointer, and the grain-state persist into it.
+
+    Failure contract: a job's exception is LATCHED and re-raised at the
+    next ``submit()`` or ``drain()`` — a failed checkpoint write stops
+    the run loudly, one boundary late, instead of being swallowed by a
+    daemon thread. ``drain()`` blocks until every submitted job
+    finished; the SIGTERM preemption path calls it BEFORE
+    ``save_latest`` so the emergency save can never interleave with an
+    in-flight async save on the same orbax managers. kill -9 mid-job
+    leaves at most an uncommitted orbax tmp step, which ``all_steps()``
+    never lists — resume falls back to the last committed step (pinned
+    in tests/test_faults.py)."""
+
+    def __init__(self):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._err: "BaseException | None" = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ckpt-async-saver"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:
+                    return
+                job()
+            except BaseException as e:  # noqa: BLE001 - latched, re-raised
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, job) -> None:
+        """Enqueue one save job (runs after every previously submitted
+        job). Re-raises a prior job's latched failure first."""
+        if self._closed:
+            raise RuntimeError("AsyncSaver is closed")
+        self._raise_pending()
+        self._q.put(job)
+
+    def drain(self) -> None:
+        """Block until every submitted job has finished; re-raise any
+        latched failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain, stop the worker, and surface any latched failure."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join()
+        self._raise_pending()

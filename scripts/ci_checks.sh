@@ -4,6 +4,10 @@
 #
 #   bash scripts/ci_checks.sh            # lint + quick tier (~5 min)
 #   bash scripts/ci_checks.sh --lint-only
+#   bash scripts/ci_checks.sh --mixedprec-smoke
+#       lint + the train.dtype seam smoke (ISSUE 11): a 2-step bf16
+#       fit + golden-curve parity gate (pass AND refusal drill) on
+#       synthetic data — scripts/mixedprec_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -16,6 +20,12 @@ echo "== graftlint (contract checker) =="
 python scripts/graftlint.py --json
 
 if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+if [[ "${1:-}" == "--mixedprec-smoke" ]]; then
+    echo "== mixed-precision smoke (train.dtype seam) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/mixedprec_smoke.py
     exit 0
 fi
 

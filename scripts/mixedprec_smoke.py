@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""CI mixed-precision smoke (ISSUE 11 satellite): prove the
+``train.dtype`` seam end-to-end in under a minute on CPU — a 2-step
+bf16 fit on synthetic data, the golden-curve parity gate PASSING
+against the run's own fp32 twin, and the gate REFUSING against a
+deliberately-wrong pinned curve — so the dtype seam cannot rot between
+bench runs (scripts/ci_checks.sh --mixedprec-smoke).
+
+Exit 0 = seam healthy; any failure raises (exit != 0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _log(msg: str) -> None:
+    print(f"mixedprec_smoke: {msg}", file=sys.stderr)
+
+
+def main() -> int:
+    from jama16_retina_tpu import trainer, train_lib
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.data import tfrecord
+
+    data_dir = tempfile.mkdtemp(prefix="mixedprec_smoke_data_")
+    for split, n in (("train", 48), ("val", 24)):
+        tfrecord.write_synthetic_split(data_dir, split, n, 64, 1, seed=5)
+
+    base = override(get_config("smoke"), [
+        "train.steps=2", "train.eval_every=2", "train.log_every=2",
+        "data.batch_size=8",
+    ])
+
+    # 1) fp32 twin: pins the golden curve this smoke gates against.
+    w_fp32 = tempfile.mkdtemp(prefix="mixedprec_smoke_fp32_")
+    trainer.fit(base, data_dir, w_fp32)
+    ref = os.path.join(w_fp32, "metrics.jsonl")
+    _log(f"fp32 twin done ({ref})")
+
+    # 2) bf16 fit gated on the fp32 curve at the shipped-scale
+    # tolerance: must PASS (2 tiny-cnn steps cannot drift an AUC on 24
+    # val images beyond 0.5 unless the seam is broken).
+    w_bf16 = tempfile.mkdtemp(prefix="mixedprec_smoke_bf16_")
+    cfg_bf16 = override(base, [
+        "train.dtype=bf16",
+        f"train.dtype_curve_ref={ref}",
+        "train.dtype_curve_tol=0.5",
+    ])
+    res = trainer.fit(cfg_bf16, data_dir, w_bf16)
+    _log(f"bf16 fit passed the parity gate (best_auc={res['best_auc']})")
+
+    # 3) Refusal drill against a deterministically-wrong pinned curve
+    # (val_auc 0.0 at the eval step): the gate MUST refuse — a gate
+    # that cannot fire is a gate that rotted.
+    bad_ref = os.path.join(data_dir, "bad_curve.jsonl")
+    with open(bad_ref, "w") as f:
+        f.write(json.dumps(
+            {"kind": "eval", "step": 2, "val_auc": 0.0, "t": 0.0}
+        ) + "\n")
+    w_drill = tempfile.mkdtemp(prefix="mixedprec_smoke_drill_")
+    cfg_drill = override(base, [
+        "train.dtype=bf16",
+        f"train.dtype_curve_ref={bad_ref}",
+        "train.dtype_curve_tol=0.01",
+    ])
+    try:
+        trainer.fit(cfg_drill, data_dir, w_drill)
+    except train_lib.DtypeCurveRejected as e:
+        _log(f"refusal drill OK: {e}")
+    else:
+        raise AssertionError(
+            "DtypeCurveRejected did not fire against a 0.0 pinned "
+            "curve at tol=0.01 — the parity gate is broken"
+        )
+    _log("mixed-precision seam healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -209,7 +209,7 @@ def stalls_summary(records: list) -> "dict | None":
         return None
     tot = {k: sum(r.get(k, 0.0) for r in wins)
            for k in ("window_sec", "input_wait_sec", "dispatch_sec",
-                     "pause_sec", "other_sec")}
+                     "pause_sec", "save_sec", "other_sec")}
     worst = max(wins, key=lambda r: r.get("input_wait_sec", 0.0))
     return {
         "windows": len(wins),
@@ -229,7 +229,8 @@ def render_stalls(records: list) -> str:
         (name, f"{s[key]:.2f}", f"{100 * s[key] / wall:.1f}%")
         for name, key in (
             ("input wait (pipeline starvation)", "input_wait_sec"),
-            ("eval/checkpoint pause", "pause_sec"),
+            ("eval pause", "pause_sec"),
+            ("checkpoint save stall", "save_sec"),
             ("step dispatch", "dispatch_sec"),
             ("other (host python, logging)", "other_sec"),
         )

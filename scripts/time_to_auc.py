@@ -75,6 +75,17 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bootstrap", type=int, default=2000)
     p.add_argument(
+        "--train_dtype", default="fp32", choices=("fp32", "bf16"),
+        help="train.dtype for the run (ISSUE 11): bf16 measures the "
+        "mixed-precision time-to-AUC against the same recipe/seed; "
+        "runs ungated here (pin a curve with --dtype_curve_ref)",
+    )
+    p.add_argument(
+        "--dtype_curve_ref", default="",
+        help="optional fp32 metrics.jsonl to gate a --train_dtype=bf16 "
+        "run against (train.dtype_curve_ref)",
+    )
+    p.add_argument(
         "--save_every_evals", type=int, default=4,
         help="checkpoint every Nth eval (train.save_every_evals; the "
         "final eval always saves). Each save fetches the full stacked "
@@ -105,7 +116,10 @@ def _log(msg: str) -> None:
 SPLIT_SEEDS = {"train": 11, "val": 12, "test": 13}
 
 
-def main(argv=None) -> dict:
+def main(argv=None, print_json: bool = True) -> dict:
+    """``print_json=False`` (bench.py's in-process caller) returns the
+    artifact dict without writing it to stdout — bench owns stdout's
+    one-JSON contract."""
     args = parse_args(argv)
     from jama16_retina_tpu import trainer
     from jama16_retina_tpu.configs import get_config, override
@@ -225,6 +239,15 @@ def main(argv=None) -> dict:
         f"train.seed={args.seed}",
         f"train.ensemble_size={args.k}",
         "train.ensemble_parallel=true",
+        # The crossing metric READS the member-parallel driver's
+        # ensemble_val_auc records; the 1-device auto-fallback to
+        # sequential members would scatter evals across member_NN
+        # workdirs and leave nothing to cross — force the stacked
+        # driver (the measured protocol, whatever the mesh).
+        "train.ensemble_parallel_force=true",
+        f"train.dtype={args.train_dtype}",
+        *( [f"train.dtype_curve_ref={args.dtype_curve_ref}"]
+           if args.dtype_curve_ref else [] ),
         f"train.steps={args.steps}",
         f"train.eval_every={args.eval_every}",
         f"train.log_every={args.eval_every}",
@@ -346,11 +369,13 @@ def main(argv=None) -> dict:
             "warmup_steps": warmup, "ema_decay": cfg.train.ema_decay,
             "label_smoothing": cfg.train.label_smoothing,
             "tta": cfg.eval.tta,
+            "train_dtype": args.train_dtype,
         },
         "device": jax.devices()[0].device_kind,
         "workdir": workdir,
     }
-    print(json.dumps(out, indent=1, default=float))
+    if print_json:
+        print(json.dumps(out, indent=1, default=float))
     return out
 
 

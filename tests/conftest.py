@@ -172,6 +172,17 @@ _QUICK_TESTS = {
     "test_cascade.py::test_all_escalate_and_none_escalate_edges",
     "test_cascade.py::test_gate_refuses_garbage_student_and_admits_faithful_one",
     "test_cascade.py::test_compile_cache_stale_fingerprint_refused",
+    # raw-speed training (ISSUE 11): the cheap pins — knob validation,
+    # fused-kernel vs reference parity, the dtype-gate unit contract,
+    # the async-saver failure latch, and the master-weight dtype pin;
+    # the fit()-level drills (parity refusal, overlap trajectory,
+    # kill -9 mid-save) stay in the full tier (XLA compiles dominate)
+    "test_mixedprec.py::test_validate_train_knobs_refusals",
+    "test_mixedprec.py::test_fused_adamw_matches_optax_reference",
+    "test_mixedprec.py::test_fused_normalize_augment_matches_jnp_reference",
+    "test_mixedprec.py::test_dtype_curve_gate_unit",
+    "test_mixedprec.py::test_async_saver_latches_and_reraises_failures",
+    "test_mixedprec.py::test_bf16_step_keeps_fp32_master_weights",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

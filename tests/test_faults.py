@@ -1045,3 +1045,74 @@ def test_predict_strict_semantics_exact_with_retries(tmp_path):
     faultinject.disarm()
     assert len(pre2.skipped) == 1 and len(pre2.kept) == 2
     assert pre2.retried == []
+
+
+# ---------------------------------------------------------------------------
+# kill -9 during an in-flight async checkpoint save (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def test_kill9_during_inflight_async_save_resumes_cleanly(
+    fit_data, tmp_path
+):
+    """THE async-save crash drill: a child fit (train.async_save=true)
+    SIGKILLs itself on the AsyncSaver worker thread immediately after
+    handing orbax the first eval-time save — the commit may still be in
+    flight, exactly what a preempted host leaves behind. The workdir
+    must stay a valid resume point: uncommitted orbax tmp steps are
+    invisible to all_steps(), so the parent's resume either continues
+    from the committed step or restarts from 0 — and either way runs to
+    completion with a restorable final checkpoint."""
+    import subprocess
+    import sys as _sys
+
+    wd = str(tmp_path / "wd")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    driver = f"""
+import os, signal, sys
+sys.path.insert(0, {json.dumps(repo)})
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu import trainer
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+real_save = ckpt_lib.Checkpointer.save
+def killing_save(self, step, state, metrics):
+    # Runs on the AsyncSaver worker (train.async_save routes every
+    # eval-time save there): start the real orbax save, then die with
+    # its finalization possibly still in flight.
+    real_save(self, step, state, metrics)
+    os.kill(os.getpid(), signal.SIGKILL)
+ckpt_lib.Checkpointer.save = killing_save
+
+cfg = override(get_config("smoke"), [
+    "model.image_size={SIZE}",
+    "train.steps=6", "train.eval_every=3", "train.log_every=2",
+    "data.batch_size=8", "data.augment=false", "eval.batch_size=8",
+    "obs.flush_every_s=0", "train.async_save=true",
+])
+trainer.fit(cfg, {json.dumps(fit_data)}, {json.dumps(wd)}, seed=0)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([_sys.executable, "-c", driver], env=env,
+                          capture_output=True, timeout=560)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+
+    # The torn workdir restores or reports empty — never raises a
+    # deep orbax traceback — and resume completes the run.
+    res = trainer.fit(
+        _fit_cfg(extra=("train.async_save=true", "train.resume=true")),
+        fit_data, wd, seed=0,
+    )
+    assert res["best_auc"] is not None
+    ck = ckpt_lib.Checkpointer(wd)
+    assert ck.latest_step == 6
+    restored = ck.restore(
+        ckpt_lib.abstract_like(jax.device_get(
+            train_lib.create_state(
+                _fit_cfg(), models.build(_fit_cfg().model),
+                jax.random.key(0),
+            )[0]
+        ))
+    )
+    assert int(np.asarray(restored.step)) == 6
+    ck.close()

@@ -207,10 +207,12 @@ def test_stall_clock_fields_sum_to_window():
         time.sleep(0.005)
     with sc.measure("pause"):
         time.sleep(0.01)
+    with sc.measure("save"):
+        time.sleep(0.002)
     time.sleep(0.005)  # unattributed host time -> other
     f = sc.fields()
     total = (f["input_wait_sec"] + f["dispatch_sec"] + f["pause_sec"]
-             + f["other_sec"])
+             + f["save_sec"] + f["other_sec"])
     assert total == pytest.approx(f["window_sec"], abs=2e-3)
     assert f["input_wait_sec"] >= 0.018
     assert f["other_sec"] >= 0.003
@@ -587,10 +589,10 @@ def test_fit_train_records_carry_stall_attribution(obs_fit):
     assert train
     for r in train:
         for k in ("window_sec", "input_wait_sec", "dispatch_sec",
-                  "pause_sec", "other_sec"):
+                  "pause_sec", "save_sec", "other_sec"):
             assert k in r, (k, r)
         total = (r["input_wait_sec"] + r["dispatch_sec"] + r["pause_sec"]
-                 + r["other_sec"])
+                 + r["save_sec"] + r["other_sec"])
         assert total == pytest.approx(r["window_sec"], abs=2e-3), r
 
 
