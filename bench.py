@@ -522,6 +522,111 @@ def _cheappath_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _router_overhead_guard(extras: dict, rate_on: float,
+                           rate_off: float,
+                           max_overhead: float = 0.02) -> bool:
+    """ISSUE 12's pin, same shared math: the SAME workload routed
+    through a 1-replica Router (submit -> tick re-binning -> replica
+    worker -> future reassembly) must stay within 2% of calling the
+    replica directly — the front door's bookkeeping must never tax the
+    serving hot path it fronts."""
+    return _overhead_guard(extras, "router", rate_on, rate_off,
+                           max_overhead)
+
+
+def _router_bench(extras: dict) -> None:
+    """Router scaling rows (ISSUE 12): the dispatch pipeline measured
+    OFF-DEVICE over stub replicas with a fixed simulated per-row
+    service time (time.sleep releases the GIL, so replica overlap is
+    real concurrency — the same role the fake infer plays in the chaos
+    smoke). Published as ``router_k{1,2,4}_images_per_sec`` plus the
+    ``router_k4_vs_k1`` scaling ratio (acceptance: >= 2.5x on 4
+    replicas), ``router_vs_single_engine`` (routed k=1 vs calling the
+    same replica directly), and the shared <=2% ``_overhead_guard``
+    pin. These are router-dispatch rates, not model rates — no model
+    FLOPs run, so the physics guard deliberately does not apply (its
+    FLOPs numerator does not exist for a sleep)."""
+    import dataclasses as _dc
+    import threading
+
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.obs.registry import Registry
+    from jama16_retina_tpu.serve.router import Router
+
+    ROWS = 64           # rows per request == the bin/bucket size
+    PER_ROW_S = 50e-6   # simulated device time per row
+    FIXED_S = 1e-3      # simulated per-dispatch fixed cost
+    WORKERS = 8         # closed-loop submitters
+    PER_WORKER = 25     # requests each
+
+    class _StubReplica:
+        def __init__(self, rid):
+            self.generation = rid
+
+        def probs(self, rows):
+            time.sleep(FIXED_S + PER_ROW_S * rows.shape[0])
+            return rows.reshape(rows.shape[0], -1).sum(axis=1)
+
+    rows = np.zeros((ROWS, 2, 2, 3), np.uint8)
+    total_rows = WORKERS * PER_WORKER * ROWS
+
+    # The direct baseline: the same total rows through ONE replica,
+    # dispatch after dispatch — exactly what the router's single
+    # replica worker does, minus the router.
+    stub = _StubReplica(0)
+    t0 = time.perf_counter()
+    for _ in range(WORKERS * PER_WORKER):
+        stub.probs(rows)
+    rate_direct = total_rows / (time.perf_counter() - t0)
+
+    cfg = get_config("smoke")
+    cfg = cfg.replace(serve=_dc.replace(
+        cfg.serve, max_batch=ROWS, bucket_sizes=(ROWS,),
+        max_wait_ms=1.0, router_tick_ms=1.0,
+    ))
+
+    def routed_rate(k: int) -> float:
+        router = Router(
+            cfg, engines=[_StubReplica(r) for r in range(k)],
+            registry=Registry(),
+        )
+        errs: list = []
+
+        def run(w):
+            try:
+                for _ in range(PER_WORKER):
+                    router.submit(rows).result()
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(w,))
+            for w in range(WORKERS)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        router.close()
+        if errs:
+            raise errs[0]
+        return total_rows / dt
+
+    rates = {}
+    for k in (1, 2, 4):
+        rates[k] = routed_rate(k)
+        extras[f"router_k{k}_images_per_sec"] = round(rates[k], 1)
+        _log(f"router k={k}: {rates[k]:.0f} img/s (stub replicas, "
+             f"{WORKERS} submitters)")
+    extras["router_k4_vs_k1"] = round(rates[4] / rates[1], 2)
+    extras["router_vs_single_engine"] = round(rates[1] / rate_direct, 3)
+    _router_overhead_guard(extras, rates[1], rate_direct)
+    _log(f"router scaling: k4/k1 = {extras['router_k4_vs_k1']}x, "
+         f"routed/direct = {extras['router_vs_single_engine']}")
+
+
 def _chaos_smoke(extras: dict) -> None:
     """``--chaos``: deterministically drive every recovery path the
     reliability layer claims, off-device (tiny batcher + fake infer +
@@ -573,6 +678,11 @@ def _chaos_smoke(extras: dict) -> None:
         "serve.compile_cache.load": {"kind": "error", "on_calls": [1],
                                      "error": "OSError",
                                      "message": "chaos cache load"},
+        # Front-door router (ISSUE 12): the 4th bin dispatch kills its
+        # replica mid-storm — bins retry on siblings, zero drops.
+        "serve.router.dispatch": {"kind": "error", "on_calls": [4],
+                                  "error": "RuntimeError",
+                                  "message": "chaos replica death"},
     })
     prev = faultinject.arm(plan)
     try:
@@ -660,6 +770,74 @@ def _chaos_smoke(extras: dict) -> None:
                 ok = False  # stale fingerprint must refuse
             except CompileCacheStale:
                 pass
+
+        # 2c) Front-door router (ISSUE 12): a replica dies mid-storm
+        #     (injected at serve.router.dispatch) — its bins retry on
+        #     siblings with typed accounting; ZERO dropped requests,
+        #     and every response stays attributable to the
+        #     (replica, generation) that served it.
+        import dataclasses as _dc
+        import threading as _threading
+
+        from jama16_retina_tpu.configs import get_config as _gc
+        from jama16_retina_tpu.serve.router import Router
+
+        class _ChaosReplica:
+            def __init__(self, rid):
+                self.generation = rid
+
+            def probs(self, rows):
+                time.sleep(5e-4)
+                return rows.reshape(rows.shape[0], -1).astype(
+                    np.float64).sum(axis=1)
+
+        rcfg = _gc("smoke")
+        rcfg = rcfg.replace(serve=_dc.replace(
+            rcfg.serve, max_batch=8, bucket_sizes=(8,), max_wait_ms=1.0,
+        ))
+        router = Router(
+            rcfg, engines=[_ChaosReplica(r) for r in range(4)],
+            registry=reg,
+        )
+        futs: list = []
+        futs_lock = _threading.Lock()
+
+        def _storm(w):
+            rng = np.random.default_rng(w)
+            for i in range(10):
+                r_rows = rng.integers(0, 256, (8, 2, 2, 3), np.uint8)
+                f = router.submit(
+                    r_rows,
+                    priority="interactive" if i % 2 else "batch",
+                )
+                with futs_lock:
+                    futs.append((r_rows, f))
+
+        storm_threads = [
+            _threading.Thread(target=_storm, args=(w,)) for w in range(4)
+        ]
+        for t in storm_threads:
+            t.start()
+        for t in storm_threads:
+            t.join()
+        drops = 0
+        for r_rows, f in futs:
+            try:
+                out = f.result(timeout=60)
+            except Exception:  # noqa: BLE001 - counted as a drop
+                drops += 1
+                continue
+            ref = r_rows.reshape(8, -1).astype(np.float64).sum(axis=1)
+            ok &= bool(np.array_equal(out, ref))
+            segs = getattr(f, "segments", None)
+            ok &= bool(segs) and all(
+                "replica" in s and "generation" in s for s in segs
+            )
+        router.close()
+        ok &= drops == 0
+        ok &= reg.counter("serve.router.retried_bins").value >= 1
+        ok &= reg.counter("serve.router.replica_failures").value >= 1
+        extras["chaos_router_zero_drops"] = drops == 0
 
         # 3) Lifecycle plane (ISSUE 8): the journaled state machine
         #    driven through all three injected fault sites, off-device
@@ -984,6 +1162,12 @@ def main() -> None:
         help="skip the serve_frontier latency/throughput sweep "
              "(serve.bucket_sizes x concurrency; one serving compile "
              "per swept bucket)",
+    )
+    parser.add_argument(
+        "--skip_router", action="store_true",
+        help="skip the front-door router scaling rows (ISSUE 12: "
+             "router_k{1,2,4}_images_per_sec over stub replicas + the "
+             "<=2% routed-vs-direct overhead pin; off-device, ~10s)",
     )
     parser.add_argument(
         "--skip_time_to_auc", action="store_true",
@@ -2299,6 +2483,13 @@ def main() -> None:
             except Exception as e:  # pragma: no cover - bench emits JSON
                 _log(f"serve frontier bench failed: "
                      f"{type(e).__name__}: {e}")
+
+    # Front-door router scaling (ISSUE 12): off-device, no compiles.
+    if not args.skip_router:
+        try:
+            _router_bench(extras)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"router bench failed: {type(e).__name__}: {e}")
 
     # Time-to-AUC rows (ISSUE 11): the north-star's FIRST clause lands
     # in the trajectory JSON instead of living only in the side script.

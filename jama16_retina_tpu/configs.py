@@ -503,6 +503,56 @@ class ServeConfig:
     # long a post-swap regression takes to show (the lifecycle WATCH
     # phase), not to "forever".
     rollback_keep_s: float = 900.0
+    # --- Front-door router (ISSUE 12; serve/router.py) -----------------
+    # Engine replicas the Router builds from its replica factory when
+    # none are handed in explicitly (in-process replica handles; the
+    # ReplicaHandle seam is where cross-host replicas plug in later).
+    router_replicas: int = 1
+    # Bin->replica dispatch policy: "least_in_flight" (default; fewest
+    # rows queued+scoring wins) or "bucket_affinity" (prefer a replica
+    # that already served this bucket shape — maximizes per-replica
+    # compile-cache reuse, falls back to least-in-flight among the
+    # warm set).
+    router_policy: str = "least_in_flight"
+    # Dispatch-tick cadence: how often queued rows are re-binned across
+    # bucket boundaries (continuous batching). A full bucket of rows
+    # dispatches at the next tick regardless of which requests
+    # contributed them; only a partial remainder waits out max_wait_ms.
+    router_tick_ms: float = 2.0
+    # Class-aware admission control: total rows the router may hold
+    # queued + in flight (the admitted-unresolved backlog) before
+    # submits shed with typed Overloaded (0 = off). Interactive
+    # requests shed at the full threshold; batch requests shed FIRST,
+    # at router_batch_shed_frac of it.
+    router_shed_rows: int = 0
+    # Fraction of router_shed_rows at which the batch class sheds —
+    # batch scoring yields queue headroom to interactive traffic
+    # before interactive feels anything.
+    router_batch_shed_frac: float = 0.5
+    # Cascade-aware routing: size of the shared full-ensemble
+    # EscalationPool behind student-only replicas (predict.py builds
+    # this wiring when cascade_student_dir is set and --replicas > 1;
+    # most replicas then pay ~1/k FLOPs).
+    router_escalation_replicas: int = 1
+    # Versioned serving-policy artifact (serve/policy.py) derived from
+    # a measured serve_frontier sweep by scripts/derive_serve_policy.py:
+    # when set, bucket sizes / max_batch / max_wait_ms / shed
+    # thresholds still at their dataclass defaults are filled from the
+    # artifact (hand-set knobs always win); a stale model/mesh
+    # fingerprint is refused with typed PolicyStale. Empty = off.
+    policy_from: str = ""
+    # --- Replica autoscaling (serve/scaler.py) -------------------------
+    # Bounds the scaler's desired-replica signal moves within; the
+    # router acts on the signal in-process only when it owns a replica
+    # factory (otherwise the gauge is the product — external
+    # autoscalers read serve.scaler.desired_replicas).
+    scaler_min_replicas: int = 1
+    scaler_max_replicas: int = 8
+    # Tumbling-window seconds one scaling decision observes.
+    scaler_window_s: float = 10.0
+    # p99 request-latency SLO (ms) the scaler treats as a hot signal;
+    # 0 disables the latency input.
+    scaler_slo_p99_ms: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -156,6 +156,19 @@ def reliability_rules(cfg) -> list:
     rules.append(AlertRule(
         "rate(serve.reload_rejected)", ">", 0.0, reason="reload_rejected",
     ))
+    # Front-door router (ISSUE 12): sustained dispatch imbalance means
+    # the policy (or a sick replica) is concentrating load; a latched
+    # scaler-saturation gauge means demand wants more replicas than
+    # serve.scaler_max_replicas allows. Both are inactive until the
+    # router publishes its gauges.
+    rules.append(AlertRule(
+        "serve.router.imbalance", ">", 3.0, for_seconds=60.0,
+        reason="router_imbalance",
+    ))
+    rules.append(AlertRule(
+        "serve.scaler.saturated", ">=", 1.0, for_seconds=120.0,
+        reason="scaler_saturated",
+    ))
     return rules
 
 

@@ -170,6 +170,13 @@ class Snapshotter:
         self._step = int(step)
         self._last_progress_t = time.time()
 
+    def write_record(self, kind: str, **fields) -> None:
+        """One custom JSONL record through the snapshotter's RunLog
+        (e.g. the Router's session report as a ``router`` record —
+        ISSUE 12). RunLog.write is lock-guarded, so this is safe
+        against a concurrent flush cadence."""
+        self._log.write(kind, **fields)
+
     def _prom_path(self) -> str:
         idx = _process_index()
         name = self._prom_name

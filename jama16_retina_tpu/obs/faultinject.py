@@ -70,6 +70,11 @@ SITES = {
                  "the in-flight-save window for kill drills",
     "engine.dispatch": "ServingEngine per-chunk dispatch "
                        "(serve/engine.py)",
+    "serve.router.dispatch": "Router per-bin replica dispatch "
+                             "(serve/router.py; an injected failure "
+                             "kills the replica — its bins retry on "
+                             "siblings with typed accounting, zero "
+                             "dropped requests)",
     "serve.compile_cache.load": "persistent AOT compile-cache entry "
                                 "deserialize (serve/compilecache.py; a "
                                 "failed load degrades to a counted "

@@ -27,6 +27,15 @@ Layers, composable and individually testable:
   * ``compilecache`` — persistent per-(bucket, mesh, dtype, k) AOT
     executable cache: engine restart deserializes in seconds instead
     of re-paying the ~79 s warmup+compile (docs/PERF.md §Cheap-path).
+  * ``router``  — Router (ISSUE 12): the front door above N engine
+    replicas — priority-classed admission, continuous batching across
+    bucket boundaries, retry-on-sibling replica failover, graceful
+    drain, and in-process autoscaling actuation.
+  * ``policy``  — frontier-derived serving policy artifacts
+    (bucket/wait/shed knobs read off a measured serve_frontier sweep;
+    versioned, fingerprint-checked, hand-set knobs win).
+  * ``scaler``  — the pure hysteresis-guarded replica autoscaling
+    policy behind ``serve.scaler.desired_replicas``.
 
 predict.py rides this stack for --device={tpu,cpu}; bench.py's
 ``serve_*`` section measures it under the round-3 fenced discipline.
@@ -49,6 +58,12 @@ from jama16_retina_tpu.serve.engine import (
     ServingEngine,
     resolve_buckets,
 )
+from jama16_retina_tpu.serve.policy import PolicyStale, ServePolicy
+from jama16_retina_tpu.serve.router import (
+    EscalationPool,
+    NoReplicasLeft,
+    Router,
+)
 
 __all__ = [
     "CascadeEngine",
@@ -57,10 +72,15 @@ __all__ = [
     "CompileCacheStale",
     "DeadlineExceeded",
     "DtypeRejected",
+    "EscalationPool",
     "MicroBatcher",
+    "NoReplicasLeft",
     "Overloaded",
+    "PolicyStale",
     "ReloadRejected",
     "RollbackUnavailable",
+    "Router",
+    "ServePolicy",
     "ServingEngine",
     "resolve_buckets",
 ]

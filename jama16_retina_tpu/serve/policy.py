@@ -1,0 +1,325 @@
+"""Frontier-derived serving policy: bucket sizes, coalescing wait, and
+shed thresholds chosen from a MEASURED ``serve_frontier`` sweep instead
+of hand-set constants (ISSUE 12 tentpole, with "Batch Size Influence on
+GPU/TPU Performance", PAPERS.md, as the motivation: the throughput/
+latency frontier of an accelerator is an empirical curve, and policy
+read off the curve beats policy guessed from folklore).
+
+The flow:
+
+  1. ``bench.py`` (not --skip_frontier) sweeps serve.bucket_sizes x
+     offered concurrency and lands the frontier as the
+     ``serve_frontier`` list in its JSON output;
+  2. ``scripts/derive_serve_policy.py`` turns that JSON into a
+     VERSIONED policy artifact (``derive_policy`` + ``save_policy``
+     here): a small JSON file carrying the chosen knobs, a content-hash
+     version string, and the model fingerprint the sweep described;
+  3. ``serve.policy_from=<path>`` loads the artifact
+     (``load_policy`` + ``apply_policy``) at router/predict
+     construction. Hand-set knobs STILL WIN: the policy only fills
+     fields the config carries at their dataclass defaults, so an
+     operator override is never silently clobbered.
+
+Staleness is refused, not absorbed: an artifact derived for a different
+(arch, image_size, head, device-count) raises typed
+:class:`PolicyStale` naming the re-derive command — the same discipline
+the rawshard manifest and the compile cache apply to their fingerprints.
+
+Derivation heuristics (each documented inline; all deterministic —
+``derive_policy`` is a pure function of the sweep, so the same bench
+JSON always yields the same artifact and version hash):
+
+  * ``max_batch``: the smallest swept bucket reaching >= KNEE_FRAC of
+    the sweep's best throughput — past the knee, bigger buckets buy
+    latency, not images/sec;
+  * ``bucket_sizes``: every swept bucket <= max_batch (the ladder the
+    sweep actually measured, so partial windows run a measured shape);
+  * ``max_wait_ms``: half the chosen bucket's p50 at its best
+    concurrency, clamped to [1, 25] ms — waiting longer than ~half a
+    service time to fill a window trades latency for nothing;
+  * ``shed_in_flight`` / ``shed_queue_depth``: multiples of the
+    concurrency where the chosen bucket's throughput peaked — offered
+    load beyond the peak only grows the queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+from absl import logging as absl_logging
+
+FORMAT = "jama16.serve_policy"
+VERSION = 1
+
+# The knee rule: the smallest bucket within this fraction of the
+# sweep's best throughput is chosen as max_batch (module-level so the
+# tests pin against the shipped value).
+KNEE_FRAC = 0.90
+# Shed thresholds as multiples of the peak-throughput concurrency:
+# in-flight requests beyond SHED_IN_FLIGHT_X * peak add queueing, not
+# throughput; the queue cap is looser to absorb bursts.
+SHED_IN_FLIGHT_X = 4
+SHED_QUEUE_X = 8
+
+
+class PolicyStale(RuntimeError):
+    """The policy artifact was derived for a different model/mesh
+    fingerprint (or an incompatible artifact version): serving with it
+    would apply a frontier measured on different shapes. Re-derive with
+    scripts/derive_serve_policy.py against a fresh sweep."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePolicy:
+    """One derived, versioned serving policy (the artifact's typed
+    form). ``version`` is a content hash — two artifacts with the same
+    knobs and fingerprint carry the same version string, so provenance
+    survives copying the file around."""
+
+    bucket_sizes: tuple
+    max_batch: int
+    max_wait_ms: float
+    shed_in_flight: int
+    shed_queue_depth: int
+    fingerprint: dict
+    source: dict
+    version: str = ""
+
+    def payload(self) -> dict:
+        return {
+            "format": FORMAT,
+            "version": VERSION,
+            "bucket_sizes": [int(b) for b in self.bucket_sizes],
+            "max_batch": int(self.max_batch),
+            "max_wait_ms": float(self.max_wait_ms),
+            "shed_in_flight": int(self.shed_in_flight),
+            "shed_queue_depth": int(self.shed_queue_depth),
+            "fingerprint": dict(self.fingerprint),
+            "source": dict(self.source),
+        }
+
+
+def _content_version(payload: dict) -> str:
+    blob = json.dumps(payload, sort_keys=True).encode()
+    return f"sp{VERSION}-{hashlib.sha256(blob).hexdigest()[:10]}"
+
+
+def policy_fingerprint(cfg, n_devices: int = 1) -> dict:
+    """What a frontier sweep is a function of: the model's compiled
+    shapes and the device count the rates were normalized by. A policy
+    carries this; loading it under a different value is refused."""
+    return {
+        "arch": cfg.model.arch,
+        "image_size": int(cfg.model.image_size),
+        "head": cfg.model.head,
+        "n_devices": int(n_devices),
+    }
+
+
+def frontier_from_bench_json(obj: dict) -> list:
+    """Extract the ``serve_frontier`` list from a bench JSON — either
+    bench.py's own output (top-level key) or the archived wrapper form
+    (nested under ``parsed``). Raises when the JSON carries no sweep:
+    deriving policy from nothing must be loud."""
+    for holder in (obj, obj.get("parsed") or {}, obj.get("extras") or {}):
+        if isinstance(holder, dict) and holder.get("serve_frontier"):
+            return list(holder["serve_frontier"])
+    raise ValueError(
+        "bench JSON carries no 'serve_frontier' sweep — run "
+        "bench.py WITHOUT --skip_frontier (and --skip_serve) first"
+    )
+
+
+def derive_policy(frontier: list, fingerprint: dict,
+                  slo_p99_ms: float = 0.0,
+                  source: "dict | None" = None) -> ServePolicy:
+    """Pure derivation of a ServePolicy from frontier sweep rows
+    (``{bucket, concurrency, images_per_sec, p50_ms, p99_ms}``; rows
+    whose rate the physics guard withheld — images_per_sec None — are
+    skipped). ``slo_p99_ms`` > 0 additionally restricts the bucket
+    choice to buckets whose best-throughput point keeps p99 under the
+    SLO; if none qualifies the SLO is ignored, loudly."""
+    points = [
+        p for p in frontier
+        if p.get("images_per_sec") is not None and p.get("bucket")
+    ]
+    if not points:
+        raise ValueError(
+            "serve_frontier sweep has no usable points (all rates "
+            "withheld?) — cannot derive a policy"
+        )
+    # Best (rate, concurrency, p50, p99) per bucket.
+    best: dict = {}
+    for p in points:
+        b = int(p["bucket"])
+        if b not in best or p["images_per_sec"] > best[b]["images_per_sec"]:
+            best[b] = p
+    # SLO first, knee second: restrict to buckets whose best-throughput
+    # point keeps p99 under the SLO, THEN take the smallest bucket
+    # within KNEE_FRAC of that eligible set's peak. An unsatisfiable
+    # SLO falls back to the whole sweep, loudly.
+    eligible = dict(best)
+    if slo_p99_ms > 0:
+        under_slo = {
+            b: p for b, p in best.items()
+            if p.get("p99_ms") is not None and p["p99_ms"] <= slo_p99_ms
+        }
+        if under_slo:
+            eligible = under_slo
+        else:
+            absl_logging.warning(
+                "no frontier bucket meets p99 <= %g ms at its best "
+                "throughput; deriving policy from the knee rule alone",
+                slo_p99_ms,
+            )
+    peak_rate = max(p["images_per_sec"] for p in eligible.values())
+    candidates = sorted(
+        b for b, p in eligible.items()
+        if p["images_per_sec"] >= KNEE_FRAC * peak_rate
+    )
+    max_batch = candidates[0]
+    chosen = best[max_batch]
+    buckets = tuple(sorted(b for b in best if b <= max_batch))
+    p50 = float(chosen.get("p50_ms") or 2.0)
+    max_wait_ms = round(min(25.0, max(1.0, p50 / 2.0)), 2)
+    peak_conc = max(1, int(chosen.get("concurrency") or 1))
+    policy = ServePolicy(
+        bucket_sizes=buckets,
+        max_batch=int(max_batch),
+        max_wait_ms=max_wait_ms,
+        shed_in_flight=SHED_IN_FLIGHT_X * peak_conc,
+        shed_queue_depth=SHED_QUEUE_X * peak_conc,
+        fingerprint=dict(fingerprint),
+        source=dict(source or {}),
+    )
+    return dataclasses.replace(
+        policy, version=_content_version(policy.payload())
+    )
+
+
+def save_policy(path: str, policy: ServePolicy) -> str:
+    """Atomic tmp+rename write of the artifact (the rawshard-manifest
+    discipline: a torn policy file must never parse)."""
+    payload = policy.payload()
+    payload["policy_version"] = (
+        policy.version or _content_version(payload)
+    )
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_policy(path: str) -> ServePolicy:
+    """Load + validate an artifact; refuses unknown formats/versions
+    with :class:`PolicyStale` (an artifact this code cannot interpret
+    must not silently half-apply)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        raise PolicyStale(
+            f"cannot read policy artifact {path}: "
+            f"{type(e).__name__}: {e} — re-derive with "
+            "scripts/derive_serve_policy.py"
+        ) from e
+    if obj.get("format") != FORMAT or obj.get("version") != VERSION:
+        raise PolicyStale(
+            f"policy artifact {path} is "
+            f"{obj.get('format')!r} v{obj.get('version')!r}, this code "
+            f"reads {FORMAT!r} v{VERSION} — re-derive with "
+            "scripts/derive_serve_policy.py"
+        )
+    expected = {
+        "bucket_sizes", "max_batch", "max_wait_ms", "shed_in_flight",
+        "shed_queue_depth", "fingerprint",
+    }
+    missing = expected - set(obj)
+    if missing:
+        raise PolicyStale(
+            f"policy artifact {path} is torn/incomplete (missing "
+            f"{sorted(missing)}) — re-derive with "
+            "scripts/derive_serve_policy.py"
+        )
+    return ServePolicy(
+        bucket_sizes=tuple(int(b) for b in obj["bucket_sizes"]),
+        max_batch=int(obj["max_batch"]),
+        max_wait_ms=float(obj["max_wait_ms"]),
+        shed_in_flight=int(obj["shed_in_flight"]),
+        shed_queue_depth=int(obj["shed_queue_depth"]),
+        fingerprint=dict(obj["fingerprint"]),
+        source=dict(obj.get("source") or {}),
+        version=str(obj.get("policy_version") or ""),
+    )
+
+
+def check_fingerprint(policy: ServePolicy, cfg,
+                      n_devices: int = 1, path: str = "") -> None:
+    """Refuse a policy derived for a different model/mesh: the frontier
+    it encodes was measured on other compiled shapes."""
+    want = policy_fingerprint(cfg, n_devices)
+    if dict(policy.fingerprint) != want:
+        raise PolicyStale(
+            f"policy artifact {path or '(loaded)'} was derived for "
+            f"{policy.fingerprint} but this session runs {want} — "
+            "re-derive with scripts/derive_serve_policy.py against a "
+            "fresh serve_frontier sweep"
+        )
+
+
+def apply_policy(cfg, policy: ServePolicy) -> "tuple[object, list]":
+    """Fill the serving knobs the policy derives into ``cfg.serve``,
+    WITHOUT clobbering anything the operator set explicitly: a field is
+    policy-filled only while it still carries its ServeConfig dataclass
+    default (the "hand-set knobs still win" contract; the applied field
+    list is returned for the session's provenance record)."""
+    from jama16_retina_tpu.configs import ServeConfig
+
+    defaults = ServeConfig()
+    sc = cfg.serve
+    updates: dict = {}
+    if tuple(sc.bucket_sizes) == tuple(defaults.bucket_sizes):
+        updates["bucket_sizes"] = tuple(policy.bucket_sizes)
+    if sc.max_batch == defaults.max_batch:
+        updates["max_batch"] = policy.max_batch
+    if sc.max_wait_ms == defaults.max_wait_ms:
+        updates["max_wait_ms"] = policy.max_wait_ms
+    if sc.shed_in_flight == defaults.shed_in_flight:
+        updates["shed_in_flight"] = policy.shed_in_flight
+    if sc.shed_queue_depth == defaults.shed_queue_depth:
+        updates["shed_queue_depth"] = policy.shed_queue_depth
+    if not updates:
+        return cfg, []
+    new_cfg = cfg.replace(serve=dataclasses.replace(sc, **updates))
+    return new_cfg, sorted(updates)
+
+
+def maybe_apply_policy(cfg, n_devices: int = 1) -> "tuple[object, dict]":
+    """The one entry point sessions call: when ``serve.policy_from``
+    names an artifact, load -> fingerprint-check -> apply, and return
+    (possibly-updated cfg, provenance dict for reports). A config
+    without the knob returns unchanged with empty provenance."""
+    path = cfg.serve.policy_from
+    if not path:
+        return cfg, {}
+    policy = load_policy(path)
+    check_fingerprint(policy, cfg, n_devices=n_devices, path=path)
+    cfg, applied = apply_policy(cfg, policy)
+    absl_logging.info(
+        "serve policy %s applied from %s (fields: %s)",
+        policy.version, path, ", ".join(applied) or "none — all knobs "
+        "hand-set",
+    )
+    return cfg, {
+        "path": path,
+        "version": policy.version,
+        "applied": applied,
+        "source": dict(policy.source),
+    }

@@ -1,0 +1,1105 @@
+"""Front-door router: multi-replica dispatch, priority classes, and
+continuous batching over the serving engines (ISSUE 12 tentpole).
+
+Everything below the ROADMAP's "millions of users" line so far
+terminates in ONE ``ServingEngine``+``MicroBatcher`` pair. This module
+is the layer above — the dataflow front door ("TensorFlow: a system
+for large-scale machine learning", PAPERS.md, is the precedent for
+decoupling the request-routing graph from per-device execution):
+
+  * a :class:`Router` owns N replica handles (in-process today — each
+    wraps its own ``ServingEngine``/``CascadeEngine``; the
+    :class:`ReplicaHandle` duck contract is the seam cross-host
+    replicas plug into later) and dispatches request BATCHES to them
+    by a pluggable policy: ``least_in_flight`` (default) or
+    ``bucket_affinity`` (prefer a replica that already compiled/served
+    this bucket shape — maximizes per-replica compile-cache reuse);
+  * CONTINUOUS BATCHING: submitted requests land in a row queue that
+    the dispatch tick re-bins across bucket boundaries — a bin closes
+    the moment a full bucket of rows exists (whoever they arrived
+    from), and only a partial remainder waits out ``serve.max_wait_ms``
+    — instead of every request waiting on its own fixed window. A
+    request larger than one bin SPLITS across bins (and possibly
+    replicas); its rows never reorder (results reassemble by offset,
+    pinned by tests/test_router.py);
+  * PRIORITY CLASSES: every request is ``interactive`` or ``batch``.
+    Interactive rows bin first each tick, and admission control is
+    class-aware — batch submits shed (typed ``Overloaded``, PR 6's
+    vocabulary) at ``router_batch_shed_frac`` of the row threshold
+    interactive traffic sheds at, so screening batch jobs yield
+    capacity to clinicians before clinicians feel anything;
+  * REPLICA LIFECYCLE: a failed dispatch marks the replica dead and
+    retries its bins on siblings with typed accounting — a mid-storm
+    replica death drops ZERO requests and every response stays
+    attributable to the (replica, generation) that actually served it.
+    ``drain()`` is graceful: a draining replica takes no new bins,
+    finishes what it holds, then releases its engine (and with it the
+    generation handles);
+  * AUTOSCALING: the router samples its own queue/in-flight/latency
+    into tumbling windows and runs ``scaler.decide`` (serve/scaler.py
+    — pure, hysteresis-guarded) each window, publishing the
+    desired-replica gauge ALWAYS and acting on it in-process
+    (activate via the replica factory / drain the newest replica)
+    when it owns a factory.
+
+Cascade-aware routing (the 1/k-FLOPs twist) composes rather than
+nests: build N student-only ``CascadeEngine`` replicas that all share
+one :class:`EscalationPool` — a small pool of full-ensemble engines
+that only sees rows inside the escalation band — and hand those
+cascades to the Router as its replicas. Most replicas then pay student
+FLOPs; the expensive pool is shared and load-balanced.
+
+Observability rides the PR-3/4 stack unchanged: ``serve.router.*`` /
+``serve.scaler.*`` metrics with help strings (glossary in
+docs/OBSERVABILITY.md), a trace span per dispatch tick, and the
+``serve.router.dispatch`` fault site (obs/faultinject.py) that the
+bench ``--chaos`` replica-death drill injects through.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as np
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
+from jama16_retina_tpu.obs.spans import span
+from jama16_retina_tpu.serve import scaler as scaler_lib
+from jama16_retina_tpu.serve.batcher import DeadlineExceeded, Overloaded
+from jama16_retina_tpu.serve.engine import resolve_buckets
+
+PRIORITIES = ("interactive", "batch")
+DISPATCH_POLICIES = ("least_in_flight", "bucket_affinity")
+
+# Replica lifecycle states (ReplicaHandle.state vocabulary; the drain
+# semantics are documented in docs/RELIABILITY.md §Router).
+ACTIVE = "active"
+DRAINING = "draining"
+DRAINED = "drained"
+FAILED = "failed"
+
+_STOP = object()
+
+
+class NoReplicasLeft(RuntimeError):
+    """Every replica is failed/drained: the router has no dispatch
+    target. Requests fail typed (never hang) — the operator condition
+    is a dead fleet, not a slow one."""
+
+
+class ReplicaHandle:
+    """The duck contract a Router replica must satisfy — documented as
+    a class so the cross-host implementation (ROADMAP item 1) has a
+    named seam to fill, but NOT enforced via abc (in-process engines
+    and test stubs satisfy it structurally):
+
+      * ``probs(images) -> scores`` with the engine's row contract
+        (row i in = row i out), and optionally
+        ``probs_with_generation(images) -> (scores, gen_id)`` for
+        response attribution;
+      * optionally a ``generation`` property (defaults to 0).
+
+    ``ServingEngine`` and ``CascadeEngine`` both qualify as-is.
+    """
+
+
+class EscalationPool:
+    """A shared pool of full-ensemble engines behind many student
+    cascades (ISSUE 12's cascade-aware routing): satisfies the
+    CascadeEngine ``ensemble`` contract (``probs`` row-wise), routing
+    each escalation batch to the pool member with the fewest rows in
+    flight. Escalated rows are counted (``serve.router.escalations``)
+    so the 1/k economics stay measurable."""
+
+    def __init__(self, engines, registry: "obs_registry.Registry | None" = None):
+        if not engines:
+            raise ValueError("EscalationPool needs at least one engine")
+        self._engines = list(engines)
+        self._in_flight = [0] * len(self._engines)
+        self._lock = threading.Lock()
+        reg = (registry if registry is not None
+               else obs_registry.default_registry())
+        self._c_rows = reg.counter(
+            "serve.router.escalations",
+            help="rows escalated through the shared full-ensemble pool "
+                 "(cascade-aware routing: student replicas everywhere, "
+                 "expensive escalations pooled)",
+        )
+
+    @property
+    def generation(self) -> int:
+        """The pool's newest member generation (CascadeEngine reads
+        this through its ``ensemble`` half for attribution)."""
+        return max(
+            int(getattr(e, "generation", 0)) for e in self._engines
+        )
+
+    def probs(self, images: np.ndarray) -> np.ndarray:
+        n = int(np.asarray(images).shape[0])
+        with self._lock:
+            idx = min(
+                range(len(self._engines)), key=lambda i: self._in_flight[i]
+            )
+            self._in_flight[idx] += n
+        try:
+            out = self._engines[idx].probs(images)
+        finally:
+            with self._lock:
+                self._in_flight[idx] -= n
+        self._c_rows.inc(n)
+        return out
+
+
+class _Replica:
+    """One in-process replica handle: an engine, its dispatch queue +
+    worker thread, and the accounting the router's policy reads. All
+    mutable counters are guarded by the ROUTER's lock (one lock
+    hierarchy; the replica only owns its queue)."""
+
+    __slots__ = ("rid", "engine", "state", "queue", "in_flight_rows",
+                 "rows", "window_rows", "buckets_served", "thread",
+                 "c_rows")
+
+    def __init__(self, rid: int, engine, registry):
+        self.rid = rid
+        self.engine = engine
+        self.state = ACTIVE
+        self.queue: "queue.Queue" = queue.Queue()
+        self.in_flight_rows = 0   # bins queued or scoring (router lock)
+        self.rows = 0             # rows completed, lifetime
+        self.window_rows = 0      # rows completed this scaler window
+        self.buckets_served: set = set()
+        self.thread: "threading.Thread | None" = None
+        self.c_rows = registry.counter(
+            f"serve.router.replica{rid}.rows",
+            help="rows served by this router replica (per-replica "
+                 "ledger; response attribution pairs it with the "
+                 "generation id)",
+        )
+
+    def score(self, rows: np.ndarray) -> "tuple[np.ndarray, int]":
+        eng = self.engine
+        if hasattr(eng, "probs_with_generation"):
+            out, gen = eng.probs_with_generation(rows)
+            return np.asarray(out), int(gen)
+        out = np.asarray(eng.probs(rows))
+        return out, int(getattr(eng, "generation", 0))
+
+
+class _Request:
+    """One routed request: its rows, class, deadline, and the
+    reassembly state its bins complete into."""
+
+    __slots__ = ("rows", "n", "priority", "future", "t_submit",
+                 "t_deadline", "trace_id", "offset", "parts",
+                 "parts_done", "results", "segments", "failed")
+
+    def __init__(self, rows: np.ndarray, priority: str,
+                 t_deadline: "float | None"):
+        self.rows = rows
+        self.n = int(rows.shape[0])
+        self.priority = priority
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+        self.t_deadline = t_deadline
+        self.trace_id = obs_trace.next_trace_id()
+        self.offset = 0        # rows binned so far (router lock)
+        self.parts = 0         # bins carrying this request's rows
+        self.parts_done = 0
+        self.results: dict = {}    # req-row offset -> scored rows
+        self.segments: list = []   # attribution, in completion order
+        self.failed = False
+
+
+class _Bin:
+    """One dispatch unit: contiguous FIFO rows re-binned from one or
+    more requests, bound for one replica (retried on siblings on
+    dispatch failure — ``tried`` keeps the exclusion set)."""
+
+    __slots__ = ("rows", "parts", "bucket", "tried")
+
+    def __init__(self, rows: np.ndarray, parts: list, bucket: int):
+        self.rows = rows
+        self.parts = parts  # [(request, req_lo, req_hi), ...]
+        self.bucket = bucket
+        self.tried: set = set()
+
+
+class Router:
+    """The front door: ``submit()`` rows with a priority class, get a
+    Future; N replica engines serve re-binned batches behind it.
+
+    ``engines``: the initial replica engines (ReplicaHandle contract).
+    ``replica_factory(rid) -> engine``: how the router builds MORE
+    replicas — when present the scaler's decisions are ACTED on
+    (activate/drain); without one the scaler only publishes its
+    desired-replica gauge. When ``engines`` is None the factory builds
+    ``cfg.serve.router_replicas`` replicas up front.
+
+    The policy artifact seam (``serve.policy_from``) is applied by the
+    CALLER (``policy.maybe_apply_policy``) before construction — the
+    router receives the already-resolved config plus the provenance
+    dict for its report, so the fingerprint check happens exactly once
+    with the caller's device count.
+    """
+
+    def __init__(self, cfg, engines=None, *, replica_factory=None,
+                 registry: "obs_registry.Registry | None" = None,
+                 policy_provenance: "dict | None" = None):
+        sc = cfg.serve
+        if sc.router_policy not in DISPATCH_POLICIES:
+            raise ValueError(
+                f"serve.router_policy must be one of {DISPATCH_POLICIES}, "
+                f"got {sc.router_policy!r}"
+            )
+        if engines is None and replica_factory is None:
+            raise ValueError(
+                "Router needs engines=[...] and/or a replica_factory"
+            )
+        self.cfg = cfg
+        self.dispatch_policy = sc.router_policy
+        self._buckets = resolve_buckets(sc)
+        self.max_wait_s = max(0.0, float(sc.max_wait_ms)) / 1e3
+        self._tick_s = max(5e-4, float(sc.router_tick_ms) / 1e3)
+        self.shed_rows = int(sc.router_shed_rows)
+        self.batch_shed_frac = float(sc.router_batch_shed_frac)
+        if not (0.0 < self.batch_shed_frac <= 1.0):
+            raise ValueError(
+                "serve.router_batch_shed_frac must be in (0, 1], got "
+                f"{self.batch_shed_frac}"
+            )
+        self.registry = (
+            registry if registry is not None
+            else obs_registry.default_registry()
+        )
+        self._policy_provenance = dict(policy_provenance or {})
+        self._factory = replica_factory
+        self._limits = scaler_lib.ScalerLimits(
+            min_replicas=int(sc.scaler_min_replicas),
+            max_replicas=int(sc.scaler_max_replicas),
+            slo_p99_s=max(0.0, float(sc.scaler_slo_p99_ms)) / 1e3,
+        )
+        self._scaler_window_s = max(0.05, float(sc.scaler_window_s))
+
+        reg = self.registry
+        self._c_req_interactive = reg.counter(
+            "serve.router.requests.interactive",
+            help="interactive-class requests admitted by the router",
+        )
+        self._c_req_batch = reg.counter(
+            "serve.router.requests.batch",
+            help="batch-class requests admitted by the router",
+        )
+        self._c_rows = reg.counter(
+            "serve.router.rows",
+            help="request rows admitted by the router (both classes)",
+        )
+        self._g_queue_rows = reg.gauge(
+            "serve.router.queue_rows",
+            help="rows admitted but not yet binned to a replica",
+        )
+        self._g_in_flight_rows = reg.gauge(
+            "serve.router.in_flight_rows",
+            help="rows binned to replicas but not yet resolved (queued "
+                 "+ in-flight is the class-aware shed backlog)",
+        )
+        self._c_dispatches = reg.counter(
+            "serve.router.dispatches",
+            help="bins dispatched to replicas (continuous batching: "
+                 "re-binned across request boundaries each tick)",
+        )
+        self._c_rebins = reg.counter(
+            "serve.router.rebins",
+            help="requests split across more than one dispatch bin "
+                 "(continuous batching across bucket boundaries)",
+        )
+        self._c_retried = reg.counter(
+            "serve.router.retried_bins",
+            help="bins retried on a sibling after a replica dispatch "
+                 "failure (zero-drop contract: typed accounting, the "
+                 "request completes elsewhere)",
+        )
+        self._c_replica_failures = reg.counter(
+            "serve.router.replica_failures",
+            help="replicas marked failed after a dispatch error; their "
+                 "queued bins moved to siblings",
+        )
+        self._c_request_failures = reg.counter(
+            "serve.router.request_failures",
+            help="requests failed after every live replica was tried "
+                 "(or none remained) — the loud end of the retry path",
+        )
+        self._c_shed_interactive = reg.counter(
+            "serve.router.shed.interactive",
+            help="interactive submits rejected Overloaded at the full "
+                 "serve.router_shed_rows threshold",
+        )
+        self._c_shed_batch = reg.counter(
+            "serve.router.shed.batch",
+            help="batch submits rejected Overloaded at "
+                 "router_batch_shed_frac of the row threshold — batch "
+                 "sheds first, interactive keeps the headroom",
+        )
+        self._c_shed_deadline = reg.counter(
+            "serve.router.shed.deadline",
+            help="requests whose deadline passed before any of their "
+                 "rows were binned; failed DeadlineExceeded with no "
+                 "device work spent",
+        )
+        self._c_rejected_closed = reg.counter(
+            "serve.router.rejected_at_close",
+            help="submits refused because the router was already closed",
+        )
+        self._g_active = reg.gauge(
+            "serve.router.active_replicas",
+            help="replicas currently accepting dispatches",
+        )
+        self._g_draining = reg.gauge(
+            "serve.router.draining_replicas",
+            help="replicas finishing in-flight work before release",
+        )
+        self._g_imbalance = reg.gauge(
+            "serve.router.imbalance",
+            help="per-window max/mean completed-row ratio across active "
+                 "replicas (1.0 = perfectly balanced; the "
+                 "router_imbalance alert reads this)",
+        )
+        self._h_latency = reg.histogram(
+            "serve.router.request_latency_s",
+            help="routed end-to-end request latency: submit -> future "
+                 "resolved (all bins reassembled)",
+        )
+        # Pre-registered so the span() call in the tick loop reuses a
+        # help-carrying histogram (span itself registers help-lessly).
+        reg.histogram(
+            "serve.router.tick_s",
+            help="dispatch-tick duration: deadline sweep + re-binning "
+                 "+ replica selection for one tick",
+        )
+        self._g_desired = reg.gauge(
+            "serve.scaler.desired_replicas",
+            help="replica count the autoscaling policy wants "
+                 "(serve/scaler.py decide(); external autoscalers may "
+                 "read this gauge directly)",
+        )
+        self._g_saturated = reg.gauge(
+            "serve.scaler.saturated",
+            help="1 while the scaler wants MORE than "
+                 "serve.scaler_max_replicas allows (the "
+                 "scaler_saturated alert reads this)",
+        )
+        self._c_decisions = reg.counter(
+            "serve.scaler.decisions",
+            help="scaler windows evaluated (every decide() call, "
+                 "including holds)",
+        )
+        self._c_scale_ups = reg.counter(
+            "serve.scaler.scale_ups",
+            help="scale-up decisions issued by the policy (acted on "
+                 "in-process when the router owns a replica factory)",
+        )
+        self._c_scale_downs = reg.counter(
+            "serve.scaler.scale_downs",
+            help="scale-down decisions issued by the policy (acted on "
+                 "as a graceful replica drain)",
+        )
+
+        # One condition guards ALL router mutable state: the request
+        # queues, row accounting, the replica table, and the scaler
+        # window accumulators. Workers take it briefly per bin.
+        self._work = threading.Condition()
+        self._q_interactive: deque = deque()
+        self._q_batch: deque = deque()
+        self._queued_rows = 0
+        self._in_flight_rows = 0
+        self._closed = False
+        self._replicas: "list[_Replica]" = []
+        self._next_rid = 0
+        self._scaler_state = scaler_lib.ScalerState()
+        self._scaler_t0 = time.monotonic()
+        self._scaler_samples: list = []   # (queued_rows, in_flight_rows)
+        self._window_lat: list = []       # completed latencies (sec)
+        # Bounded decision ledger (the REPLICA_ROWS_KEEP discipline): a
+        # long-lived front door must not grow one dict per scaler
+        # window forever; render/report only ever need the recent tail.
+        self._ledger: deque = deque(maxlen=self.SCALER_LEDGER_KEEP)
+        # Row shape/dtype pinned from the FIRST submit: rows from
+        # different requests concatenate into one bin, so a mismatched
+        # submit must be rejected AT SUBMIT (typed, at the caller) —
+        # not explode np.concatenate inside the dispatch tick.
+        self._row_shape: "tuple | None" = None
+        self._row_dtype = None
+
+        if engines is None:
+            n = max(1, int(sc.router_replicas))
+            engines = [replica_factory(r) for r in range(n)]
+        with self._work:
+            for eng in engines:
+                self._add_replica_locked(eng)
+        self._g_desired.set(len(engines))
+
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name="jama16-serve-router", daemon=True
+        )
+        self._tick_thread.start()
+
+    # How many per-replica row ledgers stay exported: a fleet churning
+    # replicas through the scaler must not grow one counter per
+    # activation forever (the engine's GEN_ROWS_KEEP discipline).
+    REPLICA_ROWS_KEEP = 8
+    # Scaler decisions retained for the report (obs_report renders the
+    # tail; the full history lives in telemetry gauges over time).
+    SCALER_LEDGER_KEEP = 256
+
+    # -- replica table (all *_locked: caller holds self._work) -------------
+
+    def _add_replica_locked(self, engine) -> "_Replica":
+        retire = self._next_rid - self.REPLICA_ROWS_KEEP
+        if retire >= 0 and not any(
+                r.rid == retire and r.state in (ACTIVE, DRAINING)
+                for r in self._replicas):
+            self.registry.remove(f"serve.router.replica{retire}.rows")
+        rep = _Replica(self._next_rid, engine, self.registry)
+        self._next_rid += 1
+        self._replicas.append(rep)
+        rep.thread = threading.Thread(
+            target=self._worker, args=(rep,),
+            name=f"jama16-router-replica-{rep.rid}", daemon=True,
+        )
+        rep.thread.start()
+        self._update_replica_gauges_locked()
+        return rep
+
+    def _update_replica_gauges_locked(self) -> None:
+        self._g_active.set(
+            sum(1 for r in self._replicas if r.state == ACTIVE)
+        )
+        self._g_draining.set(
+            sum(1 for r in self._replicas if r.state == DRAINING)
+        )
+
+    def _active_locked(self) -> "list[_Replica]":
+        return [r for r in self._replicas if r.state == ACTIVE]
+
+    def _maybe_finish_drain_locked(self, rep: "_Replica") -> None:
+        """A draining replica with nothing queued and nothing in
+        flight is DONE: release its engine (and with it the generation
+        handles) and stop its worker."""
+        if (rep.state == DRAINING and rep.in_flight_rows == 0
+                and rep.queue.empty()):
+            rep.state = DRAINED
+            rep.engine = None
+            rep.queue.put(_STOP)
+            self._update_replica_gauges_locked()
+            absl_logging.info(
+                "router replica %d drained; engine released", rep.rid
+            )
+
+    # -- admission (class-aware shedding; ISSUE 12) ------------------------
+
+    def submit(self, rows: np.ndarray, priority: str = "interactive",
+               deadline_ms: "float | None" = None) -> Future:
+        """Enqueue ``rows`` ([n, ...], n >= 1) under a priority class;
+        the Future resolves to the per-row scores in row order (bins
+        reassembled by offset). The resolved Future additionally
+        carries ``.segments`` — ``[{lo, hi, replica, generation}, ...]``
+        — so every response row is attributable to the replica and
+        model generation that served it.
+
+        Raises typed ``Overloaded`` (PR 6) at the class-aware row
+        threshold: batch sheds at ``router_batch_shed_frac`` of
+        ``serve.router_shed_rows``, interactive at the full threshold.
+        ``deadline_ms`` falls back to ``serve.default_deadline_ms``; an
+        expired request that never binned fails ``DeadlineExceeded``
+        with zero device work spent."""
+        rows = np.asarray(rows)
+        if rows.ndim < 1 or rows.shape[0] == 0:
+            raise ValueError(
+                f"submit() wants [n, ...] with n >= 1, got {rows.shape}"
+            )
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}"
+            )
+        if deadline_ms is None:
+            deadline_ms = self.cfg.serve.default_deadline_ms
+        n = int(rows.shape[0])
+        with self._work:
+            if self._closed:
+                self._c_rejected_closed.inc()
+                raise RuntimeError("Router is closed")
+            if self._row_shape is None:
+                self._row_shape = rows.shape[1:]
+                self._row_dtype = rows.dtype
+            elif (rows.shape[1:] != self._row_shape
+                  or rows.dtype != self._row_dtype):
+                raise ValueError(
+                    f"submit() rows must be [n, {self._row_shape}] "
+                    f"{self._row_dtype} (pinned by this router's first "
+                    f"request), got {rows.shape} {rows.dtype} — "
+                    "rejected at submit so a malformed request cannot "
+                    "poison the bins it would coalesce into"
+                )
+            if self.shed_rows > 0:
+                threshold = (
+                    self.shed_rows if priority == "interactive"
+                    else max(1, int(self.shed_rows * self.batch_shed_frac))
+                )
+                # Backlog = queued + in flight: continuous batching
+                # moves rows onto replica queues at tick speed, so the
+                # queue alone never shows sustained overload — the
+                # admitted-unresolved total does (the batcher's
+                # shed_in_flight lesson, in rows).
+                backlog = self._queued_rows + self._in_flight_rows
+                if backlog + n > threshold:
+                    if priority == "interactive":
+                        self._c_shed_interactive.inc()
+                    else:
+                        self._c_shed_batch.inc()
+                    raise Overloaded(
+                        f"{backlog} rows queued/in-flight + {n} new > "
+                        f"{priority} shed threshold {threshold} "
+                        f"(serve.router_shed_rows={self.shed_rows}, "
+                        f"batch frac {self.batch_shed_frac:g}); request "
+                        "shed at submit"
+                    )
+            req = _Request(
+                rows, priority,
+                t_deadline=(time.monotonic() + deadline_ms / 1e3
+                            if deadline_ms and deadline_ms > 0 else None),
+            )
+            (self._q_interactive if priority == "interactive"
+             else self._q_batch).append(req)
+            self._queued_rows += n
+            self._g_queue_rows.set(self._queued_rows)
+            (self._c_req_interactive if priority == "interactive"
+             else self._c_req_batch).inc()
+            self._c_rows.inc(n)
+            self._work.notify_all()
+        return req.future
+
+    def probs(self, images: np.ndarray,
+              priority: str = "interactive") -> np.ndarray:
+        """Blocking convenience: submit + result."""
+        return self.submit(images, priority=priority).result()
+
+    # -- the dispatch tick (continuous batching) ---------------------------
+
+    def _tick_loop(self) -> None:
+        while True:
+            with self._work:
+                if self._closed and not self._queued_rows:
+                    return
+                if not self._queued_rows:
+                    self._work.wait(timeout=self._tick_s)
+                if self._closed and not self._queued_rows:
+                    return
+            with span("serve.router.tick_s", self.registry):
+                assignments = []
+                with self._work:
+                    try:
+                        self._expire_deadlines_locked(time.monotonic())
+                        assignments = self._pack_locked(time.monotonic())
+                    except Exception as e:  # noqa: BLE001 - tick survives
+                        # Belt behind the submit-time shape pin: a pack
+                        # failure fails the queued requests TYPED and
+                        # the tick loop lives on — a wedged dispatch
+                        # thread would hang every future forever.
+                        absl_logging.error(
+                            "router pack failed; failing queued "
+                            "requests: %s: %s", type(e).__name__, e,
+                        )
+                        self._fail_all_queued_locked(e)
+                    self._scaler_sample_locked()
+                    # Enqueue UNDER the lock: a replica selected above
+                    # cannot transition to FAILED (and drain its queue)
+                    # between selection and this put — an unlocked put
+                    # could strand the bin on a dead replica's queue
+                    # forever. queue.put is unbounded, it never blocks.
+                    for rep, b in assignments:
+                        rep.queue.put(b)
+            try:
+                self._maybe_scale()
+            except Exception as e:  # noqa: BLE001 - tick must survive
+                absl_logging.error(
+                    "router scaler actuation failed (tick loop "
+                    "continues): %s: %s", type(e).__name__, e,
+                )
+            if not assignments:
+                # Nothing dispatchable: don't spin at CPU speed while a
+                # partial bin waits out max_wait_ms.
+                time.sleep(self._tick_s / 4)
+
+    def _expire_deadlines_locked(self, now: float) -> None:
+        """Fail never-binned expired requests typed, before any device
+        work; partially-binned requests are past the point of cheap
+        refusal and complete normally (late but whole)."""
+        for q in (self._q_interactive, self._q_batch):
+            kept = deque()
+            while q:
+                req = q.popleft()
+                if (req.offset == 0 and req.t_deadline is not None
+                        and now > req.t_deadline):
+                    self._queued_rows -= req.n
+                    self._c_shed_deadline.inc()
+                    try:
+                        req.future.set_exception(DeadlineExceeded(
+                            f"deadline passed {now - req.t_deadline:.3f}s "
+                            "before any row was binned; no device work "
+                            "was spent"
+                        ))
+                    except InvalidStateError:
+                        pass
+                else:
+                    kept.append(req)
+            q.extend(kept)
+        self._g_queue_rows.set(self._queued_rows)
+
+    def _pack_locked(self, now: float) -> list:
+        """Re-bin queued rows across request boundaries into dispatch
+        bins (interactive rows first), assign each bin a replica by the
+        dispatch policy, and account it in flight. Returns
+        [(replica, bin), ...] for the caller to enqueue outside the
+        lock."""
+        out = []
+        while self._queued_rows > 0:
+            total = self._queued_rows
+            if total >= self._buckets[-1]:
+                take = self._buckets[-1]
+            else:
+                # Partial remainder: dispatch only once the oldest
+                # unbinned request has waited out the coalescing
+                # window (or the router is closing and must flush).
+                oldest = None
+                for q in (self._q_interactive, self._q_batch):
+                    for req in q:
+                        if req.offset < req.n and (
+                                oldest is None
+                                or req.t_submit < oldest):
+                            oldest = req.t_submit
+                if oldest is None:
+                    break
+                if not self._closed and now - oldest < self.max_wait_s:
+                    break
+                take = total
+            reps = self._active_locked()
+            if not reps:
+                self._fail_all_queued_locked(NoReplicasLeft(
+                    "no active replicas to dispatch to"
+                ))
+                break
+            b = self._make_bin_locked(take)
+            rep = self._choose_replica_locked(reps, b)
+            b.tried.add(rep.rid)
+            rep.in_flight_rows += b.rows.shape[0]
+            self._in_flight_rows += b.rows.shape[0]
+            self._c_dispatches.inc()
+            out.append((rep, b))
+        self._g_queue_rows.set(self._queued_rows)
+        self._g_in_flight_rows.set(self._in_flight_rows)
+        return out
+
+    def _make_bin_locked(self, take: int) -> "_Bin":
+        """Cut ``take`` rows FIFO (interactive queue first) into one
+        bin, splitting requests at the boundary; fully-binned requests
+        leave their queue."""
+        parts = []
+        chunks = []
+        remaining = take
+        for q in (self._q_interactive, self._q_batch):
+            while remaining > 0 and q:
+                req = q[0]
+                lo = req.offset
+                hi = min(req.n, lo + remaining)
+                chunks.append(req.rows[lo:hi])
+                parts.append((req, lo, hi))
+                req.offset = hi
+                req.parts += 1
+                if req.parts == 2:  # counted once, at the first split
+                    self._c_rebins.inc()
+                remaining -= hi - lo
+                if req.offset >= req.n:
+                    q.popleft()
+                else:
+                    break  # bin boundary landed inside this request
+            if remaining == 0:
+                break
+        self._queued_rows -= take
+        rows = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+        bucket = next(
+            (bk for bk in self._buckets if bk >= rows.shape[0]),
+            self._buckets[-1],
+        )
+        return _Bin(rows, parts, bucket)
+
+    def _choose_replica_locked(self, reps: "list[_Replica]",
+                               b: "_Bin") -> "_Replica":
+        if self.dispatch_policy == "bucket_affinity":
+            warm = [r for r in reps if b.bucket in r.buckets_served]
+            if warm:
+                reps = warm
+        return min(reps, key=lambda r: (r.in_flight_rows, r.rid))
+
+    def _purge_request_locked(self, req: "_Request") -> None:
+        """Drop a failed request's still-unbinned remainder from the
+        queues (its completed/in-flight bins just no-op at resolution:
+        ``req.failed`` gates set_result)."""
+        for q in (self._q_interactive, self._q_batch):
+            if req in q:
+                q.remove(req)
+                self._queued_rows -= req.n - req.offset
+        self._g_queue_rows.set(self._queued_rows)
+
+    def _fail_all_queued_locked(self, exc: BaseException) -> None:
+        for q in (self._q_interactive, self._q_batch):
+            while q:
+                req = q.popleft()
+                self._queued_rows -= req.n - req.offset
+                req.failed = True
+                self._c_request_failures.inc()
+                try:
+                    req.future.set_exception(exc)
+                except InvalidStateError:
+                    pass
+        self._g_queue_rows.set(self._queued_rows)
+
+    # -- replica workers ---------------------------------------------------
+
+    def _worker(self, rep: "_Replica") -> None:
+        while True:
+            item = rep.queue.get()
+            if item is _STOP:
+                return
+            b: _Bin = item
+            try:
+                # Fault seam (obs/faultinject.py "serve.router.dispatch"):
+                # one global read + branch unarmed; the --chaos drill
+                # injects a replica death here mid-storm.
+                faultinject.check("serve.router.dispatch")
+                out, gen = rep.score(b.rows)
+                if out.shape[0] != b.rows.shape[0]:
+                    raise RuntimeError(
+                        f"replica {rep.rid} returned {out.shape[0]} rows "
+                        f"for {b.rows.shape[0]} inputs — row contract "
+                        "broken"
+                    )
+            except BaseException as e:  # noqa: BLE001 - retried/typed
+                self._on_dispatch_failure(rep, b, e)
+                if rep.state == FAILED:
+                    return
+                continue
+            self._complete_bin(rep, b, out, gen)
+
+    def _complete_bin(self, rep: "_Replica", b: "_Bin",
+                      out: np.ndarray, gen: int) -> None:
+        n = int(b.rows.shape[0])
+        done = []
+        with self._work:
+            rep.in_flight_rows -= n
+            rep.rows += n
+            rep.window_rows += n
+            rep.buckets_served.add(b.bucket)
+            self._in_flight_rows -= n
+            self._g_in_flight_rows.set(self._in_flight_rows)
+            lo = 0
+            for req, req_lo, req_hi in b.parts:
+                seg = out[lo:lo + (req_hi - req_lo)]
+                lo += req_hi - req_lo
+                req.results[req_lo] = seg
+                req.segments.append({
+                    "lo": req_lo, "hi": req_hi,
+                    "replica": rep.rid, "generation": int(gen),
+                })
+                req.parts_done += 1
+                if (req.offset >= req.n and req.parts_done == req.parts
+                        and not req.failed):
+                    done.append(req)
+            self._maybe_finish_drain_locked(rep)
+            self._work.notify_all()
+        rep.c_rows.inc(n)
+        now = time.monotonic()
+        for req in done:
+            pieces = [req.results[k] for k in sorted(req.results)]
+            result = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+            req.segments.sort(key=lambda s: s["lo"])
+            req.future.segments = req.segments
+            try:
+                req.future.set_result(result)
+                self._h_latency.observe(now - req.t_submit)
+                with self._work:
+                    self._window_lat.append(now - req.t_submit)
+            except InvalidStateError:
+                pass
+
+    def _on_dispatch_failure(self, rep: "_Replica", b: "_Bin",
+                             exc: BaseException) -> None:
+        """A replica died mid-dispatch: mark it failed, move its bins
+        (this one + everything still queued behind it) to siblings with
+        typed accounting — zero dropped requests as long as one live
+        replica remains."""
+        moved = [b]
+        orphaned_reqs = []
+        with self._work:
+            if rep.state in (ACTIVE, DRAINING):
+                rep.state = FAILED
+                self._c_replica_failures.inc()
+                self._update_replica_gauges_locked()
+                absl_logging.error(
+                    "router replica %d failed dispatching %d rows "
+                    "(%s: %s); retrying on siblings",
+                    rep.rid, b.rows.shape[0], type(exc).__name__, exc,
+                )
+            rep.engine = None
+            while True:
+                try:
+                    item = rep.queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _STOP:
+                    moved.append(item)
+            seen_failed = set()
+            for mb in moved:
+                n = int(mb.rows.shape[0])
+                rep.in_flight_rows -= n
+                reps = [
+                    r for r in self._active_locked()
+                    if r.rid not in mb.tried
+                ]
+                if not reps:
+                    # Orphan bin: retries exhausted. Fail each carried
+                    # request ONCE (a request may span several orphan
+                    # bins) and purge its still-unbinned remainder from
+                    # the queues — no more device work is spent on a
+                    # caller that already holds an exception.
+                    self._in_flight_rows -= n
+                    for req, _lo, _hi in mb.parts:
+                        if id(req) in seen_failed or req.failed:
+                            continue
+                        seen_failed.add(id(req))
+                        req.failed = True
+                        self._c_request_failures.inc()
+                        self._purge_request_locked(req)
+                        orphaned_reqs.append(req)
+                    continue
+                target = self._choose_replica_locked(reps, mb)
+                mb.tried.add(target.rid)
+                target.in_flight_rows += n
+                self._c_retried.inc()
+                # Under the lock for the same reason as the tick-loop
+                # puts: the target must not fail-and-drain between
+                # selection and enqueue.
+                target.queue.put(mb)
+            self._g_in_flight_rows.set(self._in_flight_rows)
+            self._work.notify_all()
+        for req in orphaned_reqs:
+            try:
+                req.future.set_exception(exc)
+            except InvalidStateError:
+                pass
+
+    # -- autoscaling (serve/scaler.py signals + in-process actuation) ------
+
+    def _scaler_sample_locked(self) -> None:
+        self._scaler_samples.append(
+            (self._queued_rows, self._in_flight_rows)
+        )
+
+    def _maybe_scale(self) -> None:
+        now = time.monotonic()
+        build_engine_for = None
+        drain_rid = None
+        with self._work:
+            window = now - self._scaler_t0
+            if window < self._scaler_window_s:
+                return
+            samples = self._scaler_samples or [(0, 0)]
+            lat = sorted(self._window_lat)
+            # Nearest-rank p99: for small windows this is the max — a
+            # low-traffic SLO breach must register, not vanish into an
+            # interpolated underestimate.
+            p99 = lat[
+                min(len(lat) - 1,
+                    max(0, int(np.ceil(0.99 * len(lat))) - 1))
+            ] if lat else 0.0
+            stats = scaler_lib.ScalerStats(
+                window_sec=window,
+                queue_rows=float(np.mean([s[0] for s in samples])),
+                in_flight_rows=float(np.mean([s[1] for s in samples])),
+                p99_latency_s=float(p99),
+            )
+            active = len(self._active_locked())
+            decision = scaler_lib.decide(
+                stats, active, self.cfg.serve.max_batch,
+                self._scaler_state, self._limits,
+            )
+            self._scaler_state = decision.state
+            self._scaler_t0 = now
+            self._scaler_samples = []
+            self._window_lat = []
+            self._c_decisions.inc()
+            self._g_desired.set(decision.desired)
+            self._g_saturated.set(1.0 if decision.saturated else 0.0)
+            # Imbalance: completed-row spread across active replicas
+            # this window (the router_imbalance alert's gauge).
+            window_rows = [
+                r.window_rows for r in self._replicas if r.state == ACTIVE
+            ]
+            mean_rows = float(np.mean(window_rows)) if window_rows else 0.0
+            self._g_imbalance.set(
+                float(max(window_rows) / mean_rows)
+                if mean_rows > 0 else 1.0
+            )
+            for r in self._replicas:
+                r.window_rows = 0
+            self._ledger.append({
+                "t": time.time(),
+                "active": active,
+                "desired": decision.desired,
+                "reason": decision.reason,
+                "queue_rows": round(stats.queue_rows, 1),
+                "in_flight_rows": round(stats.in_flight_rows, 1),
+                "p99_latency_ms": round(stats.p99_latency_s * 1e3, 2),
+            })
+            if decision.desired > active:
+                self._c_scale_ups.inc()
+                if self._factory is not None and not self._closed:
+                    build_engine_for = self._next_rid
+            elif decision.desired < active:
+                self._c_scale_downs.inc()
+                if self._factory is not None:
+                    # Drain the NEWEST active replica: oldest replicas
+                    # hold the warmest compile caches.
+                    act = self._active_locked()
+                    if len(act) > 1:
+                        drain_rid = act[-1].rid
+        if build_engine_for is not None:
+            try:
+                engine = self._factory(build_engine_for)
+            except Exception as e:  # noqa: BLE001 - scaling must not kill
+                absl_logging.error(
+                    "replica factory failed for replica %d: %s: %s",
+                    build_engine_for, type(e).__name__, e,
+                )
+                return
+            with self._work:
+                if not self._closed:
+                    self._add_replica_locked(engine)
+        elif drain_rid is not None:
+            try:
+                self.drain_replica(drain_rid)
+            except ValueError as e:
+                # A replica failed between the decision and the drain,
+                # leaving drain_rid the last active one — hold instead.
+                absl_logging.info("scale-down skipped: %s", e)
+
+    def drain_replica(self, rid: int) -> None:
+        """Graceful drain: the replica takes no new bins, finishes its
+        queued/in-flight work, then releases its engine (generation
+        handles included). Refuses to drain the last active replica."""
+        with self._work:
+            rep = next(
+                (r for r in self._replicas if r.rid == rid), None
+            )
+            if rep is None or rep.state != ACTIVE:
+                return
+            if len(self._active_locked()) <= 1:
+                raise ValueError(
+                    "refusing to drain the last active replica — the "
+                    "router would have no dispatch target"
+                )
+            rep.state = DRAINING
+            self._update_replica_gauges_locked()
+            self._maybe_finish_drain_locked(rep)
+            absl_logging.info("router replica %d draining", rid)
+
+    # -- reports / lifecycle -----------------------------------------------
+
+    def replica_states(self) -> list:
+        """Snapshot of the replica table (tests + the report)."""
+        with self._work:
+            return [
+                {
+                    "replica": r.rid, "state": r.state,
+                    "rows": r.rows, "in_flight_rows": r.in_flight_rows,
+                    "buckets": sorted(r.buckets_served),
+                    "generation": (
+                        int(getattr(r.engine, "generation", 0))
+                        if r.engine is not None else None
+                    ),
+                }
+                for r in self._replicas
+            ]
+
+    def scaler_ledger(self) -> list:
+        with self._work:
+            return list(self._ledger)
+
+    def report(self) -> dict:
+        """The router's session report — what predict.py journals as a
+        ``router`` record and scripts/obs_report.py renders: replica
+        ledger, priority/shed split, re-binning + retry accounting, the
+        scaler decision ledger, and the policy provenance."""
+        return {
+            "dispatch_policy": self.dispatch_policy,
+            "buckets": [int(b) for b in self._buckets],
+            "policy": dict(self._policy_provenance) or None,
+            "replicas": self.replica_states(),
+            "requests": {
+                "interactive": int(self._c_req_interactive.value),
+                "batch": int(self._c_req_batch.value),
+            },
+            "shed": {
+                "interactive": int(self._c_shed_interactive.value),
+                "batch": int(self._c_shed_batch.value),
+                "deadline": int(self._c_shed_deadline.value),
+            },
+            "rows": int(self._c_rows.value),
+            "dispatches": int(self._c_dispatches.value),
+            "rebins": int(self._c_rebins.value),
+            "retried_bins": int(self._c_retried.value),
+            "replica_failures": int(self._c_replica_failures.value),
+            # Snapshot read, NOT counter(): a router without an
+            # EscalationPool must not register (and so export) a
+            # spurious always-zero escalations series as a side effect
+            # of its own report.
+            "escalations": int(self.registry.snapshot().get(
+                "counters", {}
+            ).get("serve.router.escalations", 0)),
+            "scaler": self.scaler_ledger(),
+        }
+
+    def close(self) -> None:
+        """Stop accepting, flush everything queued, join workers."""
+        with self._work:
+            if self._closed:
+                return
+            self._closed = True
+            self._work.notify_all()
+        self._tick_thread.join()
+        # The tick loop exits only once the queues are empty; every bin
+        # is on (or moving between) replica queues. Wait for the last
+        # in-flight bin to resolve BEFORE stopping workers: a failure
+        # retry re-enqueues on a sibling, and that bin must never land
+        # behind the sibling's _STOP.
+        with self._work:
+            while self._in_flight_rows > 0:
+                self._work.wait(timeout=0.05)
+            reps = list(self._replicas)
+        for rep in reps:
+            rep.queue.put(_STOP)
+        for rep in reps:
+            if rep.thread is not None:
+                rep.thread.join()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,146 @@
+"""Replica autoscaling signals: a pure, hysteresis-guarded policy over
+the router's own load gauges (ISSUE 12).
+
+The scaling question — "how many engine replicas should be serving?" —
+is answered the way the ingest autotuner (data/autotune.py) answers its
+knob questions: a PURE ``decide()`` over tumbling-window statistics,
+with hysteresis so a stationary workload converges and stays converged.
+Same stats in, same decision out — which is what lets the tests pin
+exact decision sequences, and what makes the desired-replica gauge
+trustworthy as an external autoscaling signal (a k8s HPA reading
+``serve.scaler.desired_replicas`` sees policy, not noise).
+
+The router drives this at its tick cadence and ACTS on the output
+in-process (activate / drain replicas) when it owns a replica factory;
+without one the signals still publish — the gauge is the product, the
+in-process actuation is the proof it closes.
+
+Hysteresis shape (constants module-level so tests pin shipped values):
+
+  * scale UP needs ``HOT_WINDOWS`` consecutive hot windows — a window
+    is hot when the queue backlog exceeds ``QUEUE_HIGH`` of one
+    dispatch wave's capacity, in-flight utilization exceeds
+    ``IN_FLIGHT_HIGH``, or the p99 latency breaches the SLO;
+  * scale DOWN needs ``QUIET_WINDOWS`` consecutive quiet windows
+    (empty queue, utilization under ``IN_FLIGHT_LOW``, p99 under half
+    the SLO) — the same decay discipline the autotuner applies;
+  * the band between holds still AND resets both streaks (windows must
+    be consecutive);
+  * one replica per decision, bounded by [min_replicas, max_replicas];
+    a decision pinned at max_replicas while still hot reports
+    ``saturated`` — the condition the scaler-saturation alert reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# --- Policy constants (pinned by tests/test_router.py) ------------------
+QUEUE_HIGH = 0.5       # queued rows > this fraction of one dispatch
+                       # wave (active * max_batch) = backlog building
+IN_FLIGHT_HIGH = 0.75  # in-flight rows / capacity above = replicas busy
+IN_FLIGHT_LOW = 0.25   # below (with an empty queue) = over-provisioned
+HOT_WINDOWS = 2        # consecutive hot windows before one scale-up
+QUIET_WINDOWS = 3      # consecutive quiet windows before one scale-down
+MIN_WINDOW_S = 0.05    # shorter windows carry no usable signal
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalerStats:
+    """One tumbling window's load signals, normalized by the router:
+    mean queued rows, mean in-flight rows, and the window's p99 request
+    latency (0 = unknown/no requests)."""
+
+    window_sec: float
+    queue_rows: float
+    in_flight_rows: float
+    p99_latency_s: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalerState:
+    """Controller memory threaded through ``decide`` — explicit state
+    keeps the decision function pure (the autotuner's ControlState
+    pattern)."""
+
+    hot_windows: int = 0
+    quiet_windows: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalerLimits:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # p99 SLO in seconds; 0 disables the latency signal.
+    slo_p99_s: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalerDecision:
+    desired: int
+    state: ScalerState
+    reason: str
+    saturated: bool = False
+
+
+def decide(stats: ScalerStats, active: int, max_batch: int,
+           state: ScalerState, limits: ScalerLimits) -> ScalerDecision:  # graftlint: deterministic
+    """One pure scaling decision (same stats, same state -> same
+    decision; no clocks, no RNG — pinned by tests/test_router.py).
+
+    ``active`` is the replica count the window's stats describe;
+    ``max_batch`` sizes one dispatch wave. The returned ``desired`` is
+    at most one step from ``active`` and always inside the limits."""
+    active = max(1, int(active))
+    lo = max(1, int(limits.min_replicas))
+    hi = max(lo, int(limits.max_replicas))
+    clamped = min(hi, max(lo, active))
+    if stats.window_sec < MIN_WINDOW_S:
+        return ScalerDecision(clamped, state, "window_too_short")
+    capacity = float(active * max(1, int(max_batch)))
+    in_flight_frac = stats.in_flight_rows / capacity
+    slo = float(limits.slo_p99_s)
+    slo_hot = slo > 0 and stats.p99_latency_s > slo
+    hot = (
+        stats.queue_rows > QUEUE_HIGH * capacity
+        or in_flight_frac > IN_FLIGHT_HIGH
+        or slo_hot
+    )
+    quiet = (
+        stats.queue_rows == 0
+        and in_flight_frac < IN_FLIGHT_LOW
+        and (slo <= 0 or stats.p99_latency_s < 0.5 * slo)
+    )
+    if hot:
+        streak = state.hot_windows + 1
+        if streak >= HOT_WINDOWS:
+            if clamped >= hi:
+                # Still hot at the ceiling: hold, report saturation
+                # (the alert-rule condition), keep the streak so the
+                # signal stays loud every window.
+                return ScalerDecision(
+                    hi, ScalerState(hot_windows=min(streak, HOT_WINDOWS)),
+                    "saturated_at_max", saturated=True,
+                )
+            return ScalerDecision(
+                min(hi, clamped + 1), ScalerState(),
+                "scale_up:" + ("slo_p99" if slo_hot else
+                               "queue" if stats.queue_rows
+                               > QUEUE_HIGH * capacity else "in_flight"),
+            )
+        return ScalerDecision(
+            clamped, ScalerState(hot_windows=streak), "hot_streak"
+        )
+    if quiet:
+        streak = state.quiet_windows + 1
+        if streak >= QUIET_WINDOWS and clamped > lo:
+            return ScalerDecision(
+                clamped - 1, ScalerState(), "scale_down:quiet"
+            )
+        return ScalerDecision(
+            clamped, ScalerState(quiet_windows=min(streak, QUIET_WINDOWS)),
+            "quiet_streak",
+        )
+    # The hysteresis band: hold, and reset both streaks — hot/quiet
+    # evidence must be CONSECUTIVE to move the replica count.
+    return ScalerDecision(clamped, ScalerState(), "hold")

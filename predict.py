@@ -92,6 +92,25 @@ _HOST_WORKERS = flags.DEFINE_integer(
     "derives one per host core up to 8. Output is worker-count-"
     "invariant, so this is a pure throughput knob",
 )
+_REPLICAS = flags.DEFINE_integer(
+    "replicas", 0,
+    "serve this batch through the front-door Router (serve/router.py) "
+    "over N in-process engine replicas: continuous batching across "
+    "bucket boundaries, class-aware admission, replica failover. 0 "
+    "(default) keeps the direct single-engine path; --replicas 1 is "
+    "byte-identical JSONL to it (pinned by tests/test_router.py). "
+    "With serve.cascade_student_dir set, replicas are student-only "
+    "cascades sharing one full-ensemble EscalationPool of "
+    "serve.router_escalation_replicas engines. Quality monitoring "
+    "lives on replica 0 (at --replicas 1 that is the whole fleet); "
+    "tpu/cpu devices only",
+)
+_PRIORITY = flags.DEFINE_enum(
+    "priority", "interactive", ["interactive", "batch"],
+    "router priority class for this batch (only with --replicas): "
+    "batch-class traffic sheds first under overload "
+    "(serve.router_shed_rows x serve.router_batch_shed_frac)",
+)
 _OBS_WORKDIR = flags.DEFINE_string(
     "obs_workdir", "",
     "emit `telemetry` + per-process `heartbeat` JSONL records (and the "
@@ -103,6 +122,65 @@ _OBS_WORKDIR = flags.DEFINE_string(
 )
 
 _EXTS = (".jpg", ".jpeg", ".png", ".tif", ".tiff", ".bmp")
+
+
+def _router_replica_engines(cfg, dirs, model, n):
+    """The Router's in-process replica engines (ISSUE 12): N plain
+    ensemble engines, or — with ``serve.cascade_student_dir`` — N
+    student-only cascades sharing ONE full-ensemble
+    :class:`EscalationPool` of ``serve.router_escalation_replicas``
+    engines, so most replicas pay ~1/k FLOPs while escalations pool.
+
+    Quality observability lives on replica 0 only: one monitor, one
+    canary cadence, no same-name gauge interleaving across replicas
+    (at --replicas 1 replica 0 IS the fleet — exactly the
+    single-engine wiring, which is what keeps the JSONL byte-identity
+    pin honest)."""
+    import dataclasses
+
+    from jama16_retina_tpu.obs import quality as quality_lib
+    from jama16_retina_tpu.serve import (
+        CascadeEngine,
+        EscalationPool,
+        ServingEngine,
+    )
+    from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+    sub = cfg.replace(obs=dataclasses.replace(
+        cfg.obs, quality=dataclasses.replace(
+            cfg.obs.quality, enabled=False,
+        ),
+    ))
+    if not cfg.serve.cascade_student_dir:
+        return [
+            ServingEngine(cfg if i == 0 else sub, dirs, model=model)
+            for i in range(n)
+        ]
+    student_dirs = ckpt_lib.discover_member_dirs(
+        cfg.serve.cascade_student_dir
+    )
+    pool = EscalationPool([
+        ServingEngine(sub, dirs, model=model)
+        for _ in range(max(1, cfg.serve.router_escalation_replicas))
+    ])
+    cascades = [
+        CascadeEngine(
+            cfg if i == 0 else sub,
+            ServingEngine(sub, student_dirs, model=model),
+            pool,
+            quality=(
+                quality_lib.monitor_from_config(cfg.obs.quality)
+                if i == 0 and cfg.obs.enabled else None
+            ),
+        )
+        for i in range(n)
+    ]
+    # One go-live gate for the fleet: every cascade shares the same
+    # student/band/thresholds, so replica 0's verdicts cover all
+    # (typed CascadeRejected refuses the whole batch, same as the
+    # single-cascade path).
+    cascades[0].go_live()
+    return cascades
 
 
 def _expand(patterns: list[str]) -> list[str]:
@@ -148,6 +226,13 @@ def main(argv):
     cfg = configs.get_config(_CONFIG.value)
     if _SET.value:
         cfg = configs.override(cfg, _SET.value)
+    if _REPLICAS.value < 0:
+        raise app.UsageError(f"--replicas must be >= 0, got {_REPLICAS.value}")
+    if _REPLICAS.value > 0 and _DEVICE.value == "tf":
+        raise app.UsageError(
+            "--replicas needs --device={tpu,cpu}: the tf legacy backend "
+            "has no serving engine to replicate"
+        )
     # Fault plan armed BEFORE the host preprocessing stage: the
     # host.decode seam lives there, ahead of engine construction
     # (obs/faultinject.py; env wins over obs.fault_plan).
@@ -289,14 +374,64 @@ def main(argv):
         # shapes — and therefore the probabilities — are bit-identical
         # to the sequential per-member path this replaced
         # (tests/test_serve.py pins both levels).
-        from jama16_retina_tpu.serve import CascadeEngine, ServingEngine
+        import jax
 
+        from jama16_retina_tpu.serve import CascadeEngine, ServingEngine
+        from jama16_retina_tpu.serve import policy as policy_lib
+        from jama16_retina_tpu.serve.router import Router
+
+        # Frontier-derived serving policy (ISSUE 12; serve/policy.py):
+        # applied BEFORE the CLI's bucket pin, so an artifact fills
+        # max_wait/shed knobs while the single-bucket byte-identity
+        # contract below still wins on shapes. A stale fingerprint
+        # refuses the batch loudly (typed PolicyStale).
+        policy_prov = {}
+        if cfg.serve.policy_from:
+            cfg, policy_prov = policy_lib.maybe_apply_policy(
+                cfg, n_devices=jax.local_device_count()
+            )
         cfg = cfg.replace(serve=dataclasses.replace(
             cfg.serve,
             max_batch=_BATCH.value,
             bucket_sizes=(_BATCH.value,),
         ))
-        if cfg.serve.cascade_student_dir:
+        if _REPLICAS.value > 0:
+            # Front-door router (ISSUE 12): the same blocks the
+            # single-engine path would chunk, submitted as prioritized
+            # requests and re-binned/dispatched across N replicas.
+            # Results reassemble in submission order, so the JSONL is
+            # byte-identical to the single-engine path at --replicas 1
+            # (pinned by tests/test_router.py).
+            engines = _router_replica_engines(
+                cfg, dirs, model, _REPLICAS.value
+            )
+            router = Router(
+                cfg, engines=engines,
+                policy_provenance=policy_prov or None,
+            )
+            futs = [
+                router.submit(pre.images[i:i + _BATCH.value],
+                              priority=_PRIORITY.value)
+                for i in range(0, len(kept), _BATCH.value)
+            ]
+            blocks = []
+            for bi, f in enumerate(futs):
+                blocks.append(np.asarray(f.result()))
+                if snap is not None:
+                    snap.progress(
+                        min(len(kept), (bi + 1) * _BATCH.value)
+                    )
+                    snap.maybe_flush()
+            probs = (blocks[0] if len(blocks) == 1
+                     else np.concatenate(blocks))
+            if snap is not None:
+                # The router's session report (replica ledger, shed
+                # split, scaler decisions, policy provenance) lands as
+                # one `router` record — scripts/obs_report.py's Router
+                # section reads it.
+                snap.write_record("router", **router.report())
+            router.close()
+        elif cfg.serve.cascade_student_dir:
             # Cheap-path serving (ISSUE 10): the distilled student
             # scores every image; only rows inside serve.cascade_band
             # of the operating thresholds pay the full stacked
@@ -356,7 +491,9 @@ def main(argv):
             engine.go_live()
         else:
             engine = ServingEngine(cfg, dirs, model=model)
-        if snap is None:
+        if _REPLICAS.value > 0:
+            pass  # probs computed through the router above
+        elif snap is None:
             probs = engine.probs(pre.images)
         else:
             # Per-block calls so heartbeats advance DURING a long batch.

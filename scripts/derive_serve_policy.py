@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Derive a versioned serving-policy artifact from a measured
+``serve_frontier`` sweep (ISSUE 12 satellite).
+
+The flow (docs/RELIABILITY.md §Router):
+
+    python bench.py ... > bench.json          # not --skip_frontier
+    python scripts/derive_serve_policy.py \
+        --bench_json bench.json --out serve_policy.json
+    python predict.py ... --set serve.policy_from=serve_policy.json
+
+The artifact carries the chosen bucket ladder / max_batch /
+max_wait_ms / shed thresholds, a content-hash ``policy_version``, and
+the (arch, image_size, head, n_devices) fingerprint the sweep
+described — ``serve.policy_from`` refuses a stale fingerprint with a
+typed error naming this script (serve/policy.py). Hand-set knobs in
+the serving config always win over the artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+_BENCH_JSON = flags.DEFINE_string(
+    "bench_json", "",
+    "bench.py JSON output carrying a serve_frontier sweep (run bench "
+    "WITHOUT --skip_serve/--skip_frontier)",
+)
+_OUT = flags.DEFINE_string(
+    "out", "serve_policy.json",
+    "policy artifact path (written atomically; versioned by content "
+    "hash)",
+)
+_CONFIG = flags.DEFINE_string(
+    "config", "eyepacs_binary",
+    "config preset the sweep ran under (bench.py uses eyepacs_binary); "
+    "fixes the artifact's model fingerprint",
+)
+_SET = flags.DEFINE_multi_string("set", [], "config overrides")
+_DEVICES = flags.DEFINE_integer(
+    "devices", 1,
+    "device count the sweep's rates were normalized by (bench.py "
+    "logs '<n> device(s)'); part of the fingerprint",
+)
+_SLO_P99_MS = flags.DEFINE_float(
+    "slo_p99_ms", 0.0,
+    "optional p99 latency SLO: restrict the bucket choice to frontier "
+    "points meeting it (0 = throughput-knee rule alone)",
+)
+
+
+def main(argv):
+    del argv
+    from jama16_retina_tpu import configs
+    from jama16_retina_tpu.serve import policy as policy_lib
+
+    if not _BENCH_JSON.value:
+        raise app.UsageError("--bench_json is required")
+    cfg = configs.get_config(_CONFIG.value)
+    if _SET.value:
+        cfg = configs.override(cfg, _SET.value)
+    with open(_BENCH_JSON.value) as f:
+        bench = json.load(f)
+    frontier = policy_lib.frontier_from_bench_json(bench)
+    policy = policy_lib.derive_policy(
+        frontier,
+        policy_lib.policy_fingerprint(cfg, n_devices=_DEVICES.value),
+        slo_p99_ms=_SLO_P99_MS.value,
+        source={
+            "bench_json": _BENCH_JSON.value,
+            "frontier_points": len(frontier),
+            "config": _CONFIG.value,
+            "slo_p99_ms": _SLO_P99_MS.value,
+        },
+    )
+    path = policy_lib.save_policy(_OUT.value, policy)
+    print(json.dumps({
+        "policy": path,
+        "policy_version": policy.version,
+        "bucket_sizes": list(policy.bucket_sizes),
+        "max_batch": policy.max_batch,
+        "max_wait_ms": policy.max_wait_ms,
+        "shed_in_flight": policy.shed_in_flight,
+        "shed_queue_depth": policy.shed_queue_depth,
+        "fingerprint": policy.fingerprint,
+    }, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(app.run(main))

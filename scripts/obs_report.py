@@ -707,6 +707,136 @@ _LIFECYCLE_STATES = (
 )
 
 
+def router_summary(records: list) -> "dict | None":
+    """The Router section's machine-readable form (--json twin;
+    ISSUE 12): per-replica ledger, priority + class-aware shed split,
+    continuous-batching/retry accounting, scaler decision ledger, and
+    the serving-policy provenance. Prefers the session's ``router``
+    report record (predict.py --replicas writes one); falls back to
+    the telemetry counters for sessions that only streamed metrics.
+    None when the run never routed."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    counters = latest.get("counters", {})
+    gauges = latest.get("gauges", {})
+    reports = [r for r in records if r.get("kind") == "router"]
+    report = reports[-1] if reports else {}
+    routed = report or any(
+        k.startswith(("serve.router.", "serve.scaler."))
+        for k in list(counters) + list(gauges)
+    )
+    if not routed:
+        return None
+
+    def ctr(name):
+        return int(counters.get(name, 0))
+
+    replicas = report.get("replicas") or [
+        {
+            "replica": int(k[len("serve.router.replica"):-len(".rows")]),
+            "rows": int(v),
+        }
+        for k, v in sorted(counters.items())
+        if k.startswith("serve.router.replica") and k.endswith(".rows")
+    ]
+    return {
+        "dispatch_policy": report.get("dispatch_policy"),
+        "policy": report.get("policy"),
+        "replicas": replicas,
+        "requests": report.get("requests") or {
+            "interactive": ctr("serve.router.requests.interactive"),
+            "batch": ctr("serve.router.requests.batch"),
+        },
+        "shed": report.get("shed") or {
+            "interactive": ctr("serve.router.shed.interactive"),
+            "batch": ctr("serve.router.shed.batch"),
+            "deadline": ctr("serve.router.shed.deadline"),
+        },
+        "rows": int(report.get("rows", ctr("serve.router.rows"))),
+        "dispatches": int(
+            report.get("dispatches", ctr("serve.router.dispatches"))
+        ),
+        "rebins": int(report.get("rebins", ctr("serve.router.rebins"))),
+        "retried_bins": int(
+            report.get("retried_bins", ctr("serve.router.retried_bins"))
+        ),
+        "replica_failures": int(report.get(
+            "replica_failures", ctr("serve.router.replica_failures")
+        )),
+        "request_failures": ctr("serve.router.request_failures"),
+        "escalations": int(
+            report.get("escalations", ctr("serve.router.escalations"))
+        ),
+        "active_replicas": (
+            int(gauges["serve.router.active_replicas"])
+            if "serve.router.active_replicas" in gauges else None
+        ),
+        "desired_replicas": (
+            int(gauges["serve.scaler.desired_replicas"])
+            if "serve.scaler.desired_replicas" in gauges else None
+        ),
+        "saturated": bool(gauges.get("serve.scaler.saturated", 0)),
+        "imbalance": gauges.get("serve.router.imbalance"),
+        "scaler_ledger": report.get("scaler") or [],
+    }
+
+
+def render_router(records: list) -> "str | None":
+    s = router_summary(records)
+    if s is None:
+        return None
+    rows = []
+    if s["policy"]:
+        p = s["policy"]
+        rows.append(("serving policy",
+                     f"{p.get('version', '?')} from {p.get('path', '?')} "
+                     f"(applied: {', '.join(p.get('applied') or []) or 'none'})"))
+    if s["dispatch_policy"]:
+        rows.append(("dispatch policy", s["dispatch_policy"]))
+    req = s["requests"]
+    rows.append(("requests (interactive/batch)",
+                 f"{req.get('interactive', 0)}/{req.get('batch', 0)}"))
+    shed = s["shed"]
+    if any(shed.values()):
+        rows.append(("shed (interactive/batch/deadline)",
+                     f"{shed.get('interactive', 0)}/"
+                     f"{shed.get('batch', 0)}/{shed.get('deadline', 0)}"))
+    rows.append(("rows routed", s["rows"]))
+    rows.append(("dispatch bins (rebinned requests)",
+                 f"{s['dispatches']} ({s['rebins']})"))
+    if s["retried_bins"] or s["replica_failures"]:
+        rows.append(("replica failures (bins retried on siblings)",
+                     f"{s['replica_failures']} ({s['retried_bins']})"))
+    if s["request_failures"]:
+        rows.append(("request failures (retries exhausted)",
+                     s["request_failures"]))
+    if s["escalations"]:
+        rows.append(("rows escalated through the shared pool",
+                     s["escalations"]))
+    if s["active_replicas"] is not None or s["desired_replicas"] is not None:
+        rows.append(("replicas active -> scaler desired",
+                     f"{s['active_replicas']} -> {s['desired_replicas']}"
+                     + (" [SATURATED]" if s["saturated"] else "")))
+    if s["imbalance"] is not None:
+        rows.append(("dispatch imbalance (max/mean)",
+                     round(float(s["imbalance"]), 2)))
+    for r in s["replicas"]:
+        detail = f"{r.get('rows', 0)} rows"
+        if r.get("state"):
+            detail += f", {r['state']}"
+        if r.get("generation") is not None:
+            detail += f", gen {r['generation']}"
+        rows.append((f"replica {r.get('replica')}", detail))
+    for d in s["scaler_ledger"][-5:]:
+        rows.append((
+            "scaler decision",
+            f"{d.get('active')} -> {d.get('desired')} ({d.get('reason')}; "
+            f"queue {d.get('queue_rows')}, in-flight "
+            f"{d.get('in_flight_rows')}, p99 {d.get('p99_latency_ms')} ms)",
+        ))
+    return "router:\n" + _table(rows, ("signal", "value"))
+
+
 def lifecycle_summary(records: list) -> "dict | None":
     """The Lifecycle section's machine-readable form (--json twin):
     current controller state, the newest cycle's transition timeline,
@@ -1101,6 +1231,7 @@ def main(argv=None) -> int:
             "quality": quality_summary(records),
             "reliability": reliability_summary(records),
             "serving_cost": serving_cost_summary(records),
+            "router": router_summary(records),
             "lifecycle": lifecycle_summary(records),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
@@ -1129,6 +1260,10 @@ def main(argv=None) -> int:
     if sc:
         print()
         print(sc)
+    rt = render_router(records)
+    if rt:
+        print()
+        print(rt)
     lcy = render_lifecycle(records)
     if lcy:
         print()

@@ -183,6 +183,23 @@ _QUICK_TESTS = {
     "test_mixedprec.py::test_dtype_curve_gate_unit",
     "test_mixedprec.py::test_async_saver_latches_and_reraises_failures",
     "test_mixedprec.py::test_bf16_step_keeps_fp32_master_weights",
+    # front-door router (ISSUE 12): the numpy-cheap policy pins —
+    # continuous-batching re-bin correctness over stub replicas,
+    # dispatch-policy selection, class-aware shed ordering, the pure
+    # scaler decision sequences, replica-death zero-drop retry, drain
+    # semantics, and the policy-artifact round-trip/staleness; the
+    # real-engine byte-identity + predict CLI pins stay in the full
+    # tier (XLA compiles dominate there)
+    "test_router.py::test_rebin_correctness_no_row_reordered",
+    "test_router.py::test_dispatch_policy_least_in_flight_pin",
+    "test_router.py::test_bucket_affinity_prefers_warm_replica",
+    "test_router.py::test_priority_shed_ordering_batch_first",
+    "test_router.py::test_scaler_decide_pinned_sequences",
+    "test_router.py::test_scaler_decide_is_deterministic",
+    "test_router.py::test_replica_death_storm_zero_drops",
+    "test_router.py::test_drain_finishes_in_flight_and_releases_engine",
+    "test_router.py::test_policy_artifact_roundtrip_and_derivation",
+    "test_router.py::test_policy_stale_fingerprint_refused",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

@@ -703,12 +703,14 @@ def test_lint_repo_clean():
 
 
 def test_repo_fault_sites_registry_matches_wired_seams():
-    """The declared vocabulary is exactly the seams PR 6/8/10/11 wired."""
+    """The declared vocabulary is exactly the seams PR 6/8/10/11/12
+    wired."""
     from jama16_retina_tpu.obs import faultinject
 
     assert set(faultinject.SITES) == {
         "tfrecord.read", "host.decode", "ckpt.restore", "ckpt.save",
-        "engine.dispatch", "serve.compile_cache.load", "trainer.step",
+        "engine.dispatch", "serve.router.dispatch",
+        "serve.compile_cache.load", "trainer.step",
         "lifecycle.retrain", "lifecycle.gate", "lifecycle.swap",
     }
     assert all(desc for desc in faultinject.SITES.values())

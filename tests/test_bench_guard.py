@@ -313,6 +313,22 @@ def test_robustness_overhead_guard_pins_two_percent():
     assert extras["robustness_overhead_pct"] == 0.0
 
 
+def test_router_overhead_guard_pins_two_percent():
+    """The ISSUE 12 pin, same shared guard math: the workload routed
+    through a 1-replica Router must stay within 2% of calling the
+    replica directly."""
+    extras = {}
+    assert bench._router_overhead_guard(extras, 990.0, 1000.0)
+    assert extras["router_overhead_ok"] is True
+    assert extras["router_overhead_pct"] == pytest.approx(1.0)
+    extras = {}
+    assert not bench._router_overhead_guard(extras, 950.0, 1000.0)
+    assert extras["router_overhead_ok"] is False
+    extras = {}
+    assert bench._router_overhead_guard(extras, 1010.0, 1000.0)
+    assert extras["router_overhead_pct"] == 0.0
+
+
 def test_unarmed_fault_site_costs_one_branch():
     """Per-op bound backing the robustness pin off-chip (ISSUE 6): an
     UNARMED faultinject.check — what every seam (tfrecord.read,
@@ -360,6 +376,10 @@ def test_chaos_smoke_recovers_every_path():
     assert extras["chaos_injections"]["lifecycle.retrain"] == 1
     assert extras["chaos_injections"]["lifecycle.gate"] == 1
     assert extras["chaos_injections"]["lifecycle.swap"] == 1
+    # ISSUE 12: the replica-death drill rode the same plan — one
+    # injected router dispatch failure, zero dropped requests.
+    assert extras["chaos_injections"]["serve.router.dispatch"] == 1
+    assert extras["chaos_router_zero_drops"] is True
 
 
 def test_lifecycle_overhead_guard_pins_two_percent():

@@ -665,14 +665,16 @@ def test_manager_for_trainerless_wiring(tmp_path):
                  if not r.metric.startswith("quality.")]
     assert len(quality_rules) == 3
     assert {r.reason for r in rel_rules} == {
-        "data_quarantine", "reload_rejected"
+        "data_quarantine", "reload_rejected",
+        "router_imbalance", "scaler_saturated",  # ISSUE 12 ride-alongs
     }
     assert am._flight is not None and am._flight.workdir == str(tmp_path)
     # Quality off: the reliability rules alone still get a manager.
     am_base = obs_alerts.manager_for(cfg, str(tmp_path))
     assert am_base is not None
     assert {r.reason for r in am_base.rules} == {
-        "data_quarantine", "reload_rejected"
+        "data_quarantine", "reload_rejected",
+        "router_imbalance", "scaler_saturated",
     }
     cfg_off = cfg_q.replace(
         obs=dataclasses.replace(cfg_q.obs, enabled=False)
