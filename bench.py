@@ -534,6 +534,21 @@ def _router_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _integrity_overhead_guard(extras: dict, rate_on: float,
+                              rate_off: float,
+                              max_overhead: float = 0.02) -> bool:
+    """ISSUE 13's pin, same shared math: device_only with the sealed-
+    artifact layer's hot-path residue — the unarmed ``integrity.write``
+    seam branch charged per step (conservative: real steps only pay it
+    when a durable write happens) plus a FULL sealed-JSON publish
+    (serialize + sha256 + tmp + fsync + rename) every 25 steps, a far
+    denser durable-write cadence than any real checkpoint/telemetry
+    interval. The contract the tentpole claims: checksum cost rides
+    writes, never the train/serve hot loop."""
+    return _overhead_guard(extras, "integrity", rate_on, rate_off,
+                           max_overhead)
+
+
 def _router_bench(extras: dict) -> None:
     """Router scaling rows (ISSUE 12): the dispatch pipeline measured
     OFF-DEVICE over stub replicas with a fixed simulated per-row
@@ -947,6 +962,243 @@ def _chaos_smoke(extras: dict) -> None:
     _log(f"chaos smoke: ok={ok}, injections={extras['chaos_injections']}")
 
 
+def _chaos_integrity(extras: dict) -> None:
+    """``--chaos`` disaster drill, durable-state half (ISSUE 13):
+    seed a REAL serving-ready workdir (checkpoint + live.json + closed
+    journal cycle + policy + profile + sealed canary + transcoded
+    rawshard split), corrupt every sealed artifact class with a
+    mid-file bit flip, and prove the whole chain: each loader refuses
+    typed (ArtifactCorrupt) or degrades counted, ``graftfsck`` detects
+    every corpse (exit 1, naming the files), ``--repair`` + the named
+    rebuild commands return the workdir to serving-ready (fsck exit 0,
+    a real ServingEngine restores off live.json, live.json intact) —
+    and kill -9 INSIDE the sealed writer (held open at the
+    integrity.write.commit seam) leaves no readable torn artifact.
+    Publishes ``chaos_integrity_ok`` + per-phase booleans."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.data import rawshard as rawshard_lib
+    from jama16_retina_tpu.data import tfrecord as tfrecord_lib
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+    from jama16_retina_tpu.integrity import fsck as fsck_lib
+    from jama16_retina_tpu.lifecycle.journal import Journal
+    from jama16_retina_tpu.obs import quality as quality_lib
+    from jama16_retina_tpu.obs.registry import default_registry
+    from jama16_retina_tpu.serve import policy as policy_lib
+    from jama16_retina_tpu.serve.engine import ServingEngine
+    from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+    ok = True
+    size = 32
+    # The loaders count corruption on the process default registry
+    # (that is the alert rule's input) — the drill must read the SAME
+    # counter, strictly increased, or a counting regression would pass.
+    reg = default_registry()
+
+    def bitflip(path: str, marker: "bytes | None" = None) -> None:
+        """Flip one bit. For JSON artifacts a ``marker`` inside a
+        string VALUE is targeted, so the file stays parseable and the
+        drill deterministically exercises the checksum (not the
+        parser); binaries flip mid-file."""
+        with open(path, "rb") as f:
+            blob = bytearray(f.read())
+        i = blob.find(marker) if marker else len(blob) // 2
+        assert i >= 0, f"marker {marker!r} not in {path}"
+        blob[i] ^= 0x01
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+
+    def expect_corrupt(fn) -> bool:
+        try:
+            fn()
+        except artifact_lib.ArtifactCorrupt:
+            return True
+        except ValueError:
+            # A flipped byte can break JSON syntax instead of content;
+            # the loud unparseable refusal is equally typed.
+            return True
+        return False
+
+    with tempfile.TemporaryDirectory() as wd:
+        # --- seed the serving-ready workdir --------------------------
+        cfg = override(get_config("smoke"), [
+            f"model.image_size={size}", "serve.max_batch=4",
+            "serve.bucket_sizes=4",
+        ])
+        model = models.build(cfg.model)
+        member = os.path.join(wd, "member_00")
+        m_state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+        ck = ckpt_lib.Checkpointer(member)
+        ck.save(1, jax.device_get(m_state), {"val_auc": 0.5})
+        ck.wait()
+        ck.close()
+        j = Journal(os.path.join(wd, "lifecycle"))
+        j.write_live([member])
+        j.append("DRIFT_DETECTED", cycle=0, reason="drill")
+        j.append("ROLLBACK", cycle=0, cause="drill")  # closed cycle
+        pol = policy_lib.derive_policy(
+            [{"bucket": 4, "concurrency": 1, "images_per_sec": 100.0,
+              "p50_ms": 2.0, "p99_ms": 5.0}],
+            {"arch": "drill"},
+        )
+        ppath = os.path.join(wd, "serve_policy.json")
+        policy_lib.save_policy(ppath, pol)
+        rng = np.random.default_rng(7)
+        prpath = os.path.join(wd, "profile.json")
+        quality_lib.save_profile(prpath, quality_lib.build_profile(
+            rng.random(256), thresholds=[{"threshold": 0.5}],
+        ))
+        cimgs = rng.integers(0, 256, (2, size, size, 3), np.uint8)
+        cpath = quality_lib.save_canary(
+            os.path.join(wd, "canary.npz"), cimgs, scores=rng.random(2)
+        )
+        src = os.path.join(wd, "data")
+        tfrecord_lib.write_synthetic_split(
+            src, "train", 8, image_size=size, num_shards=1, seed=0
+        )
+        rawshard_lib.transcode_split(src, "train", image_size=size,
+                                     shard_records=4, workers=1)
+        shard_dir = rawshard_lib.default_shard_dir(src, size)
+        baseline = fsck_lib.fsck_workdir(wd)
+        extras["chaos_integrity_baseline_clean"] = baseline.clean
+        ok &= baseline.clean
+
+        # --- corrupt every class; typed refusal / counted degrade ----
+        # Baseline BEFORE the refusal section: every in-process typed
+        # refusal below must strictly grow the default registry's
+        # integrity.corrupt (the alert rule's input).
+        corrupt_before = reg.counter("integrity.corrupt").value
+        bitflip(ppath, marker=b"drill")
+        try:
+            policy_lib.load_policy(ppath)
+            policy_refused = False
+        except (artifact_lib.ArtifactCorrupt, policy_lib.PolicyStale):
+            policy_refused = True
+        ok &= policy_refused
+        bitflip(prpath, marker=b"threshold")
+        ok &= expect_corrupt(lambda: quality_lib.load_profile(prpath))
+        bitflip(cpath)
+        ok &= expect_corrupt(lambda: quality_lib.load_canary_file(cpath))
+        jpath = os.path.join(wd, "lifecycle", "journal.json")
+        bitflip(jpath, marker=b"drill")
+        ok &= expect_corrupt(
+            lambda: Journal(os.path.join(wd, "lifecycle"))
+        )
+        shard = sorted(
+            p for p in os.listdir(shard_dir)
+            if p.endswith(".images.npy")
+        )[0]
+        bitflip(os.path.join(shard_dir, shard))
+
+        # --- graftfsck detects every corpse (exit 1, names files) ----
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "graftfsck.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r1 = subprocess.run(
+            [_sys.executable, script, wd, "--json"],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        ok &= r1.returncode == 1
+        try:
+            rep1 = json.loads(r1.stdout)
+            named = {f["path"] for f in rep1["findings"]}
+        except Exception:  # noqa: BLE001
+            named = set()
+            ok = False
+        for must in (ppath, prpath, cpath, jpath,
+                     os.path.join(shard_dir, shard)):
+            ok &= any(must in p for p in named)
+        extras["chaos_integrity_detected"] = len(named)
+
+        # --- repair + named rebuilds -> serving-ready ----------------
+        r2 = subprocess.run(
+            [_sys.executable, script, wd, "--repair"],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        # Rebuild the derivable pieces exactly as the findings direct:
+        # resume the transcode (trimmed shards), re-derive the policy,
+        # re-emit the profile, re-pin the canary.
+        rawshard_lib.transcode_split(src, "train", image_size=size,
+                                     shard_records=4, workers=1)
+        policy_lib.save_policy(ppath, pol)
+        quality_lib.save_profile(prpath, quality_lib.build_profile(
+            rng.random(256), thresholds=[{"threshold": 0.5}],
+        ))
+        quality_lib.save_canary(cpath, cimgs, scores=rng.random(2))
+        r3 = subprocess.run(
+            [_sys.executable, script, wd],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        ok &= r3.returncode == 0
+        extras["chaos_integrity_repaired_clean"] = r3.returncode == 0
+        live = Journal(os.path.join(wd, "lifecycle")).read_live()
+        ok &= live == [member]  # live.json intact through it all
+        try:
+            engine = ServingEngine(cfg, live, model=model)
+            probe = rng.integers(0, 256, (2, size, size, 3), np.uint8)
+            ok &= engine.probs(probe).shape[0] == 2
+        except Exception as e:  # noqa: BLE001
+            _log(f"chaos integrity: engine restore failed: {e}")
+            ok = False
+        counted = reg.counter("integrity.corrupt").value > corrupt_before
+        extras["chaos_integrity_corrupt_counted"] = counted
+        ok &= counted
+
+        # --- kill -9 inside the sealed writer ------------------------
+        # The child appends a journal entry with the commit seam held
+        # open (latency plan at integrity.write.commit); SIGKILL lands
+        # mid-write. No torn artifact may ever be readable: the journal
+        # still loads (old content) and only an inert .tmp remains.
+        kdir = os.path.join(wd, "kill9")
+        Journal(kdir).append("DRIFT_DETECTED", cycle=0, reason="pre")
+        child_src = (
+            "import os, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from jama16_retina_tpu.obs import faultinject\n"
+            "faultinject.arm_from_env_or_config()\n"
+            "from jama16_retina_tpu.lifecycle.journal import Journal\n"
+            "Journal(%r).append('RETRAIN', cycle=0, note='torn')\n"
+            % (os.path.dirname(os.path.abspath(__file__)), kdir)
+        )
+        kenv = dict(
+            env,
+            JAMA16_FAULTS=json.dumps({
+                "integrity.write.commit": {
+                    "kind": "latency", "on_calls": [1], "delay_s": 60.0,
+                },
+            }),
+        )
+        child = subprocess.Popen([_sys.executable, "-c", child_src],
+                                 env=kenv)
+        deadline = time.time() + 60
+        tmp_seen = False
+        while time.time() < deadline:
+            if any(".tmp." in n for n in os.listdir(kdir)):
+                tmp_seen = True
+                break
+            if child.poll() is not None:
+                break
+            time.sleep(0.02)
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+        ok &= tmp_seen
+        j_after = Journal(kdir)  # must load cleanly: the OLD content
+        ok &= j_after.state == "DRIFT_DETECTED"
+        extras["chaos_integrity_kill9_ok"] = bool(
+            tmp_seen and j_after.state == "DRIFT_DETECTED"
+        )
+
+    extras["chaos_integrity_ok"] = bool(ok)
+    _log(f"chaos integrity drill: ok={ok}")
+
+
 def _latency_summary(latencies_ms) -> dict:
     """p50/p99/mean over one offered-load window's per-request
     latencies. Both percentiles come from the SAME sorted sample, so
@@ -1186,7 +1438,10 @@ def main() -> None:
              "arm a FaultPlan, drive poison-record quarantine, batcher "
              "window-error recovery, deadline expiry, and load "
              "shedding off-device; publishes chaos_ok + the per-site "
-             "injection ledger",
+             "injection ledger. Plus the ISSUE 13 durable-state "
+             "disaster drill: bit-flip every sealed artifact class, "
+             "graftfsck detect + --repair back to serving-ready, and "
+             "kill -9 inside the sealed writer (chaos_integrity_*)",
     )
     args = parser.parse_args()
 
@@ -1414,6 +1669,57 @@ def main() -> None:
                 _robustness_overhead_guard(extras, rate_r, device_only)
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"robustness overhead bench failed: "
+                 f"{type(e).__name__}: {e}")
+
+    # Integrity overhead pin (ISSUE 13): the sealed-artifact layer's
+    # whole hot-path residue — one unarmed integrity.write seam branch
+    # per step plus a full sealed publish every 25 steps (see
+    # _integrity_overhead_guard). Same ≤2% budget, shared guard math.
+    if not headline_serialized:
+        try:
+            import shutil as _shutil
+            import tempfile as _tempfile
+
+            from jama16_retina_tpu.integrity import (
+                artifact as artifact_lib,
+            )
+            from jama16_retina_tpu.obs import faultinject
+
+            i_dir = _tempfile.mkdtemp(prefix="bench_integrity_")
+            i_path = os.path.join(i_dir, "probe.json")
+            i_state = {"n": 0, "writes": 0}
+
+            def integrity_step(s, batch, k):
+                faultinject.check("integrity.write")
+                out = step(s, batch, k)
+                i_state["n"] += 1
+                if i_state["n"] >= 25:
+                    i_state["writes"] += 1
+                    artifact_lib.write_sealed_json(
+                        i_path,
+                        {"writes": i_state["writes"],
+                         "payload": list(range(64))},
+                        schema="integrity.probe", version=1,
+                    )
+                    i_state["n"] = 0
+                return out
+
+            rate_i, state = _timed_steps(
+                integrity_step, state,
+                lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            _shutil.rmtree(i_dir, ignore_errors=True)
+            rate_i = _publish(
+                extras, "device_only_integrity", rate_i,
+                flops_per_image, peak,
+                suffix=" (device_only + unarmed integrity.write seam + "
+                       "sealed publish every 25 steps)",
+            )
+            if rate_i is not None:
+                _integrity_overhead_guard(extras, rate_i, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"integrity overhead bench failed: "
                  f"{type(e).__name__}: {e}")
 
     # Autotune overhead pin (ISSUE 7): the same device_only window with
@@ -1721,6 +2027,10 @@ def main() -> None:
 
     if args.chaos:
         _chaos_smoke(extras)
+        _chaos_integrity(extras)
+        extras["chaos_ok"] = bool(
+            extras.get("chaos_ok") and extras.get("chaos_integrity_ok")
+        )
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.
     aug_imgs = jax.device_put(batches[0]["image"])

@@ -13,6 +13,9 @@ file:line findings and stable suppression keys:
   * ``faults``   — every fault site fired, armed, or documented
     resolves to ``obs/faultinject.SITES`` (and every declared site is
     fired and documented);
+  * ``artifacts`` — durable writes (``os.replace``, ``json.dump``,
+    ``np.save``/``pickle.dump`` to disk) happen only through
+    ``integrity/artifact.py``'s sealed atomic writer (ISSUE 13);
   * ``locks``    — lock-guarded attributes of threaded classes are
     never written bare;
   * ``purity``   — declared-deterministic scopes never call clocks or
@@ -33,6 +36,9 @@ from jama16_retina_tpu.analysis.core import (  # noqa: F401
     Finding,
     run_rules,
 )
+from jama16_retina_tpu.analysis.rule_artifacts import (  # noqa: F401
+    ArtifactsRule,
+)
 from jama16_retina_tpu.analysis.rule_config import ConfigRule  # noqa: F401
 from jama16_retina_tpu.analysis.rule_faults import FaultsRule  # noqa: F401
 from jama16_retina_tpu.analysis.rule_locks import LocksRule  # noqa: F401
@@ -49,6 +55,7 @@ def default_rules() -> list:
         MetricsRule(),
         ConfigRule(),
         FaultsRule(),
+        ArtifactsRule(),
         LocksRule(),
         PurityRule(),
         PytestMarksRule(),

@@ -338,12 +338,13 @@ def load_baseline(path: str) -> set:
 
 
 def write_baseline(path: str, findings: list) -> None:
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(
-            {"accepted": [{"code": x.code, "key": x.key} for x in findings]},
-            f, indent=1, sort_keys=True,
-        )
-        f.write("\n")
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    artifact_lib.write_json(
+        path,
+        {"accepted": [{"code": x.code, "key": x.key} for x in findings]},
+        sort_keys=True, trailing_newline=True,
+    )
 
 
 # --- Runner ---------------------------------------------------------------

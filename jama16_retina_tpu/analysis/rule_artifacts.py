@@ -1,0 +1,87 @@
+"""graftlint rule ``artifacts``: the durable-write contract (ISSUE 13).
+
+``integrity/artifact.py`` is the ONE place durable bytes may reach
+disk: its sealed writer carries the atomic tmp+fsync+rename discipline,
+the content checksum, and the ``integrity.write`` chaos seams. A
+hand-rolled write anywhere else silently opts out of all three — the
+exact drift that left ten artifact formats with ten atomicity
+conventions before ISSUE 13. This rule makes the discipline a
+machine-checked contract like locks/purity:
+
+  * ``artifacts.bare-replace``   — ``os.replace``/``os.rename`` calls
+    (publishing or moving a file without the shared seam);
+  * ``artifacts.bare-json-dump`` — ``json.dump`` to a file handle
+    (use ``artifact.write_sealed_json`` or ``artifact.write_json``);
+  * ``artifacts.bare-binary-dump`` — ``np.save``/``np.savez``/
+    ``np.savez_compressed``/``pickle.dump`` straight to disk (use
+    ``artifact.atomic_write_bytes`` + a seal sidecar).
+
+Scope: the package + scripts + entry scripts (the lint corpus), MINUS
+``integrity/artifact.py`` itself. Checkpoint I/O through orbax is
+invisible here by construction (orbax owns its own atomicity).
+Intentional exceptions go in ``.graftlint.json`` with a justification
+— the acceptance bar is <= 3.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from jama16_retina_tpu.analysis import core
+
+_OWNER_SUFFIX = "integrity/artifact.py"
+
+# dotted-call suffixes -> finding code
+_REPLACE_CALLS = {"os.replace", "os.rename"}
+_JSON_CALLS = {"json.dump"}
+_BINARY_TAILS = {"save", "savez", "savez_compressed", "dump"}
+_BINARY_RECEIVERS = {"np", "numpy", "pickle"}
+
+
+class ArtifactsRule:
+    name = "artifacts"
+
+    def run(self, corpus: "core.Corpus") -> list:
+        findings: list = []
+        for pf in corpus.py:
+            if pf.rel.replace("\\", "/").endswith(_OWNER_SUFFIX):
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = core.dotted(node.func)
+                if not fn:
+                    continue
+                code = self._classify(fn)
+                if code is None:
+                    continue
+                scope = core.scope_of(node)
+                findings.append(core.Finding(
+                    rule=self.name, code=code, path=pf.rel,
+                    line=node.lineno,
+                    message=(
+                        f"durable write via {fn}() outside "
+                        "integrity/artifact.py — it skips the sealed "
+                        "atomic-write discipline (tmp+fsync+rename, "
+                        "content checksum, integrity.write chaos "
+                        "seams); route through artifact.write_sealed_"
+                        "json / write_json / atomic_write_bytes / "
+                        "rename, or suppress with a justification in "
+                        ".graftlint.json"
+                    ),
+                    key=f"{pf.rel}::{scope}.{fn}",
+                ))
+        return findings
+
+    @staticmethod
+    def _classify(fn: str) -> "str | None":
+        parts = fn.split(".")
+        tail2 = ".".join(parts[-2:])
+        if tail2 in _REPLACE_CALLS:
+            return "artifacts.bare-replace"
+        if tail2 in _JSON_CALLS:
+            return "artifacts.bare-json-dump"
+        if (len(parts) >= 2 and parts[-1] in _BINARY_TAILS
+                and parts[-2] in _BINARY_RECEIVERS):
+            return "artifacts.bare-binary-dump"
+        return None

@@ -714,6 +714,15 @@ class ObsConfig:
     slow_step_factor: float = 4.0
     # How many of the newest trace events a blackbox dump carries.
     blackbox_events: int = 1024
+    # Cross-run blackbox dump cap (ISSUE 13 satellite): after every
+    # dump, the flight recorder deletes the OLDEST dump directories
+    # under <workdir>/blackbox beyond this many (by mtime — per-run
+    # sequence numbers restart, mtime orders across runs), counted as
+    # obs.blackbox_pruned. One-per-reason-per-run limits a single run;
+    # this bounds the workdir across a long-lived supervisor's many
+    # runs. <= 0 disables the cap. integrity/retention.py applies the
+    # same cap offline.
+    blackbox_keep: int = 20
     # Model/data-quality monitoring (ISSUE 5): online drift detection
     # against a reference profile, golden-set canary, and SLO/alert
     # rules. Nested because it is a subsystem, not a knob — override
@@ -735,6 +744,35 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IntegrityConfig:
+    """Durable-state integrity (jama16_retina_tpu/integrity/; ISSUE 13):
+    retention-GC policy knobs for ``integrity/retention.py`` (driven by
+    ``scripts/graftfsck.py --gc``, dry-run first) plus the fsck/repair
+    machinery's defaults. Sealing itself has no knobs — every durable
+    writer seals unconditionally; these bound what the workdir is
+    allowed to ACCUMULATE."""
+
+    # Total bytes of compile-cache ENTRY files (exec_*.jex + seal
+    # sidecars; the manifest is never collected) one cache directory
+    # may hold before the GC evicts least-recently-used entries. An
+    # evicted entry recompiles + re-saves on the next warm-up — cost,
+    # not correctness. <= 0 disables the cap.
+    cache_max_bytes: int = 4 << 30
+    # Size (bytes) above which a run's metrics JSONL (and its .p{N}
+    # mirrors) is rotated to <name>.1 by the GC, with older rotations
+    # deleted. OFFLINE-only (never while a run appends — graftfsck is
+    # an operator tool); a rotated JSONL trims resume's best-tracking
+    # replay to the new file, so rotate between runs. <= 0 disables.
+    telemetry_max_bytes: int = 64 << 20
+    # Retired lifecycle candidate checkpoint sets (and canary-pre
+    # backups) kept beyond the ones still reachable: the newest N
+    # CLOSED cycles' candidate roots survive, older ones are
+    # collectible. Anything named by live.json or an OPEN cycle is
+    # pinned unconditionally (never collected — tested).
+    keep_candidate_cycles: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "eyepacs_binary"
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -746,6 +784,9 @@ class ExperimentConfig:
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     lifecycle: LifecycleConfig = dataclasses.field(
         default_factory=LifecycleConfig
+    )
+    integrity: IntegrityConfig = dataclasses.field(
+        default_factory=IntegrityConfig
     )
 
     def replace(self, **sections) -> "ExperimentConfig":

@@ -1,0 +1,53 @@
+"""Durable-state integrity (ISSUE 13).
+
+PRs 6 and 8 made *runtime* failure a handled input; this subsystem does
+the same for the system's *durable* state — the checkpointed artifacts
+a production deployment actually survives on ("TensorFlow: A system for
+large-scale machine learning", PAPERS.md). Three layers:
+
+  * ``artifact.py`` — the SEALED ARTIFACT envelope every durable writer
+    shares: atomic tmp+fsync+rename through one seam, schema
+    name/version, environment fingerprint, and a sha256 content
+    checksum verified on load (typed :class:`ArtifactCorrupt`, counted
+    ``integrity.corrupt.{artifact}``);
+  * ``fsck.py`` — repo-wide verification of a workdir: every artifact
+    class checked for checksums, schema versions, and cross-artifact
+    consistency, findings classified CORRUPT/STALE/ORPHAN/REPAIRABLE,
+    with ``--repair`` rebuilding derivable artifacts and quarantining
+    the rest (``scripts/graftfsck.py`` is the CLI);
+  * ``retention.py`` — the unified dry-run-first GC policy: blackbox
+    dumps, compile-cache bytes, telemetry JSONL, and retired lifecycle
+    candidate sets, journaled per deletion and pinned to never collect
+    anything reachable from ``live.json`` or an open journal cycle.
+
+Proven by ``bench.py --chaos``'s disk-fault drills (torn write, bit
+flip, truncation, ENOSPC at the ``integrity.write`` site family, plus
+kill -9 inside the sealed writer) and tests/test_integrity.py.
+"""
+
+from __future__ import annotations
+
+from jama16_retina_tpu.integrity.artifact import (  # noqa: F401
+    ArtifactCorrupt,
+    atomic_write_bytes,
+    atomic_write_text,
+    env_fingerprint,
+    payload_digest,
+    read_sealed_json,
+    sha256_file,
+    verify_sidecar,
+    write_json,
+    write_seal_sidecar,
+    write_sealed_json,
+)
+from jama16_retina_tpu.integrity.fsck import (  # noqa: F401
+    FsckFinding,
+    FsckReport,
+    fsck_workdir,
+    repair_workdir,
+)
+from jama16_retina_tpu.integrity.retention import (  # noqa: F401
+    RetentionPlan,
+    apply_plan,
+    plan_retention,
+)

@@ -1,0 +1,386 @@
+"""The sealed-artifact envelope: ONE durable-write discipline (ISSUE 13).
+
+Before this module, ~10 artifact formats (rawshard manifests, the
+lifecycle journal + ``live.json``, serve policies, compile-cache
+manifests/entries, reference profiles, canary ``.npz``, blackbox dumps,
+telemetry JSONL) each hand-rolled their own atomic-rename write and
+only two carried content hashes — silent on-disk corruption was
+invisible until a reader crashed on it. This module is the one seam
+they all share now:
+
+  * ``write_sealed_json`` — the payload is written with an embedded
+    ``__seal__`` block: seal version, schema name + version, an
+    environment fingerprint, and a sha256 over the canonical payload
+    JSON. The write itself is atomic (tmp in the same directory,
+    fsync, ``os.replace``) and carries the ``integrity.write`` /
+    ``integrity.write.commit`` fault sites, so ``bench.py --chaos``
+    can inject torn writes, bit flips, truncation, and ENOSPC-style
+    failures into EVERY artifact class through one seam — and a
+    kill -9 between fsync and publish provably leaves no readable
+    torn artifact (the tmp file is inert; readers only see the path).
+  * ``read_sealed_json`` / ``verify_payload`` — the digest is verified
+    on load; a mismatch raises typed :class:`ArtifactCorrupt` naming
+    the file, expected/actual digest, and the rebuild command, and
+    increments ``integrity.corrupt`` + ``integrity.corrupt.{artifact}``
+    (the ``rate(integrity.corrupt) > 0`` alert rule's input). Files
+    written before sealing existed load as "unsealed" (legacy) —
+    ``graftfsck`` flags them STALE; loads do not refuse them.
+  * Binary artifacts (rawshard ``.npy``, canary ``.npz``, compile-cache
+    ``.jex``) seal via ``write_seal_sidecar`` / ``verify_sidecar``:
+    a ``<name>.seal.json`` sealed-JSON sidecar carrying the target's
+    byte size and sha256.
+  * ``write_json`` / ``atomic_write_text`` — the non-sealed escape
+    hatches (report files, blackbox dumps, the ``.prom`` exposition
+    snapshot) so every durable write in the repo still flows through
+    this module: graftlint's ``artifacts`` rule makes a bare
+    ``os.replace``/``json.dump`` outside this file a finding.
+
+The checksum cost rides WRITES (one sha256 over bytes already in
+memory) and artifact LOADS, never the train/serve hot loop — pinned by
+bench.py's ``integrity_overhead_pct`` guard.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+from jama16_retina_tpu.obs import faultinject
+
+SEAL_KEY = "__seal__"
+SEAL_VERSION = 1
+
+# Rebuild commands per artifact class — what an ArtifactCorrupt error
+# and the graftfsck report tell the operator. "Derivable" classes can
+# be regenerated from other durable state; the rest restore from
+# quarantine/ or a backup, never silently.
+REBUILD = {
+    "rawshard.manifest": (
+        "re-run scripts/transcode_shards.py (it resumes from the last "
+        "durable shard)"
+    ),
+    "rawshard.shard": (
+        "delete the shard pair and re-run scripts/transcode_shards.py "
+        "(resume rebuilds exactly the missing shards)"
+    ),
+    "lifecycle.journal": (
+        "NOT derivable — inspect or restore from quarantine/; a fresh "
+        "journal starts idle (live.json still names the serving set)"
+    ),
+    "lifecycle.live": (
+        "NOT derivable — restore from quarantine/ or re-point at the "
+        "blessed checkpoint set (scripts/lifecycle_run.py --status "
+        "shows the journal's view)"
+    ),
+    "serve.policy": "re-derive with scripts/derive_serve_policy.py",
+    "compile_cache.manifest": (
+        "rm -r the cache directory and re-warm one engine construction"
+    ),
+    "compile_cache.entry": (
+        "delete the entry (+.seal.json); the next engine warm-up "
+        "recompiles and re-saves it"
+    ),
+    "quality.profile": "re-emit with evaluate.py --profile_out",
+    "quality.canary": (
+        "NOT derivable — restore from quarantine/ or re-pin with "
+        "obs/quality.save_canary on the served checkpoint"
+    ),
+    "integrity.ledger": (
+        "NOT derivable — the quarantine/GC ledger records actions "
+        "already taken; move it aside"
+    ),
+    "integrity.fsck": "re-run scripts/graftfsck.py on the workdir",
+}
+
+# Short artifact-class names (what loaders/fsck tag corruption with:
+# the integrity.corrupt.{artifact} counter suffixes) -> REBUILD keys.
+REBUILD_BY_CLASS = {
+    "rawshard": "rawshard.shard",
+    "journal": "lifecycle.journal",
+    "live": "lifecycle.live",
+    "policy": "serve.policy",
+    "compile_cache": "compile_cache.entry",
+    "profile": "quality.profile",
+    "canary": "quality.canary",
+    "ledger": "integrity.ledger",
+}
+
+
+def rebuild_hint(artifact: str) -> str:
+    return REBUILD.get(
+        artifact,
+        REBUILD.get(REBUILD_BY_CLASS.get(artifact, ""),
+                    "inspect or restore the file"),
+    )
+
+
+class ArtifactCorrupt(RuntimeError):
+    """A sealed artifact failed its content-checksum (or seal-schema)
+    verification: the bytes on disk are not the bytes the writer
+    sealed. Never absorbed silently — the message names the file, the
+    expected and actual digest, and the rebuild command for the
+    artifact's class."""
+
+    def __init__(self, path: str, expected: str, actual: str,
+                 artifact: str = "", detail: str = "",
+                 rebuild_key: str = ""):
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+        self.artifact = artifact
+        rebuild = REBUILD.get(rebuild_key) or rebuild_hint(artifact)
+        super().__init__(
+            f"artifact {path} is CORRUPT"
+            + (f" ({detail})" if detail else "")
+            + f": sealed sha256 {expected} but content is {actual}"
+            + (f" [{artifact}]" if artifact else "")
+            + f" — {rebuild}"
+        )
+
+
+def env_fingerprint() -> dict:
+    """What produced an artifact — deterministic per container (no
+    clocks, no hostnames), so sealed writes of identical payloads are
+    byte-identical and the lifecycle journal's byte-stability pins
+    survive sealing."""
+    import numpy as np
+
+    return {
+        "python": ".".join(str(v) for v in sys.version_info[:3]),
+        "numpy": str(np.__version__),
+        "platform": sys.platform,
+    }
+
+
+def payload_digest(payload: dict) -> str:
+    """sha256 over the canonical (sorted, compact) JSON of the payload
+    WITHOUT its seal — the quantity the seal pins and loads verify."""
+    body = {k: v for k, v in payload.items() if k != SEAL_KEY}
+    blob = json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def count_corrupt(artifact: str, registry=None) -> None:
+    """One detected corruption: ``integrity.corrupt`` (the alert rule's
+    burn-rate input) plus the per-class ``integrity.corrupt.{artifact}``
+    ledger."""
+    from jama16_retina_tpu.obs import registry as registry_lib
+
+    reg = registry if registry is not None \
+        else registry_lib.default_registry()
+    reg.counter(
+        "integrity.corrupt",
+        help="sealed artifacts whose content checksum (or seal sidecar) "
+             "failed verification on load — any nonzero rate fires the "
+             "artifact_corrupt alert rule",
+    ).inc()
+    reg.counter(
+        f"integrity.corrupt.{artifact}",
+        help="per-class corrupt-artifact detections "
+             "(rawshard/journal/live/policy/compile_cache/profile/"
+             "canary/ledger)",
+    ).inc()
+
+
+# ---------------------------------------------------------------------------
+# The one atomic write seam
+# ---------------------------------------------------------------------------
+
+
+def atomic_write_bytes(path: str, blob: bytes,
+                       fsync: bool = True) -> None:
+    """tmp in the same directory + fsync + ``os.replace``: a reader (or
+    a process resuming after kill -9 at ANY point in here) sees either
+    the old artifact or the new one, never a torn file. The
+    ``integrity.write`` fault site damages/fails the payload
+    (torn/bitflip/truncate/ENOSPC drills); ``integrity.write.commit``
+    sits between durability and publish — a latency plan there holds
+    the window open for the kill -9 drill. ``fsync=False`` keeps the
+    rename-only atomicity for REGENERATED snapshots on hot paths (the
+    ``.prom`` scrape file): a scraper needs never-torn, not durable —
+    an fsync per telemetry flush would tax the loop for nothing."""
+    blob = faultinject.corrupt("integrity.write", blob)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        faultinject.check("integrity.write.commit")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    """Atomic publish of a plain-text artifact (the ``telemetry.prom``
+    exposition snapshot): same seam, no seal — the consumer is a
+    scrape parser, not this codebase."""
+    atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
+
+
+def rename(src: str, dst: str) -> None:
+    """Atomic move/publish of an existing file (quarantine moves, log
+    rotation). Same-filesystem ``os.replace`` semantics; centralized
+    here so graftlint's ``artifacts`` rule can keep every durable
+    rename inside this module."""
+    os.replace(src, dst)
+
+
+def write_json(path: str, obj, indent: "int | None" = 1,
+               sort_keys: bool = False, default=None,
+               trailing_newline: bool = False) -> None:
+    """Plain (NON-atomic, unsealed) JSON write for report/dump-grade
+    files — blackbox dumps, bench/report outputs, baselines. Exists so
+    graftlint's ``artifacts`` rule can insist every ``json.dump`` in
+    the repo flows through integrity/artifact.py: the caller chose
+    plain semantics, it did not hand-roll them."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=indent, sort_keys=sort_keys,
+                  default=default)
+        if trailing_newline:
+            f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# Sealed JSON artifacts
+# ---------------------------------------------------------------------------
+
+
+def make_seal(payload: dict, schema: str, version) -> dict:
+    return {
+        "seal_version": SEAL_VERSION,
+        "schema": schema,
+        "schema_version": version,
+        "sha256": payload_digest(payload),
+        "env": env_fingerprint(),
+    }
+
+
+def write_sealed_json(path: str, payload: dict, schema: str,
+                      version) -> str:
+    """Atomically publish ``payload`` with its embedded ``__seal__``.
+    The payload's own keys stay at the top level (every pre-seal reader
+    of these formats keeps working); the seal is one reserved key."""
+    doc = dict(payload)
+    doc.pop(SEAL_KEY, None)
+    doc[SEAL_KEY] = make_seal(doc, schema, version)
+    blob = (json.dumps(doc, indent=1, sort_keys=True) + "\n").encode()
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    atomic_write_bytes(path, blob)
+    return path
+
+
+def verify_payload(doc: dict, path: str, artifact: str = "",
+                   registry=None, rebuild_key: str = "") -> "dict | None":
+    """Verify an already-parsed sealed document IN PLACE and return its
+    seal (None = legacy unsealed file — tolerated on load, flagged
+    STALE by fsck). Raises :class:`ArtifactCorrupt` (and counts it) on
+    a digest mismatch. Split out of :func:`read_sealed_json` so loaders
+    can run their own format/version checks FIRST — a hand-bumped
+    version must keep raising the loader's own typed error, not a
+    digest mismatch."""
+    seal = doc.pop(SEAL_KEY, None)
+    if seal is None:
+        return None
+    actual = payload_digest(doc)
+    expected = str(seal.get("sha256", ""))
+    if actual != expected:
+        count_corrupt(artifact or str(seal.get("schema", "unknown")),
+                      registry=registry)
+        raise ArtifactCorrupt(path, expected, actual, artifact=artifact,
+                              rebuild_key=rebuild_key)
+    return seal
+
+
+def read_sealed_json(path: str, artifact: str = "",
+                     registry=None) -> "tuple[dict, dict | None]":
+    """(payload, seal|None) with the digest verified. OSError /
+    JSONDecodeError propagate — callers keep their existing torn-file
+    semantics; only a parseable-but-mismatched file is CORRUPT."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path} is not a JSON object artifact")
+    seal = verify_payload(doc, path, artifact=artifact, registry=registry)
+    return doc, seal
+
+
+# ---------------------------------------------------------------------------
+# Sidecar seals for binary artifacts
+# ---------------------------------------------------------------------------
+
+
+def sidecar_path(path: str) -> str:
+    return path + ".seal.json"
+
+
+def write_seal_sidecar(path: str, schema: str, version,
+                       extra: "dict | None" = None,
+                       blob: "bytes | None" = None) -> str:
+    """Seal a binary artifact that already sits at ``path``: a sealed
+    JSON sidecar pins its byte size and sha256 (the digest of the FILE,
+    not of JSON). Pass ``blob`` (the bytes the writer INTENDED) when
+    available — the sidecar then pins the intended content, so damage
+    injected into the write itself (the ``integrity.write`` chaos
+    drills) is detectable instead of being sealed over. The sidecar
+    itself is a sealed artifact, so a torn sidecar is detected like
+    any other."""
+    if blob is not None:
+        size = len(blob)
+        digest = hashlib.sha256(blob).hexdigest()
+    else:
+        size = os.path.getsize(path)
+        digest = sha256_file(path)
+    payload = {
+        "target": os.path.basename(path),
+        "bytes": size,
+        "sha256": digest,
+        **(extra or {}),
+    }
+    return write_sealed_json(sidecar_path(path), payload, schema, version)
+
+
+def verify_sidecar(path: str, artifact: str = "",
+                   registry=None) -> str:
+    """Check a binary artifact against its seal sidecar. Returns
+    ``"ok"`` (verified) or ``"unsealed"`` (no sidecar — legacy);
+    raises :class:`ArtifactCorrupt` (counted) when the sidecar's
+    pinned size/digest disagrees with the file, or the sidecar itself
+    fails its own seal."""
+    sc = sidecar_path(path)
+    if not os.path.exists(sc):
+        return "unsealed"
+    payload, _seal = read_sealed_json(sc, artifact=artifact,
+                                      registry=registry)
+    want_bytes = int(payload.get("bytes", -1))
+    if not os.path.exists(path) or os.path.getsize(path) != want_bytes:
+        have = os.path.getsize(path) if os.path.exists(path) else -1
+        count_corrupt(artifact or "sidecar", registry=registry)
+        raise ArtifactCorrupt(
+            path, f"{want_bytes} bytes", f"{have} bytes",
+            artifact=artifact, detail="size mismatch vs seal sidecar",
+        )
+    actual = sha256_file(path)
+    expected = str(payload.get("sha256", ""))
+    if actual != expected:
+        count_corrupt(artifact or "sidecar", registry=registry)
+        raise ArtifactCorrupt(path, expected, actual, artifact=artifact)
+    return "ok"

@@ -1,0 +1,773 @@
+"""Repo-wide workdir fsck: verify every durable artifact class
+(ISSUE 13).
+
+``fsck_workdir`` walks one workdir and verifies everything the stack
+persists — sealed JSON artifacts (lifecycle journal + ``live.json``,
+serve policies, rawshard manifests, compile-cache manifests, quality
+profiles), seal-sidecar'd binaries (rawshard shards via their manifest
+digests, canary ``.npz``, compile-cache entries), JSONL logs (torn-line
+scan), blackbox dumps, and the CROSS-ARTIFACT consistency no single
+loader can see: ``live.json`` members exist with a restorable
+checkpoint structure, the journal's terminal state agrees with the live
+pointer, rawshard manifests agree with their shards' bytes, cache
+entries agree with their sidecars.
+
+Findings are classified:
+
+  * ``CORRUPT``    — bytes disagree with a seal/digest/size the writer
+    pinned, a sealed artifact no longer parses, or a cross-referenced
+    file is missing: the state is WRONG.
+  * ``STALE``      — readable but outdated: unsealed legacy artifacts,
+    old schema versions, an interrupted transcode's partial coverage.
+    Report-only; the finding names the rebuild command.
+  * ``ORPHAN``     — a file its manifest does not claim (stray shard,
+    sidecar without target, dead ``.tmp`` leftovers).
+  * ``REPAIRABLE`` — damage with a lossless automatic fix (torn JSONL
+    lines the tolerant reader already skips).
+
+``repair_workdir`` applies each finding's repair action: DERIVABLE
+artifacts (policy, profiles, compile-cache entries/manifests, rawshard
+shards with a reachable source) are deleted so their owners rebuild
+them on demand — the finding names the exact rebuild command;
+non-derivable ones (journal, live pointer, canary) are MOVED to
+``<workdir>/quarantine/`` with a sealed, journaled ledger; torn JSONL
+files are rewritten without their torn lines. Nothing named by an
+in-flight lifecycle cycle or reachable from ``live.json`` is ever
+touched — if the journal itself is unreadable, the whole lifecycle
+directory is left alone (reported, not repaired): repairing blind is
+how a half-done rollout gets destroyed.
+
+CLI: ``scripts/graftfsck.py`` (text + ``--json``, exit 0 clean /
+1 findings / 2 internal error, ``--repair``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+# Artifact classes the walk recognizes (the inventory table in
+# docs/RELIABILITY.md §Durable state mirrors this list).
+CLASSES = (
+    "journal", "live", "policy", "profile", "canary",
+    "rawshard", "compile_cache", "jsonl", "blackbox", "checkpoint",
+    "ledger", "other",
+)
+
+_CANDIDATE_RE = re.compile(r"^candidate-(\d{4})$")
+_TMP_RE = re.compile(r"\.tmp(\.\d+)?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class FsckFinding:
+    """One verification failure. ``status`` is the taxonomy above;
+    ``repair`` the action ``repair_workdir`` would take (``delete`` /
+    ``quarantine`` / ``trim-manifest`` / ``rewrite`` / None =
+    operator-only); ``detail`` says what disagreed and how to
+    rebuild."""
+
+    path: str
+    artifact: str
+    status: str
+    detail: str
+    repair: "str | None" = None
+
+    def render(self) -> str:
+        act = f" [repair: {self.repair}]" if self.repair else ""
+        return f"{self.status} {self.artifact} {self.path}: " \
+               f"{self.detail}{act}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FsckReport:
+    workdir: str
+    findings: list
+    checked: dict          # class -> {"count": n, "bytes": b}
+    protected: list        # paths pinned by live.json / open cycle
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def by_status(self) -> dict:
+        out: dict = {}
+        for f in self.findings:
+            out.setdefault(f.status, []).append(f)
+        return out
+
+    def as_dict(self) -> dict:
+        return {
+            "workdir": self.workdir,
+            "clean": self.clean,
+            "findings": [f.as_dict() for f in self.findings],
+            "checked": self.checked,
+            "protected": sorted(self.protected),
+            "counts": {s: len(fs) for s, fs in self.by_status().items()},
+        }
+
+
+def _rel(workdir: str, path: str) -> str:
+    try:
+        return os.path.relpath(path, workdir)
+    except ValueError:  # pragma: no cover - cross-drive on win
+        return path
+
+
+def _has_checkpoint_structure(member_dir: str) -> bool:
+    """Light 'restorable' probe (the deep proof is the engine restore
+    the chaos drill performs): the member dir carries at least one
+    step directory under best/ or latest/ (utils/checkpoint layout),
+    or is itself a non-empty orbax-style directory."""
+    if not os.path.isdir(member_dir):
+        return False
+    for sub in ("best", "latest"):
+        d = os.path.join(member_dir, sub)
+        if os.path.isdir(d) and any(
+            s.isdigit() for s in os.listdir(d)
+        ):
+            return True
+    # A bare checkpoint dir (tests point members at orbax roots
+    # directly): any numeric step child counts.
+    return any(s.isdigit() for s in os.listdir(member_dir))
+
+
+def protected_paths(workdir: str) -> "tuple[set, bool]":
+    """(paths pinned against repair/GC, journal_readable). Pinned:
+    everything ``live.json`` names, every string an OPEN journal
+    cycle's entries carry that resolves to an existing path, and the
+    journal + live pointer themselves while a cycle is open. An
+    unreadable journal returns journal_readable=False — callers must
+    then refuse to touch the lifecycle directory at all."""
+    pinned: set = set()
+    lc_dir = os.path.join(workdir, "lifecycle")
+    live_path = os.path.join(lc_dir, "live.json")
+    journal_path = os.path.join(lc_dir, "journal.json")
+    readable = True
+    if os.path.exists(live_path):
+        # Raw read, digest deliberately NOT verified here: pinning from
+        # a possibly-corrupt pointer only ever protects MORE (and the
+        # walk reports/counts the corruption separately).
+        try:
+            with open(live_path) as f:
+                doc = json.load(f)
+            for m in doc.get("member_dirs", ()):
+                pinned.add(os.path.abspath(m))
+        except Exception:  # noqa: BLE001 - unreadable live pointer
+            readable = False
+    if os.path.exists(journal_path):
+        try:
+            with open(journal_path) as f:
+                doc = json.load(f)
+            doc.pop(artifact_lib.SEAL_KEY, None)
+            entries = list(doc.get("entries", ()))
+        except Exception:  # noqa: BLE001 - corrupt journal
+            return pinned, False
+        terminal = ("COMMIT", "ROLLBACK")
+        if entries and entries[-1].get("state") not in terminal:
+            cycle = entries[-1].get("cycle")
+            pinned.add(os.path.abspath(journal_path))
+            pinned.add(os.path.abspath(live_path))
+            for e in entries:
+                if e.get("cycle") != cycle:
+                    continue
+                for v in _strings_in(e):
+                    p = v if os.path.isabs(v) else os.path.join(
+                        workdir, v
+                    )
+                    if os.path.exists(p):
+                        pinned.add(os.path.abspath(p))
+    return pinned, readable
+
+
+def _strings_in(obj) -> list:
+    out = []
+    if isinstance(obj, str):
+        out.append(obj)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            out.extend(_strings_in(v))
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            out.extend(_strings_in(v))
+    return out
+
+
+def _is_protected(path: str, pinned: set) -> bool:
+    p = os.path.abspath(path)
+    for root in pinned:
+        if p == root or p.startswith(root + os.sep):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Per-class checks
+# ---------------------------------------------------------------------------
+
+
+def _check_sealed_json(path: str, artifact: str, findings: list,
+                       registry=None) -> "dict | None":
+    """Parse + digest-verify one sealed JSON artifact. Returns the
+    payload (seal stripped) or None after recording a finding.
+    Unsealed legacy files return their payload AND record STALE."""
+    try:
+        doc, seal = artifact_lib.read_sealed_json(
+            path, artifact=artifact, registry=registry
+        )
+    except artifact_lib.ArtifactCorrupt as e:
+        findings.append(FsckFinding(
+            path=path, artifact=artifact, status="CORRUPT",
+            detail=str(e),
+            repair=("delete" if artifact in _DERIVABLE else "quarantine"),
+        ))
+        return None
+    except (OSError, ValueError) as e:
+        findings.append(FsckFinding(
+            path=path, artifact=artifact, status="CORRUPT",
+            detail=f"unparseable ({type(e).__name__}: {e}) — "
+                   + artifact_lib.REBUILD.get(
+                       _REBUILD_KEY.get(artifact, ""), "inspect"),
+            repair=("delete" if artifact in _DERIVABLE else "quarantine"),
+        ))
+        return None
+    if seal is None:
+        findings.append(FsckFinding(
+            path=path, artifact=artifact, status="STALE",
+            detail="unsealed legacy artifact (written before ISSUE 13); "
+                   "rewrite by its owner seals it — "
+                   + artifact_lib.REBUILD.get(
+                       _REBUILD_KEY.get(artifact, ""), "rewrite"),
+        ))
+    return doc
+
+
+# Derivable classes: repair deletes them (owners rebuild on demand).
+_DERIVABLE = {"policy", "profile", "compile_cache"}
+
+_REBUILD_KEY = {
+    "journal": "lifecycle.journal",
+    "live": "lifecycle.live",
+    "policy": "serve.policy",
+    "profile": "quality.profile",
+    "canary": "quality.canary",
+    "rawshard": "rawshard.manifest",
+    "compile_cache": "compile_cache.manifest",
+    "ledger": "integrity.ledger",
+}
+
+
+def _check_rawshard(mpath: str, findings: list, checked: dict,
+                    registry=None) -> None:
+    shard_dir = os.path.dirname(mpath)
+    m = _check_sealed_json(mpath, "rawshard", findings,
+                           registry=registry)
+    if m is None:
+        return
+    claimed: set = set()
+    for e in m.get("shards", ()):
+        for fk, sk, dk in (("images", "images_bytes", "images_sha256"),
+                           ("grades", "grades_bytes", "grades_sha256")):
+            name = e.get(fk)
+            if not name:
+                continue
+            claimed.add(name)
+            p = os.path.join(shard_dir, name)
+            if not os.path.exists(p):
+                findings.append(FsckFinding(
+                    path=p, artifact="rawshard", status="CORRUPT",
+                    detail=f"shard named by manifest {mpath} is "
+                           f"missing — {artifact_lib.REBUILD['rawshard.shard']}",
+                    repair="trim-manifest",
+                ))
+                continue
+            size = os.path.getsize(p)
+            checked.setdefault("rawshard", {"count": 0, "bytes": 0})
+            checked["rawshard"]["count"] += 1
+            checked["rawshard"]["bytes"] += size
+            if size != e.get(sk):
+                findings.append(FsckFinding(
+                    path=p, artifact="rawshard", status="CORRUPT",
+                    detail=f"shard is {size} bytes, manifest pins "
+                           f"{e.get(sk)} — "
+                           + artifact_lib.REBUILD["rawshard.shard"],
+                    repair="trim-manifest",
+                ))
+                continue
+            want = e.get(dk)
+            if want:
+                have = artifact_lib.sha256_file(p)
+                if have != want:
+                    artifact_lib.count_corrupt("rawshard",
+                                               registry=registry)
+                    findings.append(FsckFinding(
+                        path=p, artifact="rawshard", status="CORRUPT",
+                        detail=f"shard sha256 {have} != manifest's "
+                               f"{want} (bit rot) — "
+                               + artifact_lib.REBUILD["rawshard.shard"],
+                        repair="trim-manifest",
+                    ))
+    covered = sum(int(e.get("records", 0)) for e in m.get("shards", ()))
+    if covered != int(m.get("num_records", covered)):
+        findings.append(FsckFinding(
+            path=mpath, artifact="rawshard", status="STALE",
+            detail=f"manifest covers {covered} of "
+                   f"{m.get('num_records')} records (interrupted or "
+                   "repaired transcode) — "
+                   + artifact_lib.REBUILD["rawshard.manifest"],
+        ))
+    # Strays: .npy files beside a VALID manifest that it doesn't claim.
+    split = str(m.get("split", ""))
+    for name in sorted(os.listdir(shard_dir)):
+        if (name.endswith(".npy") and name.startswith(split + "-")
+                and name not in claimed):
+            findings.append(FsckFinding(
+                path=os.path.join(shard_dir, name), artifact="rawshard",
+                status="ORPHAN",
+                detail=f"shard not claimed by manifest {mpath}",
+                repair="quarantine",
+            ))
+
+
+def _check_compile_cache(mpath: str, findings: list, checked: dict,
+                         registry=None) -> None:
+    cache_dir = os.path.dirname(mpath)
+    m = _check_sealed_json(mpath, "compile_cache", findings,
+                           registry=registry)
+    if m is None:
+        return
+    for name in sorted(os.listdir(cache_dir)):
+        p = os.path.join(cache_dir, name)
+        if name.endswith(".jex"):
+            checked.setdefault("compile_cache", {"count": 0, "bytes": 0})
+            checked["compile_cache"]["count"] += 1
+            checked["compile_cache"]["bytes"] += os.path.getsize(p)
+            try:
+                status = artifact_lib.verify_sidecar(
+                    p, artifact="compile_cache", registry=registry
+                )
+            except artifact_lib.ArtifactCorrupt as e:
+                findings.append(FsckFinding(
+                    path=p, artifact="compile_cache", status="CORRUPT",
+                    detail=str(e), repair="delete",
+                ))
+                continue
+            if status == "unsealed":
+                findings.append(FsckFinding(
+                    path=p, artifact="compile_cache", status="STALE",
+                    detail="entry has no seal sidecar (pre-ISSUE 13); "
+                           + artifact_lib.REBUILD["compile_cache.entry"],
+                ))
+        elif name.endswith(".jex.seal.json"):
+            target = p[: -len(".seal.json")]
+            if not os.path.exists(target):
+                findings.append(FsckFinding(
+                    path=p, artifact="compile_cache", status="ORPHAN",
+                    detail="seal sidecar without its entry",
+                    repair="delete",
+                ))
+
+
+def _check_jsonl(path: str, findings: list, checked: dict) -> None:
+    torn = 0
+    total = 0
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                total += 1
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError:
+                    torn += 1
+    except OSError as e:  # pragma: no cover - unreadable log
+        findings.append(FsckFinding(
+            path=path, artifact="jsonl", status="CORRUPT",
+            detail=f"unreadable ({e})", repair="quarantine",
+        ))
+        return
+    checked.setdefault("jsonl", {"count": 0, "bytes": 0})
+    checked["jsonl"]["count"] += 1
+    checked["jsonl"]["bytes"] += os.path.getsize(path)
+    if torn:
+        findings.append(FsckFinding(
+            path=path, artifact="jsonl", status="REPAIRABLE",
+            detail=f"{torn}/{total} torn JSONL line(s) (readers "
+                   "tolerate them; rewrite drops them losslessly)",
+            repair="rewrite",
+        ))
+
+
+def _check_live_cross_refs(workdir: str, findings: list,
+                           registry=None) -> None:
+    lc_dir = os.path.join(workdir, "lifecycle")
+    live_path = os.path.join(lc_dir, "live.json")
+    journal_path = os.path.join(lc_dir, "journal.json")
+    members: "list | None" = None
+    if os.path.exists(live_path):
+        # Raw read (no digest verify): the walk already verified and
+        # reported/counted a corrupt live pointer once.
+        try:
+            with open(live_path) as f:
+                doc = json.load(f)
+            doc.pop(artifact_lib.SEAL_KEY, None)
+            members = [str(m) for m in doc.get("member_dirs", ())]
+        except Exception:  # noqa: BLE001 - already reported by the walk
+            members = None
+    if members is not None:
+        for m in members:
+            p = m if os.path.isabs(m) else os.path.join(workdir, m)
+            if not _has_checkpoint_structure(p):
+                findings.append(FsckFinding(
+                    path=p, artifact="checkpoint", status="CORRUPT",
+                    detail=f"live.json names this member but no "
+                           "restorable checkpoint structure exists "
+                           "(best/, latest/, or a step dir) — the "
+                           "serving engine cannot rebuild; restore the "
+                           "member or re-point live.json",
+                ))
+    # Journal terminal state vs the live pointer: a COMMITted cycle
+    # with no pointer means the promote's pointer write was lost.
+    if os.path.exists(journal_path):
+        try:
+            with open(journal_path) as f:
+                doc = json.load(f)
+            doc.pop(artifact_lib.SEAL_KEY, None)
+            entries = list(doc.get("entries", ()))
+        except Exception:  # noqa: BLE001 - reported by the walk
+            return
+        if entries and entries[-1].get("state") == "COMMIT" \
+                and members is None and not os.path.exists(live_path):
+            findings.append(FsckFinding(
+                path=journal_path, artifact="journal", status="CORRUPT",
+                detail="journal's newest cycle COMMITted a promote but "
+                       "live.json is missing — the blessed set is "
+                       "unknown; re-point live.json at the committed "
+                       "candidate (see the cycle's STAGED_ROLLOUT "
+                       "entry)",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# The walk
+# ---------------------------------------------------------------------------
+
+
+def fsck_workdir(workdir: str, registry=None) -> FsckReport:
+    """Verify every artifact class under ``workdir``. Read-only: the
+    report says what repair WOULD do; ``repair_workdir`` does it."""
+    workdir = os.path.abspath(workdir)
+    findings: list = []
+    checked: dict = {}
+
+    def count(cls: str, path: str) -> None:
+        checked.setdefault(cls, {"count": 0, "bytes": 0})
+        checked[cls]["count"] += 1
+        try:
+            checked[cls]["bytes"] += os.path.getsize(path)
+        except OSError:  # pragma: no cover
+            pass
+
+    pinned, journal_readable = protected_paths(workdir)
+    for base, dirs, files in os.walk(workdir):
+        dirs[:] = sorted(d for d in dirs if d != "quarantine")
+        in_blackbox = os.path.basename(
+            os.path.dirname(base)
+        ) == "blackbox" or os.path.basename(base) == "blackbox"
+        for name in sorted(files):
+            path = os.path.join(base, name)
+            if _TMP_RE.search(name):
+                findings.append(FsckFinding(
+                    path=path, artifact="other", status="ORPHAN",
+                    detail="dead temp file from an interrupted atomic "
+                           "write (inert: readers only see the "
+                           "published path)",
+                    repair="delete",
+                ))
+                continue
+            if name.endswith(".rawshard.json"):
+                count("rawshard", path)
+                _check_rawshard(path, findings, checked,
+                                registry=registry)
+            elif name == "MANIFEST.json":
+                count("compile_cache", path)
+                _check_compile_cache(path, findings, checked,
+                                     registry=registry)
+            elif name == "journal.json":
+                count("journal", path)
+                _check_sealed_json(path, "journal", findings,
+                                   registry=registry)
+            elif name == "live.json":
+                count("live", path)
+                _check_sealed_json(path, "live", findings,
+                                   registry=registry)
+            elif name.endswith(".seal.json"):
+                if name.endswith(".jex.seal.json"):
+                    continue  # _check_compile_cache owns those
+                target = path[: -len(".seal.json")]
+                if not os.path.exists(target):
+                    findings.append(FsckFinding(
+                        path=path,
+                        artifact=("canary" if target.endswith(".npz")
+                                  else "other"),
+                        status="ORPHAN",
+                        detail="seal sidecar without its target",
+                        repair="delete",
+                    ))
+            elif name.endswith(".npz"):
+                count("canary", path)
+                try:
+                    status = artifact_lib.verify_sidecar(
+                        path, artifact="canary", registry=registry
+                    )
+                except artifact_lib.ArtifactCorrupt as e:
+                    findings.append(FsckFinding(
+                        path=path, artifact="canary", status="CORRUPT",
+                        detail=str(e), repair="quarantine",
+                    ))
+                    continue
+                if status == "unsealed" and "canary" in name:
+                    findings.append(FsckFinding(
+                        path=path, artifact="canary", status="STALE",
+                        detail="canary artifact has no seal sidecar "
+                               "(pre-ISSUE 13); re-save with "
+                               "obs/quality.save_canary to seal it",
+                    ))
+            elif name.endswith(".jsonl"):
+                _check_jsonl(path, findings, checked)
+            elif name.endswith(".json") and not in_blackbox:
+                # Sniff sealed/known JSON artifacts by content.
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    doc = None
+                if not isinstance(doc, dict):
+                    continue
+                if doc.get("format") == "jama16.serve_policy":
+                    count("policy", path)
+                    _check_sealed_json(path, "policy", findings,
+                                       registry=registry)
+                elif doc.get("kind") == "quality_profile":
+                    count("profile", path)
+                    _check_sealed_json(path, "profile", findings,
+                                       registry=registry)
+                elif doc.get("kind") == "integrity_ledger":
+                    count("ledger", path)
+                    _check_sealed_json(path, "ledger", findings,
+                                       registry=registry)
+            elif in_blackbox and name == "meta.json":
+                count("blackbox", path)
+                try:
+                    with open(path) as f:
+                        json.load(f)
+                except (OSError, ValueError) as e:
+                    findings.append(FsckFinding(
+                        path=path, artifact="blackbox",
+                        status="CORRUPT",
+                        detail=f"dump metadata unparseable ({e})",
+                        repair="quarantine",
+                    ))
+    _check_live_cross_refs(workdir, findings, registry=registry)
+    if not journal_readable:
+        # Repairing blind destroys rollout state: flag loudly.
+        findings.append(FsckFinding(
+            path=os.path.join(workdir, "lifecycle"), artifact="journal",
+            status="CORRUPT",
+            detail="the lifecycle journal (or live pointer) is "
+                   "unreadable, so open-cycle protection cannot be "
+                   "computed — --repair will NOT touch the lifecycle "
+                   "directory; inspect it by hand",
+        ))
+    return FsckReport(
+        workdir=workdir, findings=findings, checked=checked,
+        protected=sorted(pinned),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Repair
+# ---------------------------------------------------------------------------
+
+
+def _append_ledger(workdir: str, actions: list) -> str:
+    """Sealed, journaled quarantine/repair ledger: each repair run
+    appends its actions (read-modify-write through the sealed writer,
+    same discipline as the lifecycle journal)."""
+    qdir = os.path.join(workdir, "quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    path = os.path.join(qdir, "ledger.json")
+    entries: list = []
+    if os.path.exists(path):
+        try:
+            doc, _ = artifact_lib.read_sealed_json(
+                path, artifact="ledger"
+            )
+            entries = list(doc.get("actions", ()))
+        except Exception:  # noqa: BLE001 - a corrupt ledger must not
+            entries = []   # block repairing everything else
+    entries.extend(actions)
+    artifact_lib.write_sealed_json(path, {
+        "kind": "integrity_ledger", "actions": entries,
+    }, schema="integrity.ledger", version=1)
+    return path
+
+
+def _quarantine(workdir: str, path: str) -> str:
+    qdir = os.path.join(workdir, "quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    base = os.path.basename(path)
+    dst = os.path.join(qdir, base)
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = os.path.join(qdir, f"{base}.{n}")
+    artifact_lib.rename(path, dst)
+    return dst
+
+
+def _trim_manifest(shard_path: str) -> "str | None":
+    """Drop the manifest entry claiming a corrupt/missing shard (and
+    delete the shard pair): the manifest returns to a valid PARTIAL
+    state — exactly what an interrupted transcode leaves — so
+    ``transcode_shards.py`` resume rebuilds precisely the trimmed
+    shards."""
+    shard_dir = os.path.dirname(shard_path)
+    name = os.path.basename(shard_path)
+    for mname in os.listdir(shard_dir):
+        if not mname.endswith(".rawshard.json"):
+            continue
+        mpath = os.path.join(shard_dir, mname)
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        m.pop(artifact_lib.SEAL_KEY, None)
+        hit = [e for e in m.get("shards", ())
+               if e.get("images") == name or e.get("grades") == name]
+        if not hit:
+            continue
+        m["shards"] = [e for e in m["shards"] if e not in hit]
+        for e in hit:
+            for k in ("images", "grades"):
+                p = os.path.join(shard_dir, e.get(k, ""))
+                if e.get(k) and os.path.exists(p):
+                    os.unlink(p)
+        artifact_lib.write_sealed_json(
+            mpath, m, schema="rawshard.manifest",
+            version=m.get("version", 1),
+        )
+        return mpath
+    return None
+
+
+def repair_workdir(workdir: str, report: "FsckReport | None" = None,
+                   registry=None) -> dict:
+    """Apply every finding's repair action (see module docstring).
+    Returns the ledger dict {actions: [...], skipped: [...]} — also
+    appended to ``<workdir>/quarantine/ledger.json`` (sealed) and
+    counted under ``integrity.repaired``."""
+    from jama16_retina_tpu.obs import registry as registry_lib
+
+    workdir = os.path.abspath(workdir)
+    if report is None:
+        report = fsck_workdir(workdir, registry=registry)
+    pinned, journal_readable = protected_paths(workdir)
+    reg = registry if registry is not None \
+        else registry_lib.default_registry()
+    c_repaired = reg.counter(
+        "integrity.repaired",
+        help="fsck repair actions applied (derivable artifacts "
+             "deleted for on-demand rebuild, non-derivable ones "
+             "quarantined, torn JSONL rewritten)",
+    )
+    actions: list = []
+    skipped: list = []
+    lc_dir = os.path.join(workdir, "lifecycle")
+    trimmed: set = set()
+    for f in report.findings:
+        if not f.repair:
+            continue
+        if _is_protected(f.path, pinned):
+            skipped.append({"path": f.path, "why": "protected "
+                            "(live.json / open lifecycle cycle)"})
+            continue
+        if not journal_readable and os.path.abspath(f.path).startswith(
+                os.path.abspath(lc_dir) + os.sep):
+            skipped.append({"path": f.path, "why": "lifecycle journal "
+                            "unreadable; repairing blind is refused"})
+            continue
+        if not os.path.exists(f.path) and f.repair != "trim-manifest":
+            # trim-manifest's target IS allowed to be missing (a lost
+            # shard): the repair edits the manifest, not the shard.
+            continue
+        try:
+            if f.repair == "delete":
+                size = os.path.getsize(f.path)
+                os.unlink(f.path)
+                sc = artifact_lib.sidecar_path(f.path)
+                if os.path.exists(sc):
+                    os.unlink(sc)
+                actions.append({"action": "delete", "path": f.path,
+                                "artifact": f.artifact, "bytes": size,
+                                "rebuild": f.detail})
+            elif f.repair == "quarantine":
+                dst = _quarantine(workdir, f.path)
+                # The seal sidecar travels with its binary: leaving it
+                # behind would be a fresh ORPHAN finding (and the
+                # quarantined file would lose its seal pairing for
+                # later forensics).
+                sc = artifact_lib.sidecar_path(f.path)
+                sc_dst = None
+                if os.path.exists(sc):
+                    sc_dst = _quarantine(workdir, sc)
+                actions.append({"action": "quarantine", "path": f.path,
+                                "artifact": f.artifact, "moved_to": dst,
+                                **({"sidecar_moved_to": sc_dst}
+                                   if sc_dst else {})})
+            elif f.repair == "trim-manifest":
+                if f.path in trimmed:
+                    continue
+                mpath = _trim_manifest(f.path)
+                trimmed.add(f.path)
+                actions.append({"action": "trim-manifest",
+                                "path": f.path, "artifact": f.artifact,
+                                "manifest": mpath,
+                                "rebuild": artifact_lib.REBUILD[
+                                    "rawshard.shard"]})
+            elif f.repair == "rewrite":
+                kept: list = []
+                with open(f.path, encoding="utf-8",
+                          errors="replace") as fh:
+                    for line in fh:
+                        if not line.strip():
+                            continue
+                        try:
+                            json.loads(line)
+                            kept.append(line if line.endswith("\n")
+                                        else line + "\n")
+                        except json.JSONDecodeError:
+                            pass
+                artifact_lib.atomic_write_text(f.path, "".join(kept))
+                actions.append({"action": "rewrite", "path": f.path,
+                                "artifact": f.artifact,
+                                "kept_lines": len(kept)})
+            else:  # pragma: no cover - unknown action
+                skipped.append({"path": f.path,
+                                "why": f"unknown repair {f.repair!r}"})
+                continue
+            c_repaired.inc()
+        except OSError as e:  # pragma: no cover - fs race
+            skipped.append({"path": f.path, "why": f"OSError: {e}"})
+    ledger = {"actions": actions, "skipped": skipped}
+    if actions:
+        _append_ledger(workdir, actions)
+    return ledger

@@ -1,0 +1,298 @@
+"""Unified retention GC: a workdir must not grow without bound
+(ISSUE 13).
+
+Until this module NOTHING was ever garbage-collected: blackbox dumps
+accumulated one-per-reason-per-run forever, compile-cache entries for
+every (bucket, mesh, dtype) ever served stayed on disk, telemetry JSONL
+grew monotonically, and every lifecycle cycle's candidate checkpoint
+set survived its own rollback. One dry-run-first policy covers all of
+it:
+
+  * BLACKBOX — keep the newest ``obs.blackbox_keep`` dump dirs (the
+    flight recorder enforces the same cap at dump time; this is the
+    offline sweep for workdirs written by older code).
+  * COMPILE CACHE — entry files LRU-evicted (by mtime) above
+    ``integrity.cache_max_bytes``; the manifest is never collected, an
+    evicted entry recompiles on the next warm-up.
+  * TELEMETRY — a metrics JSONL above ``integrity.telemetry_max_bytes``
+    rotates to ``<name>.1`` (older rotations and ``.prev`` files
+    deleted). Offline only — never run against a live run's log.
+  * CHECKPOINTS — retired lifecycle candidate roots
+    (``lifecycle/candidate-NNNN``) and canary-pre backups of CLOSED
+    cycles beyond the newest ``integrity.keep_candidate_cycles``.
+    Within a checkpoint dir, orbax's own ``max_to_keep`` retention
+    owns step-level GC — this layer collects whole retired sets.
+
+THE PIN (tested): nothing reachable from ``live.json`` or named by an
+OPEN journal cycle is ever planned, let alone deleted — and an
+unreadable journal freezes the lifecycle/checkpoint classes entirely.
+
+``plan_retention`` is a pure function of the filesystem state (same
+state ⇒ identical plan, so the dry-run ledger and the apply ledger
+match — pinned); ``apply_plan`` executes exactly the plan, appends a
+sealed GC ledger at ``<workdir>/integrity/gc-ledger.json``, and counts
+``integrity.gc.deleted{.class}`` / ``integrity.gc.bytes``. Driven by
+``scripts/graftfsck.py --gc [--apply]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.integrity.fsck import _is_protected, protected_paths
+
+_CANDIDATE_RE = re.compile(r"^candidate-(\d+)$")
+_CANARY_BACKUP_RE = re.compile(r"^canary-pre-(\d+)\.npz$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One planned GC action: ``kind`` is ``delete`` (file or tree) or
+    ``rotate`` (JSONL size rotation)."""
+
+    kind: str
+    path: str
+    cls: str           # blackbox | compile_cache | telemetry | checkpoint
+    bytes: int
+    reason: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class RetentionPlan:
+    workdir: str
+    actions: list
+    pinned: list
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(a.bytes for a in self.actions)
+
+    def ledger(self) -> dict:
+        """The ledger this plan implies — IDENTICAL for dry-run and
+        apply by construction (apply executes exactly these actions)."""
+        return {
+            "workdir": self.workdir,
+            "actions": [a.as_dict() for a in self.actions],
+            "total_bytes": self.total_bytes,
+            "pinned": sorted(self.pinned),
+        }
+
+
+def _tree_bytes(path: str) -> int:
+    if os.path.isfile(path):
+        return os.path.getsize(path)
+    total = 0
+    for base, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(base, f))
+            except OSError:  # pragma: no cover
+                pass
+    return total
+
+
+def plan_retention(workdir: str, cfg) -> RetentionPlan:
+    """Compute the GC plan for ``workdir`` under ``cfg`` (an
+    ExperimentConfig — reads ``cfg.integrity.*`` and
+    ``cfg.obs.blackbox_keep``). Pure over the filesystem state: walks,
+    sizes, and mtime order only — no clock, no randomness — so two
+    plans over the same state are identical (the dry-run-equals-apply
+    ledger pin)."""
+    workdir = os.path.abspath(workdir)
+    icfg = cfg.integrity
+    actions: list = []
+    pinned, journal_readable = protected_paths(workdir)
+
+    def plan(kind: str, path: str, cls: str, reason: str) -> None:
+        if _is_protected(path, pinned):
+            return
+        # A tree delete must also be refused when a PINNED path lives
+        # INSIDE it (live.json pointing into an old candidate root —
+        # deleting the parent would eat the blessed member).
+        p = os.path.abspath(path)
+        if any(root.startswith(p + os.sep) for root in pinned):
+            return
+        actions.append(Action(
+            kind=kind, path=path, cls=cls, bytes=_tree_bytes(path),
+            reason=reason,
+        ))
+
+    # 1) Blackbox dumps: newest obs.blackbox_keep survive.
+    keep = int(cfg.obs.blackbox_keep)
+    bb = os.path.join(workdir, "blackbox")
+    if keep > 0 and os.path.isdir(bb):
+        dumps = sorted(
+            (os.path.join(bb, n) for n in os.listdir(bb)
+             if os.path.isdir(os.path.join(bb, n))),
+            key=lambda p: (os.path.getmtime(p), p),
+        )
+        for p in dumps[: max(0, len(dumps) - keep)]:
+            plan("delete", p, "blackbox",
+                 f"beyond obs.blackbox_keep={keep} (oldest first)")
+
+    # 2) Compile-cache entries: LRU by mtime above cache_max_bytes.
+    cap = int(icfg.cache_max_bytes)
+    if cap > 0:
+        for base, dirs, files in os.walk(workdir):
+            dirs[:] = sorted(d for d in dirs if d != "quarantine")
+            if "MANIFEST.json" not in files:
+                continue
+            entries = []
+            for n in sorted(files):
+                if n.endswith(".jex"):
+                    p = os.path.join(base, n)
+                    sc = artifact_lib.sidecar_path(p)
+                    size = os.path.getsize(p) + (
+                        os.path.getsize(sc) if os.path.exists(sc) else 0
+                    )
+                    entries.append((os.path.getmtime(p), p, size))
+            total = sum(s for _, _, s in entries)
+            for _mt, p, size in sorted(entries):
+                if total <= cap:
+                    break
+                plan("delete", p, "compile_cache",
+                     f"cache over integrity.cache_max_bytes={cap}; "
+                     "LRU-evicted (recompiles on next warm-up)")
+                total -= size
+
+    # 3) Telemetry JSONL rotation. Order matters at apply time (the
+    #    ledger executes in plan order): an EXISTING .1 that a planned
+    #    rotation would land on is deleted BEFORE the rotate — never
+    #    after, which would unlink the freshly rotated current log. A
+    #    .1 whose base is NOT rotating is the one allowed rotation and
+    #    is kept; .prev backups (RunLog fresh-rotation leftovers) are
+    #    always superseded.
+    tcap = int(icfg.telemetry_max_bytes)
+    if tcap > 0:
+        for base, dirs, files in os.walk(workdir):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("quarantine", "blackbox")
+            )
+            for n in sorted(files):
+                p = os.path.join(base, n)
+                if n.endswith(".jsonl.prev"):
+                    plan("delete", p, "telemetry",
+                         "superseded backup file")
+                elif n.endswith(".jsonl") and os.path.getsize(p) > tcap:
+                    if os.path.exists(p + ".1"):
+                        plan("delete", p + ".1", "telemetry",
+                             "superseded rotation (its base rotates "
+                             "onto it this run)")
+                    plan("rotate", p, "telemetry",
+                         f"over integrity.telemetry_max_bytes={tcap}; "
+                         "rotated to .1 (offline runs only — resume "
+                         "best-tracking replays the fresh file)")
+
+    # 4) Retired lifecycle candidate sets + canary backups. An
+    #    unreadable journal freezes this class: collecting candidates
+    #    blind could eat a half-done rollout's work.
+    lc = os.path.join(workdir, "lifecycle")
+    if journal_readable and os.path.isdir(lc):
+        jpath = os.path.join(lc, "journal.json")
+        closed: list = []
+        open_cycle = -1
+        if os.path.exists(jpath):
+            try:
+                with open(jpath) as f:
+                    doc = json.load(f)
+                doc.pop(artifact_lib.SEAL_KEY, None)
+                entries = list(doc.get("entries", ()))
+            except Exception:  # noqa: BLE001 - raced; freeze the class
+                entries = None
+            if entries is None:
+                return RetentionPlan(workdir=workdir, actions=actions,
+                                     pinned=sorted(pinned))
+            terminal = ("COMMIT", "ROLLBACK")
+            by_cycle: dict = {}
+            for e in entries:
+                by_cycle.setdefault(e.get("cycle"), []).append(e)
+            for c, es in by_cycle.items():
+                if es[-1].get("state") in terminal:
+                    closed.append(int(c))
+                else:
+                    open_cycle = int(c)
+            closed.sort()
+        keep_c = set(closed[-max(0, int(icfg.keep_candidate_cycles)):])
+        for n in sorted(os.listdir(lc)):
+            p = os.path.join(lc, n)
+            m = _CANDIDATE_RE.match(n) or _CANARY_BACKUP_RE.match(n)
+            if not m:
+                continue
+            cyc = int(m.group(1))
+            if cyc == open_cycle or cyc in keep_c or cyc not in closed:
+                continue
+            plan("delete", p, "checkpoint",
+                 f"candidate artifacts of closed cycle {cyc} beyond "
+                 "integrity.keep_candidate_cycles="
+                 f"{icfg.keep_candidate_cycles}")
+    return RetentionPlan(workdir=workdir, actions=actions,
+                         pinned=sorted(pinned))
+
+
+def apply_plan(plan: RetentionPlan, registry=None) -> dict:
+    """Execute EXACTLY the planned actions (the dry-run ledger is the
+    apply ledger), append the sealed GC ledger, count every deletion."""
+    import shutil
+
+    from jama16_retina_tpu.obs import registry as registry_lib
+
+    reg = registry if registry is not None \
+        else registry_lib.default_registry()
+    c_deleted = reg.counter(
+        "integrity.gc.deleted",
+        help="files/trees removed by the retention GC, all classes",
+    )
+    c_bytes = reg.counter(
+        "integrity.gc.bytes",
+        help="bytes reclaimed by the retention GC",
+    )
+    executed: list = []
+    for a in plan.actions:
+        if not os.path.exists(a.path):
+            continue
+        try:
+            if a.kind == "rotate":
+                artifact_lib.rename(a.path, a.path + ".1")
+            elif os.path.isdir(a.path):
+                shutil.rmtree(a.path)
+            else:
+                os.unlink(a.path)
+                sc = artifact_lib.sidecar_path(a.path)
+                if os.path.exists(sc):
+                    os.unlink(sc)
+        except OSError:  # pragma: no cover - fs race
+            continue
+        reg.counter(
+            f"integrity.gc.deleted.{a.cls}",
+            help="retention-GC removals per artifact class "
+                 "(blackbox/compile_cache/telemetry/checkpoint)",
+        ).inc()
+        c_deleted.inc()
+        c_bytes.inc(a.bytes)
+        executed.append(a.as_dict())
+    ledger = dict(plan.ledger())
+    ledger["executed"] = executed
+    idir = os.path.join(plan.workdir, "integrity")
+    os.makedirs(idir, exist_ok=True)
+    path = os.path.join(idir, "gc-ledger.json")
+    prior: list = []
+    if os.path.exists(path):
+        try:
+            doc, _ = artifact_lib.read_sealed_json(path,
+                                                   artifact="ledger")
+            prior = list(doc.get("runs", ()))
+        except Exception:  # noqa: BLE001 - a corrupt ledger must not
+            prior = []     # block the GC itself; fsck reports it
+    prior.append({"actions": executed,
+                  "total_bytes": ledger["total_bytes"]})
+    artifact_lib.write_sealed_json(path, {
+        "kind": "integrity_ledger", "runs": prior,
+    }, schema="integrity.ledger", version=1)
+    return ledger

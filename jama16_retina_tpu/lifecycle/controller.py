@@ -46,7 +46,8 @@ import numpy as np
 from absl import logging as absl_logging
 
 from jama16_retina_tpu.configs import ExperimentConfig
-from jama16_retina_tpu.lifecycle.journal import Journal, _atomic_write_json
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.lifecycle.journal import Journal
 from jama16_retina_tpu.obs import alerts as obs_alerts
 from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import registry as obs_registry
@@ -800,11 +801,11 @@ def _default_retrain(ctl: LifecycleController, cand_root: str) -> list:
             mcfg, ctl.data_dir, dst,
             seed=cfg.train.seed + 1000 * (cycle + 1) + m,
         )
-        _atomic_write_json(marker, {
+        artifact_lib.write_sealed_json(marker, {
             "cycle": cycle, "init_from": src, "steps": steps,
             "best_auc": result.get("best_auc"),
             "t": round(time.time(), 3),
-        })
+        }, schema="lifecycle.retrain_marker", version=1)
         out.append(dst)
     return out
 

@@ -31,18 +31,11 @@ import json
 import os
 import time
 
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+
 
 FORMAT = "jama16.lifecycle"
 VERSION = 1
-
-
-def _atomic_write_json(path: str, obj: dict) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=1, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
 
 
 class Journal:
@@ -84,6 +77,13 @@ class Journal:
                     f"{doc.get('format')!r} v{doc.get('version')!r}; this "
                     f"code reads {FORMAT} v{VERSION}"
                 )
+            # Seal check AFTER the format/version refusals above (a
+            # hand-bumped version must keep its own error): a journal
+            # whose sealed digest disagrees with its content raises
+            # typed ArtifactCorrupt — a controller must never resume a
+            # rollout from silently-damaged state (ISSUE 13).
+            artifact_lib.verify_payload(doc, self.path,
+                                        artifact="journal")
             self.entries = list(doc.get("entries", ()))
 
     # -- reads -------------------------------------------------------------
@@ -141,25 +141,29 @@ class Journal:
         }
         self.entries.append(entry)
         os.makedirs(self.dir, exist_ok=True)
-        _atomic_write_json(self.path, {
+        artifact_lib.write_sealed_json(self.path, {
             "format": FORMAT, "version": VERSION, "entries": self.entries,
-        })
+        }, schema="lifecycle.journal", version=VERSION)
         return entry
 
     # -- the live pointer --------------------------------------------------
 
     def read_live(self) -> "list[str] | None":
         """The blessed serving checkpoint set (None = never written:
-        serve whatever the deployment config names)."""
+        serve whatever the deployment config names). Seal-verified: a
+        corrupt pointer raises ArtifactCorrupt instead of rebuilding
+        the engine from garbage member paths."""
         if not os.path.exists(self.live_path):
             return None
-        with open(self.live_path) as f:
-            return list(json.load(f)["member_dirs"])
+        doc, _seal = artifact_lib.read_sealed_json(
+            self.live_path, artifact="live"
+        )
+        return list(doc["member_dirs"])
 
     def write_live(self, member_dirs) -> None:
         os.makedirs(self.dir, exist_ok=True)
-        _atomic_write_json(self.live_path, {
+        artifact_lib.write_sealed_json(self.live_path, {
             "format": FORMAT, "version": VERSION,
             "member_dirs": list(member_dirs),
             "t": round(self._now(), 3),
-        })
+        }, schema="lifecycle.live", version=VERSION)

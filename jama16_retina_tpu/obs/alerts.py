@@ -169,6 +169,13 @@ def reliability_rules(cfg) -> list:
         "serve.scaler.saturated", ">=", 1.0, for_seconds=120.0,
         reason="scaler_saturated",
     ))
+    # Durable-state integrity (ISSUE 13): ANY detected artifact
+    # corruption (a sealed checksum or seal sidecar failing on load)
+    # pages — silent on-disk rot is the failure mode the stack cannot
+    # otherwise see. Inactive until integrity.corrupt first counts.
+    rules.append(AlertRule(
+        "rate(integrity.corrupt)", ">", 0.0, reason="artifact_corrupt",
+    ))
     return rules
 
 
@@ -197,6 +204,7 @@ def manager_for(cfg, workdir: str, registry=None,
         blackbox_events=cfg.obs.blackbox_events,
         # No step loop to watch in a serving/predict process.
         slow_step_factor=float("inf"),
+        blackbox_keep=cfg.obs.blackbox_keep,
     )
     return AlertManager(rules, registry=registry, flight=flight,
                         on_fire=on_fire)

@@ -209,12 +209,17 @@ class Snapshotter:
             self.alerts.evaluate(snapshot=snap, runlog=self._log)
         if self._workdir:
             path = self._prom_path()
-            tmp = path + ".tmp"
             os.makedirs(self._workdir, exist_ok=True)
-            with open(tmp, "w") as f:
-                f.write(prometheus_text(snap))
-            # Atomic publish: a scraper never reads a half-written file.
-            os.replace(tmp, path)
+            # Atomic publish through the shared sealed-writer seam
+            # (integrity/artifact.py — unsealed text: the consumer is
+            # a scrape parser): a scraper never reads a half-written
+            # file.
+            from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+            # fsync=False: the snapshot regenerates every flush — a
+            # scraper needs never-torn (the rename), not durable.
+            artifact_lib.atomic_write_text(path, prometheus_text(snap),
+                                           fsync=False)
         self._last_flush = time.time()
         self.flushes += 1
         return snap

@@ -86,6 +86,19 @@ SITES = {
                       "rolls back)",
     "lifecycle.swap": "LifecycleController STAGED_ROLLOUT promote "
                       "(lifecycle/controller.py)",
+    "integrity.write": "sealed-artifact payload seam (integrity/"
+                       "artifact.atomic_write_bytes — EVERY durable "
+                       "writer: rawshard manifests+shards, lifecycle "
+                       "journal/live.json, serve policy, compile-cache "
+                       "manifest/entries, profiles, canary): corrupt-"
+                       "family kinds (torn/bitflip/truncate) damage "
+                       "the serialized blob, error kinds fail the "
+                       "write ENOSPC-style",
+    "integrity.write.commit": "between the sealed writer's tmp-file "
+                              "fsync and its atomic os.replace publish "
+                              "— a latency plan holds the window open "
+                              "for the kill -9 torn-write drill "
+                              "(integrity/artifact.py)",
 }
 
 # Error classes a JSON spec may name. Deliberately small: injected
@@ -103,6 +116,36 @@ _ERRORS = {
 class InjectedFault(RuntimeError):
     """Default exception for kind="error" entries that name no class —
     unambiguous in logs/dumps: this failure was asked for."""
+
+
+# Every plan kind. The corrupt FAMILY (data-damaging kinds delivered
+# via ``corrupt()`` at data-carrying seams) models the disk-fault
+# taxonomy of ISSUE 13's drills: "corrupt" (legacy: truncate-to-half +
+# XOR), "torn" (only a prefix of the bytes land — a non-atomic write
+# interrupted mid-flight), "bitflip" (one flipped bit mid-payload —
+# silent media rot a size check cannot see), "truncate" (the tail is
+# lost — a filesystem that acknowledged bytes it never wrote).
+# kind="error" with error="OSError" is the ENOSPC-style write failure.
+_KINDS = ("error", "latency", "corrupt", "torn", "bitflip", "truncate")
+_CORRUPT_KINDS = ("corrupt", "torn", "bitflip", "truncate")
+
+
+def _damage(kind: str, data: bytes) -> bytes:
+    """Deterministic byte damage per corrupt-family kind (no RNG: the
+    same plan always produces the same corpse, so fsck/test assertions
+    can pin exactly what the reader must detect)."""
+    if len(data) == 0:
+        return data
+    if kind == "torn":
+        return data[: max(1, len(data) // 3)]
+    if kind == "bitflip":
+        i = len(data) // 2
+        return data[:i] + bytes([data[i] ^ 0x01]) + data[i + 1:]
+    if kind == "truncate":
+        return data[: max(1, (len(data) * 3) // 4)]
+    # legacy "corrupt": truncate to half and XOR-flip every byte
+    half = data[: max(1, len(data) // 2)]
+    return bytes(b ^ 0xFF for b in half)
 
 
 @dataclass
@@ -204,10 +247,10 @@ def plan_from_spec(spec: "str | dict",
                 f"(allowed: {sorted(allowed)})"
             )
         kind = entry.get("kind", "error")
-        if kind not in ("error", "latency", "corrupt"):
+        if kind not in _KINDS:
             raise ValueError(
                 f"fault site {name!r}: unknown kind {kind!r} "
-                "(want error|latency|corrupt)"
+                f"(want {'|'.join(_KINDS)})"
             )
         err = entry.get("error", "")
         if err and err not in _ERRORS:
@@ -309,18 +352,20 @@ def check(site: str) -> None:
         return
     if s.kind == "error":
         raise s.make_error()
-    # kind == "corrupt" at a non-data seam: nothing to corrupt; treat
-    # as an error so the plan is never silently inert.
+    # A corrupt-family kind at a non-data seam: nothing to corrupt;
+    # treat as an error so the plan is never silently inert.
     raise s.make_error()
 
 
 def corrupt(site: str, data: bytes) -> bytes:
-    """Data-carrying seam hook (TFRecord payloads, image bytes):
-    returns ``data`` untouched unless an armed kind="corrupt" entry
-    fires, in which case the bytes are deterministically damaged
-    (truncated to half and XOR-flipped) so parsers downstream see a
-    genuinely corrupt payload, not a magic sentinel. kind="error"/
-    "latency" entries behave exactly like ``check``."""
+    """Data-carrying seam hook (TFRecord payloads, image bytes, sealed
+    artifact blobs): returns ``data`` untouched unless an armed
+    corrupt-family entry fires, in which case the bytes are
+    deterministically damaged per the kind — "corrupt" (truncate-to-
+    half + XOR), "torn", "bitflip", "truncate" (see ``_damage``) — so
+    parsers downstream see a genuinely corrupt payload, not a magic
+    sentinel. kind="error"/"latency" entries behave exactly like
+    ``check``."""
     plan = _active
     if plan is None:
         return data
@@ -336,5 +381,4 @@ def corrupt(site: str, data: bytes) -> bytes:
         return data
     if s.kind == "error":
         raise s.make_error()
-    half = data[: max(1, len(data) // 2)]
-    return bytes(b ^ 0xFF for b in half)
+    return _damage(s.kind, data)

@@ -42,6 +42,7 @@ from collections import deque
 
 import numpy as np
 
+from jama16_retina_tpu.integrity import artifact as artifact_lib
 from jama16_retina_tpu.obs import registry as registry_lib
 from jama16_retina_tpu.obs import trace as trace_lib
 
@@ -67,6 +68,7 @@ class FlightRecorder:
         slow_step_factor: float = 4.0,
         profile_hook=None,
         enabled: bool = True,
+        blackbox_keep: int = 20,
     ):
         self.enabled = bool(enabled)
         self.workdir = workdir
@@ -81,6 +83,11 @@ class FlightRecorder:
         )
         self.blackbox_events = int(blackbox_events)
         self.slow_step_factor = float(slow_step_factor)
+        # Cross-run dump cap (ISSUE 13 satellite): one-per-reason-per-
+        # run still grows without bound on a long-lived supervisor
+        # restarting runs; after every dump the OLDEST dump dirs beyond
+        # ``blackbox_keep`` are pruned (<= 0 disables the cap).
+        self.blackbox_keep = int(blackbox_keep)
         self._profile_hook = profile_hook
         self._profile_fired = False
         self._step_times: deque = deque(maxlen=self.STEP_WINDOW)
@@ -243,18 +250,58 @@ class FlightRecorder:
         with open(os.path.join(d, "trace.jsonl"), "w") as f:
             for ev in events:
                 f.write(json.dumps(ev) + "\n")
-        with open(os.path.join(d, "registry.json"), "w") as f:
-            json.dump(self._registry.snapshot(), f, indent=1)
-        with open(os.path.join(d, "config.json"), "w") as f:
-            json.dump(self._config, f, indent=1, default=str)
-        with open(os.path.join(d, "meta.json"), "w") as f:
-            json.dump({
-                "reason": reason,
-                "t": round(time.time(), 3),
-                "step": self._last_step,
-                "n_trace_events": len(events),
-                "trace_events_dropped": self._tracer.dropped(),
-                **meta,
-            }, f, indent=1)
+        artifact_lib.write_json(
+            os.path.join(d, "registry.json"), self._registry.snapshot()
+        )
+        artifact_lib.write_json(
+            os.path.join(d, "config.json"), self._config, default=str
+        )
+        artifact_lib.write_json(os.path.join(d, "meta.json"), {
+            "reason": reason,
+            "t": round(time.time(), 3),
+            "step": self._last_step,
+            "n_trace_events": len(events),
+            "trace_events_dropped": self._tracer.dropped(),
+            **meta,
+        })
         self.dumps.append(d)
+        self._prune_blackbox()
         return d
+
+    def _prune_blackbox(self) -> None:
+        """Enforce the cross-run dump cap: keep the ``blackbox_keep``
+        NEWEST dump dirs under ``<workdir>/blackbox`` (by mtime —
+        per-run seq numbers restart, mtime orders across runs), delete
+        the rest oldest-first. Never touches dumps this run just wrote
+        unless the cap itself demands it (this run's are the newest).
+        Prunes are counted (``obs.blackbox_pruned``) so the GC is
+        ledgered like every other deletion (ISSUE 13)."""
+        if self.blackbox_keep <= 0:
+            return
+        try:
+            dirs = [
+                os.path.join(self.blackbox_dir, n)
+                for n in os.listdir(self.blackbox_dir)
+            ]
+            dirs = sorted(
+                (p for p in dirs if os.path.isdir(p)),
+                key=os.path.getmtime,
+            )
+        except OSError:  # pragma: no cover - racing cleanup
+            return
+        excess = dirs[: max(0, len(dirs) - self.blackbox_keep)]
+        if not excess:
+            return
+        import shutil
+
+        c = self._registry.counter(
+            "obs.blackbox_pruned",
+            help="blackbox dump directories deleted oldest-first to "
+                 "enforce the cross-run obs.blackbox_keep cap",
+        )
+        for p in excess:
+            try:
+                shutil.rmtree(p)
+                c.inc()
+            except OSError:  # pragma: no cover - racing cleanup
+                pass

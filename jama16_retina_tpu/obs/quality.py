@@ -50,6 +50,7 @@ import time
 import numpy as np
 from absl import logging as absl_logging
 
+from jama16_retina_tpu.integrity import artifact as artifact_lib
 from jama16_retina_tpu.obs import registry as registry_lib
 
 PROFILE_VERSION = 1
@@ -193,15 +194,13 @@ def build_profile(
 
 
 def save_profile(path: str, profile: dict) -> str:
-    """Atomic write (tmp + rename): a monitor loading mid-write must
-    never see a torn artifact — same publish rule as telemetry.prom."""
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(profile, f, indent=1)
-    os.replace(tmp, path)
-    return path
+    """Sealed atomic write (integrity/artifact.py, ISSUE 13): a monitor
+    loading mid-write must never see a torn artifact, and a bit-flipped
+    one must fail its content checksum instead of silently re-shaping
+    every PSI the monitor publishes."""
+    return artifact_lib.write_sealed_json(
+        path, profile, schema="quality.profile", version=PROFILE_VERSION
+    )
 
 
 def load_profile(path: str) -> dict:
@@ -216,6 +215,9 @@ def load_profile(path: str) -> dict:
         )
     if profile.get("kind") != "quality_profile":
         raise ValueError(f"{path!r} is not a quality profile artifact")
+    # Checksum after the version/kind refusals keep their own errors:
+    # bit rot raises typed ArtifactCorrupt, counted (ISSUE 13).
+    artifact_lib.verify_payload(profile, path, artifact="profile")
     return profile
 
 
@@ -258,6 +260,8 @@ def save_canary(path: str, images: np.ndarray,
     scores for the (checkpoint, bucket) being served. Without scores
     the first live run pins them (and a restart re-pins — persist the
     scored form for cross-run byte-stability)."""
+    import io
+
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     payload = {"images": np.asarray(images, np.uint8)}
     if scores is not None:
@@ -265,12 +269,24 @@ def save_canary(path: str, images: np.ndarray,
     # np.savez appends .npz itself when missing; return the name it
     # actually wrote so the value feeds obs.quality.canary_path as-is.
     out = path if path.endswith(".npz") else path + ".npz"
-    np.savez(out, **payload)
+    # Sealed atomic publish (ISSUE 13): serialize in memory, write
+    # through the one integrity.write seam, and pin size+sha256 in a
+    # seal sidecar — a half-written or bit-flipped canary must raise
+    # typed ArtifactCorrupt at load, never silently re-pin scores.
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    blob = buf.getvalue()
+    artifact_lib.atomic_write_bytes(out, blob)
+    artifact_lib.write_seal_sidecar(out, schema="quality.canary",
+                                    version=PROFILE_VERSION, blob=blob)
     return out
 
 
 def load_canary_file(path: str) -> tuple:
-    """(images, scores|None) from a save_canary .npz."""
+    """(images, scores|None) from a save_canary .npz; the seal sidecar
+    (when present — pre-seal artifacts load unsealed) is verified
+    first, raising counted ArtifactCorrupt on damage."""
+    artifact_lib.verify_sidecar(path, artifact="canary")
     with np.load(path) as z:
         images = np.asarray(z["images"], np.uint8)
         scores = (np.asarray(z["scores"], np.float64)

@@ -251,8 +251,9 @@ def chrome_trace(events: list) -> dict:
 
 
 def write_chrome_json(path: str, events: list) -> None:
-    with open(path, "w") as f:
-        json.dump(chrome_trace(events), f)
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    artifact_lib.write_json(path, chrome_trace(events), indent=None)
 
 
 _default = Tracer()

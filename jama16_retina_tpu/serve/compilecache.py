@@ -50,6 +50,7 @@ import pickle
 
 from absl import logging as absl_logging
 
+from jama16_retina_tpu.integrity import artifact as artifact_lib
 from jama16_retina_tpu.obs import faultinject
 
 CACHE_VERSION = 1
@@ -95,10 +96,10 @@ def fingerprint_hash(fp: dict) -> str:
 
 
 def _atomic_write_bytes(path: str, blob: bytes) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
+    # The shared sealed-writer seam (integrity/artifact.py): atomic
+    # tmp+fsync+rename plus the integrity.write fault sites, so the
+    # --chaos disk-fault drills cover cache entries too.
+    artifact_lib.atomic_write_bytes(path, blob)
 
 
 class CompileCache:
@@ -118,6 +119,7 @@ class CompileCache:
         os.makedirs(self.dir, exist_ok=True)
         reg = (registry if registry is not None
                else obs_registry.default_registry())
+        self._reg = reg
         self.c_hits = reg.counter(
             "serve.compile_cache.hits",
             help="per-bucket serving executables deserialized from the "
@@ -178,13 +180,19 @@ class CompileCache:
                     "serve.compile_cache_dir at a per-model directory) "
                     "and re-warm one engine construction"
                 )
+            # Sealed-content check last (the staleness refusals above
+            # keep their own typed errors): bit rot in the manifest
+            # raises ArtifactCorrupt, counted (ISSUE 13).
+            artifact_lib.verify_payload(
+                manifest, path, artifact="compile_cache",
+                rebuild_key="compile_cache.manifest",
+            )
             return
-        blob = json.dumps({
+        artifact_lib.write_sealed_json(path, {
             "version": CACHE_VERSION,
             "fingerprint": self.fp_hash,
             "detail": self.fingerprint,
-        }, indent=1, sort_keys=True).encode()
-        _atomic_write_bytes(path, blob)
+        }, schema="compile_cache.manifest", version=CACHE_VERSION)
 
     # -- entries -----------------------------------------------------------
 
@@ -212,6 +220,12 @@ class CompileCache:
             if not os.path.exists(path):
                 self.c_misses.inc()
                 return None
+            # Seal-sidecar verification BEFORE unpickling (ISSUE 13):
+            # a bit-flipped entry is a counted corruption + counted
+            # recompile, never bytes handed to pickle. Entries saved
+            # before sealing existed ("unsealed") still load.
+            artifact_lib.verify_sidecar(path, artifact="compile_cache",
+                                        registry=self._reg)
             from jax.experimental import serialize_executable
 
             with open(path, "rb") as f:
@@ -239,9 +253,12 @@ class CompileCache:
             payload, in_tree, out_tree = serialize_executable.serialize(
                 compiled
             )
-            _atomic_write_bytes(
-                self.entry_path(key),
-                pickle.dumps((payload, in_tree, out_tree)),
+            path = self.entry_path(key)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+            _atomic_write_bytes(path, blob)
+            artifact_lib.write_seal_sidecar(
+                path, schema="compile_cache.entry",
+                version=CACHE_VERSION, extra={"key": key}, blob=blob,
             )
             return True
         except Exception as e:  # noqa: BLE001 - cache is best-effort

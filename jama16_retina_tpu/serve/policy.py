@@ -51,6 +51,8 @@ import os
 
 from absl import logging as absl_logging
 
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+
 FORMAT = "jama16.serve_policy"
 VERSION = 1
 
@@ -201,20 +203,16 @@ def derive_policy(frontier: list, fingerprint: dict,
 
 
 def save_policy(path: str, policy: ServePolicy) -> str:
-    """Atomic tmp+rename write of the artifact (the rawshard-manifest
-    discipline: a torn policy file must never parse)."""
+    """Sealed atomic write of the artifact (integrity/artifact.py —
+    ISSUE 13: a torn policy file must never parse, and a bit-flipped
+    one must fail its content checksum on load)."""
     payload = policy.payload()
     payload["policy_version"] = (
         policy.version or _content_version(payload)
     )
-    d = os.path.dirname(os.path.abspath(path))
-    os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
-    return path
+    return artifact_lib.write_sealed_json(
+        path, payload, schema="serve.policy", version=VERSION
+    )
 
 
 def load_policy(path: str) -> ServePolicy:
@@ -248,6 +246,9 @@ def load_policy(path: str) -> ServePolicy:
             f"{sorted(missing)}) — re-derive with "
             "scripts/derive_serve_policy.py"
         )
+    # Content checksum last (after the typed staleness refusals keep
+    # their own errors): bit rot raises ArtifactCorrupt, counted.
+    artifact_lib.verify_payload(obj, path, artifact="policy")
     return ServePolicy(
         bucket_sizes=tuple(int(b) for b in obj["bucket_sizes"]),
         max_batch=int(obj["max_batch"]),

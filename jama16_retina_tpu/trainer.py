@@ -117,6 +117,7 @@ def _flight_for(cfg: ExperimentConfig, workdir: str,
         blackbox_events=cfg.obs.blackbox_events,
         slow_step_factor=(slow if slow > 0 else float("inf")),
         profile_hook=(profiler.arm if profiler is not None else None),
+        blackbox_keep=cfg.obs.blackbox_keep,
     )
 
 
@@ -1131,8 +1132,10 @@ def _load_or_write_run_meta(
             )
         return int(meta.get("seed", seed))
     os.makedirs(workdir, exist_ok=True)
-    with open(path, "w") as f:
-        json.dump({"seed": seed, "config": cfg_name}, f)
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    artifact_lib.write_json(path, {"seed": seed, "config": cfg_name},
+                            indent=None)
     return seed
 
 

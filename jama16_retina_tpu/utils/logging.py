@@ -69,7 +69,9 @@ class RunLog:
             stem, ext = os.path.splitext(self._name)
             self.path = os.path.join(self._workdir, f"{stem}.p{idx}{ext}")
         if self._fresh and os.path.exists(self.path):
-            os.replace(self.path, self.path + ".prev")
+            from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+            artifact_lib.rename(self.path, self.path + ".prev")
         self._fh = open(self.path, "a")
         if self._want_tb and idx == 0:
             import tensorflow as tf

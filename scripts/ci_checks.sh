@@ -8,6 +8,10 @@
 #       lint + the train.dtype seam smoke (ISSUE 11): a 2-step bf16
 #       fit + golden-curve parity gate (pass AND refusal drill) on
 #       synthetic data — scripts/mixedprec_smoke.py.
+#   bash scripts/ci_checks.sh --fsck-smoke
+#       lint + the durable-state integrity smoke (ISSUE 13): seed a
+#       sealed workdir, flip one byte, assert graftfsck exit 1 naming
+#       the artifact, --repair, assert exit 0 — scripts/fsck_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -26,6 +30,12 @@ fi
 if [[ "${1:-}" == "--mixedprec-smoke" ]]; then
     echo "== mixed-precision smoke (train.dtype seam) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/mixedprec_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--fsck-smoke" ]]; then
+    echo "== durable-state integrity smoke (graftfsck) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/fsck_smoke.py
     exit 0
 fi
 

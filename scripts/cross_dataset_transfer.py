@@ -160,8 +160,9 @@ def main() -> None:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "docs", "cross_dataset_transfer_r5.json",
     )
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1, default=float)
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    artifact_lib.write_json(path, out, default=float)
     print(json.dumps({"written": path}))
     if not args.keep:
         # ~600 MB of rendered TFRecords + checkpoints per run; the JSON

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""CI fsck smoke (ISSUE 13 satellite; scripts/ci_checks.sh --fsck-smoke):
+seed a tiny workdir of sealed artifacts, flip one byte, and assert the
+whole detect-and-repair chain end to end:
+
+  1. graftfsck on the fresh workdir exits 0 (sealing is self-clean);
+  2. after a one-byte flip in the serve-policy artifact it exits 1 and
+     the report NAMES the corrupted file;
+  3. ``--repair`` deletes the derivable corpse (quarantine-ledgered);
+  4. graftfsck exits 0 again, and ``obs_report --check-integrity``
+     agrees (exit 0 after, with a verdict present).
+
+Exit 0 = every step held; 1 = a step failed (message says which).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    from jama16_retina_tpu.lifecycle.journal import Journal
+    from jama16_retina_tpu.obs import quality as quality_lib
+    from jama16_retina_tpu.serve import policy as policy_lib
+
+    import numpy as np
+
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    fsck = os.path.join(_REPO, "scripts", "graftfsck.py")
+    report = os.path.join(_REPO, "scripts", "obs_report.py")
+
+    def run(*args) -> "subprocess.CompletedProcess":
+        return subprocess.run(
+            [sys.executable, *args], capture_output=True, text=True,
+            env=env, timeout=300,
+        )
+
+    with tempfile.TemporaryDirectory() as wd:
+        # Seed: a sealed policy + profile + a closed lifecycle journal.
+        pol = policy_lib.derive_policy(
+            [{"bucket": 8, "concurrency": 1, "images_per_sec": 10.0,
+              "p50_ms": 1.0, "p99_ms": 2.0}],
+            {"arch": "smoke"},
+        )
+        ppath = os.path.join(wd, "serve_policy.json")
+        policy_lib.save_policy(ppath, pol)
+        rng = np.random.default_rng(0)
+        quality_lib.save_profile(
+            os.path.join(wd, "profile.json"),
+            quality_lib.build_profile(rng.random(128),
+                                      thresholds=[{"threshold": 0.5}]),
+        )
+        j = Journal(os.path.join(wd, "lifecycle"))
+        j.append("DRIFT_DETECTED", cycle=0, reason="smoke")
+        j.append("ROLLBACK", cycle=0, cause="smoke")
+
+        r = run(fsck, wd)
+        if r.returncode != 0:
+            print(f"FAIL: fresh workdir not clean (exit {r.returncode})"
+                  f"\n{r.stdout}{r.stderr}")
+            return 1
+
+        # Flip one byte inside a string value (the checksum must catch
+        # what the parser cannot).
+        with open(ppath, "rb") as f:
+            blob = bytearray(f.read())
+        i = blob.find(b"smoke")
+        blob[i] ^= 0x01
+        with open(ppath, "wb") as f:
+            f.write(bytes(blob))
+
+        r = run(fsck, wd, "--json")
+        if r.returncode != 1:
+            print(f"FAIL: corrupted workdir exited {r.returncode}, "
+                  f"want 1\n{r.stdout}{r.stderr}")
+            return 1
+        doc = json.loads(r.stdout)
+        named = [f["path"] for f in doc["findings"]]
+        if not any(ppath in p for p in named):
+            print(f"FAIL: fsck did not name {ppath}; findings: {named}")
+            return 1
+
+        r = run(fsck, wd, "--repair")
+        if r.returncode != 0:
+            print(f"FAIL: --repair left findings (exit {r.returncode})"
+                  f"\n{r.stdout}{r.stderr}")
+            return 1
+        r = run(fsck, wd)
+        if r.returncode != 0:
+            print(f"FAIL: post-repair fsck exit {r.returncode}"
+                  f"\n{r.stdout}{r.stderr}")
+            return 1
+        r = run(report, "--check-integrity", wd)
+        if r.returncode != 0:
+            print(f"FAIL: --check-integrity exit {r.returncode} after "
+                  f"repair\n{r.stdout}{r.stderr}")
+            return 1
+    print("fsck smoke: seed clean -> byte flip detected (exit 1, file "
+          "named) -> repaired -> clean (exit 0, --check-integrity 0)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

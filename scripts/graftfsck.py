@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""graftfsck — verify, repair, and garbage-collect a workdir's durable
+state (ISSUE 13; jama16_retina_tpu/integrity/).
+
+    python scripts/graftfsck.py <workdir>            # verify only
+    python scripts/graftfsck.py <workdir> --json     # machine output
+    python scripts/graftfsck.py <workdir> --repair   # fix + re-verify
+    python scripts/graftfsck.py <workdir> --gc           # GC dry run
+    python scripts/graftfsck.py <workdir> --gc --apply   # GC for real
+
+Exit codes (the API CI consumes): 0 = clean, 1 = findings (or a repair
+that could not restore cleanliness), 2 = internal error. Every run
+writes its verdict to ``<workdir>/integrity/fsck-last.json`` (sealed)
+— ``obs_report --check-integrity`` reads it, so a cron pairing
+``graftfsck`` + ``obs_report --check-integrity`` distinguishes "clean",
+"corrupt", and "never checked".
+
+``--repair`` deletes DERIVABLE corrupt artifacts (policy, profiles,
+compile-cache entries — their owners rebuild on demand; rawshard
+shards are trimmed from their manifest so the transcode resumes) and
+QUARANTINES non-derivable ones into ``<workdir>/quarantine/`` with a
+sealed ledger. Nothing reachable from ``live.json`` or an open
+lifecycle cycle is ever touched. ``--gc`` applies the retention policy
+(integrity/retention.py) — dry-run by default, ``--apply`` executes
+and appends the sealed GC ledger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _latest_corrupt_counter(workdir: str) -> float:
+    """The newest telemetry record's cumulative ``integrity.corrupt``
+    across the workdir's JSONL logs — pinned into the verdict so
+    ``obs_report --check-integrity`` can page on NEW corruption (the
+    counter having GROWN since the verdict) instead of on stale
+    cumulative history a repair already resolved."""
+    latest_t = None
+    val = 0.0
+    for base, dirs, files in os.walk(workdir):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("quarantine", "blackbox"))
+        for n in sorted(files):
+            if not n.endswith(".jsonl"):
+                continue
+            try:
+                with open(os.path.join(base, n), encoding="utf-8",
+                          errors="replace") as f:
+                    for line in f:
+                        if '"telemetry"' not in line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if rec.get("kind") != "telemetry":
+                            continue
+                        t = rec.get("t", 0)
+                        if latest_t is None or t >= latest_t:
+                            latest_t = t
+                            val = float(rec.get("counters", {}).get(
+                                "integrity.corrupt", 0))
+            except OSError:  # pragma: no cover - racing cleanup
+                continue
+    return val
+
+
+def _write_verdict(workdir: str, report, repaired: "dict | None") -> None:
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    idir = os.path.join(workdir, "integrity")
+    os.makedirs(idir, exist_ok=True)
+    import time
+
+    artifact_lib.write_sealed_json(
+        os.path.join(idir, "fsck-last.json"),
+        {
+            "kind": "integrity_fsck",
+            "t": round(time.time(), 3),
+            "corrupt_at_verdict": _latest_corrupt_counter(workdir),
+            "clean": report.clean,
+            "counts": {s: len(fs) for s, fs in report.by_status().items()},
+            "findings": [f.as_dict() for f in report.findings],
+            "checked": report.checked,
+            "repaired": repaired,
+        },
+        schema="integrity.fsck", version=1,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("workdir", help="workdir to verify")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--repair", action="store_true",
+                    help="apply repair actions, then re-verify")
+    ap.add_argument("--gc", action="store_true",
+                    help="run the retention policy (dry run unless "
+                         "--apply)")
+    ap.add_argument("--apply", action="store_true",
+                    help="with --gc: execute the plan and append the "
+                         "sealed GC ledger")
+    ap.add_argument("--config", default="smoke",
+                    help="config preset whose integrity.*/obs.* "
+                         "retention knobs drive --gc (default: smoke "
+                         "— i.e. the dataclass defaults)")
+    ap.add_argument("--set", action="append", default=[],
+                    dest="overrides", metavar="SECTION.FIELD=VALUE",
+                    help="config overrides for --gc, e.g. "
+                         "integrity.cache_max_bytes=34359738368 or "
+                         "obs.blackbox_keep=100")
+    args = ap.parse_args(argv)
+    try:
+        from jama16_retina_tpu.integrity import fsck as fsck_lib
+        from jama16_retina_tpu.integrity import retention as retention_lib
+
+        workdir = os.path.abspath(args.workdir)
+        if not os.path.isdir(workdir):
+            print(f"graftfsck: no such workdir: {workdir}",
+                  file=sys.stderr)
+            return 2
+
+        if args.gc:
+            from jama16_retina_tpu.configs import get_config, override
+
+            cfg = override(get_config(args.config), args.overrides)
+            plan = retention_lib.plan_retention(workdir, cfg)
+            ledger = plan.ledger()
+            ledger["applied"] = False
+            if args.apply:
+                ledger = retention_lib.apply_plan(plan)
+                ledger["applied"] = True
+            if args.json:
+                print(json.dumps(ledger, indent=1))
+            else:
+                mode = "APPLIED" if args.apply else "DRY RUN"
+                print(f"graftfsck --gc [{mode}]: "
+                      f"{len(plan.actions)} action(s), "
+                      f"{plan.total_bytes} bytes")
+                for a in plan.actions:
+                    print(f"  {a.kind} [{a.cls}] {a.path}: {a.reason}")
+            return 0
+
+        report = fsck_lib.fsck_workdir(workdir)
+        repaired = None
+        if args.repair and not report.clean:
+            repaired = fsck_lib.repair_workdir(workdir, report=report)
+            report = fsck_lib.fsck_workdir(workdir)
+        _write_verdict(workdir, report, repaired)
+        if args.json:
+            out = report.as_dict()
+            if repaired is not None:
+                out["repaired"] = repaired
+            print(json.dumps(out, indent=1))
+        else:
+            counts = {s: len(fs) for s, fs in report.by_status().items()}
+            print(f"graftfsck {workdir}: "
+                  + ("CLEAN" if report.clean else str(counts)))
+            for cls, c in sorted(report.checked.items()):
+                print(f"  checked {cls}: {c['count']} file(s), "
+                      f"{c['bytes']} bytes")
+            for f in report.findings:
+                print("  " + f.render())
+            if repaired is not None:
+                print(f"  repaired: {len(repaired['actions'])} "
+                      f"action(s), {len(repaired['skipped'])} skipped")
+        return 0 if report.clean else 1
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - exit-code API
+        print(f"graftfsck: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        import traceback
+
+        traceback.print_exc()
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

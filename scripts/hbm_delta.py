@@ -95,8 +95,9 @@ def main() -> None:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "docs", "hbm_delta_r5.json",
     )
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    artifact_lib.write_json(path, out)
     print(json.dumps({"written": path}))
 
 

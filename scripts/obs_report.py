@@ -58,6 +58,10 @@ import os
 import sys
 import time
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 
 # ---------------------------------------------------------------------------
 # Loading
@@ -376,8 +380,12 @@ def load_trace_events(path: str) -> list:
 def write_chrome_json(path: str, events: list) -> None:
     """The Chrome trace-event JSON object format — loadable by the
     Perfetto UI (https://ui.perfetto.dev) and chrome://tracing."""
-    with open(path, "w") as f:
-        json.dump({"traceEvents": list(events), "displayTimeUnit": "ms"}, f)
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    artifact_lib.write_json(
+        path, {"traceEvents": list(events), "displayTimeUnit": "ms"},
+        indent=None,
+    )
 
 
 def slowest_requests(events: list, n: int = 10) -> list:
@@ -1078,6 +1086,212 @@ def render_quality(records: list) -> "str | None":
     return "\n\n".join(out)
 
 
+def _load_sealed_quietly(path: str) -> "dict | None":
+    """A sealed JSON artifact for REPORTING: payload on success, a
+    {'__corrupt__': msg} sentinel when the seal fails (the report must
+    render the corruption, not crash on it), None when absent."""
+    if not os.path.exists(path):
+        return None
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    try:
+        doc, _seal = artifact_lib.read_sealed_json(path)
+        return doc
+    except artifact_lib.ArtifactCorrupt as e:
+        return {"__corrupt__": str(e)}
+    except (OSError, ValueError) as e:
+        return {"__corrupt__": f"{type(e).__name__}: {e}"}
+
+
+_CLASS_PATTERNS = (
+    ("journal", lambda n, p: n == "journal.json"),
+    ("live", lambda n, p: n == "live.json"),
+    ("rawshard", lambda n, p: n.endswith(".rawshard.json")
+        or n.endswith(".npy")),
+    ("compile_cache", lambda n, p: n == "MANIFEST.json"
+        or n.endswith(".jex") or n.endswith(".jex.seal.json")),
+    ("canary", lambda n, p: n.endswith(".npz")
+        or n.endswith(".npz.seal.json")),
+    ("policy", lambda n, p: "policy" in n and n.endswith(".json")),
+    ("profile", lambda n, p: "profile" in n and n.endswith(".json")),
+    ("blackbox", lambda n, p: f"{os.sep}blackbox{os.sep}" in p),
+    ("telemetry", lambda n, p: n.endswith(".jsonl")
+        or n.endswith(".jsonl.1") or n.endswith(".prom")),
+    ("checkpoint", lambda n, p: f"{os.sep}best{os.sep}" in p
+        or f"{os.sep}latest{os.sep}" in p),
+    ("quarantine", lambda n, p: f"{os.sep}quarantine{os.sep}" in p),
+)
+
+
+def workdir_bytes_by_class(workdir: str) -> dict:
+    """{class: {count, bytes}} by a cheap filename/path classifier (no
+    hashing — graftfsck owns verification; this is the obs_report
+    Integrity section's size table)."""
+    out: dict = {}
+    for base, _dirs, files in os.walk(workdir):
+        for n in files:
+            p = os.path.join(base, n)
+            cls = "other"
+            for name, match in _CLASS_PATTERNS:
+                if match(n, p):
+                    cls = name
+                    break
+            d = out.setdefault(cls, {"count": 0, "bytes": 0})
+            d["count"] += 1
+            try:
+                d["bytes"] += os.path.getsize(p)
+            except OSError:  # pragma: no cover
+                pass
+    return out
+
+
+def integrity_summary(workdir: str, records: list) -> "dict | None":
+    """The Integrity section's machine-readable form (--json twin;
+    ISSUE 13): corrupt/repair counters out of the latest telemetry
+    record, the last graftfsck verdict (age + counts), the GC and
+    quarantine ledgers, and workdir bytes by artifact class. None when
+    ``workdir`` is not a directory (file-mode reports have no workdir
+    to size)."""
+    if not workdir or not os.path.isdir(workdir):
+        return None
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    counters = telemetry[-1].get("counters", {}) if telemetry else {}
+    corrupt = {
+        k: v for k, v in counters.items()
+        if k == "integrity.corrupt" or k.startswith("integrity.corrupt.")
+    }
+    gc = {
+        k: v for k, v in counters.items()
+        if k.startswith("integrity.gc.") or k == "obs.blackbox_pruned"
+    }
+    fsck_last = _load_sealed_quietly(
+        os.path.join(workdir, "integrity", "fsck-last.json")
+    )
+    gc_ledger = _load_sealed_quietly(
+        os.path.join(workdir, "integrity", "gc-ledger.json")
+    )
+    q_ledger = _load_sealed_quietly(
+        os.path.join(workdir, "quarantine", "ledger.json")
+    )
+    out = {
+        "corrupt_counters": corrupt,
+        "repaired": counters.get("integrity.repaired", 0),
+        "gc_counters": gc,
+        "fsck": None,
+        "gc_ledger_runs": None,
+        "quarantine_actions": None,
+        "bytes_by_class": workdir_bytes_by_class(workdir),
+    }
+    if fsck_last is not None:
+        if "__corrupt__" in fsck_last:
+            out["fsck"] = {"corrupt": fsck_last["__corrupt__"]}
+        else:
+            out["fsck"] = {
+                "clean": bool(fsck_last.get("clean")),
+                "counts": fsck_last.get("counts", {}),
+                "t": fsck_last.get("t"),
+                "corrupt_at_verdict": fsck_last.get("corrupt_at_verdict"),
+            }
+    out["telemetry_t"] = telemetry[-1].get("t") if telemetry else None
+    if gc_ledger is not None and "__corrupt__" not in gc_ledger:
+        runs = gc_ledger.get("runs", [])
+        out["gc_ledger_runs"] = {
+            "runs": len(runs),
+            "last_actions": len(runs[-1]["actions"]) if runs else 0,
+            "last_bytes": runs[-1].get("total_bytes") if runs else 0,
+        }
+    if q_ledger is not None and "__corrupt__" not in q_ledger:
+        out["quarantine_actions"] = len(q_ledger.get("actions", []))
+    return out
+
+
+def render_integrity(workdir: str, records: list) -> "str | None":
+    s = integrity_summary(workdir, records)
+    if s is None:
+        return None
+    lines = ["== Integrity (durable state) =="]
+    if s["fsck"] is None:
+        lines.append("last fsck: NEVER RUN (blind — run "
+                     "scripts/graftfsck.py)")
+    elif "corrupt" in s["fsck"]:
+        lines.append(f"last fsck verdict UNREADABLE: {s['fsck']['corrupt']}")
+    else:
+        verdict = "CLEAN" if s["fsck"]["clean"] else str(s["fsck"]["counts"])
+        lines.append(f"last fsck: {verdict}")
+    if s["corrupt_counters"]:
+        lines.append("corrupt detections: " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(s["corrupt_counters"].items())
+        ))
+    else:
+        lines.append("corrupt detections: none counted")
+    if s["repaired"]:
+        lines.append(f"repairs applied: {s['repaired']:g}")
+    if s["gc_counters"]:
+        lines.append("GC counters: " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(s["gc_counters"].items())
+        ))
+    if s["gc_ledger_runs"]:
+        g = s["gc_ledger_runs"]
+        lines.append(f"GC ledger: {g['runs']} run(s), last "
+                     f"{g['last_actions']} action(s) / "
+                     f"{g['last_bytes']} bytes")
+    if s["quarantine_actions"]:
+        lines.append(f"quarantine ledger: {s['quarantine_actions']} "
+                     "action(s)")
+    rows = [
+        (cls, d["count"], d["bytes"])
+        for cls, d in sorted(s["bytes_by_class"].items(),
+                             key=lambda kv: -kv[1]["bytes"])
+    ]
+    lines.append(_table(rows, ("class", "files", "bytes")))
+    return "\n".join(lines)
+
+
+def check_integrity(workdir: str) -> tuple[int, str]:
+    """Exit-code mode mirroring --check-alerts (ISSUE 13): 0 the last
+    graftfsck verdict is clean and no corruption has been counted,
+    1 corruption evidence (a non-clean verdict or nonzero
+    integrity.corrupt counters), 2 no fsck verdict exists — the
+    workdir has never been checked (blind)."""
+    records = load_records(workdir) if os.path.isdir(workdir) else []
+    s = integrity_summary(workdir, records)
+    if s is None:
+        return 2, f"not a workdir: {workdir}"
+    if s["fsck"] is None:
+        return 2, ("no fsck verdict under <workdir>/integrity/ — run "
+                   "scripts/graftfsck.py first (exit 2 = blind, "
+                   "mirroring --check-alerts)")
+    if "corrupt" in s["fsck"]:
+        return 1, f"fsck verdict itself corrupt: {s['fsck']['corrupt']}"
+    total_corrupt = s["corrupt_counters"].get("integrity.corrupt", 0)
+    if not s["fsck"]["clean"]:
+        return 1, f"last fsck found {s['fsck']['counts']}"
+    # Corrupt counters are CUMULATIVE per run: evidence of NEW
+    # corruption is the counter having GROWN past the value the clean
+    # verdict pinned (graftfsck records corrupt_at_verdict) — a live
+    # run re-flushing its pre-repair cumulative count must not page
+    # forever. Verdicts from before that field existed fall back to a
+    # timestamp gate (only telemetry newer than the verdict pages).
+    at_verdict = s["fsck"].get("corrupt_at_verdict")
+    if total_corrupt and at_verdict is not None:
+        if total_corrupt > at_verdict:
+            return 1, (
+                f"integrity.corrupt grew {at_verdict:g} -> "
+                f"{total_corrupt:g} since the last clean fsck verdict "
+                "— new corruption detected"
+            )
+    elif total_corrupt:
+        verdict_t = s["fsck"].get("t")
+        tele_t = s.get("telemetry_t")
+        if (verdict_t is not None and tele_t is not None
+                and tele_t > verdict_t):
+            return 1, (f"integrity.corrupt={total_corrupt:g} in "
+                       "telemetry flushed AFTER the last clean fsck "
+                       "verdict — corruption detected since the repair")
+    return 0, "clean (last fsck clean, no corruption evidence newer "\
+              "than it)"
+
+
 def check_alerts(workdir: str) -> tuple[int, str]:
     """Exit-code mode mirroring --check-heartbeats: 0 quiet, 1 any rule
     currently FIRING (last `alert` record per rule), 2 a reference
@@ -1161,6 +1375,12 @@ def main(argv=None) -> int:
              "quality profile is configured but no drift data exists",
     )
     ap.add_argument(
+        "--check-integrity", metavar="WORKDIR", default=None,
+        help="exit-code mode (ISSUE 13): 0 last graftfsck verdict "
+             "clean + zero corrupt counters, 1 corruption evidence, "
+             "2 never fsck'd (blind)",
+    )
+    ap.add_argument(
         "--trace-out", metavar="CHROME_JSON", default=None,
         help="convert the blackbox/trace dump at PATH to Chrome "
              "trace-event JSON (open in https://ui.perfetto.dev)",
@@ -1182,9 +1402,13 @@ def main(argv=None) -> int:
         code, msg = check_alerts(args.check_alerts)
         print(msg)
         return code
+    if args.check_integrity:
+        code, msg = check_integrity(args.check_integrity)
+        print(msg)
+        return code
     if not args.path:
         ap.error("need a path (or --check-heartbeats / --check-alerts "
-                 "WORKDIR)")
+                 "/ --check-integrity WORKDIR)")
 
     if args.path.endswith(".prom"):
         with open(args.path) as f:
@@ -1233,6 +1457,10 @@ def main(argv=None) -> int:
             "serving_cost": serving_cost_summary(records),
             "router": router_summary(records),
             "lifecycle": lifecycle_summary(records),
+            "integrity": (
+                integrity_summary(args.path, records)
+                if os.path.isdir(args.path) else None
+            ),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
                 for p, b in sorted(latest_heartbeats(records).items())
@@ -1268,6 +1496,11 @@ def main(argv=None) -> int:
     if lcy:
         print()
         print(lcy)
+    if os.path.isdir(args.path):
+        integ = render_integrity(args.path, records)
+        if integ:
+            print()
+            print(integ)
     print()
     print(render_heartbeats(records))
     if events:

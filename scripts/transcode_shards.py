@@ -80,6 +80,13 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    # Arm env-driven fault plans (JAMA16_FAULTS) before any shard
+    # write: the ISSUE 13 disk-fault drills drive this CLI's
+    # integrity.write seam exactly like train/predict arm theirs.
+    from jama16_retina_tpu.obs import faultinject
+
+    faultinject.arm_from_env_or_config()
+
     from jama16_retina_tpu.data import rawshard
 
     for split in [s for s in args.splits.split(",") if s]:

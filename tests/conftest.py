@@ -200,6 +200,24 @@ _QUICK_TESTS = {
     "test_router.py::test_drain_finishes_in_flight_and_releases_engine",
     "test_router.py::test_policy_artifact_roundtrip_and_derivation",
     "test_router.py::test_policy_stale_fingerprint_refused",
+    # durable-state integrity (ISSUE 13): the numpy-cheap policy pins —
+    # sealed round trip, typed+counted corruption refusal, injected
+    # disk-fault detection, fsck classification, the repair/GC
+    # protection pins, and the artifacts lint rule; the subprocess
+    # CLI/kill -9 drills and the compile-cache/rawshard fixtures stay
+    # in the full tier
+    "test_integrity.py::test_sealed_roundtrip_and_seal_shape",
+    "test_integrity.py::test_sealing_is_deterministic",
+    "test_integrity.py::test_digest_mismatch_raises_typed_counted_with_rebuild",
+    "test_integrity.py::test_injected_disk_fault_is_always_detected",
+    "test_integrity.py::test_enospc_style_write_failure_keeps_old_artifact",
+    "test_integrity.py::test_journal_and_live_pointer_seal_detect_bitflip",
+    "test_integrity.py::test_fsck_classifies_all_four_statuses",
+    "test_integrity.py::test_repair_never_touches_open_cycle_or_live_members",
+    "test_integrity.py::test_retention_dry_run_ledger_matches_apply",
+    "test_integrity.py::test_retention_never_collects_live_or_open_cycle",
+    "test_integrity.py::test_artifacts_rule_flags_bare_writes_and_passes_routed",
+    "test_integrity.py::test_reliability_rules_include_artifact_corrupt",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

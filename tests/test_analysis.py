@@ -703,7 +703,7 @@ def test_lint_repo_clean():
 
 
 def test_repo_fault_sites_registry_matches_wired_seams():
-    """The declared vocabulary is exactly the seams PR 6/8/10/11/12
+    """The declared vocabulary is exactly the seams PR 6/8/10/11/12/13
     wired."""
     from jama16_retina_tpu.obs import faultinject
 
@@ -712,6 +712,7 @@ def test_repo_fault_sites_registry_matches_wired_seams():
         "engine.dispatch", "serve.router.dispatch",
         "serve.compile_cache.load", "trainer.step",
         "lifecycle.retrain", "lifecycle.gate", "lifecycle.swap",
+        "integrity.write", "integrity.write.commit",
     }
     assert all(desc for desc in faultinject.SITES.values())
 

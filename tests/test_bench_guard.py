@@ -313,6 +313,24 @@ def test_robustness_overhead_guard_pins_two_percent():
     assert extras["robustness_overhead_pct"] == 0.0
 
 
+def test_integrity_overhead_guard_pins_two_percent():
+    """The ISSUE 13 pin, same shared guard math: device_only with the
+    sealed-artifact layer's hot-path residue (unarmed integrity.write
+    seam branch per step + a full sealed publish every 25 steps) must
+    stay within 2% — checksum cost rides writes, never the hot loop."""
+    extras = {}
+    assert bench._integrity_overhead_guard(extras, 990.0, 1000.0)
+    assert extras["integrity_overhead_ok"] is True
+    assert extras["integrity_overhead_pct"] == pytest.approx(1.0)
+    extras = {}
+    assert not bench._integrity_overhead_guard(extras, 950.0, 1000.0)
+    assert extras["integrity_overhead_ok"] is False
+    assert extras["integrity_overhead_pct"] == pytest.approx(5.0)
+    extras = {}
+    assert bench._integrity_overhead_guard(extras, 1010.0, 1000.0)
+    assert extras["integrity_overhead_pct"] == 0.0
+
+
 def test_router_overhead_guard_pins_two_percent():
     """The ISSUE 12 pin, same shared guard math: the workload routed
     through a 1-replica Router must stay within 2% of calling the

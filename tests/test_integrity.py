@@ -1,0 +1,922 @@
+"""Durable-state integrity (ISSUE 13): sealed artifacts, disk-fault
+injection, repo-wide fsck/repair, and retention GC.
+
+The contract under test, layer by layer:
+
+  * every durable writer publishes through integrity/artifact.py's ONE
+    seam — atomic, sealed (schema/version/env + sha256), and any
+    corruption is a TYPED, COUNTED refusal on load, never silent;
+  * injected disk faults (torn/bitflip/truncate/ENOSPC at the
+    ``integrity.write`` family) and a literal kill -9 inside the
+    commit window are all detectable or harmless — no torn artifact is
+    ever readable;
+  * ``fsck_workdir`` classifies CORRUPT/STALE/ORPHAN/REPAIRABLE and
+    ``repair_workdir`` deletes derivable corpses / quarantines the
+    rest with a sealed ledger, NEVER touching live.json-reachable or
+    open-cycle state;
+  * ``plan_retention`` is dry-run-first (plan == apply ledger) and the
+    same protection pin holds for GC.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.integrity import fsck as fsck_lib
+from jama16_retina_tpu.integrity import retention as retention_lib
+from jama16_retina_tpu.lifecycle.journal import Journal
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import quality as quality_lib
+from jama16_retina_tpu.obs.registry import Registry
+from jama16_retina_tpu.serve import policy as policy_lib
+
+pytestmark = pytest.mark.integrity
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GRAFTFSCK = os.path.join(REPO_ROOT, "scripts", "graftfsck.py")
+
+
+def flip_byte(path: str, marker: "bytes | None" = None) -> None:
+    """One flipped bit — inside ``marker`` for JSON artifacts (keeps
+    the file parseable so the CHECKSUM, not the parser, must catch
+    it); mid-file for binaries."""
+    with open(path, "rb") as f:
+        blob = bytearray(f.read())
+    i = blob.find(marker) if marker else len(blob) // 2
+    assert i >= 0
+    blob[i] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+
+def seed_policy(path: str) -> "policy_lib.ServePolicy":
+    pol = policy_lib.derive_policy(
+        [{"bucket": 8, "concurrency": 2, "images_per_sec": 50.0,
+          "p50_ms": 2.0, "p99_ms": 4.0}],
+        {"arch": "marker"},
+    )
+    policy_lib.save_policy(path, pol)
+    return pol
+
+
+# ---------------------------------------------------------------------------
+# The sealed envelope
+# ---------------------------------------------------------------------------
+
+
+def test_sealed_roundtrip_and_seal_shape(tmp_path):
+    p = str(tmp_path / "a.json")
+    payload = {"format": "x", "version": 3, "vals": [1, 2, 3]}
+    artifact_lib.write_sealed_json(p, payload, schema="serve.policy",
+                                   version=3)
+    doc, seal = artifact_lib.read_sealed_json(p, artifact="policy")
+    assert doc == payload  # payload keys at the top level, seal stripped
+    assert seal["schema"] == "serve.policy"
+    assert seal["schema_version"] == 3
+    assert seal["seal_version"] == artifact_lib.SEAL_VERSION
+    assert len(seal["sha256"]) == 64
+    assert set(seal["env"]) == {"python", "numpy", "platform"}
+    # No temp leftovers: the write published via rename.
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+
+def test_sealing_is_deterministic(tmp_path):
+    """Same payload -> byte-identical sealed file (no clocks in the
+    seal): the lifecycle journal's byte-stability pins survive."""
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    payload = {"k": [1, 2], "s": "x"}
+    artifact_lib.write_sealed_json(a, payload, schema="s", version=1)
+    artifact_lib.write_sealed_json(b, payload, schema="s", version=1)
+    assert open(a, "rb").read() == open(b, "rb").read()
+
+
+def test_digest_mismatch_raises_typed_counted_with_rebuild(tmp_path):
+    p = str(tmp_path / "pol.json")
+    seed_policy(p)
+    flip_byte(p, marker=b"marker")
+    reg = Registry()
+    with pytest.raises(artifact_lib.ArtifactCorrupt) as ei:
+        artifact_lib.read_sealed_json(p, artifact="policy", registry=reg)
+    msg = str(ei.value)
+    assert p in msg                      # names the file
+    assert ei.value.expected != ei.value.actual
+    assert ei.value.expected in msg and ei.value.actual in msg
+    assert "derive_serve_policy" in msg  # names the rebuild command
+    assert reg.counter("integrity.corrupt").value == 1
+    assert reg.counter("integrity.corrupt.policy").value == 1
+
+
+def test_unsealed_legacy_file_loads_with_none_seal(tmp_path):
+    p = str(tmp_path / "legacy.json")
+    with open(p, "w") as f:
+        json.dump({"format": "old", "v": 1}, f)
+    doc, seal = artifact_lib.read_sealed_json(p)
+    assert seal is None and doc["format"] == "old"
+
+
+def test_sidecar_seal_detects_bitflip_and_size_change(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    blob = bytes(range(256)) * 8
+    artifact_lib.atomic_write_bytes(p, blob)
+    artifact_lib.write_seal_sidecar(p, schema="quality.canary",
+                                    version=1, blob=blob)
+    assert artifact_lib.verify_sidecar(p, artifact="canary") == "ok"
+    flip_byte(p)
+    reg = Registry()
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        artifact_lib.verify_sidecar(p, artifact="canary", registry=reg)
+    assert reg.counter("integrity.corrupt.canary").value == 1
+    with open(p, "ab") as f:  # size change is caught before hashing
+        f.write(b"x")
+    with pytest.raises(artifact_lib.ArtifactCorrupt, match="size"):
+        artifact_lib.verify_sidecar(p, artifact="canary")
+    # No sidecar = legacy, tolerated.
+    q = str(tmp_path / "other.bin")
+    artifact_lib.atomic_write_bytes(q, b"abc")
+    assert artifact_lib.verify_sidecar(q) == "unsealed"
+
+
+# ---------------------------------------------------------------------------
+# Disk-fault injection at the integrity.write family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["torn", "bitflip", "truncate",
+                                  "corrupt"])
+def test_injected_disk_fault_is_always_detected(tmp_path, kind):
+    """Every corrupt-family kind at the sealed writer's payload seam
+    yields a file the reader REFUSES — typed ArtifactCorrupt when the
+    damage preserves JSON, the loud unparseable refusal otherwise.
+    Silent acceptance is the one outcome that must be impossible."""
+    p = str(tmp_path / "a.json")
+    payload = {"data": list(range(64)), "name": "drillvalue"}
+    prev = faultinject.arm({
+        "integrity.write": {"kind": kind, "on_calls": [1]},
+    })
+    try:
+        artifact_lib.write_sealed_json(p, payload, schema="s", version=1)
+    finally:
+        faultinject.arm(prev)
+    with pytest.raises((artifact_lib.ArtifactCorrupt, ValueError)):
+        artifact_lib.read_sealed_json(p, artifact="journal",
+                                      registry=Registry())
+
+
+def test_enospc_style_write_failure_keeps_old_artifact(tmp_path):
+    p = str(tmp_path / "a.json")
+    artifact_lib.write_sealed_json(p, {"gen": 1}, schema="s", version=1)
+    prev = faultinject.arm({
+        "integrity.write": {"kind": "error", "on_calls": [1],
+                            "error": "OSError",
+                            "message": "No space left on device"},
+    })
+    try:
+        with pytest.raises(OSError, match="No space left"):
+            artifact_lib.write_sealed_json(p, {"gen": 2}, schema="s",
+                                           version=1)
+    finally:
+        faultinject.arm(prev)
+    doc, _ = artifact_lib.read_sealed_json(p)
+    assert doc["gen"] == 1  # the old artifact is untouched and valid
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+
+
+def test_new_kinds_validated_in_spec():
+    plan = faultinject.plan_from_spec({
+        "integrity.write": {"kind": "torn", "on_calls": [1]},
+    })
+    assert plan.site("integrity.write").kind == "torn"
+    with pytest.raises(ValueError, match="unknown kind"):
+        faultinject.plan_from_spec({
+            "integrity.write": {"kind": "shred", "on_calls": [1]},
+        })
+
+
+def test_kill9_in_commit_window_leaves_no_readable_torn_artifact(tmp_path):
+    """THE torn-write drill: a writer SIGKILLed between fsync and the
+    rename publish leaves the OLD artifact fully readable and only an
+    inert .tmp behind."""
+    kdir = str(tmp_path / "lc")
+    Journal(kdir).append("DRIFT_DETECTED", cycle=0, reason="pre")
+    child_src = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO_ROOT!r})\n"
+        "from jama16_retina_tpu.obs import faultinject\n"
+        "faultinject.arm_from_env_or_config()\n"
+        "from jama16_retina_tpu.lifecycle.journal import Journal\n"
+        f"Journal({kdir!r}).append('RETRAIN', cycle=0)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAMA16_FAULTS=json.dumps({
+        "integrity.write.commit": {"kind": "latency", "on_calls": [1],
+                                   "delay_s": 60.0},
+    }))
+    child = subprocess.Popen([sys.executable, "-c", child_src], env=env)
+    deadline = time.time() + 60
+    tmp_seen = False
+    while time.time() < deadline:
+        if any(".tmp." in n for n in os.listdir(kdir)):
+            tmp_seen = True
+            break
+        if child.poll() is not None:
+            break
+        time.sleep(0.02)
+    child.send_signal(signal.SIGKILL)
+    child.wait(timeout=30)
+    assert tmp_seen, "the commit-window latency plan never held the write"
+    j = Journal(kdir)  # loads cleanly: the OLD content, not a torn file
+    assert j.state == "DRIFT_DETECTED"
+
+
+# ---------------------------------------------------------------------------
+# Every adopted writer refuses corruption typed
+# ---------------------------------------------------------------------------
+
+
+def test_journal_and_live_pointer_seal_detect_bitflip(tmp_path):
+    d = str(tmp_path / "lc")
+    j = Journal(d)
+    j.append("DRIFT_DETECTED", cycle=0, reason="drift")
+    flip_byte(os.path.join(d, "journal.json"), marker=b"drift")
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        Journal(d)
+    d2 = str(tmp_path / "lc2")
+    j2 = Journal(d2)
+    j2.write_live(["/ckpt/m0"])
+    assert j2.read_live() == ["/ckpt/m0"]
+    flip_byte(os.path.join(d2, "live.json"), marker=b"ckpt")
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        j2.read_live()
+
+
+def test_policy_profile_canary_seals_detect_corruption(tmp_path):
+    ppath = str(tmp_path / "pol.json")
+    seed_policy(ppath)
+    assert policy_lib.load_policy(ppath).max_batch == 8  # intact loads
+    flip_byte(ppath, marker=b"marker")
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        policy_lib.load_policy(ppath)
+
+    prof = quality_lib.build_profile(
+        np.random.default_rng(0).random(64),
+        thresholds=[{"threshold": 0.5}],
+    )
+    prpath = str(tmp_path / "prof.json")
+    quality_lib.save_profile(prpath, prof)
+    assert quality_lib.load_profile(prpath)["kind"] == "quality_profile"
+    flip_byte(prpath, marker=b"threshold")
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        quality_lib.load_profile(prpath)
+
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, (2, 8, 8, 3), np.uint8)
+    cpath = quality_lib.save_canary(str(tmp_path / "canary"), imgs,
+                                    scores=rng.random(2))
+    assert cpath.endswith(".npz")
+    assert os.path.exists(artifact_lib.sidecar_path(cpath))
+    back, scores = quality_lib.load_canary_file(cpath)  # intact loads
+    assert np.array_equal(back, imgs)
+    flip_byte(cpath)
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        quality_lib.load_canary_file(cpath)
+
+
+@pytest.fixture(scope="module")
+def shard_fixture(tmp_path_factory):
+    from jama16_retina_tpu.data import rawshard as rawshard_lib
+    from jama16_retina_tpu.data import tfrecord as tfrecord_lib
+
+    root = tmp_path_factory.mktemp("rs")
+    src = str(root / "data")
+    tfrecord_lib.write_synthetic_split(src, "train", 12, image_size=16,
+                                       num_shards=1, seed=0)
+    rawshard_lib.transcode_split(src, "train", image_size=16,
+                                 shard_records=4, workers=1)
+    return src, rawshard_lib.default_shard_dir(src, 16)
+
+
+def test_rawshard_manifest_sealed_with_per_shard_digests(shard_fixture):
+    from jama16_retina_tpu.data import rawshard as rawshard_lib
+
+    src, shard_dir = shard_fixture
+    mpath = rawshard_lib.manifest_path(shard_dir, "train")
+    with open(mpath) as f:
+        m = json.load(f)
+    assert artifact_lib.SEAL_KEY in m
+    for e in m["shards"]:
+        assert len(e["images_sha256"]) == 64
+        assert len(e["grades_sha256"]) == 64
+        blob = open(os.path.join(shard_dir, e["images"]), "rb").read()
+        import hashlib
+
+        assert hashlib.sha256(blob).hexdigest() == e["images_sha256"]
+    # The loader verifies the manifest's seal.
+    rawshard_lib.RawShardSplit(shard_dir, "train", image_size=16,
+                               source_dir=src)
+
+
+def test_corrupt_manifest_names_the_MANIFEST_rebuild(shard_fixture,
+                                                     tmp_path):
+    """Review regression: a corrupt manifest's error must name the
+    manifest rebuild (re-run the transcode, it resumes), not the
+    shard-pair deletion hint."""
+    import shutil
+
+    from jama16_retina_tpu.data import rawshard as rawshard_lib
+
+    _src, shard_dir = shard_fixture
+    copy = str(tmp_path / "rs")
+    shutil.copytree(shard_dir, copy)
+    flip_byte(rawshard_lib.manifest_path(copy, "train"),
+              marker=b"train-00000")
+    with pytest.raises(artifact_lib.ArtifactCorrupt) as ei:
+        rawshard_lib.RawShardSplit(copy, "train", image_size=16)
+    assert "it resumes" in str(ei.value)
+    assert "delete the shard pair" not in str(ei.value)
+
+
+def test_compile_cache_entry_corruption_degrades_counted(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu.serve.compilecache import CompileCache
+
+    reg = Registry()
+    cache = CompileCache(str(tmp_path / "cc"), {"probe": 1}, registry=reg)
+    probe = jax.jit(lambda x: x + 1).lower(
+        jnp.zeros((2,), jnp.float32)
+    ).compile()
+    if not cache.save("probe", probe):
+        pytest.skip("backend without executable serialization")
+    assert cache.load("probe") is not None  # intact: a hit
+    flip_byte(cache.entry_path("probe"))
+    misses0 = reg.counter("serve.compile_cache.misses").value
+    assert cache.load("probe") is None      # degrade, never raise
+    assert reg.counter("serve.compile_cache.misses").value == misses0 + 1
+    assert reg.counter("integrity.corrupt.compile_cache").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# fsck: classify + repair + protection
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_classifies_all_four_statuses(tmp_path):
+    wd = str(tmp_path)
+    # CORRUPT: a bit-flipped sealed policy.
+    seed_policy(os.path.join(wd, "pol.json"))
+    flip_byte(os.path.join(wd, "pol.json"), marker=b"marker")
+    # STALE: an unsealed legacy profile.
+    with open(os.path.join(wd, "prof.json"), "w") as f:
+        json.dump({"version": 1, "kind": "quality_profile",
+                   "bins": 20, "score_hist": [0] * 20}, f)
+    # ORPHAN: a dead tmp leftover.
+    with open(os.path.join(wd, "x.json.tmp.123"), "w") as f:
+        f.write("{")
+    # REPAIRABLE: a torn JSONL line.
+    with open(os.path.join(wd, "metrics.jsonl"), "w") as f:
+        f.write('{"kind": "train", "step": 1}\n{"kind": "tr')
+    report = fsck_lib.fsck_workdir(wd, registry=Registry())
+    by = report.by_status()
+    assert {f.artifact for f in by["CORRUPT"]} == {"policy"}
+    assert {f.artifact for f in by["STALE"]} == {"profile"}
+    assert any(f.path.endswith(".tmp.123") for f in by["ORPHAN"])
+    assert {f.artifact for f in by["REPAIRABLE"]} == {"jsonl"}
+
+    ledger = fsck_lib.repair_workdir(wd, report=report,
+                                     registry=Registry())
+    acts = {a["action"] for a in ledger["actions"]}
+    assert acts == {"delete", "rewrite"}
+    # Post-repair: the corrupt policy is gone (derivable — rebuild on
+    # demand), the torn line dropped losslessly, the STALE legacy
+    # profile reported but untouched.
+    report2 = fsck_lib.fsck_workdir(wd, registry=Registry())
+    assert set(report2.by_status()) == {"STALE"}
+    lines = open(os.path.join(wd, "metrics.jsonl")).read().splitlines()
+    assert lines == ['{"kind": "train", "step": 1}']
+
+
+def test_fsck_cross_refs_live_member_and_commit_pointer(tmp_path):
+    wd = str(tmp_path)
+    j = Journal(os.path.join(wd, "lifecycle"))
+    j.write_live([os.path.join(wd, "member_00")])  # does not exist
+    report = fsck_lib.fsck_workdir(wd)
+    assert any(
+        f.artifact == "checkpoint" and f.status == "CORRUPT"
+        and "live.json" in f.detail
+        for f in report.findings
+    )
+    # A restorable-looking member clears it.
+    os.makedirs(os.path.join(wd, "member_00", "latest", "1"))
+    assert fsck_lib.fsck_workdir(wd).clean
+
+
+def test_repair_quarantines_journal_with_sealed_ledger(tmp_path):
+    wd = str(tmp_path)
+    d = os.path.join(wd, "lifecycle")
+    j = Journal(d)
+    j.append("DRIFT_DETECTED", cycle=0, reason="drift")
+    j.append("ROLLBACK", cycle=0, cause="done")  # CLOSED cycle
+    flip_byte(os.path.join(d, "journal.json"), marker=b"drift")
+    report = fsck_lib.fsck_workdir(wd, registry=Registry())
+    assert any(f.artifact == "journal" and f.status == "CORRUPT"
+               for f in report.findings)
+    ledger = fsck_lib.repair_workdir(wd, report=report,
+                                     registry=Registry())
+    assert any(a["action"] == "quarantine" for a in ledger["actions"])
+    qdir = os.path.join(wd, "quarantine")
+    assert os.path.exists(os.path.join(qdir, "journal.json"))
+    # The ledger is itself a sealed artifact.
+    doc, seal = artifact_lib.read_sealed_json(
+        os.path.join(qdir, "ledger.json"), artifact="ledger"
+    )
+    assert seal is not None and doc["actions"]
+    assert fsck_lib.fsck_workdir(wd, registry=Registry()).clean
+
+
+def test_repair_never_touches_open_cycle_or_live_members(tmp_path):
+    """THE protection pin: an open cycle's journal (even corrupt) and
+    anything under a live.json member dir are skipped by repair."""
+    wd = str(tmp_path)
+    d = os.path.join(wd, "lifecycle")
+    member = os.path.join(wd, "member_00")
+    os.makedirs(os.path.join(member, "latest", "1"))
+    j = Journal(d)
+    j.write_live([member])
+    j.append("DRIFT_DETECTED", cycle=0, reason="open")  # cycle OPEN
+    flip_byte(os.path.join(d, "journal.json"), marker=b"open")
+    # A corrupt artifact INSIDE the live member dir.
+    seed_policy(os.path.join(member, "pol.json"))
+    flip_byte(os.path.join(member, "pol.json"), marker=b"marker")
+    report = fsck_lib.fsck_workdir(wd, registry=Registry())
+    paths = {f.path for f in report.findings}
+    assert any("journal.json" in p for p in paths)
+    ledger = fsck_lib.repair_workdir(wd, report=report,
+                                     registry=Registry())
+    assert ledger["actions"] == []  # nothing touched
+    assert {s["why"].split(" ")[0] for s in ledger["skipped"]} \
+        == {"protected"}
+    assert os.path.exists(os.path.join(d, "journal.json"))
+    assert os.path.exists(os.path.join(member, "pol.json"))
+
+
+def test_fsck_rawshard_bitflip_trim_then_resume_rebuilds(tmp_path):
+    from jama16_retina_tpu.data import rawshard as rawshard_lib
+    from jama16_retina_tpu.data import tfrecord as tfrecord_lib
+
+    wd = str(tmp_path)
+    src = os.path.join(wd, "data")
+    tfrecord_lib.write_synthetic_split(src, "train", 8, image_size=16,
+                                       num_shards=1, seed=0)
+    rawshard_lib.transcode_split(src, "train", image_size=16,
+                                 shard_records=4, workers=1)
+    shard_dir = rawshard_lib.default_shard_dir(src, 16)
+    victim = sorted(n for n in os.listdir(shard_dir)
+                    if n.endswith(".images.npy"))[0]
+    flip_byte(os.path.join(shard_dir, victim))
+    reg = Registry()
+    report = fsck_lib.fsck_workdir(wd, registry=reg)
+    assert any(f.path.endswith(victim) and f.status == "CORRUPT"
+               for f in report.findings)
+    assert reg.counter("integrity.corrupt.rawshard").value >= 1
+    fsck_lib.repair_workdir(wd, report=report, registry=reg)
+    # Trimmed: the manifest is a valid PARTIAL transcode now...
+    report2 = fsck_lib.fsck_workdir(wd)
+    assert {f.status for f in report2.findings} == {"STALE"}
+    # ...and the named rebuild command (resume) restores cleanliness.
+    rawshard_lib.transcode_split(src, "train", image_size=16,
+                                 shard_records=4, workers=1)
+    assert fsck_lib.fsck_workdir(wd).clean
+    rs = rawshard_lib.RawShardSplit(shard_dir, "train", image_size=16,
+                                    source_dir=src)
+    assert len(rs) == 8
+
+
+def test_repair_trims_manifest_for_a_MISSING_shard(tmp_path):
+    """Review regression: a shard that is GONE (not just damaged) must
+    still be trimmed out of its manifest by --repair — the repair edits
+    the manifest, so the missing target must not short-circuit it."""
+    from jama16_retina_tpu.data import rawshard as rawshard_lib
+    from jama16_retina_tpu.data import tfrecord as tfrecord_lib
+
+    wd = str(tmp_path)
+    src = os.path.join(wd, "data")
+    tfrecord_lib.write_synthetic_split(src, "train", 8, image_size=16,
+                                       num_shards=1, seed=0)
+    rawshard_lib.transcode_split(src, "train", image_size=16,
+                                 shard_records=4, workers=1)
+    shard_dir = rawshard_lib.default_shard_dir(src, 16)
+    victim = sorted(n for n in os.listdir(shard_dir)
+                    if n.endswith(".images.npy"))[0]
+    os.unlink(os.path.join(shard_dir, victim))
+    report = fsck_lib.fsck_workdir(wd)
+    assert any(f.repair == "trim-manifest" for f in report.findings)
+    ledger = fsck_lib.repair_workdir(wd, report=report,
+                                     registry=Registry())
+    assert any(a["action"] == "trim-manifest" for a in ledger["actions"])
+    # Post-repair: a valid PARTIAL manifest (STALE only), and the
+    # resume command restores cleanliness.
+    assert {f.status for f in fsck_lib.fsck_workdir(wd).findings} \
+        == {"STALE"}
+    rawshard_lib.transcode_split(src, "train", image_size=16,
+                                 shard_records=4, workers=1)
+    assert fsck_lib.fsck_workdir(wd).clean
+
+
+def test_quarantine_takes_the_seal_sidecar_along(tmp_path):
+    """Review regression: quarantining a sidecar-sealed binary must
+    move the sidecar too — one --repair pass restores cleanliness."""
+    wd = str(tmp_path)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (2, 8, 8, 3), np.uint8)
+    cpath = quality_lib.save_canary(os.path.join(wd, "canary.npz"),
+                                    imgs, scores=rng.random(2))
+    flip_byte(cpath)
+    report = fsck_lib.fsck_workdir(wd, registry=Registry())
+    ledger = fsck_lib.repair_workdir(wd, report=report,
+                                     registry=Registry())
+    q = [a for a in ledger["actions"] if a["action"] == "quarantine"]
+    assert q and q[0]["sidecar_moved_to"]
+    assert not os.path.exists(artifact_lib.sidecar_path(cpath))
+    assert fsck_lib.fsck_workdir(wd, registry=Registry()).clean
+
+
+def test_orphan_jex_sidecar_single_finding_right_class(tmp_path):
+    """Review regression: a compile-cache sidecar without its entry is
+    ONE finding, labeled compile_cache (not a duplicate 'canary')."""
+    wd = str(tmp_path)
+    cc = os.path.join(wd, "cc")
+    os.makedirs(cc)
+    artifact_lib.write_sealed_json(
+        os.path.join(cc, "MANIFEST.json"),
+        {"version": 1, "fingerprint": "f", "detail": {}},
+        schema="compile_cache.manifest", version=1,
+    )
+    p = os.path.join(cc, "exec_b8.jex")
+    artifact_lib.atomic_write_bytes(p, b"x" * 64)
+    artifact_lib.write_seal_sidecar(p, schema="compile_cache.entry",
+                                    version=1, blob=b"x" * 64)
+    os.unlink(p)
+    report = fsck_lib.fsck_workdir(wd)
+    orphans = [f for f in report.findings if f.status == "ORPHAN"]
+    assert len(orphans) == 1
+    assert orphans[0].artifact == "compile_cache"
+
+
+def test_retention_rotation_with_existing_rotation_keeps_fresh_log(
+        tmp_path):
+    """Review regression: rotating metrics.jsonl onto an existing .1
+    must delete the OLD .1 first — never the freshly rotated log; and
+    a .1 whose base is NOT rotating is the kept rotation."""
+    wd = str(tmp_path)
+    big = os.path.join(wd, "metrics.jsonl")
+    with open(big, "w") as f:
+        f.write('{"kind": "fresh"}\n' * 50)
+    with open(big + ".1", "w") as f:
+        f.write('{"kind": "old"}\n')
+    keep = os.path.join(wd, "small.jsonl")
+    with open(keep, "w") as f:
+        f.write('{"kind": "k"}\n')
+    with open(keep + ".1", "w") as f:
+        f.write('{"kind": "kept rotation"}\n')
+    plan = retention_lib.plan_retention(wd, _cfg(telemetry_max_bytes=100))
+    kinds = [(a.kind, os.path.basename(a.path)) for a in plan.actions]
+    assert kinds.index(("delete", "metrics.jsonl.1")) \
+        < kinds.index(("rotate", "metrics.jsonl"))
+    assert ("delete", "small.jsonl.1") not in kinds  # base not rotating
+    retention_lib.apply_plan(plan, registry=Registry())
+    assert not os.path.exists(big)
+    assert "fresh" in open(big + ".1").read()  # the CURRENT log survived
+    assert "kept rotation" in open(keep + ".1").read()
+
+
+def test_check_integrity_does_not_page_on_stale_counters(tmp_path):
+    """Review regression: cumulative integrity.corrupt counters flushed
+    BEFORE a clean fsck verdict are repaired history, not evidence —
+    the cron probe must return 0 after repair + re-fsck."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    wd = str(tmp_path)
+    with open(os.path.join(wd, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "telemetry", "t": 100.0,
+            "counters": {"integrity.corrupt": 3}, "gauges": {},
+            "histograms": {},
+        }) + "\n")
+    os.makedirs(os.path.join(wd, "integrity"))
+    artifact_lib.write_sealed_json(
+        os.path.join(wd, "integrity", "fsck-last.json"),
+        {"kind": "integrity_fsck", "t": 200.0, "clean": True,
+         "corrupt_at_verdict": 3.0,
+         "counts": {}, "findings": [], "checked": {}, "repaired": None},
+        schema="integrity.fsck", version=1,
+    )
+    code, msg = obs_report.check_integrity(wd)
+    assert code == 0, msg  # stale counters predate the clean verdict
+    # A LIVE run keeps flushing its cumulative pre-repair count with
+    # newer timestamps: still repaired history, still quiet.
+    with open(os.path.join(wd, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "kind": "telemetry", "t": 300.0,
+            "counters": {"integrity.corrupt": 3}, "gauges": {},
+            "histograms": {},
+        }) + "\n")
+    code, msg = obs_report.check_integrity(wd)
+    assert code == 0, msg
+    # Only the counter GROWING past the verdict's pinned value pages.
+    with open(os.path.join(wd, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "kind": "telemetry", "t": 400.0,
+            "counters": {"integrity.corrupt": 4}, "gauges": {},
+            "histograms": {},
+        }) + "\n")
+    code, msg = obs_report.check_integrity(wd)
+    assert code == 1 and "grew" in msg
+
+
+def test_graftfsck_cli_exit_codes_and_verdict(tmp_path):
+    wd = str(tmp_path)
+    ppath = os.path.join(wd, "pol.json")
+    seed_policy(ppath)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*args):
+        return subprocess.run([sys.executable, GRAFTFSCK, wd, *args],
+                              capture_output=True, text=True, env=env,
+                              timeout=300)
+
+    assert run().returncode == 0
+    flip_byte(ppath, marker=b"marker")
+    r = run("--json")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert any(ppath in f["path"] for f in doc["findings"])
+    assert run("--repair").returncode == 0
+    assert run().returncode == 0
+    # Every run wrote the sealed verdict obs_report reads.
+    verdict, seal = artifact_lib.read_sealed_json(
+        os.path.join(wd, "integrity", "fsck-last.json")
+    )
+    assert seal is not None and verdict["clean"] is True
+
+
+# ---------------------------------------------------------------------------
+# Retention GC
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**integrity_over):
+    cfg = get_config("smoke")
+    over = [f"integrity.{k}={v}" for k, v in integrity_over.items()]
+    return override(cfg, over) if over else cfg
+
+
+def _mk_dump(bb: str, name: str, mtime: float) -> str:
+    d = os.path.join(bb, name)
+    os.makedirs(d)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump({"reason": name}, f)
+    os.utime(d, (mtime, mtime))
+    return d
+
+
+def test_retention_dry_run_ledger_matches_apply(tmp_path):
+    wd = str(tmp_path)
+    bb = os.path.join(wd, "blackbox")
+    for i in range(25):
+        _mk_dump(bb, f"{i:02d}-r", 1_000_000 + i)
+    with open(os.path.join(wd, "metrics.jsonl.prev"), "w") as f:
+        f.write("{}\n")
+    cfg = _cfg()
+    plan1 = retention_lib.plan_retention(wd, cfg)
+    plan2 = retention_lib.plan_retention(wd, cfg)
+    assert plan1.ledger() == plan2.ledger()  # pure over fs state
+    dry = plan1.ledger()
+    applied = retention_lib.apply_plan(plan1, registry=Registry())
+    assert applied["actions"] == dry["actions"]
+    assert applied["executed"] == dry["actions"]  # apply == dry run
+    # The sealed GC ledger landed.
+    doc, seal = artifact_lib.read_sealed_json(
+        os.path.join(wd, "integrity", "gc-ledger.json")
+    )
+    assert seal is not None and len(doc["runs"]) == 1
+
+
+def test_retention_blackbox_cap_oldest_first(tmp_path):
+    wd = str(tmp_path)
+    bb = os.path.join(wd, "blackbox")
+    for i in range(25):
+        _mk_dump(bb, f"{i:02d}-r", 1_000_000 + i)
+    reg = Registry()
+    plan = retention_lib.plan_retention(wd, _cfg())
+    deleted = {os.path.basename(a.path) for a in plan.actions
+               if a.cls == "blackbox"}
+    assert deleted == {f"{i:02d}-r" for i in range(5)}  # the 5 OLDEST
+    retention_lib.apply_plan(plan, registry=reg)
+    assert sorted(os.listdir(bb)) == [f"{i:02d}-r" for i in range(5, 25)]
+    assert reg.counter("integrity.gc.deleted.blackbox").value == 5
+    assert reg.counter("integrity.gc.deleted").value == 5
+
+
+def test_retention_cache_lru_and_telemetry_rotation(tmp_path):
+    wd = str(tmp_path)
+    cc = os.path.join(wd, "cache")
+    os.makedirs(cc)
+    artifact_lib.write_sealed_json(
+        os.path.join(cc, "MANIFEST.json"),
+        {"version": 1, "fingerprint": "f", "detail": {}},
+        schema="compile_cache.manifest", version=1,
+    )
+    for i in range(4):
+        p = os.path.join(cc, f"exec_b{i}.jex")
+        artifact_lib.atomic_write_bytes(p, b"x" * 1000)
+        os.utime(p, (2_000_000 + i, 2_000_000 + i))
+    big = os.path.join(wd, "metrics.jsonl")
+    with open(big, "w") as f:
+        f.write('{"kind": "t"}\n' * 200)
+    cfg = _cfg(cache_max_bytes=2500, telemetry_max_bytes=100)
+    plan = retention_lib.plan_retention(wd, cfg)
+    cache_dels = sorted(os.path.basename(a.path) for a in plan.actions
+                        if a.cls == "compile_cache")
+    assert cache_dels == ["exec_b0.jex", "exec_b1.jex"]  # LRU first
+    assert any(a.kind == "rotate" and a.path == big
+               for a in plan.actions)
+    retention_lib.apply_plan(plan, registry=Registry())
+    assert os.path.exists(big + ".1") and not os.path.exists(big)
+    assert os.path.exists(os.path.join(cc, "MANIFEST.json"))
+
+
+def test_retention_never_collects_live_or_open_cycle(tmp_path):
+    """THE GC protection pin: candidate sets of closed cycles beyond
+    the keep window are collected — but NEVER one named by live.json,
+    and NEVER the open cycle's, regardless of age."""
+    wd = str(tmp_path)
+    lc = os.path.join(wd, "lifecycle")
+    j = Journal(lc)
+    # Cycles 0..3 closed, 4 open. Candidate roots for each.
+    for c in range(5):
+        cand = os.path.join(lc, f"candidate-{c:04d}")
+        os.makedirs(os.path.join(cand, "member_00"))
+        j.append("DRIFT_DETECTED", cycle=c, reason="r")
+        j.append("RETRAIN", cycle=c,
+                 member_dirs=[os.path.join(cand, "member_00")])
+        if c < 4:
+            j.append("ROLLBACK", cycle=c, cause="x")
+    # live.json points INTO the OLDEST candidate (a promoted-then-
+    # committed set that later cycles never replaced).
+    j.write_live([os.path.join(lc, "candidate-0000", "member_00")])
+    plan = retention_lib.plan_retention(wd, _cfg(keep_candidate_cycles=1))
+    planned = {os.path.basename(a.path) for a in plan.actions}
+    # Closed cycles beyond keep=1 are 0, 1, 2 — but 0 is live-pinned
+    # and 4 is open: only 1 and 2 are collectible.
+    assert planned == {"candidate-0001", "candidate-0002"}
+    retention_lib.apply_plan(plan, registry=Registry())
+    left = sorted(n for n in os.listdir(lc) if n.startswith("candidate"))
+    assert left == ["candidate-0000", "candidate-0003",
+                    "candidate-0004"]
+
+
+def test_flightrec_prunes_blackbox_across_runs(tmp_path):
+    from jama16_retina_tpu.obs.flightrec import FlightRecorder
+    from jama16_retina_tpu.obs.trace import Tracer
+
+    wd = str(tmp_path)
+    bb = os.path.join(wd, "blackbox")
+    for i in range(6):  # dumps left behind by PREVIOUS runs
+        _mk_dump(bb, f"old-{i}", 1_000_000 + i)
+    reg = Registry()
+    fr = FlightRecorder(wd, registry=reg, tracer=Tracer(),
+                        blackbox_keep=4)
+    fr.dump("drill")
+    kept = sorted(os.listdir(bb))
+    assert len(kept) == 4
+    assert "01-drill" in kept            # this run's dump survives
+    assert "old-0" not in kept and "old-1" not in kept  # oldest pruned
+    assert reg.counter("obs.blackbox_pruned").value == 3
+
+
+# ---------------------------------------------------------------------------
+# obs_report: Integrity section + --check-integrity
+# ---------------------------------------------------------------------------
+
+
+def test_check_integrity_exit_codes(tmp_path):
+    wd = str(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    report_py = os.path.join(REPO_ROOT, "scripts", "obs_report.py")
+
+    def probe():
+        return subprocess.run(
+            [sys.executable, report_py, "--check-integrity", wd],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+
+    r = probe()
+    assert r.returncode == 2 and "blind" in r.stdout  # never fsck'd
+    seed_policy(os.path.join(wd, "pol.json"))
+    run_fsck = lambda *a: subprocess.run(  # noqa: E731
+        [sys.executable, GRAFTFSCK, wd, *a], capture_output=True,
+        text=True, env=env, timeout=300)
+    run_fsck()
+    assert probe().returncode == 0
+    flip_byte(os.path.join(wd, "pol.json"), marker=b"marker")
+    run_fsck()
+    r = probe()
+    assert r.returncode == 1 and "fsck found" in r.stdout
+    run_fsck("--repair")
+    assert probe().returncode == 0
+
+
+def test_obs_report_integrity_section_json(tmp_path):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    wd = str(tmp_path)
+    seed_policy(os.path.join(wd, "pol.json"))
+    with open(os.path.join(wd, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "kind": "telemetry", "t": 1.0,
+            "counters": {"integrity.corrupt": 2,
+                         "integrity.corrupt.policy": 2,
+                         "integrity.gc.deleted": 1,
+                         "integrity.repaired": 1},
+            "gauges": {}, "histograms": {},
+        }) + "\n")
+    records = obs_report.load_records(wd)
+    s = obs_report.integrity_summary(wd, records)
+    assert s["corrupt_counters"]["integrity.corrupt"] == 2
+    assert s["repaired"] == 1
+    assert s["gc_counters"]["integrity.gc.deleted"] == 1
+    assert s["fsck"] is None  # never fsck'd
+    assert s["bytes_by_class"]["telemetry"]["count"] == 1
+    text = obs_report.render_integrity(wd, records)
+    assert "Integrity" in text and "NEVER RUN" in text
+
+
+# ---------------------------------------------------------------------------
+# graftlint rule ``artifacts``
+# ---------------------------------------------------------------------------
+
+
+def test_artifacts_rule_flags_bare_writes_and_passes_routed(tmp_path):
+    from jama16_retina_tpu.analysis.core import Corpus
+    from jama16_retina_tpu.analysis.rule_artifacts import ArtifactsRule
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import json, os, pickle\n"
+        "import numpy as np\n"
+        "def w(path, obj, arr, fh):\n"
+        "    json.dump(obj, fh)\n"
+        "    os.replace(path + '.tmp', path)\n"
+        "    np.save(path, arr)\n"
+        "    pickle.dump(obj, fh)\n"
+    )
+    (pkg / "integrity").mkdir()
+    (pkg / "integrity" / "artifact.py").write_text(
+        "import os\n"
+        "def atomic(path):\n"
+        "    os.replace(path + '.tmp', path)\n"
+    )
+    (pkg / "good.py").write_text(
+        "from pkg.integrity import artifact\n"
+        "def w(path, obj):\n"
+        "    artifact.write_sealed_json(path, obj, schema='s', version=1)\n"
+    )
+    corpus = Corpus(str(tmp_path), package="pkg")
+    found = ArtifactsRule().run(corpus)
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f)
+    assert len(by_code["artifacts.bare-replace"]) == 1  # not artifact.py
+    assert len(by_code["artifacts.bare-json-dump"]) == 1
+    assert len(by_code["artifacts.bare-binary-dump"]) == 2
+    assert all(f.path == "pkg/bad.py" for f in found)
+    # Stable, name-based suppression keys.
+    assert by_code["artifacts.bare-replace"][0].key \
+        == "pkg/bad.py::w.os.replace"
+
+
+def test_reliability_rules_include_artifact_corrupt():
+    from jama16_retina_tpu.obs import alerts as obs_alerts
+
+    rules = obs_alerts.reliability_rules(get_config("smoke"))
+    by_metric = {r.metric: r for r in rules}
+    assert by_metric["rate(integrity.corrupt)"].reason \
+        == "artifact_corrupt"
+    assert by_metric["rate(integrity.corrupt)"].threshold == 0.0

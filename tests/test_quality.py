@@ -667,6 +667,7 @@ def test_manager_for_trainerless_wiring(tmp_path):
     assert {r.reason for r in rel_rules} == {
         "data_quarantine", "reload_rejected",
         "router_imbalance", "scaler_saturated",  # ISSUE 12 ride-alongs
+        "artifact_corrupt",                      # ISSUE 13 ride-along
     }
     assert am._flight is not None and am._flight.workdir == str(tmp_path)
     # Quality off: the reliability rules alone still get a manager.
@@ -675,6 +676,7 @@ def test_manager_for_trainerless_wiring(tmp_path):
     assert {r.reason for r in am_base.rules} == {
         "data_quarantine", "reload_rejected",
         "router_imbalance", "scaler_saturated",
+        "artifact_corrupt",
     }
     cfg_off = cfg_q.replace(
         obs=dataclasses.replace(cfg_q.obs, enabled=False)
