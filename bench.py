@@ -51,10 +51,25 @@ Extra keys quantify the rest of the system (VERDICT.md round-1 #3):
                        scales with members); the capability's payoff is
                        pod topology — see configs.py ensemble_parallel.
                        A measured ratio < 1.0 is never published as a
-                       speedup: the key is withheld and the value lands
-                       in ensemble4_parallel_gated with a logged reason
-                       (trainer.fit_ensemble auto-falls back to the
-                       sequential driver on 1-device meshes to match).
+                       speedup ON A 1-DEVICE MESH: the key is withheld
+                       and the value lands in ensemble4_parallel_gated
+                       with a logged reason (trainer.fit_ensemble
+                       auto-falls back to the sequential driver there
+                       to match). On >= 4-device meshes the real ratio
+                       publishes ungated (ISSUE 14: member-sharded
+                       stacking is the production path at that width).
+  train_mesh_d{N}_images_per_sec / serve_mesh_d{N}_images_per_sec /
+  train_mesh_d{N}_vs_d1
+                     — mesh-scaling rows (ISSUE 14): the pjit+LAMB
+                       train step and the ASSEMBLED serving engine
+                       measured across simulated device counts via
+                       scripts/dryrun_multichip.py (fresh fake-device
+                       subprocess per count, single-threaded per
+                       device). --skip_mesh skips.
+  time_to_auc_sec_lamb / time_to_auc_lamb_speedup
+                     — the LAMB large-batch recipe (2x global batch,
+                       linear-scaled LR) vs the adamw reference run,
+                       same seed/target (ISSUE 14 acceptance row).
   serve_*            — the serving engine (serve/engine.py):
                        serve_images_per_sec (k=1 saturated engine
                        throughput at the eval batch; self-fencing —
@@ -353,10 +368,26 @@ def _gate_ensemble_speedup(extras: dict, rate: float,
     path. The gating reason ALSO lands in the JSON record
     (``ensemble4_parallel_gated_reason``; ISSUE 7): a trajectory file
     must explain a withheld key by itself, not via a stderr log that
-    rotated away."""
+    rotated away.
+
+    UN-GATED on >= 4-device meshes (ISSUE 14): member-sharded stacking
+    is the PRODUCTION path there — the member axis amortizes exactly
+    what a single chip cannot — so the real ratio publishes whatever
+    it measures (a <1.0 value on a wide mesh would be a genuine
+    regression the trajectory must show, not hide) and the 1-device
+    gated-reason row never appears."""
     # Gate on the UNROUNDED ratio: a 0.996 slowdown must not round up
     # to a published "1.0 speedup". Round only for display.
     speedup = rate / device_only
+    if n_dev >= 4:
+        extras["ensemble4_parallel_speedup"] = round(speedup, 2)
+        _log(
+            f"ensemble4 stacked step on a {n_dev}-device mesh: "
+            f"{speedup:.3f}x the sequential member rate (published "
+            "ungated — member-sharded stacking is the production path "
+            "at this width)"
+        )
+        return
     if speedup >= 1.0:
         extras["ensemble4_parallel_speedup"] = round(speedup, 2)
         return
@@ -1423,10 +1454,19 @@ def main() -> None:
     )
     parser.add_argument(
         "--skip_time_to_auc", action="store_true",
-        help="skip the time-to-AUC rows (ISSUE 11: two smoke-scale "
-             "fit_ensemble runs — fp32 and bf16 — through "
-             "scripts/time_to_auc.py; the accepted north-star metric "
-             "lands in the trajectory JSON as time_to_auc_sec_*)",
+        help="skip the time-to-AUC rows (ISSUE 11/14: smoke-scale "
+             "fit_ensemble runs — fp32, bf16, and the LAMB large-batch "
+             "recipe — through scripts/time_to_auc.py; the accepted "
+             "north-star metric lands in the trajectory JSON as "
+             "time_to_auc_sec_* / time_to_auc_lamb_speedup)",
+    )
+    parser.add_argument(
+        "--skip_mesh", action="store_true",
+        help="skip the mesh-scaling rows (ISSUE 14: "
+             "train_mesh_d{1,4}_images_per_sec / serve_mesh_d{N} via "
+             "scripts/dryrun_multichip.py — one fresh fake-device "
+             "subprocess per count, single-threaded per device; "
+             "~2-4 min cold)",
     )
     parser.add_argument(
         "--time_to_auc_target", type=float, default=0.95,
@@ -2838,8 +2878,51 @@ def main() -> None:
                 extras["time_to_auc_bf16_speedup"] = round(
                     r32["value"] / rbf["value"], 2
                 )
+            # The LAMB large-batch recipe (ISSUE 14): 2x the global
+            # batch under linear-scaled LR + trust-ratio adaptation,
+            # same seed/target — the first-class acceptance row is the
+            # wall-clock ratio vs the adamw reference-batch run above.
+            rlamb = tta.main(common + [
+                "--optimizer", "lamb", "--global_batch", "64",
+                "--lr_scale_ref_batch", "32",
+            ], print_json=False)
+            extras["time_to_auc_sec_lamb"] = rlamb["value"]
+            _log(f"time_to_auc lamb (global batch 64, scaled LR): "
+                 f"{rlamb['value']} s to AUC >= "
+                 f"{args.time_to_auc_target} "
+                 f"(crossed={rlamb['crossed']})")
+            if r32["value"] and rlamb["value"]:
+                extras["time_to_auc_lamb_speedup"] = round(
+                    r32["value"] / rlamb["value"], 2
+                )
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"time_to_auc bench failed: {type(e).__name__}: {e}")
+
+    # Mesh-scaling rows (ISSUE 14): the pjit+LAMB train step and the
+    # ASSEMBLED serving engine measured across simulated device counts
+    # (scripts/dryrun_multichip.py — fresh subprocess per count; each
+    # fake device computes single-threaded so the rows report device
+    # parallelism, not intra-op thread count).
+    if not args.skip_mesh:
+        try:
+            import importlib.util as _ilu
+
+            spec = _ilu.spec_from_file_location(
+                "dryrun_multichip_script",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "dryrun_multichip.py"),
+            )
+            drm = _ilu.module_from_spec(spec)
+            spec.loader.exec_module(drm)
+            mesh_rows = drm.run_counts(
+                [1, 4], steps=8, batch_per_device=64, serve_rows=64
+            )
+            extras.update({
+                k: v for k, v in mesh_rows.items()
+                if "images_per_sec" in k or "_vs_d1" in k
+            })
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"mesh-scaling bench failed: {type(e).__name__}: {e}")
 
     extras["device_only"] = round(device_only, 2)
     print(json.dumps({

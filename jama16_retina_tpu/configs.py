@@ -97,7 +97,12 @@ class DataConfig:
     # gates, eval caches, the autotuner's staging headroom). 0 = detect
     # from the runtime, falling back to the conservative 8 GB smallest-
     # deployed-core assumption (hbm_pipeline.hbm_budget_bytes logs the
-    # fallback and names this knob).
+    # fallback and names this knob). On multi-process pod slices the
+    # budget is PER HOST in effect (ISSUE 14): each host sizes, decodes
+    # and stages only its own devices' addressable shard of the tiered
+    # resident set (tiered_pipeline.host_spill_plan), so the knob
+    # bounds what one host's devices pin — never a global sum some
+    # other host would have to stage.
     hbm_budget_bytes: int = 0
     # Directory of ahead-of-time transcoded raw shards for
     # data.loader=rawshard. Empty = <data_dir>/rawshard<image_size>,
@@ -239,8 +244,39 @@ class TrainConfig:
     lr_schedule: str = "cosine"  # constant | cosine | warmup_cosine
     warmup_steps: int = 500
     weight_decay: float = 4e-5
-    optimizer: str = "adamw"  # adamw | sgdm | rmsprop
+    # adamw | sgdm | rmsprop | lamb. "lamb" is the large-batch recipe's
+    # optimizer (ISSUE 14; "Training EfficientNets at Supercomputer
+    # Scale", PAPERS.md): Adam moments + per-layer trust-ratio
+    # adaptation, which keeps the update scale sane when the global
+    # batch — and with lr_scale_ref_batch the LR — grows by an order of
+    # magnitude. optax-native (optax.lamb), so optimizer state in
+    # checkpoints stays optax-structure-compatible exactly like the
+    # fused adamw path (ops/pallas_opt.py) — resume cannot tell which
+    # optimizer family wrote the moments' tree layout.
+    optimizer: str = "adamw"
     momentum: float = 0.9
+    # --- Large-batch recipe (ISSUE 14) --------------------------------
+    # Linear LR scaling tied to the global batch (Goyal et al.; the
+    # EfficientNets-at-scale recipe): with a reference batch R > 0 the
+    # effective peak LR becomes learning_rate × (global_batch / R),
+    # where global_batch = data.batch_size (factored as accum_steps ×
+    # per-forward device batch × data-axis ways — train.accum_steps
+    # decouples the two, which is exactly what it was built for).
+    # Resolved ONCE at fit entry (train_lib.resolve_large_batch, logged
+    # with the factorization); pair with lr_schedule=warmup_cosine —
+    # a scaled LR without warmup diverges at these scales and the
+    # resolver warns when warmup is absent. 0 = off (LR verbatim).
+    lr_scale_ref_batch: int = 0
+    # Golden-curve parity gate for the large-batch recipe — the recipe
+    # twin of dtype_curve_ref (same _DtypeCurveGate machinery): a
+    # metrics.jsonl from the ACCEPTED baseline recipe (e.g. adamw at
+    # the reference batch) that every eval's val AUC is compared
+    # against at matching steps. Drift beyond recipe_curve_tol raises
+    # train_lib.RecipeCurveRejected — a faster recipe must prove
+    # quality parity on time-to-AUC terms, never silently ship. Empty
+    # = ungated (logged when a lamb/scaled-LR run has no pin).
+    recipe_curve_ref: str = ""
+    recipe_curve_tol: float = 0.02
     # Early stopping on validation AUC (reference: stop after `patience`
     # evals without a new best; keep best checkpoint).
     early_stop_patience: int = 10
@@ -353,17 +389,42 @@ class TrainConfig:
 
 @dataclasses.dataclass(frozen=True)
 class ParallelConfig:
-    """Device-mesh config (SURVEY.md N7-N9).
+    """Device-mesh config (SURVEY.md N7-N9; ISSUE 14 pod-scale mesh).
 
-    The workload is data-parallel only (SURVEY.md N10: Inception-v3 at
-    ~24M params fits trivially per chip); ``data_axis`` is the one mesh
-    axis. ``model_axis_size`` is the documented extension seam for a
-    future model axis — kept at 1.
+    The mesh is a CONFIG AXIS, not an assumption: training meshes are
+    ``(member × data)`` when the member axis is sized, pure
+    data-parallel otherwise, and the serving engine assembles over its
+    own mesh (``serve_devices``) through the EngineSpec seam
+    (serve/assemble.py). ``model_axis_size`` is the documented
+    extension seam for a future model axis — kept at 1 (SURVEY.md N10:
+    Inception-v3 at ~24M params fits trivially per chip).
     """
 
+    # Name of the data-parallel mesh axis — batches shard over it, the
+    # gradient/BN all-reduces ride it. The explicit-collective ensemble
+    # forms (train.ensemble_manual_data) and axis_name BatchNorm pin
+    # the literal name "data" and refuse other spellings loudly; the
+    # GSPMD jit paths honor any name.
     data_axis: str = "data"
     num_devices: int = 0  # 0 = all local devices
     model_axis_size: int = 1
+    # Member-axis size of the (member × data) training mesh for the
+    # member-parallel ensemble driver. 0 = auto (gcd(k, n_devices) —
+    # the largest count dividing both, mesh.make_ensemble_mesh's
+    # historical rule); >1 pins the member axis explicitly (refused
+    # loudly when it does not divide the member count and the device
+    # count). On a serving mesh (serve_devices > 1) a value > 1 shards
+    # the STACKED serving tree across the member axis too — each
+    # device group holds k/member_axis_size members, the pod-scale
+    # form that finally amortizes ensemble serving.
+    member_axis_size: int = 0
+    # Devices the ASSEMBLED serving engine's mesh spans (ISSUE 14;
+    # serve/assemble.py). 0/1 = the mesh-less single-device
+    # construction — the bit-identity default every predict.py parity
+    # pin rides; >1 = a GSPMD serving mesh over that many devices:
+    # batch rows shard over data_axis, and with member_axis_size > 1
+    # the stacked tree shards over the member axis as well.
+    serve_devices: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

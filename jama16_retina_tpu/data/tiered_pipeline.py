@@ -106,6 +106,91 @@ def plan_residency(
     return steps, res_pb, res_pb * steps
 
 
+def host_spill_plan(n_padded: int, process_count: int) -> list:
+    """The cross-host sharded spill plan (ISSUE 14): process-major
+    contiguous ``[lo, hi)`` blocks of the PADDED resident set — host p
+    decodes and stages exactly its addressable shard, never the whole
+    resident tier (on a pod each host would otherwise burn
+    process_count× the decode work and host RAM staging rows whose
+    device copies it cannot even address).
+
+    ``n_padded`` is the resident row count already padded to the
+    mesh's data-axis size (``_place_resident``'s rule), so block
+    boundaries are device-block aligned: the union of the per-host
+    blocks IS the single-host resident set, disjoint and in order —
+    the content-invariance contract pinned by tests/test_podscale.py.
+    A pure function of its arguments (graftlint-deterministic)."""
+    if process_count < 1:
+        raise ValueError(f"process_count must be >= 1, got {process_count}")
+    if n_padded % process_count:
+        raise ValueError(
+            f"{n_padded} padded resident rows do not split across "
+            f"{process_count} process(es); pad to the data-axis size "
+            "first (_place_resident's rule — every host owns an equal "
+            "device-aligned block)"
+        )
+    per = n_padded // process_count
+    return [(p * per, (p + 1) * per) for p in range(process_count)]
+
+
+def host_spill_ids(n_res: int, n_padded: int, process_index: int,
+                   process_count: int) -> np.ndarray:
+    """Global record ids host ``process_index`` stages: its
+    ``host_spill_plan`` block, with padding rows (>= n_res) wrapping
+    onto leading records exactly like the single-host pad
+    (``_place_resident``'s wraparound rule), so the padded global
+    array's contents are invariant to how many hosts staged it."""
+    lo, hi = host_spill_plan(n_padded, process_count)[process_index]
+    return (np.arange(lo, hi) % max(n_res, 1)).astype(np.int64)
+
+
+def stage_resident(decoder, n_res: int, mesh, process_index=None,
+                   process_count=None):
+    """Decode + pin the resident tier, per-host sharded (ISSUE 14).
+
+    Single-process (the historical path, bit-identical): one
+    ``decode_range`` + ``_place_resident``. Multi-process: each host
+    decodes only its ``host_spill_ids`` block and contributes it via
+    ``jax.make_array_from_process_local_data`` — the spill cache's
+    device layout is identical to the single-host placement (row-
+    sharded dim 0, process-major), only the staging work is sharded.
+    ``process_index``/``process_count`` default to the jax runtime's
+    (tests pass them explicitly to drive the plan single-process)."""
+    import jax
+
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    P = jax.process_count() if process_count is None else process_count
+    p = jax.process_index() if process_index is None else process_index
+    if mesh is None or P <= 1:
+        images, grades = decoder.decode_range(0, n_res)
+        return _place_resident(images, grades, mesh)
+    if mesh_lib.has_member_axis(mesh):
+        # Rows shard over the DATA axis only — a >1-way member axis
+        # REPLICATES every row across member groups, so a host whose
+        # devices sit in one member row addresses ALL rows of its data
+        # columns, not a disjoint 1/P block: the per-host plan below
+        # cannot express that layout (make_array_from_process_local_data
+        # would mis-assemble it). Refuse loudly; full-local placement
+        # (mesh_lib.place_full_local / the hbm loader) is the
+        # member-mesh road.
+        raise ValueError(
+            "the cross-host sharded spill plan needs a data-only mesh "
+            "(rows replicate across a >1-way member axis, so no "
+            "disjoint per-host row block exists) — use the hbm loader "
+            "or a pure data mesh for multi-process tiered residency"
+        )
+    d = int(mesh.shape[mesh_lib._batch_axis(mesh)])
+    n_padded = n_res + ((-n_res) % d)
+    ids = host_spill_ids(n_res, n_padded, p, P)
+    host = decoder.decode_batch(ids)
+    sharding = mesh_lib.batch_sharding(mesh)
+    return (
+        jax.make_array_from_process_local_data(sharding, host["image"]),
+        jax.make_array_from_process_local_data(sharding, host["grade"]),
+    )
+
+
 def _epoch_perm(seed: int, epoch: int, tier: int, n: int) -> np.ndarray:
     """Deterministic per-(tier, epoch) permutation of [0, n) — a numpy
     stream seeded on (seed, tier, epoch) via SeedSequence (the same
@@ -281,13 +366,6 @@ def train_batches(
     )
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
-    if jax.process_count() > 1:
-        raise ValueError(
-            "data.loader='tiered' is single-process for now — use the "
-            "hbm loader (fully resident, multi-host sharded) or the "
-            "grain/tfdata loaders on multi-process launches"
-        )
-
     workers = (
         knobs.decode_workers if knobs is not None
         else resolve_decode_workers(cfg.decode_workers)
@@ -316,6 +394,21 @@ def train_batches(
         budget_base_bytes=getattr(cfg, "hbm_budget_bytes", 0),
     )
     plan = _TierPlan(n, cfg.batch_size, capacity, seed)
+
+    if jax.process_count() > 1 and plan.str_pb:
+        # The STREAMED tier stays single-process (its per-batch host
+        # decode has no per-process row block under this plan); the
+        # fully-resident case proceeds below with the cross-host
+        # sharded spill plan — each host stages only its addressable
+        # shard (stage_resident / host_spill_plan, ISSUE 14), so
+        # data.hbm_budget_bytes governs each host's own staging.
+        raise ValueError(
+            "data.loader='tiered' at PARTIAL residency is "
+            "single-process — raise the budget until the split is "
+            "fully resident (the spill plan then shards staging "
+            "across hosts), or use the hbm/grain/tfdata loaders on "
+            "multi-process launches"
+        )
 
     logging.info(
         "tiered loader: %d/%d rows HBM-resident (%.0f%%, %.1f MB over %d "
@@ -357,11 +450,24 @@ def train_batches(
         "data.tiered.resident_rows_pinned",
         help="rows the HBM budget admitted into the resident tier",
     ).set(plan.n_res)
+    g_host_spill = reg.gauge(
+        "data.tiered.host_spill_rows",
+        help="resident-tier rows THIS host decoded and staged (the "
+             "cross-host sharded spill plan's addressable shard; "
+             "single-process = the whole resident set)",
+    )
 
     res_images = res_grades = None
     if plan.n_res:
-        res_images, res_grades = decoder.decode_range(0, plan.n_res)
-        res_images, res_grades = _place_resident(res_images, res_grades, mesh)
+        res_images, res_grades = stage_resident(decoder, plan.n_res, mesh)
+        if jax.process_count() > 1:
+            n_padded = plan.n_res + ((-plan.n_res) % n_dev)
+            lo, hi = host_spill_plan(n_padded, jax.process_count())[
+                jax.process_index()
+            ]
+            g_host_spill.set(hi - lo)
+        else:
+            g_host_spill.set(plan.n_res)
     combine = _make_combine_fn(
         res_images, res_grades, plan.res_pb, plan.str_pb, mesh
     )

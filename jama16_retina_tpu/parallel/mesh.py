@@ -146,18 +146,24 @@ def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
 
 
 def make_ensemble_mesh(
-    n_members: int, num_devices: int = 0
+    n_members: int, num_devices: int = 0, member_axis_size: int = 0,
+    data_axis: str = "data",
 ) -> Mesh:
-    """2-D ``('member', 'data')`` mesh for member-parallel ensemble
-    training (trainer.fit_ensemble_parallel).
+    """2-D ``('member', data_axis)`` mesh for member-parallel ensemble
+    training (trainer.fit_ensemble_parallel) and member-sharded serving
+    (serve/assemble.py).
 
     The member axis carries INDEPENDENT replicas — stacked params shard
     across it with zero cross-member collectives (it is ensemble
     data-parallelism over seeds, not a tensor/pipeline axis; SURVEY.md
-    N10's honesty note stands). Its size is ``gcd(n_members, n_devices)``
-    — the largest count that divides both, so the stacked member dim and
-    the device array always factor evenly (k=10 on 8 chips -> member
-    axis 2, data axis 4, 5 members per member-shard).
+    N10's honesty note stands). ``member_axis_size`` 0 = auto:
+    ``gcd(n_members, n_devices)`` — the largest count that divides both,
+    so the stacked member dim and the device array always factor evenly
+    (k=10 on 8 chips -> member axis 2, data axis 4, 5 members per
+    member-shard). An explicit size (``parallel.member_axis_size``) is
+    validated against BOTH divisibility constraints here, at mesh
+    construction, instead of surfacing as an XLA uneven-sharding error
+    mid-compile.
     """
     import math
 
@@ -169,17 +175,90 @@ def make_ensemble_mesh(
             )
         devices = devices[:num_devices]
     n = len(devices)
-    member_size = math.gcd(max(n_members, 1), n)
+    if member_axis_size and member_axis_size > 0:
+        member_size = int(member_axis_size)
+        if n % member_size:
+            raise ValueError(
+                f"parallel.member_axis_size={member_size} does not "
+                f"divide the {n}-device mesh"
+            )
+        if max(n_members, 1) % member_size:
+            raise ValueError(
+                f"parallel.member_axis_size={member_size} does not "
+                f"divide the {n_members}-member ensemble"
+            )
+    else:
+        member_size = math.gcd(max(n_members, 1), n)
     return Mesh(
         np.asarray(devices).reshape(member_size, n // member_size),
-        ("member", "data"),
+        ("member", data_axis),
+    )
+
+
+def make_serve_mesh(pc, n_members: int = 1) -> "Mesh | None":
+    """The serving mesh a ParallelConfig describes (ISSUE 14;
+    serve/assemble.py builds engines over it).
+
+    ``parallel.serve_devices`` 0/1 returns None — the mesh-less
+    single-device construction every predict.py bit-identity pin rides,
+    byte-for-byte the pre-seam path. >1 with ``member_axis_size`` <= 1
+    is a 1-D data mesh (state replicated, batch rows sharded); with
+    ``member_axis_size`` > 1 it is the ('member', data_axis) mesh that
+    shards the STACKED serving tree across the member axis — each
+    device group holds n_members/member_axis_size members.
+    """
+    n = int(pc.serve_devices)
+    if n <= 1:
+        return None
+    member = int(pc.member_axis_size)
+    if member <= 1:
+        return make_mesh(n, axis=pc.data_axis)
+    return make_ensemble_mesh(
+        n_members, num_devices=n, member_axis_size=member,
+        data_axis=pc.data_axis,
+    )
+
+
+def mesh_fingerprint(mesh: "Mesh | None") -> dict:
+    """The identity of a mesh as seen by serialized executables: device
+    array shape, AXIS NAMES, and the process count of the launch
+    (serve/compilecache.py folds this into the model fingerprint, so a
+    resharded pod slice — same device total, different axis factoring
+    or host split — refuses stale executables with the typed
+    CompileCacheStale rebuild message instead of deserializing a
+    program partitioned for another topology)."""
+    if mesh is None:
+        return {
+            "shape": [1],
+            "axis_names": [],
+            "process_count": int(jax.process_count()),
+        }
+    return {
+        "shape": [int(s) for s in mesh.devices.shape],
+        "axis_names": [str(a) for a in mesh.axis_names],
+        "process_count": int(jax.process_count()),
+    }
+
+
+def has_member_axis(mesh: "Mesh | None") -> bool:
+    """True when the mesh carries a >1-way 'member' axis — the signal
+    the serving stack keys member-sharded placement/dispatch on."""
+    return (
+        mesh is not None
+        and "member" in mesh.axis_names
+        and int(mesh.shape["member"]) > 1
     )
 
 
 def _batch_axis(mesh: Mesh) -> str:
     """The mesh axis batches shard over: 'data' when present (2-D
-    ensemble mesh), else the sole axis of the 1-D mesh."""
-    return "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+    ensemble mesh), else the sole axis of the 1-D mesh — or, under a
+    renamed ``parallel.data_axis`` on a 2-D mesh, the non-'member'
+    axis (the member axis never carries batch rows)."""
+    if "data" in mesh.axis_names:
+        return "data"
+    non_member = [a for a in mesh.axis_names if a != "member"]
+    return non_member[0] if non_member else mesh.axis_names[0]
 
 
 def member_sharding(mesh: Mesh) -> NamedSharding:

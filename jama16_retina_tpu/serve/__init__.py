@@ -4,6 +4,13 @@ half of the ROADMAP north star).
 
 Layers, composable and individually testable:
 
+  * ``assemble`` — EngineSpec -> built engine (ISSUE 14): the ONE
+    composable assembly seam where mesh shape, serving dtype, cascade,
+    compile cache, and member count compose declaratively instead of
+    each constructor site wiring the layers below positionally.
+    predict.py, the router's replica factory, and the lifecycle CLI
+    all construct through it; a 1-device spec is pinned bit-identical
+    to the pre-seam construction path.
   * ``engine``  — ServingEngine: restore every ensemble member ONCE,
     stack them into one device-resident [k] parameter tree
     (train_lib.stack_states), and serve a single stacked forward per
@@ -41,6 +48,7 @@ predict.py rides this stack for --device={tpu,cpu}; bench.py's
 ``serve_*`` section measures it under the round-3 fenced discipline.
 """
 
+from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
 from jama16_retina_tpu.serve.batcher import (
     DeadlineExceeded,
     MicroBatcher,
@@ -72,6 +80,7 @@ __all__ = [
     "CompileCacheStale",
     "DeadlineExceeded",
     "DtypeRejected",
+    "EngineSpec",
     "EscalationPool",
     "MicroBatcher",
     "NoReplicasLeft",
@@ -82,5 +91,6 @@ __all__ = [
     "Router",
     "ServePolicy",
     "ServingEngine",
+    "assemble",
     "resolve_buckets",
 ]

@@ -1,0 +1,198 @@
+"""Engine assembly: ONE composable seam from declared intent to a
+built serving engine (ISSUE 14 tentpole).
+
+The serve stack grew four cooperating layers that each wrapped the next
+ad hoc — ``engine.py`` (restore→stack→device_put), ``cascade.py``
+(a CascadeEngine hand-wired around two engines), ``quantize.py``
+(via ``make_serving_step(param_transform=)``), ``compilecache.py``
+(keyed per-mesh) — and every constructor site (predict.py's three
+paths, the router's replica factory, the lifecycle CLI) re-derived the
+wiring positionally. :class:`EngineSpec` makes the composition
+declarative: mesh shape, serving dtype, cascade, compile cache, and
+member count are FIELDS of one frozen spec, and :func:`assemble` is the
+one function that turns a spec into a ready engine.
+
+Contracts:
+
+  * **Bit-identity at the default spec.** A 1-device ``EngineSpec``
+    (``parallel.serve_devices`` <= 1, no explicit mesh) constructs the
+    engine through byte-for-byte the same calls the pre-seam code made
+    — mesh=None, same constructor arguments — so every existing parity
+    pin (engine vs sequential path, predict.py byte-identical JSONL)
+    rides ``assemble()`` unchanged (pinned by tests/test_podscale.py).
+  * **The mesh is config.** With no explicit ``mesh``, the serving mesh
+    comes from ``parallel.serve_devices`` / ``parallel.member_axis_size``
+    (mesh_lib.make_serve_mesh): 0/1 = the mesh-less legacy engine,
+    >1 = GSPMD data-sharded serving, member_axis_size > 1 additionally
+    shards the stacked tree across the member axis (the pod form).
+  * **Cascade composes, not wraps.** ``student_dirs`` (or
+    ``serve.cascade_student_dir``) assembles the ISSUE-10 cascade with
+    exactly predict.py's historical quality/registry wiring — including
+    the detached-registry dtype-gate construction for non-fp32
+    ensembles — behind the same spec.
+
+Construction sites (all through here): predict.py's single-engine,
+cascade, and router-replica paths; scripts/lifecycle_run.py's
+controller engine; the mesh-scaling dryrun and smoke harnesses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from jama16_retina_tpu.configs import ExperimentConfig
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSpec:
+    """Everything an engine assembly needs, declared up front.
+
+    ``cfg`` carries the knob surface (serve.dtype, cascade band/
+    thresholds, compile cache dir, parallel.* mesh axes); the spec adds
+    the per-deployment identities: which checkpoints, which (optional)
+    explicit mesh, which registry. ``member_dirs`` XOR ``state`` is the
+    engine's restore source (exactly ServingEngine's contract).
+    """
+
+    cfg: ExperimentConfig
+    # Ensemble member checkpoint dirs (the restore-once source); empty
+    # needs ``state``.
+    member_dirs: tuple = ()
+    # Distilled-student checkpoint dirs: non-empty assembles a
+    # CascadeEngine (student scores all rows, the ensemble only the
+    # escalation band). Empty falls back to
+    # ``serve.cascade_student_dir`` (discovered), then to no cascade.
+    student_dirs: tuple = ()
+    # Pre-stacked TrainState (bench/tests skip the orbax round-trip).
+    state: Any = None
+    # Pre-built flax model (the checkpoint schema); None builds one.
+    model: Any = None
+    # Explicit jax Mesh — wins over the config derivation. None derives
+    # from cfg.parallel (make_serve_mesh; None at <=1 serve_devices).
+    mesh: Any = None
+    # Telemetry registry; None = the engine's own default wiring.
+    registry: Any = None
+    # Cascade-level QualityMonitor; None builds one from cfg.obs.quality
+    # when the spec assembles a cascade (predict.py's wiring).
+    quality: Any = None
+    # Run the cascade's go-live gates (golden canary + operating-point
+    # parity) before returning — typed CascadeRejected on failure.
+    go_live: bool = False
+    # False assembles the PLAIN ensemble engine even when
+    # ``serve.cascade_student_dir`` is set — the router's replica
+    # factory builds its cascade by composition around a SHARED
+    # escalation pool, so its member/student sub-engines must assemble
+    # un-cascaded.
+    cascade: bool = True
+
+    def n_members(self) -> int:
+        if self.member_dirs:
+            return len(self.member_dirs)
+        if self.state is not None:
+            return int(self.state.step.shape[0])
+        return 1
+
+
+def resolve_mesh(spec: EngineSpec):
+    """The serving mesh this spec assembles over: the explicit mesh
+    when one is injected, else the ``parallel.*``-derived one (None —
+    the bit-identity single-device construction — unless
+    ``parallel.serve_devices`` > 1)."""
+    if spec.mesh is not None:
+        return spec.mesh
+    return mesh_lib.make_serve_mesh(
+        spec.cfg.parallel, n_members=spec.n_members()
+    )
+
+
+def _quality_off(cfg: ExperimentConfig) -> ExperimentConfig:
+    """cfg with the engine-level quality monitor disabled — the
+    sub-engine config of every cascade/replica assembly (the merged
+    view or replica 0 owns quality; sub-engines must not
+    double-observe)."""
+    return cfg.replace(obs=dataclasses.replace(
+        cfg.obs, quality=dataclasses.replace(
+            cfg.obs.quality, enabled=False,
+        ),
+    ))
+
+
+def _resolve_student_dirs(spec: EngineSpec) -> tuple:
+    if not spec.cascade:
+        return ()
+    if spec.student_dirs:
+        return tuple(spec.student_dirs)
+    if spec.cfg.serve.cascade_student_dir:
+        from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+        return tuple(ckpt_lib.discover_member_dirs(
+            spec.cfg.serve.cascade_student_dir
+        ))
+    return ()
+
+
+def assemble(spec: EngineSpec):
+    """Spec -> ready engine (ServingEngine, or CascadeEngine when the
+    spec carries a student). The one home of the serve stack's
+    composition rules; see the module docstring for the contracts."""
+    from jama16_retina_tpu import models
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg = spec.cfg
+    model = spec.model if spec.model is not None else models.build(cfg.model)
+    mesh = resolve_mesh(spec)
+    member_dirs = list(spec.member_dirs) if spec.member_dirs else None
+    student_dirs = _resolve_student_dirs(spec)
+
+    if not student_dirs:
+        # The plain ensemble engine — at the default spec this is
+        # byte-for-byte the legacy construction (mesh=None, same
+        # arguments), which is what keeps every parity pin honest.
+        return ServingEngine(
+            cfg, member_dirs, model=model, mesh=mesh, state=spec.state,
+            registry=spec.registry,
+        )
+
+    # Cascade assembly (ISSUE 10 wiring, now declarative): quality
+    # observability lives on the CASCADE (the merged scores are what
+    # the deployment serves), so both sub-engines build quality-off —
+    # EXCEPT the ensemble half under a non-fp32 dtype with a pinned
+    # canary, whose DtypeRejected construction gate needs the
+    # engine-level canary on a DETACHED registry (its gauges must not
+    # collide with the cascade's merged-view monitor).
+    from jama16_retina_tpu.obs import quality as quality_lib
+    from jama16_retina_tpu.obs import registry as obs_registry
+    from jama16_retina_tpu.serve.cascade import CascadeEngine
+
+    sub = _quality_off(cfg)
+    if (cfg.serve.dtype != "fp32"
+            and cfg.obs.quality.enabled
+            and cfg.obs.quality.canary_path):
+        ensemble = ServingEngine(
+            cfg, member_dirs, model=model, mesh=mesh, state=spec.state,
+            registry=obs_registry.Registry(),
+        )
+        # The monitor existed to arm the one-shot construction gate;
+        # steady-state quality lives on the cascade below.
+        ensemble.quality = None
+    else:
+        ensemble = ServingEngine(
+            sub, member_dirs, model=model, mesh=mesh, state=spec.state,
+            registry=spec.registry,
+        )
+    quality = spec.quality
+    if quality is None and cfg.obs.enabled:
+        quality = quality_lib.monitor_from_config(cfg.obs.quality)
+    engine = CascadeEngine(
+        cfg,
+        ServingEngine(sub, list(student_dirs), model=model, mesh=mesh),
+        ensemble,
+        registry=(spec.registry if spec.registry is not None
+                  else obs_registry.default_registry()),
+        quality=quality,
+    )
+    if spec.go_live:
+        engine.go_live()
+    return engine

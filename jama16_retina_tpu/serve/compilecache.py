@@ -66,14 +66,23 @@ class CompileCacheStale(RuntimeError):
 def model_fingerprint(cfg, mesh=None, n_devices: "int | None" = None) -> dict:
     """The identity a cached executable is only valid for: everything
     that changes the lowered serving program — model architecture knobs,
-    member form, TTA, mesh shape, and the jax/backend pair that produced
-    the serialization format. The serving DTYPE is deliberately NOT
-    here: it is part of every entry key instead, so one cache directory
+    member form, TTA, the mesh TOPOLOGY (device count, AXIS NAMES, and
+    the launch's process count — not just the shape: a resharded pod
+    slice with the same device total but a different member/data
+    factoring or host split lowers a differently-partitioned program,
+    and ISSUE 14's fix is that it must refuse with the typed
+    CompileCacheStale rebuild message instead of deserializing a
+    mismatched executable), and the jax/backend pair that produced the
+    serialization format. The serving DTYPE is deliberately NOT here:
+    it is part of every entry key instead, so one cache directory
     serves a model's fp32/bf16/int8 engines side by side."""
     import jax
 
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
     if n_devices is None:
         n_devices = int(mesh.devices.size) if mesh is not None else 1
+    mfp = mesh_lib.mesh_fingerprint(mesh)
     m = cfg.model
     return {
         "arch": m.arch,
@@ -85,6 +94,8 @@ def model_fingerprint(cfg, mesh=None, n_devices: "int | None" = None) -> dict:
         "member_parallel": bool(cfg.serve.member_parallel),
         "tta": bool(cfg.eval.tta),
         "n_devices": int(n_devices),
+        "mesh_axes": "x".join(mfp["axis_names"]) or "none",
+        "process_count": int(mfp["process_count"]),
         "jax": jax.__version__,
         "backend": jax.default_backend(),
     }

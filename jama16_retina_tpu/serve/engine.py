@@ -458,10 +458,27 @@ class ServingEngine:
         # generation build (begin_shadow -> promote) is untouched.
         state = quantize.state_for_dtype(state, self.dtype)
         n_members = int(state.step.shape[0])
-        place = (
-            mesh_lib.replicated(self.mesh) if self.mesh is not None
-            else jax.local_devices()[0]
-        )
+        if mesh_lib.has_member_axis(self.mesh):
+            # Member-sharded serving (ISSUE 14): the stacked tree
+            # shards across the mesh's member axis — each device group
+            # resides (and forwards) only k/m members. Divisibility is
+            # checked HERE, at generation build, with the knob named,
+            # instead of surfacing as an XLA uneven-sharding error on
+            # the first dispatch.
+            m = int(self.mesh.shape["member"])
+            if n_members % m:
+                raise ValueError(
+                    f"{n_members} stacked member(s) do not shard "
+                    f"across the serving mesh's {m}-way member axis — "
+                    "parallel.member_axis_size must divide the "
+                    "ensemble member count"
+                )
+            place = mesh_lib.member_sharding(self.mesh)
+        else:
+            place = (
+                mesh_lib.replicated(self.mesh) if self.mesh is not None
+                else jax.local_devices()[0]
+            )
         gen = _Generation(
             gen_id, jax.device_put(state, place), n_members, member_dirs,
             # DETACHED counter (not registered): a candidate's gate

@@ -95,6 +95,16 @@ class DtypeCurveRejected(RuntimeError):
     loops; the run stops with the violating step and both AUCs named."""
 
 
+class RecipeCurveRejected(RuntimeError):
+    """A large-batch recipe run (LAMB / scaled LR; ISSUE 14) drifted
+    beyond ``train.recipe_curve_tol`` of the pinned baseline golden
+    curve (``train.recipe_curve_ref`` — a metrics.jsonl from the
+    accepted reference recipe). Same fail-closed contract as
+    :class:`DtypeCurveRejected`: a recipe accepted on time-to-AUC must
+    prove it still REACHES the AUC — drift is refused with the
+    violating step and both AUCs named, never silently shipped."""
+
+
 class TrainState(flax.struct.PyTreeNode):
     step: jnp.ndarray
     params: Any
@@ -149,6 +159,19 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
         opt = optax.chain(
             optax.add_decayed_weights(tc.weight_decay, mask=_decay_mask),
             optax.rmsprop(sched, decay=0.9, eps=1.0, momentum=tc.momentum),
+        )
+    elif tc.optimizer == "lamb":
+        # Large-batch recipe (ISSUE 14): Adam moments + per-layer trust
+        # ratio ("Training EfficientNets at Supercomputer Scale",
+        # PAPERS.md) so a linearly-scaled LR stays sane layerwise at
+        # global batches an order of magnitude above the reference.
+        # optax-native: the optimizer state is the standard optax chain
+        # structure, so checkpoints/resume are optimizer-family-
+        # oblivious exactly like the fused adamw path (pinned by
+        # tests/test_podscale.py's 3-step parity + state-structure
+        # round-trip).
+        opt = optax.lamb(
+            sched, weight_decay=tc.weight_decay, mask=_decay_mask
         )
     else:
         raise ValueError(f"unknown optimizer {tc.optimizer!r}")
@@ -243,6 +266,61 @@ def validate_train_knobs(tc: TrainConfig) -> None:
                 "the whole optax chain; the clip transform would be "
                 "silently dropped) — disable one of the two"
             )
+
+
+def global_batch(cfg: ExperimentConfig) -> int:
+    """The recipe batch the optimizer sees per update: data.batch_size
+    — which factors as accum_steps × per-forward device batch ×
+    data-axis ways (train.accum_steps splits it into micro-batches
+    inside the one jit step, the mesh's data axis shards each
+    micro-batch across devices). THE one home for the definition the
+    large-batch LR rule scales against."""
+    return int(cfg.data.batch_size)
+
+
+def resolve_large_batch(cfg: ExperimentConfig, mesh=None) -> ExperimentConfig:
+    """Linear LR scaling tied to the global batch (ISSUE 14;
+    ``train.lr_scale_ref_batch``): effective peak LR = learning_rate ×
+    (global_batch / ref_batch), the Goyal-et-al. rule the large-batch
+    literature (PAPERS.md) pairs with LAMB and a warmup schedule.
+
+    A PURE function of (cfg, mesh) applied once at fit entry — resume
+    re-derives the identical effective LR, and the factorization
+    (accum × device batch × data ways) is logged so a recipe change is
+    traceable in the run log. 0 (the default) returns cfg untouched:
+    every existing pin rides the byte-identical config."""
+    ref = int(cfg.train.lr_scale_ref_batch)
+    if ref <= 0:
+        return cfg
+    gb = global_batch(cfg)
+    scale = gb / ref
+    ways = 1
+    if mesh is not None:
+        axis = mesh_lib._batch_axis(mesh)
+        ways = int(mesh.shape[axis])
+    accum = max(1, int(cfg.train.accum_steps))
+    eff_lr = cfg.train.learning_rate * scale
+    absl_logging.info(
+        "large-batch recipe: global batch %d (= %d accum × %d device "
+        "batch × %d data ways), LR %g × %.3g -> %g (%s)",
+        gb, accum, gb // (accum * ways), ways,
+        cfg.train.learning_rate, scale, eff_lr, cfg.train.optimizer,
+    )
+    if scale != 1.0 and cfg.train.lr_schedule not in (
+        "warmup_cosine",
+    ):
+        absl_logging.warning(
+            "lr_scale_ref_batch scaled the peak LR %.3gx under "
+            "lr_schedule=%s — scaled-LR recipes want "
+            "warmup_cosine (a cold start at the scaled LR is the "
+            "classic large-batch divergence mode)",
+            scale, cfg.train.lr_schedule,
+        )
+    import dataclasses
+
+    return dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, learning_rate=eff_lr)
+    )
 
 
 def _labels_from_grades(grades: jnp.ndarray, head: str) -> jnp.ndarray:
@@ -1008,9 +1086,21 @@ def make_serving_step(
     None (the default) leaves the program byte-identical to before the
     hook existed.
 
+    Member-sharded serving (ISSUE 14): a ('member', data) mesh
+    (mesh_lib.make_serve_mesh with ``parallel.member_axis_size`` > 1)
+    shards the STACKED state across the member axis — each device
+    group forwards only its local members (manual member axis via
+    shard_map, reusing the same ``step`` body so the two paths cannot
+    diverge; the same gathers-elimination rationale as
+    make_ensemble_eval_step), while batch rows shard over the data
+    axis. This is what finally amortizes ensemble serving across a pod
+    slice: k members on an m-way member axis pay k/m member-forwards
+    of wall-clock per batch.
+
     Same EMA/TTA semantics as every other eval surface (_eval_probs).
     """
     cfg = _pallas_safe_cfg(cfg, mesh, "serving step")
+    member_sharded = mesh_lib.has_member_axis(mesh)
 
     def step(state: TrainState, batch: dict):
         if param_transform is not None:
@@ -1020,14 +1110,42 @@ def make_serving_step(
         def fwd(st):
             return _eval_probs(st, images, model, cfg)
 
-        if member_parallel:
+        # A member-sharded mesh serves the vmapped member form per
+        # shard regardless of serve.member_parallel: it IS the
+        # pod-serving form that flag documents (float-equivalent, not
+        # bit-equal — the lax.map scan body is rejected by the manual-
+        # axis partitioner), and the engine's bit-identity pins all
+        # ride mesh-less / data-mesh engines, which keep lax.map.
+        if member_parallel or member_sharded:
             return jax.vmap(fwd)(state)
         return jax.lax.map(fwd, state)
 
     if mesh is None:
         return jax.jit(step)
-    repl = mesh_lib.replicated(mesh)
     data = mesh_lib.batch_sharding(mesh)
+    if member_sharded:
+        def member_sharded_step(state: TrainState, batch: dict):
+            # Manual member axis: local member weights forward locally
+            # (the shard's k/m members under vmap) instead of being
+            # all-gathered by XLA's batched-conv strategy; the data
+            # axis stays automatic so batch-row sharding compiles to
+            # the same programs the 1-D serving mesh runs.
+            return _shard_map(
+                lambda st_local: step(st_local, batch),
+                mesh=mesh, axis_names={"member"},
+                in_specs=(P("member"),), out_specs=P("member"),
+            )(state)
+
+        member = mesh_lib.member_sharding(mesh)
+        probs_sharding = (
+            mesh_lib.replicated(mesh) if jax.process_count() > 1
+            else member
+        )
+        return jax.jit(
+            member_sharded_step,
+            in_shardings=(member, data), out_shardings=probs_sharding,
+        )
+    repl = mesh_lib.replicated(mesh)
     return jax.jit(step, in_shardings=(repl, data), out_shardings=repl)
 
 

@@ -1040,67 +1040,108 @@ class _BgJob:
         return self._result
 
 
+def _load_curve_ref(path: str, knob: str) -> dict:
+    """step -> pinned val AUC from a metrics.jsonl golden curve; the
+    loud refusals name the knob that pinned the path."""
+    from jama16_retina_tpu.utils.logging import read_jsonl
+
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{knob} {path!r} does not exist — pin a reference run's "
+            "metrics.jsonl (or unset the knob to run ungated)"
+        )
+    ref: dict = {}
+    for r in read_jsonl(path):
+        if r.get("kind") != "eval" or r.get("step") is None:
+            continue
+        auc = r.get("ensemble_val_auc", r.get("val_auc"))
+        if auc is not None and int(r["step"]) not in ref:
+            ref[int(r["step"])] = float(auc)
+    if not ref:
+        raise ValueError(
+            f"{knob} {path!r} holds no eval records — point it at the "
+            "reference run's metrics.jsonl"
+        )
+    return ref
+
+
 class _DtypeCurveGate:
-    """The train-side golden-curve parity gate (ISSUE 11), mirroring
-    serve/quantize's canary gate: a non-fp32 run must track the pinned
-    fp32 eval-AUC trajectory (``train.dtype_curve_ref`` — a metrics
-    JSONL from an fp32 run of the same config/seed) within
-    ``train.dtype_curve_tol`` at every matching step, or the run is
-    REFUSED (train_lib.DtypeCurveRejected), not silently shipped.
-    fp32 runs and ref-less non-fp32 runs (logged as ungated) no-op."""
+    """The train-side golden-curve parity gate (ISSUE 11; extended by
+    ISSUE 14), mirroring serve/quantize's canary gate. Two arms, same
+    machinery:
+
+      * DTYPE — a non-fp32 run must track the pinned fp32 eval-AUC
+        trajectory (``train.dtype_curve_ref``) within
+        ``train.dtype_curve_tol`` at every matching step, or the run is
+        REFUSED (train_lib.DtypeCurveRejected);
+      * RECIPE — a large-batch recipe run (LAMB / scaled LR) must track
+        the pinned baseline-recipe curve (``train.recipe_curve_ref``)
+        within ``train.recipe_curve_tol``, or it is REFUSED
+        (train_lib.RecipeCurveRejected).
+
+    fp32/baseline runs and ref-less cheap/recipe runs (logged as
+    ungated) no-op. Both arms can gate one run — a bf16 LAMB run
+    checks both curves at every eval."""
 
     def __init__(self, cfg: ExperimentConfig):
-        self._ref: "dict | None" = None
-        self._tol = cfg.train.dtype_curve_tol
-        self._dtype = cfg.train.dtype
-        if cfg.train.dtype == "fp32":
-            return
-        path = cfg.train.dtype_curve_ref
-        if not path:
+        # [(step->auc, tol, exc_cls, description)]
+        self._arms: list = []
+        tc = cfg.train
+        if tc.dtype != "fp32":
+            if tc.dtype_curve_ref:
+                self._arms.append((
+                    _load_curve_ref(
+                        tc.dtype_curve_ref, "train.dtype_curve_ref"
+                    ),
+                    tc.dtype_curve_tol,
+                    train_lib.DtypeCurveRejected,
+                    f"train.dtype={tc.dtype} drifted from the pinned "
+                    "fp32 golden curve"
+                    " — the cheap numerics mode is refused; retrain in "
+                    "fp32 or widen train.dtype_curve_tol deliberately",
+                ))
+            else:
+                absl_logging.warning(
+                    "train.dtype=%s runs UNGATED: no "
+                    "train.dtype_curve_ref golden curve is pinned — "
+                    "eval-AUC parity with fp32 is not being checked",
+                    tc.dtype,
+                )
+        recipe_run = tc.optimizer == "lamb" or tc.lr_scale_ref_batch > 0
+        if recipe_run and tc.recipe_curve_ref:
+            self._arms.append((
+                _load_curve_ref(
+                    tc.recipe_curve_ref, "train.recipe_curve_ref"
+                ),
+                tc.recipe_curve_tol,
+                train_lib.RecipeCurveRejected,
+                f"the {tc.optimizer} large-batch recipe drifted from "
+                "the pinned baseline golden curve"
+                " — the recipe is refused; rebaseline or widen "
+                "train.recipe_curve_tol deliberately",
+            ))
+        elif recipe_run:
             absl_logging.warning(
-                "train.dtype=%s runs UNGATED: no train.dtype_curve_ref "
-                "golden curve is pinned — eval-AUC parity with fp32 is "
-                "not being checked", cfg.train.dtype,
+                "large-batch recipe (optimizer=%s, lr_scale_ref_batch="
+                "%d) runs UNGATED: no train.recipe_curve_ref golden "
+                "curve is pinned — eval-AUC parity with the baseline "
+                "recipe is not being checked",
+                tc.optimizer, tc.lr_scale_ref_batch,
             )
-            return
-        from jama16_retina_tpu.utils.logging import read_jsonl
-
-        if not os.path.exists(path):
-            raise FileNotFoundError(
-                f"train.dtype_curve_ref {path!r} does not exist — pin "
-                "an fp32 run's metrics.jsonl (or unset the knob to run "
-                "ungated)"
-            )
-        ref: dict = {}
-        for r in read_jsonl(path):
-            if r.get("kind") != "eval" or r.get("step") is None:
-                continue
-            auc = r.get("ensemble_val_auc", r.get("val_auc"))
-            if auc is not None and int(r["step"]) not in ref:
-                ref[int(r["step"])] = float(auc)
-        if not ref:
-            raise ValueError(
-                f"train.dtype_curve_ref {path!r} holds no eval records "
-                "— point it at the fp32 run's metrics.jsonl"
-            )
-        self._ref = ref
 
     def check(self, step: int, auc: float) -> None:
-        if self._ref is None:
-            return
-        ref = self._ref.get(int(step))
-        if ref is None:
-            return
-        if abs(float(auc) - ref) > self._tol:
-            raise train_lib.DtypeCurveRejected(
-                f"train.dtype={self._dtype} drifted from the pinned "
-                f"fp32 golden curve at step {step}: val AUC "
-                f"{float(auc):.5f} vs pinned {ref:.5f} "
-                f"(|Δ|={abs(float(auc) - ref):.5f} > "
-                f"train.dtype_curve_tol={self._tol}) — the cheap "
-                "numerics mode is refused; retrain in fp32 or widen "
-                "the tolerance deliberately"
-            )
+        for ref_map, tol, exc_cls, desc in self._arms:
+            ref = ref_map.get(int(step))
+            if ref is None:
+                continue
+            if abs(float(auc) - ref) > tol:
+                head, _, tail = desc.partition(" — ")
+                raise exc_cls(
+                    f"{head} at step {step}: val AUC {float(auc):.5f} "
+                    f"vs pinned {ref:.5f} "
+                    f"(|Δ|={abs(float(auc) - ref):.5f} > tol={tol}) — "
+                    f"{tail}"
+                )
 
 
 def _run_meta_path(workdir: str) -> str:
@@ -1210,7 +1251,14 @@ def fit(
     prev_debug_nans = jax.config.jax_debug_nans
     if cfg.train.debug:
         jax.config.update("jax_debug_nans", True)
-    mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
+    mesh = mesh or mesh_lib.make_mesh(
+        cfg.parallel.num_devices, axis=cfg.parallel.data_axis
+    )
+    # Large-batch recipe resolution (ISSUE 14): linear LR scaling tied
+    # to the global batch, applied ONCE here so the optimizer/schedule
+    # built below see the effective LR (pure function of cfg + mesh —
+    # resume re-derives the identical value).
+    cfg = train_lib.resolve_large_batch(cfg, mesh)
     log = RunLog(workdir, tensorboard=cfg.train.tensorboard,
                  fresh=not cfg.train.resume)
     log.write("config", name=cfg.name, seed=seed,
@@ -1743,7 +1791,12 @@ def fit_ensemble_parallel(
             "through sequential fit() calls — the lifecycle controller's "
             "RETRAIN phase does exactly that"
         )
-    mesh = mesh_lib.make_ensemble_mesh(k, cfg.parallel.num_devices)
+    mesh = mesh_lib.make_ensemble_mesh(
+        k, cfg.parallel.num_devices,
+        member_axis_size=cfg.parallel.member_axis_size,
+        data_axis=cfg.parallel.data_axis,
+    )
+    cfg = train_lib.resolve_large_batch(cfg, mesh)
     prev_debug_nans = jax.config.jax_debug_nans
     if cfg.train.debug:
         jax.config.update("jax_debug_nans", True)
@@ -2356,6 +2409,15 @@ def fit_tf(
             "legacy tf backend saves synchronously — unset them with "
             "--device=tf"
         )
+    if (cfg.train.optimizer == "lamb" or cfg.train.lr_scale_ref_batch > 0
+            or cfg.train.recipe_curve_ref):
+        raise ValueError(
+            "the large-batch recipe (train.optimizer=lamb / "
+            "train.lr_scale_ref_batch / train.recipe_curve_ref) is a "
+            "flax-path feature (ISSUE 14): keras has no LAMB twin and "
+            "the golden-curve gate lives in the flax eval block — "
+            "unset them with --device=tf"
+        )
     seed = cfg.train.seed if seed is None else seed
     seed = _load_or_write_run_meta(workdir, seed, cfg.name, cfg.train.resume)
     tf.keras.utils.set_random_seed(seed)
@@ -2620,7 +2682,9 @@ def evaluate_checkpoints(
             "protocol avoids (the plain operating_points rows already "
             "report them)"
         )
-    mesh = mesh or mesh_lib.make_mesh(cfg.parallel.num_devices)
+    mesh = mesh or mesh_lib.make_mesh(
+        cfg.parallel.num_devices, axis=cfg.parallel.data_axis
+    )
     model = models.build(cfg.model)  # flax: checkpoint tree structure
     if backend == "tf":
         from jama16_retina_tpu.models import tf_backend

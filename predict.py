@@ -131,42 +131,52 @@ def _router_replica_engines(cfg, dirs, model, n):
     :class:`EscalationPool` of ``serve.router_escalation_replicas``
     engines, so most replicas pay ~1/k FLOPs while escalations pool.
 
+    Every engine is a replica FACTORY product of the assembly seam
+    (serve/assemble.py; ISSUE 14): the spec declares member dirs, the
+    quality-carrying replica, and — for cascades — ``cascade=False``
+    on the sub-engines so the shared-pool composition stays the
+    router's, not the spec's. ``parallel.serve_devices`` therefore
+    meshes every replica identically.
+
     Quality observability lives on replica 0 only: one monitor, one
     canary cadence, no same-name gauge interleaving across replicas
     (at --replicas 1 replica 0 IS the fleet — exactly the
     single-engine wiring, which is what keeps the JSONL byte-identity
     pin honest)."""
-    import dataclasses
-
     from jama16_retina_tpu.obs import quality as quality_lib
-    from jama16_retina_tpu.serve import (
-        CascadeEngine,
-        EscalationPool,
-        ServingEngine,
+    from jama16_retina_tpu.serve import CascadeEngine, EscalationPool
+    from jama16_retina_tpu.serve.assemble import (
+        EngineSpec,
+        _quality_off,
+        assemble,
     )
     from jama16_retina_tpu.utils import checkpoint as ckpt_lib
 
-    sub = cfg.replace(obs=dataclasses.replace(
-        cfg.obs, quality=dataclasses.replace(
-            cfg.obs.quality, enabled=False,
-        ),
-    ))
+    sub = _quality_off(cfg)
+    dirs = tuple(dirs)
     if not cfg.serve.cascade_student_dir:
         return [
-            ServingEngine(cfg if i == 0 else sub, dirs, model=model)
+            assemble(EngineSpec(
+                cfg=cfg if i == 0 else sub, member_dirs=dirs, model=model,
+            ))
             for i in range(n)
         ]
-    student_dirs = ckpt_lib.discover_member_dirs(
+    student_dirs = tuple(ckpt_lib.discover_member_dirs(
         cfg.serve.cascade_student_dir
-    )
+    ))
     pool = EscalationPool([
-        ServingEngine(sub, dirs, model=model)
+        assemble(EngineSpec(
+            cfg=sub, member_dirs=dirs, model=model, cascade=False,
+        ))
         for _ in range(max(1, cfg.serve.router_escalation_replicas))
     ])
     cascades = [
         CascadeEngine(
             cfg if i == 0 else sub,
-            ServingEngine(sub, student_dirs, model=model),
+            assemble(EngineSpec(
+                cfg=sub, member_dirs=student_dirs, model=model,
+                cascade=False,
+            )),
             pool,
             quality=(
                 quality_lib.monitor_from_config(cfg.obs.quality)
@@ -376,8 +386,8 @@ def main(argv):
         # (tests/test_serve.py pins both levels).
         import jax
 
-        from jama16_retina_tpu.serve import CascadeEngine, ServingEngine
         from jama16_retina_tpu.serve import policy as policy_lib
+        from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
         from jama16_retina_tpu.serve.router import Router
 
         # Frontier-derived serving policy (ISSUE 12; serve/policy.py):
@@ -432,65 +442,26 @@ def main(argv):
                 snap.write_record("router", **router.report())
             router.close()
         elif cfg.serve.cascade_student_dir:
-            # Cheap-path serving (ISSUE 10): the distilled student
-            # scores every image; only rows inside serve.cascade_band
-            # of the operating thresholds pay the full stacked
-            # ensemble. Quality observability moves UP to the cascade
-            # (the merged scores are what this batch serves), so the
-            # sub-engines are built with the engine-level monitor off —
-            # EXCEPT the ensemble half under a non-fp32 dtype with a
-            # configured canary: the DtypeRejected construction gate
-            # needs the engine-level pinned canary, so quality stays on
-            # there, on a DETACHED registry (its monitor's gauges must
-            # not collide with the cascade's merged-view monitor). The
-            # student's dtype numerics are gated transitively by the
-            # cascade's go-live canary below, which scores the full
-            # student->escalation path at the serving dtype.
-            from jama16_retina_tpu.obs import quality as quality_lib
-            from jama16_retina_tpu.obs import registry as obs_registry
-
-            sub = cfg.replace(obs=dataclasses.replace(
-                cfg.obs, quality=dataclasses.replace(
-                    cfg.obs.quality, enabled=False,
-                ),
+            # Cheap-path serving (ISSUE 10), assembled through the
+            # EngineSpec seam (ISSUE 14; serve/assemble.py): the
+            # distilled student scores every image; only rows inside
+            # serve.cascade_band of the operating thresholds pay the
+            # full stacked ensemble. assemble() owns the historical
+            # wiring — quality moves UP to the cascade, the non-fp32
+            # ensemble half keeps its DtypeRejected construction gate
+            # on a detached registry, and go_live=True runs the
+            # golden-canary + operating-point parity gates (typed
+            # CascadeRejected refuses the batch; a student/band pair
+            # that moves the operating points never scores a
+            # screening batch).
+            engine = assemble(EngineSpec(
+                cfg=cfg, member_dirs=tuple(dirs), model=model,
+                go_live=True,
             ))
-            student_dirs = ckpt_lib.discover_member_dirs(
-                cfg.serve.cascade_student_dir
-            )
-            if (cfg.serve.dtype != "fp32"
-                    and cfg.obs.quality.enabled
-                    and cfg.obs.quality.canary_path):
-                ensemble = ServingEngine(
-                    cfg, dirs, model=model,
-                    registry=obs_registry.Registry(),
-                )
-                # The monitor existed to arm the one-shot construction
-                # gate; steady-state quality lives on the CASCADE below
-                # (merged scores). Detach it so escalated traffic
-                # doesn't feed band-biased drift windows or re-score
-                # the golden set on the engine's canary cadence.
-                ensemble.quality = None
-            else:
-                ensemble = ServingEngine(sub, dirs, model=model)
-            engine = CascadeEngine(
-                cfg,
-                ServingEngine(sub, student_dirs, model=model),
-                ensemble,
-                registry=obs_registry.default_registry(),
-                quality=(
-                    quality_lib.monitor_from_config(cfg.obs.quality)
-                    if cfg.obs.enabled else None
-                ),
-            )
-            # The go-live gate (serve/cascade.py): with a pinned golden
-            # canary configured the cascade must reproduce it within
-            # lifecycle.gate_canary_max_dev or this batch refuses
-            # loudly (typed CascadeRejected) — a student/band pair that
-            # moves the operating points never scores a screening
-            # batch. Without gate artifacts the verdicts record skips.
-            engine.go_live()
         else:
-            engine = ServingEngine(cfg, dirs, model=model)
+            engine = assemble(EngineSpec(
+                cfg=cfg, member_dirs=tuple(dirs), model=model,
+            ))
         if _REPLICAS.value > 0:
             pass  # probs computed through the router above
         elif snap is None:

@@ -12,6 +12,11 @@
 #       lint + the durable-state integrity smoke (ISSUE 13): seed a
 #       sealed workdir, flip one byte, assert graftfsck exit 1 naming
 #       the artifact, --repair, assert exit 0 — scripts/fsck_smoke.py.
+#   bash scripts/ci_checks.sh --mesh-smoke
+#       lint + the pod-scale mesh smoke (ISSUE 14): a simulated
+#       4-device assembled engine, 2 train steps under pjit+LAMB, and
+#       the golden-curve recipe gate firing on a poisoned reference —
+#       scripts/mesh_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -36,6 +41,12 @@ fi
 if [[ "${1:-}" == "--fsck-smoke" ]]; then
     echo "== durable-state integrity smoke (graftfsck) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/fsck_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--mesh-smoke" ]]; then
+    echo "== pod-scale mesh smoke (assemble + pjit+LAMB + recipe gate) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/mesh_smoke.py
     exit 0
 fi
 

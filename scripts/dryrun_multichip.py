@@ -1,0 +1,379 @@
+#!/usr/bin/env python
+"""Mesh-scaling dryrun: the new mesh axis measured end to end
+(ISSUE 14 satellite).
+
+``__graft_entry__.dryrun_multichip`` proves the multi-device programs
+COMPILE AND EXECUTE; this script measures how they SCALE — per device
+count it runs, in a fresh subprocess with that many fake CPU devices:
+
+  * the production pjit train step (train_lib.make_train_step over a
+    ``parallel.data_axis`` mesh) under the large-batch LAMB recipe
+    (``train.optimizer=lamb``), timed to
+    ``train_mesh_d{N}_images_per_sec``;
+  * an ASSEMBLED serving engine (serve/assemble.py EngineSpec — the
+    one construction seam) over the config-derived serving mesh
+    (``parallel.serve_devices`` / ``member_axis_size``: a simulated
+    2×2 ('member','data') mesh at N=4), timed to
+    ``serve_mesh_d{N}_images_per_sec``;
+  * at N >= 4, the ensemble4 stacked-vs-sequential ratio in the POD
+    regime (small per-device batch — collective-width-dominated),
+    published UNGATED as ``ensemble4_parallel_speedup[_d{N}]``: the
+    member-sharded manual-data form vs one member DP over the whole
+    mesh (~2x at N=4, ~2.8x at N=8 on this container — the ratio the
+    1-device bench gate could never express).
+
+Fresh subprocesses because fake-device counts pin at first backend
+init (the conftest/XLA_FLAGS rule); each child re-enters this file
+with ``--single N``. The parent merges rows, derives
+``train_mesh_d4_vs_d1`` (the >= 3.0 scaling acceptance bar), and —
+unless ``--out none`` — writes them into the newest
+``MULTICHIP_r0*.json`` next to the repo root (or ``--out PATH``), so
+the driver's multichip record carries the scaling story, not just
+rc=0.
+
+    python scripts/dryrun_multichip.py                  # d1, d4, d8
+    python scripts/dryrun_multichip.py --devices 1,4
+    python scripts/dryrun_multichip.py --json --out none
+
+bench.py's ``--skip_mesh``-gated mesh section drives the same rows in
+process-pooled form (bench merges them into its trajectory JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _log(msg: str) -> None:
+    print(f"dryrun_multichip: {msg}", file=sys.stderr)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", default="1,4,8",
+                   help="comma list of fake-device counts to measure")
+    p.add_argument("--steps", type=int, default=8,
+                   help="timed train steps per device count (after 2 "
+                        "warmup steps)")
+    p.add_argument("--batch_per_device", type=int, default=64,
+                   help="train rows per device per step (the global "
+                        "batch scales with the mesh — weak scaling, "
+                        "the pod recipe)")
+    p.add_argument("--serve_rows", type=int, default=64,
+                   help="rows per timed serving request")
+    p.add_argument("--json", action="store_true",
+                   help="print the merged rows as one JSON object on "
+                        "stdout")
+    p.add_argument("--out", default="auto",
+                   help="'auto' = newest MULTICHIP_r0*.json in the repo "
+                        "root (falls back to MULTICHIP_mesh.json); "
+                        "'none' = stdout/stderr only; else a path")
+    p.add_argument("--single", type=int, default=0,
+                   help="(internal) measure THIS device count in-process "
+                        "and print one JSON line")
+    return p.parse_args(argv)
+
+
+def _measure_single(n_devices: int, steps: int, batch_per_device: int,
+                    serve_rows: int) -> dict:
+    """One device count, measured in THIS process (which must be fresh:
+    fake-device counts pin at first backend init)."""
+    # Each fake CPU device computes SINGLE-threaded: a fake device that
+    # fans its convs across every host core is a dishonest simulation
+    # of "one chip per device" (real mesh devices do not share compute)
+    # and flattens the scaling curve this harness exists to measure —
+    # device-thread parallelism, not intra-op thread count, is the
+    # quantity train_mesh_d{N} rows report. Must land in XLA_FLAGS
+    # before the backend parses DebugOptions (this process is fresh by
+    # construction — the parent spawns one child per device count).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "multi_thread_eigen" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_multi_thread_eigen=false"
+        ).strip()
+
+    import jax
+
+    from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+    jax.config.update("jax_platforms", "cpu")
+    mesh_lib.configure_fake_cpu_devices(n_devices)
+    mesh_lib.enable_persistent_compilation_cache("/tmp/jama16_xla_cache")
+
+    import numpy as np
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
+
+    avail = len(jax.devices())
+    if avail < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {avail} — run via the "
+            "parent process (fresh subprocess per count)"
+        )
+    out: dict = {"n_devices": n_devices}
+    rng = np.random.default_rng(0)
+
+    # -- train: pjit step over the config mesh, LAMB recipe ------------
+    batch_rows = batch_per_device * n_devices
+    cfg = override(get_config("smoke"), [
+        "model.image_size=64",
+        f"data.batch_size={batch_rows}",
+        "train.optimizer=lamb",
+        "train.lr_schedule=warmup_cosine",
+        "train.lr_scale_ref_batch=16",
+        f"parallel.num_devices={n_devices}",
+    ])
+    cfg = train_lib.resolve_large_batch(cfg)
+    mesh = mesh_lib.make_mesh(
+        cfg.parallel.num_devices, axis=cfg.parallel.data_axis
+    )
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    batches = [
+        mesh_lib.shard_batch({
+            "image": rng.integers(
+                0, 256, (batch_rows, 64, 64, 3), np.uint8
+            ),
+            "grade": rng.integers(0, 5, (batch_rows,), np.int32),
+        }, mesh)
+        for _ in range(2)
+    ]
+    key = jax.random.key(1)
+    for i in range(2):  # warmup: compile + first dispatches
+        state, m = step(state, batches[i % 2], key)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = step(state, batches[i % 2], key)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    out[f"train_mesh_d{n_devices}_images_per_sec"] = round(
+        steps * batch_rows / dt, 1
+    )
+    out["train_mesh_loss"] = float(jax.device_get(m["loss"]))
+    assert np.isfinite(out["train_mesh_loss"])
+
+    # -- ensemble4: member-sharded stacking vs the sequential protocol --
+    # The ISSUE 14 un-gating row, finally MEASURED on a >=4-device
+    # mesh. Geometry is the POD regime: a small per-device batch (8
+    # rows), where step wall-clock is dominated by collective width
+    # and dispatch — exactly what grows with scale on real pods. The
+    # sequential baseline trains ONE member DP over all n devices
+    # (n-way allreduce every step); the stacked manual-data form
+    # (train.ensemble_manual_data — the big-mesh production form)
+    # trains 4 members whose groups allreduce over only n/4 ways.
+    # Measured on this container: ~2x at n=4, ~2.8x at n=8 — the
+    # ratio the 1-device bench gate could never express.
+    if n_devices >= 4:
+        eb = 8 * n_devices
+        seq_cfg = override(get_config("smoke"), [
+            "model.image_size=64", f"data.batch_size={eb}",
+        ])
+        seq_model = models.build(seq_cfg.model)
+        seq_state, seq_tx = train_lib.create_state(
+            seq_cfg, seq_model, jax.random.key(0)
+        )
+        seq_state = jax.device_put(seq_state, mesh_lib.replicated(mesh))
+        seq_step = train_lib.make_train_step(
+            seq_cfg, seq_model, seq_tx, mesh=mesh
+        )
+        seq_batch = mesh_lib.shard_batch({
+            "image": rng.integers(0, 256, (eb, 64, 64, 3), np.uint8),
+            "grade": rng.integers(0, 5, (eb,), np.int32),
+        }, mesh)
+        for _ in range(2):
+            seq_state, _ = seq_step(seq_state, seq_batch, key)
+        jax.block_until_ready(seq_state)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            seq_state, _ = seq_step(seq_state, seq_batch, key)
+        jax.block_until_ready(seq_state)
+        seq_rate = steps * eb / (time.perf_counter() - t0)
+
+        k = 4
+        ens_cfg = override(seq_cfg, [
+            "train.ensemble_size=4", "train.ensemble_parallel=true",
+            "train.ensemble_manual_data=true",
+        ])
+        ens_model = models.build(ens_cfg.model, axis_name="data")
+        ens_mesh = mesh_lib.make_ensemble_mesh(k, n_devices)
+        ens_state, ens_tx = train_lib.create_ensemble_state(
+            ens_cfg, ens_model, list(range(k)), mesh=ens_mesh
+        )
+        ens_step = train_lib.make_ensemble_train_step(
+            ens_cfg, ens_model, ens_tx, mesh=ens_mesh, manual_data=True
+        )
+        ens_keys = train_lib.stack_member_keys(
+            list(range(k)), mesh=ens_mesh
+        )
+        ens_batch = mesh_lib.shard_batch({
+            "image": rng.integers(0, 256, (eb, 64, 64, 3), np.uint8),
+            "grade": rng.integers(0, 5, (eb,), np.int32),
+        }, ens_mesh)
+        for _ in range(2):
+            ens_state, _ = ens_step(ens_state, ens_batch, ens_keys)
+        jax.block_until_ready(ens_state)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ens_state, _ = ens_step(ens_state, ens_batch, ens_keys)
+        jax.block_until_ready(ens_state)
+        ens_rate = steps * k * eb / (time.perf_counter() - t0)
+        # Published UNGATED (the >=4-device rule bench._gate_ensemble_
+        # speedup applies): the real ratio, whatever it measures.
+        out[f"ensemble4_member_images_per_sec_d{n_devices}"] = round(
+            ens_rate, 1
+        )
+        out[f"ensemble4_parallel_speedup_d{n_devices}"] = round(
+            ens_rate / seq_rate, 2
+        )
+
+    # -- serve: the ASSEMBLED engine over the config-derived mesh ------
+    member_axis = 2 if n_devices >= 4 else 1
+    scfg = override(get_config("smoke"), [
+        "model.image_size=64",
+        f"serve.max_batch={serve_rows}",
+        f"serve.bucket_sizes={serve_rows}",
+        f"parallel.serve_devices={n_devices}",
+        f"parallel.member_axis_size={member_axis}",
+    ])
+    smodel = models.build(scfg.model)
+    stacked = train_lib.stack_states([
+        train_lib.create_state(scfg, smodel, jax.random.key(s))[0]
+        for s in range(2)
+    ])
+    engine = assemble(EngineSpec(cfg=scfg, model=smodel, state=stacked))
+    mesh_shape = (
+        dict(engine.mesh.shape) if engine.mesh is not None else {"": 1}
+    )
+    imgs = rng.integers(0, 256, (serve_rows, 64, 64, 3), np.uint8)
+    engine.probs(imgs)  # warmup (compile per bucket)
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        engine.probs(imgs)
+    dt = time.perf_counter() - t0
+    out[f"serve_mesh_d{n_devices}_images_per_sec"] = round(
+        reps * serve_rows / dt, 1
+    )
+    out["serve_mesh_shape"] = {str(k): int(v) for k, v in mesh_shape.items()}
+    return out
+
+
+def run_counts(devices, steps: int, batch_per_device: int,
+               serve_rows: int) -> dict:
+    """Fresh subprocess per device count; merged rows + scaling ratios.
+    Importable by bench.py's mesh section (``--skip_mesh`` gates it)."""
+    rows: dict = {}
+    for n in devices:
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             f"--single={n}", f"--steps={steps}",
+             f"--batch_per_device={batch_per_device}",
+             f"--serve_rows={serve_rows}"],
+            capture_output=True, text=True, timeout=1800, env=env,
+            cwd=REPO,
+        )
+        if proc.returncode != 0:
+            _log(f"d{n} FAILED (rc={proc.returncode}):\n"
+                 f"{proc.stderr[-2000:]}")
+            rows[f"mesh_d{n}_error"] = f"rc={proc.returncode}"
+            continue
+        line = proc.stdout.strip().splitlines()[-1]
+        child = json.loads(line)
+        for k in (f"train_mesh_d{n}_images_per_sec",
+                  f"serve_mesh_d{n}_images_per_sec"):
+            rows[k] = child[k]
+        rows[f"serve_mesh_d{n}_shape"] = child["serve_mesh_shape"]
+        ens = child.get(f"ensemble4_parallel_speedup_d{n}")
+        if ens is not None:
+            rows[f"ensemble4_parallel_speedup_d{n}"] = ens
+            rows[f"ensemble4_member_images_per_sec_d{n}"] = child[
+                f"ensemble4_member_images_per_sec_d{n}"
+            ]
+            # The plain key (the 1-device bench gates it; on a >=4-
+            # device mesh it publishes ungated — the WIDEST measured
+            # mesh wins, regardless of --devices order) with NO
+            # gated/reason companion.
+            if n >= rows.get("_ensemble4_widest_n", 0):
+                rows["ensemble4_parallel_speedup"] = ens
+                rows["_ensemble4_widest_n"] = n
+        _log(f"d{n}: train {child[f'train_mesh_d{n}_images_per_sec']} "
+             f"img/s, serve {child[f'serve_mesh_d{n}_images_per_sec']} "
+             f"img/s over {child['serve_mesh_shape']} "
+             f"[{time.time() - t0:.0f}s]")
+    rows.pop("_ensemble4_widest_n", None)
+    d1 = rows.get("train_mesh_d1_images_per_sec")
+    for n in devices:
+        dn = rows.get(f"train_mesh_d{n}_images_per_sec")
+        if n != 1 and d1 and dn:
+            rows[f"train_mesh_d{n}_vs_d1"] = round(dn / d1, 2)
+    return rows
+
+
+def _resolve_out(out: str) -> "str | None":
+    if out == "none":
+        return None
+    if out != "auto":
+        return out
+    # Name order, not mtime: the round number IS the ordering (checked-
+    # out files carry arbitrary mtimes).
+    cands = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r0*.json")))
+    return cands[-1] if cands else os.path.join(REPO, "MULTICHIP_mesh.json")
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.single:
+        print(json.dumps(_measure_single(
+            args.single, args.steps, args.batch_per_device,
+            args.serve_rows,
+        )))
+        return 0
+    devices = [int(d) for d in args.devices.split(",") if d]
+    rows = run_counts(
+        devices, args.steps, args.batch_per_device, args.serve_rows
+    )
+    rows["mesh_scaling_recipe"] = {
+        "optimizer": "lamb", "lr_scale_ref_batch": 16,
+        "batch_per_device": args.batch_per_device,
+        "steps": args.steps, "image_size": 64, "arch": "tiny_cnn",
+        "serve_members": 2,
+    }
+    path = _resolve_out(args.out)
+    if path is not None:
+        from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+        merged = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    merged = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                _log(f"{path} unreadable ({e}); writing rows alone")
+                merged = {}
+        merged.update(rows)
+        artifact_lib.write_json(path, merged, indent=1)
+        _log(f"mesh-scaling rows written into {path}")
+    if args.json:
+        print(json.dumps(rows, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

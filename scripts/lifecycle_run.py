@@ -44,7 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _build_controller(cfg, args):
     from jama16_retina_tpu.lifecycle import Journal, LifecycleController
-    from jama16_retina_tpu.serve import ServingEngine
+    from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
 
     journal = Journal(os.path.join(args.workdir, "lifecycle"))
     live = journal.read_live() or list(args.ckpt or ())
@@ -53,7 +53,13 @@ def _build_controller(cfg, args):
             "need the live checkpoint set: --ckpt member_dir [...] "
             "(or a journal live pointer from a previous promote)"
         )
-    engine = ServingEngine(cfg, live)
+    # The assembly seam (ISSUE 14; serve/assemble.py): the controller's
+    # engine — and therefore every reload/rollback generation it drives
+    # — is built from the same declarative spec predict.py serves
+    # through, so parallel.serve_devices / member_axis_size mesh the
+    # lifecycle path identically (a 1-device spec is the pre-seam
+    # construction, bit for bit).
+    engine = assemble(EngineSpec(cfg=cfg, member_dirs=tuple(live)))
     return LifecycleController(
         cfg, args.workdir, engine=engine, data_dir=args.data_dir,
         live_member_dirs=live,

@@ -85,6 +85,40 @@ def parse_args(argv=None):
         help="optional fp32 metrics.jsonl to gate a --train_dtype=bf16 "
         "run against (train.dtype_curve_ref)",
     )
+    # --- Pod-scale / large-batch axes (ISSUE 14) ----------------------
+    p.add_argument(
+        "--mesh", type=int, default=0,
+        help="devices in the training mesh (parallel.num_devices; "
+        "0 = all local). The member-parallel driver factors its "
+        "('member','data') mesh over this count",
+    )
+    p.add_argument(
+        "--global_batch", type=int, default=32,
+        help="the recipe batch (data.batch_size = accum_steps × "
+        "device batch × data-axis ways); sweep it with --accum_steps "
+        "to grow the recipe batch past per-forward HBM",
+    )
+    p.add_argument(
+        "--accum_steps", type=int, default=1,
+        help="micro-batches per optimizer step (train.accum_steps)",
+    )
+    p.add_argument(
+        "--optimizer", default="adamw", choices=("adamw", "lamb"),
+        help="train.optimizer: lamb is the large-batch recipe "
+        "(trust-ratio layerwise adaptation; pair with "
+        "--lr_scale_ref_batch for linear LR scaling)",
+    )
+    p.add_argument(
+        "--lr_scale_ref_batch", type=int, default=0,
+        help="reference batch for linear LR scaling "
+        "(train.lr_scale_ref_batch; 0 = off)",
+    )
+    p.add_argument(
+        "--recipe_curve_ref", default="",
+        help="optional baseline metrics.jsonl to gate the large-batch "
+        "recipe against (train.recipe_curve_ref; the run REFUSES on "
+        "drift beyond train.recipe_curve_tol)",
+    )
     p.add_argument(
         "--save_every_evals", type=int, default=4,
         help="checkpoint every Nth eval (train.save_every_evals; the "
@@ -248,6 +282,14 @@ def main(argv=None, print_json: bool = True) -> dict:
         f"train.dtype={args.train_dtype}",
         *( [f"train.dtype_curve_ref={args.dtype_curve_ref}"]
            if args.dtype_curve_ref else [] ),
+        # Pod-scale / large-batch axes (ISSUE 14).
+        f"train.optimizer={args.optimizer}",
+        f"train.accum_steps={args.accum_steps}",
+        f"parallel.num_devices={args.mesh}",
+        *( [f"train.lr_scale_ref_batch={args.lr_scale_ref_batch}"]
+           if args.lr_scale_ref_batch else [] ),
+        *( [f"train.recipe_curve_ref={args.recipe_curve_ref}"]
+           if args.recipe_curve_ref else [] ),
         f"train.steps={args.steps}",
         f"train.eval_every={args.eval_every}",
         f"train.log_every={args.eval_every}",
@@ -255,7 +297,7 @@ def main(argv=None, print_json: bool = True) -> dict:
         f"train.ema_decay={args.ema_decay}" if not args.smoke else
         "train.ema_decay=0.0",
         "data.loader=hbm",
-        "data.batch_size=32",
+        f"data.batch_size={args.global_batch}",
         "eval.batch_size=64",
         # Patience in UNITS OF EVALS; keep the run bounded but give the
         # recipe room past the first crossing for the final protocol.
@@ -358,7 +400,8 @@ def main(argv=None, print_json: bool = True) -> dict:
         "test_report": report,
         "recipe": {
             "preset": preset, "k": args.k, "image_size": image_size,
-            "loader": "hbm", "batch_size": 32, "steps": args.steps,
+            "loader": "hbm", "batch_size": args.global_batch,
+            "steps": args.steps,
             "eval_every": args.eval_every, "train_n": args.train_n,
             "seed": args.seed, "ensemble_parallel": True,
             "save_every_evals": args.save_every_evals,
@@ -370,6 +413,13 @@ def main(argv=None, print_json: bool = True) -> dict:
             "label_smoothing": cfg.train.label_smoothing,
             "tta": cfg.eval.tta,
             "train_dtype": args.train_dtype,
+            "optimizer": args.optimizer,
+            "accum_steps": args.accum_steps,
+            "mesh": args.mesh,
+            "lr_scale_ref_batch": args.lr_scale_ref_batch,
+            # The BASE peak LR; the trainer's resolve_large_batch log
+            # carries the scaled effective value when scaling is on.
+            "base_lr": float(cfg.train.learning_rate),
         },
         "device": jax.devices()[0].device_kind,
         "workdir": workdir,

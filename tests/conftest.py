@@ -218,6 +218,19 @@ _QUICK_TESTS = {
     "test_integrity.py::test_retention_never_collects_live_or_open_cycle",
     "test_integrity.py::test_artifacts_rule_flags_bare_writes_and_passes_routed",
     "test_integrity.py::test_reliability_rules_include_artifact_corrupt",
+    # pod-scale mesh (ISSUE 14): the numpy-cheap pins — serve-mesh
+    # config derivation + refusals, LAMB optax parity, the recipe
+    # curve gate's fail-closed contract, spill-plan content
+    # invariance, and the compile-cache topology refusal; the
+    # assembled-engine bit-identity and mesh-engine lifecycle tests
+    # stay in the full tier (XLA compiles dominate there)
+    "test_podscale.py::test_make_serve_mesh_config_axis",
+    "test_podscale.py::test_ensemble_mesh_member_axis_size_override",
+    "test_podscale.py::test_lamb_three_step_optax_parity",
+    "test_podscale.py::test_resolve_large_batch_scaling_and_identity",
+    "test_podscale.py::test_recipe_curve_gate_passes_and_fails_closed",
+    "test_podscale.py::test_host_spill_plan_content_invariance",
+    "test_podscale.py::test_compile_cache_refuses_resharded_topology",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

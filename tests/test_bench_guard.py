@@ -571,6 +571,26 @@ def test_gated_ensemble_reason_lands_in_json():
     assert "ensemble4_parallel_gated_reason" not in extras
 
 
+def test_ensemble_speedup_ungated_on_wide_mesh():
+    """ISSUE 14 satellite: on a >= 4-device mesh the REAL ratio
+    publishes whatever it measures — member-sharded stacking is the
+    production path there, so a <1.0 value is a regression the
+    trajectory must show, never a gated row — and the gated/reason
+    keys never appear. 1-device behavior (the previous test) is
+    pinned unchanged."""
+    extras = {}
+    bench._gate_ensemble_speedup(extras, rate=1182.4, device_only=1397.8,
+                                 n_dev=4)
+    assert extras["ensemble4_parallel_speedup"] == 0.85
+    assert "ensemble4_parallel_gated" not in extras
+    assert "ensemble4_parallel_gated_reason" not in extras
+    extras = {}
+    bench._gate_ensemble_speedup(extras, rate=4200.0, device_only=1397.8,
+                                 n_dev=8)
+    assert extras["ensemble4_parallel_speedup"] == 3.0
+    assert "ensemble4_parallel_gated_reason" not in extras
+
+
 def test_disabled_tuner_is_one_branch():
     """ISSUE 7's overhead pin off-chip: with data.autotune off the
     loaders carry no tuner — their poll sites reduce to one

@@ -1,0 +1,523 @@
+"""Pod-scale mesh (ISSUE 14): the engine-assembly seam is pinned
+bit-identical to the legacy construction at 1 device, the mesh is a
+config axis end to end (serve meshes from ``parallel.*``, member-
+sharded serving over a ('member','data') mesh, loud divisibility
+refusals), the large-batch LAMB recipe is optax-parity-pinned with
+checkpoint-compatible state, the recipe golden-curve gate fails
+closed, the tiered loader's cross-host spill plan is content-
+invariant, lifecycle promote/rollback drives through an assembled
+mesh engine, and the compile-cache fingerprint refuses resharded
+topologies."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from jama16_retina_tpu import models, train_lib, trainer
+from jama16_retina_tpu.configs import (
+    ParallelConfig,
+    ServeConfig,
+    TrainConfig,
+    get_config,
+    override,
+)
+from jama16_retina_tpu.data import tiered_pipeline
+from jama16_retina_tpu.parallel import mesh as mesh_lib
+from jama16_retina_tpu.serve import (
+    CompileCacheStale,
+    EngineSpec,
+    ServingEngine,
+    assemble,
+    compilecache,
+)
+from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+pytestmark = pytest.mark.podscale
+
+K = 2
+N_IMGS = 12
+SIZE = 32
+
+
+@pytest.fixture(scope="module")
+def pod_setup(tmp_path_factory):
+    """Smoke-model member checkpoints (two distinct seed pairs — the
+    lifecycle test reloads from B and rolls back to A) + request rows."""
+    root = tmp_path_factory.mktemp("podscale")
+    cfg = override(get_config("smoke"), [f"model.image_size={SIZE}"])
+    cfg = cfg.replace(serve=ServeConfig(
+        max_batch=8, max_wait_ms=20.0, bucket_sizes=(4, 8),
+    ))
+    model = models.build(cfg.model)
+
+    def save_members(tag, seed0):
+        dirs = []
+        for m in range(K):
+            state, _ = train_lib.create_state(
+                cfg, model, jax.random.key(seed0 + m)
+            )
+            d = str(root / f"{tag}_member_{m:02d}")
+            ck = ckpt_lib.Checkpointer(d)
+            ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+            ck.wait()
+            ck.close()
+            dirs.append(d)
+        return dirs
+
+    dirs_a = save_members("a", 0)
+    dirs_b = save_members("b", 100)
+    imgs = np.random.default_rng(0).integers(
+        0, 256, (N_IMGS, SIZE, SIZE, 3), np.uint8
+    )
+    return cfg, model, dirs_a, dirs_b, imgs
+
+
+# ---------------------------------------------------------------------------
+# The mesh as a config axis
+# ---------------------------------------------------------------------------
+
+
+def test_make_serve_mesh_config_axis():
+    """parallel.serve_devices/member_axis_size describe the serving
+    mesh: 0/1 = the mesh-less legacy construction (None), >1 data-only,
+    member_axis_size>1 the ('member','data') pod form — with every
+    divisibility violation refused at construction, knob named."""
+    assert mesh_lib.make_serve_mesh(ParallelConfig()) is None
+    assert mesh_lib.make_serve_mesh(
+        ParallelConfig(serve_devices=1)
+    ) is None
+    m = mesh_lib.make_serve_mesh(ParallelConfig(serve_devices=4))
+    assert m.axis_names == ("data",) and m.devices.size == 4
+    m22 = mesh_lib.make_serve_mesh(
+        ParallelConfig(serve_devices=4, member_axis_size=2), n_members=2
+    )
+    assert m22.axis_names == ("member", "data")
+    assert dict(m22.shape) == {"member": 2, "data": 2}
+    # member axis must divide the member count...
+    with pytest.raises(ValueError, match="member_axis_size"):
+        mesh_lib.make_serve_mesh(
+            ParallelConfig(serve_devices=8, member_axis_size=4),
+            n_members=2,
+        )
+    # ...and the device count.
+    with pytest.raises(ValueError, match="member_axis_size"):
+        mesh_lib.make_ensemble_mesh(6, 8, member_axis_size=3)
+
+
+def test_ensemble_mesh_member_axis_size_override():
+    """Explicit member_axis_size beats the gcd auto-factoring (k=4 on 8
+    devices auto-factors to member 4; the config can pin member 2)."""
+    auto = mesh_lib.make_ensemble_mesh(4, 8)
+    assert dict(auto.shape) == {"member": 4, "data": 2}
+    pinned = mesh_lib.make_ensemble_mesh(4, 8, member_axis_size=2)
+    assert dict(pinned.shape) == {"member": 2, "data": 4}
+
+
+def test_mesh_fingerprint_shapes():
+    fp = mesh_lib.mesh_fingerprint(None)
+    assert fp == {"shape": [1], "axis_names": [],
+                  "process_count": jax.process_count()}
+    m = mesh_lib.make_ensemble_mesh(2, 4, member_axis_size=2)
+    fp = mesh_lib.mesh_fingerprint(m)
+    assert fp["shape"] == [2, 2]
+    assert fp["axis_names"] == ["member", "data"]
+
+
+# ---------------------------------------------------------------------------
+# The assembly seam: 1-device bit-identity, member-sharded mesh serving
+# ---------------------------------------------------------------------------
+
+
+def test_assembled_default_spec_bit_identical_to_legacy(pod_setup):
+    """THE seam acceptance pin: a default (1-device) EngineSpec
+    constructs through byte-for-byte the legacy path — member probs,
+    averaged probs, and the predict.py-shaped JSONL rows built from
+    them are all bit-identical."""
+    cfg, model, dirs, _, imgs = pod_setup
+    legacy = ServingEngine(cfg, dirs, model=model)
+    assembled = assemble(EngineSpec(
+        cfg=cfg, member_dirs=tuple(dirs), model=model,
+    ))
+    assert type(assembled) is ServingEngine and assembled.mesh is None
+    np.testing.assert_array_equal(
+        assembled.member_probs(imgs), legacy.member_probs(imgs)
+    )
+    pa, pb = legacy.probs(imgs), assembled.probs(imgs)
+    np.testing.assert_array_equal(pa, pb)
+    rows_a = [json.dumps({"prob": round(float(p), 6), "n_models": K})
+              for p in pa]
+    rows_b = [json.dumps({"prob": round(float(p), 6), "n_models": K})
+              for p in pb]
+    assert rows_a == rows_b  # byte-identical JSONL
+
+
+def test_member_sharded_assembly_over_config_mesh(pod_setup):
+    """parallel.serve_devices=8 + member_axis_size=2 assembles a
+    ('member': 2, 'data': 4) engine whose scores are float-equivalent
+    to the mesh-less engine (the vmapped pod form's documented
+    contract; the smoke model's bf16 compute dtype bounds the drift at
+    ~4e-4), with every bucket dividing the data axis."""
+    cfg, model, dirs, _, imgs = pod_setup
+    ref = ServingEngine(cfg, dirs, model=model).member_probs(imgs)
+    pod_cfg = cfg.replace(parallel=ParallelConfig(
+        serve_devices=8, member_axis_size=2,
+    ))
+    engine = assemble(EngineSpec(
+        cfg=pod_cfg, member_dirs=tuple(dirs), model=model,
+    ))
+    assert dict(engine.mesh.shape) == {"member": 2, "data": 4}
+    assert all(b % 4 == 0 for b in engine.buckets)
+    got = engine.member_probs(imgs)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=0, atol=2e-3)
+
+
+def test_member_axis_must_divide_stacked_members(pod_setup):
+    """An explicit mesh whose member axis does not divide the stacked
+    member count refuses at generation build with the knob named —
+    never an opaque XLA uneven-sharding error."""
+    cfg, model, dirs, _, _ = pod_setup
+    mesh = mesh_lib.make_ensemble_mesh(4, 8, member_axis_size=4)
+    with pytest.raises(ValueError, match="member_axis_size"):
+        ServingEngine(cfg, dirs, model=model, mesh=mesh)
+
+
+def test_lifecycle_promote_rollback_through_assembled_mesh_engine(
+    pod_setup,
+):
+    """The lifecycle surfaces (reload -> new generation; rollback ->
+    retained generation re-swapped) drive through an ASSEMBLED
+    member-sharded mesh engine: the rolled-back outputs are bit-equal
+    to generation 0's."""
+    cfg, model, dirs_a, dirs_b, imgs = pod_setup
+    pod_cfg = cfg.replace(parallel=ParallelConfig(
+        serve_devices=8, member_axis_size=2,
+    ))
+    engine = assemble(EngineSpec(
+        cfg=pod_cfg, member_dirs=tuple(dirs_a), model=model,
+    ))
+    out_a, gen0 = engine.probs_with_generation(imgs)
+    assert gen0 == 0
+    info = engine.reload(dirs_b)
+    assert info["generation"] == 1
+    out_b, gen1 = engine.probs_with_generation(imgs)
+    assert gen1 == 1
+    assert not np.array_equal(out_a, out_b)  # different weights served
+    rb = engine.rollback()
+    assert rb["restored_from"] == 0 and rb["generation"] == 2
+    out_rb, gen2 = engine.probs_with_generation(imgs)
+    assert gen2 == 2
+    np.testing.assert_array_equal(out_rb, out_a)
+
+
+# ---------------------------------------------------------------------------
+# LAMB large-batch recipe
+# ---------------------------------------------------------------------------
+
+
+def _toy_params():
+    return {
+        "dense": {"kernel": np.linspace(-1, 1, 12, dtype=np.float32)
+                  .reshape(4, 3),
+                  "bias": np.zeros((3,), np.float32)},
+        "bn": {"scale": np.ones((3,), np.float32)},
+    }
+
+
+def test_lamb_three_step_optax_parity():
+    """make_optimizer('lamb') is LAMB exactly: 3 update steps match a
+    hand-composed scale_by_adam -> masked decoupled weight decay
+    (rank>=2 kernels only, the repo's _decay_mask) -> trust ratio ->
+    LR chain, leaf for leaf."""
+    tc = TrainConfig(optimizer="lamb", lr_schedule="constant",
+                     learning_rate=1e-2, weight_decay=1e-3)
+    tx = train_lib.make_optimizer(tc)
+    ref = optax.chain(
+        optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-6, eps_root=0.0),
+        optax.add_decayed_weights(
+            weight_decay=tc.weight_decay, mask=train_lib._decay_mask
+        ),
+        optax.scale_by_trust_ratio(),
+        optax.scale_by_learning_rate(
+            train_lib.make_schedule(tc)
+        ),
+    )
+    params_a = jax.tree.map(np.copy, _toy_params())
+    params_b = jax.tree.map(np.copy, _toy_params())
+    st_a, st_b = tx.init(params_a), ref.init(params_b)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        grads = jax.tree.map(
+            lambda p: rng.normal(size=p.shape).astype(np.float32),
+            params_a,
+        )
+        up_a, st_a = tx.update(grads, st_a, params_a)
+        params_a = optax.apply_updates(params_a, up_a)
+        up_b, st_b = ref.update(grads, st_b, params_b)
+        params_b = optax.apply_updates(params_b, up_b)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=0
+        ),
+        params_a, params_b,
+    )
+
+
+def test_lamb_checkpoint_state_structure_roundtrip(tmp_path):
+    """LAMB optimizer state is optax-structure-compatible in
+    checkpoints: a TrainState carrying it saves and restores through
+    the standard Checkpointer with identical tree structure and leaf
+    values — resume cannot tell which optimizer family wrote it."""
+    cfg = override(get_config("smoke"), [
+        "model.image_size=32", "train.optimizer=lamb",
+    ])
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    batch = {
+        "image": np.zeros((8, 32, 32, 3), np.uint8),
+        "grade": np.zeros((8,), np.int32),
+    }
+    step = train_lib.make_train_step(cfg, model, tx, donate=False)
+    state, _ = step(state, batch, jax.random.key(1))
+    host = jax.device_get(state)
+    ck = ckpt_lib.Checkpointer(str(tmp_path / "lamb_ck"))
+    ck.save(1, host, {"val_auc": 0.5})
+    ck.wait()
+    restored = ck.restore(ckpt_lib.abstract_like(host), 1)
+    ck.close()
+    assert (jax.tree_util.tree_structure(restored.opt_state)
+            == jax.tree_util.tree_structure(host.opt_state))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        restored.opt_state, host.opt_state,
+    )
+
+
+def test_resolve_large_batch_scaling_and_identity():
+    """Linear LR scaling: ref=0 is the identity (every existing pin's
+    config is byte-identical); ref>0 scales the peak LR by
+    global_batch/ref, deterministically."""
+    base = override(get_config("smoke"), ["data.batch_size=64"])
+    assert train_lib.resolve_large_batch(base) is base
+    scaled = override(base, ["train.lr_scale_ref_batch=16"])
+    out = train_lib.resolve_large_batch(scaled)
+    assert out.train.learning_rate == pytest.approx(
+        scaled.train.learning_rate * 4.0
+    )
+    out2 = train_lib.resolve_large_batch(scaled)
+    assert out2.train.learning_rate == out.train.learning_rate
+
+
+def test_recipe_curve_gate_passes_and_fails_closed(tmp_path):
+    """The recipe arm of the golden-curve gate: within tolerance it is
+    silent; beyond it raises typed RecipeCurveRejected naming the step
+    and both AUCs — a LAMB run accepted on time-to-AUC must still
+    reach the AUC."""
+    ref_path = str(tmp_path / "baseline.jsonl")
+    with open(ref_path, "w") as f:
+        f.write(json.dumps(
+            {"kind": "eval", "step": 10, "val_auc": 0.9, "t": 0.0}
+        ) + "\n")
+    cfg = override(get_config("smoke"), [
+        "train.optimizer=lamb",
+        f"train.recipe_curve_ref={ref_path}",
+        "train.recipe_curve_tol=0.05",
+    ])
+    gate = trainer._DtypeCurveGate(cfg)
+    gate.check(10, 0.92)   # inside tol
+    gate.check(99, 0.0)    # step not pinned -> no opinion
+    with pytest.raises(train_lib.RecipeCurveRejected, match="step 10"):
+        gate.check(10, 0.5)
+
+
+def test_recipe_gate_arms_alongside_dtype_gate(tmp_path):
+    """A bf16 LAMB run gates against BOTH pinned curves — the dtype
+    arm still raises DtypeCurveRejected, the recipe arm
+    RecipeCurveRejected, each against its own reference."""
+    dtype_ref = str(tmp_path / "fp32.jsonl")
+    recipe_ref = str(tmp_path / "recipe.jsonl")
+    with open(dtype_ref, "w") as f:
+        f.write(json.dumps(
+            {"kind": "eval", "step": 5, "val_auc": 0.8, "t": 0.0}
+        ) + "\n")
+    with open(recipe_ref, "w") as f:
+        f.write(json.dumps(
+            {"kind": "eval", "step": 7, "val_auc": 0.8, "t": 0.0}
+        ) + "\n")
+    cfg = override(get_config("smoke"), [
+        "train.dtype=bf16", f"train.dtype_curve_ref={dtype_ref}",
+        "train.optimizer=lamb", f"train.recipe_curve_ref={recipe_ref}",
+    ])
+    gate = trainer._DtypeCurveGate(cfg)
+    with pytest.raises(train_lib.DtypeCurveRejected):
+        gate.check(5, 0.1)
+    with pytest.raises(train_lib.RecipeCurveRejected):
+        gate.check(7, 0.1)
+
+
+def test_fit_tf_refuses_large_batch_recipe():
+    cfg = override(get_config("smoke"), ["train.optimizer=lamb"])
+    with pytest.raises(ValueError, match="flax-path"):
+        trainer.fit_tf(cfg, "/nonexistent", "/nonexistent")
+
+
+# ---------------------------------------------------------------------------
+# Cross-host sharded spill plan
+# ---------------------------------------------------------------------------
+
+
+def test_host_spill_plan_content_invariance():
+    """The per-host union IS the single-host resident set: disjoint,
+    in order, device-block aligned, for every (rows, axis, hosts)
+    geometry — the spill plan's acceptance contract."""
+    for n_res, d, P in [(28, 4, 2), (64, 8, 4), (5, 4, 2), (16, 2, 2),
+                        (12, 4, 4), (7, 8, 8)]:
+        n_padded = n_res + ((-n_res) % d)
+        if n_padded % P:
+            continue
+        blocks = tiered_pipeline.host_spill_plan(n_padded, P)
+        assert blocks[0][0] == 0 and blocks[-1][1] == n_padded
+        for (lo_a, hi_a), (lo_b, _) in zip(blocks, blocks[1:]):
+            assert hi_a == lo_b  # contiguous, disjoint
+        union = np.concatenate([
+            tiered_pipeline.host_spill_ids(n_res, n_padded, p, P)
+            for p in range(P)
+        ])
+        single = np.arange(n_padded) % n_res
+        np.testing.assert_array_equal(union, single)
+    with pytest.raises(ValueError, match="do not split"):
+        tiered_pipeline.host_spill_plan(10, 4)
+    with pytest.raises(ValueError, match="process_count"):
+        tiered_pipeline.host_spill_plan(8, 0)
+
+
+def test_host_spill_decode_union_matches_single_host(tmp_path):
+    """Decode-level invariance: the rows the per-host blocks decode
+    union to exactly what the single-host path decodes (wraparound
+    padding included) — the plan changes who stages, never what."""
+    from jama16_retina_tpu.data import tfrecord
+    from jama16_retina_tpu.data.grain_pipeline import (
+        ParallelDecoder,
+        TFRecordIndex,
+    )
+
+    data_dir = str(tmp_path)
+    tfrecord.write_synthetic_split(data_dir, "train", 14, SIZE, 1, seed=3)
+    index = TFRecordIndex(tfrecord.list_split(data_dir, "train"))
+    decoder = ParallelDecoder(index, SIZE, workers=1, quarantine=True)
+    try:
+        n_res, d, P = 14, 4, 2
+        n_padded = n_res + ((-n_res) % d)  # 16
+        single_imgs, single_grades = decoder.decode_range(0, n_res)
+        pad_idx = np.arange(n_padded) % n_res
+        want_imgs = single_imgs[pad_idx]
+        want_grades = single_grades[pad_idx]
+        parts = [
+            decoder.decode_batch(
+                tiered_pipeline.host_spill_ids(n_res, n_padded, p, P)
+            )
+            for p in range(P)
+        ]
+        got_imgs = np.concatenate([h["image"] for h in parts])
+        got_grades = np.concatenate([h["grade"] for h in parts])
+        np.testing.assert_array_equal(got_imgs, want_imgs)
+        np.testing.assert_array_equal(got_grades, want_grades)
+    finally:
+        decoder.close()
+
+
+def test_stage_resident_refuses_member_meshes_multiprocess():
+    """The spill plan is a DATA-only layout: a >1-way member axis
+    replicates rows across member groups, so no disjoint per-host row
+    block exists — stage_resident must refuse the multi-process
+    member-mesh combination loudly (full-local placement is that
+    road), never mis-assemble the resident tier."""
+    mesh = mesh_lib.make_ensemble_mesh(2, 4, member_axis_size=2)
+    with pytest.raises(ValueError, match="data-only mesh"):
+        tiered_pipeline.stage_resident(
+            None, 8, mesh, process_index=0, process_count=2
+        )
+
+
+def test_tiered_partial_residency_multiprocess_refusal_message():
+    """The multi-process refusal moved from 'tiered at all' to 'tiered
+    at PARTIAL residency' — the message must say so (full residency
+    proceeds through the sharded spill plan)."""
+    import inspect
+
+    src = inspect.getsource(tiered_pipeline.train_batches)
+    assert "PARTIAL residency" in src
+    assert "stage_resident" in src
+
+
+# ---------------------------------------------------------------------------
+# Compile-cache topology fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_fingerprint_carries_mesh_topology(pod_setup):
+    cfg, _, _, _, _ = pod_setup
+    fp_flat = compilecache.model_fingerprint(cfg, mesh=None)
+    assert fp_flat["mesh_axes"] == "none"
+    assert fp_flat["process_count"] == jax.process_count()
+    mesh = mesh_lib.make_ensemble_mesh(2, 4, member_axis_size=2)
+    fp_mesh = compilecache.model_fingerprint(cfg, mesh=mesh)
+    assert fp_mesh["mesh_axes"] == "memberxdata"
+    assert fp_mesh["n_devices"] == 4
+
+
+def test_compile_cache_refuses_resharded_topology(pod_setup, tmp_path):
+    """A cache directory written under one mesh topology refuses an
+    engine on another (same device count, different axis factoring or
+    process split) with CompileCacheStale naming the differing fields
+    — never a deserialized program partitioned for another layout."""
+    cfg, _, _, _, _ = pod_setup
+    path = str(tmp_path / "cc")
+    fp_a = compilecache.model_fingerprint(cfg, n_devices=4)
+    compilecache.CompileCache(path, fp_a)
+    fp_b = dict(fp_a, mesh_axes="memberxdata")
+    with pytest.raises(CompileCacheStale, match="mesh_axes"):
+        compilecache.CompileCache(path, fp_b)
+    fp_c = dict(fp_a, process_count=fp_a["process_count"] + 1)
+    with pytest.raises(CompileCacheStale, match="process_count"):
+        compilecache.CompileCache(path, fp_c)
+
+
+# ---------------------------------------------------------------------------
+# pjit+LAMB end to end on the config mesh
+# ---------------------------------------------------------------------------
+
+
+def test_lamb_pjit_step_trains_on_config_mesh():
+    """Two pjit+LAMB steps over the parallel.num_devices mesh with
+    scaled LR: finite losses, step counter advances — the mesh-smoke
+    contract as a tier-1 pin."""
+    cfg = override(get_config("smoke"), [
+        "model.image_size=32", "data.batch_size=16",
+        "train.optimizer=lamb", "train.lr_schedule=warmup_cosine",
+        "train.lr_scale_ref_batch=8", "parallel.num_devices=4",
+    ])
+    cfg = train_lib.resolve_large_batch(cfg)
+    mesh = mesh_lib.make_mesh(
+        cfg.parallel.num_devices, axis=cfg.parallel.data_axis
+    )
+    model = models.build(cfg.model)
+    state, tx = train_lib.create_state(cfg, model, jax.random.key(0))
+    state = jax.device_put(state, mesh_lib.replicated(mesh))
+    step = train_lib.make_train_step(cfg, model, tx, mesh=mesh)
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        batch = mesh_lib.shard_batch({
+            "image": rng.integers(0, 256, (16, 32, 32, 3), np.uint8),
+            "grade": rng.integers(0, 5, (16,), np.int32),
+        }, mesh)
+        state, m = step(state, batch, jax.random.key(1))
+        assert np.isfinite(float(m["loss"]))
+    assert int(jax.device_get(state.step)) == 2
