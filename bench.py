@@ -580,6 +580,21 @@ def _integrity_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _fleet_overhead_guard(extras: dict, rate_on: float,
+                          rate_off: float,
+                          max_overhead: float = 0.02) -> bool:
+    """ISSUE 15's pin, same shared math: device_only with the fleet
+    plane's hot-path residue — the DISABLED segment bus is one
+    ``is not None`` branch per flush check (the production default:
+    obs.fleet_dir empty), plus a real sealed segment publish every 25
+    steps (serialize + sha256 + atomic rename + prune), a far denser
+    publish cadence than any real obs.flush_every_s. The contract that
+    lets every process of a deployment join the fleet dir without
+    taxing its own hot loop."""
+    return _overhead_guard(extras, "fleet", rate_on, rate_off,
+                           max_overhead)
+
+
 def _router_bench(extras: dict) -> None:
     """Router scaling rows (ISSUE 12): the dispatch pipeline measured
     OFF-DEVICE over stub replicas with a fixed simulated per-row
@@ -1761,6 +1776,59 @@ def main() -> None:
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"integrity overhead bench failed: "
                  f"{type(e).__name__}: {e}")
+
+    # Fleet overhead pin (ISSUE 15): the segment bus's whole hot-path
+    # residue — the disabled-bus branch per step (obs.fleet_dir empty,
+    # the production default, is one `is not None` check per flush)
+    # plus a REAL sealed segment publish every 25 steps (see
+    # _fleet_overhead_guard). Same ≤2% budget, shared guard math.
+    if not headline_serialized:
+        try:
+            import shutil as _shutil
+            import tempfile as _tempfile
+
+            from jama16_retina_tpu.obs import fleet as fleet_lib
+            from jama16_retina_tpu.obs.registry import Registry
+
+            f_dir = _tempfile.mkdtemp(prefix="bench_fleet_")
+            f_reg = Registry()
+            f_reg.counter(
+                "bench.steps",
+                help="train steps executed by bench.py's instrumented "
+                     "overhead-pin workload",
+            ).inc()
+            f_bus = fleet_lib.FleetBus(f_dir, "bench", registry=f_reg,
+                                       keep_segments=4)
+            f_state = {"n": 0, "disabled_bus": None}
+
+            def fleet_step(s, batch, k):
+                out = step(s, batch, k)
+                # The production default: no bus — one branch.
+                if f_state["disabled_bus"] is not None:
+                    raise RuntimeError("unreachable: fleet bus off")
+                f_state["n"] += 1
+                if f_state["n"] >= 25:
+                    f_state["n"] = 0
+                    f_bus.publish(f_reg.snapshot(),
+                                  heartbeat={"step": f_state["n"]})
+                return out
+
+            rate_f, state = _timed_steps(
+                fleet_step, state,
+                lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            _shutil.rmtree(f_dir, ignore_errors=True)
+            rate_f = _publish(
+                extras, "device_only_fleet", rate_f,
+                flops_per_image, peak,
+                suffix=" (device_only + disabled-bus branch + sealed "
+                       "segment publish every 25 steps)",
+            )
+            if rate_f is not None:
+                _fleet_overhead_guard(extras, rate_f, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"fleet overhead bench failed: {type(e).__name__}: {e}")
 
     # Autotune overhead pin (ISSUE 7): the same device_only window with
     # the steady-state costs a tuned run pays per step — one live knob

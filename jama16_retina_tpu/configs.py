@@ -784,6 +784,40 @@ class ObsConfig:
     # runs. <= 0 disables the cap. integrity/retention.py applies the
     # same cap offline.
     blackbox_keep: int = 20
+    # --- Fleet observability plane (ISSUE 15; obs/fleet.py) -----------
+    # Shared directory the process publishes sealed telemetry segments
+    # into (one <role>-p<pid>/ stream per process: snapshot + heartbeat
+    # + trace rings). Point every process of a deployment — trainers,
+    # predict servers, the lifecycle --watch supervisor — at ONE fleet
+    # dir; `obs_report --fleet` then answers fleet-level questions
+    # (merged counters/histograms, per-process gauges, who wedged) no
+    # single process can. Empty (default) = off: the Snapshotter pays
+    # exactly one branch per flush (bench fleet_overhead_pct pin).
+    fleet_dir: str = ""
+    # Role tag of this process's segment stream (trainer / server /
+    # router / lifecycle ...). Empty = the wiring site's default
+    # (train loops publish "trainer", serving sessions "server",
+    # predict --replicas "router", lifecycle --watch "lifecycle").
+    fleet_role: str = ""
+    # Newest segments each process keeps in its stream (pruned at
+    # publish time; integrity/retention.py additionally enforces
+    # integrity.telemetry_max_bytes per stream offline). The stream's
+    # depth bounds how much history fleet burn-rate windows can see.
+    fleet_keep_segments: int = 64
+    # Fleet-scope alert rules the AGGREGATOR evaluates over MERGED
+    # snapshots (obs/alerts.parse_fleet_rule grammar): the plain rule
+    # grammar over fleet sums/merges, plus the multi-window burn-rate
+    # form `burn(bad_counter/total_counter, LONG, SHORT) OP threshold
+    # [-> reason]` — rules a single process can never fire. Evaluated
+    # by `obs_report --fleet/--check-fleet`, never by the in-process
+    # AlertManager.
+    fleet_rules: tuple[str, ...] = ()
+    # Opt-in stdlib HTTP telemetry endpoint (obs/httpd.py): /metrics
+    # serves live Prometheus text, /healthz heartbeat freshness (same
+    # 0/1/2 semantics as --check-heartbeats; HTTP 200/503). 0 =
+    # disabled (default) — tests bind ephemeral ports through
+    # Snapshotter.serve_http(0) directly.
+    http_port: int = 0
     # Model/data-quality monitoring (ISSUE 5): online drift detection
     # against a reference profile, golden-set canary, and SLO/alert
     # rules. Nested because it is a subsystem, not a knob — override

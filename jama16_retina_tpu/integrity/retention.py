@@ -17,6 +17,10 @@ it:
   * TELEMETRY — a metrics JSONL above ``integrity.telemetry_max_bytes``
     rotates to ``<name>.1`` (older rotations and ``.prev`` files
     deleted). Offline only — never run against a live run's log.
+  * FLEET — each fleet segment stream (``<role>-p<pid>/seg-*.json``,
+    obs/fleet.py; ISSUE 15) is bounded to the same
+    ``integrity.telemetry_max_bytes``, oldest segments first, newest
+    (heartbeat-bearing) segment always kept.
   * CHECKPOINTS — retired lifecycle candidate roots
     (``lifecycle/candidate-NNNN``) and canary-pre backups of CLOSED
     cycles beyond the newest ``integrity.keep_candidate_cycles``.
@@ -56,7 +60,7 @@ class Action:
 
     kind: str
     path: str
-    cls: str           # blackbox | compile_cache | telemetry | checkpoint
+    cls: str    # blackbox | compile_cache | telemetry | fleet | checkpoint
     bytes: int
     reason: str
 
@@ -190,6 +194,50 @@ def plan_retention(workdir: str, cfg) -> RetentionPlan:
                          "rotated to .1 (offline runs only — resume "
                          "best-tracking replays the fresh file)")
 
+    # 3b) Fleet segment streams (ISSUE 15): each <role>-p<pid>/ stream
+    #     under a fleet dir is bounded to telemetry_max_bytes — oldest
+    #     segments deleted first (the bus's keep_segments prune is the
+    #     online half; this is the offline byte-cap half, so a
+    #     long-lived fleet dir with many short-lived pids stays
+    #     bounded). The NEWEST segment always survives (it carries the
+    #     process's heartbeat — collecting it would blind
+    #     --check-heartbeats to a live process).
+    if tcap > 0:
+        from jama16_retina_tpu.obs import fleet as fleet_lib
+        for base, dirs, files in os.walk(workdir):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("quarantine", "blackbox")
+            )
+            if not fleet_lib._PROC_DIR_RE.match(os.path.basename(base)):
+                continue
+            segs = sorted(
+                n for n in files if fleet_lib._SEG_RE.match(n)
+            )
+            if not segs:
+                continue
+            # A live FleetBus prunes its own stream concurrently
+            # (obs.fleet_keep_segments); a segment listed by os.walk
+            # may be gone by stat time — already collected, skip it.
+            sizes = {}
+            for n in segs:
+                try:
+                    sizes[n] = os.path.getsize(os.path.join(base, n))
+                except OSError:
+                    pass
+            segs = [n for n in segs if n in sizes]
+            if not segs:
+                continue
+            total = sum(sizes.values())
+            for n in segs[:-1]:  # newest always survives
+                if total <= tcap:
+                    break
+                plan("delete", os.path.join(base, n), "fleet",
+                     f"segment stream over "
+                     f"integrity.telemetry_max_bytes={tcap}; oldest "
+                     "segments deleted first (heartbeat-bearing newest "
+                     "kept)")
+                total -= sizes[n]
+
     # 4) Retired lifecycle candidate sets + canary backups. An
     #    unreadable journal freezes this class: collecting candidates
     #    blind could eat a half-done rollout's work.
@@ -272,7 +320,7 @@ def apply_plan(plan: RetentionPlan, registry=None) -> dict:
         reg.counter(
             f"integrity.gc.deleted.{a.cls}",
             help="retention-GC removals per artifact class "
-                 "(blackbox/compile_cache/telemetry/checkpoint)",
+                 "(blackbox/compile_cache/telemetry/fleet/checkpoint)",
         ).inc()
         c_deleted.inc()
         c_bytes.inc(a.bytes)

@@ -200,7 +200,8 @@ class LifecycleController:
         self._g_state = reg.gauge(
             "serve.lifecycle.state",
             help="lifecycle controller state: "
-                 + " ".join(f"{i}={n}" for n, i in STATE_IDS.items()),
+                 + " ".join(f"{i}={n}" for n, i in STATE_IDS.items())
+                 + " [fleet:max]",
         )
         self._c_transitions = reg.counter(
             "lifecycle.transitions",
@@ -308,9 +309,19 @@ class LifecycleController:
             )
             return False
         live = self.live_member_dirs()
+        # Distributed-trace seam (ISSUE 15): the trigger mints the
+        # cycle's serializable trace context into the journal entry, so
+        # every later step — possibly executed by a DIFFERENT process
+        # (--watch supervisor, one-shot --step) — stamps its events
+        # with the same trace_id and the stitched fleet trace shows one
+        # cycle across pid lanes. A caller-supplied wire dict (the
+        # lifecycle_run --trigger CLI) wins over minting.
+        trace_wire = detail.pop("trace", None)
+        if trace_wire is None:
+            trace_wire = obs_trace.new_context().wire()
         self._arrive(
             "DRIFT_DETECTED", cycle=self.journal.cycle + 1,
-            reason=reason, live_member_dirs=live,
+            reason=reason, live_member_dirs=live, trace=trace_wire,
             **{k: v for k, v in detail.items() if v is not None},
         )
         return True
@@ -326,27 +337,47 @@ class LifecycleController:
         state = self.state
         if state == "IDLE" or state in TERMINAL_STATES:
             return None
+        # The cycle's trace context (ISSUE 15), recovered from the
+        # DRIFT_DETECTED entry — minted by whichever process triggered
+        # (on_fire seam, lifecycle_run --trigger). The step's work is
+        # wrapped in a `lifecycle.<state>` complete event carrying its
+        # trace_id and runs under the ambient context, so a RETRAIN's
+        # trainer spans (and anything below them) belong to the cycle.
+        ctx = self._cycle_context()
+        tracer = obs_trace.default_tracer()
+        args = ({"trace_id": ctx.trace_id, "state": state}
+                if ctx is not None else {"state": state})
         try:
-            if state == "DRIFT_DETECTED":
-                return self._step_retrain()
-            if state == "RETRAIN":
-                return self._step_gate()
-            if state == "GATE":
-                gate = self.journal.find("GATE")
-                if gate and not gate["passed"]:
-                    return self._step_rollback("gate_rejected")
-                return self._step_rollout()
-            if state == "STAGED_ROLLOUT":
-                return self._step_watch()
-            if state == "WATCH":
-                watch = self.journal.find("WATCH")
-                if watch and not watch["healthy"]:
-                    return self._step_rollback("watch_regression")
-                return self._step_commit()
+            with obs_trace.use_context(ctx), \
+                    tracer.trace(f"lifecycle.{state.lower()}", args=args):
+                if state == "DRIFT_DETECTED":
+                    return self._step_retrain()
+                if state == "RETRAIN":
+                    return self._step_gate()
+                if state == "GATE":
+                    gate = self.journal.find("GATE")
+                    if gate and not gate["passed"]:
+                        return self._step_rollback("gate_rejected")
+                    return self._step_rollout()
+                if state == "STAGED_ROLLOUT":
+                    return self._step_watch()
+                if state == "WATCH":
+                    watch = self.journal.find("WATCH")
+                    if watch and not watch["healthy"]:
+                        return self._step_rollback("watch_regression")
+                    return self._step_commit()
         except Exception:
             self._c_step_errors.inc()
             raise
         raise AssertionError(f"unreachable lifecycle state {state!r}")
+
+    def _cycle_context(self):
+        """The open cycle's TraceContext from its DRIFT_DETECTED entry
+        (None for legacy journals written before contexts existed)."""
+        trigger = self.journal.find("DRIFT_DETECTED")
+        if not trigger:
+            return None
+        return obs_trace.TraceContext.from_wire(trigger.get("trace"))
 
     def run(self, max_steps: int = 16) -> str:
         """Drive to a terminal state (the ``--watch`` supervisor's

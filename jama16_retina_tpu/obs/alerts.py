@@ -78,6 +78,81 @@ class AlertRule:
         return txt
 
 
+_BURN_RE = re.compile(
+    r"^\s*burn\(\s*(?P<bad>[A-Za-z0-9_.]+)\s*/\s*(?P<total>[A-Za-z0-9_.]+)"
+    r"\s*,\s*(?P<long>[0-9]*\.?[0-9]+)\s*s?\s*,"
+    r"\s*(?P<short>[0-9]*\.?[0-9]+)\s*s?\s*\)\s*"
+    r"(?P<op>>=|<=|==|!=|>|<)\s*"
+    r"(?P<threshold>[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)\s*"
+    r"(?:->\s*(?P<reason>[A-Za-z0-9_]+))?\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnRule:
+    """Multi-window SLO burn-rate rule (ISSUE 15):
+
+        burn(bad_counter/total_counter, LONG, SHORT) OP threshold
+            [-> reason]
+
+    e.g. ``burn(serve.shed.deadline/serve.router.rows, 300, 60) > 0.02
+    -> slo_burn``. The bad/total counter-delta RATIO must satisfy the
+    condition over BOTH trailing windows — the long one proves the
+    error budget is burning sustainedly (not a blip), the short one
+    proves it is still burning NOW (not a resolved incident paging an
+    hour late): the SRE multi-window multi-burn-rate discipline.
+    Evaluated by the FLEET aggregator only (obs/fleet.evaluate_burn)
+    over merged counter deltas — no single process holds the fleet
+    totals, which is the point."""
+
+    bad: str
+    total: str
+    long_s: float
+    short_s: float
+    op: str
+    threshold: float
+    reason: str = "slo_burn"
+
+    @property
+    def name(self) -> str:
+        return (f"burn({self.bad}/{self.total},{self.long_s:g},"
+                f"{self.short_s:g}){self.op}{self.threshold:g}")
+
+
+def parse_fleet_rule(text: str) -> "AlertRule | BurnRule":
+    """One fleet-scope rule: the ``burn()`` multi-window form, or any
+    rule of the plain grammar (evaluated over MERGED snapshots, where
+    a summed gauge/counter can cross thresholds no single process
+    reaches). Raises on anything it cannot parse completely."""
+    m = _BURN_RE.match(text)
+    if m:
+        long_s = float(m.group("long"))
+        short_s = float(m.group("short"))
+        if short_s >= long_s:
+            raise ValueError(
+                f"burn rule {text!r}: the short window ({short_s:g}s) "
+                f"must be shorter than the long window ({long_s:g}s) — "
+                "equal windows degenerate to a single-window rule"
+            )
+        return BurnRule(
+            bad=m.group("bad"), total=m.group("total"),
+            long_s=long_s, short_s=short_s,
+            op=m.group("op"), threshold=float(m.group("threshold")),
+            reason=m.group("reason") or "slo_burn",
+        )
+    return parse_rule(text)
+
+
+def fleet_rules(cfg) -> list:
+    """The fleet-scope rule set one ExperimentConfig implies: every
+    ``obs.fleet_rules`` string through :func:`parse_fleet_rule`.
+    Separate from quality_rules/reliability_rules because these are
+    evaluated by the AGGREGATOR over merged fleet snapshots, never by
+    a process-local AlertManager."""
+    return [parse_fleet_rule(text)
+            for text in getattr(cfg.obs, "fleet_rules", ()) or ()]
+
+
 def parse_rule(text: str) -> AlertRule:
     """One rule from the declarative grammar above; raises on anything
     it cannot parse COMPLETELY (a half-understood alert rule is worse

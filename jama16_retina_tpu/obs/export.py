@@ -97,6 +97,10 @@ def _jsonl_histograms(snapshot: dict) -> dict:
             "p50": round(h["p50"], 6) if h["p50"] is not None else None,
             "p95": round(h["p95"], 6) if h["p95"] is not None else None,
             "p99": round(h["p99"], 6) if h["p99"] is not None else None,
+            # The window's slowest exemplar-tagged observation (ISSUE
+            # 15): an SLO breach in this record links straight to the
+            # trace_id to pull from the stitched fleet trace.
+            **({"exemplar": h["exemplar"]} if h.get("exemplar") else {}),
         }
         for name, h in snapshot.get("histograms", {}).items()
     }
@@ -137,6 +141,7 @@ class Snapshotter:
         every_s: float = 60.0,
         prom_name: str = "telemetry.prom",
         alerts=None,
+        fleet=None,
     ):
         if not workdir and runlog is None:
             raise ValueError("Snapshotter needs a workdir and/or a runlog")
@@ -160,6 +165,13 @@ class Snapshotter:
         # after construction (predict.py builds the engine — and thus
         # the rules' flight recorder — after its snapshotter).
         self.alerts = alerts
+        # Fleet segment bus (obs/fleet.py; ISSUE 15): when a FleetBus
+        # is attached (obs.fleet_dir set — see fleet.bus_for), every
+        # flush ALSO publishes a sealed telemetry segment into the
+        # shared fleet dir. None = one branch per flush (the bench
+        # fleet_overhead_pct contract).
+        self._fleet = fleet
+        self._http = None
         self._last_flush = time.time()
         self._step: "int | None" = None
         self._last_progress_t: "float | None" = None
@@ -188,8 +200,10 @@ class Snapshotter:
     def flush(self) -> dict:
         """Snapshot now: one ``telemetry`` + one ``heartbeat`` JSONL
         record, and (when a workdir is set) an atomic .prom rewrite.
-        Returns the raw snapshot (tests read it)."""
-        snap = self._registry.snapshot()
+        Returns the raw snapshot (tests read it). The flush is the ONE
+        consumer that closes histogram exemplar windows — scrapes and
+        dumps read without consuming."""
+        snap = self._registry.snapshot(reset_exemplars=True)
         self._log.write(
             "telemetry",
             counters={k: round(v, 6) for k, v in snap["counters"].items()},
@@ -207,6 +221,15 @@ class Snapshotter:
         )
         if self.alerts is not None:
             self.alerts.evaluate(snapshot=snap, runlog=self._log)
+        if self._fleet is not None:
+            self._fleet.publish(snap, heartbeat={
+                "step": self._step,
+                "last_progress_t": (
+                    round(self._last_progress_t, 3)
+                    if self._last_progress_t is not None else None
+                ),
+                "flushes": self.flushes + 1,
+            })
         if self._workdir:
             path = self._prom_path()
             os.makedirs(self._workdir, exist_ok=True)
@@ -229,9 +252,36 @@ class Snapshotter:
             return self.flush()
         return None
 
+    def serve_http(self, port: int, max_age_s: float = 300.0):
+        """Opt-in stdlib HTTP endpoint (ISSUE 15 satellite): start an
+        ObsHttp server (obs/httpd.py) bound to this snapshotter's
+        registry + heartbeat state — ``/metrics`` serves the live
+        Prometheus text, ``/healthz`` the heartbeat freshness with the
+        same 0/1/2 semantics as ``--check-heartbeats``. Bind failures
+        are logged, never raised (a busy port must not kill the run).
+        Returns the server (its ``.port`` resolves port 0), or None."""
+        from absl import logging as absl_logging
+
+        from jama16_retina_tpu.obs import httpd
+
+        try:
+            self._http = httpd.ObsHttp(
+                self._registry, port, snapshotter=self,
+                max_age_s=max_age_s,
+            )
+        except OSError as e:
+            absl_logging.error(
+                "obs http endpoint failed to bind port %d: %s", port, e
+            )
+            return None
+        return self._http
+
     def close(self) -> None:
         """Final flush + close the owned RunLog (never one the caller
         passed in — the trainer closes its own log after this)."""
         self.flush()
+        if self._http is not None:
+            self._http.close()
+            self._http = None
         if self._owns_log:
             self._log.close()

@@ -1,0 +1,804 @@
+"""Fleet observability plane: cross-process telemetry segments, the
+kind-correct aggregator, stitched traces, and fleet-scope SLO rules
+(ISSUE 15).
+
+PRs 12-14 made the runtime a multi-process FLEET (router replicas over
+an EscalationPool, the lifecycle ``--watch`` supervisor, GSPMD
+multi-host trainers) while the PR-3/4/5 observability stack stayed
+strictly per-process: one registry, one JSONL, one ``.prom``, one ring
+tracer per workdir. Nobody could answer "what is the fleet's p99" or
+"which process wedged" from one place. This module is that place — the
+distributed-runtime monitoring discipline of "TensorFlow: a system for
+large-scale machine learning" (PAPERS.md) applied to our own stack:
+
+  * **Segment bus** — every :class:`~jama16_retina_tpu.obs.export.
+    Snapshotter` additionally publishes SEALED telemetry segments
+    (riding the PR-13 ``integrity/artifact`` seam: atomic, digest-
+    verified) into a shared ``obs.fleet_dir``. One directory per
+    process — ``<fleet_dir>/<role>-p<pid>/`` — holding a bounded
+    stream of ``seg-NNNNNN.json`` snapshots (each tagged with role /
+    pid / host index / heartbeat) plus an atomically-rewritten
+    ``trace.json`` with the process's current event rings and the
+    wall-clock epoch that aligns them across processes.
+  * **Kind-correct aggregation** — :func:`merge_snapshots`: counters
+    SUM, fixed-bucket histograms merge BUCKET-EXACT (identical bounds
+    ⇒ cumulative series add; quantiles recomputed from the merged
+    series — never averaged), gauges keep their per-process series AND
+    a fleet reduction the metric's help string declares
+    (``[fleet:sum|max|min|mean|last]``, default sum). Pinned by the
+    property ``merged == sum/merge of the per-process snapshots``
+    (tests/test_fleet.py).
+  * **Fleet-scope SLO rules** — :func:`evaluate_fleet` replays the
+    merged snapshot TIMELINE through the ordinary alert grammar (so
+    ``for S`` latching and ``rate()`` work over fleet history) and
+    evaluates the multi-window ``burn(bad/total, LONG, SHORT)``
+    burn-rate form (obs/alerts.py) over merged counter deltas — rules
+    a single process can never fire, because no single process holds
+    the fleet totals. Firing transitions write the standard ``alert``
+    record (``<fleet_dir>/fleet.jsonl``) and a blackbox dump through
+    the PR-4 FlightRecorder, deduped across aggregator invocations by
+    a sealed state artifact (``fleet-alerts.json``).
+  * **Stitched traces** — :func:`stitch_trace` merges every process's
+    published rings into ONE Chrome trace with per-process pid lanes,
+    wall-clock aligned via each tracer's ``epoch_unix``
+    (``obs_report --trace-out`` over a fleet dir).
+
+Retention: the per-process segment streams are bounded twice — the bus
+prunes beyond ``obs.fleet_keep_segments`` at publish time, and
+``integrity/retention.py`` enforces ``integrity.telemetry_max_bytes``
+per stream offline (the blackbox_keep dual-enforcement precedent).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.obs import registry as registry_lib
+
+SEGMENT_SCHEMA = "obs.fleet_segment"
+SEGMENT_VERSION = 1
+STATE_SCHEMA = "obs.fleet_alerts"
+STATE_VERSION = 1
+
+# <fleet_dir>/<role>-p<pid>/ — role sanitized to this alphabet so the
+# directory name round-trips through the regex below.
+_ROLE_RE = re.compile(r"[^a-z0-9_-]")
+_PROC_DIR_RE = re.compile(r"^([a-z0-9_-]+)-p(\d+)$")
+_SEG_RE = re.compile(r"^seg-(\d+)\.json$")
+
+# Gauge fleet-reduction declared in the metric's help string:
+# "... [fleet:max]" — absent means sum (queue depths, in-flight rows,
+# resident counts all add across processes; the exceptions declare
+# themselves).
+_REDUCTION_RE = re.compile(r"\[fleet:(sum|max|min|mean|last)\]")
+
+# How many merged timeline points rule replay walks (newest kept): the
+# long burn window bounds how much history is USEFUL; this bounds how
+# much is read.
+TIMELINE_KEEP = 256
+
+# A stream whose newest segment is older than this stops contributing
+# its GAUGES to the merge (a dead server's frozen queue depth is not a
+# current level — left in, it would keep a fleet threshold rule firing
+# forever off a dead pid, and a restarted process's new stream would
+# double-count it). Cumulative counters and histograms STAY in the
+# fleet totals: the rows that dead process served did happen, and a
+# frozen counter contributes zero to every rate()/burn() delta.
+STALE_GAUGES_AFTER_S = 900.0
+
+
+def _without_gauges(snapshot: dict) -> dict:
+    out = dict(snapshot)
+    out["gauges"] = {}
+    return out
+
+
+def sanitize_role(role: str) -> str:
+    return _ROLE_RE.sub("_", (role or "proc").lower()) or "proc"
+
+
+def process_dir(fleet_dir: str, role: str, pid: "int | None" = None) -> str:
+    pid = os.getpid() if pid is None else int(pid)
+    return os.path.join(fleet_dir, f"{sanitize_role(role)}-p{pid}")
+
+
+def is_fleet_dir(path: str) -> bool:
+    """Does ``path`` look like a fleet dir (vs an ordinary workdir)?
+    True when any immediate subdirectory is a segment stream."""
+    if not os.path.isdir(path):
+        return False
+    for n in os.listdir(path):
+        if _PROC_DIR_RE.match(n) and glob.glob(
+                os.path.join(path, n, "seg-*.json")):
+            return True
+    return False
+
+
+class FleetBus:
+    """One process's publisher half of the segment bus.
+
+    Constructed by :func:`bus_for` (None when the fleet plane is off —
+    the Snapshotter then pays ONE branch per flush, the bench
+    ``fleet_overhead_pct`` contract). ``publish`` is driven from the
+    Snapshotter's flush cadence; a publish failure is counted
+    (``obs.fleet.publish_errors``) and logged, never raised into the
+    flush — losing one fleet segment must not take telemetry down.
+    """
+
+    def __init__(self, fleet_dir: str, role: str,
+                 registry: "registry_lib.Registry | None" = None,
+                 tracer=None, keep_segments: int = 64,
+                 host_index: "int | None" = None):
+        from jama16_retina_tpu.obs import trace as trace_lib
+
+        self.fleet_dir = fleet_dir
+        self.role = sanitize_role(role)
+        self.pid = os.getpid()
+        self.dir = process_dir(fleet_dir, self.role, self.pid)
+        self.keep_segments = max(1, int(keep_segments))
+        self._registry = (registry if registry is not None
+                          else registry_lib.default_registry())
+        self._tracer = (tracer if tracer is not None
+                        else trace_lib.default_tracer())
+        self._host_index = host_index
+        # Resume the stream: a process running several sequential runs
+        # (ensemble members) keeps ONE monotone segment sequence.
+        self._seq = 0
+        if os.path.isdir(self.dir):
+            for n in os.listdir(self.dir):
+                m = _SEG_RE.match(n)
+                if m:
+                    self._seq = max(self._seq, int(m.group(1)))
+        self._c_segments = self._registry.counter(
+            "obs.fleet.segments",
+            help="sealed telemetry segments this process published to "
+                 "the fleet dir (obs.fleet_dir)",
+        )
+        self._c_errors = self._registry.counter(
+            "obs.fleet.publish_errors",
+            help="fleet-segment publish failures swallowed so the "
+                 "telemetry flush survives (disk full, permissions)",
+        )
+
+    def _host(self) -> int:
+        if self._host_index is not None:
+            return int(self._host_index)
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:  # noqa: BLE001 - no backend == single host
+            return 0
+
+    def publish(self, snapshot: dict, heartbeat: "dict | None" = None) -> None:
+        """One sealed segment (+ the trace rewrite) into this process's
+        stream; prunes beyond ``keep_segments``. Never raises."""
+        try:
+            self._seq += 1
+            payload = {
+                "kind": "fleet_segment",
+                "role": self.role,
+                "pid": self.pid,
+                "host_index": self._host(),
+                "seq": self._seq,
+                "t": round(time.time(), 3),
+                "heartbeat": dict(heartbeat or {}),
+                "snapshot": {
+                    "counters": snapshot.get("counters", {}),
+                    "gauges": snapshot.get("gauges", {}),
+                    "histograms": snapshot.get("histograms", {}),
+                    "help": snapshot.get("help", {}),
+                },
+            }
+            os.makedirs(self.dir, exist_ok=True)
+            artifact_lib.write_sealed_json(
+                os.path.join(self.dir, f"seg-{self._seq:06d}.json"),
+                payload, schema=SEGMENT_SCHEMA, version=SEGMENT_VERSION,
+            )
+            self._prune()
+            if self._tracer.enabled:
+                self._publish_trace()
+            self._c_segments.inc()
+        except Exception as e:  # noqa: BLE001 - flush must survive
+            self._c_errors.inc()
+            try:
+                from absl import logging as absl_logging
+
+                absl_logging.error(
+                    "fleet segment publish failed (%s): %s: %s",
+                    self.dir, type(e).__name__, e,
+                )
+            except Exception:  # pragma: no cover - logging itself broke
+                pass
+
+    def _publish_trace(self) -> None:
+        """Atomic rewrite of this process's current event rings with
+        the wall-clock epoch the stitcher aligns on. Regenerated every
+        flush (rings are overwrite-oldest), so rename-only atomicity —
+        no fsync on the flush path (the .prom precedent)."""
+        doc = {
+            "meta": {
+                "role": self.role,
+                "pid": self.pid,
+                "epoch_unix": round(self._tracer.epoch_unix, 6),
+            },
+            "traceEvents": self._tracer.events(),
+        }
+        artifact_lib.atomic_write_text(
+            os.path.join(self.dir, "trace.json"),
+            json.dumps(doc), fsync=False,
+        )
+
+    def _prune(self) -> None:
+        segs = sorted(
+            n for n in os.listdir(self.dir) if _SEG_RE.match(n)
+        )
+        for n in segs[: max(0, len(segs) - self.keep_segments)]:
+            try:
+                os.unlink(os.path.join(self.dir, n))
+            except OSError:  # pragma: no cover - racing GC
+                pass
+
+
+def bus_for(cfg, role: str, registry=None, tracer=None) -> "FleetBus | None":
+    """The FleetBus one wiring site hangs on its Snapshotter, or None
+    when the fleet plane is off (``obs.fleet_dir`` empty or obs
+    disabled) — the disabled path is one ``is not None`` branch per
+    flush. ``obs.fleet_role`` overrides the site's default role."""
+    if not cfg.obs.enabled or not cfg.obs.fleet_dir:
+        return None
+    return FleetBus(
+        cfg.obs.fleet_dir,
+        role=cfg.obs.fleet_role or role,
+        registry=registry, tracer=tracer,
+        keep_segments=cfg.obs.fleet_keep_segments,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Reading
+# ---------------------------------------------------------------------------
+
+
+def read_segments(proc_dir: str, registry=None) -> "tuple[list, list]":
+    """(segments sorted by seq, corrupt file basenames). A corrupt
+    segment is SKIPPED (and counted through the integrity machinery by
+    read_sealed_json) — one torn segment must not blind the aggregator
+    to the rest of the stream."""
+    segs, corrupt = [], []
+    if not os.path.isdir(proc_dir):
+        return segs, corrupt
+    for n in sorted(os.listdir(proc_dir)):
+        if not _SEG_RE.match(n):
+            continue
+        p = os.path.join(proc_dir, n)
+        try:
+            doc, _seal = artifact_lib.read_sealed_json(
+                p, artifact="fleet_segment", registry=registry
+            )
+            segs.append(doc)
+        except artifact_lib.ArtifactCorrupt:
+            corrupt.append(n)
+        except (OSError, ValueError):
+            corrupt.append(n)
+    segs.sort(key=lambda s: int(s.get("seq", 0)))
+    return segs, corrupt
+
+
+def read_fleet(fleet_dir: str, registry=None) -> dict:
+    """{(role, pid): {"segments": [...], "corrupt": [...], "dir": path}}
+    for every segment stream under ``fleet_dir``."""
+    out: dict = {}
+    if not os.path.isdir(fleet_dir):
+        return out
+    for n in sorted(os.listdir(fleet_dir)):
+        m = _PROC_DIR_RE.match(n)
+        if not m:
+            continue
+        p = os.path.join(fleet_dir, n)
+        if not os.path.isdir(p):
+            continue
+        segs, corrupt = read_segments(p, registry=registry)
+        if segs or corrupt:
+            out[(m.group(1), int(m.group(2)))] = {
+                "segments": segs, "corrupt": corrupt, "dir": p,
+            }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Kind-correct merge
+# ---------------------------------------------------------------------------
+
+
+def gauge_reduction(help_text: "str | None") -> str:
+    """The fleet reduction a gauge's help string declares
+    (``[fleet:max]`` etc.); sum when undeclared — levels like queue
+    depth, in-flight rows, and resident counts add across processes."""
+    if help_text:
+        m = _REDUCTION_RE.search(help_text)
+        if m:
+            return m.group(1)
+    return "sum"
+
+
+def _merge_histogram(hists: list) -> "dict | None":
+    """Bucket-exact merge of same-name histogram snapshots: identical
+    bounds ⇒ the cumulative series (and sum/count) add elementwise,
+    and quantiles are recomputed from the MERGED series with the same
+    rank interpolation obs/registry.py applies — never averaged across
+    processes (an average of p99s is not a p99). Mismatched bounds
+    return None (the caller keeps them per-process and says so)."""
+    bounds = [tuple(b for b, _c in h.get("buckets", ())) for h in hists]
+    if len(set(bounds)) != 1:
+        return None
+    merged_bounds = bounds[0]
+    cum = [0] * len(merged_bounds)
+    total = 0
+    s = 0.0
+    exemplar = None
+    for h in hists:
+        for i, (_b, c) in enumerate(h.get("buckets", ())):
+            cum[i] += int(c)
+        total += int(h.get("count", 0))
+        s += float(h.get("sum", 0.0))
+        ex = h.get("exemplar")
+        if ex and ex.get("value") is not None and (
+                exemplar is None or ex["value"] > exemplar["value"]):
+            exemplar = dict(ex)
+
+    def quantile(q: float):
+        if not total:
+            return None
+        target = q * total
+        prev_cum, lo = 0, 0.0
+        for bound, c_cum in zip(merged_bounds, cum):
+            c = c_cum - prev_cum
+            if c and c_cum >= target:
+                frac = (target - prev_cum) / c
+                return lo + (bound - lo) * frac
+            prev_cum, lo = c_cum, bound
+        return merged_bounds[-1] if merged_bounds else None
+
+    return {
+        "count": total,
+        "sum": s,
+        "mean": (s / total) if total else None,
+        "p50": quantile(0.5),
+        "p95": quantile(0.95),
+        "p99": quantile(0.99),
+        "buckets": list(zip(merged_bounds, cum)),
+        "exemplar": exemplar,
+    }
+
+
+def merge_snapshots(snaps: "list[tuple[str, dict]]") -> dict:
+    """THE aggregator: ``[(proc_key, Registry.snapshot()), ...]`` →
+    one merged snapshot with kind-correct semantics. Counters sum;
+    histograms merge bucket-exact (mismatched bounds land in
+    ``unmerged_histograms`` per process instead of being mangled);
+    gauges carry BOTH the help-declared fleet reduction (``gauges``)
+    and the per-process series (``gauge_series``). ``help`` is the
+    union (first writer wins). Pinned by the merged==sum property test.
+    """
+    out: dict = {
+        "counters": {}, "gauges": {}, "gauge_series": {},
+        "histograms": {}, "unmerged_histograms": {}, "help": {},
+    }
+    hist_groups: dict = {}
+    gauge_groups: dict = {}
+    for proc, snap in snaps:
+        for name, v in snap.get("counters", {}).items():
+            out["counters"][name] = out["counters"].get(name, 0.0) + v
+        for name, v in snap.get("gauges", {}).items():
+            gauge_groups.setdefault(name, []).append((proc, float(v)))
+        for name, h in snap.get("histograms", {}).items():
+            hist_groups.setdefault(name, []).append((proc, h))
+        for name, text in snap.get("help", {}).items():
+            out["help"].setdefault(name, text)
+    for name, group in gauge_groups.items():
+        values = [v for _p, v in group]
+        red = gauge_reduction(out["help"].get(name))
+        if red == "max":
+            fleet = max(values)
+        elif red == "min":
+            fleet = min(values)
+        elif red == "mean":
+            fleet = sum(values) / len(values)
+        elif red == "last":
+            fleet = values[-1]
+        else:
+            fleet = sum(values)
+        out["gauges"][name] = fleet
+        out["gauge_series"][name] = {p: v for p, v in group}
+    for name, group in hist_groups.items():
+        merged = _merge_histogram([h for _p, h in group])
+        if merged is not None:
+            out["histograms"][name] = merged
+        else:
+            out["unmerged_histograms"][name] = {p: h for p, h in group}
+    return out
+
+
+def _segment_at(segments: list, t: float) -> "dict | None":
+    """Newest segment published at or before ``t`` (None = the process
+    had not published yet)."""
+    best = None
+    for seg in segments:
+        if float(seg.get("t", 0.0)) <= t:
+            best = seg
+        else:
+            break
+    return best
+
+
+def merged_timeline(fleet: dict, keep: int = TIMELINE_KEEP,
+                    stale_after_s: float = STALE_GAUGES_AFTER_S) -> list:
+    """[(t, merged_snapshot), ...] oldest-first: one merged fleet
+    instant per distinct segment timestamp (each process contributes
+    its newest segment at or before that instant; a contribution older
+    than ``stale_after_s`` at that instant keeps its cumulative
+    counters/histograms but loses its point-in-time gauges — see
+    STALE_GAUGES_AFTER_S). This is the replay input for ``for S``
+    latching and rate()/burn() forms — fleet-level alert evaluation
+    needs fleet-level HISTORY, which is exactly what the segment
+    streams keep and a single .prom snapshot does not."""
+    times = sorted({
+        float(seg.get("t", 0.0))
+        for proc in fleet.values() for seg in proc["segments"]
+    })
+    times = times[-max(1, int(keep)):] if times else []
+    out = []
+    for t in times:
+        snaps = []
+        for (role, pid), proc in sorted(fleet.items()):
+            seg = _segment_at(proc["segments"], t)
+            if seg is None:
+                continue
+            snap = seg.get("snapshot", {})
+            if t - float(seg.get("t", 0.0)) > stale_after_s:
+                snap = _without_gauges(snap)
+            snaps.append((f"{role}-p{pid}", snap))
+        if snaps:
+            out.append((t, merge_snapshots(snaps)))
+    return out
+
+
+def fleet_meta(fleet: dict, now: "float | None" = None,
+               stale_after_s: float = STALE_GAUGES_AFTER_S) -> dict:
+    """Per-process meta table from an already-read fleet dict (one
+    read serves report + meta — the aggregator must not re-read and
+    re-hash every sealed segment per section)."""
+    now = time.time() if now is None else now
+    meta = {}
+    for (role, pid), proc in sorted(fleet.items()):
+        key = f"{role}-p{pid}"
+        if proc["segments"]:
+            newest = proc["segments"][-1]
+            meta[key] = {
+                "role": role, "pid": pid,
+                "host_index": newest.get("host_index"),
+                "seq": newest.get("seq"),
+                "t": newest.get("t"),
+                "heartbeat": newest.get("heartbeat", {}),
+                "segments": len(proc["segments"]),
+                "corrupt": proc["corrupt"],
+                "stale": (now - float(newest.get("t") or 0.0)
+                          > stale_after_s),
+            }
+        elif proc["corrupt"]:
+            meta[key] = {
+                "role": role, "pid": pid, "segments": 0,
+                "corrupt": proc["corrupt"],
+            }
+    return meta
+
+
+def fleet_snapshot(fleet_dir: str, registry=None,
+                   now: "float | None" = None,
+                   stale_after_s: float = STALE_GAUGES_AFTER_S,
+                   fleet: "dict | None" = None) -> "tuple[dict, dict]":
+    """(merged latest snapshot, per-process meta) — the ``--fleet``
+    report's payload. A stream whose newest segment is older than
+    ``stale_after_s`` keeps its cumulative counters/histograms in the
+    merge but NOT its gauges (marked ``stale`` in the meta). Pass a
+    pre-read ``fleet`` dict to skip the second read."""
+    now = time.time() if now is None else now
+    if fleet is None:
+        fleet = read_fleet(fleet_dir, registry=registry)
+    snaps = []
+    for (role, pid), proc in sorted(fleet.items()):
+        if not proc["segments"]:
+            continue
+        newest = proc["segments"][-1]
+        snap = newest.get("snapshot", {})
+        if now - float(newest.get("t") or 0.0) > stale_after_s:
+            snap = _without_gauges(snap)
+        snaps.append((f"{role}-p{pid}", snap))
+    return merge_snapshots(snaps), fleet_meta(
+        fleet, now=now, stale_after_s=stale_after_s
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fleet heartbeats
+# ---------------------------------------------------------------------------
+
+
+def check_fleet_heartbeats(fleet_dir: str, max_age_s: float,
+                           now: "float | None" = None) -> "tuple[int, str]":
+    """The fleet twin of obs_report's --check-heartbeats: 0 every
+    process fresh, 1 any stale/wedged — the message names EXACTLY the
+    sick process (role + pid) and stays quiet about the healthy
+    remainder — 2 no segments at all (blind)."""
+    now = time.time() if now is None else now
+    _merged, meta = fleet_snapshot(fleet_dir)
+    procs = {k: m for k, m in meta.items() if m.get("segments")}
+    if not procs:
+        return 2, f"no fleet segments under {fleet_dir}"
+    stale = []
+    for key, m in sorted(procs.items()):
+        age = now - float(m.get("t") or 0.0)
+        if age > max_age_s:
+            stale.append(
+                f"{key}: last segment {age:.0f}s old (> {max_age_s:.0f}s)"
+            )
+            continue
+        prog = (m.get("heartbeat") or {}).get("last_progress_t")
+        if prog and now - float(prog) > max_age_s:
+            stale.append(
+                f"{key}: segments fresh but no step progress for "
+                f"{now - float(prog):.0f}s (> {max_age_s:.0f}s) — wedged?"
+            )
+    if stale:
+        return 1, "\n".join(stale)
+    return 0, "\n".join(
+        f"{key}: ok (step {(m.get('heartbeat') or {}).get('step')}, "
+        f"segment {now - float(m.get('t') or 0.0):.0f}s old)"
+        for key, m in sorted(procs.items())
+    )
+
+
+# ---------------------------------------------------------------------------
+# Stitched traces
+# ---------------------------------------------------------------------------
+
+
+def stitch_trace(fleet_dir: str) -> list:
+    """ONE Chrome trace from every process's published rings: each
+    process's events keep their pid lane; timestamps shift from the
+    process-private perf_counter epoch onto a shared axis via the
+    published ``epoch_unix`` (earliest process = t 0). Per-lane
+    ``process_name`` metadata events label the lanes ``role-p<pid>``
+    so Perfetto reads like the fleet table."""
+    sources = []
+    if not os.path.isdir(fleet_dir):
+        return []
+    for n in sorted(os.listdir(fleet_dir)):
+        if not _PROC_DIR_RE.match(n):
+            continue
+        p = os.path.join(fleet_dir, n, "trace.json")
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        meta = doc.get("meta", {})
+        events = [e for e in doc.get("traceEvents", ())
+                  if isinstance(e, dict)]
+        if events:
+            sources.append((meta, events))
+    if not sources:
+        return []
+    base = min(float(m.get("epoch_unix", 0.0)) for m, _e in sources)
+    out = []
+    for meta, events in sources:
+        shift_us = (float(meta.get("epoch_unix", 0.0)) - base) * 1e6
+        pid = int(meta.get("pid", 0))
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{meta.get('role', 'proc')}-p{pid}"},
+        })
+        for e in events:
+            ev = dict(e)
+            ev["ts"] = round(float(e.get("ts", 0.0)) + shift_us, 3)
+            out.append(ev)
+    out.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fleet-scope rule evaluation (plain grammar replay + burn-rate form)
+# ---------------------------------------------------------------------------
+
+
+def _counter_delta(timeline: list, name: str, window_s: float,
+                   now: float) -> "tuple[float, float] | None":
+    """(delta, dt) of a merged counter over the trailing window, read
+    off the merged timeline (newest point minus the newest point at or
+    before ``now - window_s``; shorter history uses what exists).
+    None = fewer than two points carry the counter (no rate yet)."""
+    pts = [(t, snap["counters"][name]) for t, snap in timeline
+           if name in snap.get("counters", {})]
+    if len(pts) < 2:
+        return None
+    t1, v1 = pts[-1]
+    cutoff = now - window_s
+    t0, v0 = pts[0]
+    for t, v in pts:
+        if t <= cutoff:
+            t0, v0 = t, v
+        else:
+            break
+    if t1 <= t0:
+        return None
+    return (v1 - v0, t1 - t0)
+
+
+def evaluate_burn(timeline: list, rule, now: "float | None" = None) -> dict:
+    """One multi-window burn-rate evaluation over the merged timeline.
+
+    The SRE multi-window discipline: the bad/total ratio must breach
+    over BOTH the long window (sustained budget burn, not a blip) and
+    the short window (still happening NOW, not a resolved incident
+    paging an hour late). Returns {"firing": bool, "long": r|None,
+    "short": r|None}; a window whose total delta is zero (or with no
+    history) is no-data ⇒ not firing."""
+    from jama16_retina_tpu.obs import alerts as alerts_lib
+
+    now = time.time() if now is None else now
+    ratios = {}
+    for key, window in (("long", rule.long_s), ("short", rule.short_s)):
+        bad = _counter_delta(timeline, rule.bad, window, now)
+        total = _counter_delta(timeline, rule.total, window, now)
+        if bad is None or total is None or total[0] <= 0:
+            ratios[key] = None
+            continue
+        ratios[key] = max(0.0, bad[0]) / total[0]
+    op = alerts_lib._OPS[rule.op]
+    firing = all(
+        ratios[k] is not None and op(ratios[k], rule.threshold)
+        for k in ("long", "short")
+    )
+    return {"firing": firing, "long": ratios["long"],
+            "short": ratios["short"]}
+
+
+def _append_jsonl(path: str, rec: dict) -> None:
+    """One alert record into the fleet's own JSONL (RunLog shape,
+    without RunLog — whose lazy open imports jax for the process
+    index; the aggregator is an operator CLI that must stay light)."""
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+class _MergedRegistry(registry_lib.Registry):
+    """A Registry whose snapshot() IS the merged fleet snapshot — what
+    lets the PR-4 FlightRecorder dump fleet state through its normal
+    registry seam (its prune counter still lands on a live registry)."""
+
+    def __init__(self, merged: dict):
+        super().__init__()
+        self._merged = merged
+
+    def snapshot(self) -> dict:  # noqa: D102 - see class docstring
+        return self._merged
+
+
+def evaluate_fleet(fleet_dir: str, rules, now: "float | None" = None,
+                   write: bool = True,
+                   fleet: "dict | None" = None) -> "tuple[list, dict]":
+    """Evaluate fleet-scope rules over the merged timeline; returns
+    (firing list, merged latest snapshot).
+
+    Plain-grammar rules replay through an ordinary AlertManager over
+    the merged snapshot sequence (``for S``/rate() semantics ride the
+    segment history); ``burn()`` rules evaluate via the multi-window
+    deltas. Transitions against the persisted sealed state artifact
+    (``fleet-alerts.json``) write the standard ``alert`` record into
+    ``<fleet_dir>/fleet.jsonl`` and — for NEW firings — one blackbox
+    dump of the merged fleet state through the PR-4 FlightRecorder; a
+    rule that keeps firing across cron invocations writes/dumps
+    nothing new (the state artifact is the cross-invocation dedupe the
+    per-run dump cap cannot provide)."""
+    from jama16_retina_tpu.obs import alerts as alerts_lib
+
+    now = time.time() if now is None else now
+    if fleet is None:
+        fleet = read_fleet(fleet_dir)
+    timeline = merged_timeline(fleet)
+    merged = timeline[-1][1] if timeline else merge_snapshots([])
+    plain = [r for r in rules
+             if not isinstance(r, alerts_lib.BurnRule)]
+    burn = [r for r in rules if isinstance(r, alerts_lib.BurnRule)]
+    firing: list = []
+    if plain and timeline:
+        mgr = alerts_lib.AlertManager(
+            plain, registry=registry_lib.Registry()
+        )
+        fired: list = []
+        for t, snap in timeline:
+            fired = mgr.evaluate(snapshot=snap, now=t)
+        firing.extend(fired)
+    for rule in burn:
+        verdict = evaluate_burn(timeline, rule, now=now)
+        if verdict["firing"]:
+            firing.append({
+                "rule": rule.name, "metric": rule.name,
+                "value": verdict["short"], "threshold": rule.threshold,
+                "for_s": rule.long_s, "reason": rule.reason,
+                "long": verdict["long"], "short": verdict["short"],
+            })
+    if write:
+        _record_transitions(fleet_dir, firing, merged, now)
+    return firing, merged
+
+
+def _record_transitions(fleet_dir: str, firing: list, merged: dict,
+                        now: float) -> None:
+    """Diff the current firing set against the sealed state artifact;
+    write alert records (+ one dump per NEW firing) only for actual
+    transitions, then republish the state."""
+    state_path = os.path.join(fleet_dir, "fleet-alerts.json")
+    prev_firing: dict = {}
+    if os.path.exists(state_path):
+        try:
+            doc, _seal = artifact_lib.read_sealed_json(
+                state_path, artifact="fleet_alerts"
+            )
+            prev_firing = dict(doc.get("firing", {}))
+        except Exception:  # noqa: BLE001 - a torn state file must not
+            prev_firing = {}  # block alerting; transitions re-fire once
+    cur = {f["rule"]: f for f in firing}
+    jsonl = os.path.join(fleet_dir, "fleet.jsonl")
+    new_rules = [name for name in cur if name not in prev_firing]
+    resolved = [name for name in prev_firing if name not in cur]
+    for name in new_rules:
+        f = cur[name]
+        _append_jsonl(jsonl, {
+            "kind": "alert", "t": round(now, 3), "rule": name,
+            "state": "firing", "metric": f.get("metric"),
+            "value": (round(f["value"], 6)
+                      if isinstance(f.get("value"), float) else
+                      f.get("value")),
+            "threshold": f.get("threshold"), "reason": f.get("reason"),
+            "scope": "fleet",
+        })
+    for name in resolved:
+        _append_jsonl(jsonl, {
+            "kind": "alert", "t": round(now, 3), "rule": name,
+            "state": "resolved", "reason": prev_firing[name],
+            "scope": "fleet",
+        })
+    if new_rules:
+        from jama16_retina_tpu.obs import flightrec
+
+        flight = flightrec.FlightRecorder(
+            fleet_dir,
+            config={"scope": "fleet", "rules": sorted(cur)},
+            registry=_MergedRegistry(merged),
+        )
+        # One dump per NEW firing RULE: FlightRecorder dedupes by
+        # reason string, so two rules sharing the default reason must
+        # get distinct keys or the second rule's firing-time state
+        # would be silently skipped.
+        seen_reasons: set = set()
+        for i, name in enumerate(sorted(new_rules)):
+            reason = cur[name].get("reason") or "slo_burn"
+            if reason in seen_reasons:
+                reason = f"{reason}_{i}"
+            seen_reasons.add(reason)
+            flight.dump(reason, rule=name, scope="fleet")
+    if new_rules or resolved or not os.path.exists(state_path):
+        artifact_lib.write_sealed_json(state_path, {
+            "kind": "fleet_alerts",
+            "t": round(now, 3),
+            "firing": {name: f.get("reason") for name, f in cur.items()},
+        }, schema=STATE_SCHEMA, version=STATE_VERSION)

@@ -1,0 +1,127 @@
+"""Opt-in stdlib HTTP telemetry endpoint (ISSUE 15 satellite).
+
+The ``.prom`` file serves file-based scrapers (node_exporter textfile
+collector); standard PULL scrapers want an HTTP target. ``ObsHttp`` is
+that target, stdlib-only (``http.server`` in one daemon thread, no new
+dependencies — the container constraint):
+
+  * ``GET /metrics``  — the LIVE ``prometheus_text`` rendering of the
+    process registry (not the last flush: a scrape is a snapshot);
+  * ``GET /healthz``  — heartbeat freshness as JSON with the SAME
+    0/1/2 semantics as ``obs_report --check-heartbeats`` (0 fresh,
+    1 stale or wedged — progress stamped but old, 2 no progress ever
+    recorded). HTTP 200 for 0, 503 otherwise, so a dumb prober (k8s
+    livenessProbe, a load balancer) needs no JSON parsing.
+    ``?max_age_s=`` overrides the staleness threshold per probe.
+
+Off by default (``obs.http_port=0``); wired by the Snapshotter's
+``serve_http`` at the trainer/server/predict telemetry sites. Binds
+0.0.0.0 (a scraper is by definition another host); port 0 picks an
+ephemeral port (tests read ``.port``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from jama16_retina_tpu.obs import export as export_lib
+from jama16_retina_tpu.obs import registry as registry_lib
+
+
+class ObsHttp:
+    """One daemon-threaded HTTP server over a registry + snapshotter.
+
+    The snapshotter (optional) supplies the heartbeat state /healthz
+    reads; without one, /healthz is always status 2 (no heartbeat
+    source — the endpoint says so rather than lying "fresh").
+    """
+
+    def __init__(self, registry: "registry_lib.Registry | None",
+                 port: int, snapshotter=None, max_age_s: float = 300.0,
+                 host: str = "0.0.0.0"):
+        self._registry = (registry if registry is not None
+                          else registry_lib.default_registry())
+        self._snapshotter = snapshotter
+        self.max_age_s = float(max_age_s)
+        obs_http = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 - silence
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib casing
+                parsed = urlparse(self.path)
+                if parsed.path == "/metrics":
+                    body = export_lib.prometheus_text(
+                        obs_http._registry.snapshot()
+                    ).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if parsed.path == "/healthz":
+                    q = parse_qs(parsed.query)
+                    try:
+                        max_age = float(q["max_age_s"][0])
+                    except (KeyError, ValueError, IndexError):
+                        max_age = obs_http.max_age_s
+                    status, detail = obs_http.health(max_age_s=max_age)
+                    body = json.dumps(
+                        {"status": status, **detail}
+                    ).encode("utf-8")
+                    self.send_response(200 if status == 0 else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="jama16-obs-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def health(self, max_age_s: "float | None" = None,
+               now: "float | None" = None) -> "tuple[int, dict]":
+        """(status, detail) with --check-heartbeats' 0/1/2 semantics:
+        0 fresh, 1 progress stamped but older than the threshold
+        (wedged), 2 no snapshotter / no progress ever recorded."""
+        max_age = self.max_age_s if max_age_s is None else float(max_age_s)
+        now = time.time() if now is None else now
+        snap = self._snapshotter
+        if snap is None or snap._last_progress_t is None:
+            return 2, {"detail": "no heartbeat recorded"}
+        age = now - snap._last_progress_t
+        detail = {
+            "step": snap._step,
+            "progress_age_s": round(age, 1),
+            "max_age_s": max_age,
+        }
+        if age > max_age:
+            detail["detail"] = (
+                f"no step progress for {age:.0f}s (> {max_age:.0f}s) "
+                "— wedged?"
+            )
+            return 1, detail
+        return 0, detail
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)

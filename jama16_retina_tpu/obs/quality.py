@@ -333,12 +333,13 @@ class GoldenCanary:
         self._g_ok = reg.gauge(
             "quality.canary_ok",
             help="1 while the last golden-set canary run matched its "
-                 "pinned scores; 0 after a deviation",
+                 "pinned scores; 0 after a deviation [fleet:min]",
         )
         self._g_dev = reg.gauge(
             "quality.canary_max_dev",
             help="max |score - pinned| of the last canary run "
-                 "(-1 = score shape mismatched the pinned set)",
+                 "(-1 = score shape mismatched the pinned set) "
+                 "[fleet:max]",
         )
         self._c_runs = reg.counter(
             "quality.canary_runs",
@@ -498,7 +499,8 @@ class QualityMonitor:
         self._lock = threading.Lock()
         self._g_profile = reg.gauge(
             "quality.profile_loaded",
-            help="version of the loaded reference profile (0 = none)",
+            help="version of the loaded reference profile (0 = none) "
+                 "[fleet:min]",
         )
         self._g_profile.set(
             float(profile["version"]) if profile is not None else 0.0
@@ -507,29 +509,31 @@ class QualityMonitor:
             "quality.score_psi",
             help="debiased PSI of the live score histogram vs the "
                  "reference profile, per tumbling window (0 = at "
-                 "sampling noise; >0.25 shifted)",
+                 "sampling noise; >0.25 shifted) [fleet:max]",
         )
         self._g_score_kl = reg.gauge(
             "quality.score_kl",
             help="KL(live score histogram || reference profile) over "
-                 "the same tumbling window as quality.score_psi",
+                 "the same tumbling window as quality.score_psi "
+                 "[fleet:max]",
         )
         self._g_pos_rate = reg.gauge(
             "quality.positive_rate",
             help="fraction of window scores above the profile's primary "
-                 "operating threshold (compare to its base_rate)",
+                 "operating threshold (compare to its base_rate) "
+                 "[fleet:mean]",
         )
         self._g_input_max = reg.gauge(
             "quality.input_psi_max",
             help="max input-statistic PSI over "
-                 + "/".join(INPUT_STATS),
+                 + "/".join(INPUT_STATS) + " [fleet:max]",
         )
         self._g_input = {
             k: reg.gauge(
                 f"quality.input_psi.{k}",
                 help="debiased PSI of one post-normalization input "
                      "statistic vs the reference profile "
-                     f"({'/'.join(INPUT_STATS)})",
+                     f"({'/'.join(INPUT_STATS)}) [fleet:max]",
             ) for k in INPUT_STATS
         }
         self._c_windows = reg.counter(

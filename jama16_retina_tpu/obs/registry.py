@@ -111,7 +111,7 @@ class Histogram:
 
     __slots__ = (
         "name", "help", "_registry", "_lock", "bounds", "_counts",
-        "_sum", "_count",
+        "_sum", "_count", "_ex_value", "_ex_id",
     )
 
     def __init__(self, name: str, registry: "Registry",
@@ -127,8 +127,15 @@ class Histogram:
         self._counts = [0] * (len(self.bounds) + 1)  # +1 = overflow
         self._sum = 0.0
         self._count = 0
+        # Exemplar (ISSUE 15): the SLOWEST observation since the last
+        # snapshot, tagged with the caller-supplied id (a request's
+        # trace_id). Tumbling at the snapshot cadence, so each
+        # telemetry window names the one request to go look at when
+        # its p99 breaches an SLO.
+        self._ex_value: "float | None" = None
+        self._ex_id = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar=None) -> None:
         if not self._registry.enabled:
             return
         i = bisect.bisect_left(self.bounds, v)
@@ -136,6 +143,10 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None and (
+                    self._ex_value is None or v > self._ex_value):
+                self._ex_value = v
+                self._ex_id = exemplar
 
     def _quantile_locked(self, q: float) -> "float | None":
         """Rank-interpolated quantile from the bucket counts (callers
@@ -154,10 +165,17 @@ class Histogram:
             lo = bound
         return self.bounds[-1]
 
-    def snapshot(self) -> dict:
-        """{'count', 'sum', 'mean', 'p50', 'p95', 'p99', 'buckets'} —
-        buckets as (upper_bound, cumulative_count) pairs plus the +Inf
-        total, the shape prometheus_text renders directly."""
+    def snapshot(self, reset_exemplar: bool = False) -> dict:
+        """{'count', 'sum', 'mean', 'p50', 'p95', 'p99', 'buckets',
+        'exemplar'} — buckets as (upper_bound, cumulative_count) pairs
+        plus the +Inf total, the shape prometheus_text renders
+        directly. ``exemplar`` is {'value', 'trace_id'} for the slowest
+        exemplar-tagged observation since the last RESETTING snapshot,
+        or None. Only the telemetry flush passes ``reset_exemplar=True``
+        (its cadence defines the tumbling window); every other consumer
+        — an HTTP scrape, a blackbox dump, a test — reads without
+        consuming, so a 15 s scraper cannot steal the exemplar the
+        60 s flush was about to export."""
         with self._lock:
             counts = list(self._counts)
             total = self._count
@@ -166,6 +184,13 @@ class Histogram:
                 f"p{int(q * 100)}": self._quantile_locked(q)
                 for q in (0.5, 0.95, 0.99)
             }
+            exemplar = (
+                {"value": self._ex_value, "trace_id": self._ex_id}
+                if self._ex_value is not None else None
+            )
+            if reset_exemplar:
+                self._ex_value = None
+                self._ex_id = None
         cum, cum_counts = 0, []
         for c in counts[:-1]:
             cum += c
@@ -176,6 +201,7 @@ class Histogram:
             "mean": (s / total) if total else None,
             **quantiles,
             "buckets": list(zip(self.bounds, cum_counts)),
+            "exemplar": exemplar,
         }
 
     @property
@@ -247,16 +273,21 @@ class Registry:
                     m._counts = [0] * (len(m.bounds) + 1)
                     m._sum = 0.0
                     m._count = 0
+                    m._ex_value = None
+                    m._ex_id = None
                 else:
                     m._value = 0.0
 
-    def snapshot(self) -> dict:
+    def snapshot(self, reset_exemplars: bool = False) -> dict:
         """{'counters': {name: v}, 'gauges': {name: v},
         'histograms': {name: Histogram.snapshot()}, 'help': {name:
         text}} — the one shape every exporter (JSONL record, .prom
         file, obs_report) reads. ``help`` carries only non-empty
         strings (export.prometheus_text renders them as # HELP lines;
-        the JSONL exporter drops the map to keep records one line)."""
+        the JSONL exporter drops the map to keep records one line).
+        ``reset_exemplars=True`` is reserved for the telemetry flush —
+        it closes each histogram's exemplar window (see
+        Histogram.snapshot)."""
         with self._lock:
             metrics = list(self._metrics.values())
         out: dict = {"counters": {}, "gauges": {}, "histograms": {},
@@ -267,7 +298,9 @@ class Registry:
             elif isinstance(m, Gauge):
                 out["gauges"][m.name] = m.value
             elif isinstance(m, Histogram):
-                out["histograms"][m.name] = m.snapshot()
+                out["histograms"][m.name] = m.snapshot(
+                    reset_exemplar=reset_exemplars
+                )
             if getattr(m, "help", ""):
                 out["help"][m.name] = m.help
         return out

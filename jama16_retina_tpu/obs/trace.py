@@ -42,8 +42,98 @@ import time
 _ids = itertools.count(1)
 
 
-def next_trace_id() -> int:
-    return next(_ids)
+class TraceContext:
+    """Serializable distributed-trace identity (ISSUE 15).
+
+    A bare process-local counter would be good enough for one
+    process's timeline, aliasing the moment two processes' traces are
+    stitched into one fleet view. A TraceContext's id is minted
+    ``"<origin_pid>-<n>"`` so it is unique ACROSS the fleet, and the
+    context serializes to a plain dict (``wire()``/``from_wire``) small
+    enough to ride any existing seam: a router request, the lifecycle
+    journal's DRIFT_DETECTED entry, a future RPC header. Events in any
+    process that carry the same ``trace_id`` arg belong to the same
+    logical request/cycle, which is exactly what the stitched Chrome
+    trace groups on."""
+
+    __slots__ = ("trace_id", "parent", "origin_pid")
+
+    def __init__(self, trace_id: "str | None" = None,
+                 parent: "str | None" = None,
+                 origin_pid: "int | None" = None):
+        self.origin_pid = (int(origin_pid) if origin_pid is not None
+                           else os.getpid())
+        self.trace_id = (str(trace_id) if trace_id is not None
+                         else f"{self.origin_pid}-{next(_ids)}")
+        self.parent = parent
+
+    def child(self, parent: str) -> "TraceContext":
+        """Same trace, one nesting level deeper (``parent`` names the
+        span the callee's events hang under)."""
+        return TraceContext(self.trace_id, parent=parent,
+                            origin_pid=self.origin_pid)
+
+    def wire(self) -> dict:
+        """The serializable form every propagation seam carries."""
+        out = {"trace_id": self.trace_id, "origin_pid": self.origin_pid}
+        if self.parent:
+            out["parent"] = self.parent
+        return out
+
+    @classmethod
+    def from_wire(cls, d: "dict | None") -> "TraceContext | None":
+        """None-tolerant inverse of ``wire()`` (a seam without a
+        context — a legacy journal entry, a bare submit — propagates
+        nothing rather than crashing)."""
+        if not isinstance(d, dict) or "trace_id" not in d:
+            return None
+        return cls(trace_id=d["trace_id"], parent=d.get("parent"),
+                   origin_pid=d.get("origin_pid"))
+
+
+def new_context() -> TraceContext:
+    return TraceContext()
+
+
+# Thread-local ambient context: lets a deep callee (the EscalationPool
+# behind a CascadeEngine behind a router replica) stamp the request's
+# trace_id without threading a parameter through three layers that
+# predate distributed tracing.
+_ctx_local = threading.local()
+
+
+def current_context() -> "TraceContext | None":
+    return getattr(_ctx_local, "ctx", None)
+
+
+def set_context(ctx: "TraceContext | None") -> "TraceContext | None":
+    """Install ``ctx`` as this thread's ambient context; returns the
+    previous one so callers can restore it."""
+    prev = getattr(_ctx_local, "ctx", None)
+    _ctx_local.ctx = ctx
+    return prev
+
+
+class use_context:
+    """``with use_context(ctx): ...`` — scoped ambient-context install
+    (None installs nothing and restores nothing: a bin carrying rows
+    of several requests has no single context to claim)."""
+
+    __slots__ = ("_ctx", "_prev", "_installed")
+
+    def __init__(self, ctx: "TraceContext | None"):
+        self._ctx = ctx
+        self._installed = False
+
+    def __enter__(self) -> "use_context":
+        if self._ctx is not None:
+            self._prev = set_context(self._ctx)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            set_context(self._prev)
 
 
 class _Ring:
@@ -138,7 +228,12 @@ class Tracer:
         self._local = threading.local()
         # Export epoch: ts are published relative to tracer creation so
         # Chrome timelines start near 0 instead of at host uptime.
+        # ``epoch_unix`` is the WALL-CLOCK moment of that same epoch —
+        # what lets the fleet stitcher (obs/fleet.py) align timelines
+        # from different processes (each perf_counter has a private
+        # zero) onto one axis.
         self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
         self._gen = 0
 
     def _ring(self) -> _Ring:
@@ -203,6 +298,7 @@ class Tracer:
             self._gen += 1
             self._rings = {}
         self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
 
     def clear(self) -> None:
         self.configure()

@@ -54,6 +54,15 @@ class DeadlineExceeded(TimeoutError):
     submitter thread — the submit itself succeeded)."""
 
 
+def _submit_trace_id() -> str:
+    """The request's fleet-unique trace id, captured on the submitter
+    thread: adopt the ambient TraceContext when the caller (a router
+    dispatch) installed one — the batcher's segments then join that
+    request's trace — else mint a fresh ``"<pid>-<n>"`` id."""
+    ctx = obs_trace.current_context()
+    return ctx.trace_id if ctx is not None else obs_trace.new_context().trace_id
+
+
 @dataclass
 class _Request:
     rows: np.ndarray
@@ -65,7 +74,10 @@ class _Request:
     # Request-scoped trace id (ISSUE 4): assigned at submit, rides the
     # request through window fill -> flush -> engine forward -> future
     # resolution, so its latency decomposes into named trace segments.
-    trace_id: int = field(default_factory=obs_trace.next_trace_id)
+    # Fleet-unique (ISSUE 15): a bare process-local int would alias
+    # across pid lanes the moment two servers' exemplars merge in one
+    # fleet view.
+    trace_id: str = field(default_factory=_submit_trace_id)
     # monotonic time the worker popped this request off the queue (end
     # of its queue-wait segment, start of its window-fill segment).
     t_pop: float = 0.0
@@ -423,7 +435,10 @@ class MicroBatcher:
                 # request from poisoning its co-riders' futures.
                 try:
                     w.future.set_result(out[lo:hi])
-                    self._h_latency.observe(now - w.t_submit)
+                    # Exemplar (ISSUE 15): each flush window's slowest
+                    # request rides out through telemetry by trace_id.
+                    self._h_latency.observe(now - w.t_submit,
+                                            exemplar=w.trace_id)
                     if tr.enabled:
                         args = {
                             "trace_id": w.trace_id,

@@ -145,7 +145,8 @@ class CompileCache:
         self.g_load_sec = reg.gauge(
             "serve.compile_cache.load_sec",
             help="summed deserialize seconds of the last engine "
-                 "warm-up's cache loads (the warm-restart bill)",
+                 "warm-up's cache loads (the warm-restart bill) "
+                 "[fleet:max]",
         )
         self._check_or_write_manifest()
 

@@ -297,7 +297,7 @@ class ServingEngine:
         self._g_generation = self.registry.gauge(
             "serve.generation",
             help="currently-serving model generation (0 = the "
-                 "construction-time checkpoint set)",
+                 "construction-time checkpoint set) [fleet:max]",
         )
         # Lifecycle seams (ISSUE 8): instant rollback off the retained
         # previous generation, and the shadow-scoring session a staged
@@ -324,7 +324,7 @@ class ServingEngine:
         self._g_shadow_dev = self.registry.gauge(
             "serve.shadow.max_abs_dev",
             help="running max |candidate - live| score deviation over "
-                 "the current shadow session",
+                 "the current shadow session [fleet:max]",
         )
         self._prev_gen: "_Generation | None" = None
         self._prev_gen_t: float = 0.0
@@ -378,7 +378,8 @@ class ServingEngine:
             help="seconds from engine construction to every bucket "
                  "executable ready (cache-warmed restarts are the "
                  "serve_warm_start_sec story; 0 = no compile cache "
-                 "configured, first request pays the compile)",
+                 "configured, first request pays the compile) "
+                 "[fleet:max]",
         )
         # Generation 0: the construction-time checkpoint set. Without a
         # compile cache it is built unwarmed — the first request
@@ -1084,9 +1085,20 @@ class ServingEngine:
             alerts = obs_alerts.manager_for(
                 self.cfg, workdir, registry=self.registry
             )
-        return obs_export.Snapshotter(
+        # Fleet segment bus (ISSUE 15): serving sessions publish under
+        # the "server" role when obs.fleet_dir is set (None = one
+        # branch per flush); obs.http_port opts into the live
+        # /metrics + /healthz endpoint.
+        from jama16_retina_tpu.obs import fleet as obs_fleet
+
+        snap = obs_export.Snapshotter(
             self.registry, workdir,
             every_s=(every_s if every_s is not None
                      else self.cfg.obs.flush_every_s),
             alerts=alerts,
+            fleet=obs_fleet.bus_for(self.cfg, "server",
+                                    registry=self.registry),
         )
+        if self.cfg.obs.http_port > 0:
+            snap.serve_http(self.cfg.obs.http_port)
+        return snap

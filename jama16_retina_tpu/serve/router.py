@@ -118,7 +118,8 @@ class EscalationPool:
     flight. Escalated rows are counted (``serve.router.escalations``)
     so the 1/k economics stay measurable."""
 
-    def __init__(self, engines, registry: "obs_registry.Registry | None" = None):
+    def __init__(self, engines, registry: "obs_registry.Registry | None" = None,
+                 tracer: "obs_trace.Tracer | None" = None):
         if not engines:
             raise ValueError("EscalationPool needs at least one engine")
         self._engines = list(engines)
@@ -126,6 +127,8 @@ class EscalationPool:
         self._lock = threading.Lock()
         reg = (registry if registry is not None
                else obs_registry.default_registry())
+        self._tracer = (tracer if tracer is not None
+                        else obs_trace.default_tracer())
         self._c_rows = reg.counter(
             "serve.router.escalations",
             help="rows escalated through the shared full-ensemble pool "
@@ -148,8 +151,19 @@ class EscalationPool:
                 range(len(self._engines)), key=lambda i: self._in_flight[i]
             )
             self._in_flight[idx] += n
+        # Distributed-trace seam (ISSUE 15): the escalation happens two
+        # layers below submit() (replica worker -> CascadeEngine ->
+        # here), on whatever thread the replica runs — the AMBIENT
+        # context installed by the worker identifies the request, so
+        # the escalate event carries its trace_id and the stitched
+        # timeline shows exactly which request paid the full ensemble.
+        ctx = obs_trace.current_context()
+        args = {"rows": n, "pool_member": idx}
+        if ctx is not None:
+            args["trace_id"] = ctx.trace_id
         try:
-            out = self._engines[idx].probs(images)
+            with self._tracer.trace("serve.router.escalate", args=args):
+                out = self._engines[idx].probs(images)
         finally:
             with self._lock:
                 self._in_flight[idx] -= n
@@ -161,11 +175,24 @@ class _Replica:
     """One in-process replica handle: an engine, its dispatch queue +
     worker thread, and the accounting the router's policy reads. All
     mutable counters are guarded by the ROUTER's lock (one lock
-    hierarchy; the replica only owns its queue)."""
+    hierarchy; the replica only owns its queue).
+
+    Per-replica metric attribution (ISSUE 15 satellite): each replica
+    owns a LABELED ``serve.replica{N}.*`` namespace — rows/dispatches/
+    failures counters plus an in-flight gauge — instead of muddling
+    into the shared ``serve.router.*`` family, so the fleet aggregator
+    (and any scraper) can blame a slow or sick replica by name. The
+    newest REPLICA_ROWS_KEEP replica namespaces stay exported (the
+    scaler churns replicas; the registry must not grow forever)."""
+
+    # The labeled namespace's member metrics, retired together when the
+    # replica id ages out of REPLICA_ROWS_KEEP.
+    NAMESPACE_METRICS = ("rows", "dispatches", "failures",
+                         "in_flight_rows")
 
     __slots__ = ("rid", "engine", "state", "queue", "in_flight_rows",
                  "rows", "window_rows", "buckets_served", "thread",
-                 "c_rows")
+                 "c_rows", "c_dispatches", "c_failures", "g_in_flight")
 
     def __init__(self, rid: int, engine, registry):
         self.rid = rid
@@ -178,10 +205,25 @@ class _Replica:
         self.buckets_served: set = set()
         self.thread: "threading.Thread | None" = None
         self.c_rows = registry.counter(
-            f"serve.router.replica{rid}.rows",
+            f"serve.replica{rid}.rows",
             help="rows served by this router replica (per-replica "
                  "ledger; response attribution pairs it with the "
                  "generation id)",
+        )
+        self.c_dispatches = registry.counter(
+            f"serve.replica{rid}.dispatches",
+            help="dispatch bins this replica scored",
+        )
+        self.c_failures = registry.counter(
+            f"serve.replica{rid}.failures",
+            help="dispatch failures on this replica (nonzero = the "
+                 "replica was marked FAILED and its bins moved to "
+                 "siblings)",
+        )
+        self.g_in_flight = registry.gauge(
+            f"serve.replica{rid}.in_flight_rows",
+            help="rows queued or scoring on this replica right now "
+                 "(the least_in_flight policy's per-replica input)",
         )
 
     def score(self, rows: np.ndarray) -> "tuple[np.ndarray, int]":
@@ -198,8 +240,9 @@ class _Request:
     reassembly state its bins complete into."""
 
     __slots__ = ("rows", "n", "priority", "future", "t_submit",
-                 "t_deadline", "trace_id", "offset", "parts",
-                 "parts_done", "results", "segments", "failed")
+                 "t_deadline", "ctx", "trace_id", "offset", "parts",
+                 "parts_done", "results", "segments", "failed",
+                 "t_first_score", "t_done_score")
 
     def __init__(self, rows: np.ndarray, priority: str,
                  t_deadline: "float | None"):
@@ -209,13 +252,23 @@ class _Request:
         self.future: Future = Future()
         self.t_submit = time.monotonic()
         self.t_deadline = t_deadline
-        self.trace_id = obs_trace.next_trace_id()
+        # Fleet-unique trace context (ISSUE 15): minted at submit,
+        # propagated to the replica (ambient, single-request bins) and
+        # through it to the EscalationPool — the id the stitched trace
+        # and the latency histogram's exemplar both carry.
+        self.ctx = obs_trace.new_context()
+        self.trace_id = self.ctx.trace_id
         self.offset = 0        # rows binned so far (router lock)
         self.parts = 0         # bins carrying this request's rows
         self.parts_done = 0
         self.results: dict = {}    # req-row offset -> scored rows
         self.segments: list = []   # attribution, in completion order
         self.failed = False
+        # Request-segment stamps (router lock): first bin scoring
+        # start / last bin scoring end — with t_submit and the resolve
+        # time they tile the request's observed latency exactly.
+        self.t_first_score: "float | None" = None
+        self.t_done_score: "float | None" = None
 
 
 class _Bin:
@@ -369,7 +422,7 @@ class Router:
             "serve.router.imbalance",
             help="per-window max/mean completed-row ratio across active "
                  "replicas (1.0 = perfectly balanced; the "
-                 "router_imbalance alert reads this)",
+                 "router_imbalance alert reads this) [fleet:max]",
         )
         self._h_latency = reg.histogram(
             "serve.router.request_latency_s",
@@ -393,7 +446,7 @@ class Router:
             "serve.scaler.saturated",
             help="1 while the scaler wants MORE than "
                  "serve.scaler_max_replicas allows (the "
-                 "scaler_saturated alert reads this)",
+                 "scaler_saturated alert reads this) [fleet:max]",
         )
         self._c_decisions = reg.counter(
             "serve.scaler.decisions",
@@ -465,7 +518,8 @@ class Router:
         if retire >= 0 and not any(
                 r.rid == retire and r.state in (ACTIVE, DRAINING)
                 for r in self._replicas):
-            self.registry.remove(f"serve.router.replica{retire}.rows")
+            for metric in _Replica.NAMESPACE_METRICS:
+                self.registry.remove(f"serve.replica{retire}.{metric}")
         rep = _Replica(self._next_rid, engine, self.registry)
         self._next_rid += 1
         self._replicas.append(rep)
@@ -699,6 +753,7 @@ class Router:
             rep = self._choose_replica_locked(reps, b)
             b.tried.add(rep.rid)
             rep.in_flight_rows += b.rows.shape[0]
+            rep.g_in_flight.set(rep.in_flight_rows)
             self._in_flight_rows += b.rows.shape[0]
             self._c_dispatches.inc()
             out.append((rep, b))
@@ -778,12 +833,22 @@ class Router:
             if item is _STOP:
                 return
             b: _Bin = item
+            t0 = time.monotonic()
+            # Ambient trace context (ISSUE 15): a bin carrying exactly
+            # one request's rows propagates that request's context into
+            # the replica engine (and through a CascadeEngine to the
+            # EscalationPool) — a multi-request bin has no single
+            # context to claim, so it installs none.
+            ctxs = {id(req): req.ctx for req, _lo, _hi in b.parts}
+            bin_ctx = (next(iter(ctxs.values()))
+                       if len(ctxs) == 1 else None)
             try:
                 # Fault seam (obs/faultinject.py "serve.router.dispatch"):
                 # one global read + branch unarmed; the --chaos drill
                 # injects a replica death here mid-storm.
                 faultinject.check("serve.router.dispatch")
-                out, gen = rep.score(b.rows)
+                with obs_trace.use_context(bin_ctx):
+                    out, gen = rep.score(b.rows)
                 if out.shape[0] != b.rows.shape[0]:
                     raise RuntimeError(
                         f"replica {rep.rid} returned {out.shape[0]} rows "
@@ -795,14 +860,16 @@ class Router:
                 if rep.state == FAILED:
                     return
                 continue
-            self._complete_bin(rep, b, out, gen)
+            self._complete_bin(rep, b, out, gen, t0)
 
     def _complete_bin(self, rep: "_Replica", b: "_Bin",
-                      out: np.ndarray, gen: int) -> None:
+                      out: np.ndarray, gen: int, t0: float) -> None:
         n = int(b.rows.shape[0])
         done = []
+        t_done = time.monotonic()
         with self._work:
             rep.in_flight_rows -= n
+            rep.g_in_flight.set(rep.in_flight_rows)
             rep.rows += n
             rep.window_rows += n
             rep.buckets_served.add(b.bucket)
@@ -813,6 +880,8 @@ class Router:
                 seg = out[lo:lo + (req_hi - req_lo)]
                 lo += req_hi - req_lo
                 req.results[req_lo] = seg
+                if req.t_first_score is None or t0 < req.t_first_score:
+                    req.t_first_score = t0
                 req.segments.append({
                     "lo": req_lo, "hi": req_hi,
                     "replica": rep.rid, "generation": int(gen),
@@ -820,11 +889,14 @@ class Router:
                 req.parts_done += 1
                 if (req.offset >= req.n and req.parts_done == req.parts
                         and not req.failed):
+                    req.t_done_score = t_done
                     done.append(req)
             self._maybe_finish_drain_locked(rep)
             self._work.notify_all()
         rep.c_rows.inc(n)
+        rep.c_dispatches.inc()
         now = time.monotonic()
+        tr = obs_trace.default_tracer()
         for req in done:
             pieces = [req.results[k] for k in sorted(req.results)]
             result = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
@@ -832,9 +904,27 @@ class Router:
             req.future.segments = req.segments
             try:
                 req.future.set_result(result)
-                self._h_latency.observe(now - req.t_submit)
+                lat = now - req.t_submit
+                # Exemplar (ISSUE 15): the flush window's slowest
+                # request carries its trace_id out through telemetry,
+                # so an SLO breach links straight to the trace.
+                self._h_latency.observe(lat, exemplar=req.trace_id)
+                if tr.enabled:
+                    # Three complete events tiling [t_submit, now)
+                    # exactly — the router twin of the batcher's
+                    # request segments, same monotonic clock as the
+                    # latency observation (pinned in tests).
+                    args = {"trace_id": req.trace_id, "rows": req.n,
+                            "priority": req.priority}
+                    tr.complete("serve.router.request.queue_wait",
+                                req.t_submit, req.t_first_score, args)
+                    tr.complete("serve.router.request.device",
+                                req.t_first_score, req.t_done_score,
+                                args)
+                    tr.complete("serve.router.request.resolve",
+                                req.t_done_score, now, args)
                 with self._work:
-                    self._window_lat.append(now - req.t_submit)
+                    self._window_lat.append(lat)
             except InvalidStateError:
                 pass
 
@@ -850,6 +940,7 @@ class Router:
             if rep.state in (ACTIVE, DRAINING):
                 rep.state = FAILED
                 self._c_replica_failures.inc()
+                rep.c_failures.inc()
                 self._update_replica_gauges_locked()
                 absl_logging.error(
                     "router replica %d failed dispatching %d rows "
@@ -891,11 +982,13 @@ class Router:
                 target = self._choose_replica_locked(reps, mb)
                 mb.tried.add(target.rid)
                 target.in_flight_rows += n
+                target.g_in_flight.set(target.in_flight_rows)
                 self._c_retried.inc()
                 # Under the lock for the same reason as the tick-loop
                 # puts: the target must not fail-and-drain between
                 # selection and enqueue.
                 target.queue.put(mb)
+            rep.g_in_flight.set(max(0, rep.in_flight_rows))
             self._g_in_flight_rows.set(self._in_flight_rows)
             self._work.notify_all()
         for req in orphaned_reqs:

@@ -90,10 +90,19 @@ def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str,
             alerts = obs_alerts.AlertManager(
                 rules, registry=reg, flight=flight
             )
+        # Fleet segment bus (ISSUE 15): a trainer with obs.fleet_dir
+        # set publishes its snapshots/heartbeat/trace rings into the
+        # shared fleet dir under the "trainer" role; bus_for returns
+        # None (one branch per flush) when the plane is off.
+        from jama16_retina_tpu.obs import fleet as obs_fleet
+
         snap = obs_export.Snapshotter(
             reg, workdir, runlog=log, every_s=cfg.obs.flush_every_s,
-            alerts=alerts,
+            alerts=alerts, fleet=obs_fleet.bus_for(cfg, "trainer",
+                                                   registry=reg),
         )
+        if cfg.obs.http_port > 0:
+            snap.serve_http(cfg.obs.http_port)
     return reg, stalls, snap
 
 

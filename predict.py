@@ -267,10 +267,20 @@ def main(argv):
     if _OBS_WORKDIR.value:
         from jama16_retina_tpu.obs import alerts as obs_alerts
         from jama16_retina_tpu.obs import export as obs_export
+        from jama16_retina_tpu.obs import fleet as obs_fleet
 
+        # Fleet segment bus (ISSUE 15): a predict session joins the
+        # fleet dir under the "router" role when it fronts replicas,
+        # "server" otherwise; obs.http_port opts into /metrics +
+        # /healthz for the session's lifetime.
         snap = obs_export.Snapshotter(
             workdir=_OBS_WORKDIR.value, every_s=cfg.obs.flush_every_s,
+            fleet=obs_fleet.bus_for(
+                cfg, "router" if _REPLICAS.value > 0 else "server"
+            ),
         )
+        if cfg.obs.http_port > 0:
+            snap.serve_http(cfg.obs.http_port)
         snap.progress(0)
         # Quality/SLO alerting for batch jobs (ISSUE 5): attached
         # BEFORE any scoring on BOTH backends, so rules are evaluated
@@ -464,20 +474,39 @@ def main(argv):
             ))
         if _REPLICAS.value > 0:
             pass  # probs computed through the router above
-        elif snap is None:
-            probs = engine.probs(pre.images)
         else:
-            # Per-block calls so heartbeats advance DURING a long batch.
-            # Identical math to one call: engine.probs chunks at
-            # max_batch internally, and these blocks are exactly the
-            # chunks it would form (ensemble averaging is row-wise).
-            blocks = []
-            for i in range(0, len(kept), _BATCH.value):
-                blocks.append(engine.probs(pre.images[i:i + _BATCH.value]))
-                snap.progress(i + blocks[-1].shape[0])
-                snap.maybe_flush()
-            probs = (blocks[0] if len(blocks) == 1
-                     else np.concatenate(blocks))
+            # predict → engine trace propagation (ISSUE 15): the CLI
+            # batch mints ONE context; each scored block lands in the
+            # timeline as a `predict.block` complete event carrying
+            # its trace_id, and the ambient context identifies the
+            # batch inside the engine (and any escalation below it).
+            from jama16_retina_tpu.obs import trace as obs_trace
+
+            tracer = obs_trace.default_tracer()
+            ctx = obs_trace.new_context()
+            with obs_trace.use_context(ctx):
+                if snap is None:
+                    with tracer.trace("predict.block", args={
+                            "trace_id": ctx.trace_id,
+                            "rows": int(pre.images.shape[0])}):
+                        probs = engine.probs(pre.images)
+                else:
+                    # Per-block calls so heartbeats advance DURING a
+                    # long batch. Identical math to one call:
+                    # engine.probs chunks at max_batch internally, and
+                    # these blocks are exactly the chunks it would
+                    # form (ensemble averaging is row-wise).
+                    blocks = []
+                    for i in range(0, len(kept), _BATCH.value):
+                        block = pre.images[i:i + _BATCH.value]
+                        with tracer.trace("predict.block", args={
+                                "trace_id": ctx.trace_id,
+                                "rows": int(block.shape[0])}):
+                            blocks.append(engine.probs(block))
+                        snap.progress(i + blocks[-1].shape[0])
+                        snap.maybe_flush()
+                    probs = (blocks[0] if len(blocks) == 1
+                             else np.concatenate(blocks))
 
     for p, pr, qual in zip(kept, probs, qualities):
         if cfg.model.head != "binary":

@@ -17,6 +17,13 @@
 #       4-device assembled engine, 2 train steps under pjit+LAMB, and
 #       the golden-curve recipe gate firing on a poisoned reference —
 #       scripts/mesh_smoke.py.
+#   bash scripts/ci_checks.sh --fleet-smoke
+#       lint + the fleet observability smoke (ISSUE 15): 3 real
+#       concurrent processes (train smoke, predict server, lifecycle
+#       --watch) into one fleet dir, asserting the merged report
+#       (counters == sum, pinned), fresh fleet heartbeats, a stitched
+#       multi-lane Chrome trace, and --check-fleet exit codes —
+#       scripts/fleet_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -47,6 +54,12 @@ fi
 if [[ "${1:-}" == "--mesh-smoke" ]]; then
     echo "== pod-scale mesh smoke (assemble + pjit+LAMB + recipe gate) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/mesh_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--fleet-smoke" ]]; then
+    echo "== fleet observability smoke (3-process segment bus) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/fleet_smoke.py
     exit 0
 fi
 

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""CI fleet smoke (ISSUE 15 satellite; scripts/ci_checks.sh
+--fleet-smoke): drive THREE real concurrent processes — a smoke
+trainer, a predict server, and a lifecycle --watch supervisor — into
+one shared fleet dir, then assert the fleet plane end to end:
+
+  1. every process published a segment stream under its role
+     (trainer / server / lifecycle), each with a fresh heartbeat
+     (`obs_report --check-heartbeats <fleet_dir>` exits 0);
+  2. the merged report is KIND-CORRECT: merged counters equal the sum
+     of the newest per-process segments (recomputed independently
+     here, not trusted from the report);
+  3. `obs_report --trace-out` stitches ONE Chrome trace spanning >= 2
+     process (pid) lanes;
+  4. `--check-fleet` exit codes: a rule the merged view satisfies
+     exits 1, a quiet rule exits 0.
+
+Exit 0 = every step held; 1 = a step failed (message says which).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    import numpy as np
+
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    report = os.path.join(_REPO, "scripts", "obs_report.py")
+    lifecycle = os.path.join(_REPO, "scripts", "lifecycle_run.py")
+
+    def run(*args, timeout=300) -> "subprocess.CompletedProcess":
+        return subprocess.run(
+            [sys.executable, *args], capture_output=True, text=True,
+            env=env, timeout=timeout,
+        )
+
+    with tempfile.TemporaryDirectory() as root:
+        fleet = os.path.join(root, "fleet")
+        data = os.path.join(root, "data")
+        os.makedirs(fleet, exist_ok=True)
+
+        # Seed: a random-init smoke checkpoint (predict's contract is
+        # plumbing, not accuracy) + synthetic fundus photos.
+        import cv2
+        import jax
+
+        from jama16_retina_tpu import models, train_lib
+        from jama16_retina_tpu.configs import get_config, override
+        from jama16_retina_tpu.data import synthetic
+        from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+        cfg = override(get_config("smoke"), ["model.image_size=64"])
+        model = models.build(cfg.model)
+        state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+        ckdir = os.path.join(root, "ckpt")
+        ck = ckpt_lib.Checkpointer(ckdir)
+        ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+        ck.wait()
+        ck.close()
+        imgdir = os.path.join(root, "imgs")
+        os.makedirs(imgdir)
+        for i in range(6):
+            img = synthetic.render_fundus(
+                np.random.default_rng(i), i % 5,
+                synthetic.SynthConfig(image_size=96),
+            )
+            cv2.imwrite(os.path.join(imgdir, f"eye_{i}.jpeg"),
+                        img[..., ::-1])
+
+        fleet_set = [
+            "--set", f"obs.fleet_dir={fleet}",
+            "--set", "obs.flush_every_s=1",
+        ]
+        # 1) trainer (role "trainer"): a real smoke fit on synthetic
+        #    TFRecords, flushing fleet segments every second.
+        p_train = subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "train.py"),
+             "--config=smoke", "--synthetic=96", f"--data_dir={data}",
+             f"--workdir={os.path.join(root, 'wd_train')}",
+             "--device=cpu", *fleet_set,
+             "--set", "train.steps=30", "--set", "train.eval_every=15",
+             "--set", "train.log_every=5"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        # 2) predict server (role "server"): scores the photo batch
+        #    with telemetry + fleet segments into its own workdir.
+        p_srv = subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "predict.py"),
+             "--config=smoke", "--set", "model.image_size=64",
+             f"--checkpoint_dir={ckdir}", "--images", imgdir,
+             "--device=cpu", "--batch_size=4",
+             f"--obs_workdir={os.path.join(root, 'wd_srv')}",
+             *fleet_set],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        # 3) lifecycle --watch supervisor (role "lifecycle"): idles on
+        #    an empty journal, heartbeating into the fleet dir until
+        #    terminated.
+        p_watch = subprocess.Popen(
+            [sys.executable, lifecycle,
+             f"--workdir={os.path.join(root, 'wd_lc')}",
+             f"--data_dir={data}", "--ckpt", ckdir,
+             "--config=smoke", "--watch", "--poll_s=0.5",
+             *[a for a in fleet_set]],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        try:
+            srv_out, _ = p_srv.communicate(timeout=600)
+            train_out, _ = p_train.communicate(timeout=600)
+        finally:
+            # The supervisor runs until told otherwise; SIGINT is its
+            # documented clean stop (journal resumes it).
+            p_watch.send_signal(signal.SIGINT)
+            try:
+                watch_out, _ = p_watch.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p_watch.kill()
+                watch_out, _ = p_watch.communicate()
+        if p_train.returncode != 0:
+            print(f"FAIL: trainer exited {p_train.returncode}\n{train_out}")
+            return 1
+        if p_srv.returncode != 0:
+            print(f"FAIL: predict server exited {p_srv.returncode}"
+                  f"\n{srv_out}")
+            return 1
+
+        from jama16_retina_tpu.obs import fleet as fleet_lib
+
+        streams = fleet_lib.read_fleet(fleet)
+        roles = sorted({role for role, _pid in streams})
+        if not {"trainer", "server", "lifecycle"} <= set(roles):
+            print(f"FAIL: expected trainer/server/lifecycle streams, "
+                  f"got {roles}\n--watch output:\n{watch_out}")
+            return 1
+
+        # 2) merged == sum of per-process snapshots, recomputed here.
+        merged, meta = fleet_lib.fleet_snapshot(fleet)
+        newest = {
+            key: proc["segments"][-1]["snapshot"]
+            for key, proc in (
+                (f"{r}-p{p}", v) for (r, p), v in streams.items()
+            )
+            if proc["segments"]
+        }
+        for name, total in merged["counters"].items():
+            expect = sum(
+                s.get("counters", {}).get(name, 0.0)
+                for s in newest.values()
+            )
+            if abs(total - expect) > 1e-6:
+                print(f"FAIL: merged counter {name}={total} != "
+                      f"sum(per-process)={expect}")
+                return 1
+        print(f"merged==sum held over {len(merged['counters'])} "
+              f"counters from {len(newest)} processes")
+
+        # 1b) fleet heartbeats fresh, naming every role.
+        r = run(report, "--check-heartbeats", fleet, "--max-age-s", "300")
+        if r.returncode != 0:
+            print(f"FAIL: fleet --check-heartbeats exit {r.returncode}"
+                  f"\n{r.stdout}{r.stderr}")
+            return 1
+
+        # 3) stitched trace spans >= 2 process lanes.
+        chrome = os.path.join(root, "fleet_trace.json")
+        r = run(report, fleet, "--trace-out", chrome)
+        if r.returncode != 0:
+            print(f"FAIL: --trace-out exit {r.returncode}\n"
+                  f"{r.stdout}{r.stderr}")
+            return 1
+        with open(chrome) as f:
+            events = json.load(f)["traceEvents"]
+        pids = {e.get("pid") for e in events if e.get("ph") != "M"}
+        if len(pids) < 2:
+            print(f"FAIL: stitched trace has {len(pids)} pid lane(s), "
+                  "wanted >= 2")
+            return 1
+        print(f"stitched trace: {len(events)} events across "
+              f"{len(pids)} pid lanes")
+
+        # 4) --check-fleet exit codes, both directions.
+        r = run(report, "--check-fleet", fleet,
+                "--fleet-rule", "obs.fleet.segments >= 1")
+        if r.returncode != 1:
+            print(f"FAIL: firing fleet rule exited {r.returncode} "
+                  f"(wanted 1)\n{r.stdout}{r.stderr}")
+            return 1
+        r = run(report, "--check-fleet", fleet,
+                "--fleet-rule", "obs.fleet.segments >= 1e12")
+        if r.returncode != 0:
+            print(f"FAIL: quiet fleet rule exited {r.returncode} "
+                  f"(wanted 0)\n{r.stdout}{r.stderr}")
+            return 1
+        r = run(report, "--fleet", fleet, "--json")
+        if r.returncode != 0:
+            print(f"FAIL: --fleet report exit {r.returncode}\n"
+                  f"{r.stdout}{r.stderr}")
+            return 1
+        doc = json.loads(r.stdout)
+        if len(doc["processes"]) < 3:
+            print(f"FAIL: --fleet report saw only "
+                  f"{len(doc['processes'])} processes")
+            return 1
+
+    print("OK: 3-process fleet drill — segment streams per role, "
+          "merged==sum pinned, heartbeats fresh, stitched multi-lane "
+          "trace, --check-fleet exit codes both ways")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

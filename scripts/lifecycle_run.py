@@ -145,6 +145,7 @@ def main(argv=None) -> int:
         # Journal-only trigger: no engine, no accelerator — safe from a
         # cron job or an alert webhook handler.
         from jama16_retina_tpu.lifecycle import Journal, TERMINAL_STATES
+        from jama16_retina_tpu.obs import trace as obs_trace
 
         journal = Journal(os.path.join(args.workdir, "lifecycle"),
                           terminal_states=TERMINAL_STATES)
@@ -153,12 +154,19 @@ def main(argv=None) -> int:
                   f"{journal.state}")
             return 0
         live = journal.read_live() or list(args.ckpt or ())
+        # Distributed-trace seam (ISSUE 15): the trigger PROCESS mints
+        # the cycle's trace context and serializes it into the journal
+        # entry — the --watch supervisor (a different process) picks it
+        # up, so the stitched fleet trace shows one trace_id spanning
+        # the trigger's pid lane and the retrain's.
+        ctx = obs_trace.new_context()
         journal.append(
             "DRIFT_DETECTED", cycle=journal.cycle + 1,
             reason=args.trigger, live_member_dirs=live or None,
-            source="lifecycle_run",
+            source="lifecycle_run", trace=ctx.wire(),
         )
-        print(f"cycle {journal.cycle} opened (reason={args.trigger})")
+        print(f"cycle {journal.cycle} opened (reason={args.trigger}, "
+              f"trace {ctx.trace_id})")
         return 0
 
     if not (args.step or args.watch):
@@ -188,10 +196,41 @@ def main(argv=None) -> int:
     # read mid-retrain, a momentary restore error) leaves the journal
     # unadvanced by design — the supervisor's job is to KEEP DRIVING,
     # not to die with a traceback and silently end self-healing.
+    #
+    # Fleet observability (ISSUE 15): the supervisor is a long-lived
+    # fleet member, so it exports its own heartbeat/telemetry — into
+    # its OWN lifecycle.jsonl/.prom (never the serving session's
+    # metrics.jsonl: two processes appending one JSONL would tear it)
+    # and, with obs.fleet_dir set, into the shared segment bus under
+    # the "lifecycle" role. A wedged supervisor is then visible from
+    # `obs_report --check-heartbeats <fleet_dir>` like any trainer.
+    snap = None
+    watch_log = None
+    if cfg.obs.enabled:
+        from jama16_retina_tpu.obs import export as obs_export
+        from jama16_retina_tpu.obs import fleet as obs_fleet
+        from jama16_retina_tpu.utils.logging import RunLog
+
+        watch_log = RunLog(args.workdir, name="lifecycle.jsonl")
+        snap = obs_export.Snapshotter(
+            workdir=args.workdir, runlog=watch_log,
+            every_s=min(cfg.obs.flush_every_s, max(1.0, args.poll_s)),
+            prom_name="lifecycle.prom",
+            fleet=obs_fleet.bus_for(cfg, "lifecycle"),
+        )
+        if cfg.obs.http_port > 0:
+            snap.serve_http(cfg.obs.http_port)
     done = 0
+    polls = 0
     try:
         while True:
             ctl.journal.refresh()
+            polls += 1
+            if snap is not None:
+                # Progress = supervisor liveness (poll count): the
+                # heartbeat distinguishes "idle but alive" from wedged.
+                snap.progress(polls)
+                snap.maybe_flush()
             if ctl.journal.cycle_open():
                 try:
                     terminal = ctl.run()
@@ -212,6 +251,11 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         print(f"\nstopped at {ctl.state} (journal resumes it)")
         return 0
+    finally:
+        if snap is not None:
+            snap.close()
+        if watch_log is not None:
+            watch_log.close()
 
 
 if __name__ == "__main__":

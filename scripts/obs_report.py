@@ -36,6 +36,20 @@ than the threshold — a host that still FLUSHES but stopped advancing is
 wedged on a collective, the exact failure the mtime probe missed), and
 2 when no heartbeat exists at all.
 
+Fleet observability plane (ISSUE 15): over a FLEET dir (obs.fleet_dir
+— per-process sealed segment streams),
+
+  python scripts/obs_report.py --fleet <fleet_dir> [--json]
+
+renders the merged cross-process view (counters summed, histograms
+merged bucket-exact, gauges reduced per their help-declared fleet
+reduction with per-process series), ``--check-fleet`` evaluates the
+fleet-scope rules (obs.fleet_rules / --fleet-rule, incl. the
+multi-window burn() form) with exit 0 quiet / 1 firing / 2 blind,
+``--check-heartbeats`` auto-detects fleet dirs and names the
+stale/wedged process (role + pid), and ``--trace-out`` stitches every
+process's trace rings into ONE Chrome trace with pid lanes.
+
 Model-quality observability (ISSUE 5): runs whose registry carried the
 `quality.*` drift gauges additionally render a Quality section
 (score-PSI trend, positive rate, per-stat input PSI, canary status,
@@ -739,13 +753,22 @@ def router_summary(records: list) -> "dict | None":
     def ctr(name):
         return int(counters.get(name, 0))
 
+    # Both replica-counter generations: the labeled serve.replica{R}.*
+    # namespace (ISSUE 15) and the pre-15 serve.router.replica{R}.rows
+    # name, so historical telemetry keeps its per-replica attribution.
     replicas = report.get("replicas") or [
         {
-            "replica": int(k[len("serve.router.replica"):-len(".rows")]),
+            "replica": int(
+                k[len("serve.router.replica" if "router" in k
+                      else "serve.replica"):-len(".rows")]
+            ),
             "rows": int(v),
         }
         for k, v in sorted(counters.items())
-        if k.startswith("serve.router.replica") and k.endswith(".rows")
+        if (k.startswith(("serve.replica", "serve.router.replica"))
+            and k.endswith(".rows")
+            and k[len("serve.router.replica" if "router" in k
+                      else "serve.replica"):-len(".rows")].isdigit())
     ]
     return {
         "dispatch_policy": report.get("dispatch_policy"),
@@ -1356,6 +1379,151 @@ def check_heartbeats(workdir: str, max_age_s: float,
     )
 
 
+# ---------------------------------------------------------------------------
+# Fleet plane: merged cross-process view + fleet-scope rules (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_rules_for(config_name: str, overrides: list,
+                     extra_rules: list) -> list:
+    """The fleet-scope rule set: cfg.obs.fleet_rules (preset +
+    --set overrides) plus every --fleet-rule string, all through the
+    REAL parse_fleet_rule grammar (a half-understood fleet rule is
+    worse than none — same contract as the in-process parser)."""
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.obs import alerts as alerts_lib
+
+    cfg = override(get_config(config_name), overrides or [])
+    rules = list(alerts_lib.fleet_rules(cfg))
+    for text in extra_rules or []:
+        rules.append(alerts_lib.parse_fleet_rule(text))
+    return rules
+
+
+def fleet_report(fleet_dir: str, rules) -> dict:
+    """The --fleet report's machine-readable form: merged snapshot,
+    per-process table, firing fleet rules, corrupt-segment names. The
+    fleet is read (and digest-verified) ONCE; evaluation and the meta
+    table share the parsed dict. write=False: VIEWING the report must
+    not touch the --check-fleet dedupe state (an operator's mid-incident
+    --fleet run with a different rule set would otherwise 'resolve'
+    cron's still-firing rules and re-trigger their blackbox dumps)."""
+    from jama16_retina_tpu.obs import fleet as fleet_lib
+
+    fleet = fleet_lib.read_fleet(fleet_dir)
+    firing, merged = fleet_lib.evaluate_fleet(fleet_dir, rules,
+                                              fleet=fleet, write=False)
+    meta = fleet_lib.fleet_meta(fleet)
+    return {
+        "fleet_dir": fleet_dir,
+        "processes": meta,
+        "merged": {
+            "counters": merged.get("counters", {}),
+            "gauges": merged.get("gauges", {}),
+            "histograms": {
+                name: {k: v for k, v in h.items() if k != "buckets"}
+                for name, h in merged.get("histograms", {}).items()
+            },
+            "unmerged_histograms": sorted(
+                merged.get("unmerged_histograms", {})
+            ),
+        },
+        "gauge_series": merged.get("gauge_series", {}),
+        "firing": firing,
+    }
+
+
+def render_fleet(report: dict, now: "float | None" = None) -> str:
+    now = time.time() if now is None else now
+    out = [f"== Fleet ({report['fleet_dir']}) =="]
+    rows = []
+    for key, m in sorted(report["processes"].items()):
+        hb = m.get("heartbeat") or {}
+        age = (f"{now - m['t']:.0f}s ago"
+               if m.get("t") else "-")
+        if m.get("stale"):
+            age += " STALE (gauges excluded from merge)"
+        rows.append((
+            key, m.get("host_index", "-"), m.get("segments", 0),
+            hb.get("step", "-"), age,
+            (", ".join(m["corrupt"]) if m.get("corrupt") else "-"),
+        ))
+    out.append(_table(rows, ("process", "host", "segments", "step",
+                             "last segment", "corrupt")))
+    merged = report["merged"]
+    if merged["counters"]:
+        out.append("merged counters (fleet sums):\n" + _table(
+            sorted((k, f"{v:g}") for k, v in merged["counters"].items()),
+            ("counter", "fleet total"),
+        ))
+    if merged["gauges"]:
+        series = report.get("gauge_series", {})
+        rows = [
+            (k, f"{v:g}",
+             " ".join(f"{p}={sv:g}"
+                      for p, sv in sorted(series.get(k, {}).items())))
+            for k, v in sorted(merged["gauges"].items())
+        ]
+        out.append("merged gauges (help-declared reduction; "
+                   "per-process series):\n"
+                   + _table(rows, ("gauge", "fleet", "per process")))
+    if merged["histograms"]:
+        rows = []
+        for k, h in sorted(merged["histograms"].items()):
+            ex = h.get("exemplar") or {}
+            rows.append((
+                k, h.get("count", 0), _fmt_hist_value(k, h.get("p50")),
+                _fmt_hist_value(k, h.get("p99")),
+                (f"{ex.get('trace_id')}" if ex else "-"),
+            ))
+        out.append("merged histograms (bucket-exact):\n" + _table(
+            rows, ("histogram", "n", "p50", "p99", "slowest trace"),
+        ))
+    if merged["unmerged_histograms"]:
+        out.append("UNMERGED histograms (bucket bounds differ across "
+                   "processes): " + ", ".join(merged["unmerged_histograms"]))
+    if report["firing"]:
+        rows = [
+            (f["rule"], f.get("reason", "-"),
+             ("-" if f.get("value") is None else f"{f['value']:g}"),
+             f.get("threshold", "-"))
+            for f in report["firing"]
+        ]
+        out.append("FIRING fleet rules:\n" + _table(
+            rows, ("rule", "reason", "value", "threshold"),
+        ))
+    else:
+        out.append("fleet rules: quiet")
+    return "\n\n".join(out)
+
+
+def check_fleet(fleet_dir: str, rules) -> tuple[int, str]:
+    """Exit-code mode mirroring --check-alerts at fleet scope: 0 quiet,
+    1 any fleet-scope rule firing on the MERGED view, 2 blind (nothing
+    ever published, or nothing READABLE — every segment corrupt is a
+    monitor that can see nothing, not a healthy fleet)."""
+    from jama16_retina_tpu.obs import fleet as fleet_lib
+
+    if not fleet_lib.is_fleet_dir(fleet_dir):
+        return 2, (f"no fleet segment streams under {fleet_dir} — "
+                   "point processes at it via obs.fleet_dir (exit 2 = "
+                   "blind, mirroring --check-alerts)")
+    fleet = fleet_lib.read_fleet(fleet_dir)
+    if not any(s["segments"] for s in fleet.values()):
+        corrupt = sum(len(s["corrupt"]) for s in fleet.values())
+        return 2, (f"no readable segments under {fleet_dir} "
+                   f"({corrupt} corrupt) — blind, exit 2")
+    firing, _merged = fleet_lib.evaluate_fleet(fleet_dir, rules,
+                                               fleet=fleet)
+    if firing:
+        return 1, "\n".join(
+            f"FIRING {f['rule']} ({f.get('reason')}): value "
+            f"{f.get('value')} vs {f.get('threshold')}"
+            for f in firing
+        )
+    return 0, f"quiet ({len(rules)} fleet rules evaluated)"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument(
@@ -1383,7 +1551,38 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--trace-out", metavar="CHROME_JSON", default=None,
         help="convert the blackbox/trace dump at PATH to Chrome "
-             "trace-event JSON (open in https://ui.perfetto.dev)",
+             "trace-event JSON (open in https://ui.perfetto.dev). "
+             "When PATH is a FLEET dir (obs.fleet_dir), stitches every "
+             "process's published rings into ONE trace with "
+             "per-process pid lanes, wall-clock aligned",
+    )
+    ap.add_argument(
+        "--fleet", metavar="FLEET_DIR", default=None,
+        help="render the fleet report (ISSUE 15): merged cross-process "
+             "counters/histograms (kind-correct), per-process gauge "
+             "series + heartbeats, and fleet-scope rule state",
+    )
+    ap.add_argument(
+        "--check-fleet", metavar="FLEET_DIR", default=None,
+        help="exit-code mode: 0 quiet, 1 any fleet-scope rule "
+             "(obs.fleet_rules / --fleet-rule) firing on the MERGED "
+             "view, 2 no segments published (blind)",
+    )
+    ap.add_argument(
+        "--fleet-rule", action="append", default=[], metavar="RULE",
+        help="extra fleet-scope rule (obs/alerts.parse_fleet_rule "
+             "grammar, incl. the burn(bad/total, LONG, SHORT) form); "
+             "repeatable, added to the config's obs.fleet_rules",
+    )
+    ap.add_argument(
+        "--config", default="eyepacs_binary",
+        help="config preset supplying obs.fleet_rules for "
+             "--fleet/--check-fleet",
+    )
+    ap.add_argument(
+        "--set", action="append", default=[], dest="overrides",
+        metavar="SECTION.FIELD=VALUE",
+        help="config overrides for --fleet/--check-fleet (repeatable)",
     )
     ap.add_argument(
         "--json", action="store_true",
@@ -1395,9 +1594,36 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.check_heartbeats:
-        code, msg = check_heartbeats(args.check_heartbeats, args.max_age_s)
+        from jama16_retina_tpu.obs import fleet as fleet_lib
+
+        if fleet_lib.is_fleet_dir(args.check_heartbeats):
+            # Fleet mode (ISSUE 15 satellite): heartbeats come from
+            # the segment streams, and a stale/wedged process is named
+            # role + pid while the healthy remainder stays quiet.
+            code, msg = fleet_lib.check_fleet_heartbeats(
+                args.check_heartbeats, args.max_age_s
+            )
+        else:
+            code, msg = check_heartbeats(
+                args.check_heartbeats, args.max_age_s
+            )
         print(msg)
         return code
+    if args.fleet or args.check_fleet:
+        rules = _fleet_rules_for(args.config, args.overrides,
+                                 args.fleet_rule)
+        if args.check_fleet:
+            code, msg = check_fleet(args.check_fleet, rules)
+            print(msg)
+            return code
+        from jama16_retina_tpu.obs import fleet as fleet_lib
+
+        if not fleet_lib.is_fleet_dir(args.fleet):
+            print(f"no fleet segment streams under {args.fleet}")
+            return 2
+        report = fleet_report(args.fleet, rules)
+        print(json.dumps(report) if args.json else render_fleet(report))
+        return 0
     if args.check_alerts:
         code, msg = check_alerts(args.check_alerts)
         print(msg)
@@ -1420,6 +1646,21 @@ def main(argv=None) -> int:
     trace_src = find_trace(args.path)
     events = load_trace_events(trace_src) if trace_src else []
     if args.trace_out:
+        from jama16_retina_tpu.obs import fleet as fleet_lib
+
+        if os.path.isdir(args.path) and fleet_lib.is_fleet_dir(args.path):
+            # Fleet dir: stitch every process's published rings into
+            # ONE Chrome trace with per-process pid lanes (ISSUE 15) —
+            # preferred over any blackbox dump the dir also holds (the
+            # dump is one process's tail; the stitch is the fleet).
+            stitched = fleet_lib.stitch_trace(args.path)
+            if stitched:
+                write_chrome_json(args.trace_out, stitched)
+                pids = sorted({e.get("pid") for e in stitched})
+                print(f"stitched {len(stitched)} events across "
+                      f"{len(pids)} process lanes into {args.trace_out} "
+                      "(load in https://ui.perfetto.dev)")
+                return 0
         if not events:
             print(f"no trace dump found under {args.path}")
             return 2

@@ -231,6 +231,22 @@ _QUICK_TESTS = {
     "test_podscale.py::test_recipe_curve_gate_passes_and_fails_closed",
     "test_podscale.py::test_host_spill_plan_content_invariance",
     "test_podscale.py::test_compile_cache_refuses_resharded_topology",
+    # fleet observability plane (ISSUE 15): the numpy-cheap pins — THE
+    # merged==sum/merge property, bucket-exact histogram merge, the
+    # fleet-scope burn rule firing on the merged view only, heartbeat
+    # blame by role+pid, cross-invocation alert dedupe, the stitched
+    # multi-lane trace, and the socket-level HTTP endpoint; the
+    # 3-process drill lives in scripts/fleet_smoke.py (CI)
+    "test_fleet.py::test_merged_counters_equal_sum_of_processes",
+    "test_fleet.py::test_histogram_merge_bucket_exact_vs_union",
+    "test_fleet.py::test_gauge_reduction_help_tokens_and_per_process_series",
+    "test_fleet.py::test_burn_rule_grammar_and_rejections",
+    "test_fleet.py::test_burn_rule_fires_on_merged_view_only",
+    "test_fleet.py::test_burn_rule_multi_window_requires_both",
+    "test_fleet.py::test_fleet_heartbeats_name_exactly_the_wedged_process",
+    "test_fleet.py::test_evaluate_fleet_dedupes_records_and_dumps",
+    "test_fleet.py::test_stitch_trace_aligns_pid_lanes",
+    "test_fleet.py::test_http_metrics_and_healthz_socket_level",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

@@ -331,6 +331,24 @@ def test_integrity_overhead_guard_pins_two_percent():
     assert extras["integrity_overhead_pct"] == 0.0
 
 
+def test_fleet_overhead_guard_pins_two_percent():
+    """The ISSUE 15 pin, same shared guard math: device_only with the
+    fleet plane's residue (one disabled-bus branch per flush check + a
+    sealed segment publish every 25 steps) must stay within 2% — a
+    process joining the fleet dir must not tax its own hot loop."""
+    extras = {}
+    assert bench._fleet_overhead_guard(extras, 990.0, 1000.0)
+    assert extras["fleet_overhead_ok"] is True
+    assert extras["fleet_overhead_pct"] == pytest.approx(1.0)
+    extras = {}
+    assert not bench._fleet_overhead_guard(extras, 950.0, 1000.0)
+    assert extras["fleet_overhead_ok"] is False
+    assert extras["fleet_overhead_pct"] == pytest.approx(5.0)
+    extras = {}
+    assert bench._fleet_overhead_guard(extras, 1010.0, 1000.0)
+    assert extras["fleet_overhead_pct"] == 0.0
+
+
 def test_router_overhead_guard_pins_two_percent():
     """The ISSUE 12 pin, same shared guard math: the workload routed
     through a 1-replica Router must stay within 2% of calling the

@@ -1,0 +1,825 @@
+"""Fleet observability plane (ISSUE 15; obs/fleet.py + the segment
+bus, distributed trace contexts, burn-rate fleet rules, the HTTP
+endpoint, and the retention/heartbeat satellites).
+
+THE aggregation property — merged == sum/merge of the per-process
+snapshots — is pinned directly (counters sum, histograms merge
+bucket-exact against a union-built reference, gauges reduce by their
+help-declared reduction while keeping per-process series). Fleet-scope
+rules are pinned to fire on the MERGED view where no individual
+process can (split counters; summed rates), with the multi-window
+burn() semantics (both windows must hold) and cross-invocation alert
+dedupe. The router's request segments are pinned to tile the observed
+latency with the escalation event carrying the same trace_id.
+"""
+
+import dataclasses
+import http.client
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import QualityConfig, get_config, override
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.obs import alerts as alerts_lib
+from jama16_retina_tpu.obs import export as export_lib
+from jama16_retina_tpu.obs import fleet as fleet_lib
+from jama16_retina_tpu.obs import trace as trace_lib
+from jama16_retina_tpu.obs.registry import Registry
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(REPO, "scripts", "obs_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_seg(fleet_dir, role, pid, seq, t, counters=None, gauges=None,
+               histograms=None, help_=None, heartbeat=None):
+    """A segment written through the SAME sealed writer the bus uses,
+    with controlled pid/t — what lets one test fabricate a
+    multi-process fleet with deterministic timestamps."""
+    d = os.path.join(fleet_dir, f"{role}-p{pid}")
+    os.makedirs(d, exist_ok=True)
+    artifact_lib.write_sealed_json(
+        os.path.join(d, f"seg-{seq:06d}.json"),
+        {
+            "kind": "fleet_segment", "role": role, "pid": pid,
+            "host_index": 0, "seq": seq, "t": round(float(t), 3),
+            "heartbeat": heartbeat or {},
+            "snapshot": {
+                "counters": counters or {}, "gauges": gauges or {},
+                "histograms": histograms or {}, "help": help_ or {},
+            },
+        },
+        schema=fleet_lib.SEGMENT_SCHEMA,
+        version=fleet_lib.SEGMENT_VERSION,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Segment bus
+# ---------------------------------------------------------------------------
+
+
+def test_segment_publish_roundtrip_and_heartbeat(tmp_path):
+    fd = str(tmp_path / "fleet")
+    reg = Registry()
+    reg.counter("a.rows", help="rows").inc(7)
+    bus = fleet_lib.FleetBus(fd, "trainer", registry=reg,
+                             tracer=trace_lib.Tracer(enabled=False))
+    bus.publish(reg.snapshot(), heartbeat={"step": 5,
+                                           "last_progress_t": 123.0})
+    bus.publish(reg.snapshot(), heartbeat={"step": 9,
+                                           "last_progress_t": 124.0})
+    fleet = fleet_lib.read_fleet(fd)
+    (key,) = fleet.keys()
+    role, pid = key
+    assert role == "trainer" and pid == os.getpid()
+    segs = fleet[key]["segments"]
+    assert [s["seq"] for s in segs] == [1, 2]
+    assert segs[-1]["heartbeat"]["step"] == 9
+    assert segs[-1]["snapshot"]["counters"]["a.rows"] == 7.0
+    assert fleet[key]["corrupt"] == []
+
+
+def test_publish_prunes_beyond_keep_and_resumes_sequence(tmp_path):
+    fd = str(tmp_path / "fleet")
+    reg = Registry()
+    bus = fleet_lib.FleetBus(fd, "server", registry=reg, keep_segments=3,
+                             tracer=trace_lib.Tracer(enabled=False))
+    for _ in range(6):
+        bus.publish(reg.snapshot())
+    segs, _ = fleet_lib.read_segments(bus.dir)
+    assert [s["seq"] for s in segs] == [4, 5, 6]
+    # A NEW bus over the same dir (a second run in the same process
+    # lifetime) resumes the monotone sequence instead of clobbering.
+    bus2 = fleet_lib.FleetBus(fd, "server", registry=reg, keep_segments=3,
+                              tracer=trace_lib.Tracer(enabled=False))
+    bus2.publish(reg.snapshot())
+    segs, _ = fleet_lib.read_segments(bus.dir)
+    assert segs[-1]["seq"] == 7
+
+
+def test_corrupt_segment_skipped_not_fatal(tmp_path):
+    fd = str(tmp_path / "fleet")
+    _write_seg(fd, "trainer", 1, 1, 100.0, counters={"a.b": 1.0})
+    _write_seg(fd, "trainer", 1, 2, 101.0, counters={"a.b": 2.0})
+    p = os.path.join(fd, "trainer-p1", "seg-000001.json")
+    blob = bytearray(open(p, "rb").read())
+    i = blob.find(b'"a.b"')
+    blob[i + 1] ^= 0x01
+    with open(p, "wb") as f:
+        f.write(bytes(blob))
+    reg = Registry()
+    fleet = fleet_lib.read_fleet(fd, registry=reg)
+    proc = fleet[("trainer", 1)]
+    assert [s["seq"] for s in proc["segments"]] == [2]
+    assert proc["corrupt"] == ["seg-000001.json"]
+    assert reg.counter("integrity.corrupt").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# THE merge property: merged == sum/merge of per-process snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_merged_counters_equal_sum_of_processes():
+    rng = np.random.default_rng(3)
+    snaps = []
+    for p in range(4):
+        reg = Registry()
+        for name in ("serve.rows", "data.records", f"only.p{p}"):
+            reg.counter(name, help="n").inc(float(rng.integers(1, 100)))
+        snaps.append((f"server-p{p}", reg.snapshot()))
+    merged = fleet_lib.merge_snapshots(snaps)
+    for name in set().union(*(s["counters"] for _p, s in snaps)):
+        expect = sum(s["counters"].get(name, 0.0) for _p, s in snaps)
+        assert merged["counters"][name] == pytest.approx(expect)
+
+
+def test_histogram_merge_bucket_exact_vs_union():
+    """Merging per-process histograms must equal ONE histogram that
+    observed the union of all processes' observations — counts, sum,
+    and rank-interpolated quantiles, bucket for bucket."""
+    rng = np.random.default_rng(7)
+    union = Registry()
+    h_union = union.histogram("lat.x_s", help="lat")
+    snaps = []
+    for p in range(3):
+        reg = Registry()
+        h = reg.histogram("lat.x_s", help="lat")
+        for v in rng.gamma(2.0, 0.05, size=200):
+            h.observe(float(v))
+            h_union.observe(float(v))
+        snaps.append((f"w-p{p}", reg.snapshot()))
+    merged = fleet_lib.merge_snapshots(snaps)["histograms"]["lat.x_s"]
+    ref = union.snapshot()["histograms"]["lat.x_s"]
+    assert merged["count"] == ref["count"] == 600
+    assert merged["sum"] == pytest.approx(ref["sum"])
+    assert merged["buckets"] == ref["buckets"]
+    for q in ("p50", "p95", "p99"):
+        assert merged[q] == pytest.approx(ref[q])
+
+
+def test_histogram_bound_mismatch_kept_per_process_not_mangled():
+    a, b = Registry(), Registry()
+    a.histogram("h.x", buckets=(0.1, 1.0), help="x").observe(0.5)
+    b.histogram("h.x", buckets=(0.2, 2.0), help="x").observe(0.5)
+    merged = fleet_lib.merge_snapshots(
+        [("a-p1", a.snapshot()), ("b-p2", b.snapshot())]
+    )
+    assert "h.x" not in merged["histograms"]
+    assert set(merged["unmerged_histograms"]["h.x"]) == {"a-p1", "b-p2"}
+
+
+def test_gauge_reduction_help_tokens_and_per_process_series():
+    snaps = []
+    for p, v in enumerate((3.0, 5.0)):
+        reg = Registry()
+        reg.gauge("q.depth", help="waiting rows").set(v)
+        reg.gauge("q.peak", help="peak depth [fleet:max]").set(v)
+        reg.gauge("q.mean", help="level [fleet:mean]").set(v)
+        snaps.append((f"s-p{p}", reg.snapshot()))
+    m = fleet_lib.merge_snapshots(snaps)
+    assert m["gauges"]["q.depth"] == 8.0       # default: sum
+    assert m["gauges"]["q.peak"] == 5.0        # declared max
+    assert m["gauges"]["q.mean"] == 4.0        # declared mean
+    assert m["gauge_series"]["q.depth"] == {"s-p0": 3.0, "s-p1": 5.0}
+
+
+def test_quality_gauges_declare_non_additive_reductions():
+    """The REAL registered help strings, not a fixture: a fleet where
+    one process's canary fails must merge canary_ok to 0 (min), and
+    per-process drift PSIs must merge to the worst (max) — summed,
+    three healthy 0.15s would 'breach' a 0.2 rule with zero drift,
+    and 2-of-3 canaries passing would read as 2 (> any sane floor)."""
+    from jama16_retina_tpu.obs import quality as obs_quality
+
+    snaps = []
+    for p, (ok, psi) in enumerate(((1.0, 0.15), (1.0, 0.15),
+                                   (0.0, 0.02))):
+        reg = Registry()
+        obs_quality.QualityMonitor(
+            dataclasses.replace(QualityConfig(), enabled=True,
+                                window_scores=4),
+            registry=reg,
+        )
+        obs_quality.GoldenCanary(
+            np.zeros((1, 4, 4, 3), np.uint8), registry=reg
+        )
+        reg.gauge("quality.canary_ok").set(ok)
+        reg.gauge("quality.score_psi").set(psi)
+        snaps.append((f"server-p{p}", reg.snapshot()))
+    m = fleet_lib.merge_snapshots(snaps)
+    assert m["gauges"]["quality.canary_ok"] == 0.0
+    assert m["gauges"]["quality.score_psi"] == 0.15
+
+
+def test_exemplar_slowest_trace_id_tumbles_and_merges():
+    reg = Registry()
+    h = reg.histogram("serve.lat_s", help="lat")
+    h.observe(0.1, exemplar="fast")
+    h.observe(0.9, exemplar="slow")
+    snap = reg.snapshot()
+    assert snap["histograms"]["serve.lat_s"]["exemplar"] == {
+        "value": 0.9, "trace_id": "slow",
+    }
+    # A plain snapshot (HTTP scrape, blackbox dump, this test) reads
+    # WITHOUT consuming — only the telemetry flush closes the window.
+    assert reg.snapshot()["histograms"]["serve.lat_s"][
+        "exemplar"]["trace_id"] == "slow"
+    assert reg.snapshot(reset_exemplars=True)["histograms"][
+        "serve.lat_s"]["exemplar"]["trace_id"] == "slow"
+    # Tumbling: the next window (post-flush) starts empty.
+    assert reg.snapshot()["histograms"]["serve.lat_s"]["exemplar"] is None
+    # Merge keeps the fleet-slowest exemplar.
+    a, b = Registry(), Registry()
+    a.histogram("l.s", help="x").observe(0.2, exemplar="a1")
+    b.histogram("l.s", help="x").observe(0.7, exemplar="b1")
+    m = fleet_lib.merge_snapshots(
+        [("a-p1", a.snapshot()), ("b-p2", b.snapshot())]
+    )
+    assert m["histograms"]["l.s"]["exemplar"]["trace_id"] == "b1"
+
+
+# ---------------------------------------------------------------------------
+# Fleet-scope rules: burn() grammar + merged-only firing
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rule_grammar_and_rejections():
+    r = alerts_lib.parse_fleet_rule(
+        "burn(serve.shed.deadline/serve.router.rows, 300, 60) > 0.02 "
+        "-> slo_burn"
+    )
+    assert isinstance(r, alerts_lib.BurnRule)
+    assert (r.bad, r.total) == ("serve.shed.deadline", "serve.router.rows")
+    assert (r.long_s, r.short_s, r.threshold) == (300.0, 60.0, 0.02)
+    assert r.reason == "slo_burn"
+    # Plain grammar falls through to the ordinary parser.
+    plain = alerts_lib.parse_fleet_rule("serve.q.depth > 100 for 60")
+    assert isinstance(plain, alerts_lib.AlertRule)
+    with pytest.raises(ValueError, match="shorter than the long"):
+        alerts_lib.parse_fleet_rule("burn(a.b/c.d, 60, 60) > 1")
+    with pytest.raises(ValueError):
+        alerts_lib.parse_fleet_rule("burn(a.b/c.d, 60) > 1")
+    with pytest.raises(ValueError):
+        alerts_lib.parse_fleet_rule("total nonsense")
+
+
+def _burn_fleet(tmp_path, short_recovered=False):
+    """Two processes, 10 segments each over ~100 s: the 'bad' counter
+    burns in ONE process, the 'total' only in the other — a ratio no
+    single process can even evaluate. ``short_recovered`` stops the
+    burn for the newest ~20 s (long window still breached)."""
+    fd = str(tmp_path / "fleet")
+    t0 = 1000.0
+    for i in range(10):
+        t = t0 + 10.0 * i
+        burning = not (short_recovered and i >= 8)
+        _write_seg(fd, "router", 1, i + 1, t,
+                   counters={"serve.shed.rows": 10.0 * i if burning
+                             else 70.0})
+        _write_seg(fd, "server", 2, i + 1, t,
+                   counters={"serve.rows": 100.0 * i})
+    return fd, t0 + 90.0
+
+
+def test_burn_rule_fires_on_merged_view_only(tmp_path):
+    """THE fleet-scope acceptance pin: the burn ratio's numerator and
+    denominator live in DIFFERENT processes (sheds in the router,
+    served rows in the replica server), so no single process's stream
+    can evaluate — let alone fire — the rule; the merged view fires."""
+    fd, now = _burn_fleet(tmp_path)
+    rule = alerts_lib.parse_fleet_rule(
+        "burn(serve.shed.rows/serve.rows, 80, 20) > 0.05 -> slo_burn"
+    )
+    fleet = fleet_lib.read_fleet(fd)
+    merged_tl = fleet_lib.merged_timeline(fleet)
+    assert fleet_lib.evaluate_burn(merged_tl, rule, now=now)["firing"]
+    # Each process alone: no data for one side of the ratio.
+    for key in list(fleet):
+        solo_tl = fleet_lib.merged_timeline({key: fleet[key]})
+        verdict = fleet_lib.evaluate_burn(solo_tl, rule, now=now)
+        assert not verdict["firing"]
+    firing, _ = fleet_lib.evaluate_fleet(fd, [rule], now=now)
+    assert [f["reason"] for f in firing] == ["slo_burn"]
+
+
+def test_burn_rule_multi_window_requires_both(tmp_path):
+    """The short window is the 'still happening NOW' guard: a burn
+    that stopped inside the short window must not page, however bad
+    the long-window average still looks."""
+    fd, now = _burn_fleet(tmp_path, short_recovered=True)
+    rule = alerts_lib.parse_fleet_rule(
+        "burn(serve.shed.rows/serve.rows, 80, 20) > 0.05"
+    )
+    tl = fleet_lib.merged_timeline(fleet_lib.read_fleet(fd))
+    verdict = fleet_lib.evaluate_burn(tl, rule, now=now)
+    assert verdict["long"] is not None and verdict["long"] > 0.05
+    assert not verdict["firing"]
+
+
+def test_plain_fleet_rule_fires_on_merged_sum_only(tmp_path):
+    """A summed-gauge threshold no individual process reaches: each
+    process holds 60 rows in flight, the rule pages at 100 — only the
+    fleet view crosses it."""
+    fd = str(tmp_path / "fleet")
+    for p in range(2):
+        _write_seg(fd, "server", p + 1, 1, 1000.0 + p * 0.5,
+                   gauges={"serve.in_flight": 60.0})
+    rule = alerts_lib.parse_fleet_rule("serve.in_flight > 100")
+    firing, merged = fleet_lib.evaluate_fleet(fd, [rule])
+    assert merged["gauges"]["serve.in_flight"] == 120.0
+    assert [f["rule"] for f in firing] == [rule.name]
+    # No single process fires it.
+    for sub in ("server-p1", "server-p2"):
+        solo = fleet_lib.merge_snapshots([
+            (sub, {"gauges": {"serve.in_flight": 60.0}})
+        ])
+        assert not alerts_lib.rule_holds(rule, solo)
+
+
+def test_stale_stream_gauges_leave_the_merge_counters_stay(tmp_path):
+    """A dead process's frozen gauge must not keep a fleet threshold
+    firing forever (or double-count against its restarted successor's
+    new stream); its cumulative counters stay in the fleet totals."""
+    fd = str(tmp_path / "fleet")
+    now = 10_000.0
+    _write_seg(fd, "server", 1, 1, now - 5_000,   # dead for 5000 s
+               counters={"serve.rows": 400.0},
+               gauges={"serve.in_flight": 120.0})
+    _write_seg(fd, "server", 2, 1, now - 10,       # alive
+               counters={"serve.rows": 100.0},
+               gauges={"serve.in_flight": 8.0})
+    merged, meta = fleet_lib.fleet_snapshot(fd, now=now)
+    assert merged["counters"]["serve.rows"] == 500.0
+    assert merged["gauges"]["serve.in_flight"] == 8.0
+    assert meta["server-p1"]["stale"] is True
+    assert meta["server-p2"]["stale"] is False
+    # Within the staleness window both contribute.
+    merged, _ = fleet_lib.fleet_snapshot(fd, now=now,
+                                         stale_after_s=10_000)
+    assert merged["gauges"]["serve.in_flight"] == 128.0
+
+
+def test_evaluate_fleet_dedupes_records_and_dumps(tmp_path):
+    fd = str(tmp_path / "fleet")
+    _write_seg(fd, "server", 1, 1, 1000.0,
+               gauges={"g.hot": 9.0})
+    rule = alerts_lib.parse_fleet_rule("g.hot > 1 -> slo_breach")
+    fleet_lib.evaluate_fleet(fd, [rule], now=1001.0)
+    fleet_lib.evaluate_fleet(fd, [rule], now=1002.0)  # still firing
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(fd, "fleet.jsonl"))]
+    assert [r["state"] for r in recs] == ["firing"]
+    assert recs[0]["scope"] == "fleet"
+    dumps = os.listdir(os.path.join(fd, "blackbox"))
+    assert len(dumps) == 1 and dumps[0].endswith("slo_breach")
+    # Resolution (rule gone / condition cleared) writes exactly one
+    # resolved record.
+    fleet_lib.evaluate_fleet(fd, [], now=1003.0)
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(fd, "fleet.jsonl"))]
+    assert [r["state"] for r in recs] == ["firing", "resolved"]
+
+
+def test_fleet_report_view_does_not_touch_dedupe_state(tmp_path):
+    """An operator VIEWING --fleet mid-incident (possibly with a
+    different/empty rule set) must not 'resolve' cron's still-firing
+    rules — that would re-trigger their records and blackbox dumps on
+    the next cron minute."""
+    rep = _load_obs_report()
+    fd = str(tmp_path / "fleet")
+    _write_seg(fd, "server", 1, 1, 1000.0, gauges={"g.hot": 9.0})
+    rule = alerts_lib.parse_fleet_rule("g.hot > 1 -> slo_breach")
+    fleet_lib.evaluate_fleet(fd, [rule], now=1001.0)  # cron: fires once
+    state_path = os.path.join(fd, "fleet-alerts.json")
+    before = open(state_path, "rb").read()
+    rep.fleet_report(fd, [])       # the view, with NO rules configured
+    assert open(state_path, "rb").read() == before
+    fleet_lib.evaluate_fleet(fd, [rule], now=1002.0)  # next cron minute
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(fd, "fleet.jsonl"))]
+    assert [r["state"] for r in recs] == ["firing"], "still deduped"
+    assert len(os.listdir(os.path.join(fd, "blackbox"))) == 1
+
+
+def test_check_fleet_blind_when_all_segments_corrupt(tmp_path):
+    """Exit 2, not 0: a monitor whose every segment fails its digest
+    can see nothing — 'quiet' would report a corrupted fleet healthy."""
+    rep = _load_obs_report()
+    fd = str(tmp_path / "fleet")
+    _write_seg(fd, "server", 1, 1, 1000.0, counters={"a.b": 1.0})
+    p = os.path.join(fd, "server-p1", "seg-000001.json")
+    blob = bytearray(open(p, "rb").read())
+    i = blob.find(b'"a.b"')
+    blob[i + 1] ^= 0x01
+    with open(p, "wb") as f:
+        f.write(bytes(blob))
+    rule = alerts_lib.parse_fleet_rule("a.b >= 1")
+    rc, msg = rep.check_fleet(fd, [rule])
+    assert rc == 2 and "corrupt" in msg
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats + stitched traces
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_heartbeats_name_exactly_the_wedged_process(tmp_path):
+    fd = str(tmp_path / "fleet")
+    now = 5000.0
+    _write_seg(fd, "trainer", 11, 1, now - 10,
+               heartbeat={"step": 50, "last_progress_t": now - 12})
+    # Stale stream: stopped publishing.
+    _write_seg(fd, "server", 22, 1, now - 900,
+               heartbeat={"step": 3, "last_progress_t": now - 900})
+    # Wedged: fresh segments, stale progress.
+    _write_seg(fd, "lifecycle", 33, 1, now - 5,
+               heartbeat={"step": 7, "last_progress_t": now - 800})
+    code, msg = fleet_lib.check_fleet_heartbeats(fd, 300, now=now)
+    assert code == 1
+    assert "server-p22" in msg and "lifecycle-p33" in msg
+    assert "wedged" in msg
+    assert "trainer-p11" not in msg, "healthy remainder must stay quiet"
+    # All fresh -> 0; empty -> 2.
+    code, _ = fleet_lib.check_fleet_heartbeats(fd, 1e6, now=now)
+    assert code == 0
+    code, _ = fleet_lib.check_fleet_heartbeats(str(tmp_path / "no"), 300)
+    assert code == 2
+
+
+def test_stitch_trace_aligns_pid_lanes(tmp_path):
+    fd = str(tmp_path / "fleet")
+    for pid, role, epoch, ts in ((1, "trainer", 100.0, 5e6),
+                                 (2, "server", 103.0, 1e6)):
+        d = os.path.join(fd, f"{role}-p{pid}")
+        os.makedirs(d)
+        artifact_lib.atomic_write_text(
+            os.path.join(d, "trace.json"),
+            json.dumps({
+                "meta": {"role": role, "pid": pid, "epoch_unix": epoch},
+                "traceEvents": [{
+                    "name": f"{role}.work", "ph": "X", "ts": ts,
+                    "dur": 1000.0, "pid": pid, "tid": 1,
+                    "args": {"trace_id": "7-9"},
+                }],
+            }),
+        )
+    events = fleet_lib.stitch_trace(fd)
+    lanes = {e["pid"] for e in events if e.get("ph") != "M"}
+    assert lanes == {1, 2}
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("ph") == "M"}
+    assert names == {1: "trainer-p1", 2: "server-p2"}
+    by_name = {e["name"]: e for e in events if e.get("ph") == "X"}
+    # trainer: epoch 100 (the base) + 5 s; server: +3 s epoch + 1 s.
+    assert by_name["trainer.work"]["ts"] == pytest.approx(5e6)
+    assert by_name["server.work"]["ts"] == pytest.approx(4e6)
+
+
+def test_trace_context_wire_roundtrip_and_thread_local():
+    ctx = trace_lib.new_context()
+    assert ctx.trace_id.startswith(f"{os.getpid()}-")
+    back = trace_lib.TraceContext.from_wire(ctx.wire())
+    assert back.trace_id == ctx.trace_id
+    assert back.origin_pid == os.getpid()
+    assert trace_lib.TraceContext.from_wire(None) is None
+    assert trace_lib.TraceContext.from_wire({"nope": 1}) is None
+    child = ctx.child("serve.router.dispatch")
+    assert child.trace_id == ctx.trace_id
+    assert child.wire()["parent"] == "serve.router.dispatch"
+    assert trace_lib.current_context() is None
+    with trace_lib.use_context(ctx):
+        assert trace_lib.current_context() is ctx
+        with trace_lib.use_context(None):
+            assert trace_lib.current_context() is ctx
+    assert trace_lib.current_context() is None
+
+
+def test_batcher_request_trace_ids_are_fleet_unique():
+    """The latency exemplar rides the request's trace_id into the
+    merged fleet view: a process-local int would alias across pid
+    lanes, and a router-submitted request must join the ROUTER's
+    trace, not start a fresh one."""
+    from jama16_retina_tpu.serve import batcher as batcher_lib
+
+    rows = np.zeros((1, 2, 2, 3), np.float32)
+    bare = batcher_lib._Request(rows)
+    pid, n = bare.trace_id.split("-")
+    assert int(pid) == os.getpid() and int(n) > 0
+    ctx = trace_lib.new_context()
+    with trace_lib.use_context(ctx):
+        adopted = batcher_lib._Request(rows)
+    assert adopted.trace_id == ctx.trace_id
+
+
+# ---------------------------------------------------------------------------
+# Router: request segments tile latency; escalation carries the context
+# ---------------------------------------------------------------------------
+
+
+def test_router_request_segments_tile_latency_with_escalation():
+    import dataclasses
+
+    from jama16_retina_tpu.serve.router import EscalationPool, Router
+
+    class _Backend:
+        generation = 0
+
+        def probs(self, rows):
+            time.sleep(0.01)
+            return rows.reshape(rows.shape[0], -1).sum(axis=1)
+
+    class _EscalatingReplica:
+        """Student stub that escalates EVERY row through the shared
+        pool — the cascade shape without engine weight."""
+
+        generation = 0
+
+        def __init__(self, pool):
+            self.pool = pool
+
+        def probs(self, rows):
+            return self.pool.probs(rows)
+
+    reg = Registry()
+    tracer = trace_lib.Tracer(enabled=True)
+    prev = trace_lib.set_default_tracer(tracer)
+    try:
+        pool = EscalationPool([_Backend()], registry=reg, tracer=tracer)
+        cfg = get_config("smoke")
+        cfg = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, max_batch=8, bucket_sizes=(8,), max_wait_ms=1.0,
+            router_tick_ms=1.0,
+        ))
+        router = Router(cfg, engines=[_EscalatingReplica(pool)],
+                        registry=reg)
+        rows = np.arange(4 * 4 * 4 * 3, dtype=np.uint8).reshape(4, 4, 4, 3)
+        fut = router.submit(rows)
+        fut.result(timeout=30)
+        router.close()
+        snap = reg.snapshot()
+        h = snap["histograms"]["serve.router.request_latency_s"]
+        assert h["count"] == 1
+        tid = h["exemplar"]["trace_id"]
+        assert tid.startswith(f"{os.getpid()}-")
+        events = tracer.events()
+        segs = {
+            e["name"]: e for e in events
+            if e["name"].startswith("serve.router.request.")
+            and e["args"]["trace_id"] == tid
+        }
+        assert set(segs) == {
+            "serve.router.request.queue_wait",
+            "serve.router.request.device",
+            "serve.router.request.resolve",
+        }
+        # The three segments tile the exact latency observation.
+        total_us = sum(e["dur"] for e in segs.values())
+        assert total_us / 1e6 == pytest.approx(h["sum"], abs=2e-4)
+        # The escalation happened UNDER the request's ambient context.
+        esc = [e for e in events
+               if e["name"] == "serve.router.escalate"]
+        assert len(esc) == 1 and esc[0]["args"]["trace_id"] == tid
+        assert reg.counter("serve.router.escalations").value == 4
+    finally:
+        trace_lib.set_default_tracer(prev)
+
+
+def test_replica_namespace_metrics_and_retirement():
+    import dataclasses
+
+    from jama16_retina_tpu.serve.router import Router
+
+    class _Stub:
+        generation = 0
+
+        def probs(self, rows):
+            return rows.reshape(rows.shape[0], -1).sum(axis=1)
+
+    reg = Registry()
+    cfg = get_config("smoke")
+    cfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, max_batch=8, bucket_sizes=(8,), max_wait_ms=1.0,
+        router_tick_ms=1.0,
+    ))
+    router = Router(cfg, engines=[_Stub(), _Stub()], registry=reg)
+    for _ in range(4):
+        router.probs(np.zeros((8, 2, 2, 3), np.uint8))
+    router.close()
+    snap = reg.snapshot()
+    rows0 = snap["counters"].get("serve.replica0.rows", 0)
+    rows1 = snap["counters"].get("serve.replica1.rows", 0)
+    assert rows0 + rows1 == 32
+    assert snap["counters"]["serve.replica0.dispatches"] >= 1
+    assert "serve.replica0.in_flight_rows" in snap["gauges"]
+    assert snap["counters"]["serve.replica0.failures"] == 0
+    # Retirement sweeps the WHOLE namespace, not just .rows.
+    for m in ("rows", "dispatches", "failures", "in_flight_rows"):
+        reg.remove(f"serve.replica0.{m}")
+    snap = reg.snapshot()
+    assert not any(k.startswith("serve.replica0.")
+                   for k in {**snap["counters"], **snap["gauges"]})
+
+
+# ---------------------------------------------------------------------------
+# Snapshotter wiring + HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_snapshotter_publishes_fleet_segments(tmp_path):
+    fd = str(tmp_path / "fleet")
+    wd = str(tmp_path / "wd")
+    reg = Registry()
+    reg.counter("x.y", help="n").inc(3)
+    bus = fleet_lib.FleetBus(fd, "server", registry=reg,
+                             tracer=trace_lib.Tracer(enabled=False))
+    snap = export_lib.Snapshotter(reg, wd, every_s=1e9, fleet=bus)
+    snap.progress(4)
+    snap.flush()
+    snap.close()
+    fleet = fleet_lib.read_fleet(fd)
+    segs = fleet[("server", os.getpid())]["segments"]
+    assert len(segs) == 2  # explicit flush + close's final flush
+    assert segs[0]["heartbeat"]["step"] == 4
+    assert segs[0]["snapshot"]["counters"]["x.y"] == 3.0
+
+
+def test_bus_for_disabled_and_enabled(tmp_path):
+    cfg = get_config("smoke")
+    assert fleet_lib.bus_for(cfg, "trainer") is None  # fleet_dir empty
+    cfg = override(cfg, [f"obs.fleet_dir={tmp_path / 'f'}",
+                         "obs.fleet_role=custom",
+                         "obs.fleet_keep_segments=5"])
+    bus = fleet_lib.bus_for(cfg, "trainer", registry=Registry())
+    assert bus.role == "custom" and bus.keep_segments == 5
+    cfg = override(cfg, ["obs.enabled=false"])
+    assert fleet_lib.bus_for(cfg, "trainer") is None
+
+
+def test_http_metrics_and_healthz_socket_level(tmp_path):
+    reg = Registry()
+    reg.counter("srv.rows", help="rows served").inc(12)
+    snap = export_lib.Snapshotter(reg, str(tmp_path / "wd"), every_s=1e9)
+    server = snap.serve_http(0, max_age_s=300.0)
+    try:
+        assert server is not None and server.port > 0
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        # /healthz before any progress: 2 (no heartbeat) -> 503.
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert (r.status, body["status"]) == (503, 2)
+        snap.progress(17)
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode()
+        assert r.status == 200
+        assert "# TYPE srv_rows counter" in text
+        assert "srv_rows 12" in text
+        conn.request("GET", "/healthz")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert (r.status, body["status"]) == (200, 0)
+        assert body["step"] == 17
+        # Wedged: progress stamped but stale vs a tiny max_age probe.
+        conn.request("GET", "/healthz?max_age_s=0.0000001")
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        assert (r.status, body["status"]) == (503, 1)
+        assert "wedged" in body["detail"]
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        snap.close()  # closes the http server too
+
+
+# ---------------------------------------------------------------------------
+# Retention: fleet streams join the GC, dry-run == apply
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_retention_dry_run_equals_apply_and_bounds_stream(tmp_path):
+    from jama16_retina_tpu.integrity import retention
+
+    wd = str(tmp_path / "wd")
+    fd = os.path.join(wd, "fleet")
+    for i in range(8):
+        _write_seg(fd, "trainer", 9, i + 1, 1000.0 + i,
+                   counters={"pad.pad": float(i)},
+                   heartbeat={"step": i})
+    seg_bytes = os.path.getsize(
+        os.path.join(fd, "trainer-p9", "seg-000001.json")
+    )
+    cfg = override(get_config("smoke"),
+                   [f"integrity.telemetry_max_bytes={seg_bytes * 3}"])
+    plan = retention.plan_retention(wd, cfg)
+    fleet_actions = [a for a in plan.actions if a.cls == "fleet"]
+    assert fleet_actions, "over-cap stream must be planned"
+    dry = plan.ledger()
+    plan2 = retention.plan_retention(wd, cfg)
+    assert plan2.ledger() == dry, "pure plan: dry-run == apply ledger"
+    reg = Registry()
+    retention.apply_plan(plan2, registry=reg)
+    segs, _ = fleet_lib.read_segments(os.path.join(fd, "trainer-p9"))
+    assert segs, "the newest (heartbeat-bearing) segment survives"
+    assert segs[-1]["seq"] == 8
+    total = sum(
+        os.path.getsize(os.path.join(fd, "trainer-p9", n))
+        for n in os.listdir(os.path.join(fd, "trainer-p9"))
+    )
+    assert total <= seg_bytes * 3 + seg_bytes  # newest always kept
+    assert reg.counter("integrity.gc.deleted.fleet").value == len(
+        fleet_actions
+    )
+
+
+def test_fleet_retention_tolerates_segment_pruned_mid_scan(
+        tmp_path, monkeypatch):
+    """A live FleetBus prunes its own stream (obs.fleet_keep_segments)
+    concurrently with graftfsck --gc: a segment listed by os.walk may
+    be gone by stat time. The plan must skip it, not abort the whole
+    GC run."""
+    from jama16_retina_tpu.integrity import retention
+
+    wd = str(tmp_path / "wd")
+    fd = os.path.join(wd, "fleet")
+    for i in range(4):
+        _write_seg(fd, "trainer", 9, i + 1, 1000.0 + i,
+                   counters={"pad.pad": float(i)})
+    victim = os.path.join(fd, "trainer-p9", "seg-000002.json")
+    real_getsize = os.path.getsize
+
+    def racy_getsize(path):
+        if os.path.abspath(path) == os.path.abspath(victim):
+            raise FileNotFoundError(path)
+        return real_getsize(path)
+
+    monkeypatch.setattr(os.path, "getsize", racy_getsize)
+    cfg = override(get_config("smoke"),
+                   ["integrity.telemetry_max_bytes=1"])
+    plan = retention.plan_retention(wd, cfg)
+    planned = {a.path for a in plan.actions if a.cls == "fleet"}
+    assert victim not in planned
+    # The survivors (minus the always-kept newest) are still collected.
+    assert any(p.endswith("seg-000001.json") for p in planned)
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle: the trigger's trace context crosses the journal seam
+# ---------------------------------------------------------------------------
+
+
+def test_lifecycle_trigger_context_propagates_via_journal(tmp_path):
+    """The trigger 'process' appends a DRIFT_DETECTED entry carrying a
+    serialized TraceContext; a controller built LATER (the --watch
+    supervisor's position: fresh process, fresh tracer) recovers it
+    from the journal and stamps its RETRAIN step events with the same
+    trace_id — cross-process propagation through an existing seam."""
+    from jama16_retina_tpu.lifecycle import Journal, TERMINAL_STATES
+    from jama16_retina_tpu.lifecycle.controller import LifecycleController
+
+    wd = str(tmp_path / "wd")
+    ctx = trace_lib.new_context()
+    journal = Journal(os.path.join(wd, "lifecycle"),
+                      terminal_states=TERMINAL_STATES)
+    journal.append("DRIFT_DETECTED", cycle=1, reason="manual",
+                   live_member_dirs=[str(tmp_path / "m0")],
+                   trace=ctx.wire())
+
+    tracer = trace_lib.Tracer(enabled=True)
+    prev = trace_lib.set_default_tracer(tracer)
+    try:
+        cfg = override(get_config("smoke"), ["lifecycle.enabled=true"])
+        seen = {}
+
+        def retrain_fn(ctl, root):
+            seen["ambient"] = trace_lib.current_context()
+            os.makedirs(root, exist_ok=True)
+            return [os.path.join(root, "member_00")]
+
+        ctl = LifecycleController(cfg, wd, retrain_fn=retrain_fn)
+        entry = ctl.step()
+        assert entry["state"] == "RETRAIN"
+        assert seen["ambient"].trace_id == ctx.trace_id
+        evs = [e for e in tracer.events()
+               if e["name"] == "lifecycle.drift_detected"]
+        assert evs and evs[0]["args"]["trace_id"] == ctx.trace_id
+    finally:
+        trace_lib.set_default_tracer(prev)
