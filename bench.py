@@ -565,6 +565,21 @@ def _router_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _interactive_overhead_guard(extras: dict, rate_on: float,
+                                rate_off: float,
+                                max_overhead: float = 0.02) -> bool:
+    """ISSUE 16's pin, same shared math: the batch path with the
+    interactive machinery compiled in but DISABLED — the cascade's
+    speculative branch off, the router's fusion-aware tick bookkeeping
+    and submit wake-up scan running over single-tenant queues — must
+    stay within 2% of dispatching the same serial cascade directly.
+    The contract that lets speculation/fusion ship always-present
+    behind config knobs (policy v2 opts deployments in) instead of a
+    build flag."""
+    return _overhead_guard(extras, "interactive", rate_on, rate_off,
+                           max_overhead)
+
+
 def _integrity_overhead_guard(extras: dict, rate_on: float,
                               rate_off: float,
                               max_overhead: float = 0.02) -> bool:
@@ -688,6 +703,153 @@ def _router_bench(extras: dict) -> None:
          f"routed/direct = {extras['router_vs_single_engine']}")
 
 
+def _interactive_bench(extras: dict) -> None:
+    """Interactive latency rows (ISSUE 16): single-row closed-loop
+    requests (c=1 — one outstanding request, the fixed offered load an
+    interactive client presents) through Router + CascadeEngine over
+    stub engines with FIXED simulated service times — off-device like
+    ``_router_bench``, so the rows measure the dispatch machinery, not
+    the model. Every row escalates (the worst case for the cascade).
+
+      serve_interactive_p99_ms         — p99 with the interactive path
+                                         on: speculative escalation
+                                         (student and ensemble dispatch
+                                         concurrently; the escalated
+                                         row pays max, not sum) plus
+                                         the submit wake-up;
+      serve_interactive_serial_p99_ms  — the SAME workload with
+                                         serve.cascade_speculative off
+                                         (student-then-ensemble);
+      serve_interactive_speedup        — serial p99 / speculative p99;
+                                         acceptance >= 1.5x, flagged in
+                                         interactive_latency_ok.
+
+    The router runs a deliberately COARSE 50 ms tick: the p99 landing
+    at service-time scale (not tick scale) is the submit wake-up
+    working — the old tick/4 poll floored a lone request's queue wait
+    at ~12.5 ms regardless of its deadline.
+
+    The shared <=2% ``_interactive_overhead_guard`` pin rides along:
+    64-row batch requests through Router + serial cascade with every
+    ISSUE 16 knob at its default vs the same serial cascade dispatched
+    directly."""
+    import dataclasses as _dc
+
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.obs.registry import Registry
+    from jama16_retina_tpu.serve.cascade import CascadeEngine
+    from jama16_retina_tpu.serve.router import Router
+
+    T_STUDENT = 8e-3   # simulated per-dispatch student service time
+    T_ENSEMBLE = 8e-3  # simulated per-dispatch ensemble service time
+    N_REQ = 60
+
+    class _Stub:
+        """kind='student' pins every score inside the escalation band
+        (all rows escalate); kind='ensemble' returns the row sums."""
+
+        def __init__(self, kind, fixed_s, per_row_s=0.0):
+            self.kind = kind
+            self.fixed_s = fixed_s
+            self.per_row_s = per_row_s
+            self.generation = 0
+
+        def probs(self, rows):
+            time.sleep(self.fixed_s + self.per_row_s * rows.shape[0])
+            if self.kind == "student":
+                return np.full(rows.shape[0], 0.5)
+            return rows.reshape(rows.shape[0], -1).astype(
+                np.float64).sum(axis=1)
+
+    base = get_config("smoke")
+    one = np.zeros((1, 2, 2, 3), np.uint8)
+
+    def run(speculative: bool):
+        reg = Registry()
+        ccfg = base.replace(serve=_dc.replace(
+            base.serve, max_batch=4, bucket_sizes=(1, 4),
+            max_wait_ms=2.0, router_tick_ms=50.0,
+            cascade_thresholds=(0.5,), cascade_band=0.6,
+            cascade_speculative=speculative,
+        ))
+        casc = CascadeEngine(
+            ccfg, _Stub("student", T_STUDENT),
+            _Stub("ensemble", T_ENSEMBLE), registry=reg,
+        )
+        router = Router(ccfg, engines=[casc], registry=reg)
+        try:
+            lats, _ = _offered_load(
+                lambda r: router.submit(r, priority="interactive"),
+                1, N_REQ, lambda w, i: one,
+            )
+        finally:
+            router.close()
+            casc.close()
+        return _latency_summary(lats), reg
+
+    spec, reg_spec = run(True)
+    serial, _ = run(False)
+    extras["serve_interactive_p99_ms"] = spec["p99_ms"]
+    extras["serve_interactive_serial_p99_ms"] = serial["p99_ms"]
+    speedup = serial["p99_ms"] / spec["p99_ms"]
+    extras["serve_interactive_speedup"] = round(speedup, 2)
+    extras["interactive_latency_ok"] = speedup >= 1.5
+    counts = reg_spec.snapshot()["counters"]
+    extras["serve_interactive_speculated_rows"] = int(
+        counts.get("serve.cascade.speculated", 0)
+    )
+    if not extras["interactive_latency_ok"]:
+        _log(
+            f"INTERACTIVE LATENCY VIOLATION: speculative p99 "
+            f"{spec['p99_ms']} ms is only "
+            f"{speedup:.2f}x better than serial "
+            f"{serial['p99_ms']} ms (acceptance >= 1.5x)"
+        )
+    else:
+        _log(
+            f"interactive c=1 p99: speculative {spec['p99_ms']} ms vs "
+            f"serial {serial['p99_ms']} ms ({speedup:.2f}x, 50 ms tick "
+            "— submit wake-up bounds queue wait)"
+        )
+
+    # Disabled-machinery overhead pin: 64-row batch requests, serial
+    # cascade, every ISSUE 16 knob at its default — routed vs direct.
+    ROWS, FIXED_S, PER_ROW_S = 64, 1e-3, 50e-6
+    WORKERS, PER_WORKER = 8, 12
+    rows = np.zeros((ROWS, 2, 2, 3), np.uint8)
+    ocfg = base.replace(serve=_dc.replace(
+        base.serve, max_batch=ROWS, bucket_sizes=(ROWS,),
+        max_wait_ms=1.0, router_tick_ms=1.0,
+        cascade_thresholds=(0.5,), cascade_band=0.6,
+    ))
+    total_rows = WORKERS * PER_WORKER * ROWS
+    casc_direct = CascadeEngine(
+        ocfg, _Stub("student", FIXED_S, PER_ROW_S),
+        _Stub("ensemble", FIXED_S, PER_ROW_S), registry=Registry(),
+    )
+    t0 = time.perf_counter()
+    for _ in range(WORKERS * PER_WORKER):
+        casc_direct.probs(rows)
+    rate_direct = total_rows / (time.perf_counter() - t0)
+    casc_direct.close()
+
+    reg_r = Registry()
+    casc_routed = CascadeEngine(
+        ocfg, _Stub("student", FIXED_S, PER_ROW_S),
+        _Stub("ensemble", FIXED_S, PER_ROW_S), registry=reg_r,
+    )
+    router = Router(ocfg, engines=[casc_routed], registry=reg_r)
+    try:
+        _, window = _offered_load(
+            router.submit, WORKERS, PER_WORKER, lambda w, i: rows
+        )
+    finally:
+        router.close()
+        casc_routed.close()
+    rate_routed = total_rows / window
+    _interactive_overhead_guard(extras, rate_routed, rate_direct)
+
+
 def _chaos_smoke(extras: dict) -> None:
     """``--chaos``: deterministically drive every recovery path the
     reliability layer claims, off-device (tiny batcher + fake infer +
@@ -713,6 +875,7 @@ def _chaos_smoke(extras: dict) -> None:
 
     ok = True
     reg = Registry()
+    spec_counts: dict = {}
     plan = faultinject.plan_from_spec({
         # Poison record: corrupt the 3rd TFRecord payload read.
         "tfrecord.read": {"kind": "corrupt", "on_calls": [3]},
@@ -900,6 +1063,75 @@ def _chaos_smoke(extras: dict) -> None:
         ok &= reg.counter("serve.router.replica_failures").value >= 1
         extras["chaos_router_zero_drops"] = drops == 0
 
+        # 2d) Speculative cascade (ISSUE 16): a replica dies while
+        #     speculation is in flight. Two speculative-cascade
+        #     replicas (stub student pinned inside the band, so every
+        #     row speculates AND escalates); a dedicated one-shot plan
+        #     kills the 3rd dispatch of THIS storm — the bin retries on
+        #     the sibling, zero drops, and every answer is still the
+        #     ensemble's (the speculated work of the dead dispatch is
+        #     discarded, never half-applied).
+        from jama16_retina_tpu.serve.cascade import CascadeEngine
+
+        class _SpecStub:
+            def __init__(self, kind):
+                self.kind = kind
+                self.generation = 3
+
+            def probs(self, rows):
+                time.sleep(3e-4)
+                if self.kind == "student":
+                    return np.full(rows.shape[0], 0.5)
+                return rows.reshape(rows.shape[0], -1).astype(
+                    np.float64).sum(axis=1)
+
+        scfg = _gc("smoke")
+        scfg = scfg.replace(serve=_dc.replace(
+            scfg.serve, max_batch=4, bucket_sizes=(4,), max_wait_ms=1.0,
+            cascade_thresholds=(0.5,), cascade_band=0.6,
+            cascade_speculative=True,
+        ))
+        cascs = [
+            CascadeEngine(scfg, _SpecStub("student"), _SpecStub("ens"),
+                          registry=reg)
+            for _ in range(2)
+        ]
+        plan_spec = faultinject.plan_from_spec({
+            "serve.router.dispatch": {
+                "kind": "error", "on_calls": [3],
+                "error": "RuntimeError",
+                "message": "chaos replica death mid-speculation",
+            },
+        })
+        faultinject.arm(plan_spec)
+        try:
+            router2 = Router(scfg, engines=list(cascs), registry=reg)
+            futs2: list = []
+            rng2 = np.random.default_rng(16)
+            for _ in range(12):
+                s_rows = rng2.integers(0, 256, (4, 2, 2, 3), np.uint8)
+                futs2.append((s_rows, router2.submit(
+                    s_rows, priority="interactive")))
+            drops2 = 0
+            for s_rows, f in futs2:
+                try:
+                    out = f.result(timeout=60)
+                except Exception:  # noqa: BLE001 - counted as a drop
+                    drops2 += 1
+                    continue
+                ref = s_rows.reshape(4, -1).astype(np.float64).sum(axis=1)
+                ok &= bool(np.array_equal(out, ref))
+            router2.close()
+            for c in cascs:
+                c.close()
+            spec_counts = plan_spec.counts()
+        finally:
+            faultinject.arm(plan)  # restore the main plan for 3)
+        ok &= drops2 == 0
+        ok &= spec_counts["serve.router.dispatch"]["fires"] >= 1
+        ok &= reg.counter("serve.cascade.speculated").value >= 1
+        extras["chaos_speculation_zero_drops"] = drops2 == 0
+
         # 3) Lifecycle plane (ISSUE 8): the journaled state machine
         #    driven through all three injected fault sites, off-device
         #    (seam-injected retrain/gates, a duck-typed engine for the
@@ -1005,6 +1237,10 @@ def _chaos_smoke(extras: dict) -> None:
     extras["chaos_injections"] = {
         site: c["fires"] for site, c in plan.counts().items()
     }
+    for site, c in spec_counts.items():
+        extras["chaos_injections"][site] = (
+            extras["chaos_injections"].get(site, 0) + c["fires"]
+        )
     _log(f"chaos smoke: ok={ok}, injections={extras['chaos_injections']}")
 
 
@@ -2857,7 +3093,10 @@ def main() -> None:
         if not args.skip_frontier:
             try:
                 frontier = []
-                for b in sorted({8, 16, eval_bs}):
+                # Small buckets (2, 4) in the default grid (ISSUE 16):
+                # the v2 interactive class is derived from measured
+                # single-request-scale points, not extrapolated.
+                for b in sorted({2, 4, 8, 16, eval_bs}):
                     fcfg = cfg.replace(serve=dataclasses.replace(
                         cfg.serve, max_batch=b, bucket_sizes=(b,),
                         max_wait_ms=2.0,
@@ -2902,12 +3141,184 @@ def main() -> None:
                 _log(f"serve frontier bench failed: "
                      f"{type(e).__name__}: {e}")
 
+        # Small-batch fusion recovery (ISSUE 16 tentpole a): two
+        # tenants each offering batch-4 requests — the device_only_b4
+        # regime where a lone small dispatch leaves the chip far under
+        # b128 utilization (BENCH_r05: 359.7 vs ~2000 img/s/chip) —
+        # routed with serve.router_fusion on, so the tenants' agreeing
+        # programs share ONE stacked b8 dispatch (demuxed by offset),
+        # vs the SAME offered load unfused (per-tenant b4 bins).
+        # Acceptance: fused >= 1.5x the unfused same-run baseline
+        # (smallbatch_fusion_ok). Mesh-less engines only — the
+        # serve/fusion.py contract — so multi-device runs skip the row.
+        try:
+            if n_dev == 1:
+                import threading as _threading
+
+                from jama16_retina_tpu.obs.registry import (
+                    Registry as _Reg,
+                )
+                from jama16_retina_tpu.serve.router import (
+                    Router as _Router,
+                )
+
+                SB = 4
+                PER_TENANT_WORKERS = 2
+                SB_REQS = 25
+                st1b, _ = train_lib.create_ensemble_state(
+                    cfg, model, [1]
+                )
+
+                def _smallbatch_rate(fused: bool):
+                    reg = _Reg()
+                    fcfg = cfg.replace(serve=dataclasses.replace(
+                        cfg.serve, max_batch=2 * SB,
+                        bucket_sizes=(SB, 2 * SB), max_wait_ms=3.0,
+                        router_fusion=fused,
+                    ))
+                    eng_a = ServingEngine(
+                        fcfg, model=model, mesh=None, state=st1
+                    )
+                    eng_b = ServingEngine(
+                        fcfg, model=model, mesh=None, state=st1b
+                    )
+                    for e in (eng_a, eng_b):  # compile both buckets
+                        e.probs(imgs[:SB])
+                        e.probs(imgs[:2 * SB])
+                    if fused:
+                        # Whether the storm's bins actually mix
+                        # tenants is timing-dependent, so the k=2
+                        # stacked program may otherwise first compile
+                        # INSIDE the timed window (at 299px that
+                        # compile dominates it). Warm it directly
+                        # with the same raw-uint8 rows submit bins.
+                        from jama16_retina_tpu.serve import (
+                            fusion as fusion_lib,
+                        )
+
+                        class _Part:
+                            __slots__ = ("model",)
+
+                            def __init__(self, model):
+                                self.model = model
+
+                        fusion_lib.score_mixed(
+                            {"a": eng_a, "b": eng_b}, imgs[:2 * SB],
+                            [(_Part("a"), 0, SB), (_Part("b"), 0, SB)],
+                            2 * SB, cache=None,
+                        )
+                    router = _Router(
+                        fcfg,
+                        engines={"a": [eng_a], "b": [eng_b]},
+                        registry=reg,
+                    )
+                    block = imgs[:SB]
+                    lock = _threading.Lock()
+                    lats: list = []
+                    errs: list = []
+
+                    def run_worker(m, nreq):
+                        try:
+                            for _ in range(nreq):
+                                t0 = time.perf_counter()
+                                router.submit(block, model=m).result()
+                                dt = time.perf_counter() - t0
+                                with lock:
+                                    lats.append(dt)
+                        except Exception as e:  # noqa: BLE001
+                            errs.append(e)
+
+                    def storm(nreq):
+                        threads = [
+                            _threading.Thread(
+                                target=run_worker, args=(m, nreq)
+                            )
+                            for m in ("a", "b")
+                            for _ in range(PER_TENANT_WORKERS)
+                        ]
+                        t0 = time.perf_counter()
+                        for t in threads:
+                            t.start()
+                        for t in threads:
+                            t.join()
+                        return time.perf_counter() - t0
+
+                    try:
+                        storm(3)  # warm the fused/group programs
+                        lats.clear()
+                        window = storm(SB_REQS)
+                    finally:
+                        router.close()
+                    if errs:
+                        raise errs[0]
+                    fused_bins = int(
+                        reg.counter("serve.router.fused_bins").value
+                        if fused else 0
+                    )
+                    total = 2 * PER_TENANT_WORKERS * SB_REQS * SB
+                    return total / window, fused_bins
+
+                rate_f, fused_bins = _smallbatch_rate(True)
+                rate_u, _ = _smallbatch_rate(False)
+                flops1_per_image = (
+                    serve_flops / eval_bs if serve_flops else None
+                )
+                _publish(
+                    extras, "serve_smallbatch_images_per_sec", rate_f,
+                    # A fused image is forwarded by BOTH tenants'
+                    # members (useful rows halve the stacked program).
+                    2 * flops1_per_image if flops1_per_image else None,
+                    peak,
+                    suffix=(f" (2 tenants x b{SB} requests fused into "
+                            f"b{2 * SB} bins; {fused_bins} fused "
+                            "bins)"),
+                )
+                _publish(
+                    extras, "serve_smallbatch_unfused_images_per_sec",
+                    rate_u, flops1_per_image, peak,
+                    suffix=f" (same offered load, per-tenant b{SB} "
+                           "bins)",
+                )
+                ratio = rate_f / rate_u
+                extras["serve_smallbatch_fused_vs_unfused"] = round(
+                    ratio, 2
+                )
+                extras["serve_smallbatch_fused_bins"] = fused_bins
+                ok_sb = ratio >= 1.5 and fused_bins > 0
+                extras["smallbatch_fusion_ok"] = ok_sb
+                if not ok_sb:
+                    _log(
+                        f"SMALLBATCH FUSION VIOLATION: fused "
+                        f"{rate_f:.1f} img/s is only {ratio:.2f}x the "
+                        f"unfused {rate_u:.1f} ({fused_bins} fused "
+                        "bins; acceptance >= 1.5x)"
+                    )
+                else:
+                    _log(
+                        f"smallbatch fusion: {rate_f:.1f} img/s fused "
+                        f"vs {rate_u:.1f} unfused ({ratio:.2f}x, "
+                        f"{fused_bins} fused bins)"
+                    )
+            else:
+                _log(
+                    "smallbatch fusion row skipped: serve/fusion.py "
+                    f"fuses mesh-less engines only (n_dev={n_dev})"
+                )
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"smallbatch fusion bench failed: "
+                 f"{type(e).__name__}: {e}")
+
     # Front-door router scaling (ISSUE 12): off-device, no compiles.
     if not args.skip_router:
         try:
             _router_bench(extras)
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"router bench failed: {type(e).__name__}: {e}")
+        # Interactive latency rows (ISSUE 16): off-device, no compiles.
+        try:
+            _interactive_bench(extras)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"interactive bench failed: {type(e).__name__}: {e}")
 
     # Time-to-AUC rows (ISSUE 11): the north-star's FIRST clause lands
     # in the trajectory JSON instead of living only in the side script.

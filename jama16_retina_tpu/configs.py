@@ -614,6 +614,34 @@ class ServeConfig:
     # p99 request-latency SLO (ms) the scaler treats as a hot signal;
     # 0 disables the latency input.
     scaler_slo_p99_ms: float = 0.0
+    # --- Interactive latency frontier (ISSUE 16) -----------------------
+    # All three default OFF: the machinery costs one branch each on the
+    # untouched paths (pinned <= 2% by bench.py's interactive overhead
+    # guard) and a policy-v2 artifact is the intended way to opt in
+    # (serve.policy_from; hand-setting them also works).
+    # Pallas-fused serve-side preprocess (ops/pallas_serve.py, wired
+    # through serve/host.py prepare_images): normalize + per-image
+    # channel statistics + channels-first layout in ONE pass over the
+    # uint8 batch, so the quality monitor's input statistics stop
+    # paying a separate full host-numpy pass per batch. The jnp path
+    # (fused off) is the bit-reference the kernel is pinned against.
+    fused_preprocess: bool = False
+    # Speculative escalation (serve/cascade.py): dispatch the student
+    # AND the full ensemble concurrently instead of serially, so a
+    # band-adjacent row pays max(student, ensemble) latency instead of
+    # student + ensemble. Results are bit-equal to the serial cascade
+    # (the ensemble scores the same rows at the same bucket shape);
+    # discarded speculative work is a counted ledger
+    # (serve.cascade.speculated / serve.cascade.speculated.wasted).
+    cascade_speculative: bool = False
+    # Cross-request/cross-engine batch fusion in the Router dispatch
+    # tick (serve/fusion.py): rows destined for DIFFERENT models with
+    # agreeing shapes may share one dispatch bin — one stacked forward
+    # over the concatenated member trees when the engines' compiled
+    # shapes agree (grouped per-model calls otherwise), results demuxed
+    # by offset with per-(model, replica, generation) attribution. Off:
+    # bins never mix models.
+    router_fusion: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -470,6 +470,11 @@ class QualityMonitor:
             else registry_lib.default_registry()
         )
         self.canary = canary
+        # Replaceable input-statistics pass: predict.py swaps in the
+        # fused serve-preprocess stats (serve/host.stats_only) when
+        # serve.fused_preprocess is on, so observe() stops paying a
+        # separate host-numpy per-pixel pass per batch.
+        self.stats_fn = input_stat_values
         if not self.enabled:
             self.profile = None
             return
@@ -590,12 +595,21 @@ class QualityMonitor:
 
     # -- the hot-path hook -------------------------------------------------
 
-    def observe(self, images: "np.ndarray | None", scores: np.ndarray) -> None:
+    def observe(
+        self,
+        images: "np.ndarray | None",
+        scores: np.ndarray,
+        stats: "dict | None" = None,
+    ) -> None:
         """One coalesced batch of live traffic: ``scores`` are the
         ensemble-averaged probabilities the engine returned ([n] binary
         or [n, C] multi — reduced to referable), ``images`` the
         post-normalization uint8 rows they came from (None skips input
-        statistics, e.g. score-only call sites)."""
+        statistics, e.g. score-only call sites). ``stats`` lets a
+        caller that already computed the INPUT_STATS dict (the fused
+        serve preprocess kernel emits it as a byproduct of
+        normalization) hand it in and skip this method's own
+        per-pixel pass entirely."""
         if not self.enabled or not self._registry.enabled:
             return
         s = np.asarray(scores, np.float64)
@@ -614,10 +628,13 @@ class QualityMonitor:
         # per-pixel pass); only pay it when the profile carries
         # reference histograms to compare against — the no-profile
         # "positive-rate/canary only" mode must cost what it claims.
-        stats = (
-            input_stat_values(images)
-            if images is not None and self._ref_stats else None
-        )
+        if stats is None:
+            stats = (
+                self.stats_fn(images)
+                if images is not None and self._ref_stats else None
+            )
+        elif not self._ref_stats:
+            stats = None
         with self._lock:
             self._score_counts += score_add
             self._pos += pos_add

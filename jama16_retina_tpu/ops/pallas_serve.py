@@ -1,0 +1,178 @@
+"""Pallas fused serve-side preprocess (ISSUE 16 tentpole b).
+
+The serving host stage hands the engine uint8 rows, the engine
+normalizes them in-model (``augment.normalize``), and — when quality
+monitoring is on — ``obs/quality.input_stat_values`` makes a SEPARATE
+full per-pixel pass over the same batch on the host (the dominant
+per-batch monitor cost, per its own call-site comment). At interactive
+batch sizes that host pass is a real slice of p99.
+
+This kernel is the serve-side twin of the train-side
+``fused_normalize_color_jitter`` (ops/pallas_augment.py): ONE pass over
+the uint8 batch streams out
+
+  * the normalized float32 rows (``u8 * (1/127.5) - 1`` — the serving
+    step's input distribution), and
+  * the raw per-image statistic accumulators (per-channel pixel sums +
+    the global sum of squares) that ``stats_from_sums`` turns into the
+    exact ``INPUT_STATS`` vocabulary the quality monitor bins
+    (mean_r/mean_g/mean_b/std/brightness over x = u8/255).
+
+Layout mirrors pallas_augment: channels-first ``[B, 3, P]`` padded to
+the lane tile; zero padding contributes zero to every accumulator, so
+the true pixel count divides out exactly.
+
+``serve_preprocess_reference`` is the pure-jnp bit-reference: it runs
+the SAME chunk-sequential accumulation (a fori_loop over the kernel's
+grid order), so in interpret mode on CPU the kernel is pinned
+BIT-IDENTICAL to it (tests/test_pallas_serve.py) — not merely
+float-close. The reference (fused off) is also the live path:
+``serve/host.py prepare_images`` routes through it unless
+``serve.fused_preprocess`` opts in.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_LANE = 128
+_CHUNK = 64 * _LANE  # pixels per grid step, matching pallas_augment
+
+# Rec.601 luma weights — the same constants input_stat_values applies.
+_LUMA = (0.299, 0.587, 0.114)
+
+
+def _serve_kernel(x_ref, out_ref, stat_ref):
+    """One grid step of image ``b``, chunk ``j``: write the normalized
+    chunk and fold its raw sums into the stats accumulator (an output
+    block parked on a constant index, so it persists across the j steps
+    of one image and writes back when b advances)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _reset():
+        stat_ref[...] = jnp.zeros_like(stat_ref)
+
+    # Mosaic has no direct uint8->f32 cast on TPU; stage through int32
+    # (both legs are supported and exact for [0, 255]).
+    raw = x_ref[0].astype(jnp.int32).astype(jnp.float32)  # [3, CHUNK]
+    ch = jnp.sum(raw, axis=1, keepdims=True)              # [3, 1]
+    sq = jnp.sum(raw * raw, axis=(0, 1), keepdims=True)   # [1, 1]
+    stat_ref[0] += jnp.concatenate([ch, sq], axis=0)      # [4, 1]
+    out_ref[0] = raw * (1.0 / 127.5) - 1.0
+
+
+def stats_from_sums(sums: jnp.ndarray, n_pixels: int) -> jnp.ndarray:
+    """Raw accumulators [B, 4] (sum_r, sum_g, sum_b, sum of squares
+    over all channels, in uint8 units) -> [B, 4] stat columns
+    (mean_r, mean_g, mean_b, std) over x = u8/255 — the same
+    quantities ``obs/quality.input_stat_values`` computes, derived
+    from moments instead of a second pass. Shared by the kernel wrapper
+    and the jnp reference so bit-identity reduces to the accumulators.
+    Brightness is NOT computed here: a 3-term dot product invites an
+    FMA in whichever fusion context XLA feels like, which costs a ulp
+    of kernel-vs-reference parity — ``input_stats_dict`` derives it
+    deterministically on the host from the mean columns instead."""
+    n = float(n_pixels)
+    mean_c = sums[:, :3] * (1.0 / (255.0 * n))            # [B, 3]
+    ex = (sums[:, 0] + sums[:, 1] + sums[:, 2]) * (1.0 / (255.0 * 3.0 * n))
+    ex2 = sums[:, 3] * (1.0 / (255.0 * 255.0 * 3.0 * n))
+    std = jnp.sqrt(jnp.maximum(ex2 - ex * ex, 0.0))
+    return jnp.concatenate([mean_c, std[:, None]], axis=1)
+
+
+def _to_channels_first(images_u8: jnp.ndarray):
+    B, H, W, _ = images_u8.shape
+    P = H * W
+    P_pad = -(-P // _CHUNK) * _CHUNK
+    x = jnp.transpose(images_u8, (0, 3, 1, 2)).reshape(B, 3, P)
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, P_pad - P)))
+    return x, P, P_pad
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_serve_preprocess(
+    images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
+    interpret: bool = False,
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """One-HBM-pass serve preprocess: returns (normalized float32
+    [B, H, W, 3] in [-1, 1], stats float32 [B, 4] — mean_r, mean_g,
+    mean_b, std). Pinned bit-identical to
+    ``serve_preprocess_reference`` in interpret mode."""
+    B, H, W, _ = images_u8.shape
+    x, P, P_pad = _to_channels_first(images_u8)
+
+    out, sums = pl.pallas_call(
+        _serve_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, 3, P_pad), jnp.float32),
+            jax.ShapeDtypeStruct((B, 4, 1), jnp.float32),
+        ),
+        grid=(B, P_pad // _CHUNK),
+        in_specs=[
+            pl.BlockSpec((1, 3, _CHUNK), lambda b, j: (b, 0, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 3, _CHUNK), lambda b, j: (b, 0, j)),
+            # Constant index: the accumulator block lives in VMEM across
+            # every j step of image b and writes back once b advances.
+            pl.BlockSpec((1, 4, 1), lambda b, j: (b, 0, 0)),
+        ),
+        interpret=interpret,
+    )(x)
+
+    norm = jnp.transpose(out[:, :, :P].reshape(B, 3, H, W), (0, 2, 3, 1))
+    return norm, stats_from_sums(sums[:, :, 0], P)
+
+
+@jax.jit
+def serve_preprocess_reference(
+    images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """The pure-jnp bit-reference (and the live fused-off path): same
+    normalize expression and the same chunk-sequential sum accumulation
+    as the kernel's grid order, so interpret-mode parity is exact, not
+    toleranced."""
+    B, H, W, _ = images_u8.shape
+    x, P, P_pad = _to_channels_first(images_u8)
+    xf = x.astype(jnp.int32).astype(jnp.float32)  # [B, 3, P_pad]
+    norm = xf[:, :, :P] * (1.0 / 127.5) - 1.0
+
+    n_chunks = P_pad // _CHUNK
+
+    def body(j, acc):
+        raw = jax.lax.dynamic_slice(
+            xf, (0, 0, j * _CHUNK), (B, 3, _CHUNK)
+        )
+        ch = jnp.sum(raw, axis=2)                    # [B, 3]
+        sq = jnp.sum(raw * raw, axis=(1, 2))         # [B]
+        return acc + jnp.concatenate([ch, sq[:, None]], axis=1)
+
+    sums = jax.lax.fori_loop(
+        0, n_chunks, body, jnp.zeros((B, 4), jnp.float32)
+    )
+    return (
+        jnp.transpose(norm.reshape(B, 3, H, W), (0, 2, 3, 1)),
+        stats_from_sums(sums, P),
+    )
+
+
+def input_stats_dict(stats: np.ndarray) -> dict:
+    """Stats columns [n, 4] -> the ``input_stat_values``-shaped dict
+    ({stat: float64 [n]}) the QualityMonitor bins. Brightness is
+    derived here in float64 from the mean columns (see
+    ``stats_from_sums`` for why it stays out of the jitted epilogue)."""
+    s = np.asarray(stats, np.float64)
+    bright = s[:, 0] * _LUMA[0] + s[:, 1] * _LUMA[1] + s[:, 2] * _LUMA[2]
+    return {
+        "mean_r": s[:, 0],
+        "mean_g": s[:, 1],
+        "mean_b": s[:, 2],
+        "std": s[:, 3],
+        "brightness": bright,
+    }

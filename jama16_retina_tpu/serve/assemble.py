@@ -185,6 +185,17 @@ def assemble(spec: EngineSpec):
     quality = spec.quality
     if quality is None and cfg.obs.enabled:
         quality = quality_lib.monitor_from_config(cfg.obs.quality)
+    if quality is not None and cfg.serve.fused_preprocess:
+        # Fused serve preprocess (ISSUE 16): the cascade's merged-view
+        # monitor reads its input stats from the fused pass, same as
+        # the engine-level install in serve/engine.py.
+        from jama16_retina_tpu.serve import host as serve_host
+
+        _reg = (spec.registry if spec.registry is not None
+                else obs_registry.default_registry())
+        quality.stats_fn = lambda rows: serve_host.stats_only(
+            rows, fused=True, registry=_reg
+        )
     engine = CascadeEngine(
         cfg,
         ServingEngine(sub, list(student_dirs), model=model, mesh=mesh),

@@ -125,6 +125,29 @@ class CascadeEngine:
                  "stacked ensemble (escalation rate = escalated / "
                  "student rows)",
         )
+        # Speculative escalation (ISSUE 16 tentpole c): dispatch the
+        # ensemble CONCURRENTLY with the student instead of serially,
+        # so an escalated row pays max(student, ensemble) latency
+        # rather than student + ensemble. Results are bit-equal to the
+        # serial cascade (pinned by tests): the ensemble scores at the
+        # same bucket shape either way and rows are independent, so
+        # esc[mask] == ensemble.probs(images[mask]) row for row. The
+        # cost is wasted ensemble work on rows the band never flips —
+        # a counted ledger, not a silent one.
+        self.speculative = bool(getattr(sc, "cascade_speculative", False))
+        self._c_speculated = self.registry.counter(
+            "serve.cascade.speculated",
+            help="rows scored through the ensemble speculatively "
+                 "(concurrently with the student; "
+                 "serve.cascade_speculative)",
+        )
+        self._c_speculated_wasted = self.registry.counter(
+            "serve.cascade.speculated.wasted",
+            help="speculated rows whose ensemble score was discarded "
+                 "because the student landed outside the escalation "
+                 "band (the latency-for-FLOPs trade's cost side)",
+        )
+        self._spec_pool = None
         self.quality = quality
 
     # -- escalation policy -------------------------------------------------
@@ -141,16 +164,45 @@ class CascadeEngine:
 
     # -- the serving surface -----------------------------------------------
 
+    def _spec_submit(self, fn, *args):
+        """Run ``fn`` on the lazily-created speculation thread (one
+        worker: speculative batches are serialized against each other,
+        exactly like the serial cascade's ensemble calls were)."""
+        if self._spec_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._spec_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="jama16-cascade-spec",
+            )
+        return self._spec_pool.submit(fn, *args)
+
     def _probs_raw(self, images: np.ndarray) -> np.ndarray:
         """Score + merge, no quality hook — what the canary scores
         through (canary traffic must never pollute the drift windows,
         the same bypass ServingEngine's member_probs-based canary
         wiring applies)."""
+        spec_fut = None
+        if self.speculative and len(images):
+            # Fire the full-ensemble forward for the WHOLE batch before
+            # the student runs — by the time the student's scores tell
+            # us which rows the band wants, the ensemble is already in
+            # flight (or done). Escalated rows then pay
+            # max(student, ensemble), not student + ensemble.
+            spec_fut = self._spec_submit(self.ensemble.probs, images)
         out = np.asarray(self.student.probs(images))
         n = int(out.shape[0])
         self._c_student_rows.inc(n)
         mask = self.escalation_mask(_referable(out))
-        if mask.any():
+        if spec_fut is not None:
+            esc_all = np.asarray(spec_fut.result())
+            self._c_speculated.inc(n)
+            esc_n = int(mask.sum())
+            self._c_speculated_wasted.inc(n - esc_n)
+            if mask.any():
+                out = np.array(out)
+                out[mask] = esc_all[mask]
+                self._c_escalated_rows.inc(esc_n)
+        elif mask.any():
             out = np.array(out)
             esc = np.asarray(self.ensemble.probs(images[mask]))
             out[mask] = esc
@@ -212,6 +264,14 @@ class CascadeEngine:
 
     def release_retained(self) -> None:
         self.ensemble.release_retained()
+
+    def close(self) -> None:
+        """Stop the speculation thread (idempotent). The student and
+        ensemble engines stay open — their lifecycle belongs to
+        whoever constructed them, same as reload/rollback ownership."""
+        pool, self._spec_pool = self._spec_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
     # -- the go-live gate ---------------------------------------------------
 

@@ -264,6 +264,16 @@ class ServingEngine:
         self.quality = quality_lib.monitor_from_config(
             cfg.obs.quality, registry=self.registry
         ) if cfg.obs.enabled else None
+        if self.quality is not None and cfg.serve.fused_preprocess:
+            # Fused serve preprocess (ISSUE 16): the monitor's input
+            # stats come from the one fused pass (serve/host.stats_only)
+            # instead of a second host-numpy per-pixel sweep.
+            from jama16_retina_tpu.serve import host as serve_host
+
+            _reg = self.registry
+            self.quality.stats_fn = lambda rows: serve_host.stats_only(
+                rows, fused=True, registry=_reg
+            )
         if self.quality is not None and self.quality.canary is not None:
             want = (cfg.model.image_size, cfg.model.image_size, 3)
             got = tuple(self.quality.canary.images.shape[1:])

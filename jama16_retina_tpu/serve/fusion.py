@@ -1,0 +1,190 @@
+"""Cross-engine batch fusion: one device dispatch for rows bound to
+DIFFERENT models (ISSUE 16 tentpole a).
+
+The Router's continuous batching already coalesces rows across request
+boundaries — but only within one model, so two tenants each trickling
+single-image interactive requests each pay their own b4-class dispatch
+(the 5.5x small-batch efficiency cliff, BENCH_r05 device_only_b4 vs
+b128). When ``serve.router_fusion`` is on, the dispatch tick is allowed
+to cut bins that MIX models, and this module scores them:
+
+  * FUSED: when every engine in the bin lowers the same serving
+    program (same ``compilecache.model_fingerprint`` + serving dtype +
+    mesh-less), their stacked member states concatenate along the
+    member axis into one tree and ONE stacked forward scores the whole
+    bin for every member of every model; the demux slices each model's
+    member rows back out and ensemble-averages them exactly like
+    ``ServingEngine.probs`` (``metrics.ensemble_average``);
+  * GROUPED: engines whose programs differ (or stubs/cascades without
+    engine internals) fall back to one ``probs`` call per model over
+    that model's rows, scattered back by index — still one bin, one
+    replica charge, one completion path.
+
+Either way every output row is attributed to its (model, replica,
+generation): generation handles are pinned ONCE per model before any
+dispatch (the engine's reload-attribution discipline), and the router
+records per-part segments with the model name. Row order never
+changes — demux writes through the same index sets the mux read.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.serve import compilecache
+
+
+def fusion_token(engine) -> "tuple | None":
+    """The program identity under which two engines may share one
+    stacked forward: ``model_fingerprint`` (arch/head/size/member
+    form/TTA/backend…) plus the serving dtype (the int8 path bakes its
+    dequant into the program). None = this engine cannot fuse (no
+    engine internals — a stub or cascade — or a sharded mesh engine,
+    whose placement this module does not reproduce)."""
+    if not (hasattr(engine, "_step") and hasattr(engine, "_gen")
+            and hasattr(engine, "cfg")):
+        return None
+    if getattr(engine, "_batch_sharding", None) is not None:
+        return None
+    fp = compilecache.model_fingerprint(engine.cfg, mesh=None)
+    fp["serve_dtype"] = str(getattr(engine, "dtype", "fp32"))
+    return tuple(sorted(fp.items()))
+
+
+class FusionCache:
+    """Concatenated stacked-state cache: re-concatenating k_total
+    member trees per dispatch would cost a device copy of every
+    parameter every bin. Keyed by the exact (model, engine identity,
+    generation) tuple — a reload on ANY fused engine misses and
+    rebuilds, so a fused forward never scores a retired generation.
+    Holds one entry (the live combination): fused serving churns
+    generations, not combinations."""
+
+    def __init__(self):
+        self._key = None
+        self._state = None
+
+    def fused_state(self, pinned: "list[tuple[str, object, object]]"):
+        """``pinned``: [(model, engine, generation-handle), ...] in bin
+        member order. Returns the concatenated stacked state plus the
+        per-model member spans [(model, k_lo, k_hi), ...]."""
+        import jax
+        import jax.numpy as jnp
+
+        key = tuple(
+            (m, id(e), int(g.gen_id)) for m, e, g in pinned
+        )
+        spans = []
+        k = 0
+        for m, _e, g in pinned:
+            spans.append((m, k, k + int(g.n_members)))
+            k += int(g.n_members)
+        if key != self._key:
+            states = [g.state for _m, _e, g in pinned]
+            self._state = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *states
+            )
+            self._key = key
+        return self._state, spans
+
+
+def _model_spans(parts) -> "list[tuple[str, int, int]]":
+    """Bin-row spans per part, in bin order: the mux layout
+    ``_make_bin_locked`` produced, reused verbatim for the demux."""
+    spans = []
+    lo = 0
+    for req, req_lo, req_hi in parts:
+        hi = lo + (req_hi - req_lo)
+        spans.append((req.model, lo, hi))
+        lo = hi
+    return spans
+
+
+def score_mixed(
+    engines_by_model: dict,
+    rows: np.ndarray,
+    parts,
+    bucket: int,
+    cache: "FusionCache | None" = None,
+) -> "tuple[np.ndarray, dict]":
+    """Score one (possibly multi-model) bin: returns
+    ``(out [n, ...], {model: generation})`` with row i of ``out``
+    scored by the engine of row i's model. Tries the single fused
+    dispatch first; engines that cannot fuse take the grouped path.
+    """
+    spans = _model_spans(parts)
+    models = []
+    for m, _lo, _hi in spans:
+        if m not in models:
+            models.append(m)
+
+    tokens = {m: fusion_token(engines_by_model[m]) for m in models}
+    if (len(models) > 1
+            and all(t is not None for t in tokens.values())
+            and len(set(tokens.values())) == 1):
+        return _score_fused(engines_by_model, rows, spans, models,
+                            bucket, cache)
+    return _score_grouped(engines_by_model, rows, spans, models)
+
+
+def _score_fused(engines_by_model, rows, spans, models, bucket, cache):
+    import jax
+
+    # Pin every model's generation handle ONCE, before any device work
+    # (the engine's own reload-attribution rule): a concurrent reload
+    # swaps the NEXT bin's states, never splits this one. Sorted, not
+    # bin order: the member axis must not depend on which tenant's
+    # request led the bin, or an a-led / b-led alternation would miss
+    # the one-entry FusionCache every dispatch and pay the full
+    # stacked-params concat (a device copy of every parameter) per bin.
+    pinned = [(m, engines_by_model[m], engines_by_model[m]._gen)
+              for m in sorted(models)]
+    if cache is None:
+        cache = FusionCache()
+    state, member_spans = cache.fused_state(pinned)
+
+    n = int(rows.shape[0])
+    pad_rows = max(0, int(bucket) - n)
+    padded = (np.concatenate(
+        [rows, np.zeros((pad_rows, *rows.shape[1:]), rows.dtype)])
+        if pad_rows else rows)
+    step = pinned[0][1]._step
+    placed = jax.device_put(padded, jax.local_devices()[0])
+    member = np.asarray(jax.device_get(
+        step(state, {"image": placed})
+    ))[:, :n]
+
+    out = None
+    for m, k_lo, k_hi in member_spans:
+        avg = metrics.ensemble_average(list(member[k_lo:k_hi]))
+        if out is None:
+            out = np.empty((n, *avg.shape[1:]), avg.dtype)
+        for sm, lo, hi in spans:
+            if sm == m:
+                out[lo:hi] = avg[lo:hi]
+    gens = {m: int(g.gen_id) for m, _e, g in pinned}
+    return out, gens
+
+
+def _score_grouped(engines_by_model, rows, spans, models):
+    out = None
+    gens = {}
+    for m in models:
+        idx = np.concatenate([
+            np.arange(lo, hi) for sm, lo, hi in spans if sm == m
+        ])
+        eng = engines_by_model[m]
+        if hasattr(eng, "probs_with_generation"):
+            res, gen = eng.probs_with_generation(rows[idx])
+        else:
+            res = eng.probs(rows[idx])
+            gen = int(getattr(eng, "generation", 0))
+        res = np.asarray(res)
+        if out is None:
+            out = np.empty(
+                (int(rows.shape[0]), *res.shape[1:]), res.dtype
+            )
+        out[idx] = res
+        gens[m] = int(gen)
+    return out, gens

@@ -194,3 +194,62 @@ def preprocess_paths(
         images=images, kept=kept, skipped=skipped, qualities=qualities,
         retried=retried,
     )
+
+
+def prepare_images(
+    images_u8: np.ndarray,
+    *,
+    fused: bool = False,
+    interpret: "bool | None" = None,
+    registry: "obs_registry.Registry | None" = None,
+) -> "tuple[np.ndarray, dict | None]":
+    """Device-side serve preprocess for a uint8 batch: returns the
+    normalized float32 rows plus (fused path only) the INPUT_STATS dict
+    the quality monitor would otherwise recompute with its own
+    per-pixel pass.
+
+    ``fused=False`` (the default until serving-policy v2 opts in) runs
+    the pure-jnp reference — the bit-reference the Pallas kernel is
+    pinned against. ``fused=True`` runs the fused kernel
+    (ops/pallas_serve.py); ``interpret`` defaults to interpret mode off
+    TPU so tests and CPU smoke paths exercise the same kernel body.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu.ops import pallas_serve
+
+    x = jnp.asarray(np.ascontiguousarray(images_u8))
+    if not fused:
+        norm, stats = pallas_serve.serve_preprocess_reference(x)
+        return np.asarray(norm), pallas_serve.input_stats_dict(
+            np.asarray(stats))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    norm, stats = pallas_serve.fused_serve_preprocess(
+        x, interpret=bool(interpret))
+    reg = registry if registry is not None else obs_registry.default_registry()
+    reg.counter(
+        "serve.preprocess.fused_rows",
+        help="rows normalized by the fused Pallas serve preprocess "
+             "(normalize + channel stats + layout in one pass; "
+             "serve.fused_preprocess)",
+    ).inc(int(images_u8.shape[0]))
+    return np.asarray(norm), pallas_serve.input_stats_dict(np.asarray(stats))
+
+
+def stats_only(
+    images_u8: np.ndarray,
+    *,
+    fused: bool = False,
+    interpret: "bool | None" = None,
+    registry: "obs_registry.Registry | None" = None,
+) -> dict:
+    """INPUT_STATS dict for a uint8 batch via the (fused or reference)
+    preprocess — the drop-in ``QualityMonitor.stats_fn`` replacement
+    predict.py installs when ``serve.fused_preprocess`` is on, so the
+    monitor's input histograms stop paying a separate host-numpy
+    per-pixel pass."""
+    _, stats = prepare_images(
+        images_u8, fused=fused, interpret=interpret, registry=registry)
+    return stats

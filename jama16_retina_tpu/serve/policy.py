@@ -54,7 +54,19 @@ from absl import logging as absl_logging
 from jama16_retina_tpu.integrity import artifact as artifact_lib
 
 FORMAT = "jama16.serve_policy"
-VERSION = 1
+# v2 (ISSUE 16): adds per-priority-class knobs (``classes``) derived
+# from p99-under-SLO at a target offered load — the interactive class
+# opts into the int8 student, speculative escalation, batch fusion and
+# the fused preprocess — plus the per-bucket p99 ledger the choice was
+# made from. v1 artifacts still load (their class table is empty, so
+# they apply exactly the knobs they always did).
+VERSION = 2
+COMPAT_VERSIONS = (1, VERSION)
+# Interactive class rule: a bucket this small is single-request
+# territory — the derived class rides the cheap path (int8 student +
+# speculation + fusion) there; bigger interactive buckets keep the
+# engine dtype.
+INTERACTIVE_SMALL_BUCKET = 8
 
 # The knee rule: the smallest bucket within this fraction of the
 # sweep's best throughput is chosen as max_batch (module-level so the
@@ -89,6 +101,12 @@ class ServePolicy:
     fingerprint: dict
     source: dict
     version: str = ""
+    # v2: per-priority-class knob table ({"interactive": {...},
+    # "batch": {...}}) and the per-bucket p99 ledger (bucket -> best
+    # point's p99_ms) the interactive choice was made from. Both empty
+    # on a loaded v1 artifact.
+    classes: dict = dataclasses.field(default_factory=dict)
+    per_bucket_p99: dict = dataclasses.field(default_factory=dict)
 
     def payload(self) -> dict:
         return {
@@ -101,6 +119,12 @@ class ServePolicy:
             "shed_queue_depth": int(self.shed_queue_depth),
             "fingerprint": dict(self.fingerprint),
             "source": dict(self.source),
+            "classes": {
+                k: dict(v) for k, v in self.classes.items()
+            },
+            "per_bucket_p99": {
+                str(k): v for k, v in self.per_bucket_p99.items()
+            },
         }
 
 
@@ -135,15 +159,78 @@ def frontier_from_bench_json(obj: dict) -> list:
     )
 
 
+def _interactive_class(points: list, slo_p99_ms: float,
+                       target_images_per_sec: float) -> dict:
+    """The v2 interactive-class rule: optimize p99 UNDER the SLO at the
+    target offered load, not knee throughput — among all swept points
+    with p99 <= SLO and rate >= target, take the LOWEST p99 (ties to
+    the smaller bucket). Unsatisfiable constraints relax loudly: first
+    the target is dropped, then the SLO, so the class always derives
+    (the knee rule already guards the batch class). A small chosen
+    bucket opts the class into the whole interactive fast path —
+    int8 student, speculative escalation, batch fusion, fused
+    preprocess — which ``apply_policy`` only applies to config fields
+    still at their defaults."""
+    with_p99 = [p for p in points if p.get("p99_ms") is not None]
+    if not with_p99:
+        return {}
+    pool = with_p99
+    if slo_p99_ms > 0:
+        under = [p for p in pool if p["p99_ms"] <= slo_p99_ms]
+        if under:
+            pool = under
+        else:
+            absl_logging.warning(
+                "no frontier point meets interactive p99 <= %g ms; "
+                "interactive class minimizes p99 unconstrained",
+                slo_p99_ms,
+            )
+    if target_images_per_sec > 0:
+        loaded = [
+            p for p in pool
+            if p["images_per_sec"] >= target_images_per_sec
+        ]
+        if loaded:
+            pool = loaded
+        else:
+            absl_logging.warning(
+                "no frontier point under the SLO sustains %g img/s; "
+                "interactive class drops the load target",
+                target_images_per_sec,
+            )
+    chosen = min(pool, key=lambda p: (p["p99_ms"], int(p["bucket"])))
+    bucket = int(chosen["bucket"])
+    p50 = float(chosen.get("p50_ms") or 2.0)
+    cls = {
+        "bucket": bucket,
+        "max_wait_ms": round(min(25.0, max(1.0, p50 / 2.0)), 2),
+        "p99_ms": float(chosen["p99_ms"]),
+        "concurrency": int(chosen.get("concurrency") or 1),
+        "speculative": True,
+        "fusion": True,
+        "fused_preprocess": True,
+    }
+    if bucket <= INTERACTIVE_SMALL_BUCKET:
+        cls["dtype"] = "int8"
+    return cls
+
+
 def derive_policy(frontier: list, fingerprint: dict,
                   slo_p99_ms: float = 0.0,
-                  source: "dict | None" = None) -> ServePolicy:
+                  source: "dict | None" = None,
+                  target_images_per_sec: float = 0.0) -> ServePolicy:
     """Pure derivation of a ServePolicy from frontier sweep rows
     (``{bucket, concurrency, images_per_sec, p50_ms, p99_ms}``; rows
     whose rate the physics guard withheld — images_per_sec None — are
     skipped). ``slo_p99_ms`` > 0 additionally restricts the bucket
     choice to buckets whose best-throughput point keeps p99 under the
-    SLO; if none qualifies the SLO is ignored, loudly."""
+    SLO; if none qualifies the SLO is ignored, loudly.
+
+    v2: also derives the per-priority-class table — the batch class
+    keeps this knee rule, the interactive class optimizes p99-under-SLO
+    at ``target_images_per_sec`` (``_interactive_class``) — and records
+    every bucket's best-point p99 so a future re-derivation (or an
+    operator) can audit the choice without re-running the sweep."""
     points = [
         p for p in frontier
         if p.get("images_per_sec") is not None and p.get("bucket")
@@ -188,6 +275,17 @@ def derive_policy(frontier: list, fingerprint: dict,
     p50 = float(chosen.get("p50_ms") or 2.0)
     max_wait_ms = round(min(25.0, max(1.0, p50 / 2.0)), 2)
     peak_conc = max(1, int(chosen.get("concurrency") or 1))
+    classes = {
+        "batch": {
+            "bucket": int(max_batch),
+            "max_wait_ms": max_wait_ms,
+        },
+    }
+    interactive = _interactive_class(
+        points, slo_p99_ms, target_images_per_sec
+    )
+    if interactive:
+        classes["interactive"] = interactive
     policy = ServePolicy(
         bucket_sizes=buckets,
         max_batch=int(max_batch),
@@ -196,6 +294,12 @@ def derive_policy(frontier: list, fingerprint: dict,
         shed_queue_depth=SHED_QUEUE_X * peak_conc,
         fingerprint=dict(fingerprint),
         source=dict(source or {}),
+        classes=classes,
+        per_bucket_p99={
+            str(b): (float(p["p99_ms"])
+                     if p.get("p99_ms") is not None else None)
+            for b, p in sorted(best.items())
+        },
     )
     return dataclasses.replace(
         policy, version=_content_version(policy.payload())
@@ -228,12 +332,13 @@ def load_policy(path: str) -> ServePolicy:
             f"{type(e).__name__}: {e} — re-derive with "
             "scripts/derive_serve_policy.py"
         ) from e
-    if obj.get("format") != FORMAT or obj.get("version") != VERSION:
+    if (obj.get("format") != FORMAT
+            or obj.get("version") not in COMPAT_VERSIONS):
         raise PolicyStale(
             f"policy artifact {path} is "
             f"{obj.get('format')!r} v{obj.get('version')!r}, this code "
-            f"reads {FORMAT!r} v{VERSION} — re-derive with "
-            "scripts/derive_serve_policy.py"
+            f"reads {FORMAT!r} v{sorted(COMPAT_VERSIONS)} — re-derive "
+            "with scripts/derive_serve_policy.py"
         )
     expected = {
         "bucket_sizes", "max_batch", "max_wait_ms", "shed_in_flight",
@@ -258,6 +363,12 @@ def load_policy(path: str) -> ServePolicy:
         fingerprint=dict(obj["fingerprint"]),
         source=dict(obj.get("source") or {}),
         version=str(obj.get("policy_version") or ""),
+        # Absent on a v1 artifact: it keeps loading (version bump
+        # contract) and applies exactly the knobs it always did.
+        classes={
+            k: dict(v) for k, v in (obj.get("classes") or {}).items()
+        },
+        per_bucket_p99=dict(obj.get("per_bucket_p99") or {}),
     )
 
 
@@ -296,6 +407,24 @@ def apply_policy(cfg, policy: ServePolicy) -> "tuple[object, list]":
         updates["shed_in_flight"] = policy.shed_in_flight
     if sc.shed_queue_depth == defaults.shed_queue_depth:
         updates["shed_queue_depth"] = policy.shed_queue_depth
+    # v2 interactive class: the ONLY way the speculative / fusion /
+    # fused-preprocess machinery turns on by policy (they ship off by
+    # default; the derived class opts the deployment in) — still under
+    # the hand-set-wins rule, knob by knob.
+    interactive = policy.classes.get("interactive") or {}
+    if interactive:
+        if (interactive.get("dtype")
+                and sc.dtype == defaults.dtype):
+            updates["dtype"] = str(interactive["dtype"])
+        if (interactive.get("speculative")
+                and sc.cascade_speculative == defaults.cascade_speculative):
+            updates["cascade_speculative"] = True
+        if (interactive.get("fusion")
+                and sc.router_fusion == defaults.router_fusion):
+            updates["router_fusion"] = True
+        if (interactive.get("fused_preprocess")
+                and sc.fused_preprocess == defaults.fused_preprocess):
+            updates["fused_preprocess"] = True
     if not updates:
         return cfg, []
     new_cfg = cfg.replace(serve=dataclasses.replace(sc, **updates))

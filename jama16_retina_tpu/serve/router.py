@@ -190,13 +190,15 @@ class _Replica:
     NAMESPACE_METRICS = ("rows", "dispatches", "failures",
                          "in_flight_rows")
 
-    __slots__ = ("rid", "engine", "state", "queue", "in_flight_rows",
-                 "rows", "window_rows", "buckets_served", "thread",
-                 "c_rows", "c_dispatches", "c_failures", "g_in_flight")
+    __slots__ = ("rid", "engine", "model", "state", "queue",
+                 "in_flight_rows", "rows", "window_rows",
+                 "buckets_served", "thread", "c_rows", "c_dispatches",
+                 "c_failures", "g_in_flight")
 
-    def __init__(self, rid: int, engine, registry):
+    def __init__(self, rid: int, engine, registry, model: str = "default"):
         self.rid = rid
         self.engine = engine
+        self.model = model
         self.state = ACTIVE
         self.queue: "queue.Queue" = queue.Queue()
         self.in_flight_rows = 0   # bins queued or scoring (router lock)
@@ -239,16 +241,17 @@ class _Request:
     """One routed request: its rows, class, deadline, and the
     reassembly state its bins complete into."""
 
-    __slots__ = ("rows", "n", "priority", "future", "t_submit",
+    __slots__ = ("rows", "n", "priority", "model", "future", "t_submit",
                  "t_deadline", "ctx", "trace_id", "offset", "parts",
                  "parts_done", "results", "segments", "failed",
                  "t_first_score", "t_done_score")
 
     def __init__(self, rows: np.ndarray, priority: str,
-                 t_deadline: "float | None"):
+                 t_deadline: "float | None", model: str = "default"):
         self.rows = rows
         self.n = int(rows.shape[0])
         self.priority = priority
+        self.model = model
         self.future: Future = Future()
         self.t_submit = time.monotonic()
         self.t_deadline = t_deadline
@@ -289,12 +292,22 @@ class Router:
     """The front door: ``submit()`` rows with a priority class, get a
     Future; N replica engines serve re-binned batches behind it.
 
-    ``engines``: the initial replica engines (ReplicaHandle contract).
+    ``engines``: the initial replica engines (ReplicaHandle contract) —
+    a list (one model, named "default") or a dict
+    ``{model_name: engine-or-list}`` for multi-tenant routing (ISSUE
+    16): requests carry ``submit(..., model=...)`` and only bin onto
+    that model's replicas. With ``serve.router_fusion`` on, bins may
+    MIX models — rows of different tenants share one device dispatch
+    (one stacked forward when the engines' serving programs agree,
+    grouped per-model calls otherwise; serve/fusion.py) and demux by
+    offset with per-(model, replica, generation) attribution.
     ``replica_factory(rid) -> engine``: how the router builds MORE
     replicas — when present the scaler's decisions are ACTED on
     (activate/drain); without one the scaler only publishes its
     desired-replica gauge. When ``engines`` is None the factory builds
-    ``cfg.serve.router_replicas`` replicas up front.
+    ``cfg.serve.router_replicas`` replicas up front. A factory is a
+    single-model ("default") affair — the scaler has no per-tenant
+    signal to act on.
 
     The policy artifact seam (``serve.policy_from``) is applied by the
     CALLER (``policy.maybe_apply_policy``) before construction — the
@@ -316,9 +329,38 @@ class Router:
             raise ValueError(
                 "Router needs engines=[...] and/or a replica_factory"
             )
+        if isinstance(engines, dict):
+            engines_by_model = {
+                str(m): (list(e) if isinstance(e, (list, tuple)) else [e])
+                for m, e in engines.items()
+            }
+            if not engines_by_model or not all(
+                    v for v in engines_by_model.values()):
+                raise ValueError(
+                    "engines dict needs >= 1 engine per model"
+                )
+            if replica_factory is not None and (
+                    len(engines_by_model) > 1
+                    or "default" not in engines_by_model):
+                raise ValueError(
+                    "replica_factory is single-model: use "
+                    "engines={'default': [...]} or a plain list with it"
+                )
+        elif engines is not None:
+            engines_by_model = {"default": list(engines)}
+        else:
+            engines_by_model = None  # factory builds "default" below
         self.cfg = cfg
         self.dispatch_policy = sc.router_policy
         self._buckets = resolve_buckets(sc)
+        self.models = (
+            tuple(engines_by_model) if engines_by_model is not None
+            else ("default",)
+        )
+        self.fusion = bool(getattr(sc, "router_fusion", False))
+        self._fusion_cache = None
+        self._c_fused_bins = None
+        self._c_fused_rows = None
         self.max_wait_s = max(0.0, float(sc.max_wait_ms)) / 1e3
         self._tick_s = max(5e-4, float(sc.router_tick_ms) / 1e3)
         self.shed_rows = int(sc.router_shed_rows)
@@ -373,6 +415,25 @@ class Router:
             help="requests split across more than one dispatch bin "
                  "(continuous batching across bucket boundaries)",
         )
+        if self.fusion:
+            # Registered only when fusion is on (the escalations
+            # discipline: a fusion-less router must not export a
+            # spurious always-zero series from its own construction).
+            from jama16_retina_tpu.serve import fusion as fusion_lib
+
+            self._fusion_cache = fusion_lib.FusionCache()
+            self._c_fused_bins = reg.counter(
+                "serve.router.fused_bins",
+                help="dispatch bins that mixed rows from more than one "
+                     "model (cross-tenant batch fusion; "
+                     "serve.router_fusion)",
+            )
+            self._c_fused_rows = reg.counter(
+                "serve.router.fused_rows",
+                help="rows dispatched inside mixed-model bins (each "
+                     "demuxed back to its own (model, replica, "
+                     "generation) attribution)",
+            )
         self._c_retried = reg.counter(
             "serve.router.retried_bins",
             help="bins retried on a sibling after a replica dispatch "
@@ -471,6 +532,7 @@ class Router:
         self._q_interactive: deque = deque()
         self._q_batch: deque = deque()
         self._queued_rows = 0
+        self._queued_by_model = {m: 0 for m in self.models}
         self._in_flight_rows = 0
         self._closed = False
         self._replicas: "list[_Replica]" = []
@@ -490,13 +552,18 @@ class Router:
         self._row_shape: "tuple | None" = None
         self._row_dtype = None
 
-        if engines is None:
+        if engines_by_model is None:
             n = max(1, int(sc.router_replicas))
-            engines = [replica_factory(r) for r in range(n)]
+            engines_by_model = {
+                "default": [replica_factory(r) for r in range(n)]
+            }
+        n_engines = 0
         with self._work:
-            for eng in engines:
-                self._add_replica_locked(eng)
-        self._g_desired.set(len(engines))
+            for model, engs in engines_by_model.items():
+                for eng in engs:
+                    self._add_replica_locked(eng, model=model)
+                    n_engines += 1
+        self._g_desired.set(n_engines)
 
         self._tick_thread = threading.Thread(
             target=self._tick_loop, name="jama16-serve-router", daemon=True
@@ -513,14 +580,15 @@ class Router:
 
     # -- replica table (all *_locked: caller holds self._work) -------------
 
-    def _add_replica_locked(self, engine) -> "_Replica":
+    def _add_replica_locked(self, engine,
+                            model: str = "default") -> "_Replica":
         retire = self._next_rid - self.REPLICA_ROWS_KEEP
         if retire >= 0 and not any(
                 r.rid == retire and r.state in (ACTIVE, DRAINING)
                 for r in self._replicas):
             for metric in _Replica.NAMESPACE_METRICS:
                 self.registry.remove(f"serve.replica{retire}.{metric}")
-        rep = _Replica(self._next_rid, engine, self.registry)
+        rep = _Replica(self._next_rid, engine, self.registry, model=model)
         self._next_rid += 1
         self._replicas.append(rep)
         rep.thread = threading.Thread(
@@ -559,13 +627,20 @@ class Router:
     # -- admission (class-aware shedding; ISSUE 12) ------------------------
 
     def submit(self, rows: np.ndarray, priority: str = "interactive",
-               deadline_ms: "float | None" = None) -> Future:
+               deadline_ms: "float | None" = None,
+               model: str = "default") -> Future:
         """Enqueue ``rows`` ([n, ...], n >= 1) under a priority class;
         the Future resolves to the per-row scores in row order (bins
         reassembled by offset). The resolved Future additionally
-        carries ``.segments`` — ``[{lo, hi, replica, generation}, ...]``
-        — so every response row is attributable to the replica and
-        model generation that served it.
+        carries ``.segments`` —
+        ``[{lo, hi, model, replica, generation}, ...]`` — so every
+        response row is attributable to the model, replica and
+        generation that served it.
+
+        ``model``: which tenant's replicas serve the rows (the names
+        the router was constructed with; a plain engines list is the
+        single tenant "default"). Rows of different models only share
+        a dispatch bin under ``serve.router_fusion``.
 
         Raises typed ``Overloaded`` (PR 6) at the class-aware row
         threshold: batch sheds at ``router_batch_shed_frac`` of
@@ -581,6 +656,12 @@ class Router:
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}"
+            )
+        if model not in self._queued_by_model:
+            raise ValueError(
+                f"unknown model {model!r}: this router serves "
+                f"{self.models} — rejected at submit so a mistargeted "
+                "request cannot sit unbinnable in the queue"
             )
         if deadline_ms is None:
             deadline_ms = self.cfg.serve.default_deadline_ms
@@ -628,10 +709,12 @@ class Router:
                 rows, priority,
                 t_deadline=(time.monotonic() + deadline_ms / 1e3
                             if deadline_ms and deadline_ms > 0 else None),
+                model=model,
             )
             (self._q_interactive if priority == "interactive"
              else self._q_batch).append(req)
             self._queued_rows += n
+            self._queued_by_model[model] += n
             self._g_queue_rows.set(self._queued_rows)
             (self._c_req_interactive if priority == "interactive"
              else self._c_req_batch).inc()
@@ -687,9 +770,29 @@ class Router:
                     "continues): %s: %s", type(e).__name__, e,
                 )
             if not assignments:
-                # Nothing dispatchable: don't spin at CPU speed while a
-                # partial bin waits out max_wait_ms.
-                time.sleep(self._tick_s / 4)
+                # Nothing dispatchable: a partial is waiting out its
+                # coalescing window. Sleep exactly until the OLDEST
+                # waiter's window expires (capped at a tick) on the
+                # condition — not a fixed fraction of the tick — so a
+                # lone interactive request's queue_wait is bounded by
+                # its own max_wait_ms, not by tick granularity, and a
+                # new submit (notify_all) that completes a bucket wakes
+                # the packer immediately.
+                with self._work:
+                    oldest = None
+                    for q in (self._q_interactive, self._q_batch):
+                        for req in q:
+                            if req.offset < req.n and (
+                                    oldest is None
+                                    or req.t_submit < oldest):
+                                oldest = req.t_submit
+                    if oldest is not None:
+                        delay = (oldest + self.max_wait_s
+                                 - time.monotonic())
+                        if delay > 0:
+                            self._work.wait(
+                                timeout=min(delay, self._tick_s)
+                            )
 
     def _expire_deadlines_locked(self, now: float) -> None:
         """Fail never-binned expired requests typed, before any device
@@ -702,6 +805,7 @@ class Router:
                 if (req.offset == 0 and req.t_deadline is not None
                         and now > req.t_deadline):
                     self._queued_rows -= req.n
+                    self._queued_by_model[req.model] -= req.n
                     self._c_shed_deadline.inc()
                     try:
                         req.future.set_exception(DeadlineExceeded(
@@ -721,10 +825,42 @@ class Router:
         bins (interactive rows first), assign each bin a replica by the
         dispatch policy, and account it in flight. Returns
         [(replica, bin), ...] for the caller to enqueue outside the
-        lock."""
+        lock.
+
+        Bins are cut per PACK GROUP: without fusion each model packs
+        alone (a bin never mixes engines); with ``serve.router_fusion``
+        all models share one group, so a trickle of single-row requests
+        from different tenants fills one bucket together."""
+        if self.fusion or len(self.models) == 1:
+            groups = [set(self.models)]
+        else:
+            groups = [{m} for m in self.models]
         out = []
-        while self._queued_rows > 0:
-            total = self._queued_rows
+        for models in groups:
+            out.extend(self._pack_group_locked(now, models))
+        self._g_queue_rows.set(self._queued_rows)
+        self._g_in_flight_rows.set(self._in_flight_rows)
+        return out
+
+    def _pack_group_locked(self, now: float, models: set) -> list:
+        out = []
+        while True:
+            # A tenant whose replica set vanished fails typed NOW —
+            # its rows must not sit in (or poison) bins nothing can
+            # serve. Other tenants in the group keep packing.
+            live = {r.model for r in self._active_locked()}
+            dead = {
+                m for m in models
+                if self._queued_by_model[m] > 0 and m not in live
+            }
+            if dead:
+                self._fail_all_queued_locked(NoReplicasLeft(
+                    "no active replicas to dispatch to "
+                    f"(model(s) {sorted(dead)})"
+                ), models=dead)
+            total = sum(self._queued_by_model[m] for m in models)
+            if total <= 0:
+                break
             if total >= self._buckets[-1]:
                 take = self._buckets[-1]
             else:
@@ -734,43 +870,52 @@ class Router:
                 oldest = None
                 for q in (self._q_interactive, self._q_batch):
                     for req in q:
-                        if req.offset < req.n and (
-                                oldest is None
-                                or req.t_submit < oldest):
+                        if (req.model in models and req.offset < req.n
+                                and (oldest is None
+                                     or req.t_submit < oldest)):
                             oldest = req.t_submit
                 if oldest is None:
                     break
                 if not self._closed and now - oldest < self.max_wait_s:
                     break
                 take = total
-            reps = self._active_locked()
-            if not reps:
-                self._fail_all_queued_locked(NoReplicasLeft(
-                    "no active replicas to dispatch to"
-                ))
-                break
-            b = self._make_bin_locked(take)
+            b = self._make_bin_locked(take, models)
+            # The bin is charged to ONE replica — the first part's
+            # model (FIFO makes that the oldest waiter's tenant); a
+            # mixed bin borrows sibling engines at score time.
+            primary = b.parts[0][0].model
+            reps = [
+                r for r in self._active_locked() if r.model == primary
+            ]
             rep = self._choose_replica_locked(reps, b)
             b.tried.add(rep.rid)
             rep.in_flight_rows += b.rows.shape[0]
             rep.g_in_flight.set(rep.in_flight_rows)
             self._in_flight_rows += b.rows.shape[0]
             self._c_dispatches.inc()
+            if self._c_fused_bins is not None and len(
+                    {req.model for req, _lo, _hi in b.parts}) > 1:
+                self._c_fused_bins.inc()
+                self._c_fused_rows.inc(int(b.rows.shape[0]))
             out.append((rep, b))
-        self._g_queue_rows.set(self._queued_rows)
-        self._g_in_flight_rows.set(self._in_flight_rows)
         return out
 
-    def _make_bin_locked(self, take: int) -> "_Bin":
-        """Cut ``take`` rows FIFO (interactive queue first) into one
-        bin, splitting requests at the boundary; fully-binned requests
-        leave their queue."""
+    def _make_bin_locked(self, take: int, models: set) -> "_Bin":
+        """Cut ``take`` rows FIFO (interactive queue first, restricted
+        to ``models``) into one bin, splitting requests at the
+        boundary; fully-binned requests leave their queue."""
         parts = []
         chunks = []
         remaining = take
         for q in (self._q_interactive, self._q_batch):
-            while remaining > 0 and q:
-                req = q[0]
+            if remaining == 0:
+                break
+            finished = []
+            for req in q:
+                if remaining == 0:
+                    break
+                if req.model not in models or req.offset >= req.n:
+                    continue
                 lo = req.offset
                 hi = min(req.n, lo + remaining)
                 chunks.append(req.rows[lo:hi])
@@ -780,12 +925,11 @@ class Router:
                 if req.parts == 2:  # counted once, at the first split
                     self._c_rebins.inc()
                 remaining -= hi - lo
+                self._queued_by_model[req.model] -= hi - lo
                 if req.offset >= req.n:
-                    q.popleft()
-                else:
-                    break  # bin boundary landed inside this request
-            if remaining == 0:
-                break
+                    finished.append(req)
+            for r in finished:
+                q.remove(r)
         self._queued_rows -= take
         rows = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
         bucket = next(
@@ -810,19 +954,30 @@ class Router:
             if req in q:
                 q.remove(req)
                 self._queued_rows -= req.n - req.offset
+                self._queued_by_model[req.model] -= req.n - req.offset
         self._g_queue_rows.set(self._queued_rows)
 
-    def _fail_all_queued_locked(self, exc: BaseException) -> None:
+    def _fail_all_queued_locked(self, exc: BaseException,
+                                models: "set | None" = None) -> None:
+        """Fail queued requests typed — all of them, or (``models``)
+        only the tenants whose replica set just vanished; other
+        tenants' requests keep their live replicas."""
         for q in (self._q_interactive, self._q_batch):
+            kept = deque()
             while q:
                 req = q.popleft()
+                if models is not None and req.model not in models:
+                    kept.append(req)
+                    continue
                 self._queued_rows -= req.n - req.offset
+                self._queued_by_model[req.model] -= req.n - req.offset
                 req.failed = True
                 self._c_request_failures.inc()
                 try:
                     req.future.set_exception(exc)
                 except InvalidStateError:
                     pass
+            q.extend(kept)
         self._g_queue_rows.set(self._queued_rows)
 
     # -- replica workers ---------------------------------------------------
@@ -848,22 +1003,98 @@ class Router:
                 # injects a replica death here mid-storm.
                 faultinject.check("serve.router.dispatch")
                 with obs_trace.use_context(bin_ctx):
-                    out, gen = rep.score(b.rows)
+                    out, gens = self._score_bin(rep, b)
                 if out.shape[0] != b.rows.shape[0]:
                     raise RuntimeError(
                         f"replica {rep.rid} returned {out.shape[0]} rows "
                         f"for {b.rows.shape[0]} inputs — row contract "
                         "broken"
                     )
+            except NoReplicasLeft as e:
+                # A BORROWED tenant's replicas are gone, not this one:
+                # fail the bin typed without blaming the carrier.
+                self._fail_bin(rep, b, e)
+                continue
             except BaseException as e:  # noqa: BLE001 - retried/typed
                 self._on_dispatch_failure(rep, b, e)
                 if rep.state == FAILED:
                     return
                 continue
-            self._complete_bin(rep, b, out, gen, t0)
+            self._complete_bin(rep, b, out, gens, t0)
+
+    def _score_bin(self, rep: "_Replica",
+                   b: "_Bin") -> "tuple[np.ndarray, dict]":
+        """Score one bin, returning ``(out, {model: generation})``. A
+        bin of the replica's own model goes straight through its
+        engine; a mixed bin (serve.router_fusion) borrows the
+        least-loaded active engine of each other model under the lock
+        and scores through serve/fusion.py — one fused stacked forward
+        when the engines' programs agree, grouped per-model calls
+        otherwise. Rows stay charged to the PRIMARY replica either
+        way (its queue carried the bin); a retry on a sibling
+        re-borrows from a fresh snapshot."""
+        models = []
+        for req, _lo, _hi in b.parts:
+            if req.model not in models:
+                models.append(req.model)
+        if len(models) == 1 and models[0] == rep.model:
+            out, gen = rep.score(b.rows)
+            return out, {rep.model: gen}
+        from jama16_retina_tpu.serve import fusion as fusion_lib
+
+        with self._work:
+            engines = {}
+            for m in models:
+                if m == rep.model and rep.engine is not None:
+                    engines[m] = rep.engine
+                    continue
+                cands = [
+                    r for r in self._active_locked()
+                    if r.model == m and r.engine is not None
+                ]
+                if not cands:
+                    raise NoReplicasLeft(
+                        f"no active replica to borrow an engine for "
+                        f"model {m!r}"
+                    )
+                engines[m] = min(
+                    cands, key=lambda r: (r.in_flight_rows, r.rid)
+                ).engine
+        out, gens = fusion_lib.score_mixed(
+            engines, b.rows, b.parts, b.bucket,
+            cache=self._fusion_cache,
+        )
+        return np.asarray(out), gens
+
+    def _fail_bin(self, rep: "_Replica", b: "_Bin",
+                  exc: BaseException) -> None:
+        """Fail a bin's requests typed WITHOUT marking the replica
+        failed — the bin was unservable (a borrowed tenant's replica
+        set vanished), the carrier is healthy."""
+        n = int(b.rows.shape[0])
+        failed = []
+        with self._work:
+            rep.in_flight_rows -= n
+            rep.g_in_flight.set(max(0, rep.in_flight_rows))
+            self._in_flight_rows -= n
+            self._g_in_flight_rows.set(self._in_flight_rows)
+            for req, _lo, _hi in b.parts:
+                if req.failed:
+                    continue
+                req.failed = True
+                self._c_request_failures.inc()
+                self._purge_request_locked(req)
+                failed.append(req)
+            self._maybe_finish_drain_locked(rep)
+            self._work.notify_all()
+        for req in failed:
+            try:
+                req.future.set_exception(exc)
+            except InvalidStateError:
+                pass
 
     def _complete_bin(self, rep: "_Replica", b: "_Bin",
-                      out: np.ndarray, gen: int, t0: float) -> None:
+                      out: np.ndarray, gens: dict, t0: float) -> None:
         n = int(b.rows.shape[0])
         done = []
         t_done = time.monotonic()
@@ -883,8 +1114,9 @@ class Router:
                 if req.t_first_score is None or t0 < req.t_first_score:
                     req.t_first_score = t0
                 req.segments.append({
-                    "lo": req_lo, "hi": req_hi,
-                    "replica": rep.rid, "generation": int(gen),
+                    "lo": req_lo, "hi": req_hi, "model": req.model,
+                    "replica": rep.rid,
+                    "generation": int(gens[req.model]),
                 })
                 req.parts_done += 1
                 if (req.offset >= req.n and req.parts_done == req.parts
@@ -959,9 +1191,13 @@ class Router:
             for mb in moved:
                 n = int(mb.rows.shape[0])
                 rep.in_flight_rows -= n
+                # Retry siblings must be able to CARRY the bin: same
+                # model as its primary part (the engines for any other
+                # fused-in models are re-borrowed at score time).
+                mb_primary = mb.parts[0][0].model
                 reps = [
                     r for r in self._active_locked()
-                    if r.rid not in mb.tried
+                    if r.rid not in mb.tried and r.model == mb_primary
                 ]
                 if not reps:
                     # Orphan bin: retries exhausted. Fail each carried
@@ -1119,7 +1355,7 @@ class Router:
         with self._work:
             return [
                 {
-                    "replica": r.rid, "state": r.state,
+                    "replica": r.rid, "state": r.state, "model": r.model,
                     "rows": r.rows, "in_flight_rows": r.in_flight_rows,
                     "buckets": sorted(r.buckets_served),
                     "generation": (
@@ -1142,6 +1378,12 @@ class Router:
         return {
             "dispatch_policy": self.dispatch_policy,
             "buckets": [int(b) for b in self._buckets],
+            "models": list(self.models),
+            "fusion": self.fusion,
+            "fused_bins": (
+                int(self._c_fused_bins.value)
+                if self._c_fused_bins is not None else 0
+            ),
             "policy": dict(self._policy_provenance) or None,
             "replicas": self.replica_states(),
             "requests": {

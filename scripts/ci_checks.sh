@@ -24,6 +24,13 @@
 #       (counters == sum, pinned), fresh fleet heartbeats, a stitched
 #       multi-lane Chrome trace, and --check-fleet exit codes —
 #       scripts/fleet_smoke.py.
+#   bash scripts/ci_checks.sh --interactive-smoke
+#       lint + the interactive-latency smoke (ISSUE 16): fused
+#       preprocess bit-identity, speculative == serial cascade
+#       bit-equality, single-row wake-up under a coarse tick, a
+#       two-tenant fused bin demuxed with full attribution, and the
+#       v2 policy round-trip with v1 back-compat —
+#       scripts/interactive_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -60,6 +67,12 @@ fi
 if [[ "${1:-}" == "--fleet-smoke" ]]; then
     echo "== fleet observability smoke (3-process segment bus) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/fleet_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--interactive-smoke" ]]; then
+    echo "== interactive latency smoke (fusion + speculation + policy v2) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/interactive_smoke.py
     exit 0
 fi
 

@@ -53,6 +53,12 @@ _SLO_P99_MS = flags.DEFINE_float(
     "optional p99 latency SLO: restrict the bucket choice to frontier "
     "points meeting it (0 = throughput-knee rule alone)",
 )
+_TARGET_IPS = flags.DEFINE_float(
+    "target_images_per_sec", 0.0,
+    "offered load the v2 interactive class must sustain while "
+    "minimizing p99 under the SLO (0 = minimize p99 without a load "
+    "floor)",
+)
 
 
 def main(argv):
@@ -77,7 +83,9 @@ def main(argv):
             "frontier_points": len(frontier),
             "config": _CONFIG.value,
             "slo_p99_ms": _SLO_P99_MS.value,
+            "target_images_per_sec": _TARGET_IPS.value,
         },
+        target_images_per_sec=_TARGET_IPS.value,
     )
     path = policy_lib.save_policy(_OUT.value, policy)
     print(json.dumps({
@@ -89,6 +97,8 @@ def main(argv):
         "shed_in_flight": policy.shed_in_flight,
         "shed_queue_depth": policy.shed_queue_depth,
         "fingerprint": policy.fingerprint,
+        "classes": policy.classes,
+        "per_bucket_p99": policy.per_bucket_p99,
     }, indent=1))
     return 0
 

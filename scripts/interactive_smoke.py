@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""CI interactive-latency smoke (ISSUE 16 satellite;
+scripts/ci_checks.sh --interactive-smoke): drive every limb of the
+interactive serving path end to end, off-TPU, and assert the bit-level
+contracts the bench rows only time:
+
+  1. fused serve preprocess (ops/pallas_serve.py, interpret mode) is
+     BIT-IDENTICAL to its jnp reference on single- and multi-chunk
+     shapes, and its stats agree with obs.quality's per-image path;
+  2. speculative cascade scores are BIT-EQUAL to the serial cascade on
+     identical inputs, with the speculated/wasted counters accounting
+     every row;
+  3. a lone single-row interactive request through a Router running a
+     deliberately coarse 250 ms tick completes at service-time scale —
+     the submit wake-up bounds queue wait by the request's own window,
+     not the tick;
+  4. a mixed two-tenant bin (serve.router_fusion) demuxes every row
+     back to its own model bit-equal to each engine scored directly,
+     with (model, replica, generation) attribution on every segment;
+  5. a v2 policy derived from a synthetic small-bucket frontier
+     round-trips save -> load -> apply and opts the interactive knobs
+     in; a hand-written v1 artifact still loads (empty class table).
+
+Exit 0 = every step held; 1 = a step failed (message says which).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    import numpy as np
+
+    from jama16_retina_tpu import configs, models, train_lib
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+    from jama16_retina_tpu.obs import quality as quality_lib
+    from jama16_retina_tpu.obs.registry import Registry
+    from jama16_retina_tpu.ops import pallas_serve
+    from jama16_retina_tpu.serve import fusion as fusion_lib
+    from jama16_retina_tpu.serve import policy as policy_lib
+    from jama16_retina_tpu.serve.cascade import CascadeEngine
+    from jama16_retina_tpu.serve.engine import ServingEngine
+    from jama16_retina_tpu.serve.router import Router
+
+    rng = np.random.default_rng(16)
+
+    # 1) Fused preprocess: bit-identity against the jnp reference
+    #    (single-chunk and multi-chunk shapes), stats vs obs.quality.
+    for shape in ((3, 32, 32, 3), (2, 128, 128, 3)):
+        imgs = rng.integers(0, 256, shape, np.uint8)
+        norm_k, stats_k = pallas_serve.fused_serve_preprocess(
+            imgs, interpret=True
+        )
+        norm_r, stats_r = pallas_serve.serve_preprocess_reference(imgs)
+        if not (np.array_equal(np.asarray(norm_k), np.asarray(norm_r))
+                and np.array_equal(np.asarray(stats_k),
+                                   np.asarray(stats_r))):
+            return _fail(f"fused preprocess not bit-identical to the "
+                         f"jnp reference at {shape}")
+        got = pallas_serve.input_stats_dict(np.asarray(stats_k))
+        want = quality_lib.input_stat_values(imgs)
+        for k in quality_lib.INPUT_STATS:
+            if not np.allclose(got[k], np.asarray(want[k], np.float64),
+                               atol=1e-4):
+                return _fail(f"fused stat {k} disagrees with "
+                             f"obs.quality at {shape}")
+    print("ok: fused preprocess bit-identical (norm + stats), stats "
+          "agree with obs.quality")
+
+    # 2) Speculative cascade bit-equal to serial, counters exact.
+    class _Stub:
+        def __init__(self, kind):
+            self.kind = kind
+            self.generation = 1
+
+        def probs(self, rows):
+            flat = rows.reshape(rows.shape[0], -1).astype(np.float64)
+            if self.kind == "student":
+                return (flat.sum(axis=1) % 7) / 10.0  # some in-band
+            return flat.sum(axis=1)
+
+    base = configs.get_config("smoke")
+    rows16 = rng.integers(0, 256, (16, 2, 2, 3), np.uint8)
+
+    def cascade_out(speculative):
+        reg = Registry()
+        ccfg = base.replace(serve=dataclasses.replace(
+            base.serve, cascade_thresholds=(0.5,), cascade_band=0.2,
+            cascade_speculative=speculative,
+        ))
+        casc = CascadeEngine(ccfg, _Stub("student"), _Stub("ens"),
+                             registry=reg)
+        out = np.asarray(casc.probs(rows16))
+        casc.close()
+        return out, reg.snapshot()["counters"]
+
+    out_spec, c_spec = cascade_out(True)
+    out_serial, c_serial = cascade_out(False)
+    if not np.array_equal(out_spec, out_serial):
+        return _fail("speculative cascade is not bit-equal to serial")
+    spec_n = c_spec.get("serve.cascade.speculated", 0)
+    wasted = c_spec.get("serve.cascade.speculated.wasted", 0)
+    escal = c_spec.get("serve.cascade.escalated_rows", 0)
+    if spec_n != 16 or wasted != spec_n - escal:
+        return _fail(f"speculation ledger wrong: speculated={spec_n}, "
+                     f"wasted={wasted}, escalated={escal}")
+    print(f"ok: speculative == serial bit-equal "
+          f"({int(escal)}/16 escalated, {int(wasted)} wasted "
+          "speculations counted)")
+
+    # 3) Submit wake-up: a lone single-row request under a 250 ms tick
+    #    must complete at service-time scale (well under tick/4).
+    wcfg = base.replace(serve=dataclasses.replace(
+        base.serve, max_batch=4, bucket_sizes=(1, 4), max_wait_ms=2.0,
+        router_tick_ms=250.0, cascade_thresholds=(0.5,),
+        cascade_band=0.2, cascade_speculative=True,
+    ))
+
+    class _Timed(_Stub):
+        def probs(self, rows):
+            time.sleep(2e-3)
+            return super().probs(rows)
+
+    casc = CascadeEngine(wcfg, _Timed("student"), _Timed("ens"),
+                         registry=Registry())
+    router = Router(wcfg, engines=[casc], registry=Registry())
+    try:
+        # The full interactive path for one image: fused preprocess
+        # (bit-pinned above) -> speculative cascade under the router.
+        from jama16_retina_tpu.serve import host as serve_host
+
+        one_norm, _ = serve_host.prepare_images(
+            rows16[:1], fused=True, interpret=True, registry=Registry()
+        )
+        router.submit(one_norm, priority="interactive").result(30)
+        t0 = time.perf_counter()
+        router.submit(one_norm, priority="interactive").result(30)
+        lone_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        router.close()
+        casc.close()
+    if lone_ms >= 250.0 / 4:
+        return _fail(f"lone interactive request took {lone_ms:.1f} ms "
+                     "under a 250 ms tick — the submit wake-up is not "
+                     "bounding queue wait")
+    print(f"ok: lone single-row request {lone_ms:.1f} ms under a "
+          "250 ms tick (wake-up, not tick/4 polling)")
+
+    # 4) Two-tenant fused bin on REAL engines: demux bit-equal to each
+    #    engine direct, full (model, replica, generation) attribution.
+    SB = 4
+    fcfg = base.replace(serve=dataclasses.replace(
+        base.serve, max_batch=2 * SB, bucket_sizes=(SB, 2 * SB),
+        max_wait_ms=25.0, router_tick_ms=5.0, router_fusion=True,
+    ))
+    model = models.build(fcfg.model)
+    st_a, _ = train_lib.create_ensemble_state(fcfg, model, [0])
+    st_b, _ = train_lib.create_ensemble_state(fcfg, model, [1])
+    eng_a = ServingEngine(fcfg, model=model, mesh=None, state=st_a)
+    eng_b = ServingEngine(fcfg, model=model, mesh=None, state=st_b)
+    tok_a = fusion_lib.fusion_token(eng_a)
+    if tok_a is None or tok_a != fusion_lib.fusion_token(eng_b):
+        return _fail("identical mesh-less engines did not produce "
+                     "matching fusion tokens")
+    size = int(fcfg.model.image_size)
+    imgs = rng.integers(0, 256, (2 * SB, size, size, 3), np.uint8)
+    ref_a = np.asarray(eng_a.probs(imgs[:SB]))
+    ref_b = np.asarray(eng_b.probs(imgs[SB:]))
+    reg = Registry()
+    router = Router(fcfg, engines={"a": [eng_a], "b": [eng_b]},
+                    registry=reg)
+    try:
+        futs = {}
+
+        def sub(m, block):
+            futs[m] = router.submit(block, model=m)
+
+        ts = [threading.Thread(target=sub, args=("a", imgs[:SB])),
+              threading.Thread(target=sub, args=("b", imgs[SB:]))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        out_a = np.asarray(futs["a"].result(120))
+        out_b = np.asarray(futs["b"].result(120))
+        seg_a, seg_b = futs["a"].segments, futs["b"].segments
+    finally:
+        router.close()
+    if not (np.array_equal(out_a, ref_a)
+            and np.array_equal(out_b, ref_b)):
+        return _fail("fused bin demux is not bit-equal to the engines "
+                     "scored directly")
+    for m, segs in (("a", seg_a), ("b", seg_b)):
+        if not segs or any(
+            s.get("model") != m or "generation" not in s
+            or "replica" not in s for s in segs
+        ):
+            return _fail(f"tenant {m} segments lack (model, replica, "
+                         f"generation) attribution: {segs}")
+    fused_bins = reg.snapshot()["counters"].get(
+        "serve.router.fused_bins", 0)
+    print(f"ok: two-tenant fused dispatch bit-equal with full "
+          f"attribution ({int(fused_bins)} fused bin(s))")
+
+    # 5) Policy v2 round-trip + v1 back-compat.
+    frontier = [
+        {"bucket": b, "concurrency": c,
+         "images_per_sec": 50.0 * b / (1 + 0.1 * c),
+         "p50_ms": 2.0 * b / 4, "p99_ms": 3.0 * b / 4 + c}
+        for b in (2, 4, 8, 16) for c in (1, 4)
+    ]
+    fp = policy_lib.policy_fingerprint(base, n_devices=1)
+    pol = policy_lib.derive_policy(frontier, fp, slo_p99_ms=15.0,
+                                   target_images_per_sec=40.0)
+    inter = pol.classes.get("interactive")
+    if not inter or inter["bucket"] > policy_lib.INTERACTIVE_SMALL_BUCKET:
+        return _fail(f"derived interactive class missing/oversized: "
+                     f"{pol.classes}")
+    with tempfile.TemporaryDirectory() as wd:
+        ppath = os.path.join(wd, "serve_policy.json")
+        policy_lib.save_policy(ppath, pol)
+        pcfg = base.replace(serve=dataclasses.replace(
+            base.serve, policy_from=ppath))
+        applied_cfg, prov = policy_lib.maybe_apply_policy(pcfg)
+        sc = applied_cfg.serve
+        if not (sc.cascade_speculative and sc.router_fusion
+                and sc.fused_preprocess and sc.dtype == "int8"):
+            return _fail(f"v2 policy did not opt the interactive knobs "
+                         f"in (applied: {prov.get('applied')})")
+        v1 = {
+            "format": policy_lib.FORMAT, "version": 1,
+            "bucket_sizes": [4, 8], "max_batch": 8,
+            "max_wait_ms": 2.0, "shed_in_flight": 8,
+            "shed_queue_depth": 16, "fingerprint": dict(fp),
+            "source": {}, "policy_version": "sp1-smoke",
+        }
+        v1path = os.path.join(wd, "v1_policy.json")
+        artifact_lib.write_sealed_json(v1path, v1,
+                                       schema="serve.policy", version=1)
+        old = policy_lib.load_policy(v1path)
+        if old.classes or old.per_bucket_p99:
+            return _fail("v1 artifact loaded with phantom v2 fields")
+        _, applied_v1 = policy_lib.apply_policy(base, old)
+        if any(k in applied_v1 for k in (
+                "dtype", "cascade_speculative", "router_fusion",
+                "fused_preprocess")):
+            return _fail(f"v1 artifact applied v2 knobs: {applied_v1}")
+        # Stale-fingerprint refusal: a policy derived for a different
+        # model shape must refuse TYPED, never silently misconfigure.
+        stale_fp = dict(fp, image_size=int(fp["image_size"]) * 2)
+        stale = policy_lib.derive_policy(frontier, stale_fp,
+                                         slo_p99_ms=15.0)
+        spath = os.path.join(wd, "stale_policy.json")
+        policy_lib.save_policy(spath, stale)
+        scfg = base.replace(serve=dataclasses.replace(
+            base.serve, policy_from=spath))
+        try:
+            policy_lib.maybe_apply_policy(scfg)
+        except policy_lib.PolicyStale:
+            pass
+        else:
+            return _fail("stale-fingerprint policy was applied instead "
+                         "of refusing typed PolicyStale")
+    print("ok: policy v2 opts the interactive path in; v1 artifacts "
+          "still load and apply only their own knobs")
+
+    print("interactive smoke: all steps held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -247,6 +247,19 @@ _QUICK_TESTS = {
     "test_fleet.py::test_evaluate_fleet_dedupes_records_and_dumps",
     "test_fleet.py::test_stitch_trace_aligns_pid_lanes",
     "test_fleet.py::test_http_metrics_and_healthz_socket_level",
+    # interactive latency frontier (ISSUE 16): the cheap pins — the
+    # fused serve-preprocess bit-identity + stats vocabulary (interpret
+    # mode), speculative==serial bit-equality with its exact ledger
+    # over stub engines, the single-row submit wake-up under a coarse
+    # tick, and the deterministic two-tenant fused-bin demux; the
+    # real-engine fused/int8/reload tests stay in the full tier (XLA
+    # compiles dominate there)
+    "test_pallas_serve.py::test_fused_kernel_bit_identical_to_jnp_reference",
+    "test_pallas_serve.py::test_kernel_stats_agree_with_quality_monitor_vocabulary",
+    "test_cascade.py::test_speculative_bit_equal_to_serial_with_exact_ledger",
+    "test_router.py::test_single_row_wakeup_p99_bounded_by_own_window",
+    "test_router.py::test_multi_model_tenants_isolated_and_validated",
+    "test_router.py::test_fused_mixed_bin_demux_with_full_attribution",
     "test_rawshard.py::test_manifest_schema_and_counts",
     "test_rawshard.py::test_transcode_resumes_from_durable_shards",
     "test_rawshard.py::test_streamed_bit_identity_with_source",

@@ -412,10 +412,13 @@ def test_chaos_smoke_recovers_every_path():
     assert extras["chaos_injections"]["lifecycle.retrain"] == 1
     assert extras["chaos_injections"]["lifecycle.gate"] == 1
     assert extras["chaos_injections"]["lifecycle.swap"] == 1
-    # ISSUE 12: the replica-death drill rode the same plan — one
-    # injected router dispatch failure, zero dropped requests.
-    assert extras["chaos_injections"]["serve.router.dispatch"] == 1
+    # ISSUE 12 + ISSUE 16: the replica-death drill AND the
+    # mid-speculation replica-death drill each delivered one router
+    # dispatch failure into the merged ledger, zero dropped requests
+    # in both.
+    assert extras["chaos_injections"]["serve.router.dispatch"] == 2
     assert extras["chaos_router_zero_drops"] is True
+    assert extras["chaos_speculation_zero_drops"] is True
 
 
 def test_lifecycle_overhead_guard_pins_two_percent():

@@ -599,3 +599,168 @@ def test_fit_distill_from_trains_student(setup, tmp_path):
     ]
     kinds = {r.get("kind") for r in records}
     assert "distill" in kinds and "eval" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Interactive latency (ISSUE 16): speculative escalation + the int8
+# student default path, standalone and behind the Router
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_bit_equal_to_serial_with_exact_ledger():
+    """serve.cascade_speculative changes WHEN the ensemble runs, never
+    WHAT comes back: outputs bit-equal to the serial cascade, the
+    ensemble sees the WHOLE batch exactly once (vs only the band rows
+    serially), and speculated/wasted counters account every row."""
+    def run(speculative):
+        reg = Registry()
+        student = _StubEngine([0.1, 0.45, 0.55, 0.9])
+        ensemble = _StubEngine([0.7, 0.7, 0.7, 0.7])
+        cfg = _cfg(cascade_band=0.2, cascade_thresholds=(0.5,),
+                   cascade_speculative=speculative)
+        casc = CascadeEngine(cfg, student, ensemble, registry=reg)
+        out = np.asarray(casc.probs(_stub_rows(4)))
+        casc.close()
+        return out, ensemble.calls, reg.snapshot()["counters"]
+
+    out_spec, calls_spec, c_spec = run(True)
+    out_serial, calls_serial, c_serial = run(False)
+    np.testing.assert_array_equal(out_spec, out_serial)
+    assert calls_serial == [[1, 2]]          # band rows only
+    assert calls_spec == [[0, 1, 2, 3]]      # full batch, once
+    assert c_spec["serve.cascade.speculated"] == 4
+    assert c_spec["serve.cascade.speculated.wasted"] == 2
+    assert c_spec["serve.cascade.escalated_rows"] == 2
+    assert c_serial["serve.cascade.speculated"] == 0
+    assert c_serial["serve.cascade.speculated.wasted"] == 0
+
+
+def test_speculative_bit_equal_to_serial_on_real_engines(setup):
+    """The ISSUE 16 acceptance pin on XLA engines: a band calibrated to
+    split the request (some student rows, some ensemble rows) scores
+    bit-identically with speculation on and off, and the wasted ledger
+    balances (speculated - escalated)."""
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    s_scores = np.asarray(student.probs(imgs), np.float64)
+    thr = float(np.median(s_scores))
+    band = float(np.quantile(np.abs(s_scores - thr), 0.4))
+    outs = {}
+    for speculative in (False, True):
+        reg = Registry()
+        casc = CascadeEngine(
+            _cfg(cascade_band=band, cascade_thresholds=(thr,),
+                 cascade_speculative=speculative),
+            student, ensemble, registry=reg,
+        )
+        outs[speculative] = np.asarray(casc.probs(imgs))
+        casc.close()
+        c = reg.snapshot()["counters"]
+        esc = c["serve.cascade.escalated_rows"]
+        assert 0 < esc < N_IMGS, "fixture must split the request"
+        if speculative:
+            assert c["serve.cascade.speculated"] == N_IMGS
+            assert c["serve.cascade.speculated.wasted"] == N_IMGS - esc
+    np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_int8_student_cascade_under_router(setup):
+    """The interactive default path: an int8 student under the fp32
+    ensemble, speculative, behind the Router — routed scores are
+    bitwise the direct cascade's, every segment carries the cascade's
+    generation, and the speculation ledger counts the routed rows."""
+    from jama16_retina_tpu.serve.router import Router
+
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    casc_cfg = _cfg(cascade_band=0.05, cascade_thresholds=(0.5,),
+                    cascade_speculative=True)
+    i8cfg = casc_cfg.replace(serve=dataclasses.replace(
+        casc_cfg.serve, dtype="int8",
+    ))
+    student8 = ServingEngine(i8cfg, model=model, state=st1,
+                             registry=Registry())
+    reg = Registry()
+    casc = CascadeEngine(casc_cfg, student8, ensemble, registry=reg)
+    router = Router(casc_cfg, engines=[casc], registry=reg)
+    try:
+        expect = np.asarray(casc.probs(imgs))
+        futs = [router.submit(imgs[i:i + 4], priority="interactive")
+                for i in range(0, N_IMGS, 4)]
+        got = np.concatenate(
+            [np.asarray(f.result(timeout=120)) for f in futs]
+        )
+        segs = [s for f in futs for s in f.segments]
+    finally:
+        router.close()
+        casc.close()
+    np.testing.assert_array_equal(got, expect)
+    assert segs and all(s["generation"] == casc.generation
+                        for s in segs)
+    c = reg.snapshot()["counters"]
+    # Direct probs (N_IMGS) + the routed rows (N_IMGS): every row that
+    # crossed the cascade speculated exactly once.
+    assert c["serve.cascade.speculated"] == 2 * N_IMGS
+
+
+def test_reload_rollback_mid_speculation_zero_drops(setup):
+    """Hot-swap the ensemble while SPECULATIVE requests are in flight
+    behind the Router, then roll back mid-storm: nothing drops, and —
+    band 1.0, so the output IS the ensemble's — every row is bitwise
+    either the old or the new generation's score, never a blend."""
+    import threading
+    import time
+
+    from jama16_retina_tpu.serve.router import Router
+
+    cfg, model, dirs, st1, st2, student, ensemble, imgs = setup
+    casc_cfg = _cfg(cascade_band=1.0, cascade_thresholds=(0.5,),
+                    cascade_speculative=True)
+    ens = ServingEngine(casc_cfg, model=model, state=st2,
+                        registry=Registry())
+    st_new, _ = train_lib.create_ensemble_state(casc_cfg, model, [7, 8])
+    ens_new = ServingEngine(casc_cfg, model=model, state=st_new,
+                            registry=Registry())
+    old_ref = np.asarray(ens.probs(imgs))
+    new_ref = np.asarray(ens_new.probs(imgs))
+    assert not np.array_equal(old_ref, new_ref)
+    reg = Registry()
+    casc = CascadeEngine(casc_cfg, student, ens, registry=reg)
+    router = Router(casc_cfg, engines=[casc], registry=reg)
+    results, errors = [], []
+
+    def storm(worker):
+        try:
+            for it in range(6):
+                lo = 3 * ((worker + it) % 4)
+                f = router.submit(imgs[lo:lo + 3],
+                                  priority="interactive")
+                results.append((lo, np.asarray(f.result(timeout=120))))
+        except BaseException as e:  # noqa: BLE001 - storm must record
+            errors.append(e)
+
+    try:
+        ts = [threading.Thread(target=storm, args=(w,))
+              for w in range(4)]
+        for t in ts:
+            t.start()
+        time.sleep(0.05)
+        info = casc.reload(state=st_new)
+        assert info["generation"] == 1
+        time.sleep(0.05)
+        rb = casc.rollback()
+        assert rb["restored_from"] == 0
+        for t in ts:
+            t.join()
+    finally:
+        router.close()
+        casc.close()
+    assert not errors, f"speculative storm dropped requests: {errors}"
+    assert len(results) == 24
+    for lo, out in results:
+        for j in range(out.shape[0]):
+            row = out[j]
+            assert (np.array_equal(row, old_ref[lo + j])
+                    or np.array_equal(row, new_ref[lo + j])), (
+                f"row {lo + j} matches neither generation: {row}"
+            )
+    c = reg.snapshot()["counters"]
+    assert c["serve.cascade.speculated"] >= 24 * 3

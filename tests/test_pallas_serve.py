@@ -1,0 +1,115 @@
+"""Fused serve-side preprocess (ISSUE 16; ops/pallas_serve.py +
+serve/host.py): the Pallas kernel (interpret mode — no TPU here) is
+BIT-IDENTICAL to the pure-jnp reference on single- and multi-chunk
+shapes, its channel stats agree with obs.quality's host-numpy per-image
+pass, and the serve/host.py wiring (prepare_images / stats_only) routes
+the fused path behind serve.fused_preprocess with the
+serve.preprocess.fused_rows counter accounting every row."""
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.obs import quality as quality_lib
+from jama16_retina_tpu.obs.registry import Registry
+from jama16_retina_tpu.ops import pallas_serve
+from jama16_retina_tpu.serve import host
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [(1, 8, 8, 3), (3, 32, 32, 3), (2, 128, 128, 3)],
+    ids=["tiny", "single_chunk", "multi_chunk"],
+)
+def test_fused_kernel_bit_identical_to_jnp_reference(shape):
+    """norm AND stats, bitwise, across chunk-boundary shapes — fusion
+    must never change a bit of what the engine scores."""
+    imgs = np.random.default_rng(7).integers(0, 256, shape, np.uint8)
+    norm_k, stats_k = pallas_serve.fused_serve_preprocess(
+        imgs, interpret=True
+    )
+    norm_r, stats_r = pallas_serve.serve_preprocess_reference(imgs)
+    np.testing.assert_array_equal(np.asarray(norm_k), np.asarray(norm_r))
+    np.testing.assert_array_equal(
+        np.asarray(stats_k), np.asarray(stats_r)
+    )
+    assert np.asarray(norm_k).dtype == np.float32
+    assert np.asarray(norm_k).shape == shape
+
+
+def test_kernel_stats_agree_with_quality_monitor_vocabulary():
+    """input_stats_dict speaks the exact INPUT_STATS vocabulary and its
+    values match obs.quality.input_stat_values — the fused path can
+    feed the drift windows without a second per-pixel pass."""
+    imgs = np.random.default_rng(8).integers(
+        0, 256, (5, 32, 32, 3), np.uint8
+    )
+    _, stats = pallas_serve.fused_serve_preprocess(imgs, interpret=True)
+    got = pallas_serve.input_stats_dict(np.asarray(stats))
+    want = quality_lib.input_stat_values(imgs)
+    assert set(got) == set(quality_lib.INPUT_STATS)
+    for k in quality_lib.INPUT_STATS:
+        np.testing.assert_allclose(
+            got[k], np.asarray(want[k], np.float64), atol=1e-4,
+            err_msg=k,
+        )
+
+
+def test_prepare_images_fused_matches_reference_and_counts_rows():
+    """serve/host.prepare_images: the fused path returns bitwise the
+    reference path's rows + stats and increments
+    serve.preprocess.fused_rows by exactly the batch size; the default
+    (non-fused) path touches no counter."""
+    imgs = np.random.default_rng(9).integers(
+        0, 256, (6, 16, 16, 3), np.uint8
+    )
+    reg = Registry()
+    norm_ref, stats_ref = host.prepare_images(
+        imgs, fused=False, registry=reg
+    )
+    assert "serve.preprocess.fused_rows" not in (
+        reg.snapshot()["counters"]
+    )
+    norm_fused, stats_fused = host.prepare_images(
+        imgs, fused=True, interpret=True, registry=reg
+    )
+    np.testing.assert_array_equal(norm_fused, norm_ref)
+    for k in quality_lib.INPUT_STATS:
+        np.testing.assert_array_equal(stats_fused[k], stats_ref[k])
+    assert reg.snapshot()["counters"][
+        "serve.preprocess.fused_rows"
+    ] == 6
+
+
+def test_stats_only_plugs_into_quality_monitor_stats_fn():
+    """stats_only is a drop-in QualityMonitor.stats_fn: same keys, same
+    values (atol 1e-4 vs the host-numpy pass), and installing it keeps
+    observe() feeding the drift windows."""
+    imgs = np.random.default_rng(10).integers(
+        0, 256, (4, 16, 16, 3), np.uint8
+    )
+    reg = Registry()
+    stats = host.stats_only(imgs, fused=True, interpret=True,
+                            registry=reg)
+    want = quality_lib.input_stat_values(imgs)
+    for k in quality_lib.INPUT_STATS:
+        np.testing.assert_allclose(
+            stats[k], np.asarray(want[k], np.float64), atol=1e-4,
+            err_msg=k,
+        )
+    # A profile WITH input_stats makes observe() run the stats pass —
+    # through the installed fused stats_fn, counted like any other rows.
+    profile = quality_lib.build_profile(
+        np.linspace(0.05, 0.95, 64), stat_values=want, bins=20
+    )
+    mon = quality_lib.QualityMonitor(
+        type("Q", (), {"enabled": True, "score_bins": 20,
+                       "window_scores": 16})(),
+        registry=reg, profile=profile,
+    )
+    mon.stats_fn = lambda rows: host.stats_only(
+        rows, fused=True, interpret=True, registry=reg
+    )
+    mon.observe(imgs, np.full((4,), 0.5))
+    assert reg.snapshot()["counters"][
+        "serve.preprocess.fused_rows"
+    ] == 2 * 4  # stats_only direct + via observe

@@ -633,7 +633,7 @@ def test_policy_artifact_roundtrip_and_derivation(tmp_path):
     assert pol.max_wait_ms == 4.0       # p50/2 at the chosen point
     assert pol.shed_in_flight == policy_lib.SHED_IN_FLIGHT_X * 8
     assert pol.shed_queue_depth == policy_lib.SHED_QUEUE_X * 8
-    assert pol.version.startswith("sp1-")
+    assert pol.version.startswith(f"sp{policy_lib.VERSION}-")
     path = str(tmp_path / "policy.json")
     policy_lib.save_policy(path, pol)
     loaded = policy_lib.load_policy(path)
@@ -649,8 +649,12 @@ def test_policy_artifact_roundtrip_and_derivation(tmp_path):
     assert applied_cfg.serve.max_batch == 16
     assert applied_cfg.serve.bucket_sizes == (8, 16)
     assert applied_cfg.serve.max_wait_ms == 4.0
+    # v2: the derived interactive class (bucket 8 here) also opts the
+    # speculative/fusion/fused-preprocess knobs and the int8 student in.
     assert set(applied) == {"bucket_sizes", "max_batch", "max_wait_ms",
-                            "shed_in_flight", "shed_queue_depth"}
+                            "shed_in_flight", "shed_queue_depth",
+                            "dtype", "cascade_speculative",
+                            "router_fusion", "fused_preprocess"}
     hand = cfg.replace(serve=dataclasses.replace(
         cfg.serve, max_batch=4, bucket_sizes=(4,)
     ))
@@ -994,3 +998,202 @@ def test_router_alert_rules_installed_and_parse():
     assert obs_alerts.rule_holds(rule, snap)
     snap["gauges"]["serve.router.imbalance"] = 1.0
     assert not obs_alerts.rule_holds(rule, snap)
+
+
+# ---------------------------------------------------------------------------
+# Interactive latency (ISSUE 16): submit wake-up, multi-model tenancy,
+# cross-tenant batch fusion
+# ---------------------------------------------------------------------------
+
+
+def test_single_row_wakeup_p99_bounded_by_own_window():
+    """A lone interactive request under a deliberately COARSE 200 ms
+    tick completes at service-time scale: submit wakes the dispatch
+    loop, so queue_wait is bounded by the request's own window
+    (max_wait_ms), not the tick. Before the wake-up, every lone
+    request ate >= tick/4 of pure polling latency."""
+    cfg = _cfg(bucket_sizes=(1, 8), max_wait_ms=2.0,
+               router_tick_ms=200.0)
+    router = Router(cfg, engines=[StubReplica(0, delay_s=2e-3)],
+                    registry=Registry())
+    row = np.zeros((1, 4, 4, 3), np.uint8)
+    try:
+        router.submit(row, priority="interactive").result(timeout=30)
+        lat = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            router.submit(row, priority="interactive").result(
+                timeout=30
+            )
+            lat.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        router.close()
+    lat.sort()
+    assert lat[-1] < 200.0 / 4, (
+        f"single-row p99 {lat[-1]:.1f} ms under a 200 ms tick — the "
+        f"submit wake-up is not bounding queue wait: {lat}"
+    )
+
+
+class _ScaledStub(StubReplica):
+    """Second-tenant stub: a DIFFERENT row function (3x the sum), so
+    any cross-tenant row leakage shows up in the numbers."""
+
+    def probs(self, rows):
+        return np.asarray(super().probs(rows)) * 3.0
+
+
+def test_multi_model_tenants_isolated_and_validated():
+    """engines={name: [replicas]}: each tenant's rows are scored only
+    by its own replicas (distinguishable row functions prove zero
+    crosstalk), segments name the model, and an unknown model is a
+    typed ValueError at submit — never an unbinnable queue entry."""
+    rng = np.random.default_rng(5)
+    rows_a = rng.integers(0, 256, (6, 2, 2, 3), np.uint8)
+    rows_b = rng.integers(0, 256, (6, 2, 2, 3), np.uint8)
+    router = Router(_cfg(router_fusion=False),
+                    engines={"a": [StubReplica(0)],
+                             "b": [_ScaledStub(1)]},
+                    registry=Registry())
+    try:
+        fa = router.submit(rows_a, model="a")
+        fb = router.submit(rows_b, model="b")
+        np.testing.assert_array_equal(
+            np.asarray(fa.result(timeout=30)), _ref(rows_a)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fb.result(timeout=30)), 3.0 * _ref(rows_b)
+        )
+        assert {s["model"] for s in fa.segments} == {"a"}
+        assert {s["model"] for s in fb.segments} == {"b"}
+        assert {s["generation"] for s in fa.segments} == {100}
+        assert {s["generation"] for s in fb.segments} == {101}
+        with pytest.raises(ValueError, match="unknown model"):
+            router.submit(rows_a, model="zebra")
+        assert sorted(router.report()["models"]) == ["a", "b"]
+    finally:
+        router.close()
+
+
+def test_fused_mixed_bin_demux_with_full_attribution():
+    """serve.router_fusion on stub tenants (no fusion token -> the
+    grouped fallback, same bin accounting): a 4+4 two-tenant bin under
+    a lone 8 bucket dispatches as ONE fused bin, every row demuxes to
+    its own model's function in submission order, and segments carry
+    per-model (model, replica, generation)."""
+    rng = np.random.default_rng(6)
+    rows_a = rng.integers(0, 256, (4, 2, 2, 3), np.uint8)
+    rows_b = rng.integers(0, 256, (4, 2, 2, 3), np.uint8)
+    reg = Registry()
+    # Lone 8 bucket: a 4-row request CANNOT fill a bucket alone, so
+    # the second tenant's submit completes the bin deterministically.
+    router = Router(_cfg(bucket_sizes=(8,), max_wait_ms=100.0,
+                         router_fusion=True),
+                    engines={"a": [StubReplica(0)],
+                             "b": [_ScaledStub(1)]},
+                    registry=reg)
+    try:
+        fa = router.submit(rows_a, model="a")
+        fb = router.submit(rows_b, model="b")
+        out_a = np.asarray(fa.result(timeout=30))
+        out_b = np.asarray(fb.result(timeout=30))
+    finally:
+        router.close()
+    np.testing.assert_array_equal(out_a, _ref(rows_a))
+    np.testing.assert_array_equal(out_b, 3.0 * _ref(rows_b))
+    assert [(s["model"], s["generation"]) for s in fa.segments] \
+        == [("a", 100)]
+    assert [(s["model"], s["generation"]) for s in fb.segments] \
+        == [("b", 101)]
+    c = reg.snapshot()["counters"]
+    assert c["serve.router.fused_bins"] == 1
+    assert c["serve.router.fused_rows"] == 8
+
+
+def test_fused_real_engines_bit_equal_with_zero_reordering(engine_setup):
+    """THE fusion acceptance pin on XLA engines: two mesh-less tenants
+    with agreeing fusion tokens share one device dispatch, and each
+    tenant's rows come back BITWISE the score its own engine produces
+    directly — fusion changes the dispatch count, never a bit of the
+    answer."""
+    from jama16_retina_tpu import train_lib
+    from jama16_retina_tpu.serve import ServingEngine
+    from jama16_retina_tpu.serve import fusion as fusion_lib
+
+    cfg, model, dirs, engine, imgs = engine_setup
+    fcfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, bucket_sizes=(8,), max_wait_ms=100.0,
+        router_fusion=True,
+    ))
+    st_a, _ = train_lib.create_ensemble_state(fcfg, model, [0])
+    st_b, _ = train_lib.create_ensemble_state(fcfg, model, [1])
+    eng_a = ServingEngine(fcfg, model=model, mesh=None, state=st_a)
+    eng_b = ServingEngine(fcfg, model=model, mesh=None, state=st_b)
+    tok = fusion_lib.fusion_token(eng_a)
+    assert tok is not None and tok == fusion_lib.fusion_token(eng_b)
+    ref_a = np.asarray(eng_a.probs(imgs[:4]))
+    ref_b = np.asarray(eng_b.probs(imgs[4:8]))
+    assert not np.array_equal(ref_a, ref_b), "tenants must differ"
+    reg = Registry()
+    router = Router(fcfg, engines={"a": [eng_a], "b": [eng_b]},
+                    registry=reg)
+    try:
+        fa = router.submit(imgs[:4], model="a")
+        fb = router.submit(imgs[4:8], model="b")
+        out_a = np.asarray(fa.result(timeout=120))
+        out_b = np.asarray(fb.result(timeout=120))
+    finally:
+        router.close()
+    np.testing.assert_array_equal(out_a, ref_a)
+    np.testing.assert_array_equal(out_b, ref_b)
+    assert {s["model"] for s in fa.segments} == {"a"}
+    assert {s["model"] for s in fb.segments} == {"b"}
+    assert reg.snapshot()["counters"]["serve.router.fused_bins"] == 1
+
+
+def test_fused_state_cache_is_bin_order_invariant(engine_setup):
+    """A b-led bin must reuse the a-led bin's concatenated stacked
+    state: the member axis is pinned by sorted model name, not by
+    which tenant's request led the bin. Before the fix an a-led /
+    b-led alternation missed the one-entry FusionCache EVERY dispatch
+    and re-copied every parameter per bin. Outputs stay bit-equal to
+    each tenant's own engine either way."""
+    from jama16_retina_tpu import train_lib
+    from jama16_retina_tpu.serve import ServingEngine
+    from jama16_retina_tpu.serve import fusion as fusion_lib
+
+    cfg, model, dirs, engine, imgs = engine_setup
+    fcfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, bucket_sizes=(8,), router_fusion=True,
+    ))
+    st_a, _ = train_lib.create_ensemble_state(fcfg, model, [0])
+    st_b, _ = train_lib.create_ensemble_state(fcfg, model, [1])
+    eng_a = ServingEngine(fcfg, model=model, mesh=None, state=st_a)
+    eng_b = ServingEngine(fcfg, model=model, mesh=None, state=st_b)
+    ref_a = np.asarray(eng_a.probs(imgs[:4]))
+    ref_b = np.asarray(eng_b.probs(imgs[4:8]))
+
+    class _Part:
+        __slots__ = ("model",)
+
+        def __init__(self, m):
+            self.model = m
+
+    ebm = {"a": eng_a, "b": eng_b}
+    cache = fusion_lib.FusionCache()
+    rows_ab = np.concatenate([imgs[:4], imgs[4:8]])
+    rows_ba = np.concatenate([imgs[4:8], imgs[:4]])
+    out_ab, _ = fusion_lib.score_mixed(
+        ebm, rows_ab, [(_Part("a"), 0, 4), (_Part("b"), 0, 4)],
+        8, cache=cache)
+    state_first = cache._state
+    assert state_first is not None
+    out_ba, _ = fusion_lib.score_mixed(
+        ebm, rows_ba, [(_Part("b"), 0, 4), (_Part("a"), 0, 4)],
+        8, cache=cache)
+    assert cache._state is state_first, \
+        "order swap must not rebuild the concatenated state"
+    np.testing.assert_array_equal(out_ab[:4], ref_a)
+    np.testing.assert_array_equal(out_ab[4:], ref_b)
+    np.testing.assert_array_equal(out_ba[:4], ref_b)
+    np.testing.assert_array_equal(out_ba[4:], ref_a)
