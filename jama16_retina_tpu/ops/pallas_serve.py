@@ -67,23 +67,33 @@ def _serve_kernel(x_ref, out_ref, stat_ref):
     out_ref[0] = raw * (1.0 / 127.5) - 1.0
 
 
-def stats_from_sums(sums: jnp.ndarray, n_pixels: int) -> jnp.ndarray:
+def stats_from_sums(sums: np.ndarray, n_pixels: int) -> np.ndarray:
     """Raw accumulators [B, 4] (sum_r, sum_g, sum_b, sum of squares
-    over all channels, in uint8 units) -> [B, 4] stat columns
+    over all channels, in uint8 units) -> [B, 4] float64 stat columns
     (mean_r, mean_g, mean_b, std) over x = u8/255 — the same
     quantities ``obs/quality.input_stat_values`` computes, derived
     from moments instead of a second pass. Shared by the kernel wrapper
     and the jnp reference so bit-identity reduces to the accumulators.
-    Brightness is NOT computed here: a 3-term dot product invites an
-    FMA in whichever fusion context XLA feels like, which costs a ulp
-    of kernel-vs-reference parity — ``input_stats_dict`` derives it
-    deterministically on the host from the mean columns instead."""
+
+    This is a HOST numpy epilogue in float64, deliberately outside the
+    jit: the moment subtraction E[x^2] - E[x]^2 is catastrophically
+    cancellative in float32 for low-variance images, so a float32 std
+    here would drift systematically from the float64 two-pass std the
+    reference profiles (``obs/quality.input_stat_values`` via
+    build_profile) were built with — shifting drift bins for exactly
+    the flattest images. Float64 from the device's float32 sums keeps
+    the live fused stats within histogram-bin tolerance of the host
+    pass (pinned by tests at the same atol as the reference path).
+
+    Brightness is NOT computed here either way: ``input_stats_dict``
+    derives it deterministically from the mean columns."""
+    s = np.asarray(sums, np.float64)
     n = float(n_pixels)
-    mean_c = sums[:, :3] * (1.0 / (255.0 * n))            # [B, 3]
-    ex = (sums[:, 0] + sums[:, 1] + sums[:, 2]) * (1.0 / (255.0 * 3.0 * n))
-    ex2 = sums[:, 3] * (1.0 / (255.0 * 255.0 * 3.0 * n))
-    std = jnp.sqrt(jnp.maximum(ex2 - ex * ex, 0.0))
-    return jnp.concatenate([mean_c, std[:, None]], axis=1)
+    mean_c = s[:, :3] / (255.0 * n)                       # [B, 3]
+    ex = (s[:, 0] + s[:, 1] + s[:, 2]) / (255.0 * 3.0 * n)
+    ex2 = s[:, 3] / (255.0 * 255.0 * 3.0 * n)
+    std = np.sqrt(np.maximum(ex2 - ex * ex, 0.0))
+    return np.concatenate([mean_c, std[:, None]], axis=1)
 
 
 def _to_channels_first(images_u8: jnp.ndarray):
@@ -96,14 +106,14 @@ def _to_channels_first(images_u8: jnp.ndarray):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def fused_serve_preprocess(
+def _fused_core(
     images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
     interpret: bool = False,
 ) -> "tuple[jnp.ndarray, jnp.ndarray]":
-    """One-HBM-pass serve preprocess: returns (normalized float32
-    [B, H, W, 3] in [-1, 1], stats float32 [B, 4] — mean_r, mean_g,
-    mean_b, std). Pinned bit-identical to
-    ``serve_preprocess_reference`` in interpret mode."""
+    """The jitted device pass: normalized rows + RAW float32 sums
+    [B, 4]. The moment combination happens on the host, in float64
+    (``stats_from_sums``) — never inside the jit, where it would run
+    in float32 and cancel catastrophically for low-variance images."""
     B, H, W, _ = images_u8.shape
     x, P, P_pad = _to_channels_first(images_u8)
 
@@ -127,17 +137,30 @@ def fused_serve_preprocess(
     )(x)
 
     norm = jnp.transpose(out[:, :, :P].reshape(B, 3, H, W), (0, 2, 3, 1))
-    return norm, stats_from_sums(sums[:, :, 0], P)
+    return norm, sums[:, :, 0]
+
+
+def fused_serve_preprocess(
+    images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
+    interpret: bool = False,
+) -> "tuple[jnp.ndarray, np.ndarray]":
+    """One-HBM-pass serve preprocess: returns (normalized float32
+    [B, H, W, 3] in [-1, 1], stats float64 [B, 4] — mean_r, mean_g,
+    mean_b, std; host epilogue, see ``stats_from_sums``). Pinned
+    bit-identical to ``serve_preprocess_reference`` in interpret
+    mode."""
+    _, H, W, _ = images_u8.shape
+    norm, sums = _fused_core(images_u8, interpret=bool(interpret))
+    return norm, stats_from_sums(np.asarray(jax.device_get(sums)), H * W)
 
 
 @jax.jit
-def serve_preprocess_reference(
+def _reference_core(
     images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
 ) -> "tuple[jnp.ndarray, jnp.ndarray]":
-    """The pure-jnp bit-reference (and the live fused-off path): same
-    normalize expression and the same chunk-sequential sum accumulation
-    as the kernel's grid order, so interpret-mode parity is exact, not
-    toleranced."""
+    """Jitted half of the reference: same normalize expression and the
+    same chunk-sequential float32 sum accumulation as the kernel's grid
+    order, so interpret-mode parity is exact, not toleranced."""
     B, H, W, _ = images_u8.shape
     x, P, P_pad = _to_channels_first(images_u8)
     xf = x.astype(jnp.int32).astype(jnp.float32)  # [B, 3, P_pad]
@@ -157,16 +180,29 @@ def serve_preprocess_reference(
         0, n_chunks, body, jnp.zeros((B, 4), jnp.float32)
     )
     return (
-        jnp.transpose(norm.reshape(B, 3, H, W), (0, 2, 3, 1)),
-        stats_from_sums(sums, P),
+        jnp.transpose(norm.reshape(B, 3, H, W), (0, 2, 3, 1)), sums
     )
+
+
+def serve_preprocess_reference(
+    images_u8: jnp.ndarray,  # [B, H, W, 3] uint8
+) -> "tuple[jnp.ndarray, np.ndarray]":
+    """The pure-jnp bit-reference (and the live fused-off path): the
+    device half accumulates the SAME raw float32 sums in the kernel's
+    chunk order, and the stats go through the SAME float64 host
+    epilogue — so kernel-vs-reference bit-identity reduces to the
+    accumulators."""
+    _, H, W, _ = images_u8.shape
+    norm, sums = _reference_core(images_u8)
+    return norm, stats_from_sums(np.asarray(jax.device_get(sums)), H * W)
 
 
 def input_stats_dict(stats: np.ndarray) -> dict:
     """Stats columns [n, 4] -> the ``input_stat_values``-shaped dict
     ({stat: float64 [n]}) the QualityMonitor bins. Brightness is
-    derived here in float64 from the mean columns (see
-    ``stats_from_sums`` for why it stays out of the jitted epilogue)."""
+    derived here in float64 from the mean columns (kept out of
+    ``stats_from_sums`` so the stat columns stay exactly the four
+    independent moments both paths share)."""
     s = np.asarray(stats, np.float64)
     bright = s[:, 0] * _LUMA[0] + s[:, 1] * _LUMA[1] + s[:, 2] * _LUMA[2]
     return {

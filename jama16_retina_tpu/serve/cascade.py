@@ -187,8 +187,16 @@ class CascadeEngine:
             # the student runs — by the time the student's scores tell
             # us which rows the band wants, the ensemble is already in
             # flight (or done). Escalated rows then pay
-            # max(student, ensemble), not student + ensemble.
-            spec_fut = self._spec_submit(self.ensemble.probs, images)
+            # max(student, ensemble), not student + ensemble. An
+            # EscalationPool ensemble takes its speculative entry point
+            # so whole speculated batches don't masquerade as
+            # escalations in the pool's 1/k-economics ledger; the rows
+            # the band actually flips are credited back below.
+            spec_fn = getattr(self.ensemble, "probs_speculative", None)
+            spec_fut = self._spec_submit(
+                spec_fn if spec_fn is not None else self.ensemble.probs,
+                images,
+            )
         out = np.asarray(self.student.probs(images))
         n = int(out.shape[0])
         self._c_student_rows.inc(n)
@@ -198,6 +206,9 @@ class CascadeEngine:
             self._c_speculated.inc(n)
             esc_n = int(mask.sum())
             self._c_speculated_wasted.inc(n - esc_n)
+            note = getattr(self.ensemble, "note_escalated", None)
+            if note is not None:
+                note(esc_n)
             if mask.any():
                 out = np.array(out)
                 out[mask] = esc_all[mask]

@@ -25,9 +25,19 @@ generation): generation handles are pinned ONCE per model before any
 dispatch (the engine's reload-attribution discipline), and the router
 records per-part segments with the model name. Row order never
 changes — demux writes through the same index sets the mux read.
+
+Observability parity: the grouped path rides ``probs``/
+``probs_with_generation`` and so feeds every engine's row hooks for
+free; the fused path bypasses them (it steps the concatenated state
+directly), so ``_observe_fused`` replays the same hooks — per-
+generation row counters, shadow sampling, drift windows, canary
+cadence — on each model's slice after the demux. Drift coverage must
+not depend on whether engines happened to fuse.
 """
 
 from __future__ import annotations
+
+import threading
 
 import numpy as np
 
@@ -59,9 +69,21 @@ class FusionCache:
     generation) tuple — a reload on ANY fused engine misses and
     rebuilds, so a fused forward never scores a retired generation.
     Holds one entry (the live combination): fused serving churns
-    generations, not combinations."""
+    generations, not combinations.
+
+    One Router shares one cache across ALL replica worker threads, and
+    score_mixed runs OUTSIDE the router lock — so _key/_state are read
+    and swapped under the cache's own lock, and callers get the state
+    that was built (or found) FOR THEIR KEY, never a re-read of
+    self._state that a concurrent bin with a different key (other
+    model subset, or a generation swap from a concurrent reload) may
+    have replaced between check and use. Without this, _key could pair
+    with the other key's _state and a fused dispatch would silently
+    score with the wrong parameters/generation while attributing the
+    pinned one."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._key = None
         self._state = None
 
@@ -80,13 +102,22 @@ class FusionCache:
         for m, _e, g in pinned:
             spans.append((m, k, k + int(g.n_members)))
             k += int(g.n_members)
-        if key != self._key:
+        # Check, build, and publish atomically; return the LOCAL state
+        # so a concurrent miss with a different key can at worst evict
+        # the cache entry, never swap the state under this bin. The
+        # concat runs under the lock: two racing misses would otherwise
+        # both pay the full stacked-params device copy just to have one
+        # overwrite the other.
+        with self._lock:
+            if key == self._key:
+                return self._state, spans
             states = [g.state for _m, _e, g in pinned]
-            self._state = jax.tree.map(
+            state = jax.tree.map(
                 lambda *xs: jnp.concatenate(xs, axis=0), *states
             )
+            self._state = state
             self._key = key
-        return self._state, spans
+        return state, spans
 
 
 def _model_spans(parts) -> "list[tuple[str, int, int]]":
@@ -156,15 +187,50 @@ def _score_fused(engines_by_model, rows, spans, models, bucket, cache):
     ))[:, :n]
 
     out = None
+    model_idx = {}
     for m, k_lo, k_hi in member_spans:
         avg = metrics.ensemble_average(list(member[k_lo:k_hi]))
         if out is None:
             out = np.empty((n, *avg.shape[1:]), avg.dtype)
-        for sm, lo, hi in spans:
-            if sm == m:
-                out[lo:hi] = avg[lo:hi]
+        idx = np.concatenate([
+            np.arange(lo, hi) for sm, lo, hi in spans if sm == m
+        ])
+        out[idx] = avg[idx]
+        model_idx[m] = idx
+    # The fused dispatch bypassed probs_with_generation, which is where
+    # the serial path feeds its per-row observability — replay those
+    # hooks here per model, or drift-monitoring coverage would silently
+    # depend on whether engines happened to fuse.
+    for m, eng, gen in pinned:
+        idx = model_idx[m]
+        _observe_fused(eng, gen, rows[idx], out[idx])
     gens = {m: int(g.gen_id) for m, _e, g in pinned}
     return out, gens
+
+
+def _observe_fused(engine, gen, images, scores) -> None:
+    """The serve-path row hooks ``probs_with_generation`` would have
+    fed, applied to one model's slice of a fused bin: the pinned
+    generation's row counter (reload attribution), the staged-rollout
+    shadow sampler, and the quality monitor's drift windows + canary
+    cadence (canary scored through ``member_probs`` on the SAME pinned
+    generation, so canary traffic never pollutes the drift histograms
+    and never splits across a concurrent reload)."""
+    c_rows = getattr(gen, "c_rows", None)
+    if c_rows is not None:
+        c_rows.inc(int(images.shape[0]))
+    sh = getattr(engine, "_shadow", None)
+    if sh is not None and sh.claim():
+        engine._shadow_sample(sh, images, scores)
+    q = getattr(engine, "quality", None)
+    if q is not None:
+        q.observe(images, scores)
+        if q.canary_claim():
+            q.run_canary(
+                lambda imgs: metrics.ensemble_average(
+                    list(engine.member_probs(imgs, _gen=gen))
+                )
+            )
 
 
 def _score_grouped(engines_by_model, rows, spans, models):

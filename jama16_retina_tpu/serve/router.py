@@ -116,7 +116,16 @@ class EscalationPool:
     CascadeEngine ``ensemble`` contract (``probs`` row-wise), routing
     each escalation batch to the pool member with the fewest rows in
     flight. Escalated rows are counted (``serve.router.escalations``)
-    so the 1/k economics stay measurable."""
+    so the 1/k economics stay measurable.
+
+    Speculative dispatches (ISSUE 16 tentpole c) are accounted apart:
+    a speculating cascade scores its WHOLE batch here before the band
+    is known, so those rows land in ``serve.router.speculations`` —
+    NOT in the escalations ledger, whose help text promises 'rows
+    escalated' — and the cascade credits the rows the band actually
+    flipped back via :meth:`note_escalated` once the student resolves.
+    The 1/k-economics ledger therefore stays exact under speculation
+    instead of counting every speculated row as an escalation."""
 
     def __init__(self, engines, registry: "obs_registry.Registry | None" = None,
                  tracer: "obs_trace.Tracer | None" = None):
@@ -125,16 +134,21 @@ class EscalationPool:
         self._engines = list(engines)
         self._in_flight = [0] * len(self._engines)
         self._lock = threading.Lock()
-        reg = (registry if registry is not None
-               else obs_registry.default_registry())
+        self._registry = (registry if registry is not None
+                          else obs_registry.default_registry())
         self._tracer = (tracer if tracer is not None
                         else obs_trace.default_tracer())
-        self._c_rows = reg.counter(
+        self._c_rows = self._registry.counter(
             "serve.router.escalations",
             help="rows escalated through the shared full-ensemble pool "
                  "(cascade-aware routing: student replicas everywhere, "
-                 "expensive escalations pooled)",
+                 "expensive escalations pooled); under speculation "
+                 "credited via note_escalated once the band resolves",
         )
+        # Registered on FIRST speculative call (the escalations
+        # discipline: a speculation-less pool must not export a
+        # spurious always-zero series).
+        self._c_spec_rows = None
 
     @property
     def generation(self) -> int:
@@ -145,11 +159,33 @@ class EscalationPool:
         )
 
     def probs(self, images: np.ndarray) -> np.ndarray:
+        return self._probs(images, speculative=False)
+
+    def probs_speculative(self, images: np.ndarray) -> np.ndarray:
+        """The speculating cascade's entry point: same routing and row
+        contract as ``probs``, but rows count as speculations, not
+        escalations — call :meth:`note_escalated` with the rows the
+        band actually flipped once the student's scores are in."""
+        return self._probs(images, speculative=True)
+
+    def note_escalated(self, n: int) -> None:
+        """Credit ``n`` speculated rows as genuine escalations (the
+        band flipped them): keeps ``serve.router.escalations`` meaning
+        'rows escalated' exactly, speculation on or off."""
+        if n > 0:
+            self._c_rows.inc(int(n))
+
+    def _probs(self, images: np.ndarray, *, speculative: bool) -> np.ndarray:
         n = int(np.asarray(images).shape[0])
         with self._lock:
             idx = min(
                 range(len(self._engines)), key=lambda i: self._in_flight[i]
             )
+            # The in-flight ledger charges the WHOLE batch either way:
+            # the member genuinely scores every speculated row, and
+            # under-charging would steer sibling escalations onto the
+            # member busiest with speculative work. Only the ROW
+            # counters distinguish speculated from escalated.
             self._in_flight[idx] += n
         # Distributed-trace seam (ISSUE 15): the escalation happens two
         # layers below submit() (replica worker -> CascadeEngine ->
@@ -159,6 +195,8 @@ class EscalationPool:
         # timeline shows exactly which request paid the full ensemble.
         ctx = obs_trace.current_context()
         args = {"rows": n, "pool_member": idx}
+        if speculative:
+            args["speculative"] = True
         if ctx is not None:
             args["trace_id"] = ctx.trace_id
         try:
@@ -167,7 +205,20 @@ class EscalationPool:
         finally:
             with self._lock:
                 self._in_flight[idx] -= n
-        self._c_rows.inc(n)
+        if speculative:
+            c = self._c_spec_rows
+            if c is None:
+                c = self._c_spec_rows = self._registry.counter(
+                    "serve.router.speculations",
+                    help="rows scored through the shared full-ensemble "
+                         "pool speculatively (whole batches, before the "
+                         "cascade band is known); the subset the band "
+                         "flips is credited to serve.router.escalations "
+                         "via note_escalated",
+                )
+            c.inc(n)
+        else:
+            self._c_rows.inc(n)
         return out
 
 

@@ -635,6 +635,39 @@ def test_speculative_bit_equal_to_serial_with_exact_ledger():
     assert c_serial["serve.cascade.speculated.wasted"] == 0
 
 
+def test_speculative_pool_accounting_separates_ledgers():
+    """A speculating cascade over a shared EscalationPool must not
+    masquerade whole speculated batches as escalations: speculated rows
+    land in serve.router.speculations, the escalations ledger counts
+    ONLY the rows the band actually flipped (credited via
+    note_escalated once the student resolves), outputs stay bit-equal
+    to the serial cascade, and a speculation-less run never registers
+    the speculations series."""
+    from jama16_retina_tpu.serve.router import EscalationPool
+
+    def run(speculative):
+        reg = Registry()
+        student = _StubEngine([0.1, 0.45, 0.55, 0.9])
+        member = _StubEngine([0.7, 0.7, 0.7, 0.7])
+        pool = EscalationPool([member], registry=reg)
+        cfg = _cfg(cascade_band=0.2, cascade_thresholds=(0.5,),
+                   cascade_speculative=speculative)
+        casc = CascadeEngine(cfg, student, pool, registry=reg)
+        out = np.asarray(casc.probs(_stub_rows(4)))
+        casc.close()
+        return out, member.calls, reg.snapshot()["counters"]
+
+    out_spec, calls_spec, c_spec = run(True)
+    out_serial, calls_serial, c_serial = run(False)
+    np.testing.assert_array_equal(out_spec, out_serial)
+    assert calls_spec == [[0, 1, 2, 3]]      # whole batch, through pool
+    assert calls_serial == [[1, 2]]          # band rows only
+    assert c_spec["serve.router.speculations"] == 4
+    assert c_spec["serve.router.escalations"] == 2
+    assert c_serial["serve.router.escalations"] == 2
+    assert "serve.router.speculations" not in c_serial
+
+
 def test_speculative_bit_equal_to_serial_on_real_engines(setup):
     """The ISSUE 16 acceptance pin on XLA engines: a band calibrated to
     split the request (some student rows, some ensemble rows) scores

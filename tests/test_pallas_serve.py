@@ -54,6 +54,31 @@ def test_kernel_stats_agree_with_quality_monitor_vocabulary():
         )
 
 
+def test_low_variance_stats_use_float64_host_epilogue():
+    """Near-constant images are the catastrophic-cancellation corner of
+    the E[x^2]-E[x]^2 moment formula: the float64 HOST epilogue
+    (stats_from_sums left the jit) keeps the fused std within
+    histogram-bin distance of the float64 two-pass numpy std the
+    reference profiles are built with, exactly where std is smallest —
+    and strictly constant images give std == 0.0, no residue."""
+    rng = np.random.default_rng(3)
+    imgs = (np.full((4, 64, 64, 3), 200, np.uint8)
+            + rng.integers(0, 2, (4, 64, 64, 3)).astype(np.uint8))
+    _, stats = pallas_serve.fused_serve_preprocess(imgs, interpret=True)
+    stats = np.asarray(stats)
+    assert stats.dtype == np.float64
+    want = quality_lib.input_stat_values(imgs)
+    assert np.all(np.asarray(want["std"]) < 0.01), "fixture not flat"
+    np.testing.assert_allclose(
+        stats[:, 3], np.asarray(want["std"], np.float64), atol=5e-5
+    )
+    imgs_c = np.full((2, 32, 32, 3), 137, np.uint8)
+    _, stats_c = pallas_serve.fused_serve_preprocess(
+        imgs_c, interpret=True
+    )
+    assert np.all(np.asarray(stats_c)[:, 3] == 0.0)
+
+
 def test_prepare_images_fused_matches_reference_and_counts_rows():
     """serve/host.prepare_images: the fused path returns bitwise the
     reference path's rows + stats and increments

@@ -1197,3 +1197,112 @@ def test_fused_state_cache_is_bin_order_invariant(engine_setup):
     np.testing.assert_array_equal(out_ab[4:], ref_b)
     np.testing.assert_array_equal(out_ba[:4], ref_b)
     np.testing.assert_array_equal(out_ba[4:], ref_a)
+
+
+def test_fusion_cache_concurrent_keys_never_cross_state():
+    """One FusionCache is shared by ALL replica workers and fused_state
+    runs outside the router lock: two threads hammering it with
+    DIFFERENT keys (different model subsets / generations) must each
+    get back the state built for THEIR key, every call — the
+    check-then-write race would pair one key with the other key's
+    state and silently score with the wrong parameters."""
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu.serve import fusion as fusion_lib
+
+    class _Gen:
+        def __init__(self, gid, val):
+            self.gen_id = gid
+            self.n_members = 1
+            self.state = jnp.full((1,), float(val), jnp.float32)
+
+    e1, e2, e3 = object(), object(), object()
+    pinned_x = [("a", e1, _Gen(1, 1.0)), ("b", e2, _Gen(2, 2.0))]
+    pinned_y = [("a", e1, _Gen(3, 3.0)), ("c", e3, _Gen(4, 4.0))]
+    cache = fusion_lib.FusionCache()
+    mismatches = []
+    start = threading.Barrier(2)
+
+    def worker(pinned, want):
+        start.wait(timeout=30)
+        for _ in range(300):
+            state, spans = cache.fused_state(pinned)
+            got = np.asarray(state)
+            if not np.array_equal(got, want):
+                mismatches.append((got.tolist(), want.tolist()))
+                return
+            assert [s[0] for s in spans] == [p[0] for p in pinned]
+
+    threads = [
+        threading.Thread(target=worker,
+                         args=(pinned_x, np.array([1.0, 2.0]))),
+        threading.Thread(target=worker,
+                         args=(pinned_y, np.array([3.0, 4.0]))),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not mismatches, (
+        f"fused_state returned another key's state: {mismatches[:1]}"
+    )
+
+
+def test_fused_dispatch_feeds_generation_and_quality_hooks(engine_setup):
+    """A FUSED bin must feed the same per-row hooks the serial path's
+    probs_with_generation feeds — the per-generation row ledger and the
+    quality monitor's drift windows — or drift coverage silently
+    depends on whether engines happened to fuse. Each monitor sees
+    exactly its OWN model's rows and the scores those rows shipped."""
+    from jama16_retina_tpu import train_lib
+    from jama16_retina_tpu.serve import ServingEngine
+    from jama16_retina_tpu.serve import fusion as fusion_lib
+
+    cfg, model, dirs, engine, imgs = engine_setup
+    fcfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, bucket_sizes=(8,), router_fusion=True,
+    ))
+    st_a, _ = train_lib.create_ensemble_state(fcfg, model, [0])
+    st_b, _ = train_lib.create_ensemble_state(fcfg, model, [1])
+    reg_a, reg_b = Registry(), Registry()
+    eng_a = ServingEngine(fcfg, model=model, mesh=None, state=st_a,
+                          registry=reg_a)
+    eng_b = ServingEngine(fcfg, model=model, mesh=None, state=st_b,
+                          registry=reg_b)
+
+    class _Q:
+        def __init__(self):
+            self.observed = []
+
+        def observe(self, images, scores):
+            self.observed.append(
+                (np.asarray(images), np.asarray(scores))
+            )
+
+        def canary_claim(self):
+            return False
+
+    qa, qb = _Q(), _Q()
+    eng_a.quality = qa
+    eng_b.quality = qb
+
+    class _Part:
+        __slots__ = ("model",)
+
+        def __init__(self, m):
+            self.model = m
+
+    rows = np.concatenate([imgs[:4], imgs[4:8]])
+    out, gens = fusion_lib.score_mixed(
+        {"a": eng_a, "b": eng_b}, rows,
+        [(_Part("a"), 0, 4), (_Part("b"), 0, 4)],
+        8, cache=fusion_lib.FusionCache(),
+    )
+    out = np.asarray(out)
+    assert reg_a.snapshot()["counters"]["serve.gen0.rows"] == 4
+    assert reg_b.snapshot()["counters"]["serve.gen0.rows"] == 4
+    assert len(qa.observed) == 1 and len(qb.observed) == 1
+    np.testing.assert_array_equal(qa.observed[0][0], imgs[:4])
+    np.testing.assert_array_equal(qa.observed[0][1], out[:4])
+    np.testing.assert_array_equal(qb.observed[0][0], imgs[4:8])
+    np.testing.assert_array_equal(qb.observed[0][1], out[4:])
