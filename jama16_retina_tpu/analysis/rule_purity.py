@@ -45,6 +45,7 @@ BANNED_PREFIXES = ("random.", "numpy.random.", "secrets.")
 DEFAULT_TARGETS = (
     "jama16_retina_tpu/data/autotune.py::decide",
     "jama16_retina_tpu/data/autotune.py::staged_cap",
+    "jama16_retina_tpu/ingest/fleettune.py::merge_windows",
     "jama16_retina_tpu/lifecycle/journal.py",
     "jama16_retina_tpu/utils/retry.py",
 )

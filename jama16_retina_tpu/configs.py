@@ -81,7 +81,13 @@ class DataConfig:
     # (scripts/transcode_shards.py + data/rawshard.py): decode/resize
     # paid ONCE offline, steady-state reads are mmap row memcpys —
     # bit-identical (post-decode) to the streamed path at the same
-    # seed. Same {'image','grade'} batch contract throughout.
+    # seed; "served" = attach to a disaggregated ingest SERVER process
+    # (scripts/ingest_server.py + jama16_retina_tpu/ingest/) over a
+    # shared-memory ring — the server owns the tiered/rawshard decode
+    # plane once for every local consumer, and the stream stays
+    # bit-identical (post-decode) to the in-process tiered path at the
+    # same seed (ingest.* knobs below configure the rendezvous). Same
+    # {'image','grade'} batch contract throughout.
     loader: str = "tfdata"
     # Closed-loop ingest autotuner (data/autotune.py; ISSUE 7): the
     # flax train loops observe their own stall attribution over
@@ -896,6 +902,41 @@ class IntegrityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Disaggregated ingest service (ISSUE 17; jama16_retina_tpu/
+    ingest/). One server process owns the decode plane — the existing
+    rawshard/tiered/autotune stack — and streams ready batches to N
+    local consumer processes over shared-memory rings, so decode is
+    paid ONCE per deployment instead of once per trainer/eval/bench
+    process. Consumers opt in with ``data.loader=served``."""
+
+    # Unix control socket the ingest server listens on and every
+    # data.loader=served consumer attaches through. Empty = the served
+    # loader refuses loudly (there is no sane default rendezvous).
+    socket_path: str = ""
+    # Shared-memory ring slots per consumer: how many ready batches the
+    # server may hold decoded + published ahead of the consumer's
+    # credits. Pure run-ahead (content-invariant), like stage_depth.
+    ring_slots: int = 4
+    # Directory of per-consumer sealed lease journals (resume-without-
+    # re-decode; integrity/artifact seam). Empty = "<socket dir>/leases".
+    lease_dir: str = ""
+    # Flush a consumer's lease journal every N credited batches (plus
+    # always at detach). The durable position after kill -9 of the
+    # SERVER lags at most this many batches; a killed CONSUMER loses
+    # nothing while the server lives (its in-memory lease is exact).
+    lease_flush_every: int = 8
+    # Seconds a consumer waits for the server's ATTACHED reply (and for
+    # each subsequent batch) before failing loudly.
+    attach_timeout_s: float = 30.0
+    # Stable consumer identity for lease resume. Empty = derived as
+    # "pid<os.getpid()>" — unique but NOT resumable across restarts;
+    # set it (e.g. per workdir) to make kill -9 reattach resume from
+    # the lease journal instead of step 0.
+    consumer_id: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "eyepacs_binary"
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -911,6 +952,7 @@ class ExperimentConfig:
     integrity: IntegrityConfig = dataclasses.field(
         default_factory=IntegrityConfig
     )
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
 
     def replace(self, **sections) -> "ExperimentConfig":
         return dataclasses.replace(self, **sections)

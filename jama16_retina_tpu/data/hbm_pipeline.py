@@ -51,6 +51,11 @@ from absl import logging
 from jama16_retina_tpu.configs import DataConfig
 from jama16_retina_tpu.data import tfrecord
 
+# Warn-once latch for the no-bytes_limit HBM fallback below: the
+# message names a per-PROCESS assumption, so repeating it per loader
+# construction adds noise, not information. Tests reset it directly.
+_WARNED_NO_BYTES_LIMIT = False
+
 
 def _decode_rows(
     index, start: int, stop: int, image_size: int, n: "int | None" = None,
@@ -157,13 +162,20 @@ def hbm_budget_bytes(max_fraction: float = 0.6,
         pass
     if not limit:
         limit = 8 * 1024**3
-        logging.warning(
-            "device reports no bytes_limit: assuming a conservative "
-            "%d GB HBM budget base (smallest deployed TPU core) — set "
-            "data.hbm_budget_bytes to this chip's true per-device "
-            "memory limit to override",
-            limit // 1024**3,
-        )
+        global _WARNED_NO_BYTES_LIMIT
+        if not _WARNED_NO_BYTES_LIMIT:
+            # Once per process (ISSUE 17 satellite): every loader
+            # construction calls this, so an unconditional warning
+            # fired twice per bench run and once per epoch-restart —
+            # same fallback, same fix, pure noise after the first.
+            _WARNED_NO_BYTES_LIMIT = True
+            logging.warning(
+                "device reports no bytes_limit: assuming a conservative "
+                "%d GB HBM budget base (smallest deployed TPU core) — set "
+                "data.hbm_budget_bytes to this chip's true per-device "
+                "memory limit to override",
+                limit // 1024**3,
+            )
     return int(limit * max_fraction)
 
 

@@ -1,0 +1,214 @@
+"""``data.loader="served"``: the disaggregated ingest service's client.
+
+A thin stream over the shared-memory ring the ingest server
+(jama16_retina_tpu/ingest/) fills: attach over the unix control
+socket, map the ring, then yield one {'image','grade'} HOST batch per
+``batch`` frame — the standard loader contract, so the trainer's
+``device_prefetch`` moves batches exactly as it does for tfdata/grain
+and the train loops never see which loader is underneath.
+
+Bit-identity: the client sends the SAME residency spec the in-process
+tiered loader would derive (``resident_row_capacity`` over the same
+budget knobs), and the server computes each batch exactly as
+``tiered_pipeline.host_reference_batches`` does — so a fit() over
+``served`` consumes the identical post-decode batch sequence as the
+same seed over ``tiered``/``rawshard`` (pinned in
+tests/test_ingest.py, >1 epoch, partial residency).
+
+Stall attribution: the client measures its own blocked-in-recv time
+and reports tumbling ``(window_sec, input_wait_sec)`` windows over the
+control channel — the fleet tuner's per-consumer input
+(ingest/fleettune.py).
+
+Crash semantics: ``skip_batches=None`` asks the server to resume from
+this consumer's lease journal (kill -9 reattach, zero re-decode); the
+trainer always passes its explicit checkpoint step instead, which
+overrides the journal (the checkpoint is the authority on training
+position).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Iterator
+
+from absl import logging
+
+from jama16_retina_tpu.ingest import protocol
+from jama16_retina_tpu.ingest.ring import BatchRing
+
+# Report a stats window to the fleet tuner every N batches: frequent
+# enough to steer within a bench window, rare enough to stay invisible
+# next to a decode.
+STATS_EVERY = 8
+
+
+class ServedStream:
+    """One attached consumer. Iterate for host batches; ``close()``
+    (or exhaust/GC) detaches cleanly. Not thread-safe — one stream per
+    consuming loop, like every other loader iterator."""
+
+    def __init__(self, socket_path: str, consumer_id: str, split: str,
+                 seed: int, batch_size: int, image_size: int,
+                 capacity_rows: int, start_step: "int | None" = 0,
+                 attach_timeout_s: float = 30.0):
+        if not socket_path:
+            raise ValueError(
+                "data.loader='served' needs ingest.socket_path — the "
+                "unix socket of a running scripts/ingest_server.py"
+            )
+        self.consumer_id = consumer_id
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(attach_timeout_s)
+        try:
+            self._sock.connect(socket_path)
+        except OSError as e:
+            self._sock.close()
+            raise ConnectionError(
+                f"no ingest server at {socket_path!r} ({e}) — start one "
+                "with scripts/ingest_server.py or switch data.loader"
+            ) from None
+        protocol.send_msg(self._sock, {
+            "type": "attach", "consumer_id": consumer_id, "split": split,
+            "seed": int(seed), "batch_size": int(batch_size),
+            "image_size": int(image_size),
+            "capacity_rows": int(capacity_rows),
+            "start_step": None if start_step is None else int(start_step),
+        })
+        reply = protocol.recv_msg(self._sock)
+        if reply is None:
+            self._sock.close()
+            raise ConnectionError(
+                f"ingest server at {socket_path!r} closed during attach"
+            )
+        if reply.get("type") == "error":
+            self._sock.close()
+            raise RuntimeError(
+                f"ingest attach refused: {reply.get('message')}"
+            )
+        if reply.get("type") != "attached":
+            self._sock.close()
+            raise RuntimeError(f"unexpected attach reply: {reply}")
+        self.start_step = int(reply["start_step"])
+        self.n_records = int(reply["n_records"])
+        self.steps_per_epoch = int(reply["steps_per_epoch"])
+        self._ring = BatchRing(
+            int(reply["batch_size"]), int(reply["image_size"]),
+            int(reply["n_slots"]), name=reply["shm_name"], create=False,
+        )
+        self._closed = False
+        self._since_stats = 0
+        self._window_t0 = time.perf_counter()
+        self._window_wait = 0.0
+        logging.info(
+            "served loader: consumer %s attached at step %d (%d records, "
+            "%d steps/epoch, ring of %d slots)", consumer_id,
+            self.start_step, self.n_records, self.steps_per_epoch,
+            int(reply["n_slots"]),
+        )
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        if self._closed:
+            raise StopIteration
+        t0 = time.perf_counter()
+        try:
+            msg = protocol.recv_msg(self._sock)
+        except socket.timeout:
+            raise TimeoutError(
+                "ingest server stopped feeding (no batch frame within "
+                "the attach timeout) — check the server process"
+            ) from None
+        self._window_wait += time.perf_counter() - t0
+        if msg is None:
+            # Server closed the stream (shutdown or an injected
+            # ingest.ring.write fault killed this consumer's pump).
+            self.close(detach=False)
+            raise ConnectionError(
+                "ingest server dropped the connection mid-stream — "
+                "reattach (the lease journal resumes this consumer "
+                "without re-decode)"
+            )
+        if msg.get("type") != "batch":
+            raise RuntimeError(f"unexpected frame mid-stream: {msg}")
+        slot = int(msg["slot"])
+        batch = self._ring.read(slot)
+        # Credit immediately: read() copied the rows out, so the slot
+        # can refill behind the train step right away.
+        protocol.send_msg(self._sock, {"type": "credit", "slot": slot,
+                                       "step": int(msg["step"])})
+        self._since_stats += 1
+        if self._since_stats >= STATS_EVERY:
+            now = time.perf_counter()
+            protocol.send_msg(self._sock, {
+                "type": "stats",
+                "window_sec": now - self._window_t0,
+                "input_wait_sec": self._window_wait,
+            })
+            self._window_t0 = now
+            self._window_wait = 0.0
+            self._since_stats = 0
+        return batch
+
+    def close(self, detach: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if detach:
+            try:
+                protocol.send_msg(self._sock, {"type": "detach"})
+            except OSError:  # pragma: no cover - server already gone
+                pass
+        try:
+            self._sock.close()
+        finally:
+            self._ring.close()
+
+
+def capacity_rows_for(cfg, mesh=None, max_fraction: float = 0.6) -> int:
+    """The resident-row capacity the SPEC carries — derived exactly as
+    the in-process tiered loader derives it (same budget knobs, same
+    mesh width), so a served consumer and an in-process tiered run at
+    the same config plan identical batches."""
+    from jama16_retina_tpu.data.hbm_pipeline import resident_row_capacity
+
+    n_dev = 1
+    if mesh is not None:
+        from jama16_retina_tpu.parallel import mesh as mesh_lib
+
+        n_dev = mesh.shape[mesh_lib._batch_axis(mesh)]
+    return resident_row_capacity(
+        cfg.model.image_size, n_dev, max_fraction,
+        budget_bytes=(
+            cfg.data.tiered_resident_bytes
+            if cfg.data.tiered_resident_bytes >= 0 else None
+        ),
+        budget_base_bytes=cfg.data.hbm_budget_bytes,
+    )
+
+
+def train_batches(cfg, seed: int = 0, skip_batches: "int | None" = 0,
+                  mesh=None, consumer_id: "str | None" = None,
+                  split: str = "train") -> Iterator[dict]:
+    """The trainer seam: a ServedStream dressed as the standard loader
+    generator (host {'image','grade'} batches; ``device_prefetch``
+    moves them). The stream detaches when the generator is closed."""
+    stream = ServedStream(
+        cfg.ingest.socket_path,
+        consumer_id=(
+            consumer_id or cfg.ingest.consumer_id or f"pid{os.getpid()}"
+        ),
+        split=split, seed=seed, batch_size=cfg.data.batch_size,
+        image_size=cfg.model.image_size,
+        capacity_rows=capacity_rows_for(cfg, mesh=mesh),
+        start_step=skip_batches,
+        attach_timeout_s=cfg.ingest.attach_timeout_s,
+    )
+    try:
+        yield from stream
+    finally:
+        stream.close()

@@ -1,0 +1,45 @@
+"""Disaggregated ingest service (ISSUE 17): one decode plane, N
+consumers.
+
+BENCH r16 shape of the problem: raw-parse decode peaks at ~2660 img/s
+while one chip's train appetite is ~1970 img/s — and every process of a
+deployment (trainer, overlapped eval, lifecycle gate evals, bench,
+transcode) pays that decode cost AGAIN, independently. "tf.data: A
+Machine Learning Data Processing Framework" (PAPERS.md) names the end
+state of a tuned input pipeline: a disaggregated data *service*. This
+package is that service for our stack:
+
+  * ``server.IngestServer`` — one process hosts the EXISTING
+    rawshard/tiered/autotune machinery (``_TierPlan`` residency
+    bookkeeping, ``ParallelDecoder`` worker pool, quarantine,
+    telemetry) behind a unix control socket
+    (``scripts/ingest_server.py`` entrypoint). Same-spec consumers
+    share one decoder and one small decoded-batch cache, so a batch is
+    decoded ONCE however many consumers pull it.
+  * ``ring.BatchRing`` — per-consumer ``multiprocessing.shared_memory``
+    slab divided into fixed-size batch slots; the server writes decoded
+    rows straight into the slot (zero-copy on the row bytes — no
+    pickling of image payloads) and announces it over the control
+    socket; the consumer credits the slot back when done.
+  * ``protocol`` — the length-prefixed JSON control frames
+    (ATTACH/ATTACHED/BATCH/CREDIT/STATS/DETACH) and the slot layout
+    math both sides derive from the attach spec.
+  * ``leases.LeaseJournal`` — a SEALED (integrity/artifact) per-consumer
+    journal of the consumed batch position: a kill -9'd consumer
+    reattaches and resumes where it left off with zero re-decode, and a
+    kill -9'd server restarts into the same pure (seed, step) epoch
+    plan from the journals alone.
+  * ``fleettune.FleetIngestTuner`` — the PR-7 ``IngestAutotuner``
+    promoted to FLEET scope: consumers report their stall attribution
+    over the control channel, the server merges the windows
+    (input-wait = the WORST consumer's — the service must feed its
+    hungriest client) and one pure ``decide()`` arbitrates
+    decode_workers / stage depth for the whole plane, publishing over
+    the PR-15 fleet segment bus.
+
+Consumers opt in with ``data.loader=served`` (data/served.py), which
+plugs a thin ``ServedStream`` client into the standard
+``trainer._train_stream`` seam; the stream is bit-identical
+(post-decode) to the in-process tiered path at the same seed — pinned
+at fit() level, >1 epoch, partial residency (tests/test_ingest.py).
+"""

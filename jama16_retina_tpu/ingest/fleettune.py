@@ -1,0 +1,97 @@
+"""Fleet-scope ingest autotuning: one tuner arbitrating for N consumers.
+
+The PR-7 ``IngestAutotuner`` closed the loop for ONE process: its own
+StallClock window in, its own knobs out. Under the disaggregated
+service the signals split across processes — stall attribution lives
+in each CONSUMER (time blocked waiting for a batch frame) while the
+knobs live in the SERVER (decode pool width, per-consumer run-ahead
+depth). This module merges them without touching the tuner's policy:
+
+  * consumers report ``(window_sec, input_wait_sec)`` tumbling windows
+    over the control channel (protocol ``stats`` frames);
+  * the server MERGES one fleet window per cadence: window length =
+    the longest reported window, input-wait fraction = the WORST
+    consumer's — a shared decode plane must feed its hungriest client,
+    and the max is the only merge under which "no consumer starves"
+    is the tuner's fixed point;
+  * the merged window feeds the SAME pure ``decide()`` via the same
+    ``IngestAutotuner.observe`` (decoder-busy and spill fractions are
+    read server-side from the decoder pool's own counters), so every
+    hysteresis/ratchet/budget-clamp guarantee PR 7 pinned holds
+    unchanged at fleet scope — one decision stream, N beneficiaries.
+
+Every applied adjustment rides the existing ``data.autotune.*``
+counters/gauges/trace events; the server publishes its registry over
+the PR-15 fleet segment bus, so ``obs_report`` on the fleet dir shows
+the arbitration next to each consumer's own telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from jama16_retina_tpu.data import autotune as autotune_lib
+
+
+class FleetIngestTuner:
+    """Wraps one ``IngestAutotuner`` behind per-consumer stall reports.
+
+    ``report()`` is called from consumer serve threads; a merged
+    ``observe`` fires once every attached consumer has contributed a
+    window (or a consumer detached — stale peers must not gate the
+    loop forever). Thread-safe; decisions stay serialized under one
+    lock so the pure state threading is exactly the single-process
+    tuner's."""
+
+    def __init__(self, tuner: "autotune_lib.IngestAutotuner"):
+        self.tuner = tuner
+        self.knobs = tuner.knobs
+        self._lock = threading.Lock()
+        self._pending: dict[str, tuple[float, float]] = {}
+        self._attached: set[str] = set()
+        self.windows_merged = 0
+
+    def attach(self, consumer_id: str) -> None:
+        with self._lock:
+            self._attached.add(consumer_id)
+
+    def detach(self, consumer_id: str) -> None:
+        with self._lock:
+            self._attached.discard(consumer_id)
+            self._pending.pop(consumer_id, None)
+
+    def report(self, consumer_id: str, window_sec: float,
+               input_wait_sec: float) -> tuple:
+        """One consumer window. Returns the adjustments applied by the
+        merged observe this report completed, or () when the fleet
+        window is still filling."""
+        with self._lock:
+            if consumer_id not in self._attached:
+                return ()
+            self._pending[consumer_id] = (
+                max(0.0, float(window_sec)),
+                max(0.0, float(input_wait_sec)),
+            )
+            if not self._attached <= set(self._pending):
+                return ()
+            window, wait = merge_windows(list(self._pending.values()))
+            self._pending.clear()
+            self.windows_merged += 1
+            return self.tuner.observe(window, wait)
+
+
+def merge_windows(
+    windows: "list[tuple[float, float]]",
+) -> tuple[float, float]:
+    """[(window_sec, input_wait_sec)] -> one (window_sec,
+    input_wait_sec) fleet window: longest wall window, worst consumer's
+    WAIT FRACTION re-expressed over it. Pure (graftlint purity scope) —
+    the merge is part of the decision function's determinism
+    guarantee."""
+    if not windows:
+        return 0.0, 0.0
+    wall = max(w for w, _ in windows)
+    worst_frac = max(
+        (min(1.0, wait / w) if w > 0 else 0.0) for w, wait in windows
+    )
+    return wall, worst_frac * wall

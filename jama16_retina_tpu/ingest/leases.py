@@ -1,0 +1,146 @@
+"""Per-consumer lease journals: durable epoch-shard handoff.
+
+One SEALED json per consumer (integrity/artifact seam, like every
+durable artifact since PR 13) recording the stream spec and the batch
+position the consumer has consumed through. Two crash classes, one
+file:
+
+  * kill -9'd CONSUMER: while the server lives its in-memory lease is
+    exact (advanced on every credit), so a reattach with
+    ``start_step=None`` resumes at the precise next batch and the
+    server re-decodes NOTHING (the decode ledger
+    ``ingest.decode.batches`` is the assertable proof).
+  * kill -9'd SERVER: the on-disk journal lags at most
+    ``ingest.lease_flush_every`` credits. A restarted server reloads
+    every journal and resumes each consumer from its flushed position
+    — into the SAME epoch plan, because the plan is a pure
+    (seed, step) function of the spec the journal carries
+    (tiered_pipeline._TierPlan; nothing else to recover).
+
+A journal whose sealed digest fails verification is COUNTED
+(integrity.corrupt.{artifact} ledger) and treated as absent — the
+consumer restarts from step 0, which is slow but always correct; a
+journal whose SPEC disagrees with the attach spec is a config error
+and refuses loudly (resuming a different stream would silently skip
+records).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from absl import logging
+
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+LEASE_SCHEMA = "ingest.lease"
+LEASE_VERSION = 1
+
+# Spec keys that must match for a lease to be resumable: together they
+# determine the pure (seed, step) batch plan.
+SPEC_KEYS = ("split", "seed", "batch_size", "image_size", "capacity_rows")
+
+
+def lease_path(lease_dir: str, consumer_id: str) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in consumer_id)
+    return os.path.join(lease_dir, f"lease-{safe}.json")
+
+
+class LeaseJournal:
+    """One consumer's durable stream position. ``consumed_through`` is
+    the COUNT of batches credited: the next batch to serve."""
+
+    def __init__(self, lease_dir: str, consumer_id: str, spec: dict,
+                 flush_every: int = 8, registry=None):
+        self.path = lease_path(lease_dir, consumer_id)
+        self.consumer_id = consumer_id
+        self.spec = {k: spec[k] for k in SPEC_KEYS}
+        self.flush_every = max(1, int(flush_every))
+        self.consumed_through = 0
+        self._flushed = -1
+        self._reg = registry
+        # One journal is shared across a consumer's successive serve
+        # threads (the server's in-memory lease cache); a reattach can
+        # briefly overlap the old thread's teardown flush.
+        self._lock = threading.Lock()
+        os.makedirs(lease_dir, exist_ok=True)
+
+    def load(self) -> int:
+        """Recover ``consumed_through`` from disk (0 when no journal /
+        corrupt journal). Spec mismatch raises — see module docstring."""
+        if not os.path.exists(self.path):
+            return 0
+        try:
+            payload, _ = artifact_lib.read_sealed_json(
+                self.path, artifact="ingest.lease", registry=self._reg
+            )
+        except artifact_lib.ArtifactCorrupt as e:
+            # read_sealed_json already counted it; start fresh rather
+            # than trust a position the digest disowns.
+            logging.warning(
+                "ingest lease %s failed seal verification (%s) — "
+                "consumer %s restarts from step 0", self.path, e,
+                self.consumer_id,
+            )
+            return 0
+        except (OSError, ValueError) as e:
+            logging.warning(
+                "ingest lease %s unreadable (%s) — consumer %s restarts "
+                "from step 0", self.path, e, self.consumer_id,
+            )
+            return 0
+        disk_spec = {k: payload.get(k) for k in SPEC_KEYS}
+        if disk_spec != self.spec:
+            raise ValueError(
+                f"ingest lease {self.path} was written for spec "
+                f"{disk_spec} but consumer {self.consumer_id!r} attached "
+                f"with {self.spec} — a resumed stream must keep its "
+                "(split, seed, batch, image_size, residency) plan; "
+                "delete the lease to deliberately restart"
+            )
+        with self._lock:
+            self.consumed_through = int(payload.get("consumed_through", 0))
+            self._flushed = self.consumed_through
+            return self.consumed_through
+
+    def reset_to(self, step: int) -> None:
+        """Adopt an EXPLICIT position (the trainer's checkpoint step —
+        the authority that overrides whatever the journal held)."""
+        with self._lock:
+            self.consumed_through = int(step)
+
+    def advance(self, step: int) -> None:
+        """One credited batch: the consumer has consumed ``step``."""
+        with self._lock:
+            self.consumed_through = max(
+                self.consumed_through, int(step) + 1
+            )
+            if (self.consumed_through - max(self._flushed, 0)
+                    >= self.flush_every):
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self.consumed_through == self._flushed:
+            return
+        artifact_lib.write_sealed_json(
+            self.path,
+            {
+                "consumer_id": self.consumer_id,
+                "consumed_through": self.consumed_through,
+                **self.spec,
+            },
+            schema=LEASE_SCHEMA, version=LEASE_VERSION,
+        )
+        self._flushed = self.consumed_through
+        if self._reg is not None:
+            self._reg.counter(
+                "ingest.lease.flushes",
+                help="sealed lease-journal writes (per-consumer durable "
+                     "stream position; ingest/leases.py)",
+            ).inc()

@@ -1,0 +1,109 @@
+"""Ingest control protocol: length-prefixed JSON frames + slot layout.
+
+The control channel carries only SMALL messages (attach specs, slot
+lifecycle, stall stats) — image payloads never touch it; they travel
+through the shared-memory ring (ring.py). Framing is a 4-byte
+big-endian length followed by UTF-8 JSON, the simplest format two
+Python processes can speak without pickling (pickle over a socket
+would also be a code-execution surface; JSON is inert).
+
+Message types (``{"type": ...}``):
+
+  * ``attach``   consumer -> server: {consumer_id, split, seed,
+                 batch_size, image_size, capacity_rows,
+                 start_step|None}. ``start_step=None`` asks the server
+                 to resume from the consumer's lease journal.
+  * ``attached`` server -> consumer: {shm_name, n_slots, slot_bytes,
+                 batch_size, image_size, start_step, n_records,
+                 steps_per_epoch} — everything the client needs to map
+                 the ring and predict the stream.
+  * ``batch``    server -> consumer: {slot, step} — slot is filled.
+  * ``credit``   consumer -> server: {slot, step} — slot is free; the
+                 lease journal advances through ``step``.
+  * ``stats``    consumer -> server: {window_sec, input_wait_sec} —
+                 one tumbling window of the consumer's stall
+                 attribution, the fleet tuner's input.
+  * ``detach``   consumer -> server: clean goodbye (flush lease, free
+                 the ring). A dead socket (kill -9) is the unclean
+                 twin and takes the same server path.
+  * ``error``    server -> consumer: {message} — attach refused.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+import numpy as np
+
+_LEN = struct.Struct(">I")
+# A control frame is a few hundred bytes; a length beyond this is a
+# corrupt stream, not a big message — fail loudly instead of
+# allocating it.
+MAX_FRAME = 1 << 20
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    blob = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(blob) > MAX_FRAME:
+        raise ValueError(f"control frame too large: {len(blob)} bytes")
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> "bytes | None":
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:  # EOF: peer closed (or was killed)
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> "dict | None":
+    """One frame, or None on EOF. ``socket.timeout`` propagates — the
+    server's serve loop uses a short timeout as its poll cadence."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length > MAX_FRAME:
+        raise ValueError(f"control frame length {length} exceeds "
+                         f"{MAX_FRAME}: corrupt stream")
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# Slot layout: both sides derive identical offsets from the attach spec.
+# ---------------------------------------------------------------------------
+
+
+def slot_layout(batch_size: int, image_size: int) -> tuple[int, int]:
+    """-> (image_bytes, slot_bytes) for one {'image','grade'} batch:
+    uint8 [B,S,S,3] rows followed by int32 [B] grades, padded to a
+    64-byte boundary so consecutive slots stay cache-line aligned."""
+    image_bytes = batch_size * image_size * image_size * 3
+    grade_bytes = batch_size * 4
+    raw = image_bytes + grade_bytes
+    return image_bytes, raw + ((-raw) % 64)
+
+
+def slot_views(buf, slot: int, batch_size: int,
+               image_size: int) -> tuple[np.ndarray, np.ndarray]:
+    """(image_view, grade_view) into shared-memory ``buf`` for ``slot``
+    — numpy views over the mapped bytes, no copies. The server writes
+    through them; the client reads through them until it credits the
+    slot."""
+    image_bytes, slot_bytes = slot_layout(batch_size, image_size)
+    base = slot * slot_bytes
+    img = np.frombuffer(
+        buf, dtype=np.uint8, count=image_bytes, offset=base
+    ).reshape(batch_size, image_size, image_size, 3)
+    grd = np.frombuffer(
+        buf, dtype=np.int32, count=batch_size, offset=base + image_bytes
+    )
+    return img, grd

@@ -1,0 +1,112 @@
+"""Shared-memory batch ring: the ingest service's data plane.
+
+One ``multiprocessing.shared_memory`` slab per consumer, divided into
+``n_slots`` fixed-size batch slots (protocol.slot_layout). The SERVER
+creates and unlinks the slab and writes decoded rows straight into a
+free slot's numpy view — the row bytes cross the process boundary with
+zero serialization (no pickling of image payloads; the control socket
+carries only the slot number). The CONSUMER maps the same slab
+read-only-by-convention and credits a slot back over the control
+socket when its batch has been consumed.
+
+Slot lifecycle is socket-ordered, not shared-atomic: a slot the server
+announced (``batch``) belongs to the consumer until its ``credit``
+frame returns; the server never rewrites an uncredited slot. Unix
+sockets deliver frames in order, so no memory fences beyond the kernel
+boundary are needed.
+"""
+
+from __future__ import annotations
+
+import secrets
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from jama16_retina_tpu.ingest import protocol
+
+# Segment names THIS process created. An in-process attach (tests,
+# bench: server and consumer share one interpreter) must not unregister
+# the owner's tracker claim — the tracker keeps one entry per name per
+# process, so the attach-side unregister below would orphan the unlink.
+_OWNED_NAMES: set = set()
+
+
+def _unregister_from_tracker(shm) -> None:
+    """Detach this process's resource_tracker claim on an ATTACHED
+    (not owned) segment: the server owns the unlink; without this the
+    tracker tears the segment down when the first consumer exits and
+    logs spurious leak warnings for the rest."""
+    try:  # pragma: no cover - tracker internals vary across versions
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+
+
+class BatchRing:
+    """The slab + slot views. ``create=True`` is the server side (owns
+    the segment and its unlink); ``create=False`` attaches by name."""
+
+    def __init__(self, batch_size: int, image_size: int, n_slots: int,
+                 name: "str | None" = None, create: bool = True):
+        self.batch = int(batch_size)
+        self.image_size = int(image_size)
+        self.n_slots = max(1, int(n_slots))
+        _, self.slot_bytes = protocol.slot_layout(self.batch,
+                                                  self.image_size)
+        self._owner = bool(create)
+        if create:
+            # Short random name: the kernel caps shm names well below
+            # path length limits, and collisions must not alias rings.
+            name = name or f"jama16-ing-{secrets.token_hex(6)}"
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True,
+                size=self.slot_bytes * self.n_slots,
+            )
+            _OWNED_NAMES.add(self._shm.name)
+        else:
+            if not name:
+                raise ValueError("attaching a BatchRing needs its name")
+            self._shm = shared_memory.SharedMemory(name=name)
+            if self._shm.name not in _OWNED_NAMES:
+                _unregister_from_tracker(self._shm)
+        self.name = self._shm.name
+
+    def views(self, slot: int) -> tuple[np.ndarray, np.ndarray]:
+        if not 0 <= slot < self.n_slots:
+            raise IndexError(f"slot {slot} outside ring of {self.n_slots}")
+        return protocol.slot_views(self._shm.buf, slot, self.batch,
+                                   self.image_size)
+
+    def write(self, slot: int, image: np.ndarray,
+              grade: np.ndarray) -> None:
+        """Server side: copy one decoded batch into ``slot``. The only
+        copy on the whole server->consumer path for these bytes."""
+        img_v, grd_v = self.views(slot)
+        np.copyto(img_v, np.ascontiguousarray(image, dtype=np.uint8))
+        np.copyto(grd_v, np.ascontiguousarray(grade, dtype=np.int32))
+
+    def read(self, slot: int) -> dict:
+        """Consumer side: one {'image','grade'} HOST batch copied out
+        of the slot. A copy (not the view) is deliberate: the batch
+        must outlive the credit frame that frees the slot, and jax's
+        CPU backend may alias a numpy buffer it is handed — a reused
+        slot under a live alias would corrupt a training batch."""
+        img_v, grd_v = self.views(slot)
+        return {"image": np.array(img_v), "grade": np.array(grd_v)}
+
+    def close(self) -> None:
+        # Views into self._shm.buf hold exported pointers; drop
+        # everything this object created before closing the mapping.
+        try:
+            self._shm.close()
+        except BufferError:  # pragma: no cover - a view outlived us
+            pass
+        if self._owner:
+            _OWNED_NAMES.discard(self._shm.name)
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:  # pragma: no cover
+                pass

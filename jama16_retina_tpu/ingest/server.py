@@ -1,0 +1,602 @@
+"""The ingest server: one decode plane behind a unix control socket.
+
+Architecture (one process, one thread per consumer plus the acceptor):
+
+  * ``_SharedStream`` — per stream SPEC (split, seed, batch_size,
+    image_size, capacity_rows): ONE decoder (the tiered/rawshard stack
+    the in-process loaders use) + the pure ``_TierPlan`` index
+    bookkeeping + a small decoded-batch cache. Batch ``step`` is
+    computed EXACTLY as ``tiered_pipeline.host_reference_batches``
+    computes it — ``decode_batch(concat(res_ids, str_ids))`` — which is
+    what makes the served stream bit-identical (post-decode) to the
+    in-process tiered path at the same seed. Same-spec consumers share
+    the stream: the cache turns the second consumer's pulls into hits,
+    so decode is paid once per batch, not once per consumer (the
+    ``pipeline_fed_served_x2`` bench row's whole claim, and the
+    resume-without-re-decode drill's mechanism).
+  * per-consumer serve loop — fills the consumer's shared-memory ring
+    up to the live stage-depth knob, announces slots over the socket,
+    and advances the consumer's sealed lease journal on every credit.
+    A dead socket (kill -9) takes the same exit path as a clean
+    ``detach``: flush the lease, free the ring.
+  * ``FleetIngestTuner`` — consumers report stall windows over the
+    control channel; one merged window per cadence drives the PR-7
+    ``decide()`` policy over the server's decode pool and stage depth
+    (fleettune.py), published over the PR-15 fleet bus when
+    ``obs.fleet_dir`` is set.
+
+Fault sites: ``ingest.attach`` fires in the attach handler (an armed
+error refuses the attach with a typed ``error`` frame — the client
+raises, nothing half-attached survives), ``ingest.ring.write`` fires
+before each slot write (an armed error drops that consumer's
+connection — the consumer's reattach path is the recovery under test;
+a latency plan widens the in-flight window for kill drills).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+from absl import logging
+
+from jama16_retina_tpu.data import tiered_pipeline
+from jama16_retina_tpu.ingest import protocol
+from jama16_retina_tpu.ingest.fleettune import FleetIngestTuner
+from jama16_retina_tpu.ingest.leases import LeaseJournal
+from jama16_retina_tpu.ingest.ring import BatchRing
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import registry as obs_registry
+
+# Decoded-batch cache per stream, in batches: covers each consumer's
+# ring run-ahead plus the skew between near-lockstep consumers; beyond
+# it a straggler re-decodes (counted on ingest.decode.batches), which
+# is correct, just not free.
+CACHE_BATCHES = 8
+# Serve-loop poll cadence: how long a consumer thread waits for a
+# credit/stats frame before re-checking fill work and shutdown.
+_POLL_S = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamSpec:
+    """Everything that determines the pure (seed, step) batch plan."""
+
+    split: str
+    seed: int
+    batch_size: int
+    image_size: int
+    capacity_rows: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _SharedStream:
+    """One decoder + plan + decoded-batch cache for one StreamSpec."""
+
+    def __init__(self, spec: StreamSpec, decoder, reg, knobs=None):
+        self.spec = spec
+        self.decoder = decoder
+        self.plan = tiered_pipeline._TierPlan(
+            len(decoder), spec.batch_size, spec.capacity_rows, spec.seed
+        )
+        self._knobs = knobs
+        self._lock = threading.Lock()
+        self._cache: "collections.OrderedDict[int, dict]" = \
+            collections.OrderedDict()
+        self._c_decoded = reg.counter(
+            "ingest.decode.batches",
+            help="unique batch decodes the serve plane paid (cache "
+                 "misses); the no-re-decode drills assert deltas of "
+                 "this ledger",
+        )
+        self._c_hits = reg.counter(
+            "ingest.cache.hits",
+            help="served batches satisfied from the decoded-batch cache "
+                 "(a second consumer or a resume re-pull; decode paid "
+                 "once)",
+        )
+        self._h_decode = reg.histogram(
+            "ingest.decode.batch_s",
+            help="seconds to decode one served batch (cache misses "
+                 "only)",
+        )
+
+    def batch(self, step: int) -> dict:
+        """The host batch for ``step`` — bit-identical to
+        ``host_reference_batches`` at the same spec, by construction:
+        same plan, same id order, same decoder contract."""
+        with self._lock:
+            hit = self._cache.get(step)
+            if hit is not None:
+                self._cache.move_to_end(step)
+                self._c_hits.inc()
+                return hit
+            if self._knobs is not None:
+                self.decoder.set_workers(self._knobs.decode_workers)
+            res_ids, str_ids = self.plan.batch_indices(step)
+            t0 = time.perf_counter()
+            host = self.decoder.decode_batch(
+                np.concatenate([res_ids, str_ids]).astype(np.int64)
+            )
+            self._h_decode.observe(time.perf_counter() - t0)
+            self._c_decoded.inc()
+            self._cache[step] = host
+            while len(self._cache) > CACHE_BATCHES:
+                self._cache.popitem(last=False)
+            return host
+
+    def close(self) -> None:
+        self.decoder.close()
+
+
+def _build_decoder(data_dir: str, split: str, image_size: int, cfg,
+                   workers: int):
+    """The decode stage the server hosts, chosen like the in-process
+    loaders choose it: ``data.loader=rawshard`` serves the transcoded
+    shards (decode paid offline), anything else the TFRecord parse
+    path. Quarantine semantics ride along unchanged."""
+    from jama16_retina_tpu.data.grain_pipeline import (
+        ParallelDecoder,
+        TFRecordIndex,
+    )
+
+    if cfg.data.loader == "rawshard":
+        from jama16_retina_tpu.data import rawshard
+
+        shard_dir = (
+            cfg.data.rawshard_dir or
+            rawshard.default_shard_dir(data_dir, image_size)
+        )
+        rs = rawshard.RawShardSplit(
+            shard_dir, split, image_size=image_size, source_dir=data_dir
+        )
+        return rawshard.RawShardDecoder(
+            rs, workers=workers, quarantine=cfg.data.quarantine_bad_records
+        )
+    from jama16_retina_tpu.data import tfrecord
+
+    index = TFRecordIndex(tfrecord.list_split(data_dir, split))
+    return ParallelDecoder(
+        index, image_size, workers=workers,
+        quarantine=cfg.data.quarantine_bad_records,
+    )
+
+
+class IngestServer:
+    """The disaggregated decode plane. ``start()`` runs the acceptor in
+    a daemon thread (tests, bench); ``serve_forever()`` blocks
+    (scripts/ingest_server.py)."""
+
+    def __init__(self, data_dir: str, cfg, socket_path: "str | None" = None,
+                 registry=None):
+        self.data_dir = data_dir
+        self.cfg = cfg
+        self.socket_path = socket_path or cfg.ingest.socket_path
+        if not self.socket_path:
+            raise ValueError(
+                "the ingest server needs ingest.socket_path (the unix "
+                "control socket consumers attach through)"
+            )
+        self.lease_dir = cfg.ingest.lease_dir or os.path.join(
+            os.path.dirname(os.path.abspath(self.socket_path)), "leases"
+        )
+        self._reg = (
+            registry if registry is not None
+            else obs_registry.default_registry()
+        )
+        self._lock = threading.Lock()
+        self._streams: dict[StreamSpec, _SharedStream] = {}
+        # Live lease journals by consumer id: while the server runs,
+        # the in-memory position is EXACT (advanced on every credit),
+        # so a kill -9'd consumer reattaches precisely where it died —
+        # the on-disk seal (lagging <= lease_flush_every) only matters
+        # across a SERVER restart.
+        self._leases: dict[str, LeaseJournal] = {}
+        self._running = False
+        self._listener: "socket.socket | None" = None
+        self._threads: list[threading.Thread] = []
+        self._consumers = 0
+
+        # Fleet-scope tuner (data.autotune=true): the PR-7 policy over
+        # the server's own decode pool, fed by merged consumer windows.
+        self.knobs = None
+        self.fleet_tuner = None
+        if cfg.data.autotune:
+            from jama16_retina_tpu.data import autotune as autotune_lib
+
+            knobs, tuner = autotune_lib.for_config(
+                cfg, mesh=None, registry=self._reg
+            )
+            self.knobs = knobs
+            self.fleet_tuner = FleetIngestTuner(tuner)
+
+        self._bus = None
+        try:
+            from jama16_retina_tpu.obs import fleet as fleet_lib
+
+            self._bus = fleet_lib.bus_for(cfg, "ingest",
+                                          registry=self._reg)
+        except Exception as e:  # pragma: no cover - bus is optional
+            logging.warning("ingest fleet bus unavailable: %s", e)
+
+        self._g_consumers = self._reg.gauge(
+            "ingest.consumers",
+            help="consumers currently attached to the ingest server "
+                 "[fleet:max]",
+        )
+        self._c_attaches = self._reg.counter(
+            "ingest.attaches",
+            help="consumer attaches accepted since server start "
+                 "(reattaches after a kill count again)",
+        )
+        self._c_resumes = self._reg.counter(
+            "ingest.lease.resumes",
+            help="attaches that resumed from a lease journal position "
+                 "> 0 instead of step 0",
+        )
+        self._c_batches = self._reg.counter(
+            "ingest.batches_served",
+            help="batches announced to consumers over shared-memory "
+                 "rings, all consumers",
+        )
+        self._c_rows = self._reg.counter(
+            "ingest.rows_served",
+            help="rows of those batches (batches_served x batch_size)",
+        )
+        self._g_inflight = self._reg.gauge(
+            "ingest.ring.inflight",
+            help="ring slots currently filled and uncredited, summed "
+                 "over consumers (the service's live run-ahead)",
+        )
+        self._h_credit = self._reg.histogram(
+            "ingest.credit.wait_s",
+            help="seconds the server spent blocked with a FULL ring "
+                 "waiting for a consumer credit (backpressure: the "
+                 "consumer is the bottleneck, not decode)",
+        )
+        self._inflight_total = 0
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "IngestServer":
+        os.makedirs(os.path.dirname(os.path.abspath(self.socket_path)),
+                    exist_ok=True)
+        os.makedirs(self.lease_dir, exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        with self._lock:
+            self._running = True
+        t = threading.Thread(target=self._accept_loop,
+                             name="jama16-ingest-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self._bus is not None:
+            tb = threading.Thread(target=self._bus_loop,
+                                  name="jama16-ingest-bus", daemon=True)
+            tb.start()
+            self._threads.append(tb)
+        logging.info("ingest server listening on %s (leases under %s)",
+                     self.socket_path, self.lease_dir)
+        return self
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:  # pragma: no cover - operator ^C
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:  # pragma: no cover
+                pass
+        for t in list(self._threads):
+            t.join(timeout=5.0)
+        with self._lock:
+            streams, self._streams = dict(self._streams), {}
+        for s in streams.values():
+            s.close()
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:  # pragma: no cover
+                pass
+
+    # -- internals ----------------------------------------------------
+
+    def _alive(self) -> bool:
+        with self._lock:
+            return self._running
+
+    def _accept_loop(self) -> None:
+        while self._alive():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_consumer, args=(conn,),
+                                 name="jama16-ingest-consumer", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _bus_loop(self) -> None:
+        while self._alive():
+            time.sleep(1.0)
+            try:
+                self._bus.publish(self._reg.snapshot(),
+                                  heartbeat={"consumers": self._consumers})
+            except Exception as e:  # pragma: no cover - keep serving
+                logging.warning("ingest bus publish failed: %s", e)
+
+    def _stream_for(self, spec: StreamSpec) -> _SharedStream:
+        with self._lock:
+            stream = self._streams.get(spec)
+            if stream is None:
+                workers = (
+                    self.knobs.decode_workers if self.knobs is not None
+                    else self._resolve_workers()
+                )
+                decoder = _build_decoder(
+                    self.data_dir, spec.split, spec.image_size, self.cfg,
+                    workers,
+                )
+                if spec.batch_size > len(decoder):
+                    raise ValueError(
+                        f"batch_size={spec.batch_size} exceeds split "
+                        f"{spec.split!r} n={len(decoder)}"
+                    )
+                stream = _SharedStream(spec, decoder, self._reg,
+                                       knobs=self.knobs)
+                self._streams[spec] = stream
+                logging.info(
+                    "ingest stream %s: %d records, %d resident + %d "
+                    "streamed rows/batch", spec, len(decoder),
+                    stream.plan.res_pb, stream.plan.str_pb,
+                )
+            return stream
+
+    def _resolve_workers(self) -> int:
+        from jama16_retina_tpu.data.grain_pipeline import (
+            resolve_decode_workers,
+        )
+
+        return resolve_decode_workers(self.cfg.data.decode_workers)
+
+    def _lease_for(self, cid: str,
+                   spec: StreamSpec) -> "tuple[LeaseJournal, bool]":
+        """The live journal for ``cid`` (reattach shares the exact
+        in-memory position), or a fresh one when none exists or the
+        consumer attached with a DIFFERENT spec (then ``load()`` is the
+        arbiter — it refuses a spec-mismatched on-disk journal)."""
+        with self._lock:
+            lease = self._leases.get(cid)
+            if lease is not None and lease.spec == {
+                k: spec.as_dict()[k] for k in lease.spec
+            }:
+                return lease, False
+            lease = LeaseJournal(
+                self.lease_dir, cid, spec.as_dict(),
+                flush_every=self.cfg.ingest.lease_flush_every,
+                registry=self._reg,
+            )
+            self._leases[cid] = lease
+            return lease, True
+
+    def _stage_depth(self) -> int:
+        if self.knobs is not None:
+            return self.knobs.stage_depth
+        return tiered_pipeline.resolve_stage_depth(self.cfg.data)
+
+    def _serve_consumer(self, conn: socket.socket) -> None:
+        cid = "<unattached>"
+        ring = None
+        lease = None
+        attached = False
+        try:
+            conn.settimeout(self.cfg.ingest.attach_timeout_s)
+            msg = protocol.recv_msg(conn)
+            if msg is None or msg.get("type") != "attach":
+                return
+            try:
+                faultinject.check("ingest.attach")
+                cid = str(msg["consumer_id"])
+                spec = StreamSpec(
+                    split=str(msg["split"]), seed=int(msg["seed"]),
+                    batch_size=int(msg["batch_size"]),
+                    image_size=int(msg["image_size"]),
+                    capacity_rows=int(msg["capacity_rows"]),
+                )
+                stream = self._stream_for(spec)
+                lease, fresh = self._lease_for(cid, spec)
+                if msg.get("start_step") is None:
+                    # `fresh` means no live journal for this cid: the
+                    # sealed on-disk position is all we have (server
+                    # restart). Otherwise the in-memory lease is exact.
+                    start = lease.load() if fresh else lease.consumed_through
+                    if start:
+                        self._c_resumes.inc()
+                        logging.info(
+                            "ingest consumer %s resumes at step %d from "
+                            "its lease journal", cid, start,
+                        )
+                else:
+                    # An explicit start (trainer resume from its own
+                    # checkpoint step) overrides the journal — adopt it
+                    # so the lease tracks the authoritative position.
+                    start = int(msg["start_step"])
+                    lease.reset_to(start)
+                ring = BatchRing(
+                    spec.batch_size, spec.image_size,
+                    self.cfg.ingest.ring_slots, create=True,
+                )
+            except Exception as e:
+                protocol.send_msg(conn, {"type": "error",
+                                         "message": f"{type(e).__name__}: {e}"})
+                raise
+            protocol.send_msg(conn, {
+                "type": "attached", "shm_name": ring.name,
+                "n_slots": ring.n_slots, "slot_bytes": ring.slot_bytes,
+                "batch_size": spec.batch_size,
+                "image_size": spec.image_size, "start_step": start,
+                "n_records": stream.plan.n,
+                "steps_per_epoch": stream.plan.steps,
+            })
+            self._c_attaches.inc()
+            attached = True
+            with self._lock:
+                self._consumers += 1
+                self._g_consumers.set(self._consumers)
+            if self.fleet_tuner is not None:
+                self.fleet_tuner.attach(cid)
+            c_rows_consumer = self._reg.counter(
+                f"ingest.consumer.{_metric_id(cid)}.rows",
+                help="decoded rows served to this one consumer "
+                     "(per-consumer share of ingest.rows_served)",
+            )
+            self._pump(conn, stream, ring, lease, c_rows_consumer)
+        except Exception as e:
+            logging.warning("ingest consumer %s dropped: %s: %s", cid,
+                            type(e).__name__, e)
+        finally:
+            if lease is not None:
+                try:
+                    lease.flush()
+                except OSError as e:  # pragma: no cover - disk full etc
+                    logging.warning("ingest lease flush for %s failed: %s",
+                                    cid, e)
+            if attached:
+                with self._lock:
+                    self._consumers = max(0, self._consumers - 1)
+                    self._g_consumers.set(self._consumers)
+            if self.fleet_tuner is not None:
+                self.fleet_tuner.detach(cid)
+            if ring is not None:
+                ring.close()
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    def _pump(self, conn, stream, ring, lease, c_rows_consumer) -> None:
+        """The per-consumer serve loop: fill free slots to the live
+        stage depth, then wait for credit/stats frames. Runs until the
+        consumer detaches, dies, or the server stops."""
+        free = collections.deque(range(ring.n_slots))
+        inflight: dict[int, int] = {}
+        try:
+            self._pump_loop(conn, stream, ring, lease, c_rows_consumer,
+                            free, inflight)
+        finally:
+            # The consumer is gone (detach, kill, or fault): its
+            # uncredited slots leave the live run-ahead gauge.
+            if inflight:
+                with self._lock:
+                    self._inflight_total -= len(inflight)
+                    self._g_inflight.set(self._inflight_total)
+
+    def _pump_loop(self, conn, stream, ring, lease, c_rows_consumer,
+                   free, inflight) -> None:
+        next_step = lease.consumed_through
+        conn.settimeout(_POLL_S)
+        while self._alive():
+            target = max(1, min(ring.n_slots, self._stage_depth()))
+            while free and len(inflight) < target:
+                slot = free.popleft()
+                batch = stream.batch(next_step)
+                faultinject.check("ingest.ring.write")
+                ring.write(slot, batch["image"], batch["grade"])
+                inflight[slot] = next_step
+                try:
+                    protocol.send_msg(conn, {"type": "batch", "slot": slot,
+                                             "step": next_step})
+                except OSError:
+                    # Consumer closed while we were filling. Its final
+                    # credits may still sit in the socket buffer —
+                    # drain them so the lease lands on the last batch
+                    # it actually consumed, not the last one we saw.
+                    self._drain_credits(conn, lease, inflight)
+                    return
+                self._c_batches.inc()
+                self._c_rows.inc(stream.plan.batch)
+                c_rows_consumer.inc(stream.plan.batch)
+                next_step += 1
+                with self._lock:
+                    self._inflight_total += 1
+                    self._g_inflight.set(self._inflight_total)
+            ring_full = not free
+            t0 = time.perf_counter()
+            try:
+                msg = protocol.recv_msg(conn)
+            except socket.timeout:
+                if ring_full:
+                    self._h_credit.observe(time.perf_counter() - t0)
+                continue
+            if msg is None:
+                return  # EOF: consumer gone (kill -9 or close)
+            if ring_full:
+                self._h_credit.observe(time.perf_counter() - t0)
+            kind = msg.get("type")
+            if kind == "credit":
+                self._credit(lease, free, inflight, msg)
+            elif kind == "stats" and self.fleet_tuner is not None:
+                self.fleet_tuner.report(
+                    lease.consumer_id,
+                    float(msg.get("window_sec", 0.0)),
+                    float(msg.get("input_wait_sec", 0.0)),
+                )
+            elif kind == "detach":
+                return
+
+    def _credit(self, lease, free, inflight, msg) -> None:
+        slot = int(msg["slot"])
+        step = inflight.pop(slot, None)
+        if step is None:
+            return
+        if free is not None:
+            free.append(slot)
+        lease.advance(step)
+        with self._lock:
+            self._inflight_total -= 1
+            self._g_inflight.set(self._inflight_total)
+
+    def _drain_credits(self, conn, lease, inflight) -> None:
+        """Read whatever the departed consumer left in the socket
+        buffer (in-order before its EOF): credits advance the lease,
+        anything else is ignored. Returns on EOF or timeout."""
+        while True:
+            try:
+                msg = protocol.recv_msg(conn)
+            except (socket.timeout, OSError):
+                return
+            if msg is None or msg.get("type") == "detach":
+                return
+            if msg.get("type") == "credit":
+                self._credit(lease, None, inflight, msg)
+
+
+def _metric_id(consumer_id: str) -> str:
+    """Consumer id -> a metric-name segment (lowercase [a-z0-9_])."""
+    out = "".join(
+        c if c.isalnum() else "_" for c in consumer_id.lower()
+    ).strip("_")
+    return out or "anon"

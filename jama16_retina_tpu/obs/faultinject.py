@@ -99,6 +99,17 @@ SITES = {
                               "— a latency plan holds the window open "
                               "for the kill -9 torn-write drill "
                               "(integrity/artifact.py)",
+    "ingest.attach": "ingest-server consumer attach handler (ingest/"
+                     "server.py; an injected error refuses the attach "
+                     "with a typed error frame — the consumer raises, "
+                     "nothing half-attached survives server-side)",
+    "ingest.ring.write": "before each shared-memory ring slot write in "
+                         "the ingest server's per-consumer serve loop "
+                         "(ingest/server.py; an injected error drops "
+                         "that consumer's connection — its lease-"
+                         "journal reattach is the recovery under test; "
+                         "latency plans widen the in-flight window for "
+                         "kill drills)",
 }
 
 # Error classes a JSON spec may name. Deliberately small: injected

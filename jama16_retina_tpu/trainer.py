@@ -483,6 +483,16 @@ def _train_stream(
             data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
             skip_batches=skip_batches, mesh=mesh, knobs=knobs,
         )
+    if cfg.data.loader == "served":
+        from jama16_retina_tpu.data import served
+
+        # Disaggregated ingest (ISSUE 17): batches arrive over a
+        # shared-memory ring from a scripts/ingest_server.py process
+        # that owns the decode plane for every local consumer. Host
+        # batches, same plan as 'tiered' — bit-identical stream.
+        return served.train_batches(
+            cfg, seed=seed, skip_batches=skip_batches, mesh=mesh,
+        )
     if cfg.data.loader == "grain":
         from jama16_retina_tpu.data import grain_pipeline
 
@@ -495,7 +505,7 @@ def _train_stream(
     if cfg.data.loader != "tfdata":
         raise ValueError(
             f"unknown data.loader {cfg.data.loader!r} "
-            "(want tfdata|grain|hbm|tiered|rawshard)"
+            "(want tfdata|grain|hbm|tiered|rawshard|served)"
         )
     return pipeline.train_batches(
         data_dir, "train", cfg.data, cfg.model.image_size, seed=seed,
@@ -2370,11 +2380,12 @@ def fit_tf(
             "checkpoint; the legacy tf backend cannot load one — "
             "fine-tune on the flax path"
         )
-    if cfg.data.loader in ("hbm", "tiered", "rawshard"):
+    if cfg.data.loader in ("hbm", "tiered", "rawshard", "served"):
         raise ValueError(
-            f"data.loader={cfg.data.loader!r} yields device-resident "
-            "batches for the jit train step; the tf backend trains on "
-            "host — use the tfdata or grain loader with --device=tf"
+            f"data.loader={cfg.data.loader!r} is wired into the flax "
+            "train loops (device-resident batches, or the ingest "
+            "service's shared-memory ring); the legacy tf backend has "
+            "no wiring — use the tfdata or grain loader with --device=tf"
         )
     if cfg.data.autotune:
         raise ValueError(

@@ -31,6 +31,15 @@
 #       two-tenant fused bin demuxed with full attribution, and the
 #       v2 policy round-trip with v1 back-compat —
 #       scripts/interactive_smoke.py.
+#   bash scripts/ci_checks.sh --ingest-smoke
+#       lint + the disaggregated ingest smoke (ISSUE 17): one real
+#       ingest-server process + two real consumer processes (a
+#       train.py fit on data.loader=served and a raw stream reader)
+#       over shared-memory rings, asserting served ≡ tiered loss
+#       curves bit for bit, reference-identical reader batches, and a
+#       kill -9'd consumer resuming from its lease journal with zero
+#       re-decode (fleet-bus decode ledger) —
+#       scripts/ingest_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -73,6 +82,12 @@ fi
 if [[ "${1:-}" == "--interactive-smoke" ]]; then
     echo "== interactive latency smoke (fusion + speculation + policy v2) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/interactive_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--ingest-smoke" ]]; then
+    echo "== disaggregated ingest smoke (server + 2 consumers over shm) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/ingest_smoke.py
     exit 0
 fi
 

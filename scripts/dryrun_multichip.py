@@ -231,8 +231,9 @@ def _measure_single(n_devices: int, steps: int, batch_per_device: int,
             ens_state, _ = ens_step(ens_state, ens_batch, ens_keys)
         jax.block_until_ready(ens_state)
         ens_rate = steps * k * eb / (time.perf_counter() - t0)
-        # Published UNGATED (the >=4-device rule bench._gate_ensemble_
-        # speedup applies): the real ratio, whatever it measures.
+        # Published UNGATED (bench._gate_ensemble_speedup's wide-mesh
+        # rule applies: this step IS member-sharded over >=4 devices,
+        # the production form): the real ratio, whatever it measures.
         out[f"ensemble4_member_images_per_sec_d{n_devices}"] = round(
             ens_rate, 1
         )

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""The disaggregated ingest server (ISSUE 17): one decode plane for
+every local consumer.
+
+Starts ``jama16_retina_tpu.ingest.server.IngestServer`` on a unix
+control socket and blocks. Consumers (train.py runs with
+``data.loader=served``, the smoke's raw reader, anything that speaks
+ingest/protocol.py) attach over the socket, map the per-consumer
+shared-memory batch ring, and stream host batches that are
+bit-identical (post-decode) to the in-process tiered/rawshard path at
+the same seed — decode is paid ONCE on this process for all of them.
+
+Usage:
+
+    python scripts/ingest_server.py --data_dir /data/eyepacs \\
+        --config eyepacs --socket /tmp/jama16-ingest.sock \\
+        --set data.loader=rawshard --set data.autotune=true
+
+    # consumers, each in its own process:
+    python train.py --data_dir /data/eyepacs --config eyepacs \\
+        --set data.loader=served \\
+        --set ingest.socket_path=/tmp/jama16-ingest.sock
+
+``--set data.loader=...`` picks the decode stage the server HOSTS
+(rawshard mmap rows vs TFRecord parse); consumers always say
+``served``. Per-consumer lease journals (sealed, under
+``ingest.lease_dir`` or ``<socket dir>/leases``) make both crash
+directions durable: a killed consumer reattaches where it stopped
+without re-decode, a killed server restarts into the same epoch plan.
+With ``data.autotune=true`` the PR-7 tuner runs here at fleet scope —
+merged per-consumer stall windows drive decode_workers/stage_depth for
+everyone. With ``obs.fleet_dir`` set, the server publishes its
+registry on the fleet bus (role ``ingest``) for scripts/obs_report.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--data_dir", required=True,
+        help="directory holding the dataset the decode plane serves",
+    )
+    parser.add_argument(
+        "--config", default="smoke",
+        help="config preset (the data.* decode knobs come from here)",
+    )
+    parser.add_argument(
+        "--socket", default="",
+        help="unix control socket path (overrides ingest.socket_path)",
+    )
+    parser.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="config overrides, e.g. data.loader=rawshard",
+    )
+    args = parser.parse_args(argv)
+
+    # Arm env-driven fault plans (JAMA16_FAULTS) before serving: the
+    # ingest.attach / ingest.ring.write chaos drills drive this
+    # process exactly like train/predict arm theirs.
+    from jama16_retina_tpu.obs import faultinject
+
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.ingest.server import IngestServer
+
+    cfg = override(get_config(args.config), list(args.set))
+    faultinject.arm_from_env_or_config(cfg.obs.fault_plan)
+    server = IngestServer(args.data_dir, cfg,
+                          socket_path=args.socket or None)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

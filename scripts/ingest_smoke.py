@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""CI smoke for the disaggregated ingest service (ISSUE 17): one REAL
+server process feeding two REAL consumer processes over shared memory.
+
+What it proves, with real process boundaries (the unit tests cover the
+same seams in-process):
+
+  * a ``train.py`` smoke fit with ``data.loader=served`` completes and
+    its per-step loss curve is BIT-IDENTICAL to the same fit over the
+    in-process ``tiered`` loader (same seed, partial residency) — the
+    service changes where decode runs, never what training sees;
+  * a raw stream reader attached CONCURRENTLY with the fit (the
+    ``ingest.consumers`` fleet heartbeat shows 2) receives batches
+    bit-identical to ``tiered_pipeline.host_reference_batches``;
+  * the reader is then ``kill -9``'d mid-epoch and a successor
+    reattaches with ``start_step=None``: it resumes at EXACTLY the
+    next uncredited step from the lease journal, its batches still
+    match the reference, and the server's ``ingest.decode.batches``
+    ledger (read off the fleet bus) grows by exactly the NEW steps the
+    successor consumed — zero re-decode.
+
+Run via ``scripts/ci_checks.sh --ingest-smoke`` or directly:
+
+    python scripts/ingest_smoke.py
+
+``--reader`` is the internal consumer-B entry point (spawned as a
+subprocess); not for direct use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH = 8
+IMAGE = 64
+CAPACITY = 24  # rows resident of 48: partial residency, mixed batches
+READER_SEED = 5
+FIT_STEPS = 8
+
+
+def _digest(batch) -> str:
+    return hashlib.sha256(
+        batch["image"].tobytes() + batch["grade"].tobytes()
+    ).hexdigest()
+
+
+def reader_main(args) -> int:
+    """Consumer process: attach (lease resume), stream, print digests."""
+    from jama16_retina_tpu.data.served import ServedStream
+
+    stream = ServedStream(
+        args.socket, consumer_id=args.consumer_id, split="train",
+        seed=READER_SEED, batch_size=BATCH, image_size=IMAGE,
+        capacity_rows=CAPACITY, start_step=None,
+    )
+    print(json.dumps({"event": "attached",
+                      "start_step": stream.start_step}), flush=True)
+    for i in range(args.count):
+        b = next(stream)
+        print(json.dumps({"event": "batch",
+                          "step": stream.start_step + i,
+                          "digest": _digest(b)}), flush=True)
+    if args.hold:
+        # Park with credits already sent; the driver kill -9s us here
+        # — "mid-epoch" for the 48-record/6-step fixture stream.
+        print(json.dumps({"event": "holding"}), flush=True)
+        time.sleep(600)
+    stream.close()
+    print(json.dumps({"event": "done"}), flush=True)
+    return 0
+
+
+def _spawn_reader(socket_path: str, count: int, hold: bool) -> subprocess.Popen:
+    cmd = [sys.executable, os.path.abspath(__file__), "--reader",
+           "--socket", socket_path, "--count", str(count),
+           "--consumer_id", "reader"]
+    if hold:
+        cmd.append("--hold")
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+
+
+def _read_until(proc: subprocess.Popen, event: str, out: list,
+                timeout_s: float = 120.0) -> None:
+    """Collect the reader's JSON lines into ``out`` until ``event``."""
+    deadline = time.time() + timeout_s
+    for line in proc.stdout:
+        rec = json.loads(line)
+        out.append(rec)
+        if rec.get("event") == event:
+            return
+        if time.time() > deadline:
+            break
+    raise AssertionError(
+        f"reader exited without {event!r} (got {[r.get('event') for r in out]})"
+    )
+
+
+def _ingest_counters(fleet_dir: str) -> "tuple[dict, dict] | None":
+    """(counters, heartbeat) from the ingest role's newest fleet
+    segment, or None before the first publish."""
+    from jama16_retina_tpu.obs import fleet
+
+    newest = None
+    for (role, _pid), stream in fleet.read_fleet(fleet_dir).items():
+        if role != "ingest" or not stream["segments"]:
+            continue
+        seg = stream["segments"][-1]
+        if newest is None or seg["t"] > newest["t"]:
+            newest = seg
+    if newest is None:
+        return None
+    return newest["snapshot"].get("counters", {}), newest.get("heartbeat", {})
+
+
+def _settled_decode_count(fleet_dir: str, timeout_s: float = 60.0) -> dict:
+    """Poll the fleet bus until ``ingest.decode.batches`` is stable
+    across two consecutive segments (the serve threads have quiesced),
+    then return that segment's counters."""
+    last, deadline = None, time.time() + timeout_s
+    while time.time() < deadline:
+        got = _ingest_counters(fleet_dir)
+        if got is not None:
+            counters, _ = got
+            cur = counters.get("ingest.decode.batches", 0.0)
+            if last is not None and cur == last:
+                return counters
+            last = cur
+        time.sleep(1.2)
+    raise AssertionError("ingest fleet segments never settled")
+
+
+def _fit(name: str, loader: str, data_dir: str, workdir: str,
+         socket_path: str, resident_bytes: int) -> None:
+    cmd = [
+        sys.executable, os.path.join(REPO, "train.py"),
+        "--config", "smoke", "--device", "cpu",
+        "--data_dir", data_dir, "--workdir", workdir,
+        "--set", f"data.loader={loader}",
+        "--set", f"data.batch_size={BATCH}",
+        "--set", f"eval.batch_size={BATCH}",
+        "--set", f"train.steps={FIT_STEPS}",
+        "--set", f"train.eval_every={FIT_STEPS}",
+        "--set", "train.log_every=1",
+        "--set", "train.lr_schedule=constant",
+        "--set", f"data.tiered_resident_bytes={resident_bytes}",
+        "--set", f"ingest.socket_path={socket_path}",
+    ]
+    t0 = time.time()
+    res = subprocess.run(
+        cmd, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    if res.returncode != 0:
+        raise AssertionError(
+            f"{name} fit failed rc={res.returncode}\n"
+            f"stdout:\n{res.stdout[-3000:]}\nstderr:\n{res.stderr[-3000:]}"
+        )
+    print(f"[ingest_smoke] {name} fit done in {time.time() - t0:.0f}s")
+
+
+def _losses(workdir: str) -> dict:
+    from jama16_retina_tpu.utils.logging import read_jsonl
+
+    return {
+        r["step"]: r["loss"]
+        for r in read_jsonl(os.path.join(workdir, "metrics.jsonl"))
+        if r.get("kind") == "train"
+    }
+
+
+def main(args) -> int:
+    root = tempfile.mkdtemp(prefix="jama16-ingest-smoke-")
+    data_dir = os.path.join(root, "data")
+    fleet_dir = os.path.join(root, "fleet")
+    sock = os.path.join(root, "ingest.sock")
+    server = reader = None
+    try:
+        from jama16_retina_tpu.configs import DataConfig
+        from jama16_retina_tpu.data import tfrecord, tiered_pipeline
+        from jama16_retina_tpu.data.hbm_pipeline import row_bytes
+
+        for split, n, seed in (("train", 48, 1), ("val", 16, 2),
+                               ("test", 16, 3)):
+            tfrecord.write_synthetic_split(data_dir, split, n, IMAGE,
+                                           num_shards=3, seed=seed)
+        resident_bytes = row_bytes(IMAGE) * CAPACITY
+
+        server = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "scripts/ingest_server.py"),
+             "--data_dir", data_dir, "--config", "smoke",
+             "--socket", sock, "--set", f"obs.fleet_dir={fleet_dir}"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+        )
+        deadline = time.time() + 60
+        while not os.path.exists(sock):
+            if server.poll() is not None or time.time() > deadline:
+                raise AssertionError("ingest server did not come up")
+            time.sleep(0.2)
+        print(f"[ingest_smoke] server pid={server.pid} on {sock}")
+
+        # The independent truth the served stream must reproduce.
+        ref = tiered_pipeline.host_reference_batches(
+            data_dir, "train", DataConfig(batch_size=BATCH), IMAGE,
+            seed=READER_SEED, capacity_rows=CAPACITY,
+        )
+        want = [_digest(next(ref)) for _ in range(14)]
+
+        # Consumer 1: the raw reader — 10 batches (mid-epoch 2 of the
+        # 6-step epoch), then parks for its kill -9.
+        reader = _spawn_reader(sock, count=10, hold=True)
+        lines: list = []
+        _read_until(reader, "holding", lines)
+        assert lines[0]["start_step"] == 0, lines[0]
+        got = [r["digest"] for r in lines if r.get("event") == "batch"]
+        assert got == want[:10], "reader A stream diverged from reference"
+        print("[ingest_smoke] reader A: 10/10 batches bit-identical")
+
+        # Consumer 2, concurrent with A: the served smoke fit.
+        w_served = os.path.join(root, "w_served")
+        _fit("served", "served", data_dir, w_served, sock, resident_bytes)
+        counters_mid = _ingest_counters(fleet_dir)
+        assert counters_mid is not None, "no fleet segments published"
+        peak = counters_mid[1].get("consumers", 0)
+        assert counters_mid[0].get("ingest.attaches", 0) >= 2, counters_mid
+        print(f"[ingest_smoke] served fit done (heartbeat consumers={peak})")
+
+        # Same fit, in-process tiered loader: the bit-identity bar.
+        w_tiered = os.path.join(root, "w_tiered")
+        _fit("tiered", "tiered", data_dir, w_tiered, sock, resident_bytes)
+        served_losses, tiered_losses = _losses(w_served), _losses(w_tiered)
+        assert served_losses and set(served_losses) == set(tiered_losses)
+        for s in sorted(served_losses):
+            assert served_losses[s] == tiered_losses[s], (
+                f"step {s}: served {served_losses[s]} != tiered "
+                f"{tiered_losses[s]}"
+            )
+        print(f"[ingest_smoke] fit bit-identity: {len(served_losses)} "
+              "steps of served loss == tiered loss")
+
+        # kill -9 consumer A mid-epoch, with credits 0..9 delivered.
+        os.kill(reader.pid, signal.SIGKILL)
+        reader.wait(timeout=30)
+        d0 = _settled_decode_count(fleet_dir)
+
+        # Successor reattaches from the lease journal: exact position,
+        # identical bytes, and ONLY its 4 new run-ahead steps decoded.
+        reader_b = _spawn_reader(sock, count=4, hold=False)
+        lines = []
+        _read_until(reader_b, "done", lines)
+        reader_b.wait(timeout=30)
+        assert lines[0]["start_step"] == 10, (
+            f"lease resume landed at {lines[0]['start_step']}, want 10"
+        )
+        got = [r["digest"] for r in lines if r.get("event") == "batch"]
+        assert got == want[10:14], "resumed stream diverged from reference"
+        d1 = _settled_decode_count(fleet_dir)
+        delta = (d1.get("ingest.decode.batches", 0)
+                 - d0.get("ingest.decode.batches", 0))
+        assert delta == 4, (
+            f"resume re-decoded: decode ledger grew by {delta} for 4 "
+            "resumed batches (want exactly the 4 NEW run-ahead steps; "
+            "the resumed window must come from cache)"
+        )
+        hits = (d1.get("ingest.cache.hits", 0)
+                - d0.get("ingest.cache.hits", 0))
+        assert hits >= 1, "resumed window never hit the decode cache"
+        assert d1.get("ingest.lease.resumes", 0) >= 1, d1
+        print(f"[ingest_smoke] kill -9 resume: step 10 exact, decode "
+              f"ledger +{delta:.0f} (no re-decode), cache hits "
+              f"+{hits:.0f}")
+        print(json.dumps({"ingest_smoke": "ok",
+                          "fit_steps_compared": len(served_losses),
+                          "resume_decode_delta": delta}))
+        return 0
+    finally:
+        for p in (reader, server):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=15)
+        if args.keep:
+            print(f"[ingest_smoke] kept {root}")
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--reader", action="store_true")
+    parser.add_argument("--socket", default="")
+    parser.add_argument("--count", type=int, default=10)
+    parser.add_argument("--consumer_id", default="reader")
+    parser.add_argument("--hold", action="store_true")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the scratch dir for debugging")
+    a = parser.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(reader_main(a) if a.reader else main(a))

@@ -717,6 +717,103 @@ def render_serving_cost(records: list) -> "str | None":
     return "serving cost:\n" + _table(rows, ("signal", "value"))
 
 
+def ingest_summary(records: list) -> "dict | None":
+    """The Ingest section's machine-readable form (--json twin;
+    ISSUE 17): the disaggregated decode plane's ledger — attached
+    consumers, batches/rows served, the decode-amplification ratio
+    (batches served per decode: > 1 means the shared decode plane is
+    actually paying decode once for several consumers), cache hits,
+    lease journal activity (flushes + crash resumes), ring
+    backpressure (in-flight slots + the credit-wait histogram), and
+    the per-consumer row split. None when the run never served —
+    a training-only or serving-only workdir renders nothing new."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    counters = latest.get("counters", {})
+    gauges = latest.get("gauges", {})
+    hists = latest.get("histograms", {})
+    attaches = int(counters.get("ingest.attaches", 0))
+    served = int(counters.get("ingest.batches_served", 0))
+    if not (attaches or served):
+        return None
+    decoded = int(counters.get("ingest.decode.batches", 0))
+    per_consumer = {
+        k[len("ingest.consumer."):-len(".rows")]: int(v)
+        for k, v in sorted(counters.items())
+        if k.startswith("ingest.consumer.") and k.endswith(".rows")
+    }
+    wait = hists.get("ingest.credit.wait_s") or {}
+    decode_s = hists.get("ingest.decode.batch_s") or {}
+    return {
+        "consumers": gauges.get("ingest.consumers"),
+        "attaches": attaches,
+        "batches_served": served,
+        "rows_served": int(counters.get("ingest.rows_served", 0)),
+        "decode_batches": decoded,
+        "cache_hits": int(counters.get("ingest.cache.hits", 0)),
+        "served_per_decode": (
+            round(served / decoded, 3) if decoded else None
+        ),
+        "lease_flushes": int(counters.get("ingest.lease.flushes", 0)),
+        "lease_resumes": int(counters.get("ingest.lease.resumes", 0)),
+        "ring_inflight": gauges.get("ingest.ring.inflight"),
+        "decode_batch_s": (
+            {"mean": decode_s.get("mean"), "p99": decode_s.get("p99")}
+            if decode_s.get("count") else None
+        ),
+        "credit_wait_s": (
+            {"count": wait.get("count"), "p50": wait.get("p50"),
+             "p99": wait.get("p99")}
+            if wait.get("count") else None
+        ),
+        "consumer_rows": per_consumer,
+    }
+
+
+def render_ingest(records: list) -> "str | None":
+    s = ingest_summary(records)
+    if s is None:
+        return None
+    rows = []
+    consumers = s["consumers"]
+    rows.append((
+        "consumers",
+        f"{int(consumers) if consumers is not None else 0} attached "
+        f"({s['attaches']} attaches, {s['lease_resumes']} lease resumes)",
+    ))
+    rows.append((
+        "served",
+        f"{s['batches_served']} batches / {s['rows_served']} rows",
+    ))
+    if s["served_per_decode"] is not None:
+        rows.append((
+            "decode amplification",
+            f"{s['served_per_decode']:.2f} batches served per decode "
+            f"({s['decode_batches']} decodes, {s['cache_hits']} cache "
+            f"hits)",
+        ))
+    if s["decode_batch_s"]:
+        d = s["decode_batch_s"]
+        rows.append((
+            "decode batch time",
+            f"mean {d['mean']:.3f}s, p99 {d['p99']:.3f}s",
+        ))
+    if s["credit_wait_s"]:
+        w = s["credit_wait_s"]
+        rows.append((
+            "ring-full credit wait",
+            f"p50 {w['p50']:.3f}s, p99 {w['p99']:.3f}s over "
+            f"{w['count']} full-ring waits (consumer backpressure)",
+        ))
+    if s["ring_inflight"] is not None:
+        rows.append(("ring slots in flight", f"{int(s['ring_inflight'])}"))
+    rows.append(("lease journal",
+                 f"{s['lease_flushes']} sealed flushes"))
+    for cid, n in sorted(s["consumer_rows"].items()):
+        rows.append((f"rows -> consumer {cid}", f"{n}"))
+    return "ingest service:\n" + _table(rows, ("signal", "value"))
+
+
 # ---------------------------------------------------------------------------
 # Lifecycle: controller state, transition timeline, gate verdicts (ISSUE 8)
 # ---------------------------------------------------------------------------
@@ -1696,6 +1793,7 @@ def main(argv=None) -> int:
             "quality": quality_summary(records),
             "reliability": reliability_summary(records),
             "serving_cost": serving_cost_summary(records),
+            "ingest": ingest_summary(records),
             "router": router_summary(records),
             "lifecycle": lifecycle_summary(records),
             "integrity": (
@@ -1729,6 +1827,10 @@ def main(argv=None) -> int:
     if sc:
         print()
         print(sc)
+    ing = render_ingest(records)
+    if ing:
+        print()
+        print(ing)
     rt = render_router(records)
     if rt:
         print()

@@ -265,6 +265,16 @@ _QUICK_TESTS = {
     "test_rawshard.py::test_streamed_bit_identity_with_source",
     "test_rawshard.py::test_loader_refuses_size_mismatch_and_staleness",
     "test_rawshard.py::test_hbm_budget_override_and_fallback_warning",
+    # disaggregated ingest service (ISSUE 17): the numpy-cheap pins —
+    # ring/protocol round-trips, the served stream's bit-identity with
+    # the in-process tiered reference across epochs, and the pure
+    # fleet-window merge; the fit()-level parity and lease/kill drills
+    # stay in the full tier (socket timing + XLA compiles)
+    "test_ingest.py::test_slot_layout_and_ring_roundtrip",
+    "test_ingest.py::test_protocol_roundtrip_and_eof",
+    "test_ingest.py::test_served_bit_identical_across_epochs_partial_residency",
+    "test_ingest.py::test_merge_windows_is_worst_consumer_over_longest_wall",
+    "test_ingest.py::test_fleet_tuner_fires_once_all_attached_report",
 }
 
 

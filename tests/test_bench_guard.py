@@ -593,23 +593,46 @@ def test_gated_ensemble_reason_lands_in_json():
 
 
 def test_ensemble_speedup_ungated_on_wide_mesh():
-    """ISSUE 14 satellite: on a >= 4-device mesh the REAL ratio
-    publishes whatever it measures — member-sharded stacking is the
-    production path there, so a <1.0 value is a regression the
-    trajectory must show, never a gated row — and the gated/reason
-    keys never appear. 1-device behavior (the previous test) is
-    pinned unchanged."""
+    """ISSUE 14 satellite: on a >= 4-device mesh with a genuinely
+    MEMBER-SHARDED step the REAL ratio publishes whatever it measures
+    — member-sharded stacking is the production path there, so a <1.0
+    value is a regression the trajectory must show, never a gated row
+    — and the gated/reason keys never appear. 1-device behavior (the
+    previous test) is pinned unchanged."""
     extras = {}
     bench._gate_ensemble_speedup(extras, rate=1182.4, device_only=1397.8,
-                                 n_dev=4)
+                                 n_dev=4, member_sharded=True)
     assert extras["ensemble4_parallel_speedup"] == 0.85
     assert "ensemble4_parallel_gated" not in extras
     assert "ensemble4_parallel_gated_reason" not in extras
     extras = {}
     bench._gate_ensemble_speedup(extras, rate=4200.0, device_only=1397.8,
-                                 n_dev=8)
+                                 n_dev=8, member_sharded=True)
     assert extras["ensemble4_parallel_speedup"] == 3.0
     assert "ensemble4_parallel_gated_reason" not in extras
+
+
+def test_ensemble_speedup_gated_on_fake_wide_replicated_mesh():
+    """ISSUE 17 satellite: device count alone must not un-gate. Bench's
+    in-process ensemble step is replicated (mesh=None), and on a
+    fake-device CPU host jax reports 8 'devices' — the old
+    ``n_dev >= 4`` rule published a 0.85 slowdown ungated there. A
+    sub-1.0 ratio from a NON-member-sharded step is withheld to the
+    _gated key with its reason, at every width."""
+    for n_dev in (1, 4, 8):
+        extras = {}
+        bench._gate_ensemble_speedup(extras, rate=1182.4,
+                                     device_only=1397.8, n_dev=n_dev,
+                                     member_sharded=False)
+        assert "ensemble4_parallel_speedup" not in extras
+        assert extras["ensemble4_parallel_gated"] == 0.85
+        assert "0.846" in extras["ensemble4_parallel_gated_reason"]
+    # A real >= 1.0 speedup still publishes even when replicated.
+    extras = {}
+    bench._gate_ensemble_speedup(extras, rate=1600.0, device_only=1397.8,
+                                 n_dev=8, member_sharded=False)
+    assert extras["ensemble4_parallel_speedup"] == 1.14
+    assert "ensemble4_parallel_gated" not in extras
 
 
 def test_disabled_tuner_is_one_branch():

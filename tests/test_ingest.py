@@ -1,0 +1,490 @@
+"""Disaggregated ingest service tests (ISSUE 17 tentpole).
+
+Pins: the shared-memory ring + length-prefixed control protocol, the
+served stream's bit-identity (post-decode) with the in-process tiered
+reference across epoch boundaries at partial residency, decode paid
+ONCE for same-spec consumers (cache-hit/decode-ledger arithmetic), the
+two crash directions of the sealed lease journals (killed consumer
+reattaches at its exact position with zero re-decode; restarted server
+resumes from the flushed position), the loud refusals (spec-mismatched
+lease, corrupt lease restarting from 0, attach without a server), the
+fleet-scope autotuner merge, the ingest fault sites, and trainer.fit on
+``data.loader=served`` matching ``data.loader=tiered`` loss for loss.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu import trainer
+from jama16_retina_tpu.configs import DataConfig, get_config, override
+from jama16_retina_tpu.data import hbm_pipeline, served, tfrecord
+from jama16_retina_tpu.data import tiered_pipeline
+from jama16_retina_tpu.ingest import protocol
+from jama16_retina_tpu.ingest.fleettune import FleetIngestTuner, merge_windows
+from jama16_retina_tpu.ingest.leases import LeaseJournal, lease_path
+from jama16_retina_tpu.ingest.ring import BatchRing
+from jama16_retina_tpu.ingest.server import IngestServer
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs.registry import Registry
+from jama16_retina_tpu.utils.logging import read_jsonl
+
+pytestmark = pytest.mark.ingest
+
+# 48 records / batch 8 -> 6 steps per epoch; capacity 24 -> partial
+# residency (4 resident + 4 streamed rows per batch), same plan shape
+# the tiered tests pin.
+N_RECORDS = 48
+BATCH = 8
+IMAGE = 32
+CAPACITY = 24
+SEED = 5
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("ingest_data"))
+    tfrecord.write_synthetic_split(d, "train", N_RECORDS, IMAGE, 3, seed=1)
+    tfrecord.write_synthetic_split(d, "val", 24, IMAGE, 2, seed=2)
+    return d
+
+
+@pytest.fixture()
+def server(data_dir, tmp_path):
+    reg = Registry()
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        f"data.batch_size={BATCH}",
+        f"ingest.socket_path={os.path.join(str(tmp_path), 'ingest.sock')}",
+    ])
+    srv = IngestServer(data_dir, cfg, registry=reg).start()
+    yield srv
+    srv.close()
+
+
+def _attach(srv, cid, start_step=None, seed=SEED, capacity=CAPACITY):
+    return served.ServedStream(
+        srv.socket_path, cid, split="train", seed=seed, batch_size=BATCH,
+        image_size=IMAGE, capacity_rows=capacity, start_step=start_step,
+    )
+
+
+def _refs(data_dir, n, seed=SEED, capacity=CAPACITY):
+    it = tiered_pipeline.host_reference_batches(
+        data_dir, "train", DataConfig(batch_size=BATCH), IMAGE, seed=seed,
+        capacity_rows=capacity,
+    )
+    return [next(it) for _ in range(n)]
+
+
+def _assert_batches_equal(got, want, step):
+    assert np.array_equal(got["image"], want["image"]), f"step {step} image"
+    assert np.array_equal(got["grade"], want["grade"]), f"step {step} grade"
+
+
+def _wait_detached(srv, timeout_s=5.0):
+    """Wait for every consumer serve thread to finish its teardown
+    (buffered-credit drain + lease flush) — reattach-after-drop tests
+    must not race the departing thread."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        with srv._lock:
+            if srv._consumers == 0:
+                return
+        time.sleep(0.02)
+    raise AssertionError("consumer serve thread did not exit")
+
+
+def _settle(counter, timeout_s=5.0):
+    """Wait for an asynchronously-advancing counter to go quiet (the
+    server processes trailing credits/refills after a detach)."""
+    last, quiet = counter.value, 0
+    deadline = time.time() + timeout_s
+    while time.time() < deadline and quiet < 4:
+        time.sleep(0.05)
+        cur = counter.value
+        quiet = quiet + 1 if cur == last else 0
+        last = cur
+    return counter.value
+
+
+# -- data plane: ring + protocol --------------------------------------------
+
+
+def test_slot_layout_and_ring_roundtrip():
+    img_bytes, slot_bytes = protocol.slot_layout(BATCH, IMAGE)
+    assert img_bytes == BATCH * IMAGE * IMAGE * 3
+    assert slot_bytes >= img_bytes + BATCH * 4
+    ring = BatchRing(BATCH, IMAGE, n_slots=2)
+    try:
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (BATCH, IMAGE, IMAGE, 3), np.uint8)
+        grd = rng.integers(0, 5, (BATCH,), np.int32)
+        ring.write(1, img, grd)
+        got = ring.read(1)
+        assert np.array_equal(got["image"], img)
+        assert np.array_equal(got["grade"], grd)
+        # read() must COPY: a slot reused under a live batch alias
+        # would corrupt a training batch.
+        ring.write(1, np.zeros_like(img), np.zeros_like(grd))
+        assert np.array_equal(got["image"], img)
+        with pytest.raises(IndexError):
+            ring.views(2)
+    finally:
+        ring.close()
+
+
+def test_ring_attach_by_name_sees_server_writes():
+    ring = BatchRing(BATCH, IMAGE, n_slots=2)
+    try:
+        img = np.full((BATCH, IMAGE, IMAGE, 3), 7, np.uint8)
+        grd = np.arange(BATCH, dtype=np.int32)
+        ring.write(0, img, grd)
+        attached = BatchRing(BATCH, IMAGE, n_slots=2, name=ring.name,
+                             create=False)
+        try:
+            got = attached.read(0)
+            assert np.array_equal(got["image"], img)
+            assert np.array_equal(got["grade"], grd)
+        finally:
+            attached.close()
+        with pytest.raises(ValueError, match="name"):
+            BatchRing(BATCH, IMAGE, n_slots=2, create=False)
+    finally:
+        ring.close()
+
+
+def test_protocol_roundtrip_and_eof():
+    a, b = socket.socketpair()
+    try:
+        protocol.send_msg(a, {"type": "credit", "slot": 3, "step": 17})
+        protocol.send_msg(a, {"type": "detach"})
+        assert protocol.recv_msg(b) == {"type": "credit", "slot": 3,
+                                        "step": 17}
+        assert protocol.recv_msg(b) == {"type": "detach"}
+        a.close()
+        assert protocol.recv_msg(b) is None  # EOF, not an exception
+    finally:
+        b.close()
+
+
+# -- the bit-identity contract ----------------------------------------------
+
+
+def test_served_bit_identical_across_epochs_partial_residency(
+        server, data_dir):
+    """14 steps at 48/8 = 6 steps/epoch crosses two epoch boundaries;
+    every batch must equal the independent host-decoded tiered
+    reference at the same (seed, capacity) — the served loader is the
+    tiered plan behind a socket, not a new data order."""
+    refs = _refs(data_dir, 14)
+    s = _attach(server, "bitident", start_step=0)
+    assert s.steps_per_epoch == N_RECORDS // BATCH
+    assert s.n_records == N_RECORDS
+    try:
+        for i in range(14):
+            _assert_batches_equal(next(s), refs[i], i)
+    finally:
+        s.close()
+
+
+def test_same_spec_consumers_pay_decode_once(server, data_dir):
+    """Two consumers at one spec pulling near-lockstep: the second
+    consumer's batches come from the decoded-batch cache — the decode
+    ledger stays ~half the served ledger (the decode-once claim of the
+    pipeline_fed_served_x2 bench row, pinned at test scale)."""
+    reg = server._reg
+    decoded = reg.counter("ingest.decode.batches")
+    hits = reg.counter("ingest.cache.hits")
+    d0, h0 = decoded.value, hits.value
+    n = 10
+    refs = _refs(data_dir, n)
+    errs = []
+
+    def consume(cid):
+        s = _attach(server, cid, start_step=0)
+        try:
+            for i in range(n):
+                _assert_batches_equal(next(s), refs[i], i)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+        finally:
+            s.close()
+
+    threads = [threading.Thread(target=consume, args=(f"twin{i}",))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    d_delta = _settle(decoded) - d0
+    h_delta = hits.value - h0
+    # 2 consumers x n batches served; decodes bounded by the unique
+    # steps touched (n plus bounded run-ahead), the rest cache hits.
+    assert d_delta <= n + 4, f"decode ledger {d_delta}: decode paid twice?"
+    assert h_delta >= n - 2, f"only {h_delta} cache hits for the twin"
+
+
+# -- lease journals: both crash directions ----------------------------------
+
+
+def test_killed_consumer_reattaches_exactly_no_redecode(server, data_dir):
+    refs = _refs(data_dir, 14)
+    s1 = _attach(server, "lazarus", start_step=None)
+    assert s1.start_step == 0
+    for i in range(5):
+        _assert_batches_equal(next(s1), refs[i], i)
+    # kill -9 stand-in: drop the socket without a detach frame. The
+    # server takes the EOF path, reading the buffered credits first,
+    # so the in-memory lease lands on the last consumed batch.
+    s1.close(detach=False)
+    _wait_detached(server)
+    decoded = server._reg.counter("ingest.decode.batches")
+    d0 = _settle(decoded)
+    s2 = _attach(server, "lazarus", start_step=None)
+    assert s2.start_step == 5, "in-memory lease must be exact"
+    for i in range(5, 14):
+        _assert_batches_equal(next(s2), refs[i], i)
+    s2.close()
+    # Zero re-decode: the resumed window re-serves nothing older than
+    # the cache, so the ledger grows by the NEW steps plus bounded
+    # run-ahead only — a replay would re-pay the first five too.
+    d_delta = _settle(decoded) - d0
+    assert d_delta <= (14 - 5) + 4, f"decode ledger grew {d_delta}"
+    assert server._reg.counter("ingest.lease.resumes").value >= 1
+
+
+def test_server_restart_resumes_from_flushed_journal(data_dir, tmp_path):
+    sock = os.path.join(str(tmp_path), "ingest.sock")
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        f"data.batch_size={BATCH}",
+        f"ingest.socket_path={sock}",
+        "ingest.lease_flush_every=4",
+    ])
+    reg1 = Registry()
+    srv1 = IngestServer(data_dir, cfg, registry=reg1).start()
+    refs = _refs(data_dir, 14)
+    s1 = _attach(srv1, "phoenix", start_step=None)
+    for i in range(9):
+        _assert_batches_equal(next(s1), refs[i], i)
+    s1.close()  # detach -> teardown flush seals consumed_through=9
+    _wait_detached(srv1)
+    srv1.close()
+    assert os.path.exists(lease_path(srv1.lease_dir, "phoenix"))
+
+    # A NEW server process-equivalent (fresh registry, fresh lease
+    # cache) over the same socket dir: the sealed journal is the only
+    # carrier of the position, and the plan re-derives from the spec.
+    reg2 = Registry()
+    srv2 = IngestServer(data_dir, cfg, registry=reg2).start()
+    try:
+        s2 = _attach(srv2, "phoenix", start_step=None)
+        assert s2.start_step == 9, "restarted server must resume the seal"
+        for i in range(9, 14):
+            _assert_batches_equal(next(s2), refs[i], i)
+        s2.close()
+        assert reg2.counter("ingest.lease.resumes").value == 1
+    finally:
+        srv2.close()
+
+
+def test_lease_spec_mismatch_refuses_loudly(server):
+    s1 = _attach(server, "strict", start_step=None)
+    next(s1)
+    s1.close()
+    _wait_detached(server)  # teardown flush seals the journal
+    # Same consumer id, different seed: resuming a different stream
+    # would silently skip records — the attach must refuse, typed.
+    with pytest.raises(RuntimeError, match="ingest attach refused"):
+        _attach(server, "strict", start_step=None, seed=SEED + 1)
+    # The refusal is non-destructive: the original spec still attaches
+    # and resumes its own lease.
+    s2 = _attach(server, "strict", start_step=None)
+    assert s2.start_step >= 1
+    s2.close()
+
+
+def test_corrupt_lease_restarts_from_zero(data_dir, tmp_path):
+    lease_dir = str(tmp_path / "leases")
+    spec = {"split": "train", "seed": SEED, "batch_size": BATCH,
+            "image_size": IMAGE, "capacity_rows": CAPACITY}
+    j = LeaseJournal(lease_dir, "bitrot", spec, flush_every=1)
+    j.advance(6)
+    assert LeaseJournal(lease_dir, "bitrot", spec).load() == 7
+    # Valid JSON whose payload no longer matches its sealed digest — a
+    # bit flip the parser survives is exactly what the seal exists for.
+    p = lease_path(lease_dir, "bitrot")
+    payload = json.loads(open(p, "r", encoding="utf-8").read())
+    payload["consumed_through"] = 99
+    open(p, "w", encoding="utf-8").write(json.dumps(payload))
+    # Counted + treated as absent: slow but always correct.
+    reg = Registry()
+    assert LeaseJournal(lease_dir, "bitrot", spec,
+                        registry=reg).load() == 0
+    assert reg.counter("integrity.corrupt").value >= 1
+
+
+def test_explicit_start_step_overrides_journal(server, data_dir):
+    refs = _refs(data_dir, 8)
+    s1 = _attach(server, "explicit", start_step=None)
+    for i in range(6):
+        next(s1)
+    s1.close()
+    _wait_detached(server)
+    # The trainer's checkpoint step is the authority on resume: an
+    # explicit start_step overrides the journal and re-bases it.
+    s2 = _attach(server, "explicit", start_step=3)
+    assert s2.start_step == 3
+    _assert_batches_equal(next(s2), refs[3], 3)
+    s2.close()
+
+
+# -- fleet-scope autotuning --------------------------------------------------
+
+
+class _StubTuner:
+    def __init__(self):
+        self.knobs = object()
+        self.observed = []
+
+    def observe(self, window_sec, input_wait_sec):
+        self.observed.append((window_sec, input_wait_sec))
+        return ("adjusted",)
+
+
+def test_merge_windows_is_worst_consumer_over_longest_wall():
+    assert merge_windows([]) == (0.0, 0.0)
+    assert merge_windows([(10.0, 2.0)]) == (10.0, 2.0)
+    # Longest wall 10s; worst wait FRACTION is 3/5 -> 6s over 10s.
+    wall, wait = merge_windows([(10.0, 2.0), (5.0, 3.0)])
+    assert (wall, wait) == (10.0, 6.0)
+    # Fractions clamp at 1.0 (a consumer that waited its whole window).
+    wall, wait = merge_windows([(4.0, 9.0), (8.0, 0.0)])
+    assert (wall, wait) == (8.0, 8.0)
+    # Degenerate zero-length windows contribute fraction 0, not NaN.
+    assert merge_windows([(0.0, 0.0)]) == (0.0, 0.0)
+
+
+def test_fleet_tuner_fires_once_all_attached_report():
+    stub = _StubTuner()
+    ft = FleetIngestTuner(stub)
+    ft.attach("a")
+    ft.attach("b")
+    assert ft.report("a", 10.0, 2.0) == ()       # fleet window filling
+    assert ft.report("ghost", 10.0, 9.0) == ()   # unattached: ignored
+    assert ft.report("b", 5.0, 3.0) == ("adjusted",)
+    assert stub.observed == [(10.0, 6.0)]
+    # A detached straggler stops gating the loop.
+    ft.detach("b")
+    assert ft.report("a", 10.0, 1.0) == ("adjusted",)
+    assert ft.windows_merged == 2
+
+
+# -- fault sites + refusals ---------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_attach_fault_refused_typed(server):
+    prev = faultinject.arm(faultinject.plan_from_spec({
+        "ingest.attach": {"kind": "error", "on_calls": [1],
+                          "error": "RuntimeError", "message": "drill"},
+    }))
+    try:
+        with pytest.raises(RuntimeError, match="ingest attach refused"):
+            _attach(server, "drilled", start_step=0)
+        # The fault is one-shot: the service keeps accepting afterwards.
+        s = _attach(server, "drilled", start_step=0)
+        next(s)
+        s.close()
+    finally:
+        faultinject.arm(prev)
+
+
+def test_served_stream_requires_server_and_socket_path(tmp_path):
+    with pytest.raises(ValueError, match="ingest.socket_path"):
+        served.ServedStream("", "c", split="train", seed=0,
+                            batch_size=BATCH, image_size=IMAGE,
+                            capacity_rows=0)
+    with pytest.raises(ConnectionError, match="no ingest server"):
+        served.ServedStream(str(tmp_path / "nope.sock"), "c",
+                            split="train", seed=0, batch_size=BATCH,
+                            image_size=IMAGE, capacity_rows=0)
+
+
+def test_attach_refuses_oversized_batch(server):
+    with pytest.raises(RuntimeError, match="batch_size"):
+        served.ServedStream(server.socket_path, "big", split="train",
+                            seed=0, batch_size=N_RECORDS + 8,
+                            image_size=IMAGE, capacity_rows=0)
+
+
+# -- the trainer seam ---------------------------------------------------------
+
+
+def test_capacity_rows_for_matches_tiered_derivation():
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        f"data.tiered_resident_bytes={hbm_pipeline.row_bytes(IMAGE) * 24}",
+    ])
+    assert served.capacity_rows_for(cfg) == 24
+    # Auto budget (-1) falls through to the same derivation the tiered
+    # loader uses, budget_base_bytes included.
+    cfg2 = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        "data.hbm_budget_bytes=1000000",
+    ])
+    assert served.capacity_rows_for(cfg2) == \
+        hbm_pipeline.resident_row_capacity(
+            IMAGE, 1, budget_base_bytes=1000000)
+
+
+def test_fit_served_matches_tiered_loss_for_loss(data_dir, tmp_path):
+    """trainer.fit on data.loader=served == data.loader=tiered, loss
+    for loss — the whole point of the service is that moving decode
+    out of process changes WHERE batches come from, never what the
+    model sees."""
+    sock = os.path.join(str(tmp_path), "ingest.sock")
+    resident = hbm_pipeline.row_bytes(64) * 24
+    base = [
+        "train.steps=6", "train.eval_every=6", "train.log_every=1",
+        "data.batch_size=8", "eval.batch_size=8",
+        "train.lr_schedule=constant",
+        f"data.tiered_resident_bytes={resident}",
+    ]
+    t_cfg = override(get_config("smoke"), base + ["data.loader=tiered"])
+    w_tiered = str(tmp_path / "tiered")
+    trainer.fit(t_cfg, data_dir, w_tiered, seed=3)
+
+    s_cfg = override(get_config("smoke"), base + [
+        "data.loader=served", f"ingest.socket_path={sock}",
+    ])
+    srv = IngestServer(data_dir, s_cfg, registry=Registry()).start()
+    try:
+        w_served = str(tmp_path / "served")
+        trainer.fit(s_cfg, data_dir, w_served, seed=3)
+    finally:
+        srv.close()
+    losses = {}
+    for w in (w_tiered, w_served):
+        losses[w] = {
+            r["step"]: r["loss"]
+            for r in read_jsonl(os.path.join(w, "metrics.jsonl"))
+            if r["kind"] == "train"
+        }
+    assert set(losses[w_tiered]) == set(losses[w_served]) == set(
+        range(1, 7))
+    for step, loss in losses[w_tiered].items():
+        assert loss == losses[w_served][step], f"step {step} diverged"
+
+
+def test_fit_tf_refuses_served_loader(data_dir, tmp_path):
+    cfg = override(get_config("smoke"), ["data.loader=served"])
+    with pytest.raises(ValueError, match="served"):
+        trainer.fit_tf(cfg, data_dir, str(tmp_path / "x"), seed=0)
