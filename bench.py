@@ -617,6 +617,22 @@ def _fleet_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _diagnosis_overhead_guard(extras: dict, rate_on: float,
+                              rate_off: float,
+                              max_overhead: float = 0.02) -> bool:
+    """ISSUE 18's pin, same shared math: device_only with the causal-
+    diagnosis plane's hot-path residue — per-step provenance stamping
+    (build the compact record + one small memcpy into a mapped
+    provenance region, exactly what the ingest server pays per served
+    batch) plus the DISABLED analyzer branch (the critical-path
+    analyzer is pure and runs only inside FlightRecorder dumps; steady
+    state pays one ``if``) — must stay within 2% of the uninstrumented
+    headline. The contract that lets ingest.provenance default on for
+    production deployments."""
+    return _overhead_guard(extras, "diagnosis", rate_on, rate_off,
+                           max_overhead)
+
+
 def _router_bench(extras: dict) -> None:
     """Router scaling rows (ISSUE 12): the dispatch pipeline measured
     OFF-DEVICE over stub replicas with a fixed simulated per-row
@@ -1656,6 +1672,147 @@ def _chaos_ingest(extras: dict) -> None:
     _log(f"chaos ingest drill: ok={ok}")
 
 
+def _chaos_diagnose(extras: dict) -> None:
+    """``--chaos`` diagnosis drill (ISSUE 18): three INJECTED
+    bottlenecks, each diagnosed by the critical-path analyzer into the
+    MATCHING typed verdict — the proof that the verdicts mean what
+    they claim.
+
+    * A throttled decode plane (latency plan on ``ingest.decode``,
+      ample ring run-ahead, back-to-back consumer) must diagnose
+      ``decode_bound``: the consumer's waits are real decode wall.
+    * The SAME decode throttle behind a 1-slot ring and a bursty
+      consumer must diagnose ``credit_starved``: with no run-ahead
+      credit, the post-burst fetch stalls on work the server could
+      have hidden — the server's genuine credit starvation (stamped in
+      provenance) absorbs the wait before decode gets any.
+    * A device-only loop (dispatch wall dominating a small input wait;
+      sleeps stand in for the device exactly like the router bench's
+      stub replicas) must diagnose ``device_bound``.
+
+    Publishes ``diagnose_ok`` + per-phase booleans and merges the
+    ``ingest.decode`` fires into the ``chaos_injections`` ledger."""
+    import shutil
+    import tempfile
+
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.data import tfrecord as tfrecord_lib
+    from jama16_retina_tpu.data.served import ServedStream
+    from jama16_retina_tpu.obs import criticalpath, faultinject
+    from jama16_retina_tpu.obs import trace as trace_lib
+    from jama16_retina_tpu.obs.registry import Registry
+
+    DELAY_S = 0.02
+
+    def served_phase(label, overrides, consume):
+        """One injected bottleneck against a REAL server + consumer:
+        arm the decode throttle, stream under an enabled tracer,
+        return (DiagnosisVerdict, injected fire count)."""
+        from jama16_retina_tpu.ingest.server import IngestServer
+
+        plan = faultinject.plan_from_spec({
+            "ingest.decode": {"kind": "latency", "every": 1,
+                              "delay_s": DELAY_S},
+        })
+        prev_plan = faultinject.arm(plan)
+        prev_tr = trace_lib.set_default_tracer(
+            trace_lib.Tracer(enabled=True))
+        root = tempfile.mkdtemp(prefix=f"jama16-chaos-diag-{label}-")
+        server = None
+        stream = None
+        try:
+            data_dir = os.path.join(root, "data")
+            tfrecord_lib.write_synthetic_split(
+                data_dir, "train", 48, image_size=32, num_shards=2,
+                seed=0,
+            )
+            cfg = override(get_config("smoke"), [
+                "model.image_size=32",
+                "data.batch_size=8",
+                f"ingest.socket_path={os.path.join(root, 'ingest.sock')}",
+            ] + overrides)
+            server = IngestServer(data_dir, cfg, registry=Registry())
+            server.start()
+            stream = ServedStream(
+                cfg.ingest.socket_path, f"diag-{label}",
+                start_step=None, split="train", seed=9, batch_size=8,
+                image_size=32, capacity_rows=24,
+            )
+            consume(stream)
+            verdict = criticalpath.diagnose(
+                trace_lib.default_tracer().events())
+        finally:
+            if stream is not None:
+                stream.close()
+            if server is not None:
+                server.close()
+            trace_lib.set_default_tracer(prev_tr)
+            faultinject.arm(prev_plan)
+            shutil.rmtree(root, ignore_errors=True)
+        return verdict, plan.counts()["ingest.decode"]["fires"]
+
+    ok = True
+    fires_total = 0
+    try:
+        def back_to_back(stream):
+            for _ in range(12):
+                next(stream)
+
+        v1, fires = served_phase("decode", [], back_to_back)
+        fires_total += fires
+        d1 = v1.verdict == "decode_bound" and fires >= 1
+        extras["chaos_diagnose_decode_bound"] = bool(d1)
+        ok &= d1
+        _log(f"chaos diagnose decode phase: {v1.verdict} "
+             f"(confidence {v1.confidence})")
+
+        def bursty(stream):
+            # Burst-then-idle: the 1-slot ring cannot bank run-ahead
+            # during the idle half, so the busy half's fetch stalls.
+            for i in range(12):
+                next(stream)
+                if i % 2 == 0:
+                    time.sleep(0.05)
+
+        v2, fires = served_phase("starve", ["ingest.ring_slots=1"],
+                                 bursty)
+        fires_total += fires
+        d2 = v2.verdict == "credit_starved" and fires >= 1
+        extras["chaos_diagnose_credit_starved"] = bool(d2)
+        ok &= d2
+        _log(f"chaos diagnose starve phase: {v2.verdict} "
+             f"(confidence {v2.confidence})")
+
+        prev_tr = trace_lib.set_default_tracer(
+            trace_lib.Tracer(enabled=True))
+        try:
+            tr = trace_lib.default_tracer()
+            for _ in range(6):
+                t0 = time.perf_counter()
+                time.sleep(0.001)
+                t1 = time.perf_counter()
+                tr.complete("trainer.input", t0, t1, {})
+                time.sleep(0.012)
+                t2 = time.perf_counter()
+                tr.complete("trainer.dispatch", t1, t2, {})
+            v3 = criticalpath.diagnose(tr.events())
+        finally:
+            trace_lib.set_default_tracer(prev_tr)
+        d3 = v3.verdict == "device_bound"
+        extras["chaos_diagnose_device_bound"] = bool(d3)
+        ok &= d3
+        _log(f"chaos diagnose device phase: {v3.verdict} "
+             f"(confidence {v3.confidence})")
+    except Exception as e:  # pragma: no cover - bench must emit JSON
+        _log(f"chaos diagnose drill failed: {type(e).__name__}: {e}")
+        ok = False
+
+    extras.setdefault("chaos_injections", {})["ingest.decode"] = (
+        int(fires_total))
+    extras["diagnose_ok"] = bool(ok)
+    _log(f"chaos diagnose drill: ok={ok}")
+
+
 def _latency_summary(latencies_ms) -> dict:
     """p50/p99/mean over one offered-load window's per-request
     latencies. Both percentiles come from the SAME sorted sample, so
@@ -2241,6 +2398,62 @@ def main() -> None:
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"fleet overhead bench failed: {type(e).__name__}: {e}")
 
+    # Diagnosis overhead pin (ISSUE 18): the causal-diagnosis plane's
+    # whole hot-path residue — per-step provenance stamping (build the
+    # compact record + length-prefixed JSON memcpy into a mapped slot
+    # region, what the ingest server pays per served batch) plus the
+    # DISABLED analyzer branch (the critical-path analyzer is pure and
+    # runs only inside FlightRecorder dumps; steady state pays one
+    # `if`). Same ≤2% budget, shared guard math — see
+    # _diagnosis_overhead_guard.
+    if not headline_serialized:
+        try:
+            from jama16_retina_tpu.ingest import protocol as _protocol
+            from jama16_retina_tpu.obs import trace as _trace_lib
+
+            _, d_slot_bytes = _protocol.slot_layout(batch_size, size)
+            d_buf = bytearray(d_slot_bytes)
+            d_state = {"seq": 0, "analyzer": None}
+            d_tr = _trace_lib.default_tracer()
+
+            def diagnosis_step(s, batch, k):
+                out = step(s, batch, k)
+                d_state["seq"] += 1
+                ctx = _trace_lib.new_context()
+                _protocol.write_provenance(
+                    d_buf, 0, batch_size, size, {
+                        "v": _protocol.PROTOCOL_VERSION,
+                        "seq": d_state["seq"],
+                        "step": d_state["seq"],
+                        "decode_s": 0.001,
+                        "cache_hit": 0,
+                        "credit_wait_s": 0.0,
+                        "t_write_unix": 0.0,
+                        "trace": ctx.wire(),
+                    })
+                # The production default: analyzer off-path — the
+                # disabled-tracer branch is the whole per-step cost.
+                if d_tr.enabled and d_state["analyzer"] is not None:
+                    raise RuntimeError("unreachable: analyzer off")
+                return out
+
+            rate_d, state = _timed_steps(
+                diagnosis_step, state,
+                lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_d = _publish(
+                extras, "device_only_diagnosis", rate_d,
+                flops_per_image, peak,
+                suffix=" (device_only + per-step provenance stamp + "
+                       "disabled-analyzer branch)",
+            )
+            if rate_d is not None:
+                _diagnosis_overhead_guard(extras, rate_d, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"diagnosis overhead bench failed: "
+                 f"{type(e).__name__}: {e}")
+
     # Autotune overhead pin (ISSUE 7): the same device_only window with
     # the steady-state costs a tuned run pays per step — one live knob
     # poll (what the loaders' fill loops do per batch) — plus a
@@ -2548,9 +2761,11 @@ def main() -> None:
         _chaos_smoke(extras)
         _chaos_integrity(extras)
         _chaos_ingest(extras)
+        _chaos_diagnose(extras)
         extras["chaos_ok"] = bool(
             extras.get("chaos_ok") and extras.get("chaos_integrity_ok")
             and extras.get("chaos_ingest_ok")
+            and extras.get("diagnose_ok")
         )
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.

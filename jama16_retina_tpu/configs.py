@@ -870,6 +870,18 @@ class ObsConfig:
     # (a bad shard, a broken preprocessing deploy). <= 0 disables the
     # rule.
     quarantine_alert_per_s: float = 0.5
+    # --- Causal diagnosis (ISSUE 18; obs/criticalpath.py) --------------
+    # Run the critical-path analyzer inside every FlightRecorder dump:
+    # the blackbox then carries diagnosis.json (typed verdict + evidence
+    # fractions + exemplar waterfalls over the dumped trace events) and
+    # the obs.diagnosis.{verdict,confidence} gauges update so alert
+    # rules can read the verdict. Off = dumps carry raw events only;
+    # the analyzer is pure and runs ONLY at dump time, so the hot path
+    # never pays for it either way (bench diagnosis_overhead_pct pin).
+    diagnosis_enabled: bool = True
+    # Slowest exemplar waterfalls a diagnosis carries (per-request and
+    # per-step each) — enough to see the pattern, small enough to read.
+    diagnosis_top_k: int = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -934,6 +946,15 @@ class IngestConfig:
     # set it (e.g. per workdir) to make kill -9 reattach resume from
     # the lease journal instead of step 0.
     consumer_id: str = ""
+    # Batch provenance stamping (ISSUE 18): the server writes a compact
+    # record (seq, decode wall vs cache hit, credit wait, wire trace
+    # context) into each slot's fixed provenance region before
+    # announcing it, and served consumers tile their measured input
+    # wait into ingest.batch.* trace segments from it. The slot region
+    # exists either way (protocol v2 layout); off clears the stamp and
+    # consumers fall back to unattributed waits. Cost is one small
+    # memcpy per batch, pinned ≤2% by the bench diagnosis guard.
+    provenance: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,6 +20,18 @@ and reports tumbling ``(window_sec, input_wait_sec)`` windows over the
 control channel — the fleet tuner's per-consumer input
 (ingest/fleettune.py).
 
+Causal attribution (ISSUE 18): each slot arrives with the server's
+provenance stamp, and ``__next__`` tiles its measured wait into
+``ingest.batch.{credit_wait,decode|cache,ring_dwell,read}`` trace
+segments with shared boundary timestamps (the PR-4 batcher
+discipline: segment sums are pinned against the measured wall).
+``min()``-clamping the server-reported credit/decode walls against the
+wait keeps attribution causal: a full-ring credit stall absorbs the
+wait first (more slots would have hidden the decode), then decode,
+and the residue is ring dwell. The wait lands on the
+``ingest.batch.wait_s`` histogram with the batch's trace id as its
+exemplar, so a slow-step dump names the exact batch that stalled it.
+
 Crash semantics: ``skip_batches=None`` asks the server to resume from
 this consumer's lease journal (kill -9 reattach, zero re-decode); the
 trainer always passes its explicit checkpoint step instead, which
@@ -38,6 +50,8 @@ from absl import logging
 
 from jama16_retina_tpu.ingest import protocol
 from jama16_retina_tpu.ingest.ring import BatchRing
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as trace_lib
 
 # Report a stats window to the fleet tuner every N batches: frequent
 # enough to steer within a bench window, rare enough to stay invisible
@@ -53,7 +67,7 @@ class ServedStream:
     def __init__(self, socket_path: str, consumer_id: str, split: str,
                  seed: int, batch_size: int, image_size: int,
                  capacity_rows: int, start_step: "int | None" = 0,
-                 attach_timeout_s: float = 30.0):
+                 attach_timeout_s: float = 30.0, registry=None):
         if not socket_path:
             raise ValueError(
                 "data.loader='served' needs ingest.socket_path — the "
@@ -71,7 +85,8 @@ class ServedStream:
                 "with scripts/ingest_server.py or switch data.loader"
             ) from None
         protocol.send_msg(self._sock, {
-            "type": "attach", "consumer_id": consumer_id, "split": split,
+            "type": "attach", "protocol": protocol.PROTOCOL_VERSION,
+            "consumer_id": consumer_id, "split": split,
             "seed": int(seed), "batch_size": int(batch_size),
             "image_size": int(image_size),
             "capacity_rows": int(capacity_rows),
@@ -85,12 +100,25 @@ class ServedStream:
             )
         if reply.get("type") == "error":
             self._sock.close()
+            if reply.get("code") == "version_mismatch":
+                raise protocol.ProtocolVersionMismatch(
+                    str(reply.get("message")))
             raise RuntimeError(
                 f"ingest attach refused: {reply.get('message')}"
             )
         if reply.get("type") != "attached":
             self._sock.close()
             raise RuntimeError(f"unexpected attach reply: {reply}")
+        # A pre-v2 server replies without a protocol field — its slot
+        # layout has no provenance region, so mapping its ring with v2
+        # offsets would shear every batch. Refuse, typed.
+        if int(reply.get("protocol", 1)) != protocol.PROTOCOL_VERSION:
+            self._sock.close()
+            raise protocol.ProtocolVersionMismatch(
+                f"ingest server speaks protocol v"
+                f"{int(reply.get('protocol', 1))}, this client v"
+                f"{protocol.PROTOCOL_VERSION} — redeploy the older side"
+            )
         self.start_step = int(reply["start_step"])
         self.n_records = int(reply["n_records"])
         self.steps_per_epoch = int(reply["steps_per_epoch"])
@@ -102,6 +130,18 @@ class ServedStream:
         self._since_stats = 0
         self._window_t0 = time.perf_counter()
         self._window_wait = 0.0
+        reg = registry if registry is not None \
+            else obs_registry.default_registry()
+        self._h_wait = reg.histogram(
+            "ingest.batch.wait_s",
+            help="seconds one served-consumer __next__ spent blocked "
+                 "for + reading a batch; exemplar = the stamped batch "
+                 "trace id, so slow-step dumps name the stalling batch",
+        )
+        # Last batch's tiling, for the segment-sum pin tests:
+        # {'input_wait_s', 'read_s', 'segments': {name: seconds}} where
+        # the non-read segments tile input_wait_s exactly.
+        self._last_tiling: "dict | None" = None
         logging.info(
             "served loader: consumer %s attached at step %d (%d records, "
             "%d steps/epoch, ring of %d slots)", consumer_id,
@@ -123,7 +163,8 @@ class ServedStream:
                 "ingest server stopped feeding (no batch frame within "
                 "the attach timeout) — check the server process"
             ) from None
-        self._window_wait += time.perf_counter() - t0
+        t_recv = time.perf_counter()
+        self._window_wait += t_recv - t0
         if msg is None:
             # Server closed the stream (shutdown or an injected
             # ingest.ring.write fault killed this consumer's pump).
@@ -137,10 +178,15 @@ class ServedStream:
             raise RuntimeError(f"unexpected frame mid-stream: {msg}")
         slot = int(msg["slot"])
         batch = self._ring.read(slot)
-        # Credit immediately: read() copied the rows out, so the slot
-        # can refill behind the train step right away.
+        # Provenance must be read BEFORE the credit frame frees the
+        # slot — a credited slot can refill (and restamp) immediately.
+        prov = self._ring.read_provenance(slot)
+        t_done = time.perf_counter()
+        # Credit immediately after: read() copied the rows out, so the
+        # slot can refill behind the train step right away.
         protocol.send_msg(self._sock, {"type": "credit", "slot": slot,
                                        "step": int(msg["step"])})
+        self._attribute(prov, int(msg["step"]), t0, t_recv, t_done)
         self._since_stats += 1
         if self._since_stats >= STATS_EVERY:
             now = time.perf_counter()
@@ -154,6 +200,46 @@ class ServedStream:
             self._since_stats = 0
         return batch
 
+    def _attribute(self, prov, step, t0, t_recv, t_done) -> None:
+        """Tile [t0, t_done] into the ``ingest.batch.*`` segments from
+        the slot's provenance stamp. Shared boundary timestamps keep
+        the tiling exact: credit wait from t0, then decode (or cache
+        lookup), then ring dwell as the residue up to the recv return,
+        then the slot read. No stamp -> the wait is still observed,
+        just unattributed (no segments)."""
+        wait_recv = t_recv - t0
+        read_s = t_done - t_recv
+        if prov is None:
+            self._h_wait.observe(wait_recv + read_s)
+            self._last_tiling = None
+            return
+        cache_hit = bool(prov.get("cache_hit"))
+        credit = min(max(0.0, float(prov.get("credit_wait_s", 0.0))),
+                     wait_recv)
+        decode = min(max(0.0, float(prov.get("decode_s", 0.0))),
+                     wait_recv - credit)
+        dwell = wait_recv - credit - decode
+        trace_id = (prov.get("trace") or {}).get("trace_id")
+        self._h_wait.observe(wait_recv + read_s, exemplar=trace_id)
+        fill = "ingest.batch.cache" if cache_hit else "ingest.batch.decode"
+        self._last_tiling = {
+            "input_wait_s": wait_recv, "read_s": read_s,
+            "trace_id": trace_id,
+            "segments": {"ingest.batch.credit_wait": credit, fill: decode,
+                         "ingest.batch.ring_dwell": dwell,
+                         "ingest.batch.read": read_s},
+        }
+        tr = trace_lib.default_tracer()
+        if tr.enabled:
+            args = {"trace_id": trace_id, "step": step,
+                    "seq": prov.get("seq"), "cache_hit": int(cache_hit)}
+            b1 = t0 + credit
+            b2 = b1 + decode
+            tr.complete("ingest.batch.credit_wait", t0, b1, args)
+            tr.complete(fill, b1, b2, args)
+            tr.complete("ingest.batch.ring_dwell", b2, t_recv, args)
+            tr.complete("ingest.batch.read", t_recv, t_done, args)
+
     def close(self, detach: bool = True) -> None:
         if self._closed:
             return
@@ -161,6 +247,19 @@ class ServedStream:
         if detach:
             try:
                 protocol.send_msg(self._sock, {"type": "detach"})
+                # Drain to the server's EOF before closing: the pump
+                # reads our frames strictly in order, so its close
+                # (after the detach) proves every credit ahead of it
+                # was processed through the normal serve path. Closing
+                # first instead turns the pump's next batch send into
+                # a connection reset mid-credit — the lease still
+                # lands (the server drains credits on the error path)
+                # but the run-ahead decode behind the torn-off credit
+                # is skipped, which the decode-once ledger drills
+                # would read as nondeterministic.
+                self._sock.settimeout(5.0)
+                while protocol.recv_msg(self._sock) is not None:
+                    pass
             except OSError:  # pragma: no cover - server already gone
                 pass
         try:

@@ -9,14 +9,20 @@ would also be a code-execution surface; JSON is inert).
 
 Message types (``{"type": ...}``):
 
-  * ``attach``   consumer -> server: {consumer_id, split, seed,
-                 batch_size, image_size, capacity_rows,
+  * ``attach``   consumer -> server: {protocol, consumer_id, split,
+                 seed, batch_size, image_size, capacity_rows,
                  start_step|None}. ``start_step=None`` asks the server
                  to resume from the consumer's lease journal.
-  * ``attached`` server -> consumer: {shm_name, n_slots, slot_bytes,
-                 batch_size, image_size, start_step, n_records,
-                 steps_per_epoch} — everything the client needs to map
-                 the ring and predict the stream.
+                 ``protocol`` (absent == 1) must equal
+                 ``PROTOCOL_VERSION`` — the slot layout changed in v2
+                 (per-slot provenance region), so a version skew means
+                 the two sides would disagree on byte offsets; the
+                 server refuses with a typed ``version_mismatch`` error
+                 instead of serving garbage.
+  * ``attached`` server -> consumer: {protocol, shm_name, n_slots,
+                 slot_bytes, batch_size, image_size, start_step,
+                 n_records, steps_per_epoch} — everything the client
+                 needs to map the ring and predict the stream.
   * ``batch``    server -> consumer: {slot, step} — slot is filled.
   * ``credit``   consumer -> server: {slot, step} — slot is free; the
                  lease journal advances through ``step``.
@@ -26,7 +32,10 @@ Message types (``{"type": ...}``):
   * ``detach``   consumer -> server: clean goodbye (flush lease, free
                  the ring). A dead socket (kill -9) is the unclean
                  twin and takes the same server path.
-  * ``error``    server -> consumer: {message} — attach refused.
+  * ``error``    server -> consumer: {message, code?} — attach
+                 refused. ``code="version_mismatch"`` is the typed
+                 protocol-skew refusal (ISSUE 18); clients surface it
+                 as ``ProtocolVersionMismatch``.
 """
 
 from __future__ import annotations
@@ -42,6 +51,17 @@ _LEN = struct.Struct(">I")
 # corrupt stream, not a big message — fail loudly instead of
 # allocating it.
 MAX_FRAME = 1 << 20
+
+# v2 (ISSUE 18): each slot carries a fixed provenance region after the
+# grades, so slot offsets differ from v1. Both sides pin this and the
+# server refuses a skewed attach — a silent mismatch would read image
+# bytes as grades.
+PROTOCOL_VERSION = 2
+
+
+class ProtocolVersionMismatch(ConnectionError):
+    """Attach refused (or reply unintelligible) because the two sides
+    speak different slot layouts. Not retryable: redeploy one side."""
 
 
 def send_msg(sock: socket.socket, msg: dict) -> None:
@@ -82,14 +102,63 @@ def recv_msg(sock: socket.socket) -> "dict | None":
 # ---------------------------------------------------------------------------
 
 
+# Fixed per-slot provenance region (v2): a 4-byte big-endian length
+# followed by UTF-8 JSON, zero length == "no record". 256 bytes holds
+# the stamp (seq, step, decode wall, cache hit, credit wait, wire-format
+# trace context) with headroom; write_provenance raises rather than
+# truncating if a record ever outgrows it.
+PROV_BYTES = 256
+
+
 def slot_layout(batch_size: int, image_size: int) -> tuple[int, int]:
     """-> (image_bytes, slot_bytes) for one {'image','grade'} batch:
-    uint8 [B,S,S,3] rows followed by int32 [B] grades, padded to a
-    64-byte boundary so consecutive slots stay cache-line aligned."""
+    uint8 [B,S,S,3] rows, int32 [B] grades, then the PROV_BYTES
+    provenance region, padded to a 64-byte boundary so consecutive
+    slots stay cache-line aligned."""
     image_bytes = batch_size * image_size * image_size * 3
     grade_bytes = batch_size * 4
-    raw = image_bytes + grade_bytes
+    raw = image_bytes + grade_bytes + PROV_BYTES
     return image_bytes, raw + ((-raw) % 64)
+
+
+def _prov_offset(slot: int, batch_size: int, image_size: int) -> int:
+    image_bytes, slot_bytes = slot_layout(batch_size, image_size)
+    return slot * slot_bytes + image_bytes + batch_size * 4
+
+
+def write_provenance(buf, slot: int, batch_size: int, image_size: int,
+                     record: "dict | None") -> None:
+    """Stamp ``record`` into ``slot``'s provenance region (None clears
+    it). The server calls this before announcing the slot; the write is
+    a single memcpy into the already-mapped ring, which is what keeps
+    stamping inside the ≤2% diagnosis overhead budget."""
+    base = _prov_offset(slot, batch_size, image_size)
+    if record is None:
+        buf[base:base + _LEN.size] = _LEN.pack(0)
+        return
+    blob = json.dumps(record, separators=(",", ":")).encode("utf-8")
+    if len(blob) > PROV_BYTES - _LEN.size:
+        raise ValueError(
+            f"provenance record {len(blob)} bytes exceeds the "
+            f"{PROV_BYTES - _LEN.size}-byte slot region")
+    buf[base:base + _LEN.size + len(blob)] = _LEN.pack(len(blob)) + blob
+
+
+def read_provenance(buf, slot: int, batch_size: int,
+                    image_size: int) -> "dict | None":
+    """Recover the slot's provenance stamp, or None when the region is
+    cleared/unparseable — provenance is diagnostic freight, so a bad
+    stamp degrades to "no attribution", never to a failed batch."""
+    base = _prov_offset(slot, batch_size, image_size)
+    (length,) = _LEN.unpack(bytes(buf[base:base + _LEN.size]))
+    if length == 0 or length > PROV_BYTES - _LEN.size:
+        return None
+    try:
+        return json.loads(
+            bytes(buf[base + _LEN.size:base + _LEN.size + length]
+                  ).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
 
 
 def slot_views(buf, slot: int, batch_size: int,

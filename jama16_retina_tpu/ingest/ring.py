@@ -88,6 +88,23 @@ class BatchRing:
         np.copyto(img_v, np.ascontiguousarray(image, dtype=np.uint8))
         np.copyto(grd_v, np.ascontiguousarray(grade, dtype=np.int32))
 
+    def write_provenance(self, slot: int, record: "dict | None") -> None:
+        """Server side: stamp (or clear) the slot's provenance region —
+        written AFTER the rows and before the ``batch`` frame, so the
+        socket-ordered lifecycle covers the stamp too."""
+        if not 0 <= slot < self.n_slots:
+            raise IndexError(f"slot {slot} outside ring of {self.n_slots}")
+        protocol.write_provenance(self._shm.buf, slot, self.batch,
+                                  self.image_size, record)
+
+    def read_provenance(self, slot: int) -> "dict | None":
+        """Consumer side: the slot's provenance stamp (None when the
+        server runs with ingest.provenance=false)."""
+        if not 0 <= slot < self.n_slots:
+            raise IndexError(f"slot {slot} outside ring of {self.n_slots}")
+        return protocol.read_provenance(self._shm.buf, slot, self.batch,
+                                        self.image_size)
+
     def read(self, slot: int) -> dict:
         """Consumer side: one {'image','grade'} HOST batch copied out
         of the slot. A copy (not the view) is deliberate: the batch

@@ -30,7 +30,17 @@ error refuses the attach with a typed ``error`` frame — the client
 raises, nothing half-attached survives), ``ingest.ring.write`` fires
 before each slot write (an armed error drops that consumer's
 connection — the consumer's reattach path is the recovery under test;
-a latency plan widens the in-flight window for kill drills).
+a latency plan widens the in-flight window for kill drills), and
+``ingest.decode`` fires inside the timed cache-miss decode (a latency
+plan throttles the decode plane — the ``decode_bound`` verdict drill's
+injection point, ISSUE 18).
+
+Provenance (ISSUE 18): with ``ingest.provenance`` on (default), every
+slot is stamped — before its ``batch`` frame — with {seq, step, decode
+wall, cache hit, accumulated credit wait, write time, wire-format
+trace context}, so the consumer can tile its measured input-wait into
+``ingest.batch.*`` segments and stitched traces link the server lane
+to the consumer lane causally.
 """
 
 from __future__ import annotations
@@ -52,6 +62,7 @@ from jama16_retina_tpu.ingest.leases import LeaseJournal
 from jama16_retina_tpu.ingest.ring import BatchRing
 from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as trace_lib
 
 # Decoded-batch cache per stream, in batches: covers each consumer's
 # ring run-ahead plus the skew between near-lockstep consumers; beyond
@@ -108,20 +119,26 @@ class _SharedStream:
                  "only)",
         )
 
-    def batch(self, step: int) -> dict:
-        """The host batch for ``step`` — bit-identical to
-        ``host_reference_batches`` at the same spec, by construction:
-        same plan, same id order, same decoder contract."""
+    def batch(self, step: int) -> "tuple[dict, bool]":
+        """``(host_batch, cache_hit)`` for ``step`` — the batch is
+        bit-identical to ``host_reference_batches`` at the same spec,
+        by construction: same plan, same id order, same decoder
+        contract. The hit flag feeds the slot's provenance stamp (a
+        consumer's wait on a hit is dwell/credit, never decode)."""
         with self._lock:
             hit = self._cache.get(step)
             if hit is not None:
                 self._cache.move_to_end(step)
                 self._c_hits.inc()
-                return hit
+                return hit, True
             if self._knobs is not None:
                 self.decoder.set_workers(self._knobs.decode_workers)
             res_ids, str_ids = self.plan.batch_indices(step)
             t0 = time.perf_counter()
+            # Inside the timed window: an armed latency plan on this
+            # site inflates the measured decode wall exactly like a
+            # slow decode pool would (the decode_bound drill).
+            faultinject.check("ingest.decode")
             host = self.decoder.decode_batch(
                 np.concatenate([res_ids, str_ids]).astype(np.int64)
             )
@@ -130,7 +147,7 @@ class _SharedStream:
             self._cache[step] = host
             while len(self._cache) > CACHE_BATCHES:
                 self._cache.popitem(last=False)
-            return host
+            return host, False
 
     def close(self) -> None:
         self.decoder.close()
@@ -263,6 +280,29 @@ class IngestServer:
         )
         self._inflight_total = 0
 
+        # v2 provenance stamping (ISSUE 18): one monotonic seq across
+        # all consumers + a fresh TraceContext per stamped slot.
+        # Disabled == the slots stay zeroed (consumers read None) and
+        # the pump loop pays one branch per batch.
+        self._provenance = bool(cfg.ingest.provenance)
+        self._prov_seq = 0
+
+        # /metrics + /healthz for the ingest role (ISSUE 18 satellite):
+        # the server was the only fleet role without the PR-15 HTTP
+        # endpoint. The snapshotter lives next to the control socket;
+        # progress() is batches served, so /healthz freshness means
+        # "the decode plane is actually feeding someone".
+        self._snap = None
+        if cfg.obs.enabled and cfg.obs.http_port > 0:
+            from jama16_retina_tpu.obs import export as export_lib
+
+            self._snap = export_lib.Snapshotter(
+                self._reg,
+                workdir=os.path.dirname(os.path.abspath(self.socket_path)),
+                every_s=cfg.obs.flush_every_s,
+            )
+            self._snap.serve_http(cfg.obs.http_port)
+
     # -- lifecycle ----------------------------------------------------
 
     def start(self) -> "IngestServer":
@@ -281,7 +321,7 @@ class IngestServer:
                              name="jama16-ingest-accept", daemon=True)
         t.start()
         self._threads.append(t)
-        if self._bus is not None:
+        if self._bus is not None or self._snap is not None:
             tb = threading.Thread(target=self._bus_loop,
                                   name="jama16-ingest-bus", daemon=True)
             tb.start()
@@ -310,6 +350,11 @@ class IngestServer:
                 pass
         for t in list(self._threads):
             t.join(timeout=5.0)
+        if self._snap is not None:
+            try:
+                self._snap.close()
+            except Exception:  # pragma: no cover - final flush only
+                pass
         with self._lock:
             streams, self._streams = dict(self._streams), {}
         for s in streams.values():
@@ -342,11 +387,19 @@ class IngestServer:
     def _bus_loop(self) -> None:
         while self._alive():
             time.sleep(1.0)
-            try:
-                self._bus.publish(self._reg.snapshot(),
-                                  heartbeat={"consumers": self._consumers})
-            except Exception as e:  # pragma: no cover - keep serving
-                logging.warning("ingest bus publish failed: %s", e)
+            if self._bus is not None:
+                try:
+                    self._bus.publish(
+                        self._reg.snapshot(),
+                        heartbeat={"consumers": self._consumers})
+                except Exception as e:  # pragma: no cover - keep serving
+                    logging.warning("ingest bus publish failed: %s", e)
+            if self._snap is not None:
+                try:
+                    self._snap.progress(int(self._c_batches.value))
+                    self._snap.maybe_flush()
+                except Exception as e:  # pragma: no cover - keep serving
+                    logging.warning("ingest snapshot failed: %s", e)
 
     def _stream_for(self, spec: StreamSpec) -> _SharedStream:
         with self._lock:
@@ -417,6 +470,20 @@ class IngestServer:
             msg = protocol.recv_msg(conn)
             if msg is None or msg.get("type") != "attach":
                 return
+            # Protocol skew check BEFORE anything side-effecting: a v1
+            # client would compute different slot offsets (no provenance
+            # region), so the only safe answer is a typed refusal.
+            peer = int(msg.get("protocol", 1))
+            if peer != protocol.PROTOCOL_VERSION:
+                protocol.send_msg(conn, {
+                    "type": "error", "code": "version_mismatch",
+                    "message": (
+                        f"ingest protocol mismatch: server speaks v"
+                        f"{protocol.PROTOCOL_VERSION}, consumer spoke "
+                        f"v{peer} — the v2 slot layout carries a "
+                        f"provenance region; redeploy the older side"),
+                })
+                return
             try:
                 faultinject.check("ingest.attach")
                 cid = str(msg["consumer_id"])
@@ -454,7 +521,9 @@ class IngestServer:
                                          "message": f"{type(e).__name__}: {e}"})
                 raise
             protocol.send_msg(conn, {
-                "type": "attached", "shm_name": ring.name,
+                "type": "attached",
+                "protocol": protocol.PROTOCOL_VERSION,
+                "shm_name": ring.name,
                 "n_slots": ring.n_slots, "slot_bytes": ring.slot_bytes,
                 "batch_size": spec.batch_size,
                 "image_size": spec.image_size, "start_step": start,
@@ -514,17 +583,50 @@ class IngestServer:
                     self._inflight_total -= len(inflight)
                     self._g_inflight.set(self._inflight_total)
 
+    def _stamp(self, ring, slot, step, decode_s, cache_hit,
+               credit_wait_s) -> None:
+        """Write one provenance record + (on a miss) one server-lane
+        trace span, causally linked through the stamped trace id."""
+        ctx = trace_lib.new_context()
+        with self._lock:
+            self._prov_seq += 1
+            seq = self._prov_seq
+        ring.write_provenance(slot, {
+            "v": protocol.PROTOCOL_VERSION, "seq": seq, "step": step,
+            "decode_s": round(decode_s, 6),
+            "cache_hit": 1 if cache_hit else 0,
+            "credit_wait_s": round(credit_wait_s, 6),
+            "t_write_unix": round(time.time(), 6),
+            "trace": ctx.wire(),
+        })
+        if not cache_hit:
+            tr = trace_lib.default_tracer()
+            if tr.enabled:
+                t1 = time.perf_counter()
+                tr.complete("ingest.decode.batch", t1 - decode_s, t1,
+                            {"trace_id": ctx.trace_id, "step": step})
+
     def _pump_loop(self, conn, stream, ring, lease, c_rows_consumer,
                    free, inflight) -> None:
         next_step = lease.consumed_through
         conn.settimeout(_POLL_S)
+        # Credit waits accumulate between slot writes and ride on the
+        # NEXT stamped slot: that is the batch whose availability the
+        # full ring actually delayed.
+        credit_wait_pending = 0.0
         while self._alive():
             target = max(1, min(ring.n_slots, self._stage_depth()))
             while free and len(inflight) < target:
                 slot = free.popleft()
-                batch = stream.batch(next_step)
+                t_b0 = time.perf_counter()
+                batch, cache_hit = stream.batch(next_step)
+                t_b1 = time.perf_counter()
                 faultinject.check("ingest.ring.write")
                 ring.write(slot, batch["image"], batch["grade"])
+                if self._provenance:
+                    self._stamp(ring, slot, next_step, t_b1 - t_b0,
+                                cache_hit, credit_wait_pending)
+                credit_wait_pending = 0.0
                 inflight[slot] = next_step
                 try:
                     protocol.send_msg(conn, {"type": "batch", "slot": slot,
@@ -549,12 +651,16 @@ class IngestServer:
                 msg = protocol.recv_msg(conn)
             except socket.timeout:
                 if ring_full:
-                    self._h_credit.observe(time.perf_counter() - t0)
+                    waited = time.perf_counter() - t0
+                    self._h_credit.observe(waited)
+                    credit_wait_pending += waited
                 continue
             if msg is None:
                 return  # EOF: consumer gone (kill -9 or close)
             if ring_full:
-                self._h_credit.observe(time.perf_counter() - t0)
+                waited = time.perf_counter() - t0
+                self._h_credit.observe(waited)
+                credit_wait_pending += waited
             kind = msg.get("type")
             if kind == "credit":
                 self._credit(lease, free, inflight, msg)

@@ -1,0 +1,289 @@
+"""Critical-path analysis over trace events: typed bottleneck verdicts.
+
+The observability stack up to PR 17 *collects* — metrics, traces,
+stitched fleet timelines — but nothing *interprets*. This module is the
+interpreter (ISSUE 18): a PURE function family over Chrome-shaped trace
+events (obs/trace.Tracer.events, or obs/fleet.stitch_trace output) that
+produces
+
+  * per-request and per-train-step WATERFALLS (ordered segment
+    decompositions with fractions, grouped by the ``trace_id`` the
+    instrumented seams stamp into event args),
+  * dominant-segment ATTRIBUTION (seconds per category over the whole
+    window), and
+  * a typed ``DiagnosisVerdict`` — the operator answer "what is the
+    bottleneck": ``device_bound`` / ``decode_bound`` / ``credit_starved``
+    / ``h2d_bound`` / ``queue_bound`` / ``balanced`` — with evidence
+    fractions and the top-K slowest exemplar waterfalls attached.
+
+Category mapping (the double-count discipline matters more than the
+names):
+
+  * ``device``  — ``trainer.dispatch`` + ``serve.request.device`` (+
+    the router twin). ``serve.engine.*`` sub-spans nest INSIDE
+    ``serve.request.device`` and are excluded from attribution.
+  * ``decode``  — the consumer-side ``ingest.batch.{decode,cache}``
+    segments. The server-lane ``ingest.decode.batch`` span is the SAME
+    wall seen from the other process, so it only counts when no
+    consumer-side decomposition is present. A plain ``trainer.input``
+    (an in-process loader, no served decomposition) also lands here:
+    input-bound IS decode-bound in this architecture's terms (tf.data's
+    framing — the operator question is "feed the chip or fix the
+    model").
+  * ``credit``  — ``ingest.batch.credit_wait`` (the ring was full: the
+    consumer, not decode, gated the server).
+  * ``queue``   — ``serve.request.{queue_wait,window_fill}`` (+ router
+    twin): admission/batch-formation pressure.
+  * ``h2d``     — any segment whose name contains ``h2d`` (host-to-
+    device transfer seams).
+  * everything else (``ring_dwell``, ``read``, ``resolve``, ``pause``,
+    ``save``, ...) — ``other``, plus the part of ``trainer.input`` the
+    ``ingest.batch.*`` segments did not explain when both are present.
+
+A verdict needs a dominant category: the largest of the five bound
+categories must carry >= ``DOMINANT_FRACTION`` of attributed time,
+else the window is ``balanced``. ``confidence`` is that dominant
+fraction either way, so a gauge reader can distinguish "balanced at
+0.38 device" from "balanced, nothing above 0.1".
+
+Everything here is pure over the event list — no clocks, no I/O — so
+the FlightRecorder can run it inside a dump and tests can pin verdicts
+against synthetic timelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Verdict -> stable numeric code for the obs.diagnosis.verdict gauge
+# (alert rules compare numbers; the order is append-only).
+VERDICT_CODES = {
+    "balanced": 0,
+    "device_bound": 1,
+    "decode_bound": 2,
+    "credit_starved": 3,
+    "h2d_bound": 4,
+    "queue_bound": 5,
+}
+
+# Category -> the verdict it argues for.
+_CATEGORY_VERDICT = {
+    "device": "device_bound",
+    "decode": "decode_bound",
+    "credit": "credit_starved",
+    "h2d": "h2d_bound",
+    "queue": "queue_bound",
+}
+
+# Share of attributed wall the dominant category must carry before the
+# diagnosis commits to a typed verdict (below it: "balanced").
+DOMINANT_FRACTION = 0.4
+
+_DEVICE = {"trainer.dispatch", "serve.request.device",
+           "serve.router.request.device"}
+_DECODE = {"ingest.batch.decode", "ingest.batch.cache"}
+_CREDIT = {"ingest.batch.credit_wait"}
+_QUEUE = {"serve.request.queue_wait", "serve.request.window_fill",
+          "serve.router.request.queue_wait"}
+# Sub-spans nested inside an already-counted parent segment: counting
+# them again would double the wall they share.
+_NESTED_PREFIXES = ("serve.engine.",)
+
+_REQUEST_PREFIXES = ("serve.request.", "serve.router.request.",
+                     "ingest.batch.")
+_STEP_PREFIX = "trainer."
+
+
+def _complete_events(events) -> list:
+    """The ph='X' events with a usable duration, as (name, ts_us,
+    dur_s, args) tuples sorted by timestamp."""
+    out = []
+    for e in events or ():
+        if not isinstance(e, dict) or e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        if not name or any(name.startswith(p) for p in _NESTED_PREFIXES):
+            continue
+        try:
+            dur_s = float(e.get("dur", 0.0)) / 1e6
+            ts = float(e.get("ts", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if dur_s < 0.0:
+            continue
+        out.append((name, ts, dur_s, e.get("args") or {}))
+    out.sort(key=lambda t: t[1])
+    return out
+
+
+def _category(name: str) -> str:
+    if name in _DEVICE:
+        return "device"
+    if name in _DECODE:
+        return "decode"
+    if name in _CREDIT:
+        return "credit"
+    if name in _QUEUE:
+        return "queue"
+    if "h2d" in name:
+        return "h2d"
+    return "other"
+
+
+def attribute(events) -> dict:
+    """Seconds per category over the whole event window, double-count
+    disciplined (module docstring): {'device','decode','credit','h2d',
+    'queue','other'} -> seconds."""
+    evs = _complete_events(events)
+    totals = {k: 0.0 for k in ("device", "decode", "credit", "h2d",
+                               "queue", "other")}
+    have_consumer_ingest = any(
+        n.startswith("ingest.batch.") for n, _t, _d, _a in evs
+    )
+    input_total = 0.0
+    ingest_total = 0.0
+    for name, _ts, dur_s, _args in evs:
+        if name == "trainer.input":
+            input_total += dur_s
+            continue
+        if name == "ingest.decode.batch":
+            # Server lane of the same wall the consumer's
+            # ingest.batch.* segments tile — only stands in when that
+            # decomposition is absent (server-only traces).
+            if not have_consumer_ingest:
+                totals["decode"] += dur_s
+            continue
+        totals[_category(name)] += dur_s
+        if name.startswith("ingest.batch."):
+            ingest_total += dur_s
+    if have_consumer_ingest:
+        # The ingest.batch.* segments tile the input wait; whatever
+        # trainer.input measured beyond them is loader overhead the
+        # decomposition did not see.
+        totals["other"] += max(0.0, input_total - ingest_total)
+    else:
+        totals["decode"] += input_total
+    return totals
+
+
+def _group_waterfalls(evs, want) -> list:
+    """Group (name, ts, dur, args) tuples by args['trace_id'] for names
+    ``want`` admits -> waterfall dicts, slowest first."""
+    groups: dict = {}
+    for name, ts, dur_s, args in evs:
+        if not want(name):
+            continue
+        tid = args.get("trace_id")
+        if not tid:
+            continue
+        groups.setdefault(tid, []).append((ts, name, dur_s))
+    out = []
+    for tid, segs in groups.items():
+        segs.sort()
+        total = sum(d for _ts, _n, d in segs)
+        out.append({
+            "trace_id": tid,
+            "total_s": round(total, 6),
+            "dominant": (
+                max(segs, key=lambda s: s[2])[1] if segs else None
+            ),
+            "segments": [
+                {"name": n, "dur_s": round(d, 6),
+                 "frac": round(d / total, 4) if total > 0 else 0.0}
+                for _ts, n, d in segs
+            ],
+        })
+    out.sort(key=lambda w: -w["total_s"])
+    return out
+
+
+def request_waterfalls(events) -> list:
+    """Per-request (and per-served-batch) waterfalls: the serve.request
+    / router / ingest.batch segment families grouped by the trace id
+    their instrumentation stamps into args, slowest first."""
+    evs = _complete_events(events)
+    return _group_waterfalls(
+        evs, lambda n: any(n.startswith(p) for p in _REQUEST_PREFIXES)
+    )
+
+
+def step_waterfalls(events) -> list:
+    """Per-train-step waterfalls: the ``trainer.*`` segment timeline
+    split at each ``trainer.dispatch`` (one dispatch == one step; the
+    segments since the previous dispatch belong to this step), slowest
+    first."""
+    evs = [t for t in _complete_events(events)
+           if t[0].startswith(_STEP_PREFIX)]
+    steps: list = []
+    cur: list = []
+    for name, ts, dur_s, _args in evs:
+        cur.append((ts, name, dur_s))
+        if name == "trainer.dispatch":
+            steps.append(cur)
+            cur = []
+    out = []
+    for i, segs in enumerate(steps):
+        total = sum(d for _ts, _n, d in segs)
+        out.append({
+            "step_index": i,
+            "total_s": round(total, 6),
+            "dominant": max(segs, key=lambda s: s[2])[1],
+            "segments": [
+                {"name": n, "dur_s": round(d, 6),
+                 "frac": round(d / total, 4) if total > 0 else 0.0}
+                for _ts, n, d in segs
+            ],
+        })
+    out.sort(key=lambda w: -w["total_s"])
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class DiagnosisVerdict:
+    """The typed answer. ``evidence`` maps every category (including
+    ``other``) to its fraction of attributed wall; ``confidence`` is
+    the dominant bound category's fraction (0.0 when nothing was
+    attributable)."""
+
+    verdict: str
+    code: int
+    confidence: float
+    evidence: dict
+    totals_s: dict
+    n_events: int
+    request_waterfalls: list
+    step_waterfalls: list
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def diagnose(events, top_k: int = 3) -> DiagnosisVerdict:
+    """events -> DiagnosisVerdict. Pure; an empty / unattributable
+    window diagnoses ``balanced`` at confidence 0.0 rather than
+    guessing."""
+    totals = attribute(events)
+    wall = sum(totals.values())
+    evidence = {
+        k: (round(v / wall, 4) if wall > 0 else 0.0)
+        for k, v in totals.items()
+    }
+    best_cat, best_frac = None, 0.0
+    for cat in _CATEGORY_VERDICT:
+        if evidence[cat] > best_frac:
+            best_cat, best_frac = cat, evidence[cat]
+    if best_cat is not None and best_frac >= DOMINANT_FRACTION:
+        verdict = _CATEGORY_VERDICT[best_cat]
+    else:
+        verdict = "balanced"
+    k = max(0, int(top_k))
+    return DiagnosisVerdict(
+        verdict=verdict,
+        code=VERDICT_CODES[verdict],
+        confidence=round(best_frac, 4),
+        evidence=evidence,
+        totals_s={k2: round(v, 6) for k2, v in totals.items()},
+        n_events=len(_complete_events(events)),
+        request_waterfalls=request_waterfalls(events)[:k],
+        step_waterfalls=step_waterfalls(events)[:k],
+    )

@@ -110,6 +110,13 @@ SITES = {
                          "journal reattach is the recovery under test; "
                          "latency plans widen the in-flight window for "
                          "kill drills)",
+    "ingest.decode": "inside the ingest server's timed cache-miss batch "
+                     "decode (ingest/server.py _SharedStream.batch; a "
+                     "latency plan throttles the decode plane so the "
+                     "stamped decode wall — and the consumer's "
+                     "ingest.batch.decode segment — inflate exactly "
+                     "like a slow pool: the decode_bound verdict "
+                     "drill's injection point, ISSUE 18)",
 }
 
 # Error classes a JSON spec may name. Deliberately small: injected

@@ -784,6 +784,11 @@ def _record_transitions(fleet_dir: str, firing: list, merged: dict,
             fleet_dir,
             config={"scope": "fleet", "rules": sorted(cur)},
             registry=_MergedRegistry(merged),
+            # Fleet-scope dumps diagnose over the STITCHED trace — the
+            # cross-lane waterfalls (server lane -> consumer lane) are
+            # exactly what a burn-rate firing needs explained
+            # (ISSUE 18).
+            events_fn=lambda: stitch_trace(fleet_dir),
         )
         # One dump per NEW firing RULE: FlightRecorder dedupes by
         # reason string, so two rules sharing the default reason must

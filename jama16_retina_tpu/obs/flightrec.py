@@ -21,8 +21,13 @@ process's last moments to ``<workdir>/blackbox/``:
 
 Each dump directory holds the last-N trace events (``trace.jsonl``, one
 event per line — readable even if the process dies mid-write), the full
-registry snapshot (``registry.json``), the run config (``config.json``)
-and a ``meta.json`` (reason/step/time/dropped-events). Dumps never
+registry snapshot (``registry.json``), the run config (``config.json``),
+a ``meta.json`` (reason/step/time/dropped-events) and — with
+``obs.diagnosis_enabled`` (default) — a ``diagnosis.json``: the
+critical-path analyzer's typed verdict + evidence fractions + exemplar
+waterfalls over the dumped events (obs/criticalpath.py; ISSUE 18), with
+the matching ``obs.diagnosis.{verdict,confidence}`` gauges published so
+alert rules can read what the dump concluded. Dumps never
 touch the run's JSONL (RunLog stays owned by the trainer), are
 rate-limited to one per reason per run, and anomaly triggers can
 additionally request ONE short ``jax.profiler`` capture per run through
@@ -69,6 +74,9 @@ class FlightRecorder:
         profile_hook=None,
         enabled: bool = True,
         blackbox_keep: int = 20,
+        diagnosis: bool = True,
+        diagnosis_top_k: int = 3,
+        events_fn=None,
     ):
         self.enabled = bool(enabled)
         self.workdir = workdir
@@ -88,6 +96,17 @@ class FlightRecorder:
         # restarting runs; after every dump the OLDEST dump dirs beyond
         # ``blackbox_keep`` are pruned (<= 0 disables the cap).
         self.blackbox_keep = int(blackbox_keep)
+        # Dump-time diagnosis (ISSUE 18): run the pure critical-path
+        # analyzer over the dumped events, write diagnosis.json beside
+        # them and publish obs.diagnosis.{verdict,confidence} gauges.
+        # Analysis happens ONLY inside dump() — the hot-path hooks
+        # never pay for it.
+        self.diagnosis = bool(diagnosis)
+        self.diagnosis_top_k = int(diagnosis_top_k)
+        # Optional event source override: the fleet aggregator passes a
+        # stitched-trace thunk so its dumps diagnose across every lane,
+        # not just this process's rings.
+        self._events_fn = events_fn
         self._profile_hook = profile_hook
         self._profile_fired = False
         self._step_times: deque = deque(maxlen=self.STEP_WINDOW)
@@ -246,10 +265,18 @@ class FlightRecorder:
             seq = self._dump_seq
         d = os.path.join(self.blackbox_dir, f"{seq:02d}-{reason}")
         os.makedirs(d, exist_ok=True)
-        events = self._tracer.events(last_n=self.blackbox_events)
+        if self._events_fn is not None:
+            try:
+                events = list(self._events_fn())
+            except Exception:  # pragma: no cover - stitched source gone
+                events = self._tracer.events(last_n=self.blackbox_events)
+        else:
+            events = self._tracer.events(last_n=self.blackbox_events)
         with open(os.path.join(d, "trace.jsonl"), "w") as f:
             for ev in events:
                 f.write(json.dumps(ev) + "\n")
+        if self.diagnosis:
+            self._diagnose_into(d, events)
         artifact_lib.write_json(
             os.path.join(d, "registry.json"), self._registry.snapshot()
         )
@@ -267,6 +294,36 @@ class FlightRecorder:
         self.dumps.append(d)
         self._prune_blackbox()
         return d
+
+    def _diagnose_into(self, d: str, events: list) -> None:
+        """Best-effort dump-time diagnosis (ISSUE 18): the dump must
+        land even when the analyzer chokes on exotic events, so this
+        never raises. The verdict gauges publish BEFORE the registry
+        snapshot is written, so the dump's own registry.json already
+        carries them."""
+        try:
+            from jama16_retina_tpu.obs import criticalpath
+
+            verdict = criticalpath.diagnose(
+                events, top_k=self.diagnosis_top_k
+            )
+            self._registry.gauge(
+                "obs.diagnosis.verdict",
+                help="latest dump-time critical-path verdict as its "
+                     "stable numeric code (criticalpath.VERDICT_CODES: "
+                     "0 balanced, 1 device, 2 decode, 3 credit, 4 h2d, "
+                     "5 queue)",
+            ).set(verdict.code)
+            self._registry.gauge(
+                "obs.diagnosis.confidence",
+                help="evidence fraction of the dominant category behind "
+                     "the latest obs.diagnosis.verdict (0..1)",
+            ).set(verdict.confidence)
+            artifact_lib.write_json(
+                os.path.join(d, "diagnosis.json"), verdict.as_dict()
+            )
+        except Exception:  # pragma: no cover - diagnosis is freight
+            pass
 
     def _prune_blackbox(self) -> None:
         """Enforce the cross-run dump cap: keep the ``blackbox_keep``

@@ -127,6 +127,8 @@ def _flight_for(cfg: ExperimentConfig, workdir: str,
         slow_step_factor=(slow if slow > 0 else float("inf")),
         profile_hook=(profiler.arm if profiler is not None else None),
         blackbox_keep=cfg.obs.blackbox_keep,
+        diagnosis=cfg.obs.diagnosis_enabled,
+        diagnosis_top_k=cfg.obs.diagnosis_top_k,
     )
 
 

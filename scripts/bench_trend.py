@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Bench-trajectory summarizer (ISSUE 18 satellite): the repo keeps one
+``BENCH_r<N>.json`` / ``MULTICHIP_r<N>.json`` per benchmarked round,
+but nothing reads them ACROSS rounds — a metric can quietly bleed 8%
+per PR and every per-round report still looks fine. This script lines
+the rounds up per metric and flags regressions:
+
+    python scripts/bench_trend.py [REPO_DIR] [--json] [--threshold 0.10]
+
+For each numeric metric present in >= 2 rounds it prints the
+first/previous/latest values, the latest-vs-previous change, and a
+``REGRESSED`` flag when the latest round moved more than ``threshold``
+(default 10%) in the metric's bad direction. Direction is inferred from
+the name: seconds/latency/overhead/wait-shaped metrics are
+lower-is-better, everything else (rates, speedups, hit counts)
+higher-is-better.
+
+Wired into scripts/ci_checks.sh as an ADVISORY step (exit code 0 even
+when regressions are flagged — round files describe different machines
+and configs across history, so a flag is a prompt to look, not a
+gate). ``--strict`` turns flags into exit 1 for local use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+# Round-file keys that are run metadata, never metrics.
+_META_KEYS = {"n", "cmd", "rc", "tail", "ok", "skipped", "n_devices",
+              "parsed"}
+
+# Name shapes where a LARGER value is the regression.
+_LOWER_BETTER = re.compile(
+    r"(_ms|_pct|_bytes)$|latency|overhead|_wait|stall|p50|p99"
+)
+
+
+def lower_is_better(metric: str) -> bool:
+    # Rates first: *_per_sec is a throughput even though it ends _sec.
+    if metric.endswith("per_sec"):
+        return False
+    if _LOWER_BETTER.search(metric):
+        return True
+    return metric.endswith(("_s", "_sec"))
+
+
+def _metrics_of(doc: dict) -> dict:
+    """Numeric scalar metrics of one round file: BENCH rounds nest them
+    under ``parsed``; MULTICHIP rounds keep them top-level next to the
+    run metadata. Bools are settings, not measurements."""
+    src = doc.get("parsed")
+    if not isinstance(src, dict):
+        src = {k: v for k, v in doc.items() if k not in _META_KEYS}
+    return {
+        k: float(v) for k, v in src.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+
+
+def load_rounds(repo_dir: str, stem: str) -> list:
+    """[(round_number, metrics_dict)] sorted by round, for one file
+    family (``BENCH`` or ``MULTICHIP``)."""
+    rounds = []
+    for p in glob.glob(os.path.join(repo_dir, f"{stem}_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if not m:
+            continue
+        try:
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            rounds.append((int(m.group(1)), _metrics_of(doc)))
+    rounds.sort()
+    return rounds
+
+
+def trend(rounds: list, threshold: float = 0.10) -> list:
+    """Per-metric trajectory rows over [(round, metrics)] — one row per
+    metric seen in >= 2 rounds, carrying the per-round series, the
+    latest-vs-previous relative change, and the regression flag."""
+    series: dict = {}
+    for rnd, metrics in rounds:
+        for k, v in metrics.items():
+            series.setdefault(k, []).append((rnd, v))
+    out = []
+    for metric in sorted(series):
+        pts = series[metric]
+        if len(pts) < 2:
+            continue
+        (_, prev), (last_round, last) = pts[-2], pts[-1]
+        change = (last - prev) / abs(prev) if prev else None
+        lower = lower_is_better(metric)
+        regressed = (
+            change is not None
+            and (change > threshold if lower else change < -threshold)
+        )
+        out.append({
+            "metric": metric,
+            "rounds": [r for r, _v in pts],
+            "values": [round(v, 6) for _r, v in pts],
+            "first": round(pts[0][1], 6),
+            "previous": round(prev, 6),
+            "latest": round(last, 6),
+            "latest_round": last_round,
+            "change_vs_previous": (
+                round(change, 4) if change is not None else None
+            ),
+            "direction": "lower_better" if lower else "higher_better",
+            "regressed": bool(regressed),
+        })
+    return out
+
+
+def summarize(repo_dir: str, threshold: float = 0.10) -> dict:
+    families = {}
+    for stem in ("BENCH", "MULTICHIP"):
+        rounds = load_rounds(repo_dir, stem)
+        if rounds:
+            families[stem] = {
+                "rounds": [r for r, _m in rounds],
+                "trend": trend(rounds, threshold=threshold),
+            }
+    flagged = [
+        row["metric"]
+        for fam in families.values()
+        for row in fam["trend"] if row["regressed"]
+    ]
+    return {
+        "threshold": threshold,
+        "families": families,
+        "regressions": flagged,
+    }
+
+
+def render(summary: dict) -> str:
+    out = []
+    for stem, fam in summary["families"].items():
+        out.append(
+            f"{stem} rounds {fam['rounds'][0]}..{fam['rounds'][-1]}:"
+        )
+        width = max(
+            (len(r["metric"]) for r in fam["trend"]), default=10
+        )
+        for row in fam["trend"]:
+            ch = row["change_vs_previous"]
+            flag = "  << REGRESSED" if row["regressed"] else ""
+            out.append(
+                f"  {row['metric']:<{width}}  "
+                f"{row['previous']:>12.4g} -> {row['latest']:>12.4g}  "
+                f"({'n/a' if ch is None else f'{ch:+.1%}'}, "
+                f"{row['direction'].replace('_', ' ')}){flag}"
+            )
+        out.append("")
+    n = len(summary["regressions"])
+    out.append(
+        f"{n} metric(s) regressed beyond "
+        f"{summary['threshold']:.0%} vs the previous round"
+        + (": " + ", ".join(summary["regressions"]) if n else "")
+    )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "repo_dir", nargs="?",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json / MULTICHIP_r*.json "
+             "(default: the repo root)",
+    )
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative change flagged as a regression")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON object on stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any metric is flagged (the CI "
+                         "wiring stays advisory; this is for local "
+                         "pre-push checks)")
+    args = ap.parse_args(argv)
+    summary = summarize(args.repo_dir, threshold=args.threshold)
+    if not summary["families"]:
+        print(f"no BENCH_r*/MULTICHIP_r* round files under "
+              f"{args.repo_dir}")
+        return 0
+    print(json.dumps(summary) if args.json else render(summary))
+    if args.strict and summary["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,13 @@ cd "$ROOT"
 echo "== graftlint (contract checker) =="
 python scripts/graftlint.py --json
 
+# Advisory (ISSUE 18 satellite): bench-round trajectory with >10%
+# regression flags. Never gates CI — round files span machines and
+# configs; a flag is a prompt to look, not a verdict (use --strict
+# locally for an exit code).
+echo "== bench trend (advisory) =="
+python scripts/bench_trend.py || true
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi

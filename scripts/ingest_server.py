@@ -31,6 +31,10 @@ With ``data.autotune=true`` the PR-7 tuner runs here at fleet scope —
 merged per-consumer stall windows drive decode_workers/stage_depth for
 everyone. With ``obs.fleet_dir`` set, the server publishes its
 registry on the fleet bus (role ``ingest``) for scripts/obs_report.py.
+With ``--set obs.http_port=PORT`` (ISSUE 18 satellite) the server also
+serves the PR-15 stdlib HTTP endpoint — ``/metrics`` live Prometheus
+text, ``/healthz`` progress freshness (progress == batches served) —
+so the ingest role probes exactly like every other fleet role.
 """
 
 from __future__ import annotations

@@ -50,6 +50,20 @@ multi-window burn() form) with exit 0 quiet / 1 firing / 2 blind,
 stale/wedged process (role + pid), and ``--trace-out`` stitches every
 process's trace rings into ONE Chrome trace with pid lanes.
 
+Causal diagnosis (ISSUE 18):
+
+  python scripts/obs_report.py --diagnose <workdir|dump|fleet_dir> \
+      [--json] [--diagnose-top-k K]
+
+runs the critical-path analyzer (obs/criticalpath.py) over the path's
+trace — a workdir's newest blackbox dump, a dump dir / trace file
+directly, or a fleet dir's stitched multi-lane trace — and prints the
+typed bottleneck verdict (device_bound / decode_bound / credit_starved
+/ h2d_bound / queue_bound / balanced) with evidence fractions and the
+top-K slowest per-request and per-step waterfalls. The Ingest section
+additionally names stale consumers by their lease age
+(--stale-lease-s), blaming one only while a peer still advances.
+
 Model-quality observability (ISSUE 5): runs whose registry carried the
 `quality.*` drift gauges additionally render a Quality section
 (score-PSI trend, positive rate, per-stat input PSI, canary status,
@@ -717,16 +731,60 @@ def render_serving_cost(records: list) -> "str | None":
     return "serving cost:\n" + _table(rows, ("signal", "value"))
 
 
-def ingest_summary(records: list) -> "dict | None":
+def lease_staleness(workdir: str, stale_s: float = 120.0,
+                    now: "float | None" = None) -> "list | None":
+    """Per-consumer lease ages with staleness blame (ISSUE 18
+    satellite): every lease-*.json under <workdir>/leases/ (or the
+    workdir itself) read sealed-quietly, sorted oldest-first. Blame
+    mirrors the fleet heartbeat semantics: a consumer is only NAMED
+    stale when at least one peer is fresh — when every lease is old the
+    whole service is idle (report it as idle, blame nobody). None when
+    no lease files exist."""
+    files = sorted(
+        glob.glob(os.path.join(workdir, "leases", "lease-*.json"))
+        + glob.glob(os.path.join(workdir, "lease-*.json"))
+    )
+    if not files:
+        return None
+    now = time.time() if now is None else now
+    entries = []
+    for p in files:
+        age = round(now - os.path.getmtime(p), 1)
+        doc = _load_sealed_quietly(p)
+        if doc is not None and "__corrupt__" in doc:
+            doc = None  # a broken seal renders as CORRUPT, not fresh
+        entries.append({
+            "consumer_id": (
+                doc.get("consumer_id") if doc else
+                os.path.basename(p)[len("lease-"):-len(".json")]
+            ),
+            "consumed_through": doc.get("consumed_through") if doc else None,
+            "age_s": age,
+            "corrupt": doc is None,
+            "stale": age > stale_s,
+        })
+    any_fresh = any(not e["stale"] for e in entries)
+    for e in entries:
+        # Peer-relative blame: stale-while-a-peer-advances is a wedged
+        # consumer; stale-with-everyone is an idle service.
+        e["blamed"] = bool(e["stale"] and any_fresh)
+    entries.sort(key=lambda e: -e["age_s"])
+    return entries
+
+
+def ingest_summary(records: list, workdir: "str | None" = None,
+                   stale_lease_s: float = 120.0) -> "dict | None":
     """The Ingest section's machine-readable form (--json twin;
     ISSUE 17): the disaggregated decode plane's ledger — attached
     consumers, batches/rows served, the decode-amplification ratio
     (batches served per decode: > 1 means the shared decode plane is
     actually paying decode once for several consumers), cache hits,
     lease journal activity (flushes + crash resumes), ring
-    backpressure (in-flight slots + the credit-wait histogram), and
-    the per-consumer row split. None when the run never served —
-    a training-only or serving-only workdir renders nothing new."""
+    backpressure (in-flight slots + the credit-wait histogram), the
+    per-consumer row split and — when a workdir with lease journals is
+    given — per-consumer lease age/staleness blame (ISSUE 18). None
+    when the run never served — a training-only or serving-only
+    workdir renders nothing new."""
     telemetry = [r for r in records if r.get("kind") == "telemetry"]
     latest = telemetry[-1] if telemetry else {}
     counters = latest.get("counters", {})
@@ -767,11 +825,17 @@ def ingest_summary(records: list) -> "dict | None":
             if wait.get("count") else None
         ),
         "consumer_rows": per_consumer,
+        "leases": (
+            lease_staleness(workdir, stale_lease_s)
+            if workdir else None
+        ),
     }
 
 
-def render_ingest(records: list) -> "str | None":
-    s = ingest_summary(records)
+def render_ingest(records: list, workdir: "str | None" = None,
+                  stale_lease_s: float = 120.0) -> "str | None":
+    s = ingest_summary(records, workdir=workdir,
+                       stale_lease_s=stale_lease_s)
     if s is None:
         return None
     rows = []
@@ -811,6 +875,18 @@ def render_ingest(records: list) -> "str | None":
                  f"{s['lease_flushes']} sealed flushes"))
     for cid, n in sorted(s["consumer_rows"].items()):
         rows.append((f"rows -> consumer {cid}", f"{n}"))
+    for lease in s["leases"] or ():
+        if lease["corrupt"]:
+            state = "CORRUPT lease file"
+        elif lease["blamed"]:
+            state = (f"STALE — no credit for {lease['age_s']:.0f}s "
+                     f"while peers advance (wedged?)")
+        elif lease["stale"]:
+            state = f"idle ({lease['age_s']:.0f}s, all consumers idle)"
+        else:
+            state = (f"fresh ({lease['age_s']:.0f}s, through step "
+                     f"{lease['consumed_through']})")
+        rows.append((f"lease {lease['consumer_id']}", state))
     return "ingest service:\n" + _table(rows, ("signal", "value"))
 
 
@@ -1621,6 +1697,59 @@ def check_fleet(fleet_dir: str, rules) -> tuple[int, str]:
     return 0, f"quiet ({len(rules)} fleet rules evaluated)"
 
 
+# ---------------------------------------------------------------------------
+# Causal diagnosis: critical-path waterfalls + typed verdict (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def diagnosis_summary(events: list, top_k: int = 3) -> dict:
+    """The --diagnose payload (--json twin): the critical-path
+    analyzer's typed verdict over ``events`` — evidence fractions,
+    per-category seconds, and the top-K slowest per-request /
+    per-step exemplar waterfalls (obs/criticalpath.diagnose)."""
+    from jama16_retina_tpu.obs import criticalpath
+
+    return criticalpath.diagnose(events, top_k=top_k).as_dict()
+
+
+def render_diagnosis(summary: dict) -> str:
+    out = [
+        f"diagnosis: {summary['verdict']} "
+        f"(confidence {summary['confidence']:.2f}, "
+        f"{summary['n_events']} events)",
+        _table(
+            [(cat, f"{summary['totals_s'].get(cat, 0.0):.3f}",
+              f"{frac:.1%}")
+             for cat, frac in sorted(summary["evidence"].items(),
+                                     key=lambda kv: -kv[1])],
+            ("category", "seconds", "share"),
+        ),
+    ]
+
+    def fmt_waterfall(w, label):
+        segs = "  ".join(
+            f"{s['name'].split('.')[-1]}={s['dur_s'] * 1e3:.1f}ms"
+            f"({s['frac']:.0%})"
+            for s in w["segments"]
+        )
+        return (f"  {label}: total {w['total_s'] * 1e3:.1f}ms, "
+                f"dominant {w['dominant']}\n    {segs}")
+
+    if summary["request_waterfalls"]:
+        out.append("slowest request/batch waterfalls:")
+        out.extend(
+            fmt_waterfall(w, w["trace_id"])
+            for w in summary["request_waterfalls"]
+        )
+    if summary["step_waterfalls"]:
+        out.append("slowest train-step waterfalls:")
+        out.extend(
+            fmt_waterfall(w, f"step[{w['step_index']}]")
+            for w in summary["step_waterfalls"]
+        )
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument(
@@ -1688,6 +1817,24 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--slowest", type=int, default=10, metavar="N",
                     help="rows in the slowest-requests/steps tables")
+    ap.add_argument(
+        "--diagnose", action="store_true",
+        help="critical-path diagnosis (ISSUE 18): run the analyzer "
+             "over PATH's trace (a workdir's newest blackbox dump, a "
+             "dump dir/trace file, or a FLEET dir's stitched lanes) "
+             "and print the typed bottleneck verdict, evidence "
+             "fractions, and exemplar waterfalls",
+    )
+    ap.add_argument(
+        "--diagnose-top-k", type=int, default=3, metavar="K",
+        help="exemplar waterfalls per table in --diagnose output",
+    )
+    ap.add_argument(
+        "--stale-lease-s", type=float, default=120.0, metavar="S",
+        help="lease age beyond which an ingest consumer is stale; it "
+             "is only BLAMED when a peer's lease is still fresh "
+             "(all-stale = the service is idle)",
+    )
     args = ap.parse_args(argv)
 
     if args.check_heartbeats:
@@ -1742,6 +1889,26 @@ def main(argv=None) -> int:
 
     trace_src = find_trace(args.path)
     events = load_trace_events(trace_src) if trace_src else []
+    if args.diagnose:
+        from jama16_retina_tpu.obs import fleet as fleet_lib
+
+        src = trace_src
+        if os.path.isdir(args.path) and fleet_lib.is_fleet_dir(args.path):
+            stitched = fleet_lib.stitch_trace(args.path)
+            if stitched:
+                events, src = stitched, f"{args.path} (stitched fleet)"
+        if not events:
+            print(f"no trace events under {args.path} — diagnosis "
+                  "needs a blackbox dump, a trace file, or a fleet "
+                  "dir with published rings")
+            return 2
+        summary = diagnosis_summary(events, top_k=args.diagnose_top_k)
+        if args.json:
+            print(json.dumps({"source": src, "diagnosis": summary}))
+        else:
+            print(f"[trace: {src}]")
+            print(render_diagnosis(summary))
+        return 0
     if args.trace_out:
         from jama16_retina_tpu.obs import fleet as fleet_lib
 
@@ -1793,7 +1960,11 @@ def main(argv=None) -> int:
             "quality": quality_summary(records),
             "reliability": reliability_summary(records),
             "serving_cost": serving_cost_summary(records),
-            "ingest": ingest_summary(records),
+            "ingest": ingest_summary(
+                records,
+                workdir=(args.path if os.path.isdir(args.path) else None),
+                stale_lease_s=args.stale_lease_s,
+            ),
             "router": router_summary(records),
             "lifecycle": lifecycle_summary(records),
             "integrity": (
@@ -1827,7 +1998,11 @@ def main(argv=None) -> int:
     if sc:
         print()
         print(sc)
-    ing = render_ingest(records)
+    ing = render_ingest(
+        records,
+        workdir=(args.path if os.path.isdir(args.path) else None),
+        stale_lease_s=args.stale_lease_s,
+    )
     if ing:
         print()
         print(ing)

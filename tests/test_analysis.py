@@ -704,7 +704,8 @@ def test_lint_repo_clean():
 
 def test_repo_fault_sites_registry_matches_wired_seams():
     """The declared vocabulary is exactly the seams PR 6/8/10/11/12/13
-    (+ the ISSUE 17 ingest service) wired."""
+    (+ the ISSUE 17 ingest service, + the ISSUE 18 decode-throttle
+    diagnosis drill) wired."""
     from jama16_retina_tpu.obs import faultinject
 
     assert set(faultinject.SITES) == {
@@ -713,7 +714,7 @@ def test_repo_fault_sites_registry_matches_wired_seams():
         "serve.compile_cache.load", "trainer.step",
         "lifecycle.retrain", "lifecycle.gate", "lifecycle.swap",
         "integrity.write", "integrity.write.commit",
-        "ingest.attach", "ingest.ring.write",
+        "ingest.attach", "ingest.ring.write", "ingest.decode",
     }
     assert all(desc for desc in faultinject.SITES.values())
 

@@ -349,6 +349,24 @@ def test_fleet_overhead_guard_pins_two_percent():
     assert extras["fleet_overhead_pct"] == 0.0
 
 
+def test_diagnosis_overhead_guard_pins_two_percent():
+    """The ISSUE 18 pin, same shared guard math: device_only with the
+    causal-diagnosis plane's residue (per-step provenance stamp + the
+    disabled-analyzer branch) must stay within 2% — the contract that
+    lets ingest.provenance default on."""
+    extras = {}
+    assert bench._diagnosis_overhead_guard(extras, 990.0, 1000.0)
+    assert extras["diagnosis_overhead_ok"] is True
+    assert extras["diagnosis_overhead_pct"] == pytest.approx(1.0)
+    extras = {}
+    assert not bench._diagnosis_overhead_guard(extras, 950.0, 1000.0)
+    assert extras["diagnosis_overhead_ok"] is False
+    assert extras["diagnosis_overhead_pct"] == pytest.approx(5.0)
+    extras = {}
+    assert bench._diagnosis_overhead_guard(extras, 1010.0, 1000.0)
+    assert extras["diagnosis_overhead_pct"] == 0.0
+
+
 def test_router_overhead_guard_pins_two_percent():
     """The ISSUE 12 pin, same shared guard math: the workload routed
     through a 1-replica Router must stay within 2% of calling the

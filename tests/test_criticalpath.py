@@ -1,0 +1,173 @@
+"""Critical-path analyzer pins (ISSUE 18): typed verdicts over
+synthetic Chrome-shaped timelines — every verdict reachable, the
+double-count discipline (nested serve.engine.* spans, server-lane
+decode vs the consumer decomposition, trainer.input residual), the
+waterfall shapes, and the as_dict schema the FlightRecorder dump and
+obs_report --json both serialize."""
+
+import pytest
+
+from jama16_retina_tpu.obs import criticalpath
+
+
+def ev(name, ts_s, dur_s, **args):
+    """One complete event in the tracer's Chrome shape (µs)."""
+    return {"ph": "X", "name": name, "ts": ts_s * 1e6,
+            "dur": dur_s * 1e6, "args": args}
+
+
+def test_verdict_codes_are_append_only_stable():
+    assert criticalpath.VERDICT_CODES == {
+        "balanced": 0, "device_bound": 1, "decode_bound": 2,
+        "credit_starved": 3, "h2d_bound": 4, "queue_bound": 5,
+    }
+
+
+def test_empty_window_is_balanced_at_zero_confidence():
+    v = criticalpath.diagnose([])
+    assert v.verdict == "balanced" and v.code == 0
+    assert v.confidence == 0.0
+    assert v.n_events == 0
+    assert v.request_waterfalls == [] and v.step_waterfalls == []
+
+
+def test_device_bound():
+    events = [ev("trainer.input", 0.0, 0.01),
+              ev("trainer.dispatch", 0.01, 0.09)]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "device_bound" and v.code == 1
+    assert v.confidence == pytest.approx(0.9)
+
+
+def test_decode_bound_from_consumer_segments():
+    events = [
+        ev("ingest.batch.credit_wait", 0.0, 0.001, trace_id="t1"),
+        ev("ingest.batch.decode", 0.001, 0.08, trace_id="t1"),
+        ev("ingest.batch.ring_dwell", 0.081, 0.001, trace_id="t1"),
+        ev("ingest.batch.read", 0.082, 0.002, trace_id="t1"),
+        ev("trainer.dispatch", 0.084, 0.01),
+    ]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "decode_bound" and v.code == 2
+    assert v.evidence["decode"] > 0.8
+
+
+def test_credit_starved():
+    events = [
+        ev("ingest.batch.credit_wait", 0.0, 0.08, trace_id="t1"),
+        ev("ingest.batch.cache", 0.08, 0.001, trace_id="t1"),
+        ev("trainer.dispatch", 0.081, 0.01),
+    ]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "credit_starved" and v.code == 3
+
+
+def test_h2d_bound_by_name_substring():
+    events = [ev("trainer.h2d_copy", 0.0, 0.08),
+              ev("trainer.dispatch", 0.08, 0.01)]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "h2d_bound" and v.code == 4
+
+
+def test_queue_bound():
+    events = [
+        ev("serve.request.queue_wait", 0.0, 0.08, trace_id="r1"),
+        ev("serve.request.device", 0.08, 0.01, trace_id="r1"),
+    ]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "queue_bound" and v.code == 5
+
+
+def test_balanced_below_dominant_fraction():
+    # 3-way near-even split: no category reaches DOMINANT_FRACTION.
+    events = [ev("trainer.dispatch", 0.0, 0.03),
+              ev("trainer.input", 0.03, 0.035),
+              ev("serve.request.queue_wait", 0.08, 0.035, trace_id="r")]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "balanced" and v.code == 0
+    assert 0.0 < v.confidence < criticalpath.DOMINANT_FRACTION
+
+
+def test_nested_engine_spans_do_not_double_count():
+    # serve.engine.* nests inside serve.request.device — counting both
+    # would double the device wall and flip a queue verdict.
+    events = [
+        ev("serve.request.queue_wait", 0.0, 0.06, trace_id="r"),
+        ev("serve.request.device", 0.06, 0.04, trace_id="r"),
+        ev("serve.engine.infer", 0.06, 0.04, trace_id="r"),
+    ]
+    v = criticalpath.diagnose(events)
+    assert v.verdict == "queue_bound"
+    assert v.totals_s["device"] == pytest.approx(0.04)
+
+
+def test_server_lane_decode_counts_only_without_consumer_segments():
+    server_only = [ev("ingest.decode.batch", 0.0, 0.08, trace_id="t")]
+    v = criticalpath.diagnose(server_only)
+    assert v.verdict == "decode_bound"
+    # With the consumer decomposition present the server lane is the
+    # SAME wall seen from the other process — it must not add.
+    both = server_only + [
+        ev("ingest.batch.decode", 0.0, 0.08, trace_id="t"),
+    ]
+    v2 = criticalpath.diagnose(both)
+    assert v2.totals_s["decode"] == pytest.approx(0.08)
+
+
+def test_trainer_input_residual_goes_to_other():
+    # trainer.input measured 0.1s; the ingest.batch.* segments explain
+    # 0.08 of it — only the unexplained 0.02 lands in "other".
+    events = [
+        ev("trainer.input", 0.0, 0.1),
+        ev("ingest.batch.decode", 0.0, 0.08, trace_id="t"),
+        ev("trainer.dispatch", 0.1, 0.01),
+    ]
+    totals = criticalpath.attribute(events)
+    assert totals["decode"] == pytest.approx(0.08)
+    assert totals["other"] == pytest.approx(0.02)
+    # No decomposition: input-bound IS decode-bound in these terms.
+    totals2 = criticalpath.attribute([ev("trainer.input", 0.0, 0.1)])
+    assert totals2["decode"] == pytest.approx(0.1)
+
+
+def test_request_waterfalls_group_by_trace_slowest_first():
+    events = [
+        ev("ingest.batch.credit_wait", 0.0, 0.01, trace_id="slow"),
+        ev("ingest.batch.decode", 0.01, 0.05, trace_id="slow"),
+        ev("ingest.batch.decode", 0.1, 0.002, trace_id="fast"),
+    ]
+    wf = criticalpath.request_waterfalls(events)
+    assert [w["trace_id"] for w in wf] == ["slow", "fast"]
+    assert wf[0]["total_s"] == pytest.approx(0.06)
+    assert wf[0]["dominant"] == "ingest.batch.decode"
+    segs = wf[0]["segments"]
+    assert [s["name"] for s in segs] == [
+        "ingest.batch.credit_wait", "ingest.batch.decode"]
+    assert sum(s["frac"] for s in segs) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_step_waterfalls_split_at_dispatch():
+    events = [
+        ev("trainer.input", 0.0, 0.01),
+        ev("trainer.dispatch", 0.01, 0.02),
+        ev("trainer.input", 0.03, 0.04),
+        ev("trainer.dispatch", 0.07, 0.02),
+    ]
+    wf = criticalpath.step_waterfalls(events)
+    assert len(wf) == 2
+    # Slowest first: the second step (0.06 total) outranks the first.
+    assert wf[0]["step_index"] == 1
+    assert wf[0]["dominant"] == "trainer.input"
+    assert wf[1]["dominant"] == "trainer.dispatch"
+
+
+def test_as_dict_schema():
+    v = criticalpath.diagnose(
+        [ev("trainer.dispatch", 0.0, 0.1)], top_k=1)
+    d = v.as_dict()
+    assert set(d) == {"verdict", "code", "confidence", "evidence",
+                      "totals_s", "n_events", "request_waterfalls",
+                      "step_waterfalls"}
+    assert set(d["evidence"]) == {"device", "decode", "credit", "h2d",
+                                  "queue", "other"}
+    assert d["code"] == criticalpath.VERDICT_CODES[d["verdict"]]

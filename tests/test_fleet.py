@@ -823,3 +823,34 @@ def test_lifecycle_trigger_context_propagates_via_journal(tmp_path):
         assert evs and evs[0]["args"]["trace_id"] == ctx.trace_id
     finally:
         trace_lib.set_default_tracer(prev)
+
+
+def test_obs_report_diagnose_stitched_fleet(tmp_path, capsys):
+    """--diagnose over a fleet dir (ISSUE 18): the analyzer runs on the
+    STITCHED multi-lane trace, so a consumer lane's ingest.batch.*
+    decomposition drives the verdict across processes."""
+    rep = _load_obs_report()
+    fd = str(tmp_path / "fleet")
+    _write_seg(fd, "trainer", 1, 1, 1000.0, heartbeat={"step": 1})
+    for pid, role, name, ts, dur in (
+            (1, "trainer", "ingest.batch.decode", 5e6, 8e4),
+            (2, "ingest", "ingest.decode.batch", 1e6, 8e4),
+    ):
+        os.makedirs(os.path.join(fd, f"{role}-p{pid}"), exist_ok=True)
+        artifact_lib.atomic_write_text(
+            os.path.join(fd, f"{role}-p{pid}", "trace.json"),
+            json.dumps({
+                "meta": {"role": role, "pid": pid, "epoch_unix": 100.0},
+                "traceEvents": [{
+                    "name": name, "ph": "X", "ts": ts, "dur": dur,
+                    "pid": pid, "tid": 1, "args": {"trace_id": "7-9"},
+                }],
+            }),
+        )
+    assert rep.main([fd, "--diagnose", "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert "stitched fleet" in obj["source"]
+    diag = obj["diagnosis"]
+    assert diag["verdict"] == "decode_bound"
+    # The server lane is the SAME wall: 0.08 s once, not twice.
+    assert diag["totals_s"]["decode"] == pytest.approx(0.08)

@@ -488,3 +488,291 @@ def test_fit_tf_refuses_served_loader(data_dir, tmp_path):
     cfg = override(get_config("smoke"), ["data.loader=served"])
     with pytest.raises(ValueError, match="served"):
         trainer.fit_tf(cfg, data_dir, str(tmp_path / "x"), seed=0)
+
+
+# -- batch provenance + causal diagnosis (ISSUE 18) --------------------------
+
+
+def test_provenance_region_roundtrip():
+    _, slot_bytes = protocol.slot_layout(BATCH, IMAGE)
+    buf = bytearray(slot_bytes * 2)
+    rec = {"v": 2, "seq": 7, "decode_s": 0.01,
+           "trace": {"trace_id": "t1"}}
+    protocol.write_provenance(buf, 1, BATCH, IMAGE, rec)
+    assert protocol.read_provenance(buf, 1, BATCH, IMAGE) == rec
+    # An unstamped slot reads as "no record", never as garbage.
+    assert protocol.read_provenance(buf, 0, BATCH, IMAGE) is None
+    protocol.write_provenance(buf, 1, BATCH, IMAGE, None)
+    assert protocol.read_provenance(buf, 1, BATCH, IMAGE) is None
+    # A record outgrowing the fixed region refuses, not truncates.
+    with pytest.raises(ValueError, match="provenance record"):
+        protocol.write_provenance(
+            buf, 0, BATCH, IMAGE, {"pad": "x" * protocol.PROV_BYTES})
+
+
+def test_v1_attach_refused_with_typed_error_frame(server):
+    """A pre-v2 consumer (attach frame without the protocol field)
+    computes provenance-free slot offsets — the only safe answer is
+    the typed version_mismatch refusal, then hang up."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10)
+    sock.connect(server.socket_path)
+    try:
+        protocol.send_msg(sock, {
+            "type": "attach", "consumer_id": "old-client",
+            "split": "train", "seed": SEED, "batch_size": BATCH,
+            "image_size": IMAGE, "capacity_rows": CAPACITY,
+            "start_step": 0,
+        })
+        reply = protocol.recv_msg(sock)
+        assert reply["type"] == "error"
+        assert reply["code"] == "version_mismatch"
+        assert "v2" in reply["message"] and "v1" in reply["message"]
+        assert protocol.recv_msg(sock) is None  # server hung up
+    finally:
+        sock.close()
+
+
+def test_pre_v2_server_reply_refused_typed(tmp_path):
+    """The other direction: an old server's attached reply has no
+    protocol field — its ring has no provenance region, so mapping it
+    with v2 offsets would shear every batch. The consumer must raise
+    the typed mismatch, not attach."""
+    path = str(tmp_path / "old.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+
+    def old_server():
+        conn, _ = srv.accept()
+        protocol.recv_msg(conn)
+        protocol.send_msg(conn, {
+            "type": "attached", "shm_name": "x", "n_slots": 1,
+            "slot_bytes": 64, "batch_size": BATCH,
+            "image_size": IMAGE, "start_step": 0,
+            "n_records": N_RECORDS, "steps_per_epoch": 6,
+        })
+        conn.close()
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(protocol.ProtocolVersionMismatch,
+                           match="protocol v1"):
+            served.ServedStream(path, "new-client", split="train",
+                                seed=SEED, batch_size=BATCH,
+                                image_size=IMAGE,
+                                capacity_rows=CAPACITY, start_step=0)
+    finally:
+        t.join(5)
+        srv.close()
+
+
+def test_provenance_tiling_segments_sum_to_input_wait(server):
+    """The segment-sum pin (PR-4 batcher discipline): the non-read
+    ingest.batch.* segments must tile the measured input wait EXACTLY
+    — attribution that under- or over-explains the wait is worse than
+    none. Also pins the emitted trace spans: per batch, four causally
+    chained segments sharing one stamped trace id."""
+    from jama16_retina_tpu.obs import trace as trace_lib
+
+    prev = trace_lib.set_default_tracer(trace_lib.Tracer(enabled=True))
+    try:
+        s = _attach(server, "tiling", start_step=0)
+        try:
+            for _ in range(6):
+                next(s)
+                t = s._last_tiling
+                assert t is not None and t["trace_id"]
+                segs = t["segments"]
+                assert ("ingest.batch.decode" in segs) ^ (
+                    "ingest.batch.cache" in segs)
+                assert all(v >= 0.0 for v in segs.values())
+                non_read = sum(v for k, v in segs.items()
+                               if k != "ingest.batch.read")
+                assert non_read == pytest.approx(t["input_wait_s"],
+                                                 abs=1e-9)
+                assert segs["ingest.batch.read"] == pytest.approx(
+                    t["read_s"], abs=1e-9)
+        finally:
+            s.close()
+        by_tid = {}
+        for e in trace_lib.default_tracer().events():
+            if e["name"].startswith("ingest.batch."):
+                by_tid.setdefault(e["args"]["trace_id"], []).append(e)
+        assert len(by_tid) >= 6
+        for tid, evs in by_tid.items():
+            assert len(evs) == 4
+            evs.sort(key=lambda e: e["ts"])
+            assert [e["name"] for e in evs][-2:] == [
+                "ingest.batch.ring_dwell", "ingest.batch.read"]
+            for a, b in zip(evs, evs[1:]):  # causally chained, no gaps
+                assert a["ts"] + a["dur"] == pytest.approx(b["ts"],
+                                                           abs=0.01)
+    finally:
+        trace_lib.set_default_tracer(prev)
+
+
+def test_ingest_wait_histogram_carries_exemplar(server):
+    reg = Registry()
+    s = served.ServedStream(server.socket_path, "exemplar",
+                            split="train", seed=SEED, batch_size=BATCH,
+                            image_size=IMAGE, capacity_rows=CAPACITY,
+                            start_step=0, registry=reg)
+    try:
+        for _ in range(3):
+            next(s)
+    finally:
+        s.close()
+    snap = reg.histogram("ingest.batch.wait_s").snapshot()
+    assert snap["count"] == 3
+    # The exemplar names the slowest batch's stamped trace id — the
+    # handle a slow-step dump uses to pull its waterfall.
+    assert snap["exemplar"] is not None
+    assert snap["exemplar"]["trace_id"]
+
+
+def test_provenance_off_still_serves_and_observes(data_dir, tmp_path):
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        f"data.batch_size={BATCH}",
+        f"ingest.socket_path={os.path.join(str(tmp_path), 'i.sock')}",
+        "ingest.provenance=false",
+    ])
+    srv = IngestServer(data_dir, cfg, registry=Registry()).start()
+    try:
+        refs = _refs(data_dir, 2)
+        reg = Registry()
+        s = served.ServedStream(srv.socket_path, "noprov",
+                                split="train", seed=SEED,
+                                batch_size=BATCH, image_size=IMAGE,
+                                capacity_rows=CAPACITY, start_step=0,
+                                registry=reg)
+        try:
+            for i in range(2):
+                _assert_batches_equal(next(s), refs[i], i)
+                assert s._last_tiling is None  # unattributed, observed
+        finally:
+            s.close()
+        assert reg.histogram("ingest.batch.wait_s").snapshot()[
+            "count"] == 2
+    finally:
+        srv.close()
+
+
+@pytest.mark.chaos
+def test_throttled_decode_diagnoses_decode_bound(server):
+    """Injected-bottleneck drill (ISSUE 18): a latency plan on
+    ingest.decode throttles the decode plane; the analyzer over the
+    consumer's stamped segments must say decode_bound."""
+    from jama16_retina_tpu.obs import criticalpath
+    from jama16_retina_tpu.obs import trace as trace_lib
+
+    prev_p = faultinject.arm(faultinject.plan_from_spec({
+        "ingest.decode": {"kind": "latency", "every": 1,
+                          "delay_s": 0.02},
+    }))
+    prev_t = trace_lib.set_default_tracer(trace_lib.Tracer(enabled=True))
+    try:
+        s = _attach(server, "throttled", start_step=0)
+        try:
+            for _ in range(10):
+                next(s)
+        finally:
+            s.close()
+        v = criticalpath.diagnose(trace_lib.default_tracer().events())
+    finally:
+        trace_lib.set_default_tracer(prev_t)
+        faultinject.arm(prev_p)
+    assert v.verdict == "decode_bound" and v.code == 2
+    assert v.evidence["decode"] >= criticalpath.DOMINANT_FRACTION
+    assert v.request_waterfalls  # exemplar waterfalls ride along
+
+
+@pytest.mark.chaos
+def test_one_slot_starved_ring_diagnoses_credit_starved(
+        data_dir, tmp_path):
+    """The same decode throttle behind a 1-slot ring and a bursty
+    consumer: with no credit to run ahead, the post-burst fetch stalls
+    on work the server could have hidden — the stamped credit wait
+    absorbs the measured wait and the verdict flips to
+    credit_starved."""
+    from jama16_retina_tpu.obs import criticalpath
+    from jama16_retina_tpu.obs import trace as trace_lib
+
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        f"data.batch_size={BATCH}",
+        f"ingest.socket_path={os.path.join(str(tmp_path), 'i.sock')}",
+        "ingest.ring_slots=1",
+    ])
+    srv = IngestServer(data_dir, cfg, registry=Registry()).start()
+    prev_p = faultinject.arm(faultinject.plan_from_spec({
+        "ingest.decode": {"kind": "latency", "every": 1,
+                          "delay_s": 0.02},
+    }))
+    prev_t = trace_lib.set_default_tracer(trace_lib.Tracer(enabled=True))
+    try:
+        s = _attach(srv, "bursty", start_step=0)
+        try:
+            for i in range(12):
+                next(s)
+                if i % 2 == 0:
+                    time.sleep(0.05)
+        finally:
+            s.close()
+        v = criticalpath.diagnose(trace_lib.default_tracer().events())
+    finally:
+        trace_lib.set_default_tracer(prev_t)
+        faultinject.arm(prev_p)
+        srv.close()
+    assert v.verdict == "credit_starved" and v.code == 3
+
+
+def test_ingest_server_http_endpoint(data_dir, tmp_path):
+    """The ISSUE 18 satellite, socket level like PR 15's: with
+    obs.http_port set the server answers /metrics (live Prometheus
+    text) and /healthz, where progress == batches served."""
+    import http.client
+
+    free = socket.socket()
+    free.bind(("127.0.0.1", 0))
+    port = free.getsockname()[1]
+    free.close()
+    cfg = override(get_config("smoke"), [
+        f"model.image_size={IMAGE}",
+        f"data.batch_size={BATCH}",
+        f"ingest.socket_path={os.path.join(str(tmp_path), 'i.sock')}",
+        f"obs.http_port={port}",
+    ])
+    srv = IngestServer(data_dir, cfg, registry=Registry()).start()
+    try:
+        s = _attach(srv, "probe", start_step=0)
+        try:
+            for _ in range(3):
+                next(s)
+        finally:
+            s.close()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        # Progress is stamped by the 1 s bus tick — wait for it.
+        body = {}
+        status = None
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            body = json.loads(r.read())
+            status = r.status
+            if status == 200 and body.get("step", 0) >= 3:
+                break
+            time.sleep(0.2)
+        assert status == 200 and body["step"] >= 3
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        text = r.read().decode()
+        assert r.status == 200
+        assert "# TYPE ingest_batches_served counter" in text
+        assert "ingest_batches_served" in text
+        conn.close()
+    finally:
+        srv.close()

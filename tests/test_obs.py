@@ -648,3 +648,153 @@ def test_obs_disabled_run_writes_no_telemetry(tmp_path_factory):
     # not of the optional registry/export machinery).
     train = [r for r in recs if r["kind"] == "train"]
     assert train and all("input_wait_sec" in r for r in train)
+
+
+# ---------------------------------------------------------------------------
+# Ingest lease staleness blame + bench trend (ISSUE 18 satellites)
+# ---------------------------------------------------------------------------
+
+
+def _write_lease(workdir, cid, step, age_s, now, corrupt=False):
+    from jama16_retina_tpu.ingest.leases import (LEASE_SCHEMA,
+                                                 LEASE_VERSION)
+    from jama16_retina_tpu.integrity import artifact as artifact_lib
+
+    d = os.path.join(workdir, "leases")
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, f"lease-{cid}.json")
+    artifact_lib.write_sealed_json(
+        p, {"consumer_id": cid, "consumed_through": step},
+        schema=LEASE_SCHEMA, version=LEASE_VERSION,
+    )
+    if corrupt:
+        # Flip a payload byte UNDER the seal: digest mismatch, typed
+        # ArtifactCorrupt on read.
+        text = open(p).read().replace(f'"{cid}"', f'"{cid[:-1]}X"')
+        with open(p, "w") as f:
+            f.write(text)
+    os.utime(p, (now - age_s, now - age_s))
+    return p
+
+
+def test_lease_staleness_blames_only_with_a_fresh_peer(tmp_path):
+    """Mirrors the --check-heartbeats fleet semantics: a consumer is
+    NAMED stale only while a peer still advances; when every lease is
+    old the whole service is idle and nobody is blamed."""
+    rep = _load_obs_report()
+    wd = str(tmp_path)
+    now = time.time()
+    _write_lease(wd, "healthy", 40, 5.0, now)
+    _write_lease(wd, "wedged", 7, 500.0, now)
+    entries = rep.lease_staleness(wd, stale_s=120.0, now=now)
+    assert [e["consumer_id"] for e in entries] == ["wedged", "healthy"]
+    wedged, healthy = entries
+    assert wedged["stale"] and wedged["blamed"]
+    assert wedged["consumed_through"] == 7
+    assert not healthy["stale"] and not healthy["blamed"]
+
+    # All old -> idle service, blame nobody.
+    wd2 = str(tmp_path / "idle")
+    _write_lease(wd2, "a", 1, 500.0, now)
+    _write_lease(wd2, "b", 2, 900.0, now)
+    entries = rep.lease_staleness(wd2, stale_s=120.0, now=now)
+    assert all(e["stale"] and not e["blamed"] for e in entries)
+
+    # No lease files at all -> None (section stays quiet).
+    assert rep.lease_staleness(str(tmp_path / "empty")) is None
+
+
+def test_lease_staleness_renders_corrupt_and_blamed_rows(tmp_path):
+    rep = _load_obs_report()
+    wd = str(tmp_path)
+    now = time.time()
+    _write_lease(wd, "healthy", 12, 5.0, now)
+    _write_lease(wd, "wedged", 3, 900.0, now)
+    _write_lease(wd, "broken", 9, 10.0, now, corrupt=True)
+    entries = rep.lease_staleness(wd, stale_s=120.0, now=now)
+    by_cid = {e["consumer_id"]: e for e in entries}
+    assert by_cid["broken"]["corrupt"]
+    assert by_cid["broken"]["consumed_through"] is None
+    assert not by_cid["healthy"]["corrupt"]
+
+    # The Ingest section names the wedged consumer; the healthy
+    # remainder stays quiet (fresh rows, no blame).
+    records = [{"kind": "telemetry",
+                "counters": {"ingest.batches_served": 10.0,
+                             "ingest.rows_served": 80.0,
+                             "ingest.consumer.healthy.rows": 80.0},
+                "gauges": {}, "histograms": {}}]
+    out = rep.render_ingest(records, workdir=wd, stale_lease_s=120.0)
+    assert "wedged" in out and "STALE" in out
+    assert "CORRUPT" in out
+    assert "healthy" in out and "fresh" in out
+    s = rep.ingest_summary(records, workdir=wd, stale_lease_s=120.0)
+    assert [e["consumer_id"] for e in s["leases"]].count("wedged") == 1
+
+
+def _load_bench_trend():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(repo, "scripts", "bench_trend.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trend_flags_regressions_by_direction(tmp_path, capsys):
+    """The trajectory summarizer (ISSUE 18 satellite): BENCH rounds
+    nest metrics under 'parsed', MULTICHIP rounds keep them top-level;
+    a >10% move in the metric's BAD direction flags REGRESSED."""
+    bt = _load_bench_trend()
+    d = str(tmp_path)
+    for rnd, rate, p99 in ((1, 1000.0, 10.0), (2, 800.0, 12.0)):
+        with open(os.path.join(d, f"BENCH_r{rnd:02d}.json"), "w") as f:
+            json.dump({"parsed": {"device_only": rate,
+                                  "serve_p99_ms": p99}}, f)
+    with open(os.path.join(d, "MULTICHIP_r01.json"), "w") as f:
+        json.dump({"n": 1, "cmd": "x", "rc": 0, "tail": "", "ok": True,
+                   "parsed": None, "eval_images_per_sec": 500.0}, f)
+    with open(os.path.join(d, "MULTICHIP_r02.json"), "w") as f:
+        json.dump({"n": 1, "cmd": "x", "rc": 0, "tail": "", "ok": True,
+                   "parsed": None, "eval_images_per_sec": 510.0}, f)
+    summary = bt.summarize(d, threshold=0.10)
+    rows = {r["metric"]: r
+            for r in summary["families"]["BENCH"]["trend"]}
+    assert rows["device_only"]["direction"] == "higher_better"
+    assert rows["device_only"]["regressed"]  # -20%
+    assert rows["device_only"]["change_vs_previous"] == pytest.approx(
+        -0.2)
+    assert rows["serve_p99_ms"]["direction"] == "lower_better"
+    assert rows["serve_p99_ms"]["regressed"]  # +20% latency
+    mrows = {r["metric"]: r
+             for r in summary["families"]["MULTICHIP"]["trend"]}
+    assert not mrows["eval_images_per_sec"]["regressed"]  # +2%
+    assert set(summary["regressions"]) == {"device_only",
+                                           "serve_p99_ms"}
+
+    # CLI: advisory exit 0 despite flags; --strict turns them into 1;
+    # --json round-trips the same object.
+    assert bt.main([d]) == 0
+    assert "REGRESSED" in capsys.readouterr().out
+    assert bt.main([d, "--strict"]) == 1
+    capsys.readouterr()
+    assert bt.main([d, "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["regressions"] == summary["regressions"]
+    # An empty dir reports and exits 0 (advisory even when blind).
+    empty = str(tmp_path / "none")
+    os.makedirs(empty)
+    assert bt.main([empty]) == 0
+
+
+def test_bench_trend_direction_heuristic():
+    bt = _load_bench_trend()
+    assert not bt.lower_is_better("eval_images_per_sec")
+    assert not bt.lower_is_better("device_only")
+    assert not bt.lower_is_better("router_k4_vs_k1")
+    assert bt.lower_is_better("hbm_load_sec")
+    assert bt.lower_is_better("serve_p99_ms")
+    assert bt.lower_is_better("fleet_overhead_pct")
+    assert bt.lower_is_better("eval_stall_sec")
+    assert bt.lower_is_better("spec_wasted_bytes")

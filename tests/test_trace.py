@@ -926,3 +926,102 @@ def test_batcher_metrics_carry_help_strings():
     # the help map (it is .prom-only).
     text = obs_export.prometheus_text(snap)
     assert "# HELP serve_request_latency_s" in text
+
+
+# ---------------------------------------------------------------------------
+# Dump-time diagnosis (ISSUE 18): diagnosis.json + verdict gauges
+# ---------------------------------------------------------------------------
+
+
+def test_dump_carries_diagnosis_and_verdict_gauges(tmp_path):
+    reg = obs_registry.Registry()
+    tr = obs_trace.Tracer(enabled=True)
+    tr.complete("trainer.input", 100.0, 100.01)
+    tr.complete("trainer.dispatch", 100.01, 100.10)
+    fr = FlightRecorder(str(tmp_path), config={"name": "t"},
+                        registry=reg, tracer=tr)
+    d = fr.dump("manual")
+    with open(os.path.join(d, "diagnosis.json")) as f:
+        diag = json.load(f)
+    assert diag["verdict"] == "device_bound" and diag["code"] == 1
+    assert diag["step_waterfalls"]
+    assert reg.gauge("obs.diagnosis.verdict").value == 1.0
+    assert reg.gauge("obs.diagnosis.confidence").value == pytest.approx(
+        0.9)
+    # Gauges publish BEFORE the snapshot lands: the dump's own
+    # registry.json already carries the verdict.
+    with open(os.path.join(d, "registry.json")) as f:
+        snap = json.load(f)
+    assert snap["gauges"]["obs.diagnosis.verdict"] == 1.0
+
+
+def test_dump_diagnosis_disabled_writes_nothing(tmp_path):
+    reg = obs_registry.Registry()
+    tr = obs_trace.Tracer(enabled=True)
+    tr.complete("trainer.dispatch", 100.0, 100.1)
+    fr = FlightRecorder(str(tmp_path), config={}, registry=reg,
+                        tracer=tr, diagnosis=False)
+    d = fr.dump("manual")
+    assert not os.path.exists(os.path.join(d, "diagnosis.json"))
+    assert "obs.diagnosis.verdict" not in reg.snapshot()["gauges"]
+
+
+def test_dump_events_fn_overrides_tracer_source(tmp_path):
+    """The fleet aggregator passes a stitched-trace thunk: its dumps
+    must diagnose across every lane, not this process's rings."""
+    stitched = [{
+        "ph": "X", "name": "serve.request.queue_wait", "ts": 0.0,
+        "dur": 80000.0, "args": {"trace_id": "r"},
+    }, {
+        "ph": "X", "name": "serve.request.device", "ts": 80000.0,
+        "dur": 10000.0, "args": {"trace_id": "r"},
+    }]
+    reg = obs_registry.Registry()
+    fr = FlightRecorder(str(tmp_path), config={}, registry=reg,
+                        tracer=obs_trace.Tracer(enabled=True),
+                        events_fn=lambda: stitched)
+    d = fr.dump("manual")
+    with open(os.path.join(d, "trace.jsonl")) as f:
+        evs = [json.loads(line) for line in f]
+    assert evs == stitched
+    with open(os.path.join(d, "diagnosis.json")) as f:
+        assert json.load(f)["verdict"] == "queue_bound"
+    # A broken thunk degrades to the tracer, never a failed dump.
+    fr2 = FlightRecorder(str(tmp_path / "w2"), config={}, registry=reg,
+                         tracer=obs_trace.Tracer(enabled=True),
+                         events_fn=lambda: 1 / 0)
+    assert os.path.isdir(fr2.dump("manual"))
+
+
+def test_obs_report_diagnose_text_and_json(tmp_path, capsys):
+    """--diagnose pins (ISSUE 18): the typed verdict + evidence table
+    + exemplar waterfalls over a dump, and the --json schema CI
+    consumes."""
+    rep = _load_obs_report()
+    d = _dump_with_serve_and_train_events(tmp_path)
+    assert rep.main([d, "--diagnose"]) == 0
+    out = capsys.readouterr().out
+    assert "diagnosis: device_bound" in out
+    assert "category" in out and "share" in out
+    assert "waterfalls" in out
+
+    assert rep.main([d, "--diagnose", "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["source"]
+    diag = obj["diagnosis"]
+    assert set(diag) == {"verdict", "code", "confidence", "evidence",
+                         "totals_s", "n_events", "request_waterfalls",
+                         "step_waterfalls"}
+    assert diag["verdict"] == "device_bound" and diag["code"] == 1
+    assert set(diag["evidence"]) == {"device", "decode", "credit",
+                                     "h2d", "queue", "other"}
+    assert diag["request_waterfalls"] and diag["step_waterfalls"]
+
+    assert rep.main([d, "--diagnose", "--diagnose-top-k", "1",
+                     "--json"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert len(obj["diagnosis"]["request_waterfalls"]) == 1
+
+    # Nothing to diagnose is a typed exit, not a guess.
+    assert rep.main([str(tmp_path / "nothing-here"),
+                     "--diagnose"]) == 2
