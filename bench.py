@@ -518,6 +518,21 @@ def _autotune_overhead_guard(extras: dict, rate_on: float,
                            max_overhead)
 
 
+def _devicemon_overhead_guard(extras: dict, rate_on: float,
+                              rate_off: float,
+                              max_overhead: float = 0.02) -> bool:
+    """ISSUE 19's pin, same shared math: device_only with the device-
+    utilization plane's steady-state hot-path costs live — one program-
+    ledger call count per step (the counted-step closure the trainer
+    wraps around the compiled step) plus a full DeviceMonitor.sample()
+    every 10 steps (memory_stats walk + gauge publishes, at a far
+    denser cadence than any real telemetry flush) — must stay within
+    2% of the uninstrumented headline. The contract that lets
+    obs.device_enabled default on."""
+    return _overhead_guard(extras, "devicemon", rate_on, rate_off,
+                           max_overhead)
+
+
 def _lifecycle_overhead_guard(extras: dict, rate_on: float,
                               rate_off: float,
                               max_overhead: float = 0.02) -> bool:
@@ -1813,6 +1828,103 @@ def _chaos_diagnose(extras: dict) -> None:
     _log(f"chaos diagnose drill: ok={ok}")
 
 
+def _chaos_device(extras: dict) -> None:
+    """``--chaos`` device-utilization drill (ISSUE 19): two INJECTED
+    device pathologies, each landing in the MATCHING typed verdict or
+    alert — the proof the device plane's refinement means what it
+    claims.
+
+    * A dispatch-dominant trace window paired with a LOW-MFU compute-
+      class device summary must refine ``device_bound`` into
+      ``device_underutilized`` (the device is the wall but mostly
+      idle — launch overhead / tiny batches, not compute saturation);
+      the SAME window with a memory-class summary must refine into
+      ``device_membw_bound``.
+    * A DeviceMonitor sampling a fake device at 95% HBM occupancy
+      must publish headroom below the 10% alert line, and the
+      reliability rule set must latch ``hbm_pressure`` after the
+      for-60s window (driven with injected clocks — deterministic).
+
+    Publishes ``device_ok`` + per-phase booleans."""
+    from jama16_retina_tpu.configs import get_config
+    from jama16_retina_tpu.obs import alerts as obs_alerts
+    from jama16_retina_tpu.obs import criticalpath
+    from jama16_retina_tpu.obs import device as device_lib
+    from jama16_retina_tpu.obs import trace as trace_lib
+    from jama16_retina_tpu.obs.registry import Registry
+
+    ok = True
+    try:
+        # Dispatch-dominant window: the device is the critical path.
+        tr = trace_lib.Tracer(enabled=True)
+        for _ in range(6):
+            t0 = time.perf_counter()
+            time.sleep(0.001)
+            t1 = time.perf_counter()
+            tr.complete("trainer.input", t0, t1, {})
+            time.sleep(0.012)
+            t2 = time.perf_counter()
+            tr.complete("trainer.dispatch", t1, t2, {})
+        events = tr.events()
+
+        # Low MFU + compute class: device-bound but mostly idle. The
+        # 3% MFU stays under SATURATED_MFU at any local device count.
+        v_low = criticalpath.diagnose(events, device={
+            "mfu": 0.03, "dominant_class": "compute",
+        })
+        d1 = v_low.verdict == "device_underutilized"
+        extras["chaos_device_underutilized"] = bool(d1)
+        ok &= d1
+        _log(f"chaos device low-MFU phase: {v_low.verdict}")
+
+        # Memory class: bandwidth is the wall regardless of MFU.
+        v_mem = criticalpath.diagnose(events, device={
+            "mfu": 0.6, "dominant_class": "memory",
+        })
+        d2 = v_mem.verdict == "device_membw_bound"
+        extras["chaos_device_membw_bound"] = bool(d2)
+        ok &= d2
+        _log(f"chaos device membw phase: {v_mem.verdict}")
+
+        # HBM-pressure window: fake device at 95% occupancy -> the
+        # headroom gauge lands under the alert line, and the for-60s
+        # rule latches across two injected-clock evaluations.
+        class _PressedDev:
+            def memory_stats(self):
+                limit = 16 << 30
+                return {"bytes_in_use": int(limit * 0.95),
+                        "peak_bytes_in_use": int(limit * 0.95),
+                        "bytes_limit": limit}
+
+        reg = Registry()
+        mon = device_lib.DeviceMonitor(reg, devices=[_PressedDev()],
+                                       ledger=device_lib.ProgramLedger())
+        mon.sample()
+        head = reg.snapshot()["gauges"].get("device.hbm.headroom_frac")
+        d3 = head is not None and head < device_lib.HBM_PRESSURE_HEADROOM
+        extras["chaos_device_headroom_frac"] = (
+            round(head, 4) if head is not None else None)
+        ok &= d3
+
+        cfg = get_config("smoke")
+        mgr = obs_alerts.AlertManager(
+            obs_alerts.reliability_rules(cfg), registry=reg,
+        )
+        mgr.evaluate(now=1000.0)
+        firing = mgr.evaluate(now=1061.0)
+        d4 = any(f.get("reason") == "hbm_pressure" for f in firing)
+        extras["chaos_device_hbm_pressure_fired"] = bool(d4)
+        ok &= d4
+        _log(f"chaos device HBM phase: headroom={head}, "
+             f"hbm_pressure fired={d4}")
+    except Exception as e:  # pragma: no cover - bench must emit JSON
+        _log(f"chaos device drill failed: {type(e).__name__}: {e}")
+        ok = False
+
+    extras["device_ok"] = bool(ok)
+    _log(f"chaos device drill: ok={ok}")
+
+
 def _latency_summary(latencies_ms) -> dict:
     """p50/p99/mean over one offered-load window's per-request
     latencies. Both percentiles come from the SAME sorted sample, so
@@ -2148,6 +2260,13 @@ def main() -> None:
     extras["physics_peak_tflops"] = round(peak / 1e12, 1)
     if flops_per_image:
         extras["train_gflops_per_image"] = round(flops_per_image / 1e9, 2)
+        # Model FLOPs utilization of the headline (ISSUE 19): the SAME
+        # numbers the physics guard already trusts (device_only is
+        # img/s/CHIP, peak is per-chip), read as a fraction instead of
+        # a ceiling — what the MFU gauge (obs/device.py) reports for a
+        # production run of this step.
+        extras["train_mfu"] = round(
+            device_only * flops_per_image / peak, 4)
 
     # Telemetry overhead pin (ISSUE 3): the SAME step/batches/window as
     # device_only, with the trainer's per-step telemetry ops live
@@ -2507,6 +2626,66 @@ def main() -> None:
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"autotune overhead bench failed: {type(e).__name__}: {e}")
 
+    # Device-monitor overhead pin (ISSUE 19): the same device_only
+    # window with the device-utilization plane's steady-state costs
+    # live — the program ledger's per-step call count (the trainer's
+    # counted-step closure) plus a full DeviceMonitor.sample() every
+    # 10 steps (far denser than any real telemetry flush). The monitor
+    # samples a FAKE device's memory_stats so the pin measures the
+    # plane's own bookkeeping, not a backend's stats quirks — the
+    # sample path (stats walk, owner ledger sum, gauge publishes,
+    # program-delta MFU math) is identical. Same ≤2% budget, shared
+    # guard math — the contract that lets obs.device_enabled default
+    # on.
+    if not headline_serialized:
+        try:
+            from jama16_retina_tpu.obs import device as device_lib
+            from jama16_retina_tpu.obs.registry import Registry
+
+            class _FakeDev:
+                def memory_stats(self):
+                    return {"bytes_in_use": 6 << 30,
+                            "peak_bytes_in_use": 7 << 30,
+                            "bytes_limit": 16 << 30}
+
+            dm_ledger = device_lib.ProgramLedger()
+            dm_entry = dm_ledger.register(
+                "bench_step", flops_per_call=train_flops or 1e9,
+                bytes_per_call=1e8,
+            )
+            dm_mon = device_lib.DeviceMonitor(
+                Registry(), devices=[_FakeDev()], ledger=dm_ledger,
+                peak_flops_per_s=peak,
+            )
+            dm_mon.sample()  # baseline tick off the clock
+            dm_state = {"n": 0}
+
+            def devicemon_step(s, batch, k):
+                dm_entry.note_call()
+                out = step(s, batch, k)
+                dm_state["n"] += 1
+                if dm_state["n"] >= 10:
+                    dm_mon.sample()
+                    dm_state["n"] = 0
+                return out
+
+            rate_dm, state = _timed_steps(
+                devicemon_step, state,
+                lambda i: batches[i % N_DISTINCT_BATCHES], key,
+                TIMED_STEPS, batch_size, n_dev,
+            )
+            rate_dm = _publish(
+                extras, "device_only_devicemon", rate_dm,
+                flops_per_image, peak,
+                suffix=" (device_only + per-step ledger count + "
+                       "monitor sample every 10 steps)",
+            )
+            if rate_dm is not None:
+                _devicemon_overhead_guard(extras, rate_dm, device_only)
+        except Exception as e:  # pragma: no cover - bench must emit JSON
+            _log(f"devicemon overhead bench failed: "
+                 f"{type(e).__name__}: {e}")
+
     # Lifecycle overhead pin (ISSUE 8): the same device_only window
     # with the self-healing layer ATTACHED BUT IDLE — one unarmed
     # lifecycle fault site + the idle-shadow branch per step, plus an
@@ -2762,10 +2941,12 @@ def main() -> None:
         _chaos_integrity(extras)
         _chaos_ingest(extras)
         _chaos_diagnose(extras)
+        _chaos_device(extras)
         extras["chaos_ok"] = bool(
             extras.get("chaos_ok") and extras.get("chaos_integrity_ok")
             and extras.get("chaos_ingest_ok")
             and extras.get("diagnose_ok")
+            and extras.get("device_ok")
         )
 
     # Augmentation stage alone: jnp vs fused pallas kernel on this chip.
@@ -3397,13 +3578,18 @@ def main() -> None:
             for _ in range(n_calls):
                 eng1.probs(imgs)
             dt = time.perf_counter() - t0
-            _publish(
+            rate1 = _publish(
                 extras, "serve_images_per_sec",
                 n_calls * eval_bs / dt / n_dev,
                 serve_flops / eval_bs if serve_flops else None, peak,
                 suffix=f" (k=1 engine, batch {eval_bs}, host-fetched "
                        "probs each call)",
             )
+            if rate1 is not None and serve_flops:
+                # Serving-side MFU at this bucket (ISSUE 19): same
+                # rate/FLOPs/peak triple as the guard, as a fraction.
+                extras[f"serve_mfu_b{eval_bs}"] = round(
+                    rate1 * (serve_flops / eval_bs) / peak, 4)
 
             # k=4 ensemble serving: images THROUGH the whole ensemble
             # per second (each image costs 4 member passes — the guard
@@ -3951,6 +4137,27 @@ def main() -> None:
             })
         except Exception as e:  # pragma: no cover - bench must emit JSON
             _log(f"mesh-scaling bench failed: {type(e).__name__}: {e}")
+
+    # Post-run HBM high-water mark (ISSUE 19): the peak occupancy the
+    # whole bench reached on any local device, as a fraction of that
+    # device's limit — the trend row that catches a memory regression
+    # before it becomes an OOM. Skipped quietly where the backend
+    # exposes no memory_stats (CPU).
+    try:
+        fracs = []
+        for d in jax.local_devices():
+            stats_fn = getattr(d, "memory_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if stats and stats.get("bytes_limit"):
+                fracs.append(
+                    float(stats.get("peak_bytes_in_use", 0))
+                    / float(stats["bytes_limit"])
+                )
+        if fracs:
+            extras["hbm_peak_frac"] = round(max(fracs), 4)
+            _log(f"hbm_peak_frac: {extras['hbm_peak_frac']}")
+    except Exception as e:  # pragma: no cover - bench must emit JSON
+        _log(f"hbm peak sampling failed: {type(e).__name__}: {e}")
 
     extras["device_only"] = round(device_only, 2)
     print(json.dumps({

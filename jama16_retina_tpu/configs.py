@@ -882,6 +882,17 @@ class ObsConfig:
     # Slowest exemplar waterfalls a diagnosis carries (per-request and
     # per-step each) — enough to see the pattern, small enough to read.
     diagnosis_top_k: int = 3
+    # --- Device-utilization plane (ISSUE 19; obs/device.py) ------------
+    # Sample device.memory_stats() + program-ledger MFU/roofline gauges
+    # + the compile ledger on every telemetry flush (the DeviceMonitor
+    # attached to the Snapshotter). Off = the Snapshotter pays exactly
+    # one branch per flush (bench devicemon_overhead_pct pin); compile
+    # sites still record into the process compile ledger either way.
+    device_enabled: bool = True
+    # HBM headroom fraction below which the hbm_pressure reliability
+    # rule fires after 60 sustained seconds (obs/alerts.py reads
+    # device.hbm.headroom_frac). <= 0 disables the rule.
+    device_hbm_headroom_alert: float = 0.1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -152,7 +152,14 @@ def hbm_budget_bytes(max_fraction: float = 0.6,
     import jax
 
     if budget_base_bytes and budget_base_bytes > 0:
-        return int(budget_base_bytes * max_fraction)
+        budget = int(budget_base_bytes * max_fraction)
+        try:
+            from jama16_retina_tpu.obs import device as device_lib
+
+            device_lib.note_hbm_budget(budget)
+        except Exception:  # noqa: BLE001 - accounting only
+            pass
+        return budget
     limit = None
     try:
         stats = jax.local_devices()[0].memory_stats()
@@ -176,7 +183,19 @@ def hbm_budget_bytes(max_fraction: float = 0.6,
                 "memory limit to override",
                 limit // 1024**3,
             )
-    return int(limit * max_fraction)
+    budget = int(limit * max_fraction)
+    # Cross-check seam (ISSUE 19): the device plane publishes this
+    # derived per-chip budget next to MEASURED occupancy
+    # (device.hbm.{derived_budget_bytes,budget_occupancy_frac}) so a
+    # budget the math got wrong shows up as occupancy > 1 in telemetry
+    # instead of as an OOM.
+    try:
+        from jama16_retina_tpu.obs import device as device_lib
+
+        device_lib.note_hbm_budget(budget)
+    except Exception:  # noqa: BLE001 - accounting only
+        pass
+    return budget
 
 
 def fits_in_hbm(

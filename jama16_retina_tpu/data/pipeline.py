@@ -457,15 +457,37 @@ def device_prefetch(
 
         return mesh_lib._rank_sharding(np.ndim(x), sharding)
 
+    # HBM owner ledger (obs/device.py; ISSUE 19): the staged run-ahead
+    # owns queue-depth x batch-bytes of device residency. Per-batch
+    # bytes are measured ONCE (first staged batch — shapes are static);
+    # the per-yield cost is one integer multiply + dict set.
+    from jama16_retina_tpu.obs import device as device_lib
+
+    batch_bytes: "int | None" = None
+
+    def _note_runahead(n_staged: int) -> None:
+        if batch_bytes is not None:
+            device_lib.set_hbm_owner(
+                "staged_runahead", n_staged * batch_bytes
+            )
+
     for batch in it:
         queue.append(put(batch))
+        if batch_bytes is None:
+            try:
+                batch_bytes = device_lib.tree_device_bytes(queue[0])
+            except Exception:  # noqa: BLE001 - accounting only
+                batch_bytes = 0
         depth = size if knobs is None else knobs.prefetch_depth
         # `while`, not `if`: a live depth DECREASE must let the queue
         # drain below the old level (each generator pull then serves
         # from the queue without appending until the new depth holds).
         while len(queue) > depth:
             g_depth.set(len(queue) - 1)
+            _note_runahead(len(queue) - 1)
             yield queue.popleft()
     while queue:
         g_depth.set(len(queue) - 1)
+        _note_runahead(len(queue) - 1)
         yield queue.popleft()
+    device_lib.clear_hbm_owner("staged_runahead")

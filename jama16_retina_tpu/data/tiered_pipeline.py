@@ -185,10 +185,10 @@ def stage_resident(decoder, n_res: int, mesh, process_index=None,
     ids = host_spill_ids(n_res, n_padded, p, P)
     host = decoder.decode_batch(ids)
     sharding = mesh_lib.batch_sharding(mesh)
-    return (
+    return _note_resident_owner((
         jax.make_array_from_process_local_data(sharding, host["image"]),
         jax.make_array_from_process_local_data(sharding, host["grade"]),
-    )
+    ))
 
 
 def _epoch_perm(seed: int, epoch: int, tier: int, n: int) -> np.ndarray:
@@ -240,6 +240,21 @@ class _TierPlan:
         return res, streamed
 
 
+def _note_resident_owner(placed):
+    """Register the pinned resident tier's per-device footprint with
+    the HBM owner ledger (obs/device.py; ISSUE 19) — one measurement at
+    placement, pass-through return."""
+    try:
+        from jama16_retina_tpu.obs import device as device_lib
+
+        device_lib.set_hbm_owner(
+            "tiered_resident", device_lib.tree_device_bytes(placed)
+        )
+    except Exception:  # noqa: BLE001 - accounting only
+        pass
+    return placed
+
+
 def _place_resident(images: np.ndarray, grades: np.ndarray, mesh):
     """Pin the resident tier on device, row-sharded over the data axis
     (hbm_pipeline.make_batch_fn's placement rule: pad dim 0 to the data
@@ -250,7 +265,9 @@ def _place_resident(images: np.ndarray, grades: np.ndarray, mesh):
     from jama16_retina_tpu.parallel import mesh as mesh_lib
 
     if mesh is None:
-        return jax.device_put(images), jax.device_put(grades)
+        return _note_resident_owner(
+            (jax.device_put(images), jax.device_put(grades))
+        )
     d = mesh.shape[mesh_lib._batch_axis(mesh)]
     pad = (-len(images)) % d
     if pad:
@@ -261,7 +278,9 @@ def _place_resident(images: np.ndarray, grades: np.ndarray, mesh):
         images = images[idx]
         grades = grades[idx]
     sh = mesh_lib.batch_sharding(mesh)
-    return jax.device_put(images, sh), jax.device_put(grades, sh)
+    return _note_resident_owner(
+        (jax.device_put(images, sh), jax.device_put(grades, sh))
+    )
 
 
 def _make_combine_fn(res_images, res_grades, res_pb: int, str_pb: int,

@@ -57,6 +57,7 @@ class BatchRing:
         _, self.slot_bytes = protocol.slot_layout(self.batch,
                                                   self.image_size)
         self._owner = bool(create)
+        self._accounted = False
         if create:
             # Short random name: the kernel caps shm names well below
             # path length limits, and collisions must not alias rings.
@@ -66,6 +67,19 @@ class BatchRing:
                 size=self.slot_bytes * self.n_slots,
             )
             _OWNED_NAMES.add(self._shm.name)
+            # Owner ledger (obs/device.py; ISSUE 19): the server's
+            # rings are the ingest plane's big pinned buffers —
+            # add/subtract (not set) because one server owns one ring
+            # PER consumer.
+            self._accounted = True
+            try:
+                from jama16_retina_tpu.obs import device as device_lib
+
+                device_lib.add_hbm_owner(
+                    "ingest_rings", self.slot_bytes * self.n_slots
+                )
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
         else:
             if not name:
                 raise ValueError("attaching a BatchRing needs its name")
@@ -123,6 +137,19 @@ class BatchRing:
             pass
         if self._owner:
             _OWNED_NAMES.discard(self._shm.name)
+            if self._accounted:
+                # Once: close() may run again from __del__/teardown
+                # paths, and a double subtract would under-count rings
+                # still alive.
+                self._accounted = False
+                try:
+                    from jama16_retina_tpu.obs import device as device_lib
+
+                    device_lib.add_hbm_owner(
+                        "ingest_rings", -(self.slot_bytes * self.n_slots)
+                    )
+                except Exception:  # noqa: BLE001 - accounting only
+                    pass
             try:
                 self._shm.unlink()
             except FileNotFoundError:  # pragma: no cover

@@ -294,12 +294,16 @@ class IngestServer:
         # "the decode plane is actually feeding someone".
         self._snap = None
         if cfg.obs.enabled and cfg.obs.http_port > 0:
+            from jama16_retina_tpu.obs import device as device_lib
             from jama16_retina_tpu.obs import export as export_lib
 
             self._snap = export_lib.Snapshotter(
                 self._reg,
                 workdir=os.path.dirname(os.path.abspath(self.socket_path)),
                 every_s=cfg.obs.flush_every_s,
+                # Device plane (ISSUE 19): the ingest role's flushes
+                # carry the ring owner-ledger gauges too.
+                device=device_lib.monitor_for(cfg, registry=self._reg),
             )
             self._snap.serve_http(cfg.obs.http_port)
 

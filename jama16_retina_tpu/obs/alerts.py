@@ -251,6 +251,16 @@ def reliability_rules(cfg) -> list:
     rules.append(AlertRule(
         "rate(integrity.corrupt)", ">", 0.0, reason="artifact_corrupt",
     ))
+    # Device-utilization plane (ISSUE 19): sustained low HBM headroom
+    # on the tightest local device pages BEFORE the allocator OOMs —
+    # the gauge is the DeviceMonitor's worst-device view. Inactive on
+    # backends without memory_stats (the gauge never publishes).
+    headroom = float(getattr(oc, "device_hbm_headroom_alert", 0.0) or 0.0)
+    if headroom > 0:
+        rules.append(AlertRule(
+            "device.hbm.headroom_frac", "<", headroom,
+            for_seconds=60.0, reason="hbm_pressure",
+        ))
     return rules
 
 

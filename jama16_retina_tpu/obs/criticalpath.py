@@ -56,7 +56,10 @@ from __future__ import annotations
 import dataclasses
 
 # Verdict -> stable numeric code for the obs.diagnosis.verdict gauge
-# (alert rules compare numbers; the order is append-only).
+# (alert rules compare numbers; the order is append-only). Codes 6-8
+# are the ISSUE 19 device-plane refinements of ``device_bound``: when
+# ``diagnose(device=...)`` gets a device summary (obs/device.py MFU +
+# roofline gauges), "the device is the bottleneck" splits into WHY.
 VERDICT_CODES = {
     "balanced": 0,
     "device_bound": 1,
@@ -64,6 +67,9 @@ VERDICT_CODES = {
     "credit_starved": 3,
     "h2d_bound": 4,
     "queue_bound": 5,
+    "device_compute_bound": 6,
+    "device_membw_bound": 7,
+    "device_underutilized": 8,
 }
 
 # Category -> the verdict it argues for.
@@ -253,15 +259,46 @@ class DiagnosisVerdict:
     n_events: int
     request_waterfalls: list
     step_waterfalls: list
+    # Device summary (obs/device.summary_from_gauges) that refined a
+    # device_bound verdict into its sub-cause, or None when no device
+    # plane was available (the verdict stays unrefined).
+    device: "dict | None" = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
 
 
-def diagnose(events, top_k: int = 3) -> DiagnosisVerdict:
+def refine_device_verdict(device: "dict | None") -> "str | None":
+    """device summary -> the typed sub-cause of ``device_bound``, or
+    None when the summary cannot commit (no MFU, no roofline class).
+
+    A memory-bandwidth-bound dominant program means more FLOP/s is not
+    on the table regardless of MFU (``device_membw_bound``); a
+    compute-class window at >= ``device.SATURATED_MFU`` is genuinely
+    compute-saturated (``device_compute_bound``); below it the chip is
+    the bottleneck only because each dispatch is too small to fill it —
+    the batch-size MFU cliff (``device_underutilized``)."""
+    if not device:
+        return None
+    if device.get("dominant_class") == "memory":
+        return "device_membw_bound"
+    mfu = device.get("mfu")
+    if mfu is None:
+        return None
+    from jama16_retina_tpu.obs import device as device_lib
+
+    if float(mfu) >= device_lib.SATURATED_MFU:
+        return "device_compute_bound"
+    return "device_underutilized"
+
+
+def diagnose(events, top_k: int = 3,
+             device: "dict | None" = None) -> DiagnosisVerdict:
     """events -> DiagnosisVerdict. Pure; an empty / unattributable
     window diagnoses ``balanced`` at confidence 0.0 rather than
-    guessing."""
+    guessing. ``device`` (obs/device.summary_from_gauges) refines a
+    ``device_bound`` verdict into its typed sub-cause; every other
+    verdict ignores it."""
     totals = attribute(events)
     wall = sum(totals.values())
     evidence = {
@@ -276,6 +313,12 @@ def diagnose(events, top_k: int = 3) -> DiagnosisVerdict:
         verdict = _CATEGORY_VERDICT[best_cat]
     else:
         verdict = "balanced"
+    used_device = None
+    if verdict == "device_bound" and device:
+        sub = refine_device_verdict(device)
+        if sub is not None:
+            verdict = sub
+            used_device = dict(device)
     k = max(0, int(top_k))
     return DiagnosisVerdict(
         verdict=verdict,
@@ -286,4 +329,5 @@ def diagnose(events, top_k: int = 3) -> DiagnosisVerdict:
         n_events=len(_complete_events(events)),
         request_waterfalls=request_waterfalls(events)[:k],
         step_waterfalls=step_waterfalls(events)[:k],
+        device=used_device,
     )

@@ -1,0 +1,697 @@
+"""Device-utilization plane (ISSUE 19): HBM by owner, MFU/roofline, compiles.
+
+Three coupled ledgers turn "the hardware" from a black box into typed,
+fleet-mergeable telemetry, all riding the existing registry/Snapshotter
+stack:
+
+  * **HBM accounting by owner** — :class:`DeviceMonitor` samples
+    ``device.memory_stats()`` per local device on the Snapshotter
+    cadence into ``device.hbm.*`` gauges, and the module-level *owner
+    ledger* lets the known residents (live serving generation, retained
+    rollback generation, tiered resident cache, staged run-ahead,
+    ingest rings) register their measured footprint so obs_report can
+    render HBM-by-owner with the gap shown as *untracked*. The
+    ``hbm_pressure`` reliability rule reads
+    ``device.hbm.headroom_frac``; ``data/hbm_pipeline.py`` notes its
+    derived budget here so budget-vs-occupancy cross-checks as a gauge.
+  * **MFU / roofline attribution** — the *program ledger* is the ONE
+    place a compiled program's cost_analysis is parsed
+    (``physics.program_costs``): the trainer's AOT step and every serve
+    bucket register (flops_per_call, bytes_per_call, signature) and
+    count dispatches with a plain integer increment (``note_call`` —
+    no registry object on the hot path; registries are run-scoped).
+    The monitor turns call deltas x window wall into ``device.mfu``
+    and achieved-bandwidth gauges per program, plus a static roofline
+    classification (compute- vs memory-bound against the chip's ridge
+    point) that refines the PR-18 ``device_bound`` verdict
+    (obs/criticalpath.py) into typed sub-causes.
+  * **Compile ledger** — :func:`compile_timed` wraps every
+    lower/compile site (trainer AOT, engine bucket warm, compile-cache
+    miss, reload/candidate warm, dtype transform) into
+    ``device.compile.{count,sec}`` counters, a per-signature entry
+    table, and a slowest-compile exemplar (the ``sec_hist`` histogram's
+    exemplar window), so a warm restart's "N compiles, S seconds paid,
+    M seconds saved by cache" is auditable in obs_report.
+
+Everything here is host-side and off the request path: the monitor
+runs on the Snapshotter flush cadence, disabled costs exactly one
+branch, and a CPU backend (no ``memory_stats``) silently publishes no
+HBM gauges rather than lying.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+import time
+
+from jama16_retina_tpu.obs import registry as registry_lib
+
+# Headroom fraction below which a process is considered memory-
+# pressured: the reliability rule's default threshold
+# (obs/alerts.reliability_rules, knob obs.device_hbm_headroom_alert)
+# and the fleet heartbeat blame annotation both read this.
+HBM_PRESSURE_HEADROOM = 0.1
+
+# Window MFU at or above which a device_bound verdict refines to
+# compute-saturated; below it (with a compute-bound program mix) the
+# device is underutilized — the small-batch MFU cliff.
+SATURATED_MFU = 0.4
+
+_OWNER_SAFE = re.compile(r"[^A-Za-z0-9_]+")
+
+
+def _safe(name: str) -> str:
+    """Metric-name-safe owner/program token (bounded vocabulary: owners
+    and programs are code-chosen literals, never user input)."""
+    return _OWNER_SAFE.sub("_", str(name)).strip("_") or "unknown"
+
+
+# -- HBM owner ledger ------------------------------------------------------
+#
+# Module-level (like the tracer and fault plan): residents register from
+# wherever they live — the serving engine, the data pipeline, an ingest
+# ring — without threading a monitor handle through every constructor.
+# The monitor publishes whatever is registered at sample time.
+
+_lock = threading.Lock()
+_HBM_OWNERS: "dict[str, float]" = {}
+_HBM_BUDGET: "float | None" = None
+
+
+def set_hbm_owner(owner: str, nbytes: float) -> None:
+    """Register (or update) a resident's per-device HBM footprint."""
+    with _lock:
+        _HBM_OWNERS[_safe(owner)] = float(max(0.0, nbytes))
+
+
+def add_hbm_owner(owner: str, delta: float) -> None:
+    """Adjust an owner's footprint by a delta (multi-instance residents
+    like ingest rings add on create and subtract on close)."""
+    with _lock:
+        key = _safe(owner)
+        _HBM_OWNERS[key] = max(0.0, _HBM_OWNERS.get(key, 0.0) + float(delta))
+
+
+def clear_hbm_owner(owner: str) -> None:
+    with _lock:
+        _HBM_OWNERS.pop(_safe(owner), None)
+
+
+def hbm_owners() -> "dict[str, float]":
+    with _lock:
+        return dict(_HBM_OWNERS)
+
+
+def note_hbm_budget(nbytes: float) -> None:
+    """Record the data plane's DERIVED per-chip HBM budget
+    (data/hbm_pipeline.hbm_budget_bytes) so the monitor can publish the
+    derived-vs-measured cross-check gauges."""
+    global _HBM_BUDGET
+    _HBM_BUDGET = float(nbytes) if nbytes and nbytes > 0 else None
+
+
+def reset_hbm_owners() -> None:
+    """Test isolation: drop every registered owner and the noted budget."""
+    global _HBM_BUDGET
+    with _lock:
+        _HBM_OWNERS.clear()
+    _HBM_BUDGET = None
+
+
+def tree_device_bytes(tree) -> int:
+    """Max per-local-device resident bytes of a pytree of arrays.
+
+    Sharded leaves are charged shard-by-shard to the device actually
+    holding them (``addressable_shards``); replicated leaves charge a
+    full copy to each device; host arrays (or committed single-device
+    trees) fall into one bucket. The max over devices matches the
+    worst-device view the ``device.hbm.*`` gauges report."""
+    import jax
+
+    per_dev: "dict[object, int]" = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                d = getattr(s, "device", None)
+                data = getattr(s, "data", None)
+                n = int(getattr(data, "nbytes", 0) or 0)
+                per_dev[d] = per_dev.get(d, 0) + n
+        elif hasattr(leaf, "nbytes"):
+            per_dev[None] = per_dev.get(None, 0) + int(leaf.nbytes)
+    return max(per_dev.values(), default=0)
+
+
+# -- program ledger (the ONE FLOPs source) ---------------------------------
+
+
+class ProgramEntry:
+    """One compiled program's static costs + a plain-int dispatch count.
+
+    ``note_call`` is the hot-path op: one integer increment, no lock,
+    no registry object — the monitor reads deltas at flush cadence and
+    publishes the registry counters itself (registries are run-scoped;
+    this ledger outlives them)."""
+
+    __slots__ = ("name", "flops", "bytes", "signature", "calls")
+
+    def __init__(self, name: str, flops=None, nbytes=None, signature=""):
+        self.name = name
+        self.flops = flops
+        self.bytes = nbytes
+        self.signature = signature or name
+        self.calls = 0
+
+    def note_call(self, n: int = 1) -> None:
+        self.calls += n
+
+    def intensity(self) -> "float | None":
+        """Arithmetic intensity (flops / byte accessed), or None when
+        cost_analysis gave no usable numbers."""
+        if not self.flops or not self.bytes:
+            return None
+        return float(self.flops) / float(self.bytes)
+
+
+class ProgramLedger:
+    """Registry of every AOT/compiled program's per-call costs."""
+
+    def __init__(self):
+        self._entries: "dict[str, ProgramEntry]" = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, *, compiled=None, flops_per_call=None,
+                 bytes_per_call=None, signature="") -> ProgramEntry:
+        """Get-or-create the entry, refreshing static costs. Pass the
+        compiled executable to have its cost_analysis parsed HERE — the
+        single parse site trainer ceilings and MFU gauges both read."""
+        flops, nbytes = flops_per_call, bytes_per_call
+        if compiled is not None and (flops is None or nbytes is None):
+            from jama16_retina_tpu.utils import physics
+
+            f, b = physics.program_costs(compiled)
+            flops = f if flops is None else flops
+            nbytes = b if nbytes is None else nbytes
+        key = _safe(name)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = self._entries[key] = ProgramEntry(key)
+            if flops is not None:
+                entry.flops = float(flops)
+            if nbytes is not None:
+                entry.bytes = float(nbytes)
+            if signature:
+                entry.signature = signature
+            return entry
+
+    def get(self, name: str) -> "ProgramEntry | None":
+        with self._lock:
+            return self._entries.get(_safe(name))
+
+    def entries(self) -> "list[ProgramEntry]":
+        with self._lock:
+            return list(self._entries.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_PROGRAMS = ProgramLedger()
+
+
+def program_ledger() -> ProgramLedger:
+    """The process program ledger (module-level, like the tracer)."""
+    return _PROGRAMS
+
+
+# -- compile ledger --------------------------------------------------------
+
+
+class CompileLedger:
+    """Per-signature compile counts/seconds + the last-compile clock.
+
+    The registry counters (``device.compile.{count,sec}``) are
+    incremented at record time against the CURRENT default registry (or
+    an explicitly passed one) so run-scoped registries see their own
+    run's compiles; this ledger is the cross-run process view /healthz
+    and obs_report's entry table read."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries: "dict[str, dict]" = {}
+        self.count = 0
+        self.sec = 0.0
+        self.last_t: "float | None" = None
+
+    def record(self, signature: str, sec: float) -> None:
+        with self._lock:
+            e = self.entries.setdefault(
+                signature, {"count": 0, "sec": 0.0, "max_sec": 0.0}
+            )
+            e["count"] += 1
+            e["sec"] += sec
+            e["max_sec"] = max(e["max_sec"], sec)
+            self.count += 1
+            self.sec += sec
+            self.last_t = time.time()
+
+    def last_compile_age_s(self, now: "float | None" = None):
+        with self._lock:
+            if self.last_t is None:
+                return None
+            return (time.time() if now is None else now) - self.last_t
+
+    def snapshot(self) -> dict:
+        """{'count','sec','slowest','entries'} — entries sorted by total
+        seconds descending, slowest = the single worst signature."""
+        with self._lock:
+            rows = [
+                {"signature": sig, **dict(e)}
+                for sig, e in self.entries.items()
+            ]
+        rows.sort(key=lambda r: -r["sec"])
+        slowest = None
+        if rows:
+            worst = max(rows, key=lambda r: r["max_sec"])
+            slowest = {"signature": worst["signature"],
+                       "sec": worst["max_sec"]}
+        return {"count": self.count, "sec": self.sec,
+                "slowest": slowest, "entries": rows}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.entries.clear()
+            self.count = 0
+            self.sec = 0.0
+            self.last_t = None
+
+
+_COMPILES = CompileLedger()
+
+
+def compile_ledger() -> CompileLedger:
+    return _COMPILES
+
+
+def record_compile(signature: str, sec: float, registry=None) -> None:
+    """One compile happened: ledger entry + registry counters + the
+    slowest-compile exemplar (the sec_hist histogram keeps the slowest
+    exemplar-tagged observation per telemetry window)."""
+    _COMPILES.record(signature, sec)
+    reg = registry if registry is not None else registry_lib.default_registry()
+    reg.counter(
+        "device.compile.count",
+        help="XLA lower+compile invocations this process paid "
+             "(trainer AOT, engine bucket warm, cache miss, "
+             "reload/candidate warm, dtype transform)",
+    ).inc()
+    reg.counter(
+        "device.compile.sec",
+        help="total wall seconds spent inside lower+compile sites",
+    ).inc(sec)
+    reg.histogram(
+        "device.compile.sec_hist",
+        help="per-compile wall seconds; the exemplar names the slowest "
+             "compile signature of the telemetry window",
+    ).observe(sec, exemplar=signature)
+
+
+@contextlib.contextmanager
+def compile_timed(signature: str, registry=None):
+    """Wrap ONE lower/compile site. Times the body and records it into
+    the compile ledger + counters even when the compile raises (the
+    seconds were still paid)."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        record_compile(signature, time.monotonic() - t0, registry=registry)
+
+
+def note_compile_saved(sec: float, registry=None) -> None:
+    """A compile-cache hit spared this many seconds (the stored
+    compile_sec of the entry that deserialized instead of recompiling)."""
+    if not sec or sec <= 0:
+        return
+    reg = registry if registry is not None else registry_lib.default_registry()
+    reg.counter(
+        "device.compile.saved_sec",
+        help="compile seconds spared by compile-cache hits (the stored "
+             "cost of each entry that deserialized instead of "
+             "recompiling)",
+    ).inc(float(sec))
+
+
+def reset_for_tests() -> None:
+    """Test isolation: clear every module-level ledger."""
+    reset_hbm_owners()
+    _PROGRAMS.reset()
+    _COMPILES.reset()
+
+
+# -- the monitor -----------------------------------------------------------
+
+
+class DeviceMonitor:
+    """Samples HBM stats + program-ledger deltas into gauges on the
+    Snapshotter cadence (obs/export.py calls ``sample`` first in every
+    flush, so the gauges land in that flush's snapshot).
+
+    ``devices``/``ledger``/``peak_flops_per_s``/``peak_bw_bytes_per_s``/
+    ``clock`` are injectable for tests and bench drills; production
+    wiring (``monitor_for``) uses real local devices, the process
+    ledgers, and the physics tables. Disabled (or constructed with
+    ``enabled=False``) costs exactly one branch per flush."""
+
+    def __init__(self, registry=None, *, enabled: bool = True,
+                 devices=None, ledger: "ProgramLedger | None" = None,
+                 peak_flops_per_s: "float | None" = None,
+                 peak_bw_bytes_per_s: "float | None" = None,
+                 clock=time.monotonic):
+        self.enabled = bool(enabled)
+        self._registry = registry
+        self._devices = devices
+        self._ledger = ledger
+        self._peak_flops = peak_flops_per_s
+        self._peak_bw = peak_bw_bytes_per_s
+        self._clock = clock
+        self._prev_calls: "dict[str, int]" = {}
+        self._prev_t: "float | None" = None
+        self._roofline_published: "set[str]" = set()
+        self._compile_count_written = 0
+
+    # -- lazy production defaults (no jax import at construction) ------
+
+    def _reg(self):
+        if self._registry is None:
+            self._registry = registry_lib.default_registry()
+        return self._registry
+
+    def _local_devices(self):
+        if self._devices is None:
+            import jax
+
+            self._devices = list(jax.local_devices())
+        return self._devices
+
+    def _peaks(self):
+        if self._peak_flops is None or self._peak_bw is None:
+            from jama16_retina_tpu.utils import physics
+
+            if self._peak_flops is None:
+                self._peak_flops = physics.peak_flops()
+            if self._peak_bw is None:
+                self._peak_bw = physics.peak_hbm_bytes_per_sec()
+        return self._peak_flops, self._peak_bw
+
+    # -- sampling ------------------------------------------------------
+
+    def sample(self, runlog=None) -> "dict | None":
+        """One monitor tick: HBM gauges, owner gauges, MFU/bandwidth/
+        roofline gauges from program-ledger deltas, and a
+        ``compile_ledger`` runlog record when new compiles landed since
+        the last tick. Returns the published values (tests read it) or
+        None when disabled."""
+        if not self.enabled:
+            return None
+        out: dict = {}
+        try:
+            self._sample_hbm(out)
+        except Exception:  # noqa: BLE001 - telemetry must not kill a flush
+            pass
+        try:
+            self._sample_programs(out)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._write_compile_record(runlog)
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    def _sample_hbm(self, out: dict) -> None:
+        reg = self._reg()
+        in_use = peak = limit = None
+        headroom = None
+        for dev in self._local_devices():
+            ms = getattr(dev, "memory_stats", None)
+            if not callable(ms):
+                continue
+            try:
+                stats = ms() or {}
+            except Exception:  # noqa: BLE001 - backend without stats
+                continue
+            b_use = stats.get("bytes_in_use")
+            b_lim = stats.get("bytes_limit")
+            b_peak = stats.get("peak_bytes_in_use", b_use)
+            if b_use is None:
+                continue
+            in_use = max(in_use or 0, int(b_use))
+            if b_peak is not None:
+                peak = max(peak or 0, int(b_peak))
+            if b_lim:
+                limit = int(b_lim) if limit is None else min(limit, int(b_lim))
+                h = (int(b_lim) - int(b_use)) / float(b_lim)
+                headroom = h if headroom is None else min(headroom, h)
+        if in_use is not None:
+            reg.gauge(
+                "device.hbm.bytes_in_use",
+                help="HBM bytes in use on the most-loaded local device "
+                     "[fleet:max]",
+            ).set(float(in_use))
+            out["bytes_in_use"] = in_use
+        if peak is not None:
+            reg.gauge(
+                "device.hbm.peak_bytes",
+                help="peak HBM bytes in use on the worst local device "
+                     "since process start [fleet:max]",
+            ).set(float(peak))
+            out["peak_bytes"] = peak
+        if limit is not None:
+            reg.gauge(
+                "device.hbm.bytes_limit",
+                help="per-device HBM capacity the runtime reports "
+                     "(smallest local device) [fleet:min]",
+            ).set(float(limit))
+            out["bytes_limit"] = limit
+        if headroom is not None:
+            reg.gauge(
+                "device.hbm.headroom_frac",
+                help="free HBM fraction on the tightest local device; "
+                     "the hbm_pressure reliability rule reads this "
+                     "[fleet:min]",
+            ).set(round(headroom, 6))
+            out["headroom_frac"] = headroom
+        owners = hbm_owners()
+        for name, nbytes in owners.items():
+            reg.gauge(
+                f"device.hbm.owner.{name}",
+                help="per-device HBM bytes this resident registered "
+                     "(owner ledger; the obs_report HBM-by-owner table) "
+                     "[fleet:max]",
+            ).set(float(nbytes))
+        if owners:
+            out["owners"] = owners
+        if in_use is not None:
+            untracked = max(0.0, float(in_use) - sum(owners.values()))
+            reg.gauge(
+                "device.hbm.untracked_bytes",
+                help="bytes_in_use minus every registered owner "
+                     "footprint — residency nothing claimed (clamped "
+                     "at 0) [fleet:max]",
+            ).set(untracked)
+            out["untracked_bytes"] = untracked
+        if _HBM_BUDGET is not None:
+            reg.gauge(
+                "device.hbm.derived_budget_bytes",
+                help="the data plane's DERIVED per-chip HBM budget "
+                     "(data/hbm_pipeline) — cross-check against "
+                     "measured occupancy [fleet:min]",
+            ).set(float(_HBM_BUDGET))
+            out["derived_budget_bytes"] = _HBM_BUDGET
+            if in_use is not None:
+                occ = float(in_use) / float(_HBM_BUDGET)
+                reg.gauge(
+                    "device.hbm.budget_occupancy_frac",
+                    help="measured bytes_in_use over the derived data-"
+                         "plane budget; >1 means the budget math "
+                         "underestimates real residency [fleet:max]",
+                ).set(round(occ, 6))
+                out["budget_occupancy_frac"] = occ
+
+    def _sample_programs(self, out: dict) -> None:
+        ledger = self._ledger if self._ledger is not None else _PROGRAMS
+        entries = ledger.entries()
+        if not entries:
+            return
+        reg = self._reg()
+        peak_flops, peak_bw = self._peaks()
+        ridge = (peak_flops / peak_bw) if peak_bw else None
+        now = self._clock()
+        prev_t, self._prev_t = self._prev_t, now
+        calls_now = {e.name: e.calls for e in entries}
+        prev_calls, self._prev_calls = self._prev_calls, calls_now
+        # Static roofline class: publish once per program, on first
+        # sight (the classification depends only on the program and the
+        # chip, not the window).
+        for e in entries:
+            if e.name in self._roofline_published:
+                continue
+            inten = e.intensity()
+            if inten is None or ridge is None:
+                continue
+            cls = 1.0 if inten >= ridge else 2.0
+            reg.gauge(
+                f"device.roofline.{e.name}",
+                help="roofline class of this program on this chip: "
+                     "1 compute-bound (intensity >= ridge point), "
+                     "2 memory-bandwidth-bound",
+            ).set(cls)
+            self._roofline_published.add(e.name)
+            out.setdefault("roofline", {})[e.name] = cls
+        if prev_t is None:
+            return  # first tick: baseline only, no window yet
+        dt = now - prev_t
+        if dt <= 0:
+            return
+        import jax
+
+        try:
+            n_dev = max(1, jax.local_device_count())
+        except Exception:  # noqa: BLE001 - jax not initialized
+            n_dev = 1
+        total_flops = 0.0
+        total_bytes = 0.0
+        window_flops: "dict[str, float]" = {}
+        for e in entries:
+            delta = e.calls - prev_calls.get(e.name, 0)
+            if delta <= 0:
+                continue
+            reg.counter(
+                f"device.program.calls.{e.name}",
+                help="dispatches of this compiled program (program "
+                     "ledger; counted at flush from hot-path integer "
+                     "deltas)",
+            ).inc(delta)
+            if e.flops:
+                pf = delta * float(e.flops)
+                total_flops += pf
+                window_flops[e.name] = pf
+                # cost_analysis FLOPs may be whole-program across
+                # devices; dividing by local chips keeps MFU
+                # conservative (never flattering) — same ambiguity
+                # note as physics.rate_ceiling, opposite direction.
+                mfu = pf / (dt * peak_flops * n_dev)
+                reg.gauge(
+                    f"device.mfu.{e.name}",
+                    help="window model-FLOPs utilization of this "
+                         "program: dispatches x flops_per_call over "
+                         "wall x peak x local chips [fleet:mean]",
+                ).set(round(mfu, 6))
+                out.setdefault("mfu_by_program", {})[e.name] = mfu
+            if e.bytes:
+                bw = delta * float(e.bytes) / dt
+                total_bytes += delta * float(e.bytes)
+                reg.gauge(
+                    f"device.bw_gbps.{e.name}",
+                    help="achieved HBM bandwidth of this program over "
+                         "the window (GB/s, cost_analysis bytes "
+                         "accessed x dispatches / wall) [fleet:mean]",
+                ).set(round(bw / 1e9, 3))
+        if total_flops > 0:
+            mfu = total_flops / (dt * peak_flops * n_dev)
+            reg.gauge(
+                "device.mfu",
+                help="window model-FLOPs utilization across every "
+                     "ledgered program [fleet:mean]",
+            ).set(round(mfu, 6))
+            out["mfu"] = mfu
+        if total_bytes > 0 and peak_bw:
+            bw_frac = total_bytes / (dt * peak_bw * n_dev)
+            reg.gauge(
+                "device.bw_frac",
+                help="achieved fraction of peak HBM bandwidth across "
+                     "every ledgered program over the window "
+                     "[fleet:mean]",
+            ).set(round(bw_frac, 6))
+            out["bw_frac"] = bw_frac
+        if window_flops and ridge is not None:
+            dominant = max(window_flops, key=window_flops.get)
+            e = ledger.get(dominant)
+            inten = e.intensity() if e is not None else None
+            if inten is not None:
+                cls = 1.0 if inten >= ridge else 2.0
+                reg.gauge(
+                    "device.roofline.dominant_class",
+                    help="roofline class of the program carrying the "
+                         "most window FLOPs: 0 none, 1 compute-bound, "
+                         "2 memory-bandwidth-bound",
+                ).set(cls)
+                out["dominant_class"] = cls
+
+    def _write_compile_record(self, runlog) -> None:
+        if runlog is None:
+            return
+        snap = _COMPILES.snapshot()
+        if snap["count"] == self._compile_count_written:
+            return
+        self._compile_count_written = snap["count"]
+        runlog.write(
+            "compile_ledger",
+            count=snap["count"],
+            sec=round(snap["sec"], 3),
+            slowest=snap["slowest"],
+            entries=[
+                {"signature": r["signature"], "count": r["count"],
+                 "sec": round(r["sec"], 3),
+                 "max_sec": round(r["max_sec"], 3)}
+                for r in snap["entries"][:12]
+            ],
+        )
+
+
+def monitor_for(cfg, registry=None) -> "DeviceMonitor | None":
+    """The monitor a telemetry wiring site attaches to its Snapshotter,
+    or None when obs (or the device plane) is off — the Snapshotter
+    then pays one ``is None`` branch per flush."""
+    oc = getattr(cfg, "obs", None)
+    if oc is None or not oc.enabled:
+        return None
+    if not getattr(oc, "device_enabled", True):
+        return None
+    return DeviceMonitor(registry=registry)
+
+
+# -- verdict-refinement summary -------------------------------------------
+
+
+def summary_from_gauges(gauges: "dict | None") -> "dict | None":
+    """Distill a registry/telemetry gauge map into the device summary
+    ``criticalpath.diagnose(device=...)`` refines device_bound with.
+    Returns None when the device plane published nothing (diagnosis
+    then keeps the unrefined verdict)."""
+    if not gauges:
+        return None
+    mfu = gauges.get("device.mfu")
+    dom = gauges.get("device.roofline.dominant_class")
+    if mfu is None and dom is None:
+        return None
+    cls = {1.0: "compute", 2.0: "memory"}.get(
+        float(dom) if dom is not None else None
+    )
+    programs = {
+        k[len("device.mfu."):]: v
+        for k, v in gauges.items()
+        if k.startswith("device.mfu.")
+    }
+    return {
+        "mfu": float(mfu) if mfu is not None else None,
+        "dominant_class": cls,
+        "bw_frac": gauges.get("device.bw_frac"),
+        "hbm_headroom_frac": gauges.get("device.hbm.headroom_frac"),
+        "programs": programs,
+    }

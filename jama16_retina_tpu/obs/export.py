@@ -142,6 +142,7 @@ class Snapshotter:
         prom_name: str = "telemetry.prom",
         alerts=None,
         fleet=None,
+        device=None,
     ):
         if not workdir and runlog is None:
             raise ValueError("Snapshotter needs a workdir and/or a runlog")
@@ -171,6 +172,12 @@ class Snapshotter:
         # shared fleet dir. None = one branch per flush (the bench
         # fleet_overhead_pct contract).
         self._fleet = fleet
+        # Device-utilization monitor (obs/device.py; ISSUE 19): sampled
+        # FIRST in every flush so the HBM/MFU/compile gauges land in
+        # the snapshot that flush exports. None = one branch per flush
+        # (the bench devicemon_overhead_pct contract). Assignable after
+        # construction, like ``alerts``.
+        self.device = device
         self._http = None
         self._last_flush = time.time()
         self._step: "int | None" = None
@@ -203,6 +210,11 @@ class Snapshotter:
         Returns the raw snapshot (tests read it). The flush is the ONE
         consumer that closes histogram exemplar windows — scrapes and
         dumps read without consuming."""
+        if self.device is not None:
+            try:
+                self.device.sample(runlog=self._log)
+            except Exception:  # noqa: BLE001 - telemetry must not kill a flush
+                pass
         snap = self._registry.snapshot(reset_exemplars=True)
         self._log.write(
             "telemetry",

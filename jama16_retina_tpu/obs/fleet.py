@@ -537,29 +537,57 @@ def check_fleet_heartbeats(fleet_dir: str, max_age_s: float,
     sick process (role + pid) and stays quiet about the healthy
     remainder — 2 no segments at all (blind)."""
     now = time.time() if now is None else now
-    _merged, meta = fleet_snapshot(fleet_dir)
+    fleet = read_fleet(fleet_dir)
+    _merged, meta = fleet_snapshot(fleet_dir, now=now, fleet=fleet)
     procs = {k: m for k, m in meta.items() if m.get("segments")}
     if not procs:
         return 2, f"no fleet segments under {fleet_dir}"
+    # Memory-pressure blame (ISSUE 19): each process's newest published
+    # device.hbm.headroom_frac gauge, read from the SAME segments the
+    # freshness verdict uses — a stale-or-wedged process that is also
+    # out of HBM gets named as memory-pressured (the usual reason an
+    # allocator-thrashing process stops heartbeating).
+    headroom: "dict[str, float]" = {}
+    for (role, pid), proc in fleet.items():
+        if not proc.get("segments"):
+            continue
+        gauges = (proc["segments"][-1].get("snapshot") or {}).get(
+            "gauges", {}
+        )
+        h = gauges.get("device.hbm.headroom_frac")
+        if h is not None:
+            headroom[f"{role}-p{pid}"] = float(h)
+
+    def _pressure(key: str) -> str:
+        from jama16_retina_tpu.obs import device as device_lib
+
+        h = headroom.get(key)
+        if h is not None and h < device_lib.HBM_PRESSURE_HEADROOM:
+            return f" [HBM headroom {h:.1%} — memory-pressured]"
+        return ""
+
     stale = []
     for key, m in sorted(procs.items()):
         age = now - float(m.get("t") or 0.0)
         if age > max_age_s:
             stale.append(
-                f"{key}: last segment {age:.0f}s old (> {max_age_s:.0f}s)"
+                f"{key}: last segment {age:.0f}s old "
+                f"(> {max_age_s:.0f}s){_pressure(key)}"
             )
             continue
         prog = (m.get("heartbeat") or {}).get("last_progress_t")
         if prog and now - float(prog) > max_age_s:
             stale.append(
                 f"{key}: segments fresh but no step progress for "
-                f"{now - float(prog):.0f}s (> {max_age_s:.0f}s) — wedged?"
+                f"{now - float(prog):.0f}s (> {max_age_s:.0f}s) — "
+                f"wedged?{_pressure(key)}"
             )
     if stale:
         return 1, "\n".join(stale)
     return 0, "\n".join(
         f"{key}: ok (step {(m.get('heartbeat') or {}).get('step')}, "
         f"segment {now - float(m.get('t') or 0.0):.0f}s old)"
+        f"{_pressure(key)}"
         for key, m in sorted(procs.items())
     )
 

@@ -303,16 +303,30 @@ class FlightRecorder:
         carries them."""
         try:
             from jama16_retina_tpu.obs import criticalpath
+            from jama16_retina_tpu.obs import device as device_lib
 
+            # Device-plane refinement (ISSUE 19): when the monitor has
+            # published MFU/roofline gauges, a device_bound verdict
+            # splits into its typed sub-cause. Reading the registry's
+            # latest gauges is exactly the summary obs_report builds
+            # from the telemetry record of the same window.
+            device = None
+            try:
+                device = device_lib.summary_from_gauges(
+                    self._registry.snapshot()["gauges"]
+                )
+            except Exception:  # noqa: BLE001 - refinement is optional
+                pass
             verdict = criticalpath.diagnose(
-                events, top_k=self.diagnosis_top_k
+                events, top_k=self.diagnosis_top_k, device=device
             )
             self._registry.gauge(
                 "obs.diagnosis.verdict",
                 help="latest dump-time critical-path verdict as its "
                      "stable numeric code (criticalpath.VERDICT_CODES: "
                      "0 balanced, 1 device, 2 decode, 3 credit, 4 h2d, "
-                     "5 queue)",
+                     "5 queue, 6 device-compute, 7 device-membw, "
+                     "8 device-underutilized)",
             ).set(verdict.code)
             self._registry.gauge(
                 "obs.diagnosis.confidence",

@@ -106,12 +106,14 @@ class ObsHttp:
         now = time.time() if now is None else now
         snap = self._snapshotter
         if snap is None or snap._last_progress_t is None:
-            return 2, {"detail": "no heartbeat recorded"}
+            return 2, {"detail": "no heartbeat recorded",
+                       **self._device_fields()}
         age = now - snap._last_progress_t
         detail = {
             "step": snap._step,
             "progress_age_s": round(age, 1),
             "max_age_s": max_age,
+            **self._device_fields(),
         }
         if age > max_age:
             detail["detail"] = (
@@ -120,6 +122,29 @@ class ObsHttp:
             )
             return 1, detail
         return 0, detail
+
+    def _device_fields(self) -> dict:
+        """Device-plane probe fields (ISSUE 19): the last-sampled HBM
+        headroom gauge plus the process compile ledger's last-compile
+        age, so a fleet prober can blame a memory-pressured (or
+        recompile-storming) process without parsing /metrics. Both are
+        None when the device plane never published."""
+        from jama16_retina_tpu.obs import device as device_lib
+
+        headroom = None
+        try:
+            headroom = self._registry.snapshot()["gauges"].get(
+                "device.hbm.headroom_frac"
+            )
+        except Exception:  # noqa: BLE001 - a probe must not raise
+            pass
+        age = device_lib.compile_ledger().last_compile_age_s()
+        return {
+            "hbm_headroom_frac": headroom,
+            "last_compile_age_s": (
+                round(age, 1) if age is not None else None
+            ),
+        }
 
     def close(self) -> None:
         self._server.shutdown()

@@ -241,7 +241,13 @@ class CompileCache:
             from jax.experimental import serialize_executable
 
             with open(path, "rb") as f:
-                payload, in_tree, out_tree = pickle.load(f)
+                entry = pickle.load(f)
+            # Entries are (payload, in_tree, out_tree[, meta]): the
+            # optional meta dict (ISSUE 19) carries the compile seconds
+            # the original miss paid, so a hit can count what it saved
+            # (device.compile.saved_sec). Pre-meta 3-tuples still load.
+            meta = entry[3] if len(entry) > 3 else {}
+            payload, in_tree, out_tree = entry[0], entry[1], entry[2]
             fn = serialize_executable.deserialize_and_load(
                 payload, in_tree, out_tree
             )
@@ -253,12 +259,21 @@ class CompileCache:
             self.c_misses.inc()
             return None
         self.c_hits.inc()
+        saved = float(meta.get("compile_sec", 0.0) or 0.0)
+        if saved > 0:
+            from jama16_retina_tpu.obs import device as device_lib
+
+            device_lib.note_compile_saved(saved, registry=self._reg)
         return fn
 
-    def save(self, key: str, compiled) -> bool:
+    def save(self, key: str, compiled,
+             compile_sec: "float | None" = None) -> bool:
         """Serialize one freshly compiled executable; failures are
         logged and swallowed (the engine keeps its in-memory
-        executable — it just stays cold across restarts)."""
+        executable — it just stays cold across restarts).
+        ``compile_sec`` — the measured seconds the compile cost — is
+        stored in the entry's meta so a future hit can count the
+        seconds it spared."""
         try:
             from jax.experimental import serialize_executable
 
@@ -266,7 +281,10 @@ class CompileCache:
                 compiled
             )
             path = self.entry_path(key)
-            blob = pickle.dumps((payload, in_tree, out_tree))
+            meta = {}
+            if compile_sec is not None and compile_sec > 0:
+                meta["compile_sec"] = round(float(compile_sec), 3)
+            blob = pickle.dumps((payload, in_tree, out_tree, meta))
             _atomic_write_bytes(path, blob)
             artifact_lib.write_seal_sidecar(
                 path, schema="compile_cache.entry",

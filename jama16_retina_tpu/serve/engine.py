@@ -40,6 +40,7 @@ from jama16_retina_tpu import models, train_lib
 from jama16_retina_tpu.configs import ExperimentConfig, ServeConfig
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
+from jama16_retina_tpu.obs import device as device_lib
 from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import quality as quality_lib
 from jama16_retina_tpu.obs import registry as obs_registry
@@ -375,6 +376,9 @@ class ServingEngine:
         # after a full restore.
         self._compiled: dict = {}
         self._compiled_k: "int | None" = None
+        # Program-ledger entries per bucket (obs/device.py; ISSUE 19):
+        # dispatch counting is one dict lookup + integer increment.
+        self._prog_entries: dict = {}
         self._cache = (
             compilecache.CompileCache(
                 cfg.serve.compile_cache_dir,
@@ -404,6 +408,7 @@ class ServingEngine:
         if self._cache is not None:
             self._warm_from_cache(self._gen)
         self._dtype_construction_gate()
+        self._note_residency()
 
     # -- generations (ISSUE 6 hot swap) -----------------------------------
 
@@ -441,6 +446,29 @@ class ServingEngine:
                  "attribution: the per-generation ledger)",
         )
 
+    def _note_residency(self) -> None:
+        """Refresh the HBM owner ledger (obs/device.py; ISSUE 19) after
+        any generation mutation: the live stacked state under
+        ``serve_live``, the retained rollback generation under
+        ``serve_retained`` (cleared when nothing is retained). Off the
+        request path — callers are construction/reload/rollback/release
+        sites — and best-effort: residency accounting must never fail a
+        swap."""
+        try:
+            device_lib.set_hbm_owner(
+                "serve_live", device_lib.tree_device_bytes(self._gen.state)
+            )
+            prev = self._prev_gen
+            if prev is not None:
+                device_lib.set_hbm_owner(
+                    "serve_retained",
+                    device_lib.tree_device_bytes(prev.state),
+                )
+            else:
+                device_lib.clear_hbm_owner("serve_retained")
+        except Exception:  # noqa: BLE001 - accounting only
+            pass
+
     def _build_generation(self, gen_id: int, member_dirs=None,
                           state: "train_lib.TrainState | None" = None,
                           warm: bool = False) -> _Generation:
@@ -467,7 +495,14 @@ class ServingEngine:
         # fp32 = identity, bf16 = cast, int8 = Q8Leaf quantization.
         # Idempotent, so a candidate state that already went through a
         # generation build (begin_shadow -> promote) is untouched.
-        state = quantize.state_for_dtype(state, self.dtype)
+        # Non-fp32 transforms jit-compile cast/quantize programs —
+        # a compile-ledger site (ISSUE 19); fp32 pays nothing.
+        if self.dtype != "fp32":
+            with device_lib.compile_timed(f"serve_dtype_{self.dtype}",
+                                          registry=self.registry):
+                state = quantize.state_for_dtype(state, self.dtype)
+        else:
+            state = quantize.state_for_dtype(state, self.dtype)
         n_members = int(state.step.shape[0])
         if mesh_lib.has_member_axis(self.mesh):
             # Member-sharded serving (ISSUE 14): the stacked tree
@@ -509,9 +544,16 @@ class ServingEngine:
             size = self.cfg.model.image_size
             for b in self.buckets:
                 zeros = np.zeros((b, size, size, 3), np.uint8)
-                jax.device_get(self._dispatch_fn(b, gen)(
-                    gen.state, {"image": self._place(zeros)}
-                ))
+                # Compile-ledger site (ISSUE 19): a candidate warm that
+                # actually compiles (no shared jit cache entry, no AOT
+                # executable) shows up as real seconds under this
+                # signature; a cache-shared warm records ~0 s — the
+                # honest "this warm was free" entry.
+                with device_lib.compile_timed(f"serve_warm_b{b}",
+                                              registry=self.registry):
+                    jax.device_get(self._dispatch_fn(b, gen)(
+                        gen.state, {"image": self._place(zeros)}
+                    ))
         return gen
 
     def _dispatch_fn(self, bucket: int, gen: "_Generation"):
@@ -567,14 +609,29 @@ class ServingEngine:
                     self._cache.c_misses.inc()
                     fn = None
             if fn is None:
+                t_c = time.monotonic()
                 fn = self._step.lower(
                     gen.state, {"image": placed}
                 ).compile()
-                self._cache.save(key, fn)
+                compile_sec = time.monotonic() - t_c
+                # Compile-ledger site (ISSUE 19): the cache-miss
+                # compile, with its measured seconds stored INTO the
+                # cache entry so a later hit can count what it saved.
+                device_lib.record_compile(
+                    f"serve_b{b}", compile_sec, registry=self.registry
+                )
+                self._cache.save(key, fn, compile_sec=compile_sec)
                 # Fresh-compile proof-run: a failure HERE is a real
                 # engine/model error and must propagate.
                 jax.device_get(fn(gen.state, {"image": placed}))
             self._compiled[b] = fn
+            # Program ledger (ISSUE 19): per-bucket MFU/roofline
+            # attribution — cost_analysis may be unavailable on a
+            # deserialized executable (entry costs stay None; the
+            # gauges just skip it).
+            self._prog_entries[b] = device_lib.program_ledger().register(
+                f"serve_b{b}", compiled=fn
+            )
         self._compiled_k = gen.n_members
         self._cache.g_load_sec.set(load_sec)
         self._g_warmup_sec.set(time.monotonic() - t0)
@@ -668,6 +725,7 @@ class ServingEngine:
         paying 2x HBM until the window expires buys nothing."""
         with self._reload_lock:
             self._prev_gen = None
+            self._note_residency()
 
     def _reload_locked(self, member_dirs, state) -> dict:
         cur = self._gen
@@ -748,6 +806,7 @@ class ServingEngine:
         self._gen = gen
         self._c_reloads.inc()
         self._g_generation.set(new_id)
+        self._note_residency()
         absl_logging.info(
             "serving generation %d live (%d members)", new_id,
             gen.n_members,
@@ -797,6 +856,7 @@ class ServingEngine:
             self._gen = gen
             self._c_rollbacks.inc()
             self._g_generation.set(new_id)
+            self._note_residency()
             absl_logging.warning(
                 "ROLLBACK: generation %d live again as generation %d "
                 "(was serving %d)", prev.gen_id, new_id, cur.gen_id,
@@ -996,6 +1056,9 @@ class ServingEngine:
                 dev = self._dispatch_fn(bucket, gen)(
                     gen.state, {"image": self._place(padded)}
                 )
+            prog = self._prog_entries.get(bucket)
+            if prog is not None:
+                prog.note_call()
             pending.append((dev, chunk.shape[0]))
             self._g_in_flight.set(len(pending))
             if len(pending) > max_in_flight:
@@ -1108,6 +1171,10 @@ class ServingEngine:
             alerts=alerts,
             fleet=obs_fleet.bus_for(self.cfg, "server",
                                     registry=self.registry),
+            # Device-utilization plane (ISSUE 19): same flush cadence
+            # as the trainer's wiring site; None = one branch.
+            device=device_lib.monitor_for(self.cfg,
+                                          registry=self.registry),
         )
         if self.cfg.obs.http_port > 0:
             snap.serve_http(self.cfg.obs.http_port)

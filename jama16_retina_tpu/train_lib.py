@@ -641,7 +641,9 @@ def make_train_step(
     )
 
 
-def aot_compile_step(step_fn, *args) -> "tuple[Callable, float | None]":
+def aot_compile_step(step_fn, *args,
+                     program: str = "train_step",
+                     ) -> "tuple[Callable, float | None]":
     """AOT-compile a jitted step at these exact args; returns
     ``(callable, flops_per_call | None)``.
 
@@ -653,15 +655,22 @@ def aot_compile_step(step_fn, *args) -> "tuple[Callable, float | None]":
     give the throughput clock its physics ceiling, the same guard
     bench.py applies to every published rate (utils/physics.py).
 
+    The compile is timed into the device plane's compile ledger and the
+    program registers in the program ledger under ``program`` (ISSUE
+    19): the ledger entry is the ONE cost_analysis parse the trainer's
+    physics ceiling AND the MFU gauges both read — the returned FLOPs
+    are exactly ``entry.flops``, so the two can never disagree.
+
     Any failure falls back to the jit dispatch path with FLOPs unknown
     (the clock then publishes unguarded, exactly round-3 behavior).
     Shapes are static by design, so later calls can never miss the
     compiled signature.
     """
-    from jama16_retina_tpu.utils import physics
+    from jama16_retina_tpu.obs import device as device_lib
 
     try:
-        compiled = step_fn.lower(*args).compile()
+        with device_lib.compile_timed(program):
+            compiled = step_fn.lower(*args).compile()
     except Exception as e:  # pragma: no cover - environment-dependent
         import logging
 
@@ -669,11 +678,12 @@ def aot_compile_step(step_fn, *args) -> "tuple[Callable, float | None]":
             "AOT compile unavailable (%s: %s); falling back to jit "
             "dispatch, throughput clock unguarded", type(e).__name__, e)
         return step_fn, None
-    # flops_from_cost_analysis swallows cost_analysis failures: they
-    # must not discard the finished executable — re-dispatching through
-    # jit would compile the whole step a second time (~40-80 s for the
-    # flagship without a persistent cache).
-    return compiled, physics.flops_from_cost_analysis(compiled)
+    # register swallows cost_analysis failures internally (entry costs
+    # just stay None): they must not discard the finished executable —
+    # re-dispatching through jit would compile the whole step a second
+    # time (~40-80 s for the flagship without a persistent cache).
+    entry = device_lib.program_ledger().register(program, compiled=compiled)
+    return compiled, entry.flops
 
 
 def make_pmap_train_step(cfg: ExperimentConfig, model, tx, axis: str = "data"):

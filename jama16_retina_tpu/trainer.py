@@ -24,6 +24,7 @@ from jama16_retina_tpu.data import augment as augment_lib
 from jama16_retina_tpu.data import pipeline
 from jama16_retina_tpu.eval import metrics
 from jama16_retina_tpu.obs import alerts as obs_alerts
+from jama16_retina_tpu.obs import device as obs_device
 from jama16_retina_tpu.obs import export as obs_export
 from jama16_retina_tpu.obs import faultinject
 from jama16_retina_tpu.obs import flightrec as obs_flightrec
@@ -100,6 +101,10 @@ def _telemetry_for(cfg: ExperimentConfig, log: RunLog, workdir: str,
             reg, workdir, runlog=log, every_s=cfg.obs.flush_every_s,
             alerts=alerts, fleet=obs_fleet.bus_for(cfg, "trainer",
                                                    registry=reg),
+            # Device-utilization plane (ISSUE 19): HBM/MFU/compile
+            # gauges sampled on the same flush cadence; None when
+            # obs.device_enabled is off (one branch per flush).
+            device=obs_device.monitor_for(cfg, registry=reg),
         )
         if cfg.obs.http_port > 0:
             snap.serve_http(cfg.obs.http_port)
@@ -831,10 +836,25 @@ def _aot_with_ceiling(cfg, mesh, clock, log, start_step, step_fn, *args):
         # subtract the wrong thing. Record the fallback, publish no
         # number (the bench's refuse-don't-guess discipline).
         log.write("compile", step=start_step, sec=None, aot_fallback=True)
+    # step_flops IS the program-ledger entry's flops (the one
+    # cost_analysis parse; train_lib.aot_compile_step registered it):
+    # the physics ceiling here and the device plane's MFU gauges read
+    # the same number by construction.
     clock.set_ceiling(physics.rate_ceiling(
         step_flops, cfg.data.batch_size,
         int(np.prod(list(mesh.shape.values()))),
     ))
+    entry = obs_device.program_ledger().get("train_step")
+    if compiled is not step_fn and entry is not None:
+        # Count dispatches for the MFU window: one plain-int increment
+        # per step (the devicemon overhead pin's hot-path budget).
+        inner = compiled
+
+        def counted_step(*a, **kw):
+            entry.note_call()
+            return inner(*a, **kw)
+
+        return counted_step
     return compiled
 
 

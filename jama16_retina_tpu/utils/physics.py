@@ -24,6 +24,18 @@ PEAK_BF16_TFLOPS = (
 )
 DEFAULT_PEAK_TFLOPS = 2000.0
 
+# Per-chip peak HBM bandwidth (GB/s) by the same device-kind substrings
+# (public Cloud TPU specs). Feeds the device plane's roofline ridge
+# point and achieved-bandwidth fractions (obs/device.py); same
+# guard-direction discipline — unknown kinds get a generous default so
+# bandwidth fractions read low, never impossibly high.
+PEAK_HBM_GBPS = (
+    ("v5 lite", 819.0), ("v5e", 819.0), ("v5p", 2765.0),
+    ("v6", 1640.0), ("trillium", 1640.0),
+    ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
+)
+DEFAULT_PEAK_HBM_GBPS = 5000.0
+
 
 def peak_flops(log=None) -> float:
     """Peak dense bf16 FLOP/s of one local device (chip peak)."""
@@ -39,21 +51,45 @@ def peak_flops(log=None) -> float:
     return DEFAULT_PEAK_TFLOPS * 1e12
 
 
-def flops_from_cost_analysis(compiled) -> "float | None":
-    """Total FLOPs of a compiled XLA program per cost_analysis, or None
-    when unavailable. THE parser for cost_analysis' version-dependent
-    return shape (dict vs one-element list of dicts) — shared by
-    bench.py and train_lib.aot_compile_step so the bench's physics
-    guard and the train loops' throughput ceiling cannot diverge when
-    the API shifts again."""
+def peak_hbm_bytes_per_sec(log=None) -> float:
+    """Peak HBM bandwidth of one local device in bytes/s."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, gbps in PEAK_HBM_GBPS:
+        if sub in kind:
+            return gbps * 1e9
+    if log is not None:
+        log(f"unknown device kind {kind!r}: using generous "
+            f"{DEFAULT_PEAK_HBM_GBPS:.0f} GB/s HBM default")
+    return DEFAULT_PEAK_HBM_GBPS * 1e9
+
+
+def program_costs(compiled) -> "tuple[float | None, float | None]":
+    """(flops, bytes_accessed) of a compiled XLA program per
+    cost_analysis; either is None when unavailable. THE parser for
+    cost_analysis' version-dependent return shape (dict vs one-element
+    list of dicts) — shared by bench.py, train_lib.aot_compile_step,
+    and the obs/device.py program ledger so the bench's physics guard,
+    the train loops' throughput ceiling, and the MFU/roofline gauges
+    cannot diverge when the API shifts again."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        ca = ca or {}
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", 0.0))
     except Exception:  # pragma: no cover - environment-dependent
-        return None
-    return flops if flops > 0 else None
+        return None, None
+    return (flops if flops > 0 else None,
+            nbytes if nbytes > 0 else None)
+
+
+def flops_from_cost_analysis(compiled) -> "float | None":
+    """Total FLOPs of a compiled XLA program, or None when unavailable
+    (thin view over :func:`program_costs`, kept for its callers)."""
+    return program_costs(compiled)[0]
 
 
 def rate_ceiling(flops_per_call: "float | None", images_per_call: int,

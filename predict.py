@@ -266,6 +266,7 @@ def main(argv):
     snap = None
     if _OBS_WORKDIR.value:
         from jama16_retina_tpu.obs import alerts as obs_alerts
+        from jama16_retina_tpu.obs import device as obs_device
         from jama16_retina_tpu.obs import export as obs_export
         from jama16_retina_tpu.obs import fleet as obs_fleet
 
@@ -278,6 +279,9 @@ def main(argv):
             fleet=obs_fleet.bus_for(
                 cfg, "router" if _REPLICAS.value > 0 else "server"
             ),
+            # Device-utilization plane (ISSUE 19): HBM/MFU/compile
+            # gauges on the same flush cadence.
+            device=obs_device.monitor_for(cfg),
         )
         if cfg.obs.http_port > 0:
             snap.serve_http(cfg.obs.http_port)

@@ -46,6 +46,13 @@ def lower_is_better(metric: str) -> bool:
     # Rates first: *_per_sec is a throughput even though it ends _sec.
     if metric.endswith("per_sec"):
         return False
+    # Device-utilization rows (ISSUE 19): MFU dropping is the
+    # regression even though no suffix says so; HBM peak fraction
+    # rising is (closer to OOM), though no _pct/_bytes suffix fires.
+    if "mfu" in metric:
+        return False
+    if metric == "hbm_peak_frac":
+        return True
     if _LOWER_BETTER.search(metric):
         return True
     return metric.endswith(("_s", "_sec"))

@@ -40,6 +40,13 @@
 #       kill -9'd consumer resuming from its lease journal with zero
 #       re-decode (fleet-bus decode ledger) —
 #       scripts/ingest_smoke.py.
+#   bash scripts/ci_checks.sh --device-smoke
+#       lint + the device-utilization smoke (ISSUE 19): a real AOT
+#       compile feeding the program/compile ledgers, a DeviceMonitor
+#       sampled through a Snapshotter flush into telemetry, a
+#       compile-cache hit crediting saved seconds, and obs_report's
+#       Device section rendered in text and --json — off-TPU end to
+#       end — scripts/device_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -95,6 +102,12 @@ fi
 if [[ "${1:-}" == "--ingest-smoke" ]]; then
     echo "== disaggregated ingest smoke (server + 2 consumers over shm) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/ingest_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--device-smoke" ]]; then
+    echo "== device utilization smoke (HBM owners + MFU + compile ledger) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/device_smoke.py
     exit 0
 fi
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""CI device-utilization smoke (ISSUE 19; scripts/ci_checks.sh
+--device-smoke): the whole device plane exercised off-TPU, end to end:
+
+  1. a REAL AOT compile (train_lib.aot_compile_step on a tiny jitted
+     program) lands in the compile ledger with a positive duration and
+     registers the program in the program ledger — and when the
+     backend's cost analysis yields FLOPs, the value aot_compile_step
+     returns IS the ledger entry's (one FLOPs source);
+  2. a DeviceMonitor over a fake device (deterministic memory_stats +
+     injected clock/peaks) sampled THROUGH a Snapshotter flush puts
+     HBM gauges, the owner split with its untracked gap, MFU, and
+     roofline class into the telemetry JSONL — plus a compile_ledger
+     record;
+  3. a compile-cache save/load round trip credits the saved seconds
+     (device.compile.saved_sec) on a hit;
+  4. obs_report renders the Device section from that workdir in text
+     AND --json, and --diagnose refines a device-bound window using
+     the run's own telemetry.
+
+Exit 0 = every step held; 1 = a step failed (message says which).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+class FakeDev:
+    """memory_stats like a TPU device: 6 GiB of 8 GiB in use."""
+
+    def memory_stats(self):
+        return {
+            "bytes_in_use": 6 << 30,
+            "peak_bytes_in_use": 7 << 30,
+            "bytes_limit": 8 << 30,
+        }
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu import train_lib
+    from jama16_retina_tpu.obs import device as device_lib
+    from jama16_retina_tpu.obs.export import Snapshotter
+    from jama16_retina_tpu.obs.registry import Registry
+
+    device_lib.reset_for_tests()
+
+    # -- 1. real AOT compile into both ledgers ------------------------
+    @jax.jit
+    def prog(x):
+        return jnp.tanh(x @ x.T).sum()
+
+    x = jnp.ones((64, 64), jnp.float32)
+    compiled, flops = train_lib.aot_compile_step(prog, x,
+                                                 program="smoke_prog")
+    led = device_lib.compile_ledger().snapshot()
+    if led["count"] < 1 or led["sec"] <= 0:
+        return fail(f"compile ledger empty after AOT compile: {led}")
+    if not any(e["signature"] == "smoke_prog" for e in led["entries"]):
+        return fail(f"smoke_prog missing from compile ledger: {led}")
+    entry = device_lib.program_ledger().get("smoke_prog")
+    if entry is None:
+        return fail("smoke_prog missing from program ledger")
+    if flops is not None and entry.flops != flops:
+        return fail(
+            f"two FLOPs sources disagree: aot={flops} ledger={entry.flops}")
+    print(f"ok: AOT compile ledgered ({led['sec']:.3f}s, "
+          f"flops={entry.flops})")
+
+    # -- 2. monitor -> Snapshotter flush -> telemetry -----------------
+    with tempfile.TemporaryDirectory() as wd:
+        clock = iter([100.0, 101.0, 102.0])
+        reg = Registry()
+        ledger = device_lib.ProgramLedger()
+        # intensity 1e9/1e7 = 100 flops/byte, above the injected ridge
+        # point 1e12/1e11 = 10 -> compute class (1).
+        e2 = ledger.register("smoke_prog", flops_per_call=1e9,
+                             bytes_per_call=1e7)
+        device_lib.set_hbm_owner("serve_live", 4 << 30)
+        mon = device_lib.DeviceMonitor(
+            reg, devices=[FakeDev()], ledger=ledger,
+            peak_flops_per_s=1e12, peak_bw_bytes_per_s=1e11,
+            clock=lambda: next(clock),
+        )
+        snapper = Snapshotter(reg, workdir=wd, device=mon)
+        snapper.flush()  # baseline tick
+        for _ in range(5):
+            e2.note_call()
+        snapper.flush()
+        snapper.close()
+
+        records = [json.loads(ln) for ln in
+                   open(os.path.join(wd, "metrics.jsonl"))]
+        telem = [r for r in records if r.get("kind") == "telemetry"]
+        # telem[1] is the windowed tick (baseline before, close-flush
+        # after — the close window saw zero calls, so its MFU is 0).
+        gauges = telem[1]["gauges"]
+        head = gauges.get("device.hbm.headroom_frac")
+        if head is None or abs(head - 0.25) > 1e-6:
+            return fail(f"headroom gauge wrong: {head}")
+        if gauges.get("device.hbm.owner.serve_live") != float(4 << 30):
+            return fail("owner gauge missing/wrong")
+        if gauges.get("device.hbm.untracked_bytes") != float(2 << 30):
+            return fail(
+                f"untracked gap wrong: "
+                f"{gauges.get('device.hbm.untracked_bytes')}")
+        mfu = gauges.get("device.mfu")
+        n_dev = max(1, jax.local_device_count())
+        want = 5 * 1e9 / (1.0 * 1e12 * n_dev)
+        if mfu is None or abs(mfu - want) > 1e-6:
+            return fail(f"mfu {mfu} != expected {want}")
+        if gauges.get("device.roofline.smoke_prog") != 1.0:
+            return fail("roofline class missing (expected compute=1)")
+        if not any(r.get("kind") == "compile_ledger" for r in records):
+            return fail("no compile_ledger record in telemetry JSONL")
+        print(f"ok: telemetry carries HBM/owner/MFU gauges "
+              f"(headroom={head}, mfu={mfu:.6f})")
+
+        # -- 3. compile-cache hit credits saved seconds ---------------
+        saved_before = reg.snapshot()["counters"].get(
+            "device.compile.saved_sec", 0.0)
+        try:
+            from jama16_retina_tpu.serve.compilecache import CompileCache
+
+            cache = CompileCache(os.path.join(wd, "jitcache"),
+                                 {"smoke": 1}, registry=reg)
+            if not cache.save("b64", compiled, compile_sec=1.5):
+                return fail("compile-cache save failed")
+            if cache.load("b64") is None:
+                return fail("compile-cache load missed a saved entry")
+            saved = reg.snapshot()["counters"].get(
+                "device.compile.saved_sec", 0.0) - saved_before
+            if abs(saved - 1.5) > 1e-6:
+                return fail(f"cache hit credited {saved}s, wanted 1.5")
+            print("ok: compile-cache hit credited 1.50s saved")
+        except Exception as e:  # noqa: BLE001
+            return fail(f"compile-cache round trip: "
+                        f"{type(e).__name__}: {e}")
+
+        # -- 4. obs_report renders the Device section -----------------
+        env = dict(os.environ,
+                   JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+        report = os.path.join(_REPO, "scripts", "obs_report.py")
+        txt = subprocess.run(
+            [sys.executable, report, wd], capture_output=True,
+            text=True, env=env, timeout=300,
+        )
+        if txt.returncode != 0:
+            return fail(f"obs_report exit {txt.returncode}: {txt.stderr}")
+        if "device utilization:" not in txt.stdout \
+                or "(untracked)" not in txt.stdout:
+            return fail(f"Device section missing from text report:\n"
+                        f"{txt.stdout}")
+        js = subprocess.run(
+            [sys.executable, report, wd, "--json"], capture_output=True,
+            text=True, env=env, timeout=300,
+        )
+        doc = json.loads(js.stdout)
+        dev = doc.get("device")
+        if not dev or dev["hbm"]["headroom_frac"] is None:
+            return fail(f"--json device section missing: {dev}")
+        if not dev["programs"].get("smoke_prog"):
+            return fail(f"--json device programs missing: {dev}")
+        print("ok: obs_report Device section renders (text + --json)")
+
+    device_lib.reset_for_tests()
+    print("device smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

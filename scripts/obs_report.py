@@ -731,6 +731,183 @@ def render_serving_cost(records: list) -> "str | None":
     return "serving cost:\n" + _table(rows, ("signal", "value"))
 
 
+# ---------------------------------------------------------------------------
+# Device utilization: HBM by owner, MFU/roofline, compile ledger (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_bytes(n: "float | None") -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def device_summary(records: list) -> "dict | None":
+    """The Device section's machine-readable form (--json twin): HBM
+    occupancy split by registered owner (with the unexplained gap as
+    ``untracked``), MFU / bandwidth / roofline class per compiled
+    program, and the compile ledger (count, seconds, what the
+    persistent cache saved). None when the run carries no device
+    signals — a monitor-off or pre-ISSUE-19 stream renders nothing
+    new."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    gauges = latest.get("gauges", {})
+    counters = latest.get("counters", {})
+    ledgers = [r for r in records if r.get("kind") == "compile_ledger"]
+    ledger = ledgers[-1] if ledgers else None
+
+    owners = {
+        k[len("device.hbm.owner."):]: float(v)
+        for k, v in sorted(gauges.items())
+        if k.startswith("device.hbm.owner.")
+    }
+    programs = {}
+    for k, v in sorted(gauges.items()):
+        if k.startswith("device.mfu."):
+            programs.setdefault(k[len("device.mfu."):], {})["mfu"] = v
+        elif k.startswith("device.bw_gbps."):
+            programs.setdefault(
+                k[len("device.bw_gbps."):], {})["bw_gbps"] = v
+        elif (k.startswith("device.roofline.")
+              and k != "device.roofline.dominant_class"):
+            cls = {1.0: "compute", 2.0: "memory"}.get(float(v))
+            programs.setdefault(
+                k[len("device.roofline."):], {})["roofline"] = cls
+    calls = {
+        k[len("device.program.calls."):]: int(v)
+        for k, v in counters.items()
+        if k.startswith("device.program.calls.")
+    }
+    for name, n in calls.items():
+        programs.setdefault(name, {})["calls"] = n
+
+    in_use = gauges.get("device.hbm.bytes_in_use")
+    mfu = gauges.get("device.mfu")
+    n_compiles = int(counters.get("device.compile.count", 0))
+    if in_use is None and mfu is None and not n_compiles \
+            and not programs and ledger is None:
+        return None
+
+    dom = gauges.get("device.roofline.dominant_class")
+    return {
+        "hbm": {
+            "bytes_in_use": in_use,
+            "peak_bytes": gauges.get("device.hbm.peak_bytes"),
+            "bytes_limit": gauges.get("device.hbm.bytes_limit"),
+            "headroom_frac": gauges.get("device.hbm.headroom_frac"),
+            "untracked_bytes": gauges.get("device.hbm.untracked_bytes"),
+            "derived_budget_bytes":
+                gauges.get("device.hbm.derived_budget_bytes"),
+            "budget_occupancy_frac":
+                gauges.get("device.hbm.budget_occupancy_frac"),
+        },
+        "owners": owners,
+        "mfu": mfu,
+        "bw_frac": gauges.get("device.bw_frac"),
+        "dominant_class": {1.0: "compute", 2.0: "memory"}.get(
+            float(dom)) if dom is not None else None,
+        "programs": programs,
+        "compile": {
+            "count": n_compiles,
+            "sec": counters.get("device.compile.sec"),
+            "saved_sec": counters.get("device.compile.saved_sec"),
+            "ledger": (
+                {k: ledger.get(k) for k in
+                 ("count", "sec", "slowest", "entries") if k in ledger}
+                if ledger else None
+            ),
+        },
+    }
+
+
+def render_device(records: list) -> "str | None":
+    s = device_summary(records)
+    if s is None:
+        return None
+    out = ["device utilization:"]
+    hbm = s["hbm"]
+    if hbm["bytes_in_use"] is not None:
+        limit = hbm["bytes_limit"]
+        head = hbm["headroom_frac"]
+        out.append(
+            f"  HBM: {_fmt_bytes(hbm['bytes_in_use'])} in use of "
+            f"{_fmt_bytes(limit)}"
+            + (f" (headroom {head:.1%})" if head is not None else "")
+            + (f", peak {_fmt_bytes(hbm['peak_bytes'])}"
+               if hbm["peak_bytes"] is not None else "")
+        )
+        if hbm["budget_occupancy_frac"] is not None:
+            out.append(
+                f"  staging budget: {hbm['budget_occupancy_frac']:.1%} "
+                f"of derived {_fmt_bytes(hbm['derived_budget_bytes'])} "
+                "occupied"
+            )
+    if s["owners"] or hbm["untracked_bytes"]:
+        rows = [(o, _fmt_bytes(b))
+                for o, b in sorted(s["owners"].items(),
+                                   key=lambda kv: -kv[1])]
+        if hbm["untracked_bytes"] is not None:
+            rows.append(("(untracked)",
+                         _fmt_bytes(hbm["untracked_bytes"])))
+        out.append("  HBM by owner:")
+        out.append(_indent(_table(rows, ("owner", "bytes")), 2))
+    if s["mfu"] is not None:
+        out.append(
+            f"  MFU: {s['mfu']:.1%}"
+            + (f", bandwidth {s['bw_frac']:.1%} of peak"
+               if s["bw_frac"] is not None else "")
+            + (f", dominant roofline class: {s['dominant_class']}"
+               if s["dominant_class"] else "")
+        )
+    if s["programs"]:
+        rows = [
+            (name,
+             f"{p['mfu']:.1%}" if p.get("mfu") is not None else "-",
+             f"{p['bw_gbps']:.1f}" if p.get("bw_gbps") is not None
+             else "-",
+             p.get("roofline") or "-",
+             str(p["calls"]) if p.get("calls") is not None else "-")
+            for name, p in sorted(s["programs"].items())
+        ]
+        out.append("  per program:")
+        out.append(_indent(
+            _table(rows, ("program", "mfu", "GB/s", "class", "calls")),
+            2))
+    c = s["compile"]
+    if c["count"] or c["ledger"]:
+        sec = c.get("sec") or 0.0
+        saved = c.get("saved_sec") or 0.0
+        line = (f"  compiles: {c['count']} ({sec:.2f}s paid"
+                + (f", {saved:.2f}s saved by cache" if saved else "")
+                + ")")
+        led = c["ledger"]
+        if led and led.get("slowest"):
+            sl = led["slowest"]
+            line += (f"; slowest {sl.get('signature')} "
+                     f"at {sl.get('sec', 0.0):.2f}s")
+        out.append(line)
+        if led and led.get("entries"):
+            rows = [(e.get("signature", "?"), str(e.get("count", 0)),
+                     f"{e.get('sec', 0.0):.2f}",
+                     f"{e.get('max_sec', 0.0):.2f}")
+                    for e in led["entries"]]
+            out.append(_indent(
+                _table(rows, ("signature", "count", "sec", "max_sec")),
+                2))
+    return "\n".join(out)
+
+
+def _indent(text: str, n: int) -> str:
+    pad = " " * n
+    return "\n".join(pad + line for line in text.split("\n"))
+
+
 def lease_staleness(workdir: str, stale_s: float = 120.0,
                     now: "float | None" = None) -> "list | None":
     """Per-consumer lease ages with staleness blame (ISSUE 18
@@ -1702,21 +1879,58 @@ def check_fleet(fleet_dir: str, rules) -> tuple[int, str]:
 # ---------------------------------------------------------------------------
 
 
-def diagnosis_summary(events: list, top_k: int = 3) -> dict:
+def diagnosis_summary(events: list, top_k: int = 3,
+                      device: "dict | None" = None) -> dict:
     """The --diagnose payload (--json twin): the critical-path
     analyzer's typed verdict over ``events`` — evidence fractions,
     per-category seconds, and the top-K slowest per-request /
-    per-step exemplar waterfalls (obs/criticalpath.diagnose)."""
+    per-step exemplar waterfalls (obs/criticalpath.diagnose). When a
+    device-utilization summary is supplied (workdir runs carry one in
+    telemetry), a ``device_bound`` verdict is refined into its typed
+    sub-cause (compute-bound / membw-bound / underutilized)."""
     from jama16_retina_tpu.obs import criticalpath
 
-    return criticalpath.diagnose(events, top_k=top_k).as_dict()
+    return criticalpath.diagnose(
+        events, top_k=top_k, device=device).as_dict()
+
+
+def _device_for_diagnosis(path: str) -> "dict | None":
+    """The latest telemetry record's device-utilization gauges, shaped
+    for criticalpath verdict refinement — None when ``path`` is not a
+    workdir or carries no device gauges."""
+    try:
+        if not os.path.isdir(path):
+            return None
+        records = load_records(path)
+        telemetry = [r for r in records if r.get("kind") == "telemetry"]
+        if not telemetry:
+            return None
+        from jama16_retina_tpu.obs import device as device_lib
+
+        return device_lib.summary_from_gauges(
+            telemetry[-1].get("gauges", {}))
+    except Exception:  # noqa: BLE001 - refinement is best-effort
+        return None
 
 
 def render_diagnosis(summary: dict) -> str:
+    dev = summary.get("device")
+    dev_line = ""
+    if dev:
+        bits = []
+        if dev.get("mfu") is not None:
+            bits.append(f"MFU {dev['mfu']:.1%}")
+        if dev.get("dominant_class"):
+            bits.append(f"roofline {dev['dominant_class']}")
+        if dev.get("hbm_headroom_frac") is not None:
+            bits.append(
+                f"HBM headroom {dev['hbm_headroom_frac']:.1%}")
+        if bits:
+            dev_line = "\ndevice evidence: " + ", ".join(bits)
     out = [
         f"diagnosis: {summary['verdict']} "
         f"(confidence {summary['confidence']:.2f}, "
-        f"{summary['n_events']} events)",
+        f"{summary['n_events']} events)" + dev_line,
         _table(
             [(cat, f"{summary['totals_s'].get(cat, 0.0):.3f}",
               f"{frac:.1%}")
@@ -1902,7 +2116,9 @@ def main(argv=None) -> int:
                   "needs a blackbox dump, a trace file, or a fleet "
                   "dir with published rings")
             return 2
-        summary = diagnosis_summary(events, top_k=args.diagnose_top_k)
+        summary = diagnosis_summary(
+            events, top_k=args.diagnose_top_k,
+            device=_device_for_diagnosis(args.path))
         if args.json:
             print(json.dumps({"source": src, "diagnosis": summary}))
         else:
@@ -1960,6 +2176,7 @@ def main(argv=None) -> int:
             "quality": quality_summary(records),
             "reliability": reliability_summary(records),
             "serving_cost": serving_cost_summary(records),
+            "device": device_summary(records),
             "ingest": ingest_summary(
                 records,
                 workdir=(args.path if os.path.isdir(args.path) else None),
@@ -1998,6 +2215,10 @@ def main(argv=None) -> int:
     if sc:
         print()
         print(sc)
+    dev = render_device(records)
+    if dev:
+        print()
+        print(dev)
     ing = render_ingest(
         records,
         workdir=(args.path if os.path.isdir(args.path) else None),

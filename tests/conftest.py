@@ -275,6 +275,27 @@ _QUICK_TESTS = {
     "test_ingest.py::test_served_bit_identical_across_epochs_partial_residency",
     "test_ingest.py::test_merge_windows_is_worst_consumer_over_longest_wall",
     "test_ingest.py::test_fleet_tuner_fires_once_all_attached_report",
+    # device-utilization plane (ISSUE 19): the numpy-cheap pins —
+    # HBM gauges/fleet reductions over fake devices, the owner ledger's
+    # untracked gap, roofline/MFU window math with injected clocks, the
+    # compile ledger + saved-seconds credit, the pure verdict
+    # refinement, the hbm_pressure rule latch, and the bench_trend
+    # directions; the real-engine compile-ledger test stays in the full
+    # tier (XLA compiles dominate there)
+    "test_device.py::test_monitor_samples_hbm_gauges",
+    "test_device.py::test_monitor_hbm_gauges_declare_fleet_reductions",
+    "test_device.py::test_disabled_monitor_is_one_branch",
+    "test_device.py::test_owner_ledger_arithmetic_and_untracked_gap",
+    "test_device.py::test_hbm_budget_cross_check_gauge",
+    "test_device.py::test_mfu_window_math_with_injected_clock",
+    "test_device.py::test_roofline_classes_against_injected_ridge",
+    "test_device.py::test_compile_timed_records_even_on_raise",
+    "test_device.py::test_compile_ledger_slowest_and_exemplar",
+    "test_device.py::test_refine_device_verdict_pure",
+    "test_device.py::test_diagnose_refines_device_bound_only",
+    "test_device.py::test_summary_from_gauges",
+    "test_device.py::test_reliability_rules_include_hbm_pressure_and_latch",
+    "test_device.py::test_bench_trend_device_row_directions",
 }
 
 

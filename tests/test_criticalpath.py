@@ -17,9 +17,13 @@ def ev(name, ts_s, dur_s, **args):
 
 
 def test_verdict_codes_are_append_only_stable():
+    # Append-only: 0..5 are the ISSUE 18 originals, 6..8 the ISSUE 19
+    # device refinements — existing codes never renumber.
     assert criticalpath.VERDICT_CODES == {
         "balanced": 0, "device_bound": 1, "decode_bound": 2,
         "credit_starved": 3, "h2d_bound": 4, "queue_bound": 5,
+        "device_compute_bound": 6, "device_membw_bound": 7,
+        "device_underutilized": 8,
     }
 
 
@@ -167,7 +171,8 @@ def test_as_dict_schema():
     d = v.as_dict()
     assert set(d) == {"verdict", "code", "confidence", "evidence",
                       "totals_s", "n_events", "request_waterfalls",
-                      "step_waterfalls"}
+                      "step_waterfalls", "device"}
     assert set(d["evidence"]) == {"device", "decode", "credit", "h2d",
                                   "queue", "other"}
     assert d["code"] == criticalpath.VERDICT_CODES[d["verdict"]]
+    assert d["device"] is None  # no device summary offered

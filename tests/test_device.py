@@ -1,0 +1,643 @@
+"""Device-utilization plane (ISSUE 19): HBM accounting by owner,
+MFU/roofline attribution, and the compile ledger (obs/device.py), plus
+its consumers — the criticalpath verdict refinement, /healthz probe
+fields, fleet memory-pressure blame, the reliability rule, and
+obs_report's Device section.
+
+Numpy-cheap pins run everywhere; the real-engine compile-ledger test
+(XLA compiles) stays out of the quick tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.obs import alerts as obs_alerts
+from jama16_retina_tpu.obs import criticalpath
+from jama16_retina_tpu.obs import device as device_lib
+from jama16_retina_tpu.obs import fleet as fleet_lib
+from jama16_retina_tpu.obs import trace as trace_lib
+from jama16_retina_tpu.obs.registry import Registry
+
+pytestmark = pytest.mark.device
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledgers():
+    device_lib.reset_for_tests()
+    yield
+    device_lib.reset_for_tests()
+
+
+class FakeDev:
+    def __init__(self, in_use, peak, limit):
+        self._stats = {"bytes_in_use": in_use,
+                       "peak_bytes_in_use": peak,
+                       "bytes_limit": limit}
+
+    def memory_stats(self):
+        return dict(self._stats)
+
+
+def _load_obs_report():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(repo, "scripts", "obs_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- monitor sampling ------------------------------------------------------
+
+
+def test_monitor_samples_hbm_gauges():
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(6000, 7000, 10000),
+                      FakeDev(9000, 9000, 10000)],
+        ledger=device_lib.ProgramLedger(),
+    )
+    out = mon.sample()
+    g = reg.snapshot()["gauges"]
+    # max in_use / max peak across devices, min headroom.
+    assert g["device.hbm.bytes_in_use"] == 9000.0
+    assert g["device.hbm.peak_bytes"] == 9000.0
+    assert g["device.hbm.bytes_limit"] == 10000.0
+    assert g["device.hbm.headroom_frac"] == pytest.approx(0.1)
+    assert out["bytes_in_use"] == 9000
+
+
+def test_monitor_hbm_gauges_declare_fleet_reductions():
+    """Merging two processes' device gauges must take the TIGHTEST
+    view: summed headrooms would hide the pressured process."""
+    snaps = []
+    for in_use in (2000, 9000):
+        reg = Registry()
+        device_lib.DeviceMonitor(
+            reg, devices=[FakeDev(in_use, in_use, 10000)],
+            ledger=device_lib.ProgramLedger(),
+        ).sample()
+        snaps.append((f"s-{in_use}", reg.snapshot()))
+    m = fleet_lib.merge_snapshots(snaps)
+    assert m["gauges"]["device.hbm.bytes_in_use"] == 9000.0   # max
+    assert m["gauges"]["device.hbm.headroom_frac"] == pytest.approx(
+        0.1)                                                  # min
+    assert m["gauges"]["device.hbm.bytes_limit"] == 10000.0   # min
+
+
+def test_cpu_device_without_memory_stats_publishes_nothing():
+    class Bare:
+        pass
+
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(reg, devices=[Bare()],
+                                   ledger=device_lib.ProgramLedger())
+    out = mon.sample()
+    assert "bytes_in_use" not in out
+    assert not any(k.startswith("device.hbm.")
+                   for k in reg.snapshot()["gauges"])
+
+
+def test_disabled_monitor_is_one_branch():
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(
+        reg, enabled=False, devices=[FakeDev(1, 1, 2)],
+        ledger=device_lib.ProgramLedger(),
+    )
+    assert mon.sample() is None
+    assert reg.snapshot()["gauges"] == {}
+
+
+def test_monitor_for_gates_on_config():
+    cfg = get_config("smoke")
+    assert device_lib.monitor_for(cfg) is not None
+    off = cfg.replace(obs=dataclasses.replace(cfg.obs,
+                                              device_enabled=False))
+    assert device_lib.monitor_for(off) is None
+    obs_off = cfg.replace(obs=dataclasses.replace(cfg.obs,
+                                                  enabled=False))
+    assert device_lib.monitor_for(obs_off) is None
+
+
+# -- owner ledger ----------------------------------------------------------
+
+
+def test_owner_ledger_arithmetic_and_untracked_gap():
+    device_lib.set_hbm_owner("serve_live", 4000)
+    device_lib.add_hbm_owner("ingest_rings", 1500)
+    device_lib.add_hbm_owner("ingest_rings", 500)
+    device_lib.add_hbm_owner("ingest_rings", -500)
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(9000, 9000, 10000)],
+        ledger=device_lib.ProgramLedger(),
+    )
+    out = mon.sample()
+    g = reg.snapshot()["gauges"]
+    assert g["device.hbm.owner.serve_live"] == 4000.0
+    assert g["device.hbm.owner.ingest_rings"] == 1500.0
+    assert g["device.hbm.untracked_bytes"] == 3500.0
+    assert out["untracked_bytes"] == 3500.0
+    # Over-claimed owners clamp the gap at 0 instead of going negative.
+    device_lib.set_hbm_owner("serve_live", 99999)
+    mon.sample()
+    assert reg.snapshot()["gauges"]["device.hbm.untracked_bytes"] == 0.0
+    # Subtracting below zero clamps; clearing removes the key.
+    device_lib.add_hbm_owner("ingest_rings", -99999)
+    assert device_lib.hbm_owners()["ingest_rings"] == 0.0
+    device_lib.clear_hbm_owner("ingest_rings")
+    assert "ingest_rings" not in device_lib.hbm_owners()
+
+
+def test_hbm_budget_cross_check_gauge():
+    device_lib.note_hbm_budget(8000)
+    reg = Registry()
+    device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(6000, 6000, 10000)],
+        ledger=device_lib.ProgramLedger(),
+    ).sample()
+    g = reg.snapshot()["gauges"]
+    assert g["device.hbm.derived_budget_bytes"] == 8000.0
+    assert g["device.hbm.budget_occupancy_frac"] == pytest.approx(0.75)
+
+
+def test_hbm_pipeline_notes_its_derived_budget():
+    from jama16_retina_tpu.data import hbm_pipeline
+
+    budget = hbm_pipeline.hbm_budget_bytes(0.6)
+    assert budget > 0
+    reg = Registry()
+    device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(100, 100, 10**12)],
+        ledger=device_lib.ProgramLedger(),
+    ).sample()
+    assert reg.snapshot()["gauges"][
+        "device.hbm.derived_budget_bytes"] == float(budget)
+
+
+def test_tree_device_bytes_host_arrays():
+    tree = {"a": np.zeros((4, 4), np.float32),
+            "b": np.zeros(8, np.uint8)}
+    assert device_lib.tree_device_bytes(tree) == 4 * 4 * 4 + 8
+    assert device_lib.tree_device_bytes({}) == 0
+
+
+# -- MFU / roofline --------------------------------------------------------
+
+
+def test_mfu_window_math_with_injected_clock():
+    import jax
+
+    clock = iter([10.0, 12.0])
+    ledger = device_lib.ProgramLedger()
+    e = ledger.register("train_step", flops_per_call=2e9,
+                        bytes_per_call=1e7)
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(
+        reg, devices=[], ledger=ledger, peak_flops_per_s=1e12,
+        peak_bw_bytes_per_s=1e11, clock=lambda: next(clock),
+    )
+    mon.sample()  # baseline tick
+    for _ in range(10):
+        e.note_call()
+    out = mon.sample()
+    n_dev = max(1, jax.local_device_count())
+    want = 10 * 2e9 / (2.0 * 1e12 * n_dev)
+    assert out["mfu"] == pytest.approx(want)
+    g = reg.snapshot()["gauges"]
+    assert g["device.mfu"] == pytest.approx(want, abs=1e-6)
+    assert g["device.mfu.train_step"] == pytest.approx(want, abs=1e-6)
+    assert reg.snapshot()["counters"][
+        "device.program.calls.train_step"] == 10.0
+
+
+def test_roofline_classes_against_injected_ridge():
+    # ridge = 1e12 / 1e11 = 10 flops/byte.
+    ledger = device_lib.ProgramLedger()
+    ledger.register("dense", flops_per_call=1e9, bytes_per_call=1e7)
+    ledger.register("streamy", flops_per_call=1e9, bytes_per_call=1e9)
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(
+        reg, devices=[], ledger=ledger, peak_flops_per_s=1e12,
+        peak_bw_bytes_per_s=1e11, clock=iter([0.0, 1.0]).__next__,
+    )
+    mon.sample()
+    g = reg.snapshot()["gauges"]
+    assert g["device.roofline.dense"] == 1.0      # 100 >= 10: compute
+    assert g["device.roofline.streamy"] == 2.0    # 1 < 10: memory
+    # The dominant class follows the program carrying the window FLOPs.
+    ledger.get("streamy").note_call(5)
+    out = mon.sample()
+    assert out["dominant_class"] == 2.0
+    assert reg.snapshot()["gauges"][
+        "device.roofline.dominant_class"] == 2.0
+
+
+def test_one_flops_source_trainer_ceiling_is_ledger_entry():
+    """aot_compile_step's returned FLOPs (the trainer throughput
+    ceiling's numerator) IS the program-ledger entry's — one parse
+    site, no second cost_analysis path to drift."""
+    import jax
+    import jax.numpy as jnp
+
+    from jama16_retina_tpu import train_lib
+
+    @jax.jit
+    def prog(x):
+        return (x @ x.T).sum()
+
+    x = jnp.ones((16, 16), jnp.float32)
+    compiled, flops = train_lib.aot_compile_step(prog, x,
+                                                 program="train_step")
+    entry = device_lib.program_ledger().get("train_step")
+    assert entry is not None
+    if flops is not None:  # cost analysis availability is backend-luck
+        assert entry.flops == flops
+    # The compile itself landed in the compile ledger.
+    snap = device_lib.compile_ledger().snapshot()
+    assert snap["count"] >= 1
+    assert any(e["signature"] == "train_step" for e in snap["entries"])
+
+
+# -- compile ledger --------------------------------------------------------
+
+
+def test_compile_timed_records_even_on_raise():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        with device_lib.compile_timed("boom", registry=reg):
+            raise ValueError("compile OOM")
+    snap = device_lib.compile_ledger().snapshot()
+    assert snap["count"] == 1
+    assert reg.snapshot()["counters"]["device.compile.count"] == 1.0
+
+
+def test_compile_ledger_slowest_and_exemplar():
+    reg = Registry()
+    device_lib.record_compile("serve_b8", 0.5, registry=reg)
+    device_lib.record_compile("train_step", 2.5, registry=reg)
+    device_lib.record_compile("serve_b8", 0.25, registry=reg)
+    snap = device_lib.compile_ledger().snapshot()
+    assert snap["count"] == 3
+    assert snap["sec"] == pytest.approx(3.25)
+    assert snap["slowest"] == {"signature": "train_step", "sec": 2.5}
+    assert snap["entries"][0]["signature"] == "train_step"
+    by_sig = {e["signature"]: e for e in snap["entries"]}
+    assert by_sig["serve_b8"]["count"] == 2
+    assert by_sig["serve_b8"]["max_sec"] == 0.5
+    # Histogram exemplar names the slowest compile of the window.
+    hist = reg.snapshot()["histograms"]["device.compile.sec_hist"]
+    assert hist["exemplar"]["trace_id"] == "train_step"
+    assert hist["exemplar"]["value"] == 2.5
+    counters = reg.snapshot()["counters"]
+    assert counters["device.compile.count"] == 3.0
+    assert counters["device.compile.sec"] == pytest.approx(3.25)
+
+
+def test_note_compile_saved_counter_and_zero_noop():
+    reg = Registry()
+    device_lib.note_compile_saved(1.25, registry=reg)
+    device_lib.note_compile_saved(0.0, registry=reg)
+    assert reg.snapshot()["counters"][
+        "device.compile.saved_sec"] == pytest.approx(1.25)
+
+
+def test_last_compile_age_and_healthz_fields():
+    from jama16_retina_tpu.obs.httpd import ObsHttp
+
+    assert device_lib.compile_ledger().last_compile_age_s() is None
+    reg = Registry()
+    device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(9500, 9500, 10000)],
+        ledger=device_lib.ProgramLedger(),
+    ).sample()
+    device_lib.record_compile("serve_b4", 1.0, registry=reg)
+    http = ObsHttp(reg, port=0)
+    try:
+        status, detail = http.health()
+        assert status == 2  # no snapshotter: still carries device fields
+        assert detail["hbm_headroom_frac"] == pytest.approx(0.05)
+        assert detail["last_compile_age_s"] is not None
+        assert detail["last_compile_age_s"] < 60.0
+    finally:
+        http.close()
+
+
+# -- Snapshotter wiring ----------------------------------------------------
+
+
+def test_snapshotter_flush_samples_monitor_into_telemetry(tmp_path):
+    from jama16_retina_tpu.obs.export import Snapshotter
+
+    reg = Registry()
+    mon = device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(6000, 7000, 10000)],
+        ledger=device_lib.ProgramLedger(),
+    )
+    device_lib.record_compile("train_step", 1.5, registry=reg)
+    snapper = Snapshotter(reg, workdir=str(tmp_path), device=mon)
+    snap = snapper.flush()
+    assert snap["gauges"]["device.hbm.headroom_frac"] == pytest.approx(
+        0.4)
+    records = [json.loads(ln) for ln in
+               open(tmp_path / "metrics.jsonl")]
+    telem = [r for r in records if r["kind"] == "telemetry"]
+    assert telem[0]["gauges"]["device.hbm.bytes_in_use"] == 6000.0
+    ledgers = [r for r in records if r["kind"] == "compile_ledger"]
+    assert ledgers and ledgers[0]["count"] == 1
+    assert ledgers[0]["slowest"]["signature"] == "train_step"
+    # No new compiles -> no duplicate compile_ledger record.
+    snapper.flush()
+    records = [json.loads(ln) for ln in
+               open(tmp_path / "metrics.jsonl")]
+    assert sum(r["kind"] == "compile_ledger" for r in records) == 1
+
+
+# -- verdict refinement ----------------------------------------------------
+
+
+def _dispatch_dominant_events():
+    tr = trace_lib.Tracer(enabled=True)
+    for _ in range(6):
+        t0 = time.perf_counter()
+        time.sleep(0.001)
+        t1 = time.perf_counter()
+        tr.complete("trainer.input", t0, t1, {})
+        time.sleep(0.01)
+        t2 = time.perf_counter()
+        tr.complete("trainer.dispatch", t1, t2, {})
+    return tr.events()
+
+
+def test_refine_device_verdict_pure():
+    assert criticalpath.refine_device_verdict(None) is None
+    assert criticalpath.refine_device_verdict({}) is None
+    assert criticalpath.refine_device_verdict(
+        {"mfu": None, "dominant_class": None}) is None
+    assert criticalpath.refine_device_verdict(
+        {"mfu": 0.9, "dominant_class": "memory"}
+    ) == "device_membw_bound"
+    assert criticalpath.refine_device_verdict(
+        {"mfu": device_lib.SATURATED_MFU, "dominant_class": "compute"}
+    ) == "device_compute_bound"
+    assert criticalpath.refine_device_verdict(
+        {"mfu": 0.05, "dominant_class": "compute"}
+    ) == "device_underutilized"
+
+
+def test_diagnose_refines_device_bound_only():
+    events = _dispatch_dominant_events()
+    base = criticalpath.diagnose(events)
+    assert base.verdict == "device_bound"
+    assert base.device is None
+
+    low = criticalpath.diagnose(events, device={
+        "mfu": 0.03, "dominant_class": "compute"})
+    assert low.verdict == "device_underutilized"
+    assert low.code == criticalpath.VERDICT_CODES[
+        "device_underutilized"]
+    assert low.device == {"mfu": 0.03, "dominant_class": "compute"}
+
+    mem = criticalpath.diagnose(events, device={
+        "mfu": 0.6, "dominant_class": "memory"})
+    assert mem.verdict == "device_membw_bound"
+
+    hot = criticalpath.diagnose(events, device={
+        "mfu": 0.55, "dominant_class": "compute"})
+    assert hot.verdict == "device_compute_bound"
+
+    # A summary that cannot commit keeps the unrefined verdict.
+    vague = criticalpath.diagnose(events, device={"mfu": None})
+    assert vague.verdict == "device_bound" and vague.device is None
+
+
+def test_diagnose_ignores_device_for_other_verdicts():
+    tr = trace_lib.Tracer(enabled=True)
+    for _ in range(4):
+        t0 = time.perf_counter()
+        time.sleep(0.01)
+        t1 = time.perf_counter()
+        tr.complete("ingest.batch.decode", t0, t1, {})
+    v = criticalpath.diagnose(tr.events(), device={
+        "mfu": 0.01, "dominant_class": "compute"})
+    assert v.verdict == "decode_bound"
+    assert v.device is None
+
+
+def test_summary_from_gauges():
+    assert device_lib.summary_from_gauges(None) is None
+    assert device_lib.summary_from_gauges({"x": 1.0}) is None
+    s = device_lib.summary_from_gauges({
+        "device.mfu": 0.12,
+        "device.mfu.train_step": 0.12,
+        "device.roofline.dominant_class": 2.0,
+        "device.bw_frac": 0.7,
+        "device.hbm.headroom_frac": 0.3,
+    })
+    assert s == {
+        "mfu": 0.12, "dominant_class": "memory", "bw_frac": 0.7,
+        "hbm_headroom_frac": 0.3,
+        "programs": {"train_step": 0.12},
+    }
+
+
+# -- alerts + fleet blame --------------------------------------------------
+
+
+def test_reliability_rules_include_hbm_pressure_and_latch():
+    cfg = get_config("smoke")
+    rules = obs_alerts.reliability_rules(cfg)
+    rule = next(r for r in rules if r.reason == "hbm_pressure")
+    assert rule.metric == "device.hbm.headroom_frac"
+    assert rule.op == "<" and rule.for_seconds == 60.0
+    assert rule.threshold == cfg.obs.device_hbm_headroom_alert
+
+    reg = Registry()
+    device_lib.DeviceMonitor(
+        reg, devices=[FakeDev(9500, 9500, 10000)],
+        ledger=device_lib.ProgramLedger(),
+    ).sample()
+    mgr = obs_alerts.AlertManager(rules, registry=reg)
+    assert not [f for f in mgr.evaluate(now=1000.0)
+                if f["reason"] == "hbm_pressure"]  # for-60s not held yet
+    firing = mgr.evaluate(now=1061.0)
+    assert any(f["reason"] == "hbm_pressure" for f in firing)
+
+
+def test_zero_threshold_disables_hbm_pressure_rule():
+    cfg = get_config("smoke")
+    cfg = cfg.replace(obs=dataclasses.replace(
+        cfg.obs, device_hbm_headroom_alert=0.0))
+    assert not [r for r in obs_alerts.reliability_rules(cfg)
+                if r.reason == "hbm_pressure"]
+
+
+def test_fleet_heartbeats_blame_memory_pressured_process(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    now = time.time()
+    for role, in_use in (("train", 2000), ("serve", 9500)):
+        reg = Registry()
+        device_lib.DeviceMonitor(
+            reg, devices=[FakeDev(in_use, in_use, 10000)],
+            ledger=device_lib.ProgramLedger(),
+        ).sample()
+        bus = fleet_lib.FleetBus(fdir, role, registry=reg)
+        bus.publish(reg.snapshot(), heartbeat={"step": 1})
+    code, msg = fleet_lib.check_fleet_heartbeats(fdir, 300.0, now=now)
+    assert code == 0
+    # Only the 5%-headroom process is named memory-pressured.
+    pressured = [ln for ln in msg.splitlines()
+                 if "memory-pressured" in ln]
+    assert len(pressured) == 1 and "serve" in pressured[0]
+    assert "5.0%" in pressured[0]
+    # A stale pressured process keeps the annotation on its blame line.
+    code, msg = fleet_lib.check_fleet_heartbeats(
+        fdir, 0.001, now=now + 100)
+    assert code == 1
+    assert any("memory-pressured" in ln for ln in msg.splitlines()
+               if "serve" in ln)
+
+
+# -- bench trend directions ------------------------------------------------
+
+
+def test_bench_trend_device_row_directions():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(repo, "scripts", "bench_trend.py")
+    )
+    bt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bt)
+    assert bt.lower_is_better("train_mfu") is False
+    assert bt.lower_is_better("serve_mfu_b64") is False
+    assert bt.lower_is_better("hbm_peak_frac") is True
+    # The device rows must not disturb the existing shapes.
+    assert bt.lower_is_better("devicemon_overhead_pct") is True
+    assert bt.lower_is_better("train_images_per_sec_per_chip") is False
+
+
+# -- obs_report Device section ---------------------------------------------
+
+
+def _device_records():
+    return [
+        {"kind": "telemetry", "t": 1.0,
+         "counters": {"device.compile.count": 3,
+                      "device.compile.sec": 4.5,
+                      "device.compile.saved_sec": 2.0,
+                      "device.program.calls.train_step": 50},
+         "gauges": {"device.hbm.bytes_in_use": 6.0e9,
+                    "device.hbm.peak_bytes": 7.0e9,
+                    "device.hbm.bytes_limit": 8.0e9,
+                    "device.hbm.headroom_frac": 0.25,
+                    "device.hbm.untracked_bytes": 1.0e9,
+                    "device.hbm.owner.serve_live": 4.0e9,
+                    "device.hbm.owner.ingest_rings": 1.0e9,
+                    "device.mfu": 0.31,
+                    "device.mfu.train_step": 0.31,
+                    "device.bw_gbps.train_step": 123.4,
+                    "device.bw_frac": 0.4,
+                    "device.roofline.train_step": 1.0,
+                    "device.roofline.dominant_class": 1.0}},
+        {"kind": "compile_ledger", "t": 2.0, "count": 3, "sec": 4.5,
+         "slowest": {"signature": "train_step", "sec": 3.0},
+         "entries": [{"signature": "train_step", "count": 1,
+                      "sec": 3.0, "max_sec": 3.0},
+                     {"signature": "serve_b8", "count": 2,
+                      "sec": 1.5, "max_sec": 1.0}]},
+    ]
+
+
+def test_obs_report_device_summary_and_render():
+    obs_report = _load_obs_report()
+    s = obs_report.device_summary(_device_records())
+    assert s["hbm"]["headroom_frac"] == 0.25
+    assert s["owners"] == {"serve_live": 4.0e9, "ingest_rings": 1.0e9}
+    assert s["mfu"] == 0.31
+    assert s["dominant_class"] == "compute"
+    assert s["programs"]["train_step"]["mfu"] == 0.31
+    assert s["programs"]["train_step"]["roofline"] == "compute"
+    assert s["programs"]["train_step"]["calls"] == 50
+    assert s["compile"]["count"] == 3
+    assert s["compile"]["saved_sec"] == 2.0
+    assert s["compile"]["ledger"]["slowest"]["signature"] == "train_step"
+    text = obs_report.render_device(_device_records())
+    assert "device utilization:" in text
+    assert "(untracked)" in text
+    assert "serve_live" in text
+    assert "MFU: 31.0%" in text
+    assert "2.00s saved by cache" in text
+    assert "slowest train_step" in text
+    # A stream with no device signals renders nothing new.
+    assert obs_report.device_summary(
+        [{"kind": "telemetry", "counters": {"x": 1}, "gauges": {}}]
+    ) is None
+
+
+def test_obs_report_diagnosis_summary_accepts_device():
+    obs_report = _load_obs_report()
+    events = _dispatch_dominant_events()
+    s = obs_report.diagnosis_summary(
+        events, device={"mfu": 0.02, "dominant_class": "compute"})
+    assert s["verdict"] == "device_underutilized"
+    text = obs_report.render_diagnosis(s)
+    assert "device_underutilized" in text
+    assert "device evidence" in text and "MFU 2.0%" in text
+
+
+# -- real-engine compile ledger (full tier: XLA compiles) ------------------
+
+
+def test_engine_warm_and_cache_hit_miss_compile_ledger(tmp_path):
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.serve.engine import ServingEngine
+
+    cfg = override(get_config("smoke"), ["model.image_size=32"])
+    cfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, max_batch=4, bucket_sizes=(4,),
+        compile_cache_dir=str(tmp_path / "cc"),
+    ))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_ensemble_state(cfg, model, [0])
+
+    reg1 = Registry()
+    eng1 = ServingEngine(cfg, model=model, state=state, registry=reg1)
+    c1 = reg1.snapshot()["counters"]
+    assert c1.get("serve.compile_cache.misses", 0) == 1
+    assert c1.get("device.compile.count", 0) >= 1
+    snap = device_lib.compile_ledger().snapshot()
+    assert any(e["signature"] == "serve_b4" for e in snap["entries"])
+    imgs = np.zeros((4, 32, 32, 3), np.uint8)
+    ref = eng1.probs(imgs)
+
+    # Same cache dir, fresh registry: the warm is a HIT — no serve_b4
+    # miss-compile, and the stored compile seconds are credited.
+    device_lib.reset_for_tests()
+    reg2 = Registry()
+    eng2 = ServingEngine(cfg, model=model, state=state, registry=reg2)
+    c2 = reg2.snapshot()["counters"]
+    assert c2.get("serve.compile_cache.hits", 0) == 1
+    assert c2.get("serve.compile_cache.misses", 0) == 0
+    assert c2.get("device.compile.saved_sec", 0) > 0
+    snap2 = device_lib.compile_ledger().snapshot()
+    assert not any(e["signature"] == "serve_b4"
+                   for e in snap2["entries"])
+    # The deserialized program is registered for dispatch counting and
+    # serves the same math.
+    np.testing.assert_array_equal(eng2.probs(imgs), ref)
+    entry = device_lib.program_ledger().get("serve_b4")
+    assert entry is not None and entry.calls >= 1
+    del eng1, eng2
+    jax.clear_caches()

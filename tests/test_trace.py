@@ -1011,7 +1011,7 @@ def test_obs_report_diagnose_text_and_json(tmp_path, capsys):
     diag = obj["diagnosis"]
     assert set(diag) == {"verdict", "code", "confidence", "evidence",
                          "totals_s", "n_events", "request_waterfalls",
-                         "step_waterfalls"}
+                         "step_waterfalls", "device"}
     assert diag["verdict"] == "device_bound" and diag["code"] == 1
     assert set(diag["evidence"]) == {"device", "decode", "credit",
                                      "h2d", "queue", "other"}
