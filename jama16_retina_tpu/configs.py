@@ -775,6 +775,50 @@ class LifecycleConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AuditConfig:
+    """Prediction provenance & audit plane (ISSUE 20; obs/audit.py).
+
+    A sealed per-request ledger: every served row's trace id, input
+    digest, scores, per-threshold decisions, and full model lineage,
+    spooled through a bounded queue to a writer thread (serving never
+    blocks; overflow is counted ``audit.dropped``) and sealed into
+    ``seg-NNNNNN.json`` segments via the integrity/artifact seam.
+    ``scripts/audit_query.py`` answers lineage queries and replays a
+    recorded request bit-for-bit. Nested subsystem — override with
+    ``obs.audit.<field>=value``."""
+
+    # Master switch. Off (default) = no ledger is built; the serve hot
+    # path pays one attribute read + branch per request (pinned by
+    # bench.py's audit_overhead_pct guard when on).
+    enabled: bool = False
+    # Segment directory. Empty = "<obs workdir>/audit" at the wiring
+    # sites (predict.py --obs_workdir, engine.start_telemetry); with no
+    # workdir either, the ledger is skipped with a loud log line.
+    dir: str = ""
+    # Fraction of served requests recorded (deterministic every-Nth,
+    # like the staged-rollout shadow sampler): 1.0 audits everything,
+    # 0.1 every 10th request. <= 0 records nothing.
+    sample: float = 1.0
+    # Records per sealed segment: the writer seals (atomic sealed-JSON
+    # publish, fault site ``audit.seal``) every N records and at
+    # close(). Kill -9 loses at most the unsealed tail.
+    seal_every: int = 64
+    # Also spool the post-preprocess input tensors (consented capture;
+    # the rawshard-writer discipline: sealed .npy + sha256) so
+    # ``audit_query replay`` can re-score the exact served bytes — and
+    # ROADMAP item 4's continual-learning capture has its substrate.
+    # Off records digests only; replay then needs the original inputs.
+    capture: bool = False
+    # Newest SEALED segments retention GC keeps per audit dir
+    # (integrity/retention.py; the newest segment always survives).
+    # <= 0 = keep everything.
+    retention: int = 256
+    # Bounded spool depth (requests queued to the writer thread). A
+    # full queue DROPS the record — counted, never blocking serving.
+    queue_max: int = 1024
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Runtime-telemetry config (jama16_retina_tpu/obs/; ISSUE 3).
 
@@ -857,6 +901,10 @@ class ObsConfig:
     # rules. Nested because it is a subsystem, not a knob — override
     # with obs.quality.<field>=value.
     quality: QualityConfig = dataclasses.field(default_factory=QualityConfig)
+    # Prediction provenance & audit plane (ISSUE 20; obs/audit.py):
+    # sealed per-request ledger + lineage queries + deterministic
+    # replay. Nested subsystem — override with obs.audit.<field>=value.
+    audit: AuditConfig = dataclasses.field(default_factory=AuditConfig)
     # --- Reliability (ISSUE 6) -----------------------------------------
     # Deterministic fault-injection plan (obs/faultinject.py): a JSON
     # spec string or a path to one, armed at run/engine start. The

@@ -92,6 +92,12 @@ REBUILD = {
         "already taken; move it aside"
     ),
     "integrity.fsck": "re-run scripts/graftfsck.py on the workdir",
+    "audit.segment": (
+        "NOT derivable — a sealed audit segment is the provenance "
+        "record of already-served predictions; move it aside "
+        "(quarantine) and treat its records as lost (they are counted "
+        "audit.dropped only at write time, never retroactively)"
+    ),
 }
 
 # Short artifact-class names (what loaders/fsck tag corruption with:
@@ -105,6 +111,7 @@ REBUILD_BY_CLASS = {
     "profile": "quality.profile",
     "canary": "quality.canary",
     "ledger": "integrity.ledger",
+    "audit": "audit.segment",
 }
 
 
@@ -192,7 +199,7 @@ def count_corrupt(artifact: str, registry=None) -> None:
         f"integrity.corrupt.{artifact}",
         help="per-class corrupt-artifact detections "
              "(rawshard/journal/live/policy/compile_cache/profile/"
-             "canary/ledger)",
+             "canary/ledger/audit)",
     ).inc()
 
 

@@ -55,11 +55,18 @@ from jama16_retina_tpu.integrity import artifact as artifact_lib
 CLASSES = (
     "journal", "live", "policy", "profile", "canary",
     "rawshard", "compile_cache", "jsonl", "blackbox", "checkpoint",
-    "ledger", "other",
+    "ledger", "audit", "other",
 )
 
 _CANDIDATE_RE = re.compile(r"^candidate-(\d{4})$")
 _TMP_RE = re.compile(r"\.tmp(\.\d+)?$")
+# Sealed audit-ledger segments (obs/audit.py, ISSUE 20). The name
+# pattern is shared with fleet segment streams, so the walk requires
+# the canonical ``audit/`` parent for the name-based match (a torn,
+# unparseable segment still classifies there); segments in a custom
+# obs.audit.dir are caught by the ``kind: audit_segment`` sniff, which
+# needs a parseable document.
+_AUDIT_SEG_RE = re.compile(r"^seg-(\d{6})\.json$")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -260,6 +267,7 @@ _REBUILD_KEY = {
     "rawshard": "rawshard.manifest",
     "compile_cache": "compile_cache.manifest",
     "ledger": "integrity.ledger",
+    "audit": "audit.segment",
 }
 
 
@@ -540,6 +548,15 @@ def fsck_workdir(workdir: str, registry=None) -> FsckReport:
                                "(pre-ISSUE 13); re-save with "
                                "obs/quality.save_canary to seal it",
                     ))
+            elif (_AUDIT_SEG_RE.match(name)
+                  and os.path.basename(base) == "audit"):
+                # Only SEALED segments ever exist on disk (the writer
+                # buffers in memory and publishes atomically), so any
+                # torn/mismatched file here is damage, never a live
+                # segment mid-write.
+                count("audit", path)
+                _check_sealed_json(path, "audit", findings,
+                                   registry=registry)
             elif name.endswith(".jsonl"):
                 _check_jsonl(path, findings, checked)
             elif name.endswith(".json") and not in_blackbox:
@@ -562,6 +579,10 @@ def fsck_workdir(workdir: str, registry=None) -> FsckReport:
                 elif doc.get("kind") == "integrity_ledger":
                     count("ledger", path)
                     _check_sealed_json(path, "ledger", findings,
+                                       registry=registry)
+                elif doc.get("kind") == "audit_segment":
+                    count("audit", path)
+                    _check_sealed_json(path, "audit", findings,
                                        registry=registry)
             elif in_blackbox and name == "meta.json":
                 count("blackbox", path)

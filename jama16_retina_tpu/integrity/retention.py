@@ -60,7 +60,8 @@ class Action:
 
     kind: str
     path: str
-    cls: str    # blackbox | compile_cache | telemetry | fleet | checkpoint
+    cls: str    # blackbox | compile_cache | telemetry | fleet |
+    #             checkpoint | audit
     bytes: int
     reason: str
 
@@ -87,6 +88,24 @@ class RetentionPlan:
             "total_bytes": self.total_bytes,
             "pinned": sorted(self.pinned),
         }
+
+
+def _audit_capture_files(seg_path: str) -> "list[str]":
+    """Relative capture-file names the segment's records reference
+    (what rides along when the segment is GC'd). Best-effort: a
+    corrupt segment contributes nothing — graftfsck owns classifying
+    it, the GC plan stays pure."""
+    try:
+        doc, _seal = artifact_lib.read_sealed_json(seg_path,
+                                                   artifact="audit")
+    except Exception:  # noqa: BLE001 - fsck's job, not the planner's
+        return []
+    out = []
+    for rec in doc.get("records", ()):
+        cap = rec.get("capture") if isinstance(rec, dict) else None
+        if cap and cap.get("file"):
+            out.append(cap["file"])
+    return out
 
 
 def _tree_bytes(path: str) -> int:
@@ -238,6 +257,38 @@ def plan_retention(workdir: str, cfg) -> RetentionPlan:
                      "kept)")
                 total -= sizes[n]
 
+    # 3c) Audit-ledger segments (ISSUE 20): each ``audit/`` dir keeps
+    #     its newest obs.audit.retention SEALED segments — oldest
+    #     deleted first, the newest always implicitly survives
+    #     (retention >= 1), and a deleted segment takes its captured
+    #     input tensors with it (capture file names embed the segment
+    #     number, so they are referenced by exactly one segment).
+    #     retention <= 0 keeps everything (the medico-legal default is
+    #     deliberately generous; pruning is an explicit opt-in).
+    akeep = int(cfg.obs.audit.retention)
+    if akeep > 0:
+        from jama16_retina_tpu.obs import audit as audit_lib
+        for base, dirs, files in os.walk(workdir):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("quarantine", "blackbox")
+            )
+            if os.path.basename(base) != "audit":
+                continue
+            segs = sorted(
+                n for n in files if audit_lib.SEGMENT_RE.match(n)
+            )
+            for n in segs[: max(0, len(segs) - akeep)]:
+                p = os.path.join(base, n)
+                plan("delete", p, "audit",
+                     f"beyond obs.audit.retention={akeep} (oldest "
+                     "sealed audit segments first)")
+                for cap in _audit_capture_files(p):
+                    cp = os.path.join(base, cap)
+                    if os.path.exists(cp):
+                        plan("delete", cp, "audit",
+                             "captured input tensor referenced only "
+                             "by a GC'd audit segment")
+
     # 4) Retired lifecycle candidate sets + canary backups. An
     #    unreadable journal freezes this class: collecting candidates
     #    blind could eat a half-done rollout's work.
@@ -320,7 +371,8 @@ def apply_plan(plan: RetentionPlan, registry=None) -> dict:
         reg.counter(
             f"integrity.gc.deleted.{a.cls}",
             help="retention-GC removals per artifact class "
-                 "(blackbox/compile_cache/telemetry/fleet/checkpoint)",
+                 "(blackbox/compile_cache/telemetry/fleet/checkpoint/"
+                 "audit)",
         ).inc()
         c_deleted.inc()
         c_bytes.inc(a.bytes)

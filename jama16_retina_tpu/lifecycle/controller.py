@@ -428,7 +428,41 @@ class LifecycleController:
         return self._arrive(
             "RETRAIN", cycle=self.journal.cycle,
             member_dirs=list(member_dirs), n_members=len(member_dirs),
+            # Training-data provenance (ISSUE 20): the rawshard
+            # manifest (path + content digest) the cycle trained from,
+            # when one exists — the link `audit_query trace` renders
+            # between a served score and its training data.
+            data_dir=self.data_dir or None,
+            data_manifest=self._data_manifest(),
         )
+
+    def _data_manifest(self) -> "dict | None":
+        """The train-split rawshard manifest identity for this cycle's
+        data_dir (data.rawshard_dir wins, then the size-suffixed
+        default location), or None — advisory lineage, never a step
+        failure."""
+        if not self.data_dir:
+            return None
+        try:
+            from jama16_retina_tpu.data import rawshard
+            from jama16_retina_tpu.integrity import (
+                artifact as artifact_lib,
+            )
+
+            dcfg = self.cfg.data
+            shard_dir = (
+                getattr(dcfg, "rawshard_dir", "")
+                or rawshard.default_shard_dir(
+                    self.data_dir, self.cfg.model.image_size
+                )
+            )
+            path = rawshard.manifest_path(shard_dir, "train")
+            if not os.path.exists(path):
+                return None
+            return {"path": path,
+                    "sha256": artifact_lib.sha256_file(path)}
+        except Exception:  # noqa: BLE001 - lineage is advisory here
+            return None
 
     def _step_gate(self) -> dict:
         member_dirs = self.journal.find("RETRAIN")["member_dirs"]

@@ -1,0 +1,859 @@
+"""Prediction provenance & audit plane (ISSUE 20).
+
+A served score in a diabetic-retinopathy screen is a clinical decision;
+this module makes every one attributable and reproducible after the
+fact. The :class:`AuditLedger` records, per served request, the PR-15
+trace id, a sha256 digest of every post-preprocess input row, the
+scores, the per-threshold decisions, and the full model lineage (engine
+generation, member checkpoint dirs + content digests, cascade path
+taken, serve dtype, bucket shapes, policy artifact provenance, canary
+status at serve time) — and ``scripts/audit_query.py`` answers
+``trace <id>`` (the complete lineage chain through the lifecycle
+journal) and ``replay <id>`` (reassemble the recorded generation and
+re-score the audited request, bit-identical on fp32).
+
+Design constraints, in the serve path's order:
+
+  * SERVING NEVER BLOCKS. ``record()`` is a sampling decision + one
+    bounded-queue ``put_nowait``; a full spool DROPS the record
+    (counted ``audit.dropped``), and every exception inside the audit
+    plane is counted and swallowed. The hot-path cost is pinned by
+    bench.py's ``audit_overhead_pct`` guard (same ≤2% budget as the
+    telemetry pin).
+  * DURABILITY IS SEGMENTED. A daemon writer thread drains the spool
+    and seals ``seg-NNNNNN.json`` segments (``obs.audit.seal_every``
+    records each, plus the tail at ``close()``) through the PR-13
+    sealed-artifact seam — atomic publish, content digest, the
+    ``audit.seal`` fault site for chaos drills. kill -9 loses at most
+    the unsealed tail; restart resumes a FRESH segment number after the
+    existing maximum, never overwriting a sealed one.
+  * CAPTURE IS OPT-IN. ``obs.audit.capture`` additionally spools the
+    consented input tensors through the rawshard writer discipline
+    (sealed ``.npy`` + sha256) — what ``replay`` re-scores, and the
+    capture substrate ROADMAP item 4's continual learning needs.
+
+Digests and lineage hashing run on the WRITER thread, never the
+request path; member-checkpoint content digests are cached per
+directory for the life of the process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import queue
+import re
+import threading
+import time
+
+import numpy as np
+from absl import logging as absl_logging
+
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import registry as obs_registry
+from jama16_retina_tpu.obs import trace as obs_trace
+
+SEGMENT_SCHEMA = "audit.segment"
+SEGMENT_VERSION = 1
+RECORD_VERSION = 1
+
+# Sealed segment files: seg-000000.json, seg-000001.json, ... — the
+# FleetBus naming discipline, so fsck/retention walk them the same way.
+SEGMENT_RE = re.compile(r"^seg-(\d{6})\.json$")
+
+# Replay tolerance band per serving dtype: fp32 replays BIT-identical
+# (the acceptance pin); reduced-precision serving legitimately moves
+# scores within the same bounds the engine's own load-time parity check
+# accepts (serve/quantize.py), so replay bands rather than pins there.
+REPLAY_TOLERANCE = {"fp32": 0.0, "bf16": 1e-2, "int8": 5e-2}
+
+_STOP = object()
+
+
+def segment_paths(audit_dir: str) -> "list[str]":
+    """Sealed segment files of one audit dir, oldest first."""
+    try:
+        names = sorted(
+            n for n in os.listdir(audit_dir) if SEGMENT_RE.match(n)
+        )
+    except OSError:
+        return []
+    return [os.path.join(audit_dir, n) for n in names]
+
+
+def row_digests(images) -> "list[str]":
+    """sha256 hex digest per post-preprocess input row — the identity
+    replay verifies before trusting a captured tensor."""
+    arr = np.ascontiguousarray(np.asarray(images))
+    return [hashlib.sha256(arr[i].tobytes()).hexdigest()
+            for i in range(arr.shape[0])]
+
+
+# Checkpoint-directory content digests are immutable once written (a
+# retrain writes a NEW candidate dir), so one walk per directory per
+# process is enough — and it runs on the audit writer thread, never the
+# request path.
+_dir_digest_cache: "dict[str, str]" = {}
+_dir_digest_lock = threading.Lock()
+
+
+def checkpoint_digest(member_dir: str) -> str:
+    """Content digest of one member checkpoint dir: sha256 over the
+    sorted (relative path, size, file sha256) listing. What the audit
+    record pins as lineage and what replay re-verifies — a swapped or
+    edited checkpoint flips this even when the path is unchanged."""
+    key = os.path.abspath(member_dir)
+    with _dir_digest_lock:
+        got = _dir_digest_cache.get(key)
+    if got is not None:
+        return got
+    h = hashlib.sha256()
+    if os.path.isdir(key):
+        for root, dirs, files in sorted(os.walk(key)):
+            dirs.sort()
+            for name in sorted(files):
+                p = os.path.join(root, name)
+                try:
+                    h.update(os.path.relpath(p, key).encode())
+                    h.update(str(os.path.getsize(p)).encode())
+                    h.update(artifact_lib.sha256_file(p).encode())
+                except OSError:
+                    h.update(b"<unreadable>")
+    else:
+        h.update(b"<missing>")
+    digest = h.hexdigest()
+    with _dir_digest_lock:
+        _dir_digest_cache[key] = digest
+    return digest
+
+
+def _referable(scores) -> np.ndarray:
+    """Scores -> referable probability [n] for either head (the scalar
+    per-threshold decisions are made on)."""
+    s = np.asarray(scores, np.float64)
+    if s.ndim == 2:
+        from jama16_retina_tpu.eval import metrics
+
+        s = np.asarray(
+            metrics.referable_probs_from_multiclass(s), np.float64
+        )
+    return s.ravel()
+
+
+class AuditLedger:
+    """Off-request-path sealed audit ledger (see module docstring).
+
+    ``thresholds``: the operating thresholds per-row decisions are
+    recorded at (the evaluate.py operating points; empty records
+    probabilities only). ``config_name``/``config_overrides`` pin how
+    the serving config was built, so ``replay`` can rebuild the exact
+    engine; ``policy_provenance`` is the resolved serve-policy artifact
+    identity (serve/policy.py) stamped into every record.
+    """
+
+    def __init__(self, audit_dir: str, *,
+                 registry: "obs_registry.Registry | None" = None,
+                 sample: float = 1.0, seal_every: int = 64,
+                 capture: bool = False, queue_max: int = 1024,
+                 thresholds=(), config_name: str = "",
+                 config_overrides=(),
+                 policy_provenance: "dict | None" = None):
+        self.dir = audit_dir
+        os.makedirs(audit_dir, exist_ok=True)
+        self.sample = float(sample)
+        # Deterministic every-Nth sampling (the shadow sampler's
+        # discipline): sample=1.0 audits everything, 0.1 every 10th
+        # request; <= 0 records nothing.
+        self._every = (0 if self.sample <= 0
+                       else max(1, int(round(1.0 / min(1.0, self.sample)))))
+        self.seal_every = max(1, int(seal_every))
+        self.capture = bool(capture)
+        self.thresholds = tuple(float(t) for t in thresholds)
+        self.config_name = str(config_name)
+        self.config_overrides = tuple(str(o) for o in config_overrides)
+        self.policy_provenance = (
+            dict(policy_provenance) if policy_provenance else None
+        )
+        reg = (registry if registry is not None
+               else obs_registry.default_registry())
+        self._registry = reg
+        self._c_records = reg.counter(
+            "audit.records",
+            help="served-request audit records accepted into the spool "
+                 "(post-sampling; audit plane, ISSUE 20)",
+        )
+        self._c_rows = reg.counter(
+            "audit.rows",
+            help="served rows covered by accepted audit records",
+        )
+        self._c_dropped = reg.counter(
+            "audit.dropped",
+            help="audit records LOST: spool full, writer stopped, or a "
+                 "failed segment seal — serving is never blocked for "
+                 "audit durability, losses are counted instead",
+        )
+        self._c_sealed = reg.counter(
+            "audit.sealed_segments",
+            help="audit segments sealed durably (atomic sealed-JSON "
+                 "publish via the integrity/artifact seam)",
+        )
+        self._c_seal_errors = reg.counter(
+            "audit.seal_errors",
+            help="audit segment seal attempts that failed (disk fault, "
+                 "injected audit.seal chaos) — the segment's records "
+                 "are dropped and counted, the writer keeps going",
+        )
+        self._c_captured = reg.counter(
+            "audit.captured",
+            help="input tensors spooled by obs.audit.capture via the "
+                 "rawshard writer discipline (sealed .npy + sha256)",
+        )
+        self._g_depth = reg.gauge(
+            "audit.spool_depth",
+            help="audit records queued to the writer thread (bounded "
+                 "at obs.audit.queue_max; a persistently full spool "
+                 "drops records)",
+        )
+        self._g_last_seal = reg.gauge(
+            "audit.last_seal_t",
+            help="unix time of the last durable audit segment seal "
+                 "(0 = none yet); /healthz derives "
+                 "audit_last_seal_age_s from it",
+        )
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(queue_max)))
+        self._count = 0
+        self._count_lock = threading.Lock()
+        self._closed = False
+        # Resume numbering after the existing maximum: a restarted
+        # process begins a FRESH segment, never overwriting sealed
+        # history (the kill -9 crash-semantics contract).
+        seq = -1
+        for p in segment_paths(audit_dir):
+            m = SEGMENT_RE.match(os.path.basename(p))
+            if m:
+                seq = max(seq, int(m.group(1)))
+        self._seg_seq = seq + 1
+        self._buf: list = []
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="jama16-audit-writer",
+            daemon=True,
+        )
+        self._writer.start()
+
+    # -- the serving-side surface (never blocks, never raises) -----------
+
+    @property
+    def spool_depth(self) -> int:
+        return self._q.qsize()
+
+    def record(self, images, scores, *, trace_id: "str | None" = None,
+               model: str = "default", replica: "int | None" = None,
+               generation: "int | None" = None, member_dirs=None,
+               engine=None, escalated=None, speculative: bool = False,
+               cascade: "dict | None" = None) -> bool:
+        """Enqueue one served request for audit. Returns True when the
+        record was accepted (sampled in AND the spool had room); every
+        failure path is counted, none raises into serving."""
+        try:
+            if self._closed or self._every == 0:
+                return False
+            with self._count_lock:
+                self._count += 1
+                if self._count % self._every:
+                    return False
+            if trace_id is None:
+                ctx = obs_trace.current_context()
+                trace_id = ctx.trace_id if ctx is not None else None
+            item = {
+                "images": np.asarray(images),
+                "scores": np.asarray(scores),
+                "trace_id": trace_id,
+                "model": str(model),
+                "replica": replica,
+                "generation": generation,
+                "member_dirs": (list(member_dirs)
+                                if member_dirs is not None else None),
+                "engine": engine,
+                "escalated": (np.asarray(escalated, bool).tolist()
+                              if escalated is not None else None),
+                "speculative": bool(speculative),
+                "cascade": dict(cascade) if cascade else None,
+                "t": time.time(),
+            }
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                self._c_dropped.inc()
+                return False
+            self._c_records.inc()
+            self._c_rows.inc(int(item["images"].shape[0]))
+            self._g_depth.set(self._q.qsize())
+            return True
+        except Exception as e:  # noqa: BLE001 - audit must never fail serving
+            self._c_dropped.inc()
+            absl_logging.error(
+                "audit record failed (request unaffected): %s: %s",
+                type(e).__name__, e,
+            )
+            return False
+
+    # -- the writer thread ------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            if "__seal__" in item:  # a flush() checkpoint request
+                self._seal()
+                item["__seal__"].set()
+                continue
+            self._g_depth.set(self._q.qsize())
+            try:
+                self._buf.append(self._build_record(item))
+            except Exception as e:  # noqa: BLE001 - counted, not fatal
+                self._c_dropped.inc()
+                absl_logging.error(
+                    "audit record build failed: %s: %s",
+                    type(e).__name__, e,
+                )
+            if len(self._buf) >= self.seal_every:
+                self._seal()
+        self._seal()  # the tail, on close()
+
+    def _lineage(self, item: dict) -> dict:
+        """The model-lineage half of a record, resolved on the writer
+        thread: generation id, member dirs + cached content digests,
+        serve dtype and bucket shapes, plus the cascade path taken."""
+        engine = item["engine"]
+        member_dirs = item["member_dirs"]
+        generation = item["generation"]
+        # A routed replica may be a composed CascadeEngine: its
+        # ensemble half carries the generation lineage (and, when the
+        # record didn't already, the cascade identity).
+        ens = getattr(engine, "ensemble", None)
+        if ens is not None and not hasattr(engine, "_gen"):
+            if item["cascade"] is None:
+                sgen = getattr(
+                    getattr(engine, "student", None), "_gen", None
+                )
+                if sgen is not None:
+                    # escalated stays None: the per-row mask is
+                    # internal to the cascade at this seam — the
+                    # record is honest about what it pinned, and
+                    # replay reports such records unreplayable
+                    # rather than guessing the path.
+                    item["cascade"] = {
+                        "student_dirs": list(sgen.member_dirs)
+                    }
+            engine = ens
+        if member_dirs is None and engine is not None:
+            gen = getattr(engine, "_gen", None)
+            if gen is not None and (generation is None
+                                    or int(gen.gen_id) == int(generation)):
+                member_dirs = gen.member_dirs
+                if generation is None:
+                    generation = int(gen.gen_id)
+        out = {
+            "generation": (int(generation)
+                           if generation is not None else None),
+            "member_dirs": (list(member_dirs)
+                            if member_dirs else None),
+            "member_digests": (
+                {d: checkpoint_digest(d) for d in member_dirs}
+                if member_dirs else None
+            ),
+            "serve_dtype": str(getattr(engine, "dtype", "fp32")),
+            "buckets": [int(b) for b in getattr(engine, "buckets", ())],
+            "max_batch": None,
+        }
+        cfg = getattr(engine, "cfg", None)
+        if cfg is not None:
+            out["max_batch"] = int(cfg.serve.max_batch)
+        if item["escalated"] is not None or item["cascade"] is not None:
+            out["cascade"] = {
+                "escalated": item["escalated"],
+                "speculative": item["speculative"],
+                **(item["cascade"] or {}),
+            }
+        return out
+
+    def _build_record(self, item: dict) -> dict:
+        images, scores = item["images"], item["scores"]
+        ref = _referable(scores)
+        rec = {
+            "record_version": RECORD_VERSION,
+            "t": round(item["t"], 3),
+            "trace_id": item["trace_id"],
+            "model": item["model"],
+            "replica": item["replica"],
+            "n": int(images.shape[0]),
+            "input_sha256": row_digests(images),
+            "scores": np.asarray(scores, np.float64).tolist(),
+            "referable": ref.tolist(),
+            "decisions": {
+                f"{t:g}": (ref >= t).tolist() for t in self.thresholds
+            },
+            **self._lineage(item),
+            "policy": self.policy_provenance,
+            "canary_ok": self._canary_status(),
+            "config": {
+                "name": self.config_name,
+                "overrides": list(self.config_overrides),
+            },
+        }
+        if self.capture:
+            rec["capture"] = self._capture(item, images)
+        return rec
+
+    def _canary_status(self) -> "float | None":
+        """The golden-canary gauge AT SERVE TIME (None when no canary
+        is wired) — read, never created: registering the gauge here
+        would make an un-monitored deployment look like a failing one."""
+        g = self._registry.peek("quality.canary_ok")
+        return float(g.value) if g is not None else None
+
+    def _capture(self, item: dict, images) -> "dict | None":
+        """Spool the consented input tensor through the rawshard
+        writer discipline (sealed atomic .npy; the sha256 of the
+        written bytes rides the record, so replay verifies the file
+        before trusting it)."""
+        try:
+            from jama16_retina_tpu.data import rawshard
+
+            cap_dir = os.path.join(self.dir, "capture")
+            os.makedirs(cap_dir, exist_ok=True)
+            name = f"cap-{self._seg_seq:06d}-{len(self._buf):04d}.npy"
+            digest = rawshard._atomic_save(
+                os.path.join(cap_dir, name), np.asarray(images)
+            )
+            self._c_captured.inc()
+            return {"file": os.path.join("capture", name),
+                    "sha256": digest}
+        except Exception as e:  # noqa: BLE001 - counted, not fatal
+            self._c_dropped.inc()
+            absl_logging.error(
+                "audit capture failed (record kept, digests only): "
+                "%s: %s", type(e).__name__, e,
+            )
+            return None
+
+    def _seal(self) -> None:
+        """Durably publish the buffered records as one sealed segment.
+        A failure (real disk fault or the ``audit.seal`` chaos site)
+        loses exactly this segment's records — counted twice over
+        (``audit.seal_errors`` + per-record ``audit.dropped``), logged,
+        and the writer keeps draining; serving never notices."""
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        path = os.path.join(self.dir, f"seg-{self._seg_seq:06d}.json")
+        try:
+            faultinject.check("audit.seal")
+            artifact_lib.write_sealed_json(path, {
+                "kind": "audit_segment",
+                "seq": self._seg_seq,
+                "records": buf,
+            }, schema=SEGMENT_SCHEMA, version=SEGMENT_VERSION)
+        except Exception as e:  # noqa: BLE001 - counted, not fatal
+            self._c_seal_errors.inc()
+            self._c_dropped.inc(len(buf))
+            absl_logging.error(
+                "audit segment seal failed (%d records lost): %s: %s",
+                len(buf), type(e).__name__, e,
+            )
+            return
+        self._seg_seq += 1
+        self._c_sealed.inc()
+        self._g_last_seal.set(time.time())
+
+    # -- control ----------------------------------------------------------
+
+    def flush(self, timeout_s: float = 10.0) -> None:
+        """Drain the spool and seal everything buffered so far (tests,
+        smoke, cadence callers). Serving-side ``record`` keeps working
+        afterwards — this is a checkpoint, not a close."""
+        deadline = time.monotonic() + timeout_s
+        while (not self._q.empty()) and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # One sentinel round-trip makes the writer seal its buffer:
+        # re-arm the loop by sending a no-op seal request.
+        evt = threading.Event()
+        self._q.put({"__seal__": evt})
+        evt.wait(timeout=max(0.0, deadline - time.monotonic()))
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop the writer and seal the tail. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_STOP)
+        self._writer.join(timeout=timeout_s)
+        self._g_depth.set(0)
+
+
+def resolve_audit_dir(cfg, workdir: "str | None") -> "str | None":
+    """Where the ledger spools: ``obs.audit.dir`` wins; empty falls
+    back to ``<workdir>/audit``; neither = None (skip, loudly)."""
+    ac = cfg.obs.audit
+    if ac.dir:
+        return ac.dir
+    if workdir:
+        return os.path.join(workdir, "audit")
+    return None
+
+
+def ledger_for(cfg, workdir: "str | None" = None, *,
+               registry: "obs_registry.Registry | None" = None,
+               thresholds=None, config_overrides=(),
+               policy_provenance: "dict | None" = None
+               ) -> "AuditLedger | None":
+    """The wiring-site constructor: None when ``obs.audit.enabled`` is
+    off (one branch at the call site) or no directory is resolvable.
+    ``thresholds`` defaults to ``serve.cascade_thresholds`` — the
+    operating points the deployment decides on."""
+    ac = cfg.obs.audit
+    if not ac.enabled:
+        return None
+    audit_dir = resolve_audit_dir(cfg, workdir)
+    if audit_dir is None:
+        absl_logging.error(
+            "obs.audit.enabled is set but neither obs.audit.dir nor a "
+            "workdir is available — audit ledger NOT started"
+        )
+        return None
+    if thresholds is None:
+        thresholds = cfg.serve.cascade_thresholds or ()
+    return AuditLedger(
+        audit_dir,
+        registry=registry,
+        sample=ac.sample,
+        seal_every=ac.seal_every,
+        capture=ac.capture,
+        queue_max=ac.queue_max,
+        thresholds=thresholds,
+        config_name=cfg.name,
+        config_overrides=config_overrides,
+        policy_provenance=policy_provenance,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Readers: lineage queries + deterministic replay (scripts/audit_query.py)
+# ---------------------------------------------------------------------------
+
+
+def iter_records(audit_dir: str, strict: bool = False):
+    """Yield ``(record, segment_path)`` across every sealed segment,
+    oldest first. A corrupt/torn segment raises in ``strict`` mode;
+    otherwise it is skipped with a loud log line (graftfsck is the
+    classifier, the query tool the survivor)."""
+    for path in segment_paths(audit_dir):
+        try:
+            payload, _seal = artifact_lib.read_sealed_json(
+                path, artifact="audit"
+            )
+        except Exception as e:  # noqa: BLE001 - skip damaged segments
+            if strict:
+                raise
+            absl_logging.warning(
+                "audit segment %s unreadable (%s: %s) — skipped; run "
+                "scripts/graftfsck.py to classify",
+                path, type(e).__name__, e,
+            )
+            continue
+        for rec in payload.get("records", ()):
+            yield rec, path
+
+
+def find_records(audit_dir: str, trace_id: str) -> "list[dict]":
+    """Every sealed record carrying ``trace_id`` (a multi-bin routed
+    request, or a fused bin's per-request slices, may have several)."""
+    return [rec for rec, _p in iter_records(audit_dir)
+            if rec.get("trace_id") == trace_id]
+
+
+def _load_journal_entries(journal_dir: str) -> "list[dict]":
+    path = os.path.join(journal_dir, "journal.json")
+    if not os.path.exists(path):
+        return []
+    doc, _seal = artifact_lib.read_sealed_json(path, artifact="journal")
+    return list(doc.get("entries", ()))
+
+
+def lineage_chain(record: dict,
+                  journal_dir: "str | None" = None) -> dict:
+    """The complete provenance chain behind one audit record: score ->
+    generation -> promoting lifecycle cycle -> gate verdicts ->
+    training data manifest -> warm-start donors. Journal-less
+    deployments (a bare predict batch) get the record's own lineage
+    with ``cycle: None`` — every link that exists is rendered, none is
+    invented."""
+    chain = {
+        "trace_id": record.get("trace_id"),
+        "model": record.get("model"),
+        "generation": record.get("generation"),
+        "member_dirs": record.get("member_dirs"),
+        "member_digests": record.get("member_digests"),
+        "serve_dtype": record.get("serve_dtype"),
+        "policy": record.get("policy"),
+        "canary_ok": record.get("canary_ok"),
+        "cascade": record.get("cascade"),
+        "cycle": None,
+    }
+    if not journal_dir:
+        return chain
+    entries = _load_journal_entries(journal_dir)
+    gen = record.get("generation")
+    cycle = None
+    for e in entries:
+        if (e.get("state") in ("STAGED_ROLLOUT", "COMMIT")
+                and e.get("generation") == gen):
+            cycle = e["cycle"]
+    if cycle is None:
+        return chain
+    ce = [e for e in entries if e.get("cycle") == cycle]
+
+    def _find(state):
+        for e in reversed(ce):
+            if e.get("state") == state:
+                return e
+        return None
+
+    drift = _find("DRIFT_DETECTED")
+    retrain = _find("RETRAIN")
+    gate = _find("GATE")
+    chain["cycle"] = cycle
+    chain["drift"] = drift
+    chain["retrain"] = retrain
+    chain["gate_verdicts"] = gate.get("verdicts") if gate else None
+    chain["rollout"] = _find("STAGED_ROLLOUT")
+    chain["commit"] = _find("COMMIT")
+    # Warm-start donors: the live set the cycle's trigger snapshotted
+    # (what RETRAIN fine-tuned from), refined per member by the durable
+    # RETRAIN_DONE markers when the candidate dirs still exist.
+    donors = list((drift or {}).get("live_member_dirs") or ())
+    markers = []
+    for d in (retrain or {}).get("member_dirs") or ():
+        marker = os.path.join(d, "RETRAIN_DONE.json")
+        if os.path.exists(marker):
+            try:
+                doc, _seal = artifact_lib.read_sealed_json(marker)
+                markers.append({"member_dir": d,
+                                "init_from": doc.get("init_from"),
+                                "steps": doc.get("steps"),
+                                "best_auc": doc.get("best_auc")})
+            except Exception:  # noqa: BLE001 - marker is advisory
+                pass
+    chain["warm_start_donors"] = donors or None
+    chain["retrain_markers"] = markers or None
+    chain["data_manifest"] = (retrain or {}).get("data_manifest")
+    return chain
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayVerdict:
+    """Typed outcome of one deterministic replay."""
+
+    trace_id: "str | None"
+    ok: bool
+    kind: str  # bit_equal | within_band | score_mismatch |
+    #            lineage_changed | no_capture | unreplayable
+    dtype: str = "fp32"
+    max_abs_dev: "float | None" = None
+    tolerance: float = 0.0
+    detail: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _replay_config(record: dict, extra_overrides=()):
+    """Rebuild the serving config the record was scored under: the
+    recorded preset + recorded overrides (+ caller extras), then the
+    recorded serve dtype / bucket shapes pinned on top — the shapes,
+    and therefore the fp32 bits, match the served dispatch exactly."""
+    from jama16_retina_tpu import configs
+
+    cfg = configs.get_config(record["config"]["name"])
+    ov = list(record["config"].get("overrides") or ())
+    ov += list(extra_overrides)
+    if ov:
+        cfg = configs.override(cfg, ov)
+    serve = dataclasses.replace(
+        cfg.serve,
+        dtype=record.get("serve_dtype", "fp32"),
+        bucket_sizes=tuple(record.get("buckets") or ()),
+        **({"max_batch": int(record["max_batch"])}
+           if record.get("max_batch") else {}),
+    )
+    return cfg.replace(serve=serve)
+
+
+def load_captured(audit_dir: str, record: dict) -> np.ndarray:
+    """The captured input tensor, verified against the record twice:
+    file bytes vs the capture sha256, then per-row digests vs
+    ``input_sha256`` — replay must score the exact served bytes or
+    refuse."""
+    cap = record.get("capture")
+    if not cap:
+        raise FileNotFoundError(
+            "record carries no captured input (obs.audit.capture was "
+            "off at serve time) — replay needs the original tensors"
+        )
+    path = os.path.join(audit_dir, cap["file"])
+    actual = artifact_lib.sha256_file(path)
+    if actual != cap["sha256"]:
+        artifact_lib.count_corrupt("audit")
+        raise artifact_lib.ArtifactCorrupt(
+            path, cap["sha256"], actual, artifact="audit",
+            detail="captured audit tensor",
+        )
+    images = np.load(path)
+    if row_digests(images) != record["input_sha256"]:
+        raise ValueError(
+            f"captured tensor {path} does not match the record's "
+            "per-row input digests — refusing to replay"
+        )
+    return images
+
+
+def replay_record(record: dict, audit_dir: str, *,
+                  extra_overrides=(), workdir: "str | None" = None,
+                  registry: "obs_registry.Registry | None" = None
+                  ) -> ReplayVerdict:
+    """Reassemble the recorded generation through the EngineSpec/
+    compile-cache path, re-score the audited request, and pin the
+    outcome: fp32 BIT-identical, reduced precision tolerance-banded.
+    A mismatch (or changed lineage) returns a typed verdict and dumps
+    an ``audit_replay_mismatch`` blackbox into ``workdir``."""
+    dtype = str(record.get("serve_dtype", "fp32"))
+    trace_id = record.get("trace_id")
+    member_dirs = record.get("member_dirs")
+    if not member_dirs:
+        return _mismatch(ReplayVerdict(
+            trace_id=trace_id, ok=False, kind="lineage_changed",
+            dtype=dtype, detail="record carries no member dirs",
+        ), record, workdir, registry)
+    # Lineage first: replay through a swapped checkpoint would compare
+    # scores of a DIFFERENT model and call the ledger a liar.
+    want = record.get("member_digests") or {}
+    for d in member_dirs:
+        have = checkpoint_digest(d)
+        if want.get(d) and have != want[d]:
+            return _mismatch(ReplayVerdict(
+                trace_id=trace_id, ok=False, kind="lineage_changed",
+                dtype=dtype,
+                detail=f"checkpoint {d} digest {have[:12]} != sealed "
+                       f"{want[d][:12]}",
+            ), record, workdir, registry)
+    casc = record.get("cascade")
+    if (casc and casc.get("student_dirs")
+            and casc.get("escalated") is None):
+        return ReplayVerdict(
+            trace_id=trace_id, ok=False, kind="unreplayable",
+            dtype=dtype,
+            detail="cascade record without a sealed escalation "
+                   "mask (routed-replica seam) — the served path "
+                   "cannot be re-walked deterministically",
+        )
+    try:
+        images = load_captured(audit_dir, record)
+    except FileNotFoundError as e:
+        return ReplayVerdict(trace_id=trace_id, ok=False,
+                             kind="no_capture", dtype=dtype,
+                             detail=str(e))
+    from jama16_retina_tpu import models
+    from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
+
+    cfg = _replay_config(record, extra_overrides)
+    model = models.build(cfg.model)
+    if casc and casc.get("student_dirs"):
+        replayed = _replay_cascade(cfg, model, record, images)
+    else:
+        engine = assemble(EngineSpec(
+            cfg=cfg, member_dirs=tuple(member_dirs), model=model,
+            cascade=False,
+        ))
+        replayed = np.asarray(engine.probs(images), np.float64)
+    served = np.asarray(record["scores"], np.float64)
+    if replayed.shape != served.shape:
+        return _mismatch(ReplayVerdict(
+            trace_id=trace_id, ok=False, kind="score_mismatch",
+            dtype=dtype,
+            detail=f"shape {replayed.shape} vs sealed {served.shape}",
+        ), record, workdir, registry)
+    dev = float(np.max(np.abs(replayed - served))) if served.size else 0.0
+    tol = REPLAY_TOLERANCE.get(dtype, 0.0)
+    if dtype == "fp32":
+        if np.array_equal(replayed, served):
+            return ReplayVerdict(trace_id=trace_id, ok=True,
+                                 kind="bit_equal", dtype=dtype,
+                                 max_abs_dev=dev, tolerance=0.0)
+    elif dev <= tol:
+        return ReplayVerdict(trace_id=trace_id, ok=True,
+                             kind="within_band", dtype=dtype,
+                             max_abs_dev=dev, tolerance=tol)
+    return _mismatch(ReplayVerdict(
+        trace_id=trace_id, ok=False, kind="score_mismatch", dtype=dtype,
+        max_abs_dev=dev, tolerance=tol,
+        detail=f"max |replayed - served| = {dev:g} (tolerance {tol:g})",
+    ), record, workdir, registry)
+
+
+def _replay_cascade(cfg, model, record: dict, images) -> np.ndarray:
+    """Re-walk the recorded cascade path: the student scores every
+    row, the recorded escalation mask (the path TAKEN, not recomputed)
+    selects which rows the full ensemble re-scores — the same bucket
+    shapes as the served dispatch, so fp32 stays bit-identical."""
+    from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
+
+    casc = record["cascade"]
+    student = assemble(EngineSpec(
+        cfg=cfg, member_dirs=tuple(casc["student_dirs"]), model=model,
+        cascade=False,
+    ))
+    out = np.asarray(student.probs(images), np.float64)
+    mask = np.asarray(casc.get("escalated") or (), bool)
+    if mask.any():
+        ensemble = assemble(EngineSpec(
+            cfg=cfg, member_dirs=tuple(record["member_dirs"]),
+            model=model, cascade=False,
+        ))
+        out = np.array(out)
+        if casc.get("speculative"):
+            esc = np.asarray(ensemble.probs(images), np.float64)
+            out[mask] = esc[mask]
+        else:
+            out[mask] = np.asarray(
+                ensemble.probs(images[mask]), np.float64
+            )
+    return out
+
+
+def _mismatch(verdict: ReplayVerdict, record: dict,
+              workdir: "str | None",
+              registry: "obs_registry.Registry | None") -> ReplayVerdict:
+    """Every failed replay is a blackbox moment: dump the verdict +
+    record identity through the flight recorder (one per reason per
+    run), so the mismatch survives for forensics even when the CLI's
+    exit code is all the operator noticed."""
+    if workdir:
+        try:
+            from jama16_retina_tpu.obs import flightrec
+
+            flightrec.FlightRecorder(
+                workdir, registry=registry
+            ).dump("audit_replay_mismatch",
+                   verdict=verdict.as_dict(),
+                   trace_id=record.get("trace_id"),
+                   generation=record.get("generation"),
+                   model=record.get("model"))
+        except Exception as e:  # noqa: BLE001 - forensics best-effort
+            absl_logging.error(
+                "audit_replay_mismatch blackbox dump failed: %s: %s",
+                type(e).__name__, e,
+            )
+    return verdict

@@ -110,6 +110,13 @@ SITES = {
                          "journal reattach is the recovery under test; "
                          "latency plans widen the in-flight window for "
                          "kill drills)",
+    "audit.seal": "audit-ledger segment seal on the writer thread "
+                  "(obs/audit.py _seal, ahead of the sealed-artifact "
+                  "publish; an injected error loses exactly that "
+                  "segment's records — counted audit.seal_errors + "
+                  "audit.dropped, serving unaffected; corrupt-family "
+                  "kinds ride integrity.write underneath and leave a "
+                  "torn segment for graftfsck to classify)",
     "ingest.decode": "inside the ingest server's timed cache-miss batch "
                      "decode (ingest/server.py _SharedStream.batch; a "
                      "latency plan throttles the decode plane so the "

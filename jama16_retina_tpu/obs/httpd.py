@@ -107,13 +107,15 @@ class ObsHttp:
         snap = self._snapshotter
         if snap is None or snap._last_progress_t is None:
             return 2, {"detail": "no heartbeat recorded",
-                       **self._device_fields()}
+                       **self._device_fields(),
+                       **self._audit_fields(now)}
         age = now - snap._last_progress_t
         detail = {
             "step": snap._step,
             "progress_age_s": round(age, 1),
             "max_age_s": max_age,
             **self._device_fields(),
+            **self._audit_fields(now),
         }
         if age > max_age:
             detail["detail"] = (
@@ -144,6 +146,27 @@ class ObsHttp:
             "last_compile_age_s": (
                 round(age, 1) if age is not None else None
             ),
+        }
+
+    def _audit_fields(self, now: "float | None" = None) -> dict:
+        """Audit-plane probe fields (ISSUE 20): spool depth and the age
+        of the last durable segment seal, so a prober can spot a
+        wedged audit writer (depth climbing, seal age unbounded)
+        without parsing /metrics. Both None when no ledger ever
+        published — the gauges are peeked, never created."""
+        now = time.time() if now is None else now
+        depth = seal_age = None
+        try:
+            gauges = self._registry.snapshot()["gauges"]
+            depth = gauges.get("audit.spool_depth")
+            last_seal = gauges.get("audit.last_seal_t")
+            if last_seal:
+                seal_age = round(max(0.0, now - last_seal), 1)
+        except Exception:  # noqa: BLE001 - a probe must not raise
+            pass
+        return {
+            "audit_spool_depth": depth,
+            "audit_last_seal_age_s": seal_age,
         }
 
     def close(self) -> None:

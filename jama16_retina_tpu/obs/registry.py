@@ -248,6 +248,15 @@ class Registry:
         return self._get_or_create(name, Histogram, buckets=buckets,
                                    help=help)
 
+    def peek(self, name: str):
+        """The registered metric, or None — a read that never CREATES.
+        Cross-subsystem observers (the audit ledger stamping canary
+        status, health detail) use this so that merely looking at
+        another plane's gauge can't register a zero-valued impostor
+        when that plane isn't wired."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def remove(self, name: str) -> None:
         """Retire a metric from snapshots. Existing handles stay valid
         (their ops just stop being exported) — the bounded-vocabulary

@@ -149,6 +149,10 @@ class CascadeEngine:
         )
         self._spec_pool = None
         self.quality = quality
+        # Prediction provenance (ISSUE 20): the wiring site attaches
+        # ONE ledger at the cascade level (sub-engines stay un-audited
+        # — otherwise every escalated row would be recorded twice).
+        self.audit = None
 
     # -- escalation policy -------------------------------------------------
 
@@ -181,6 +185,14 @@ class CascadeEngine:
         through (canary traffic must never pollute the drift windows,
         the same bypass ServingEngine's member_probs-based canary
         wiring applies)."""
+        return self._probs_masked(images)[0]
+
+    def _probs_masked(
+        self, images: np.ndarray
+    ) -> "tuple[np.ndarray, np.ndarray]":
+        """``(_probs_raw output, escalation mask)`` — the mask is the
+        path TAKEN per row (student vs ensemble), which the audit
+        ledger seals so replay can re-walk the identical cascade."""
         spec_fut = None
         if self.speculative and len(images):
             # Fire the full-ensemble forward for the WHOLE batch before
@@ -218,13 +230,23 @@ class CascadeEngine:
             esc = np.asarray(self.ensemble.probs(images[mask]))
             out[mask] = esc
             self._c_escalated_rows.inc(int(mask.sum()))
-        return out
+        return out, mask
 
     def probs(self, images: np.ndarray) -> np.ndarray:
         """The cascade's row contract (MicroBatcher-compatible): row i
         of the output is row i's score — the student's, or the full
         ensemble's when the student landed in the escalation band."""
-        out = self._probs_raw(images)
+        out, mask = self._probs_masked(images)
+        al = self.audit
+        if al is not None:
+            sgen = getattr(self.student, "_gen", None)
+            al.record(
+                images, out, engine=self.ensemble,
+                generation=self.generation, escalated=mask,
+                speculative=self.speculative,
+                cascade={"student_dirs": list(sgen.member_dirs)
+                         if sgen is not None else None},
+            )
         q = self.quality
         if q is not None:
             # Drift windows see the MERGED distribution — the scores the

@@ -348,6 +348,11 @@ class ServingEngine:
         # axis existed; bf16/int8 transform the stacked state at build
         # time (serve/quantize.py) and are canary-gated below.
         self.dtype = quantize.check_dtype(cfg.serve.dtype)
+        # Prediction provenance (ISSUE 20): an AuditLedger attached by
+        # the wiring site (predict.py, start_telemetry) records every
+        # served request's lineage off the request path. None = one
+        # attribute read per probs call.
+        self.audit = None
         self._c_dtype_rows = self.registry.counter(
             f"serve.dtype_rows.{self.dtype}",
             help="real rows forwarded by an engine of this serving "
@@ -1108,6 +1113,12 @@ class ServingEngine:
                         list(self.member_probs(imgs, _gen=gen))
                     )
                 )
+        # Audit ledger (ISSUE 20): one non-blocking enqueue stamped
+        # with the SAME pinned generation the rows were served by.
+        al = self.audit
+        if al is not None:
+            al.record(images, out, generation=gen.gen_id,
+                      member_dirs=gen.member_dirs, engine=self)
         return out, gen.gen_id
 
     def make_batcher(self):
@@ -1164,6 +1175,18 @@ class ServingEngine:
         # /metrics + /healthz endpoint.
         from jama16_retina_tpu.obs import fleet as obs_fleet
 
+        # Audit ledger (ISSUE 20): a serving session that starts
+        # telemetry with obs.audit.enabled gets its provenance ledger
+        # here unless the wiring site already attached one. The ledger
+        # outlives the snapshotter (daemon writer; unsealed tail at
+        # exit is the documented crash semantics) — close() it
+        # explicitly to seal the tail.
+        if self.audit is None and self.cfg.obs.audit.enabled:
+            from jama16_retina_tpu.obs import audit as obs_audit
+
+            self.audit = obs_audit.ledger_for(
+                self.cfg, workdir, registry=self.registry
+            )
         snap = obs_export.Snapshotter(
             self.registry, workdir,
             every_s=(every_s if every_s is not None

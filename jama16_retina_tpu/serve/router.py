@@ -330,13 +330,16 @@ class _Bin:
     more requests, bound for one replica (retried on siblings on
     dispatch failure — ``tried`` keeps the exclusion set)."""
 
-    __slots__ = ("rows", "parts", "bucket", "tried")
+    __slots__ = ("rows", "parts", "bucket", "tried", "engines")
 
     def __init__(self, rows: np.ndarray, parts: list, bucket: int):
         self.rows = rows
         self.parts = parts  # [(request, req_lo, req_hi), ...]
         self.bucket = bucket
         self.tried: set = set()
+        # {model: engine} the bin was scored through (_score_bin stashes
+        # it) — the audit ledger's lineage source per fused part.
+        self.engines: dict = {}
 
 
 class Router:
@@ -409,6 +412,12 @@ class Router:
             else ("default",)
         )
         self.fusion = bool(getattr(sc, "router_fusion", False))
+        # Prediction provenance (ISSUE 20): predict.py (or any host)
+        # attaches an AuditLedger here; _complete_bin then records one
+        # audit record PER REQUEST SLICE of every bin — fused
+        # cross-request bins attribute row spans to their originating
+        # trace ids. None = one attribute read per completed bin.
+        self.audit = None
         self._fusion_cache = None
         self._c_fused_bins = None
         self._c_fused_rows = None
@@ -1053,8 +1062,30 @@ class Router:
                 # one global read + branch unarmed; the --chaos drill
                 # injects a replica death here mid-storm.
                 faultinject.check("serve.router.dispatch")
+                t_score0 = time.perf_counter()
                 with obs_trace.use_context(bin_ctx):
                     out, gens = self._score_bin(rep, b)
+                # Per-row attribution for FUSED bins (ISSUE 20
+                # satellite): a multi-request bin installed no ambient
+                # context above, so its stitched trace would otherwise
+                # lose the originating ids — one complete event names
+                # every part's trace_id and row span instead.
+                tr = obs_trace.default_tracer()
+                if tr.enabled and len(ctxs) > 1:
+                    tr.complete(
+                        "serve.router.bin.parts", t_score0,
+                        time.perf_counter(),
+                        args={
+                            "replica": rep.rid,
+                            "rows": int(b.rows.shape[0]),
+                            "parts": [
+                                {"trace_id": req.trace_id,
+                                 "model": req.model,
+                                 "lo": req_lo, "hi": req_hi}
+                                for req, req_lo, req_hi in b.parts
+                            ],
+                        },
+                    )
                 if out.shape[0] != b.rows.shape[0]:
                     raise RuntimeError(
                         f"replica {rep.rid} returned {out.shape[0]} rows "
@@ -1089,6 +1120,7 @@ class Router:
             if req.model not in models:
                 models.append(req.model)
         if len(models) == 1 and models[0] == rep.model:
+            b.engines = {rep.model: rep.engine}
             out, gen = rep.score(b.rows)
             return out, {rep.model: gen}
         from jama16_retina_tpu.serve import fusion as fusion_lib
@@ -1111,6 +1143,7 @@ class Router:
                 engines[m] = min(
                     cands, key=lambda r: (r.in_flight_rows, r.rid)
                 ).engine
+        b.engines = dict(engines)
         out, gens = fusion_lib.score_mixed(
             engines, b.rows, b.parts, b.bucket,
             cache=self._fusion_cache,
@@ -1178,6 +1211,24 @@ class Router:
             self._work.notify_all()
         rep.c_rows.inc(n)
         rep.c_dispatches.inc()
+        # Audit ledger (ISSUE 20), OUTSIDE the router lock: one record
+        # per request slice of the bin — a fused cross-request bin
+        # demuxes into per-trace-id records, each carrying the model,
+        # replica, pinned generation, and lineage of the engine that
+        # actually scored its rows.
+        al = self.audit
+        if al is not None:
+            lo = 0
+            for req, req_lo, req_hi in b.parts:
+                w = req_hi - req_lo
+                al.record(
+                    b.rows[lo:lo + w], out[lo:lo + w],
+                    trace_id=req.trace_id, model=req.model,
+                    replica=rep.rid,
+                    generation=int(gens[req.model]),
+                    engine=b.engines.get(req.model),
+                )
+                lo += w
         now = time.monotonic()
         tr = obs_trace.default_tracer()
         for req in done:

@@ -419,6 +419,20 @@ def main(argv):
             max_batch=_BATCH.value,
             bucket_sizes=(_BATCH.value,),
         ))
+        # Prediction provenance & audit plane (ISSUE 20): built AFTER
+        # the policy application and bucket pin, so the sealed config
+        # identity (preset + --set overrides + serve shapes) is exactly
+        # what `audit_query replay` rebuilds. None when
+        # obs.audit.enabled is off — one branch per serving surface.
+        from jama16_retina_tpu.obs import audit as obs_audit
+
+        audit_ledger = obs_audit.ledger_for(
+            cfg, _OBS_WORKDIR.value or None,
+            thresholds=((_THRESHOLD.value,)
+                        if _THRESHOLD.value >= 0 else None),
+            config_overrides=tuple(_SET.value),
+            policy_provenance=policy_prov or None,
+        )
         if _REPLICAS.value > 0:
             # Front-door router (ISSUE 12): the same blocks the
             # single-engine path would chunk, submitted as prioritized
@@ -433,6 +447,7 @@ def main(argv):
                 cfg, engines=engines,
                 policy_provenance=policy_prov or None,
             )
+            router.audit = audit_ledger
             futs = [
                 router.submit(pre.images[i:i + _BATCH.value],
                               priority=_PRIORITY.value)
@@ -472,10 +487,12 @@ def main(argv):
                 cfg=cfg, member_dirs=tuple(dirs), model=model,
                 go_live=True,
             ))
+            engine.audit = audit_ledger
         else:
             engine = assemble(EngineSpec(
                 cfg=cfg, member_dirs=tuple(dirs), model=model,
             ))
+            engine.audit = audit_ledger
         if _REPLICAS.value > 0:
             pass  # probs computed through the router above
         else:
@@ -511,6 +528,10 @@ def main(argv):
                         snap.maybe_flush()
                     probs = (blocks[0] if len(blocks) == 1
                              else np.concatenate(blocks))
+        if audit_ledger is not None:
+            # Seal the tail before the rows print: a completed batch
+            # leaves NO unsealed audit records behind.
+            audit_ledger.close()
 
     for p, pr, qual in zip(kept, probs, qualities):
         if cfg.model.head != "binary":

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Lineage queries + deterministic replay over a sealed audit ledger
+(ISSUE 20; jama16_retina_tpu/obs/audit.py).
+
+  python scripts/audit_query.py list <audit_dir> [--json]
+  python scripts/audit_query.py trace <trace_id> --audit-dir D \
+      [--journal-dir J] [--json]
+  python scripts/audit_query.py replay <trace_id> --audit-dir D \
+      [--workdir W] [--set SECTION.FIELD=VALUE ...] [--json]
+
+``list`` tabulates every sealed record (trace id, time, model,
+generation, rows, decisions). ``trace`` renders the complete
+provenance chain behind a served score: record → generation → member
+checkpoints (+ content digests) → promoting lifecycle cycle (drift
+reason, RETRAIN members + warm-start donors, gate verdicts, rollout/
+commit) → training rawshard manifest. ``replay`` reassembles the
+recorded generation through the EngineSpec/compile-cache path,
+re-scores the captured input, and pins the verdict: fp32 BIT-identical
+to the served score, bf16/int8 tolerance-banded; a mismatch exits 1
+with a typed verdict and an ``audit_replay_mismatch`` blackbox dump
+under ``--workdir``.
+
+Exit codes: 0 = found / replay ok; 1 = mismatch (replay) or no such
+trace; 2 = usage / unreadable ledger.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _fmt_scores(rec: dict) -> str:
+    ref = rec.get("referable") or []
+    s = ", ".join(f"{v:.4f}" for v in ref[:4])
+    return s + (", ..." if len(ref) > 4 else "")
+
+
+def _render_record(rec: dict) -> None:
+    print(f"  trace_id:    {rec.get('trace_id')}")
+    print(f"  t:           {rec.get('t')}")
+    print(f"  model:       {rec.get('model')}   "
+          f"replica: {rec.get('replica')}")
+    print(f"  rows:        {rec.get('n')}")
+    print(f"  generation:  {rec.get('generation')}   "
+          f"dtype: {rec.get('serve_dtype')}   "
+          f"buckets: {rec.get('buckets')}")
+    print(f"  referable:   [{_fmt_scores(rec)}]")
+    for thr, dec in (rec.get("decisions") or {}).items():
+        pos = sum(1 for d in dec if d)
+        print(f"  decision @{thr}: {pos}/{len(dec)} referable")
+    casc = rec.get("cascade")
+    if casc:
+        esc = casc.get("escalated")
+        print(f"  cascade:     escalated "
+              f"{'unrecorded' if esc is None else sum(esc)}"
+              f"{'' if esc is None else f'/{len(esc)}'}"
+              f"{' (speculative)' if casc.get('speculative') else ''}")
+    if rec.get("capture"):
+        print(f"  capture:     {rec['capture']['file']} "
+              f"(sha256 {rec['capture']['sha256'][:12]})")
+
+
+def _render_chain(chain: dict) -> None:
+    print("lineage chain:")
+    print(f"  generation {chain.get('generation')} "
+          f"(dtype {chain.get('serve_dtype')})")
+    for d in chain.get("member_dirs") or ():
+        dig = (chain.get("member_digests") or {}).get(d, "")
+        print(f"    member {d}  [{dig[:12]}]")
+    if chain.get("policy"):
+        print(f"  policy artifact: {chain['policy']}")
+    if chain.get("canary_ok") is not None:
+        print(f"  canary at serve time: "
+              f"{'OK' if chain['canary_ok'] else 'FAILING'}")
+    if chain.get("cycle") is None:
+        print("  (no promoting lifecycle cycle in the journal — "
+              "directly-assembled generation)")
+        return
+    print(f"  promoted by lifecycle cycle {chain['cycle']}:")
+    drift = chain.get("drift") or {}
+    if drift:
+        print(f"    DRIFT_DETECTED: {drift.get('reason')}")
+    for d in chain.get("warm_start_donors") or ():
+        print(f"    warm-start donor: {d}")
+    for m in chain.get("retrain_markers") or ():
+        print(f"    RETRAIN {m['member_dir']}: init_from="
+              f"{m.get('init_from')} steps={m.get('steps')} "
+              f"best_auc={m.get('best_auc')}")
+    dm = chain.get("data_manifest")
+    if dm:
+        print(f"    training rawshard manifest: {dm.get('path')} "
+              f"[{(dm.get('sha256') or '')[:12]}]")
+    for v in chain.get("gate_verdicts") or ():
+        name = v.get("gate", v.get("name", "?"))
+        print(f"    GATE {name}: "
+              f"{'PASS' if v.get('passed') else 'FAIL'}")
+    if chain.get("rollout"):
+        r = chain["rollout"]
+        print(f"    STAGED_ROLLOUT: generation {r.get('generation')} "
+              f"shadow={r.get('shadow')}")
+    if chain.get("commit"):
+        print(f"    COMMIT: generation "
+              f"{chain['commit'].get('generation')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("command", choices=("list", "trace", "replay"))
+    ap.add_argument("target", nargs="?", default=None,
+                    help="trace id (trace/replay) or audit dir (list)")
+    ap.add_argument("--audit-dir", default=None,
+                    help="the sealed ledger directory "
+                         "(obs.audit.dir / <workdir>/audit)")
+    ap.add_argument("--journal-dir", default=None,
+                    help="lifecycle journal dir — links the score to "
+                         "its promoting cycle, gates, and training "
+                         "manifest")
+    ap.add_argument("--workdir", default=None,
+                    help="replay: where the audit_replay_mismatch "
+                         "blackbox and the audit_replay JSONL record "
+                         "land (defaults to the audit dir's parent)")
+    ap.add_argument("--set", action="append", default=[],
+                    dest="overrides", metavar="SECTION.FIELD=VALUE",
+                    help="replay: extra config overrides on top of the "
+                         "record's sealed ones (compile_cache_dir "
+                         "relocation and the like)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from jama16_retina_tpu.obs import audit as audit_lib
+
+    if args.command == "list":
+        audit_dir = args.audit_dir or args.target
+        if not audit_dir:
+            ap.error("list needs an audit dir")
+        rows = [rec for rec, _p in audit_lib.iter_records(audit_dir)]
+        if args.json:
+            print(json.dumps({"records": rows}))
+        else:
+            print(f"{len(rows)} sealed audit records in {audit_dir}")
+            for rec in rows:
+                print(f"  {rec.get('trace_id')}  t={rec.get('t')}  "
+                      f"model={rec.get('model')}  "
+                      f"gen={rec.get('generation')}  "
+                      f"rows={rec.get('n')}")
+        return 0
+
+    if not args.target:
+        ap.error(f"{args.command} needs a trace id")
+    if not args.audit_dir:
+        ap.error(f"{args.command} needs --audit-dir")
+    records = audit_lib.find_records(args.audit_dir, args.target)
+    if not records:
+        print(f"no sealed audit record carries trace_id "
+              f"{args.target!r} in {args.audit_dir}", file=sys.stderr)
+        return 1
+
+    if args.command == "trace":
+        chains = [audit_lib.lineage_chain(rec, args.journal_dir)
+                  for rec in records]
+        if args.json:
+            print(json.dumps({"records": records, "chains": chains}))
+            return 0
+        for rec, chain in zip(records, chains):
+            print("audit record:")
+            _render_record(rec)
+            _render_chain(chain)
+        return 0
+
+    # replay: every record slice of the trace must hold.
+    workdir = args.workdir or os.path.dirname(
+        os.path.abspath(args.audit_dir)
+    )
+    verdicts = []
+    ok = True
+    for rec in records:
+        v = audit_lib.replay_record(
+            rec, args.audit_dir,
+            extra_overrides=tuple(args.overrides),
+            workdir=workdir,
+        )
+        verdicts.append(v)
+        ok = ok and v.ok
+        # The verdict rides the workdir's JSONL stream too, so
+        # obs_report's Audit section reports replay outcomes next to
+        # the serve-time counters.
+        try:
+            from jama16_retina_tpu.utils.logging import RunLog
+
+            log = RunLog(workdir)
+            log.write("audit_replay", **v.as_dict())
+            log.close()
+        except Exception:  # noqa: BLE001 - reporting is best-effort
+            pass
+    if args.json:
+        print(json.dumps({"ok": ok,
+                          "verdicts": [v.as_dict() for v in verdicts]}))
+    else:
+        for v in verdicts:
+            line = (f"replay {v.trace_id}: "
+                    f"{'OK' if v.ok else 'MISMATCH'} [{v.kind}]"
+                    f" dtype={v.dtype}")
+            if v.max_abs_dev is not None:
+                line += (f" max_abs_dev={v.max_abs_dev:g}"
+                         f" tolerance={v.tolerance:g}")
+            if v.detail:
+                line += f" — {v.detail}"
+            print(line)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

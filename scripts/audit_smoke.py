@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""CI audit smoke (ISSUE 20 satellite; scripts/ci_checks.sh
+--audit-smoke): the prediction-provenance plane end to end on a real
+served batch:
+
+  1. seed a random-init smoke checkpoint + synthetic fundus photos,
+     then run predict.py with the audit ledger ON (capture enabled) —
+     the real serving path, not a harness;
+  2. the batch leaves sealed ``seg-NNNNNN.json`` segments behind (the
+     close() tail contract: a completed batch spools nothing unsealed)
+     with per-row input digests, scores, decisions, and lineage;
+  3. ``audit_query trace <id>`` renders the COMPLETE lineage chain
+     through a lifecycle journal whose STAGED_ROLLOUT/COMMIT promote
+     the served generation (drift reason, gate verdict, rollout,
+     commit, training manifest);
+  4. ``audit_query replay <id>`` reassembles the recorded generation
+     and pins fp32 BIT-equality against the sealed scores (exit 0,
+     every verdict ``bit_equal``).
+
+Exit 0 = every step held; 1 = a step failed (message says which).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    import cv2
+    import jax
+    import numpy as np
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.configs import get_config, override
+    from jama16_retina_tpu.data import synthetic
+    from jama16_retina_tpu.lifecycle.journal import Journal
+    from jama16_retina_tpu.obs import audit as audit_lib
+    from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    query = os.path.join(_REPO, "scripts", "audit_query.py")
+
+    def run(*args, timeout=600) -> "subprocess.CompletedProcess":
+        return subprocess.run(
+            [sys.executable, *args], capture_output=True, text=True,
+            env=env, timeout=timeout,
+        )
+
+    with tempfile.TemporaryDirectory() as root:
+        # 1) Seed: a random-init smoke checkpoint (the contract under
+        #    test is provenance plumbing, not accuracy) + 6 synthetic
+        #    fundus photos.
+        cfg = override(get_config("smoke"), ["model.image_size=64"])
+        model = models.build(cfg.model)
+        state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+        ckdir = os.path.join(root, "ckpt")
+        ck = ckpt_lib.Checkpointer(ckdir)
+        ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+        ck.wait()
+        ck.close()
+        imgdir = os.path.join(root, "imgs")
+        os.makedirs(imgdir)
+        for i in range(6):
+            img = synthetic.render_fundus(
+                np.random.default_rng(i), i % 5,
+                synthetic.SynthConfig(image_size=96),
+            )
+            cv2.imwrite(os.path.join(imgdir, f"eye_{i}.jpeg"),
+                        img[..., ::-1])
+
+        wd = os.path.join(root, "wd")
+        audit_dir = os.path.join(wd, "audit")
+        r = run(os.path.join(_REPO, "predict.py"),
+                "--config=smoke", "--set", "model.image_size=64",
+                f"--checkpoint_dir={ckdir}", "--images", imgdir,
+                "--device=cpu", "--batch_size=4", "--threshold=0.5",
+                f"--obs_workdir={wd}",
+                "--set", "obs.audit.enabled=true",
+                "--set", "obs.audit.capture=true",
+                "--set", "obs.audit.seal_every=4")
+        if r.returncode != 0:
+            print(f"FAIL: predict.py with audit on exited "
+                  f"{r.returncode}\n{r.stdout}{r.stderr}")
+            return 1
+
+        # 2) Sealed segments with full records behind the batch.
+        segs = audit_lib.segment_paths(audit_dir)
+        if not segs:
+            print(f"FAIL: no sealed audit segments in {audit_dir}")
+            return 1
+        records = [rec for rec, _p in audit_lib.iter_records(audit_dir)]
+        rows = sum(rec["n"] for rec in records)
+        if rows != 6:
+            print(f"FAIL: sealed records cover {rows} rows, want 6")
+            return 1
+        rec = records[0]
+        tid = rec.get("trace_id")
+        gen = rec.get("generation")
+        if not tid or gen is None or not rec.get("member_digests"):
+            print(f"FAIL: record missing trace_id/generation/digests: "
+                  f"{json.dumps(rec)[:400]}")
+            return 1
+        if not all(r.get("capture") for r in records):
+            print("FAIL: obs.audit.capture=true but a record carries "
+                  "no captured tensor")
+            return 1
+        if "0.5" not in rec.get("decisions", {}):
+            print(f"FAIL: no decision at threshold 0.5: "
+                  f"{rec.get('decisions')}")
+            return 1
+
+        # 3) A promoting lifecycle cycle for the served generation, then
+        #    `trace` must render the chain end to end.
+        jdir = os.path.join(root, "lifecycle")
+        j = Journal(jdir)
+        j.append("DRIFT_DETECTED", cycle=1, reason="smoke drift",
+                 live_member_dirs=[ckdir])
+        j.append("RETRAIN", cycle=1, member_dirs=list(
+            rec.get("member_dirs") or ()),
+            data_manifest={"path": "synthetic://smoke", "sha256": ""})
+        j.append("GATE", cycle=1,
+                 verdicts=[{"gate": "val_auc", "passed": True}])
+        j.append("STAGED_ROLLOUT", cycle=1, generation=gen, shadow=0.1)
+        j.append("COMMIT", cycle=1, generation=gen)
+        r = run(query, "trace", tid, f"--audit-dir={audit_dir}",
+                f"--journal-dir={jdir}")
+        if r.returncode != 0:
+            print(f"FAIL: audit_query trace exited {r.returncode}"
+                  f"\n{r.stdout}{r.stderr}")
+            return 1
+        for needle in ("promoted by lifecycle cycle 1",
+                       "DRIFT_DETECTED: smoke drift",
+                       "GATE val_auc: PASS", "COMMIT"):
+            if needle not in r.stdout:
+                print(f"FAIL: trace output missing {needle!r}"
+                      f"\n{r.stdout}")
+                return 1
+
+        # 4) Deterministic replay: fp32 bit-equality, exit 0.
+        r = run(query, "replay", tid, f"--audit-dir={audit_dir}",
+                f"--workdir={wd}", "--json")
+        if r.returncode != 0:
+            print(f"FAIL: audit_query replay exited {r.returncode}"
+                  f"\n{r.stdout}{r.stderr}")
+            return 1
+        doc = json.loads(r.stdout)
+        kinds = [v["kind"] for v in doc["verdicts"]]
+        if not doc["ok"] or set(kinds) != {"bit_equal"}:
+            print(f"FAIL: replay not bit-equal: {doc}")
+            return 1
+    print(f"audit smoke: {len(records)} sealed records ({rows} rows) "
+          "-> lineage chain rendered through the promoting cycle -> "
+          f"replay bit_equal x{len(kinds)} (exit 0)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,13 @@
 #       compile-cache hit crediting saved seconds, and obs_report's
 #       Device section rendered in text and --json — off-TPU end to
 #       end — scripts/device_smoke.py.
+#   bash scripts/ci_checks.sh --audit-smoke
+#       lint + the prediction-provenance smoke (ISSUE 20): a 2-step
+#       train smoke, N requests served with the audit ledger on
+#       (capture enabled), the lineage chain rendered by audit_query
+#       trace through a seeded lifecycle journal, and audit_query
+#       replay pinning fp32 BIT-equality against the sealed scores —
+#       scripts/audit_smoke.py.
 #
 # graftlint exit codes: 0 clean / 1 findings / 2 internal error; the
 # script propagates the first failure. See README §Development.
@@ -108,6 +115,12 @@ fi
 if [[ "${1:-}" == "--device-smoke" ]]; then
     echo "== device utilization smoke (HBM owners + MFU + compile ledger) =="
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/device_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--audit-smoke" ]]; then
+    echo "== prediction provenance smoke (ledger + lineage + replay) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python scripts/audit_smoke.py
     exit 0
 fi
 

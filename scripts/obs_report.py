@@ -1620,6 +1620,83 @@ def render_integrity(workdir: str, records: list) -> "str | None":
     return "\n".join(lines)
 
 
+def audit_summary(records: list) -> "dict | None":
+    """The Audit section's machine-readable form (--json twin; ISSUE
+    20): serve-time ledger throughput (records/rows accepted, drop
+    rate, sealed segments, seal errors, captures), writer health
+    (spool depth, seal lag at the last flush), and replay verdicts
+    (the ``audit_replay`` records audit_query writes). None when the
+    run carries no audit signals."""
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    latest = telemetry[-1] if telemetry else {}
+    counters = latest.get("counters", {})
+    gauges = latest.get("gauges", {})
+    replays = [r for r in records if r.get("kind") == "audit_replay"]
+
+    n_records = counters.get("audit.records")
+    if n_records is None and not replays:
+        return None
+    n_records = int(n_records or 0)
+    dropped = int(counters.get("audit.dropped", 0))
+    offered = n_records + dropped
+    last_seal = gauges.get("audit.last_seal_t") or 0
+    seal_lag = (
+        round(max(0.0, latest.get("t", last_seal) - last_seal), 1)
+        if last_seal else None
+    )
+    verdicts: dict = {}
+    for r in replays:
+        verdicts[r.get("kind", "?")] = verdicts.get(
+            r.get("kind", "?"), 0) + 1
+    return {
+        "records": n_records,
+        "rows": int(counters.get("audit.rows", 0)),
+        "dropped": dropped,
+        "drop_rate": (dropped / offered) if offered else 0.0,
+        "sealed_segments": int(counters.get("audit.sealed_segments", 0)),
+        "seal_errors": int(counters.get("audit.seal_errors", 0)),
+        "captured": int(counters.get("audit.captured", 0)),
+        "spool_depth": gauges.get("audit.spool_depth"),
+        "seal_lag_s": seal_lag,
+        "replays": {
+            "total": len(replays),
+            "ok": sum(1 for r in replays if r.get("ok")),
+            "verdicts": verdicts,
+        } if replays else None,
+    }
+
+
+def render_audit(records: list) -> "str | None":
+    s = audit_summary(records)
+    if s is None:
+        return None
+    out = ["== Audit & provenance (ISSUE 20) =="]
+    out.append(
+        f"records audited: {s['records']} ({s['rows']} rows), "
+        f"dropped {s['dropped']} (rate {s['drop_rate']:.2%})"
+    )
+    out.append(
+        f"sealed segments: {s['sealed_segments']}"
+        + (f", seal errors {s['seal_errors']}"
+           if s["seal_errors"] else "")
+        + (f", captured tensors {s['captured']}"
+           if s["captured"] else "")
+    )
+    if s["spool_depth"] is not None or s["seal_lag_s"] is not None:
+        out.append(
+            f"writer: spool depth {s['spool_depth']}"
+            + (f", last seal {s['seal_lag_s']}s before the final flush"
+               if s["seal_lag_s"] is not None else ", never sealed")
+        )
+    if s["replays"]:
+        r = s["replays"]
+        kinds = ", ".join(f"{k}={n}"
+                          for k, n in sorted(r["verdicts"].items()))
+        out.append(f"replay verdicts: {r['ok']}/{r['total']} ok "
+                   f"({kinds})")
+    return "\n".join(out)
+
+
 def check_integrity(workdir: str) -> tuple[int, str]:
     """Exit-code mode mirroring --check-alerts (ISSUE 13): 0 the last
     graftfsck verdict is clean and no corruption has been counted,
@@ -1719,6 +1796,24 @@ def check_heartbeats(workdir: str, max_age_s: float,
             stale.append(
                 f"p{p}: heartbeat fresh but no step progress for "
                 f"{prog_age:.0f}s (> {max_age_s:.0f}s) — wedged?"
+            )
+    # Wedged audit writer (ISSUE 20): records sitting in the spool
+    # while nothing has sealed for longer than the threshold — the
+    # serving side keeps going (drops are counted, never blocking),
+    # so ONLY this probe notices the provenance ledger has stalled.
+    telemetry = [r for r in records if r.get("kind") == "telemetry"]
+    if telemetry:
+        g = telemetry[-1].get("gauges", {})
+        depth = g.get("audit.spool_depth") or 0
+        last_seal = g.get("audit.last_seal_t") or 0
+        seal_age = now - last_seal if last_seal else None
+        if depth > 0 and (seal_age is None or seal_age > max_age_s):
+            stale.append(
+                f"audit writer: {depth:g} record(s) spooled but "
+                + (f"no segment sealed for {seal_age:.0f}s "
+                   f"(> {max_age_s:.0f}s)" if seal_age is not None
+                   else "no segment EVER sealed")
+                + " — wedged audit writer?"
             )
     if stale:
         return 1, "\n".join(stale)
@@ -2188,6 +2283,7 @@ def main(argv=None) -> int:
                 integrity_summary(args.path, records)
                 if os.path.isdir(args.path) else None
             ),
+            "audit": audit_summary(records),
             "heartbeats": {
                 f"p{p}": {**b, "age_s": round(now - b.get("t", now), 1)}
                 for p, b in sorted(latest_heartbeats(records).items())
@@ -2240,6 +2336,10 @@ def main(argv=None) -> int:
         if integ:
             print()
             print(integ)
+    aud = render_audit(records)
+    if aud:
+        print()
+        print(aud)
     print()
     print(render_heartbeats(records))
     if events:

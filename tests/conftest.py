@@ -296,6 +296,26 @@ _QUICK_TESTS = {
     "test_device.py::test_summary_from_gauges",
     "test_device.py::test_reliability_rules_include_hbm_pressure_and_latch",
     "test_device.py::test_bench_trend_device_row_directions",
+    # prediction provenance & audit plane (ISSUE 20): the numpy-cheap
+    # pins — record schema + sampling + never-blocks, the audit.seal
+    # chaos drill, fsck/retention classification, fused-bin demux over
+    # stub replicas, the typed replay refusals, and the operator
+    # surfaces; the kill -9 subprocess drill and the real-engine
+    # bit-equality replay stay in the full tier (XLA compiles/process
+    # spawn dominate there)
+    "test_audit.py::test_record_roundtrip_schema_and_decisions",
+    "test_audit.py::test_sampling_every_nth_deterministic",
+    "test_audit.py::test_spool_full_drops_counted_never_blocks",
+    "test_audit.py::test_seal_fault_counts_losses_writer_survives",
+    "test_audit.py::test_fsck_classifies_corrupt_audit_segment_quarantine",
+    "test_audit.py::test_retention_prunes_oldest_segments_with_captures",
+    "test_audit.py::test_fused_bin_demuxes_one_audit_record_per_request",
+    "test_audit.py::test_lineage_chain_renders_promoting_cycle",
+    "test_audit.py::test_replay_typed_refusal_verdicts",
+    "test_audit.py::test_capture_roundtrip_and_tamper_refused",
+    "test_audit.py::test_healthz_carries_audit_writer_fields",
+    "test_audit.py::test_obs_report_audit_section_and_wedged_blame",
+    "test_audit.py::test_ledger_for_gating_and_dir_resolution",
 }
 
 

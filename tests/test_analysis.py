@@ -705,7 +705,7 @@ def test_lint_repo_clean():
 def test_repo_fault_sites_registry_matches_wired_seams():
     """The declared vocabulary is exactly the seams PR 6/8/10/11/12/13
     (+ the ISSUE 17 ingest service, + the ISSUE 18 decode-throttle
-    diagnosis drill) wired."""
+    diagnosis drill, + the ISSUE 20 audit-segment seal) wired."""
     from jama16_retina_tpu.obs import faultinject
 
     assert set(faultinject.SITES) == {
@@ -715,6 +715,7 @@ def test_repo_fault_sites_registry_matches_wired_seams():
         "lifecycle.retrain", "lifecycle.gate", "lifecycle.swap",
         "integrity.write", "integrity.write.commit",
         "ingest.attach", "ingest.ring.write", "ingest.decode",
+        "audit.seal",
     }
     assert all(desc for desc in faultinject.SITES.values())
 

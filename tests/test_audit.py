@@ -1,0 +1,629 @@
+"""Prediction provenance & audit plane (ISSUE 20): the sealed
+per-request ledger, lineage queries, and deterministic replay.
+
+The contract under test, layer by layer:
+
+  * ``record()`` NEVER blocks or raises into serving: sampling is
+    deterministic every-Nth, a full spool drops (counted
+    ``audit.dropped``), and a failing segment seal (the ``audit.seal``
+    chaos site) loses exactly that segment's records — counted, logged,
+    writer alive, serving unaffected;
+  * crash semantics: kill -9 mid-spool loses at most the unsealed
+    tail; a restart resumes a FRESH segment number and never rewrites
+    sealed history;
+  * sealed segments carry the full record schema (per-row input
+    digests, scores, per-threshold decisions, generation + member
+    digests, cascade path, config identity) and graftfsck classifies a
+    torn/corrupt one as ``audit`` (quarantine — not derivable), while
+    retention GC prunes only beyond ``obs.audit.retention``;
+  * the router demuxes a FUSED cross-request bin into one audit record
+    per request slice, each carrying its own trace id (and the
+    ``serve.router.bin.parts`` event mirrors the attribution into the
+    stitched trace);
+  * ``replay_record`` pins fp32 BIT-equality through a real assembled
+    engine and returns typed verdicts (lineage_changed / no_capture /
+    unreplayable / score_mismatch) on every refusal path;
+  * /healthz and obs_report surface writer health (spool depth, seal
+    age) and blame a wedged audit writer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from jama16_retina_tpu.configs import get_config, override
+from jama16_retina_tpu.integrity import artifact as artifact_lib
+from jama16_retina_tpu.integrity import fsck as fsck_lib
+from jama16_retina_tpu.integrity import retention as retention_lib
+from jama16_retina_tpu.lifecycle.journal import Journal
+from jama16_retina_tpu.obs import audit as audit_lib
+from jama16_retina_tpu.obs import faultinject
+from jama16_retina_tpu.obs import trace as obs_trace
+from jama16_retina_tpu.obs.registry import Registry
+
+pytestmark = pytest.mark.audit
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rows(n=4, size=2, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, size, size, 3), np.uint8
+    )
+
+
+def _ledger(tmp_path, **kw):
+    kw.setdefault("registry", Registry())
+    kw.setdefault("seal_every", 2)
+    return audit_lib.AuditLedger(str(tmp_path / "audit"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# The record schema + serving-side surface
+# ---------------------------------------------------------------------------
+
+
+def test_record_roundtrip_schema_and_decisions(tmp_path):
+    """A flushed record carries the full sealed schema: per-row input
+    digests, float64 scores that roundtrip exactly through JSON,
+    decisions at every configured threshold, lineage (member dirs +
+    content digests), and the config identity replay rebuilds from."""
+    member = tmp_path / "member_00"
+    member.mkdir()
+    (member / "weights.bin").write_bytes(b"\x01\x02\x03")
+    reg = Registry()
+    led = _ledger(tmp_path, registry=reg, thresholds=(0.3, 0.7),
+                  config_name="smoke",
+                  config_overrides=("model.image_size=64",),
+                  policy_provenance={"path": "pol.json"})
+    rows = _rows(3)
+    scores = np.array([0.2, 0.5, 0.9])
+    assert led.record(rows, scores, trace_id="t-1", model="m",
+                      replica=2, generation=7,
+                      member_dirs=[str(member)])
+    led.close()
+    recs = [r for r, _p in audit_lib.iter_records(str(tmp_path / "audit"),
+                                                  strict=True)]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["record_version"] == audit_lib.RECORD_VERSION
+    assert rec["trace_id"] == "t-1" and rec["model"] == "m"
+    assert rec["replica"] == 2 and rec["n"] == 3
+    assert rec["input_sha256"] == audit_lib.row_digests(rows)
+    # float64 -> JSON repr -> float64 is exact: the fp32 bit-equality
+    # pin rides this roundtrip.
+    np.testing.assert_array_equal(np.asarray(rec["scores"]), scores)
+    assert rec["decisions"]["0.3"] == [False, True, True]
+    assert rec["decisions"]["0.7"] == [False, False, True]
+    assert rec["generation"] == 7
+    assert rec["member_dirs"] == [str(member)]
+    assert rec["member_digests"] == {
+        str(member): audit_lib.checkpoint_digest(str(member))
+    }
+    assert rec["config"] == {"name": "smoke",
+                             "overrides": ["model.image_size=64"]}
+    assert rec["policy"] == {"path": "pol.json"}
+    c = reg.snapshot()["counters"]
+    assert c["audit.records"] == 1 and c["audit.rows"] == 3
+    assert c["audit.sealed_segments"] == 1
+
+
+def test_sampling_every_nth_deterministic(tmp_path):
+    reg = Registry()
+    led = _ledger(tmp_path, registry=reg, sample=0.5)
+    accepted = [led.record(_rows(1), np.array([0.5])) for _ in range(10)]
+    led.close()
+    assert accepted == [False, True] * 5
+    assert reg.snapshot()["counters"]["audit.records"] == 5
+
+
+def test_spool_full_drops_counted_never_blocks(tmp_path, monkeypatch):
+    """With the writer dead and the spool bounded at 2, the third
+    record is DROPPED (counted) and the call returns immediately —
+    serving never waits on audit durability."""
+    monkeypatch.setattr(audit_lib.AuditLedger, "_writer_loop",
+                        lambda self: None)
+    reg = Registry()
+    led = _ledger(tmp_path, registry=reg, queue_max=2)
+    t0 = time.monotonic()
+    got = [led.record(_rows(1), np.array([0.5])) for _ in range(3)]
+    assert time.monotonic() - t0 < 1.0
+    assert got == [True, True, False]
+    c = reg.snapshot()["counters"]
+    assert c["audit.dropped"] == 1 and c["audit.records"] == 2
+
+
+@pytest.mark.chaos
+def test_seal_fault_counts_losses_writer_survives(tmp_path):
+    """The ``audit.seal`` chaos site: the first seal attempt fails —
+    exactly that segment's records are lost (audit.seal_errors + one
+    audit.dropped per record), the writer keeps draining, and the NEXT
+    segment seals durably. record() never raised into the caller."""
+    reg = Registry()
+    led = _ledger(tmp_path, registry=reg, seal_every=2)
+    prev = faultinject.arm({
+        "audit.seal": {"kind": "error", "on_calls": [1]},
+    })
+    try:
+        for i in range(4):
+            assert led.record(_rows(2, seed=i), np.array([0.1, 0.9]))
+        led.close()
+    finally:
+        faultinject.arm(prev)
+    c = reg.snapshot()["counters"]
+    assert c["audit.seal_errors"] == 1
+    assert c["audit.dropped"] == 2       # the failed segment's records
+    assert c["audit.sealed_segments"] == 1
+    recs = [r for r, _p in audit_lib.iter_records(str(tmp_path / "audit"),
+                                                  strict=True)]
+    assert len(recs) == 2                # the surviving segment
+
+
+def test_kill9_mid_spool_loses_only_unsealed_tail(tmp_path):
+    """Crash semantics: SIGKILL with records in flight loses at most
+    the unsealed tail; sealed segments replay cleanly; a restarted
+    ledger resumes a FRESH segment number, never rewriting history."""
+    audit_dir = str(tmp_path / "audit")
+    child = textwrap.dedent(f"""
+        import os, signal, sys, time
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        from jama16_retina_tpu.obs import audit
+        from jama16_retina_tpu.obs.registry import Registry
+        led = audit.AuditLedger({audit_dir!r}, registry=Registry(),
+                                seal_every=2)
+        imgs = np.zeros((2, 2, 2, 3), np.uint8)
+        for i in range(4):
+            led.record(imgs, np.full(2, 0.5), generation=i)
+        led.flush()                      # 2 sealed segments
+        led.record(imgs, np.full(2, 0.5), generation=4)  # unsealed tail
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    r = subprocess.run([sys.executable, "-c", child],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == -signal.SIGKILL, r.stderr
+    segs = audit_lib.segment_paths(audit_dir)
+    assert [os.path.basename(p) for p in segs] == [
+        "seg-000000.json", "seg-000001.json",
+    ]
+    before = [open(p, "rb").read() for p in segs]
+    recs = [rec for rec, _p in audit_lib.iter_records(audit_dir,
+                                                      strict=True)]
+    assert [rec["generation"] for rec in recs] == [0, 1, 2, 3]
+    # Restart: a fresh segment number after the existing maximum.
+    led = audit_lib.AuditLedger(audit_dir, registry=Registry(),
+                                seal_every=1)
+    assert led.record(np.zeros((1, 2, 2, 3), np.uint8),
+                      np.array([0.5]), generation=5)
+    led.close()
+    assert [os.path.basename(p)
+            for p in audit_lib.segment_paths(audit_dir)] == [
+        "seg-000000.json", "seg-000001.json", "seg-000002.json",
+    ]
+    after = [open(p, "rb").read() for p in segs]
+    assert before == after               # sealed history untouched
+
+
+# ---------------------------------------------------------------------------
+# fsck classification + retention GC
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.integrity
+def test_fsck_classifies_corrupt_audit_segment_quarantine(tmp_path):
+    """A bit-flipped sealed audit segment classifies CORRUPT with
+    artifact class ``audit`` (counted integrity.corrupt.audit) and
+    repairs by QUARANTINE — an audit record is evidence, never a
+    derivable corpse to delete; the clean segment is untouched."""
+    wd = str(tmp_path)
+    led = audit_lib.AuditLedger(os.path.join(wd, "audit"),
+                                registry=Registry(), seal_every=1)
+    led.record(_rows(2), np.array([0.1, 0.9]), trace_id="keep")
+    led.record(_rows(2, seed=1), np.array([0.2, 0.8]), trace_id="flip")
+    led.close()
+    reg = Registry()
+    assert fsck_lib.fsck_workdir(wd, registry=reg).clean
+    seg1 = os.path.join(wd, "audit", "seg-000001.json")
+    blob = bytearray(open(seg1, "rb").read())
+    i = blob.find(b"flip")
+    blob[i] ^= 0x01
+    open(seg1, "wb").write(bytes(blob))
+    # A torn (half-written lookalike) file in the audit dir classifies
+    # too — the name-based walk needs no parseable payload.
+    torn = os.path.join(wd, "audit", "seg-000099.json")
+    open(torn, "w").write('{"kind": "audit_se')
+    reg = Registry()
+    report = fsck_lib.fsck_workdir(wd, registry=reg)
+    bad = [f for f in report.findings if f.artifact == "audit"]
+    assert {os.path.basename(f.path) for f in bad} \
+        == {"seg-000001.json", "seg-000099.json"}
+    assert all(f.status == "CORRUPT" and f.repair == "quarantine"
+               for f in bad)
+    assert reg.snapshot()["counters"]["integrity.corrupt.audit"] >= 1
+    ledger = fsck_lib.repair_workdir(wd, report=report,
+                                     registry=Registry())
+    acts = {(a["action"], os.path.basename(a["path"]))
+            for a in ledger["actions"]}
+    assert ("quarantine", "seg-000001.json") in acts
+    # The clean segment survived and still reads strict.
+    recs = [r for r, _p in audit_lib.iter_records(
+        os.path.join(wd, "audit"), strict=True)]
+    assert [r["trace_id"] for r in recs] == ["keep"]
+
+
+@pytest.mark.integrity
+def test_retention_prunes_oldest_segments_with_captures(tmp_path):
+    """obs.audit.retention=2 over 4 sealed segments: the 2 oldest are
+    planned for deletion WITH their captured tensors; retention<=0
+    (the default) keeps everything."""
+    wd = str(tmp_path)
+    led = audit_lib.AuditLedger(os.path.join(wd, "audit"),
+                                registry=Registry(), seal_every=1,
+                                capture=True)
+    for i in range(4):
+        led.record(_rows(1, seed=i), np.array([0.5]), trace_id=f"t{i}")
+    led.close()
+    segs = audit_lib.segment_paths(os.path.join(wd, "audit"))
+    assert len(segs) == 4
+    caps = sorted(os.listdir(os.path.join(wd, "audit", "capture")))
+    assert len(caps) == 4
+
+    cfg = get_config("smoke")
+    plan = retention_lib.plan_retention(wd, cfg)  # retention=0 default
+    assert not [a for a in plan.actions if a.cls == "audit"]
+
+    cfg = override(cfg, ["obs.audit.retention=2"])
+    plan = retention_lib.plan_retention(wd, cfg)
+    planned = {os.path.basename(a.path) for a in plan.actions
+               if a.cls == "audit"}
+    assert planned == {"seg-000000.json", "seg-000001.json",
+                       caps[0], caps[1]}
+    retention_lib.apply_plan(plan, registry=Registry())
+    assert [os.path.basename(p) for p in audit_lib.segment_paths(
+        os.path.join(wd, "audit"))] == ["seg-000002.json",
+                                        "seg-000003.json"]
+    assert sorted(os.listdir(os.path.join(wd, "audit", "capture"))) \
+        == caps[2:]
+
+
+# ---------------------------------------------------------------------------
+# Fused-batch attribution through the router (ISSUE 16 seam)
+# ---------------------------------------------------------------------------
+
+
+class _Stub:
+    """Deterministic stub replica (test_router idiom)."""
+
+    def __init__(self, rid, scale=1.0):
+        self.rid = rid
+        self.generation = 100 + rid
+        self.scale = scale
+
+    def probs(self, rows):
+        return self.scale * rows.reshape(
+            rows.shape[0], -1).astype(np.float64).sum(axis=1)
+
+
+@pytest.mark.router
+def test_fused_bin_demuxes_one_audit_record_per_request(tmp_path):
+    """THE fused-batch audit pin: two tenants fused into ONE dispatch
+    bin yield one audit record PER REQUEST SLICE — each carrying its
+    own trace id, model, rows, and scores — and the
+    serve.router.bin.parts event mirrors the same attribution into the
+    stitched trace."""
+    import dataclasses
+
+    from jama16_retina_tpu.serve.router import Router
+
+    cfg = get_config("smoke")
+    cfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, max_batch=8, bucket_sizes=(8,), max_wait_ms=100.0,
+        router_tick_ms=1.0, router_fusion=True,
+    ))
+    rows_a, rows_b = _rows(4, seed=1), _rows(4, seed=2)
+    led = _ledger(tmp_path, seal_every=1)
+    router = Router(cfg, engines={"a": [_Stub(0)], "b": [_Stub(1, 3.0)]},
+                    registry=Registry())
+    router.audit = led
+    tracer = obs_trace.default_tracer()
+    prev_enabled = tracer.enabled
+    tracer.configure(enabled=True)
+    try:
+        fa = router.submit(rows_a, model="a")
+        fb = router.submit(rows_b, model="b")
+        out_a = np.asarray(fa.result(timeout=30))
+        out_b = np.asarray(fb.result(timeout=30))
+        events = [e for e in tracer.events()
+                  if e["name"] == "serve.router.bin.parts"]
+    finally:
+        tracer.configure(enabled=prev_enabled)
+        router.close()
+        led.close()
+    recs = [r for r, _p in audit_lib.iter_records(str(tmp_path / "audit"),
+                                                  strict=True)]
+    assert len(recs) == 2
+    by_model = {r["model"]: r for r in recs}
+    assert set(by_model) == {"a", "b"}
+    tids = {r["trace_id"] for r in recs}
+    assert None not in tids and len(tids) == 2
+    np.testing.assert_array_equal(
+        np.asarray(by_model["a"]["scores"]), out_a)
+    np.testing.assert_array_equal(
+        np.asarray(by_model["b"]["scores"]), out_b)
+    assert by_model["a"]["input_sha256"] == audit_lib.row_digests(rows_a)
+    assert by_model["b"]["input_sha256"] == audit_lib.row_digests(rows_b)
+    # Satellite 1: the fused bin's trace event names every part.
+    assert len(events) == 1
+    parts = events[0]["args"]["parts"]
+    assert {p["trace_id"] for p in parts} == tids
+    assert {p["model"] for p in parts} == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# Lineage chain + replay verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_lineage_chain_renders_promoting_cycle(tmp_path):
+    jdir = str(tmp_path / "lifecycle")
+    j = Journal(jdir)
+    j.append("DRIFT_DETECTED", cycle=3, reason="psi",
+             live_member_dirs=["/old/member_00"])
+    j.append("RETRAIN", cycle=3, member_dirs=["/new/member_00"],
+             data_manifest={"path": "/data/manifest.json",
+                            "sha256": "abc"})
+    j.append("GATE", cycle=3, verdicts=[{"gate": "auc", "passed": True}])
+    j.append("STAGED_ROLLOUT", cycle=3, generation=9)
+    j.append("COMMIT", cycle=3, generation=9)
+    rec = {"trace_id": "t-9", "generation": 9,
+           "member_dirs": ["/new/member_00"], "serve_dtype": "fp32"}
+    chain = audit_lib.lineage_chain(rec, jdir)
+    assert chain["cycle"] == 3
+    assert chain["drift"]["reason"] == "psi"
+    assert chain["warm_start_donors"] == ["/old/member_00"]
+    assert chain["gate_verdicts"] == [{"gate": "auc", "passed": True}]
+    assert chain["data_manifest"]["path"] == "/data/manifest.json"
+    assert chain["commit"]["generation"] == 9
+    # Journal-less: every present link renders, none is invented.
+    bare = audit_lib.lineage_chain(rec, None)
+    assert bare["cycle"] is None and bare["generation"] == 9
+
+
+def test_replay_typed_refusal_verdicts(tmp_path):
+    """The cheap verdict paths, no engine assembled: missing lineage,
+    a swapped checkpoint (digest mismatch), capture-less records, and
+    a cascade record without its sealed escalation mask."""
+    audit_dir = str(tmp_path)
+    member = tmp_path / "member_00"
+    member.mkdir()
+    (member / "w.bin").write_bytes(b"x")
+    base = {"trace_id": "t", "serve_dtype": "fp32", "scores": [0.5],
+            "input_sha256": [], "config": {"name": "smoke",
+                                           "overrides": []}}
+    v = audit_lib.replay_record({**base, "member_dirs": None},
+                                audit_dir)
+    assert (not v.ok) and v.kind == "lineage_changed"
+    v = audit_lib.replay_record(
+        {**base, "member_dirs": [str(member)],
+         "member_digests": {str(member): "0" * 64}}, audit_dir)
+    assert (not v.ok) and v.kind == "lineage_changed"
+    good = {str(member): audit_lib.checkpoint_digest(str(member))}
+    v = audit_lib.replay_record(
+        {**base, "member_dirs": [str(member)], "member_digests": good},
+        audit_dir)
+    assert (not v.ok) and v.kind == "no_capture"
+    v = audit_lib.replay_record(
+        {**base, "member_dirs": [str(member)], "member_digests": good,
+         "capture": {"file": "nope.npy", "sha256": "0" * 64},
+         "cascade": {"student_dirs": ["/s"], "escalated": None}},
+        audit_dir)
+    assert (not v.ok) and v.kind == "unreplayable"
+
+
+def test_capture_roundtrip_and_tamper_refused(tmp_path):
+    led = _ledger(tmp_path, capture=True, seal_every=1)
+    rows = _rows(2, seed=7)
+    led.record(rows, np.array([0.1, 0.9]), trace_id="c-1")
+    led.close()
+    audit_dir = str(tmp_path / "audit")
+    rec = audit_lib.find_records(audit_dir, "c-1")[0]
+    got = audit_lib.load_captured(audit_dir, rec)
+    np.testing.assert_array_equal(got, rows)
+    cap = os.path.join(audit_dir, rec["capture"]["file"])
+    blob = bytearray(open(cap, "rb").read())
+    blob[-1] ^= 0xFF
+    open(cap, "wb").write(bytes(blob))
+    with pytest.raises(artifact_lib.ArtifactCorrupt):
+        audit_lib.load_captured(audit_dir, rec)
+
+
+def test_replay_real_engine_bit_equal_and_mismatch(tmp_path):
+    """THE replay acceptance pin on a real XLA engine through the real
+    router path: serve -> sealed record -> reassemble the recorded
+    generation -> fp32 scores BIT-identical. A tampered sealed score
+    then yields a typed score_mismatch and an audit_replay_mismatch
+    blackbox dump."""
+    import dataclasses
+
+    import jax
+
+    from jama16_retina_tpu import models, train_lib
+    from jama16_retina_tpu.serve.router import Router
+    from jama16_retina_tpu.utils import checkpoint as ckpt_lib
+
+    size = 32
+    overrides = (f"model.image_size={size}",)
+    cfg = override(get_config("smoke"), list(overrides))
+    cfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, max_batch=4, bucket_sizes=(4,), max_wait_ms=5.0,
+        router_tick_ms=1.0,
+    ))
+    model = models.build(cfg.model)
+    state, _ = train_lib.create_state(cfg, model, jax.random.key(0))
+    member = str(tmp_path / "member_00")
+    ck = ckpt_lib.Checkpointer(member)
+    ck.save(1, jax.device_get(state), {"val_auc": 0.5})
+    ck.wait()
+    ck.close()
+    from jama16_retina_tpu.serve.assemble import EngineSpec, assemble
+
+    engine = assemble(EngineSpec(cfg=cfg, member_dirs=(member,),
+                                 model=model))
+    led = _ledger(tmp_path, seal_every=1, capture=True,
+                  thresholds=(0.5,), config_name="smoke",
+                  config_overrides=overrides)
+    imgs = np.random.default_rng(3).integers(
+        0, 256, (4, size, size, 3), np.uint8)
+    router = Router(cfg, engines=[engine], registry=Registry())
+    router.audit = led
+    try:
+        served = np.asarray(router.submit(imgs).result(timeout=120))
+    finally:
+        router.close()
+        led.close()
+    audit_dir = str(tmp_path / "audit")
+    recs = [r for r, _p in audit_lib.iter_records(audit_dir,
+                                                  strict=True)]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["member_dirs"] == [member]
+    np.testing.assert_array_equal(np.asarray(rec["scores"]), served)
+    v = audit_lib.replay_record(rec, audit_dir,
+                                workdir=str(tmp_path / "wd"))
+    assert v.ok and v.kind == "bit_equal" and v.max_abs_dev == 0.0
+    # Tampered sealed score: typed mismatch + blackbox forensics.
+    tampered = dict(rec, scores=(np.asarray(rec["scores"]) + 1e-3
+                                 ).tolist())
+    v = audit_lib.replay_record(tampered, audit_dir,
+                                workdir=str(tmp_path / "wd"))
+    assert (not v.ok) and v.kind == "score_mismatch"
+    dumps = [d for _b, dirs, _f in os.walk(str(tmp_path / "wd"))
+             for d in dirs if "audit_replay_mismatch" in d]
+    assert dumps
+
+
+# ---------------------------------------------------------------------------
+# Operator surfaces: /healthz, obs_report, audit_query CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.obs
+def test_healthz_carries_audit_writer_fields():
+    from jama16_retina_tpu.obs.httpd import ObsHttp
+
+    reg = Registry()
+    srv = ObsHttp(reg, port=0)
+    try:
+        _status, detail = srv.health(now=1000.0)
+        assert detail["audit_spool_depth"] is None
+        assert detail["audit_last_seal_age_s"] is None
+        reg.gauge("audit.spool_depth", help="t").set(3)
+        reg.gauge("audit.last_seal_t", help="t").set(900.0)
+        _status, detail = srv.health(now=1000.0)
+        assert detail["audit_spool_depth"] == 3
+        assert detail["audit_last_seal_age_s"] == 100.0
+    finally:
+        srv.close()
+
+
+@pytest.mark.obs
+def test_obs_report_audit_section_and_wedged_blame(tmp_path):
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    import obs_report
+
+    telemetry = {"kind": "telemetry", "t": 1000.0, "process_index": 0,
+                 "counters": {"audit.records": 10, "audit.rows": 40,
+                              "audit.dropped": 2,
+                              "audit.sealed_segments": 5,
+                              "audit.seal_errors": 1,
+                              "audit.captured": 10},
+                 "gauges": {"audit.spool_depth": 4,
+                            "audit.last_seal_t": 700.0}}
+    records = [telemetry,
+               {"kind": "audit_replay", "ok": True, "trace_id": "t"}]
+    s = obs_report.audit_summary(records)
+    assert s["records"] == 10 and s["rows"] == 40
+    assert s["drop_rate"] == pytest.approx(2 / 12)
+    assert s["seal_lag_s"] == 300.0
+    assert s["replays"]["total"] == 1
+    text = obs_report.render_audit(records)
+    assert "Audit & provenance" in text and "records audited: 10" in text
+    assert obs_report.audit_summary([{"kind": "train"}]) is None
+
+    # Wedged-writer blame: heartbeats fresh, spool nonempty, nothing
+    # sealed for longer than the threshold -> exit 1 naming the writer.
+    from jama16_retina_tpu.utils.logging import RunLog
+
+    wd = str(tmp_path)
+    log = RunLog(wd)
+    log.write("heartbeat", step=5, last_progress_t=990.0, t=995.0)
+    log.write("telemetry", t=1000.0, counters={},
+              gauges={"audit.spool_depth": 4,
+                      "audit.last_seal_t": 100.0})
+    log.close()
+    code, msg = obs_report.check_heartbeats(wd, max_age_s=300.0,
+                                            now=1000.0)
+    assert code == 1 and "wedged audit writer" in msg
+    # A drained spool clears the blame.
+    log = RunLog(wd)
+    log.write("telemetry", t=1001.0, counters={},
+              gauges={"audit.spool_depth": 0,
+                      "audit.last_seal_t": 100.0})
+    log.close()
+    code, msg = obs_report.check_heartbeats(wd, max_age_s=300.0,
+                                            now=1000.0)
+    assert code == 0, msg
+
+
+def test_ledger_for_gating_and_dir_resolution(tmp_path):
+    cfg = get_config("smoke")
+    assert audit_lib.ledger_for(cfg, str(tmp_path)) is None  # disabled
+    cfg = override(cfg, ["obs.audit.enabled=true"])
+    assert audit_lib.ledger_for(cfg, None) is None    # no dir anywhere
+    led = audit_lib.ledger_for(cfg, str(tmp_path), registry=Registry())
+    assert led is not None
+    assert led.dir == os.path.join(str(tmp_path), "audit")
+    assert led.sample == 1.0 and led.seal_every == 64
+    led.close()
+    cfg = override(cfg, [f"obs.audit.dir={tmp_path}/elsewhere",
+                         "obs.audit.sample=0.25",
+                         "obs.audit.seal_every=8",
+                         "obs.audit.queue_max=16"])
+    led = audit_lib.ledger_for(cfg, None, registry=Registry())
+    assert led.dir == f"{tmp_path}/elsewhere"
+    assert led._every == 4 and led.seal_every == 8
+    assert led._q.maxsize == 16
+    led.close()
+
+
+def test_audit_query_cli_list_trace_and_exit_codes(tmp_path):
+    led = _ledger(tmp_path, seal_every=1, thresholds=(0.5,))
+    led.record(_rows(2), np.array([0.2, 0.8]), trace_id="cli-1",
+               generation=0)
+    led.close()
+    audit_dir = str(tmp_path / "audit")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    q = os.path.join(_REPO, "scripts", "audit_query.py")
+
+    def run(*args):
+        return subprocess.run([sys.executable, q, *args],
+                              capture_output=True, text=True, env=env,
+                              timeout=300)
+
+    r = run("list", audit_dir, "--json")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["records"][0]["trace_id"] == "cli-1"
+    r = run("trace", "cli-1", f"--audit-dir={audit_dir}")
+    assert r.returncode == 0 and "cli-1" in r.stdout
+    assert "no promoting lifecycle cycle" in r.stdout
+    r = run("trace", "missing-id", f"--audit-dir={audit_dir}")
+    assert r.returncode == 1
